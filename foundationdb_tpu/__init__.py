@@ -1,0 +1,33 @@
+"""foundationdb_tpu: a TPU-native distributed transactional key-value framework.
+
+A brand-new framework with the capabilities of FoundationDB (reference:
+/root/reference, v7.1): ordered keys, strict-serializable ACID transactions,
+an optimistic-concurrency commit pipeline (GRV proxies -> commit proxies ->
+resolvers -> transaction logs -> versioned storage servers), epoch-based
+recovery, and deterministic simulation testing.
+
+It is NOT a port.  The compute-heavy heart of the commit pipeline -- the
+Resolver's per-batch range-conflict detection (reference:
+fdbserver/Resolver.actor.cpp:104, fdbserver/SkipList.cpp) -- is reformulated
+TPU-first as a batched interval-overlap kernel in JAX/Pallas over HBM-resident
+sorted key-digest arrays, shardable over a `jax.sharding.Mesh` by key range
+with OR-reduced (psum) conflict bitmaps.  The host runtime (actors, RPC,
+simulation, roles) is a deterministic event-loop runtime in Python with native
+C++ components under native/.
+
+Layer map (mirrors reference layering flow -> fdbrpc -> fdbclient -> fdbserver):
+  core/      -- futures, deterministic scheduler, RNG, knobs, trace, buggify
+  rpc/       -- typed request streams over a simulated (or real) network
+  txn/       -- transaction payload types (mutations, conflict ranges, versions)
+  conflict/  -- ConflictSet implementations: CPU oracle + TPU backend selector
+  ops/       -- JAX/Pallas device kernels (digest compare, search, range-max)
+  parallel/  -- mesh sharding of the conflict window, collectives
+  server/    -- roles: master, grv proxy, commit proxy, resolver, tlog, storage
+  client/    -- Database/Transaction API with RYW semantics and retry loop
+  sim/       -- deterministic cluster simulation harness
+  workloads/ -- composable test workloads (Cycle, ConflictRange model check, ...)
+  models/    -- flagship end-to-end pipeline model used by __graft_entry__
+  utils/     -- misc helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,31 @@
+"""Core runtime: futures/actors, deterministic event loop, RNG, knobs, trace.
+
+Equivalent layer to the reference's flow/ (see SURVEY.md L0)."""
+
+from .error import ActorCancelled, ERROR_CODES, FdbError, err
+from .futures import (AsyncTrigger, AsyncVar, Future, FutureStream, Promise,
+                      PromiseStream, error_future, map_future, quorum,
+                      ready_future, wait_all, wait_any)
+from .scheduler import (EventLoop, TaskPriority, delay, get_event_loop, now,
+                        set_event_loop, spawn, yield_now)
+from .rng import (DeterministicRandom, deterministic_random,
+                  nondeterministic_random, set_deterministic_random)
+from .buggify import buggify, buggify_enabled, enable_buggify
+from .trace import Severity, TraceEvent, Tracer, get_tracer, set_tracer
+from .knobs import (ClientKnobs, Knobs, ServerKnobs, client_knobs, get_knobs,
+                    server_knobs, set_knobs)
+
+__all__ = [
+    "ActorCancelled", "ERROR_CODES", "FdbError", "err",
+    "AsyncTrigger", "AsyncVar", "Future", "FutureStream", "Promise",
+    "PromiseStream", "error_future", "map_future", "quorum", "ready_future",
+    "wait_all", "wait_any",
+    "EventLoop", "TaskPriority", "delay", "get_event_loop", "now",
+    "set_event_loop", "spawn", "yield_now",
+    "DeterministicRandom", "deterministic_random", "nondeterministic_random",
+    "set_deterministic_random",
+    "buggify", "buggify_enabled", "enable_buggify",
+    "Severity", "TraceEvent", "Tracer", "get_tracer", "set_tracer",
+    "ClientKnobs", "Knobs", "ServerKnobs", "client_knobs", "get_knobs",
+    "server_knobs", "set_knobs",
+]

@@ -1,0 +1,41 @@
+"""BUGGIFY fault-injection sites (reference flow/flow.h:80-89).
+
+A buggify site is identified by a string name. In simulation, each site is
+deterministically enabled with probability P_BUGGIFIED_SECTION_ACTIVATED per
+run; an enabled site then fires with P_BUGGIFIED_SECTION_FIRES per evaluation.
+Outside simulation buggify() is always False.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .rng import deterministic_random
+
+P_ACTIVATED = 0.25
+P_FIRES = 0.25
+
+_enabled = False
+_site_active: Dict[str, bool] = {}
+
+
+def enable_buggify(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+    _site_active.clear()
+
+
+def buggify_enabled() -> bool:
+    return _enabled
+
+
+def buggify(site: str) -> bool:
+    """True (rarely, deterministically) when fault injection should happen."""
+    if not _enabled:
+        return False
+    rng = deterministic_random()
+    active = _site_active.get(site)
+    if active is None:
+        active = rng.random01() < P_ACTIVATED
+        _site_active[site] = active
+    return active and rng.random01() < P_FIRES

@@ -1,0 +1,82 @@
+"""Error codes for the framework.
+
+Mirrors the reference's flow/error_definitions.h error-code contract (the
+codes themselves follow the reference's public wire protocol so that clients
+behave identically on retryable vs fatal errors)."""
+
+from __future__ import annotations
+
+
+class FdbError(Exception):
+    """An error with a FoundationDB-compatible numeric code."""
+
+    def __init__(self, code: int, name: str = "", message: str = ""):
+        self.code = code
+        self.name = name or _CODE_TO_NAME.get(code, f"error_{code}")
+        super().__init__(message or self.name)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"FdbError({self.code}, {self.name!r})"
+
+    @property
+    def is_retryable(self) -> bool:
+        return self.code in _RETRYABLE
+
+
+# Subset of reference flow/error_definitions.h codes used by this framework.
+ERROR_CODES = {
+    "success": 0,
+    "end_of_stream": 1,
+    "operation_failed": 1000,
+    "timed_out": 1004,
+    "coordinated_state_conflict": 1005,
+    "future_version": 1009,
+    "process_behind": 1037,
+    "transaction_too_old": 1007,
+    "not_committed": 1020,
+    "commit_unknown_result": 1021,
+    "transaction_cancelled": 1025,
+    "transaction_timed_out": 1031,
+    "broken_promise": 1100,
+    "operation_cancelled": 1101,
+    "future_released": 1102,
+    "connection_failed": 1026,
+    "request_maybe_delivered": 1034,
+    "master_recovery_failed": 1201,
+    "tlog_stopped": 1206,
+    "worker_removed": 1202,
+    "please_reboot": 1207,
+    "transaction_too_large": 2101,
+    "key_too_large": 2102,
+    "value_too_large": 2103,
+    "used_during_commit": 2017,
+    "key_outside_legal_range": 2003,
+    "inverted_range": 2005,
+    "client_invalid_operation": 2000,
+    "unknown_error": 4000,
+    "internal_error": 4100,
+}
+
+_CODE_TO_NAME = {v: k for k, v in ERROR_CODES.items()}
+
+# Per reference fdbclient/NativeAPI.actor.cpp onError(): these are the errors a
+# client transaction retry loop handles by restarting the transaction.
+_RETRYABLE = {
+    ERROR_CODES["not_committed"],
+    ERROR_CODES["transaction_too_old"],
+    ERROR_CODES["future_version"],
+    ERROR_CODES["commit_unknown_result"],
+    ERROR_CODES["process_behind"],
+    ERROR_CODES["request_maybe_delivered"],
+}
+
+
+def err(name: str, message: str = "") -> FdbError:
+    return FdbError(ERROR_CODES[name], name, message)
+
+
+class ActorCancelled(BaseException):
+    """Raised inside an actor coroutine when its future is cancelled.
+
+    Derives from BaseException (like asyncio.CancelledError) so ordinary
+    `except Exception` handlers do not swallow cancellation."""

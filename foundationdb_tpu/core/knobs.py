@@ -1,0 +1,143 @@
+"""Knob (tuning constant) registry with BUGGIFY randomization.
+
+Reference: flow/Knobs.h/.cpp, fdbclient/ServerKnobs.cpp, ClientKnobs.cpp.
+Knobs are typed named constants, overridable at startup, and in simulation a
+subset is randomized per-seed (`if (randomize && BUGGIFY) knob = ...`) to
+widen test coverage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .rng import DeterministicRandom
+
+
+class KnobBase:
+    """Subclass sets attributes in __init__; randomizers registered alongside."""
+
+    def __init__(self) -> None:
+        self._randomizers: List[Tuple[str, Callable[[DeterministicRandom], Any]]] = []
+
+    def _rand(self, name: str, fn: Callable[[DeterministicRandom], Any]) -> None:
+        self._randomizers.append((name, fn))
+
+    def randomize(self, rng: DeterministicRandom, p: float = 0.5) -> None:
+        """Apply each registered randomizer with probability p (sim only)."""
+        for name, fn in self._randomizers:
+            if rng.random01() < p:
+                setattr(self, name, fn(rng))
+
+    def override(self, overrides: Dict[str, Any]) -> None:
+        for k, v in overrides.items():
+            if not hasattr(self, k):
+                raise KeyError(f"unknown knob {k}")
+            setattr(self, k, v)
+
+
+class FlowKnobs(KnobBase):
+    def __init__(self) -> None:
+        super().__init__()
+        self.DELAY_JITTER_OFFSET = 0.9
+        self.DELAY_JITTER_RANGE = 0.2
+        self.HUGE_ARENA_LOGGING_BYTES = 100e6
+
+
+class ServerKnobs(KnobBase):
+    """Server-side knobs. Values follow the reference's published defaults
+    (fdbclient/ServerKnobs.cpp) where the semantics carry over."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        # Versions (reference ServerKnobs.cpp:32-36)
+        self.VERSIONS_PER_SECOND = 1_000_000
+        self.MAX_READ_TRANSACTION_LIFE_VERSIONS = 5 * self.VERSIONS_PER_SECOND
+        self.MAX_WRITE_TRANSACTION_LIFE_VERSIONS = 5 * self.VERSIONS_PER_SECOND
+        self.MAX_VERSIONS_IN_FLIGHT = 100 * self.VERSIONS_PER_SECOND
+        self.MAX_COMMIT_BATCH_INTERVAL = 2.0
+
+        # Commit batching (reference ServerKnobs.cpp:376-387)
+        self.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN = 0.001
+        self.COMMIT_TRANSACTION_BATCH_INTERVAL_MAX = 0.020
+        self.COMMIT_TRANSACTION_BATCH_COUNT_MAX = 32768
+        self.COMMIT_TRANSACTION_BATCH_BYTES_MAX = 8 << 20
+        self.RESOLVER_COALESCE_TIME = 1.0
+
+        # Resolver (reference ServerKnobs.cpp:439)
+        self.RESOLVER_STATE_MEMORY_LIMIT = 1_000_000
+        self.KEY_BYTES_PER_SAMPLE = 2e4
+
+        # Conflict-set backend selector -- OUR north-star gate. "cpu" = oracle
+        # skip-structure; "tpu" = JAX device kernel; "auto" = tpu for large
+        # batches with cpu fallback below TPU_CONFLICT_MIN_BATCH.
+        self.CONFLICT_SET_BACKEND = "cpu"
+        self.TPU_CONFLICT_MIN_BATCH = 64
+        self.TPU_CONFLICT_CAPACITY = 1 << 20  # max resident history segments
+        self.TPU_CONFLICT_MAX_RANGES = 1 << 14  # per-batch padded range budget
+
+        # GRV / ratekeeper
+        self.START_TRANSACTION_BATCH_INTERVAL_MIN = 1e-6
+        self.START_TRANSACTION_BATCH_INTERVAL_MAX = 0.010
+        self.START_TRANSACTION_MAX_BUDGET_SIZE = 20
+
+        # Storage
+        self.STORAGE_DURABILITY_LAG_SOFT_MAX = 250e6
+        self.DESIRED_TOTAL_BYTES = 150000
+        self.STORAGE_LIMIT_BYTES = 500000
+
+        # TLog
+        self.TLOG_SPILL_THRESHOLD = 1500e6
+        self.UPDATE_STORAGE_BYTE_LIMIT = 1e6
+        self.MAX_COMMIT_UPDATES = 2000
+
+        self._rand("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX",
+                   lambda r: r.random01() * 0.1 + 0.001)
+        self._rand("RESOLVER_STATE_MEMORY_LIMIT", lambda r: 3e6)
+        self._rand("TPU_CONFLICT_MIN_BATCH", lambda r: r.random_int(1, 256))
+
+
+class ClientKnobs(KnobBase):
+    def __init__(self) -> None:
+        super().__init__()
+        self.MAX_BATCH_SIZE = 1000
+        self.GRV_BATCH_TIMEOUT = 0.005
+        self.DEFAULT_BACKOFF = 0.01
+        self.DEFAULT_MAX_BACKOFF = 1.0
+        self.BACKOFF_GROWTH_RATE = 2.0
+        self.TRANSACTION_SIZE_LIMIT = 1 << 24
+        self.KEY_SIZE_LIMIT = 10000
+        self.VALUE_SIZE_LIMIT = 100000
+
+
+class Knobs:
+    """Process-wide knob singleton bundle."""
+
+    def __init__(self) -> None:
+        self.flow = FlowKnobs()
+        self.server = ServerKnobs()
+        self.client = ClientKnobs()
+
+    def randomize(self, rng: DeterministicRandom) -> None:
+        self.flow.randomize(rng)
+        self.server.randomize(rng)
+        self.client.randomize(rng)
+
+
+_knobs = Knobs()
+
+
+def get_knobs() -> Knobs:
+    return _knobs
+
+
+def set_knobs(k: Knobs) -> None:
+    global _knobs
+    _knobs = k
+
+
+def server_knobs() -> ServerKnobs:
+    return _knobs.server
+
+
+def client_knobs() -> ClientKnobs:
+    return _knobs.client

@@ -1,0 +1,77 @@
+"""Deterministic randomness (reference flow/DeterministicRandom.h).
+
+ALL randomness inside a simulation must come from deterministic_random() so a
+run is reproducible from its seed.  nondeterministic_random() exists for IDs
+that must not perturb replay (reference flow/IRandom.h g_nondeterministic_random).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+class DeterministicRandom:
+    def __init__(self, seed: int) -> None:
+        self.seed = seed
+        self._r = random.Random(seed)
+
+    def random01(self) -> float:
+        return self._r.random()
+
+    def random_int(self, lo: int, hi: int) -> int:
+        """Uniform integer in [lo, hi) (reference randomInt semantics)."""
+        return self._r.randrange(lo, hi)
+
+    def random_int64(self, lo: int, hi: int) -> int:
+        return self._r.randrange(lo, hi)
+
+    def random_unique_id(self) -> str:
+        return f"{self._r.getrandbits(64):016x}{self._r.getrandbits(64):016x}"
+
+    def random_alpha_numeric(self, length: int) -> str:
+        chars = "abcdefghijklmnopqrstuvwxyz0123456789"
+        return "".join(self._r.choice(chars) for _ in range(length))
+
+    def random_bytes(self, length: int) -> bytes:
+        return self._r.getrandbits(8 * length).to_bytes(length, "little") if length else b""
+
+    def random_choice(self, seq: Sequence[T]) -> T:
+        return seq[self.random_int(0, len(seq))]
+
+    def random_skewed_uint32(self, lo: int, hi: int) -> int:
+        """Log-uniform in [lo, hi) (reference randomSkewedUInt32)."""
+        import math
+        min_l = math.log2(max(lo, 1))
+        max_l = math.log2(hi)
+        return min(int(2 ** (min_l + self.random01() * (max_l - min_l))), hi - 1)
+
+    def shuffle(self, lst: list) -> None:
+        self._r.shuffle(lst)
+
+    def coinflip(self) -> bool:
+        return self.random01() < 0.5
+
+
+_det: Optional[DeterministicRandom] = None
+# Seeded from OS entropy: IDs from this generator must differ across processes
+# and runs (they exist precisely to NOT be replayable).
+_nondet = DeterministicRandom(int.from_bytes(__import__("os").urandom(8), "little"))
+
+
+def set_deterministic_random(rng: DeterministicRandom) -> None:
+    global _det
+    _det = rng
+
+
+def deterministic_random() -> DeterministicRandom:
+    global _det
+    if _det is None:
+        _det = DeterministicRandom(1)
+    return _det
+
+
+def nondeterministic_random() -> DeterministicRandom:
+    return _nondet

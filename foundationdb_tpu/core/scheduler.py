@@ -1,0 +1,207 @@
+"""Deterministic event loop with virtual time (sim) or wall-clock (real).
+
+The reference runs all logic of a process on ONE network thread: a reactor
+with a priority-ordered ready queue plus timers (reference flow/Net2.actor.cpp
+Net2::run :1400, TaskPriority ordering).  In simulation the same loop runs on
+virtual time so whole clusters execute deterministically in-process
+(reference fdbrpc/sim2.actor.cpp).
+
+This loop keeps those properties:
+  * single-threaded; all actors interleave only at awaits;
+  * timers in a heap keyed (time, -priority, seq) -- seq makes ordering total
+    and deterministic;
+  * sim mode: time jumps to the next timer when the ready queue drains;
+  * real mode: sleeps until the next timer.
+
+JAX device dispatch happens inline on this thread (host-blocking); the TPU
+conflict backend pipelines device work across commit batches the same way the
+reference overlaps commit batches across pipeline stages.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time as _time
+from enum import IntEnum
+from typing import Callable, List, Optional
+
+from .error import err
+from .futures import ActorTask, Future, Promise
+
+
+class TaskPriority(IntEnum):
+    """Subset of reference flow/network.h TaskPriority (higher runs first)."""
+
+    Max = 1000000
+    RunLoop = 30000
+    CoordinationReply = 8810
+    Coordination = 8800
+    FailureMonitor = 8700
+    ResolutionMetrics = 8700
+    ClusterController = 8650
+    MasterTLogRejoin = 8646
+    ProxyCommitDispatcher = 8640
+    TLogQueuingMetrics = 8620
+    TLogPop = 8610
+    TLogPeekReply = 8600
+    TLogPeek = 8590
+    TLogCommitReply = 8580
+    TLogCommit = 8570
+    ProxyGetRawCommittedVersion = 8565
+    ProxyResolverReply = 8560
+    ProxyCommit = 8540
+    ProxyCommitBatcher = 8530
+    TLogConfirmRunningReply = 8520
+    TLogConfirmRunning = 8510
+    ProxyGRVTimer = 8505
+    GetConsistentReadVersion = 8500
+    DefaultPromiseEndpoint = 8000
+    DefaultOnMainThread = 7500
+    DefaultDelay = 7010
+    DefaultYield = 7000
+    DiskRead = 5010
+    DefaultEndpoint = 5000
+    UnknownEndpoint = 4000
+    MoveKeys = 3550
+    DataDistribution = 3500
+    StorageServer = 3000
+    UpdateStorage = 3000
+    DefaultLowPriority = 2000
+    Low = 1
+    Zero = 0
+
+
+class EventLoop:
+    """One logical process thread; the only scheduler in the framework."""
+
+    def __init__(self, sim: bool = True, start_time: float = 0.0) -> None:
+        self.sim = sim
+        self._time = start_time
+        self._epoch_real = _time.monotonic() - start_time
+        self._heap: List = []  # (time, -priority, seq, fn)
+        self._seq = 0
+        self._tasks: set = set()
+        self._stopped = False
+
+    # -- time ---------------------------------------------------------------
+    def now(self) -> float:
+        if self.sim:
+            return self._time
+        return _time.monotonic() - self._epoch_real
+
+    # -- scheduling primitives ---------------------------------------------
+    def call_at(self, when: float, fn: Callable[[], None],
+                priority: TaskPriority = TaskPriority.DefaultDelay) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (when, -int(priority), self._seq, fn))
+
+    def call_soon(self, fn: Callable[[], None],
+                  priority: TaskPriority = TaskPriority.DefaultYield) -> None:
+        self.call_at(self.now(), fn, priority)
+
+    def delay(self, seconds: float,
+              priority: TaskPriority = TaskPriority.DefaultDelay) -> Future:
+        p: Promise = Promise()
+        self.call_at(self.now() + seconds, lambda: p.send(None), priority)
+        return p.get_future()
+
+    def yield_now(self, priority: TaskPriority = TaskPriority.DefaultYield) -> Future:
+        return self.delay(0.0, priority)
+
+    # -- actors -------------------------------------------------------------
+    def spawn(self, coro, name: str = "") -> Future:
+        """Start an actor; returns its Future. Cancelling the Future cancels it."""
+        task = ActorTask(coro, self, name)
+        self._tasks.add(task)
+        self.call_soon(task._initial_step)
+        return task.future
+
+    def _task_done(self, task: ActorTask) -> None:
+        self._tasks.discard(task)
+
+    # -- running ------------------------------------------------------------
+    def run_until(self, future: Future, timeout: Optional[float] = None) -> object:
+        """Drive the loop until `future` resolves; returns its value/raises."""
+        deadline = None if timeout is None else self.now() + timeout
+        while not future.is_ready():
+            if not self._step_once(deadline):
+                if future.is_ready():
+                    break
+                if deadline is not None and (not self._heap or self._heap[0][0] > deadline):
+                    raise err("timed_out",
+                              f"run_until timed out at t={self.now():.3f}")
+                # Queue drained with no timeout: this is a deadlock, not a timeout.
+                raise err("internal_error",
+                          f"event loop drained at t={self.now():.3f} with future "
+                          "still pending (deadlocked or orphaned future)")
+        return future.get()
+
+    def run_for(self, seconds: float) -> None:
+        """Advance simulation by `seconds` of virtual time."""
+        end = self.now() + seconds
+        while self._heap and self._heap[0][0] <= end:
+            self._step_once(None)
+        if self.sim and self._time < end:
+            self._time = end
+
+    def _step_once(self, deadline: Optional[float]) -> bool:
+        """Run one scheduled callback; returns False if nothing to run."""
+        if not self._heap:
+            return False
+        when, negprio, seq, fn = self._heap[0]
+        if deadline is not None and when > deadline:
+            if self.sim:
+                self._time = deadline
+            return False
+        heapq.heappop(self._heap)
+        if self.sim:
+            if when > self._time:
+                self._time = when
+        else:
+            delta = when - self.now()
+            if delta > 0:
+                _time.sleep(delta)
+        fn()
+        return True
+
+    def drain(self, max_steps: int = 10_000_000) -> None:
+        """Run until no work remains (sim only)."""
+        steps = 0
+        while self._step_once(None):
+            steps += 1
+            if steps >= max_steps:
+                raise err("internal_error", "EventLoop.drain exceeded max_steps")
+
+
+# ---------------------------------------------------------------------------
+# Global current-loop access (the reference's g_network equivalent)
+# ---------------------------------------------------------------------------
+
+_current: Optional[EventLoop] = None
+
+
+def set_event_loop(loop: Optional[EventLoop]) -> None:
+    global _current
+    _current = loop
+
+
+def get_event_loop() -> EventLoop:
+    if _current is None:
+        raise err("internal_error", "no EventLoop installed (set_event_loop first)")
+    return _current
+
+
+def now() -> float:
+    return get_event_loop().now()
+
+
+def delay(seconds: float, priority: TaskPriority = TaskPriority.DefaultDelay) -> Future:
+    return get_event_loop().delay(seconds, priority)
+
+
+def yield_now(priority: TaskPriority = TaskPriority.DefaultYield) -> Future:
+    return get_event_loop().yield_now(priority)
+
+
+def spawn(coro, name: str = "") -> Future:
+    return get_event_loop().spawn(coro, name)

@@ -1,0 +1,102 @@
+"""Structured trace events (reference flow/Trace.h TraceEvent).
+
+TraceEvent("Name").detail("K", v).log() appends a structured record to the
+process tracer: an in-memory ring plus optional JSONL file (the reference
+writes rolling XML/JSON trace files, flow/FileTraceLogWriter.cpp).  Severity
+40 (SevError) events are test failures, as in the reference harness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+
+class Severity:
+    Debug = 5
+    Info = 10
+    Warn = 20
+    WarnAlways = 30
+    Error = 40
+
+
+class Tracer:
+    def __init__(self, ring_size: int = 20000, path: Optional[str] = None) -> None:
+        self.ring: Deque[Dict[str, Any]] = deque(maxlen=ring_size)
+        self.path = path
+        self._fh = open(path, "a", encoding="utf-8") if path else None
+        self.error_count = 0
+        self._lock = threading.Lock()
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            self.ring.append(event)
+            if event.get("Severity", 10) >= Severity.Error:
+                self.error_count += 1
+            if self._fh:
+                self._fh.write(json.dumps(event, default=str) + "\n")
+
+    def flush(self) -> None:
+        if self._fh:
+            self._fh.flush()
+
+    def find(self, type_name: str) -> List[Dict[str, Any]]:
+        return [e for e in self.ring if e.get("Type") == type_name]
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+_tracer = Tracer()
+
+
+def set_tracer(t: Tracer) -> None:
+    global _tracer
+    _tracer = t
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+class TraceEvent:
+    """Builder-style structured log record."""
+
+    __slots__ = ("_event", "_logged")
+
+    def __init__(self, type_name: str, severity: int = Severity.Info,
+                 id: str = "") -> None:
+        from .scheduler import _current
+        t = _current.now() if _current is not None else 0.0
+        self._event: Dict[str, Any] = {
+            "Type": type_name,
+            "Severity": severity,
+            "Time": round(t, 6),
+        }
+        if id:
+            self._event["ID"] = id
+        self._logged = False
+
+    def detail(self, key: str, value: Any) -> "TraceEvent":
+        self._event[key] = value
+        return self
+
+    def error(self, e: BaseException) -> "TraceEvent":
+        self._event["Error"] = repr(e)
+        return self
+
+    def log(self) -> None:
+        if not self._logged:
+            self._logged = True
+            _tracer.emit(self._event)
+
+    def __del__(self) -> None:  # auto-log on drop, like the reference
+        try:
+            self.log()
+        except Exception:  # pragma: no cover - interpreter shutdown
+            pass

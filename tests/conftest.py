@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding tests
+run without TPU hardware (the driver's dryrun does the same)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from foundationdb_tpu.core import (DeterministicRandom, EventLoop,  # noqa: E402
+                                   set_deterministic_random, set_event_loop)
+
+
+@pytest.fixture()
+def loop():
+    """Fresh deterministic sim event loop per test."""
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    set_deterministic_random(DeterministicRandom(1))
+    yield lp
+    set_event_loop(None)

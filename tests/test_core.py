@@ -1,0 +1,252 @@
+"""Tests for the core runtime (futures, actors, scheduler, streams, knobs)."""
+
+import pytest
+
+from foundationdb_tpu.core import (AsyncVar, FdbError, Future, Promise,
+                                   PromiseStream, TaskPriority, buggify,
+                                   delay, enable_buggify, err, now, quorum,
+                                   spawn, wait_all, wait_any)
+
+
+def test_promise_future_basic(loop):
+    p = Promise()
+    f = p.get_future()
+    assert not f.is_ready()
+    p.send(42)
+    assert f.is_ready() and f.get() == 42
+
+
+def test_future_error(loop):
+    p = Promise()
+    p.send_error(err("not_committed"))
+    with pytest.raises(FdbError) as ei:
+        p.get_future().get()
+    assert ei.value.code == 1020
+
+
+def test_actor_await_chain(loop):
+    async def child(x):
+        await delay(1.0)
+        return x * 2
+
+    async def parent():
+        a = await spawn(child(10))
+        b = await spawn(child(a))
+        return b
+
+    result = loop.run_until(spawn(parent()))
+    assert result == 40
+    assert loop.now() == pytest.approx(2.0)
+
+
+def test_actor_error_propagation(loop):
+    async def failing():
+        await delay(0.5)
+        raise err("transaction_too_old")
+
+    async def catching():
+        try:
+            await spawn(failing())
+        except FdbError as e:
+            return e.code
+
+    assert loop.run_until(spawn(catching())) == 1007
+
+
+def test_actor_cancellation(loop):
+    state = {"cleaned": False}
+
+    async def long_actor():
+        try:
+            await delay(1000.0)
+        finally:
+            state["cleaned"] = True
+
+    f = spawn(long_actor())
+    loop.run_for(1.0)
+    f.cancel()
+    loop.run_for(1.0)
+    assert state["cleaned"]
+    assert f.is_error() and f.error.code == 1101  # operation_cancelled
+
+
+def test_deterministic_ordering(loop):
+    """Two identical runs interleave identically."""
+    def run_once():
+        from foundationdb_tpu.core import (DeterministicRandom, EventLoop,
+                                           set_deterministic_random,
+                                           set_event_loop)
+        lp = EventLoop(sim=True)
+        set_event_loop(lp)
+        set_deterministic_random(DeterministicRandom(7))
+        order = []
+
+        async def worker(name, n):
+            from foundationdb_tpu.core import deterministic_random
+            for _ in range(n):
+                await delay(deterministic_random().random01() * 0.01)
+                order.append(name)
+
+        fs = [spawn(worker(f"w{i}", 5)) for i in range(4)]
+        lp.run_until(wait_all(fs))
+        return order
+
+    assert run_once() == run_once()
+
+
+def test_wait_any_and_quorum(loop):
+    async def sleeper(t, v):
+        await delay(t)
+        return v
+
+    f = wait_any([spawn(sleeper(5.0, "slow")), spawn(sleeper(1.0, "fast"))])
+    idx, val = loop.run_until(f)
+    assert (idx, val) == (1, "fast")
+
+    q = quorum([spawn(sleeper(1.0, 1)), spawn(sleeper(2.0, 2)),
+                spawn(sleeper(30.0, 3))], 2)
+    loop.run_until(q)
+    assert loop.now() < 10.0
+
+
+def test_promise_stream(loop):
+    ps = PromiseStream()
+
+    async def producer():
+        for i in range(5):
+            await delay(0.1)
+            ps.send(i)
+        ps.close()
+
+    async def consumer():
+        got = []
+        async for v in ps:
+            got.append(v)
+        return got
+
+    spawn(producer())
+    assert loop.run_until(spawn(consumer())) == [0, 1, 2, 3, 4]
+
+
+def test_async_var(loop):
+    av = AsyncVar(1)
+
+    async def watcher():
+        seen = [av.get()]
+        while len(seen) < 3:
+            await av.on_change()
+            seen.append(av.get())
+        return seen
+
+    async def setter():
+        await delay(0.1)
+        av.set(2)
+        await delay(0.1)
+        av.set(3)
+
+    f = spawn(watcher())
+    spawn(setter())
+    assert loop.run_until(f) == [1, 2, 3]
+
+
+def test_priority_ordering(loop):
+    """Same-time callbacks run in priority order, then FIFO."""
+    order = []
+    loop.call_at(1.0, lambda: order.append("low"), TaskPriority.Low)
+    loop.call_at(1.0, lambda: order.append("high"), TaskPriority.TLogCommit)
+    loop.call_at(1.0, lambda: order.append("high2"), TaskPriority.TLogCommit)
+    loop.drain()
+    assert order == ["high", "high2", "low"]
+
+
+def test_buggify_deterministic(loop):
+    enable_buggify(True)
+    fires1 = [buggify("test-site") for _ in range(100)]
+    enable_buggify(False)
+    assert not any(buggify("test-site") for _ in range(10))
+    assert isinstance(fires1[0], bool)
+
+
+def test_virtual_time_jump(loop):
+    """Sim time jumps over idle periods instantly."""
+    import time as wall
+
+    async def long_wait():
+        await delay(3600.0)
+        return now()
+
+    t0 = wall.monotonic()
+    result = loop.run_until(spawn(long_wait()))
+    assert result == pytest.approx(3600.0)
+    assert wall.monotonic() - t0 < 1.0
+
+
+def test_cancel_with_async_cleanup(loop):
+    """A cancelled actor's finally-block awaits still run to completion."""
+    state = {"flushed": False}
+
+    async def flush():
+        await delay(0.5)
+        state["flushed"] = True
+
+    async def worker():
+        try:
+            await delay(1000.0)
+        finally:
+            await spawn(flush())
+
+    f = spawn(worker())
+    loop.run_for(1.0)
+    f.cancel()
+    assert f.is_error() and f.error.code == 1101
+    loop.run_for(10.0)
+    assert state["flushed"]
+
+
+def test_cancel_before_start(loop):
+    """Cancelling before the first step means the body never runs."""
+    state = {"ran": False}
+
+    async def body():
+        state["ran"] = True
+
+    f = spawn(body())
+    f.cancel()
+    loop.drain()
+    assert not state["ran"]
+    assert f.is_error() and f.error.code == 1101
+
+
+def test_dropped_promise_breaks(loop):
+    import gc
+    p = Promise()
+    fut = p.get_future()
+    del p
+    gc.collect()
+    assert fut.is_error() and fut.error.code == 1100  # broken_promise
+
+
+def test_combinator_no_callback_leak(loop):
+    from foundationdb_tpu.core import wait_any
+
+    shutdown = Promise()
+
+    async def looper():
+        for _ in range(5):
+            await wait_any([shutdown.get_future(), delay(0.1)])
+
+    loop.run_until(spawn(looper()))
+    assert len(shutdown.get_future()._callbacks) == 0
+
+
+def test_quorum_impossible(loop):
+    from foundationdb_tpu.core import ready_future
+    q = quorum([ready_future(1)], 2)
+    assert q.is_error()
+
+
+def test_run_until_deadlock_is_not_timeout(loop):
+    p = Promise()  # keep alive: a dropped promise would break instead
+    with pytest.raises(FdbError) as ei:
+        loop.run_until(p.get_future())
+    assert ei.value.code == 4100  # internal_error, not timed_out
