@@ -1,0 +1,7 @@
+"""ConflictSet backends: CPU oracle, native C++, TPU kernel (north star)."""
+
+from .api import ConflictSet, new_conflict_set
+from .oracle import OracleConflictSet, VersionHistory
+
+__all__ = ["ConflictSet", "new_conflict_set", "OracleConflictSet",
+           "VersionHistory"]

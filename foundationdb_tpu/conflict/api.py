@@ -1,0 +1,68 @@
+"""ConflictSet: the Resolver's write-history + batch conflict detection.
+
+Opaque-factory boundary mirroring the reference's ConflictSet.h:30-52
+(newConflictSet / ConflictBatch::addTransaction / detectConflicts), extended
+with a backend selector — the north-star gate (see SURVEY.md §5.6): the knob
+CONFLICT_SET_BACKEND picks "cpu" (oracle), "tpu" (JAX device kernel), or
+"auto" (tpu above a batch-size threshold, cpu below).
+
+Abstract semantics (the parity contract, from fdbserver/SkipList.cpp):
+
+  The history is a piecewise-constant function V(k): key -> last-write
+  version, plus oldest_version (the MVCC window floor).  For a batch of
+  transactions resolving at commit version `now`:
+
+  1. too-old:   txn is TOO_OLD iff read_snapshot < oldest_version and it has
+                read conflict ranges (SkipList.cpp:819-827).
+  2. history:   txn conflicts iff any read range [b,e) has
+                max{V(k) : k in [b,e)} > read_snapshot  (SkipList.cpp:443).
+  3. intra:     scanning txns in batch order, a txn conflicts iff any read
+                range overlaps a write range of an earlier txn that SURVIVED
+                (was not conflicted/too-old) (SkipList.cpp:874-906).
+  4. insert:    all write ranges of surviving txns are written into the
+                history: V(k) := now for k in each range (SkipList.cpp:989).
+  5. gc:        oldest_version := max(oldest_version, new_oldest_version);
+                segments wholly below oldest_version may be merged — never
+                affecting any future decision (SkipList.cpp:576 removeBefore).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core.knobs import server_knobs
+from ..txn.types import CommitResult, CommitTransactionRef, KeyRange, Version
+
+
+class ConflictSet:
+    """Abstract conflict set. Subclasses: OracleConflictSet, TpuConflictSet."""
+
+    def __init__(self, oldest_version: Version = 0) -> None:
+        self.oldest_version: Version = oldest_version
+
+    def resolve(self, transactions: Sequence[CommitTransactionRef], now: Version,
+                new_oldest_version: Optional[Version] = None) -> List[CommitResult]:
+        """Resolve one commit batch at version `now`; updates history and
+        (optionally) advances the MVCC window floor. Returns one CommitResult
+        per transaction, in input order."""
+        raise NotImplementedError
+
+    def clear(self, version: Version) -> None:
+        """Reset all history (reference clearConflictSet)."""
+        raise NotImplementedError
+
+
+def new_conflict_set(backend: Optional[str] = None,
+                     oldest_version: Version = 0, **kwargs) -> ConflictSet:
+    """Factory honoring the CONFLICT_SET_BACKEND knob (north-star selector)."""
+    backend = backend or server_knobs().CONFLICT_SET_BACKEND
+    if backend == "cpu":
+        from .oracle import OracleConflictSet
+        return OracleConflictSet(oldest_version)
+    if backend == "tpu":
+        from .tpu_backend import TpuConflictSet
+        return TpuConflictSet(oldest_version, **kwargs)
+    if backend == "native":
+        from .native import NativeConflictSet
+        return NativeConflictSet(oldest_version)
+    raise ValueError(f"unknown conflict set backend {backend!r}")

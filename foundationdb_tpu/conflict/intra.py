@@ -1,0 +1,62 @@
+"""Intra-batch (within-commit-batch) conflict resolution on the host.
+
+Reference: ConflictBatch::checkIntraBatchConflicts (SkipList.cpp:874-906).
+The check is inherently order-sequential -- a reader conflicts only with
+*surviving* earlier writers, and survival is decided in batch order -- so it
+does not vectorize across transactions.  It is also tiny compared to the
+history-window work (its state is one batch, not the 5-second MVCC window),
+so it stays on the host: rank-space bitmap identical in effect to the
+reference's MiniConflictSet position bitset.  Keys are compared exactly
+(raw bytes), so digest truncation never affects intra-batch decisions.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from ..txn.types import CommitTransactionRef
+
+
+def intra_batch_resolve(transactions: Sequence[CommitTransactionRef],
+                        conflicted: List[bool],
+                        too_old: List[bool]) -> List[bool]:
+    """Update `conflicted` in place with intra-batch conflicts; returns it.
+
+    Precondition: `conflicted` holds the history-check verdicts; too_old txns
+    are skipped entirely (their ranges are never added, reference
+    SkipList.cpp:826-851)."""
+    # Rank-space: collect every endpoint of participating ranges; a range
+    # [b, e) maps to bit positions [rank(b), rank(e)).
+    endpoints = set()
+    for t, tr in enumerate(transactions):
+        if too_old[t]:
+            continue
+        for r in tr.read_conflict_ranges:
+            if r.begin < r.end:
+                endpoints.add(r.begin)
+                endpoints.add(r.end)
+        for w in tr.write_conflict_ranges:
+            if w.begin < w.end:
+                endpoints.add(w.begin)
+                endpoints.add(w.end)
+    if not endpoints:
+        return conflicted
+    rank = {k: i for i, k in enumerate(sorted(endpoints))}
+    bits = np.zeros(len(rank), dtype=bool)
+
+    for t, tr in enumerate(transactions):
+        if too_old[t] or conflicted[t]:
+            continue
+        c = False
+        for r in tr.read_conflict_ranges:
+            if r.begin < r.end and bits[rank[r.begin]:rank[r.end]].any():
+                c = True
+                break
+        conflicted[t] = c
+        if not c:
+            for w in tr.write_conflict_ranges:
+                if w.begin < w.end:
+                    bits[rank[w.begin]:rank[w.end]] = True
+    return conflicted

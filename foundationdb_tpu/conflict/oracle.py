@@ -1,0 +1,164 @@
+"""CPU oracle ConflictSet: exact reference semantics on a sorted segment list.
+
+This is the parity oracle for the TPU backend (and a correct standalone
+resolver backend).  Where the reference uses a skip list of keys with
+per-level max versions (fdbserver/SkipList.cpp), we store the equivalent
+piecewise-constant version function directly: a sorted list of boundary keys
+with the version of the segment starting at each boundary.  Same decisions,
+simpler invariants; the native C++ backend (native/) is the performance CPU
+path, this one is the readable truth.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right, insort
+from typing import List, Optional, Sequence, Tuple
+
+from ..txn.types import (CommitResult, CommitTransactionRef, KeyRange, Version)
+from .api import ConflictSet
+
+
+class VersionHistory:
+    """Piecewise-constant V(k): sorted boundary keys + per-segment versions.
+
+    keys[0] == b"" always; segment i covers [keys[i], keys[i+1]) (last one is
+    unbounded) with version vals[i]."""
+
+    __slots__ = ("keys", "vals")
+
+    def __init__(self, version: Version = 0) -> None:
+        self.keys: List[bytes] = [b""]
+        self.vals: List[Version] = [version]
+
+    def query_max(self, begin: bytes, end: bytes) -> Version:
+        """max{V(k) : k in [begin, end)}; empty range -> very old (-inf-ish)."""
+        if begin >= end:
+            return -1 << 62
+        i = bisect_right(self.keys, begin) - 1
+        j = bisect_left(self.keys, end, lo=i + 1)
+        return max(self.vals[i:j])
+
+    def insert(self, begin: bytes, end: bytes, version: Version) -> None:
+        """V(k) := version for k in [begin, end) (replace, like the skip list's
+        remove+insert in addConflictRanges, SkipList.cpp:430-441)."""
+        if begin >= end:
+            return
+        j = bisect_left(self.keys, end)          # first boundary >= end; >=1
+        has_end = j < len(self.keys) and self.keys[j] == end
+        # Version continuing at `end` = version of the segment containing end
+        # before this insert (SkipList.cpp:434 insert(endF, prior max)).
+        cont_v = self.vals[j - 1]
+        i = bisect_left(self.keys, begin)        # first boundary >= begin
+        if has_end:
+            self.keys[i:j] = [begin]
+            self.vals[i:j] = [version]
+        else:
+            self.keys[i:j] = [begin, end]
+            self.vals[i:j] = [version, cont_v]
+
+    def remove_before(self, oldest: Version) -> None:
+        """Merge adjacent segments both below `oldest` (reference removeBefore
+        SkipList.cpp:576: a node is dropped iff it and its predecessor are both
+        below). Decision-invariant for any read with snapshot >= oldest."""
+        if len(self.keys) <= 1:
+            return
+        keep_k: List[bytes] = [self.keys[0]]
+        keep_v: List[Version] = [self.vals[0]]
+        for k, v in zip(self.keys[1:], self.vals[1:]):
+            if v < oldest and keep_v[-1] < oldest:
+                continue  # merge into previous stale segment
+            keep_k.append(k)
+            keep_v.append(v)
+        self.keys, self.vals = keep_k, keep_v
+
+    def segment_count(self) -> int:
+        return len(self.keys)
+
+
+def combine_write_ranges(
+        ranges: List[Tuple[bytes, bytes]]) -> List[Tuple[bytes, bytes]]:
+    """Union of half-open ranges, merging overlapping/touching ones
+    (reference combineWriteConflictRanges, SkipList.cpp:996)."""
+    if not ranges:
+        return []
+    ranges = sorted(r for r in ranges if r[0] < r[1])
+    out: List[Tuple[bytes, bytes]] = []
+    for b, e in ranges:
+        if out and b <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((b, e))
+    return out
+
+
+class OracleConflictSet(ConflictSet):
+    """Reference-semantics conflict set over VersionHistory."""
+
+    def __init__(self, oldest_version: Version = 0) -> None:
+        super().__init__(oldest_version)
+        self.history = VersionHistory(oldest_version)
+
+    def clear(self, version: Version) -> None:
+        self.history = VersionHistory(version)
+
+    def resolve(self, transactions: Sequence[CommitTransactionRef], now: Version,
+                new_oldest_version: Optional[Version] = None) -> List[CommitResult]:
+        n = len(transactions)
+        too_old = [False] * n
+        conflict = [False] * n
+
+        # 1. too-old classification (SkipList.cpp:819-827): snapshot below the
+        # window floor, and only if the txn actually read something.
+        for t, tr in enumerate(transactions):
+            if tr.read_snapshot < self.oldest_version and tr.read_conflict_ranges:
+                too_old[t] = True
+
+        # 2. history check (checkReadConflictRanges -> SkipList::detectConflicts)
+        for t, tr in enumerate(transactions):
+            if too_old[t]:
+                continue
+            for r in tr.read_conflict_ranges:
+                if self.history.query_max(r.begin, r.end) > tr.read_snapshot:
+                    conflict[t] = True
+                    break
+
+        # 3. intra-batch, in batch order; only surviving writers block
+        # (checkIntraBatchConflicts, SkipList.cpp:874-906).
+        surviving_writes: List[Tuple[bytes, bytes]] = []
+        for t, tr in enumerate(transactions):
+            if conflict[t]:
+                continue
+            c = too_old[t]
+            if not c:
+                for r in tr.read_conflict_ranges:
+                    for wb, we in surviving_writes:
+                        if r.begin < we and wb < r.end:
+                            c = True
+                            break
+                    if c:
+                        break
+            conflict[t] = c
+            if not c:
+                for w in tr.write_conflict_ranges:
+                    if w.begin < w.end:
+                        surviving_writes.append((w.begin, w.end))
+
+        # 4. merge surviving write ranges into history at version `now`.
+        for b, e in combine_write_ranges(surviving_writes):
+            self.history.insert(b, e, now)
+
+        # 5. window GC.
+        if new_oldest_version is not None and new_oldest_version > self.oldest_version:
+            self.oldest_version = new_oldest_version
+            self.history.remove_before(new_oldest_version)
+
+        out: List[CommitResult] = []
+        for t in range(n):
+            if too_old[t]:
+                out.append(CommitResult.TOO_OLD)
+            elif conflict[t]:
+                out.append(CommitResult.CONFLICT)
+            else:
+                out.append(CommitResult.COMMITTED)
+        return out

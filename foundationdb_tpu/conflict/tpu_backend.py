@@ -1,0 +1,189 @@
+"""TPU-offloaded ConflictSet: the north-star backend (BASELINE.json).
+
+Orchestrates the device window kernels (conflict/window.py) from the host:
+
+  per commit batch (reference Resolver.actor.cpp:104 resolveBatch):
+    1. host: too-old classification against the MVCC floor
+    2. device: batched history conflict check (window_query)
+    3. host: order-sequential intra-batch pass (conflict/intra.py)
+    4. device: insert surviving write ranges at the batch version
+    5. device: amortized removeBefore GC + int32 version rebase
+
+Batch arrays are padded to power-of-two buckets so XLA compiles one program
+per bucket (SURVEY.md §7 hard part 2).  Versions are int32 offsets from
+self.version_base (rebased during GC).  Decisions are bit-identical to the
+CPU oracle for keys <= 23 bytes; longer keys round conservatively (extra
+aborts possible, missed conflicts impossible) -- see ops/digest.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.knobs import server_knobs
+from ..txn.types import CommitResult, CommitTransactionRef, Version
+from .api import ConflictSet
+from .intra import intra_batch_resolve
+
+_MIN_BUCKET = 256
+
+
+def _bucket(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+class TpuConflictSet(ConflictSet):
+    def __init__(self, oldest_version: Version = 0,
+                 capacity: Optional[int] = None,
+                 gc_interval_batches: int = 8) -> None:
+        super().__init__(oldest_version)
+        import jax.numpy as jnp  # lazy: backend selectable without jax init
+        from . import window
+        self._w = window
+        self._jnp = jnp
+        self.capacity = capacity or int(server_knobs().TPU_CONFLICT_CAPACITY)
+        self.version_base = oldest_version
+        self.state = window.make_window_state(self.capacity, 0)
+        self._batches_since_gc = 0
+        self._gc_interval = gc_interval_batches
+        self._pending_oldest: Optional[Version] = None
+
+    # An int32 offset span we never let live versions approach; beyond this
+    # resolve() forces a rebase, and if the window floor lags so far behind
+    # that rebasing cannot help, we fail loudly rather than clamp silently
+    # (a clamp could equate a write version and a later snapshot and miss a
+    # real conflict).
+    _REL_LIMIT = (1 << 31) - (1 << 24)
+
+    # -- helpers ------------------------------------------------------------
+    def _rel(self, v: Version) -> int:
+        """Absolute version -> int32 offset from version_base."""
+        off = v - self.version_base
+        if off >= self._REL_LIMIT:
+            from ..core.error import err
+            raise err("internal_error",
+                      f"version offset {off} exceeds int32 window; "
+                      "advance new_oldest_version to allow rebasing")
+        # Snapshots far below the base (already deep in TOO_OLD territory)
+        # may clamp upward safely: every comparison against them has the
+        # same outcome anywhere below the window floor.
+        return int(max(off, -(1 << 31) + 2))
+
+    def clear(self, version: Version) -> None:
+        # Like the reference clearConflictSet (SkipList.cpp:797): V(k) :=
+        # version everywhere; oldest_version is deliberately NOT changed.
+        self.version_base = version
+        self.state = self._w.make_window_state(self.capacity, 0)
+        self._pending_oldest = None
+
+    # -- resolve ------------------------------------------------------------
+    def resolve(self, transactions: Sequence[CommitTransactionRef], now: Version,
+                new_oldest_version: Optional[Version] = None) -> List[CommitResult]:
+        from ..ops.digest import KEY_LANES, encode_keys
+        jnp = self._jnp
+        # Proactive rebase long before the int32 offset space runs out.
+        if now - self.version_base >= (1 << 30):
+            self._run_gc(force=True)
+        n = len(transactions)
+        too_old = [bool(tr.read_snapshot < self.oldest_version and
+                        tr.read_conflict_ranges) for tr in transactions]
+        conflicted = [False] * n
+
+        # --- gather read ranges of live txns -------------------------------
+        r_keys_b, r_keys_e, r_snap, r_txn = [], [], [], []
+        for t, tr in enumerate(transactions):
+            if too_old[t]:
+                continue
+            for r in tr.read_conflict_ranges:
+                if r.begin < r.end:
+                    r_keys_b.append(r.begin)
+                    r_keys_e.append(r.end)
+                    r_snap.append(self._rel(tr.read_snapshot))
+                    r_txn.append(t)
+
+        # --- device history check ------------------------------------------
+        if r_keys_b:
+            rcap = _bucket(len(r_keys_b))
+            nb = np.zeros((rcap, KEY_LANES), dtype=np.uint32)
+            ne = np.zeros((rcap, KEY_LANES), dtype=np.uint32)
+            nb[:len(r_keys_b)] = encode_keys(r_keys_b)
+            ne[:len(r_keys_e)] = encode_keys(r_keys_e, round_up=True)
+            snap = np.zeros((rcap,), dtype=np.int32)
+            snap[:len(r_snap)] = r_snap
+            valid = np.zeros((rcap,), dtype=bool)
+            valid[:len(r_keys_b)] = True
+            bits = np.asarray(self._w.window_query(
+                self.state.bk, self.state.bv,
+                jnp.asarray(nb), jnp.asarray(ne),
+                jnp.asarray(snap), jnp.asarray(valid)))
+            for i, t in enumerate(r_txn):
+                if bits[i]:
+                    conflicted[t] = True
+
+        # --- host intra-batch pass -----------------------------------------
+        conflicted = intra_batch_resolve(transactions, conflicted, too_old)
+
+        # --- device insert of surviving writes -----------------------------
+        w_keys_b, w_keys_e = [], []
+        for t, tr in enumerate(transactions):
+            if too_old[t] or conflicted[t]:
+                continue
+            for w in tr.write_conflict_ranges:
+                if w.begin < w.end:
+                    w_keys_b.append(w.begin)
+                    w_keys_e.append(w.end)
+        if w_keys_b:
+            wcap = _bucket(len(w_keys_b))
+            wb = np.zeros((wcap, KEY_LANES), dtype=np.uint32)
+            we = np.zeros((wcap, KEY_LANES), dtype=np.uint32)
+            wb[:len(w_keys_b)] = encode_keys(w_keys_b)
+            we[:len(w_keys_e)] = encode_keys(w_keys_e, round_up=True)
+            wvalid = np.zeros((wcap,), dtype=bool)
+            wvalid[:len(w_keys_b)] = True
+            self.state, overflow = self._w.window_insert(
+                self.state, jnp.asarray(wb), jnp.asarray(we),
+                jnp.asarray(wvalid), jnp.int32(self._rel(now)))
+            if bool(overflow):
+                # Emergency: force GC and retry once; if still full, fail loud.
+                self._run_gc(force=True)
+                self.state, overflow = self._w.window_insert(
+                    self.state, jnp.asarray(wb), jnp.asarray(we),
+                    jnp.asarray(wvalid), jnp.int32(self._rel(now)))
+                if bool(overflow):
+                    from ..core.error import err
+                    raise err("internal_error",
+                              "TPU conflict window capacity exceeded")
+
+        # --- window floor / GC ---------------------------------------------
+        if new_oldest_version is not None and new_oldest_version > self.oldest_version:
+            self.oldest_version = new_oldest_version
+            self._pending_oldest = new_oldest_version
+        self._batches_since_gc += 1
+        if self._pending_oldest is not None and (
+                self._batches_since_gc >= self._gc_interval):
+            self._run_gc()
+
+        return [CommitResult.TOO_OLD if too_old[t]
+                else CommitResult.CONFLICT if conflicted[t]
+                else CommitResult.COMMITTED for t in range(n)]
+
+    def _run_gc(self, force: bool = False) -> None:
+        self._batches_since_gc = 0
+        oldest = self._pending_oldest if self._pending_oldest is not None \
+            else self.oldest_version
+        self._pending_oldest = None
+        # Rebase so the int32 offset space stays centered on the live window.
+        delta = max(oldest - self.version_base, 0)
+        self.state = self._w.window_gc(
+            self.state, self._jnp.int32(self._rel(oldest)),
+            self._jnp.int32(delta))
+        self.version_base += delta
+
+    # -- introspection ------------------------------------------------------
+    def segment_count(self) -> int:
+        return int(self.state.size)

@@ -1,0 +1,228 @@
+"""Device-resident conflict window: sorted segment arrays + JAX kernels.
+
+TPU-first reformulation of the reference skip list (fdbserver/SkipList.cpp).
+The skip list maintains a piecewise-constant function V(key) = version of the
+last write covering key, as nodes with per-level max versions.  Here the same
+function is a pair of HBM-resident capacity-padded arrays:
+
+    bk: uint32[CAP, 6]  sorted boundary digests (padding = MAX_DIGEST)
+    bv: int32[CAP]      version of segment [bk[i], bk[i+1])  (padding NEG_INF)
+    size: int32[]       live boundary count
+
+Versions are int32 offsets from a host-held base (the 5s MVCC window spans
+5e6 versions, ServerKnobs VERSIONS_PER_SECOND; int32 gives ~35min before a
+rebase).  Three jitted kernels:
+
+  window_query   -- batched "max V over [begin,end) > snapshot" checks
+                    (replaces SkipList::detectConflicts 16-way pointer chase,
+                    SkipList.cpp:443-721, with binary search + sparse-table
+                    range-max: two gathers per query)
+  window_insert  -- union of surviving write ranges, then a fully parallel
+                    sorted merge into the boundary arrays (replaces
+                    addConflictRanges remove+insert, SkipList.cpp:430-441)
+  window_gc      -- removeBefore: merge adjacent sub-floor segments
+                    (SkipList.cpp:576), plus version rebase
+
+All shapes are static (CAP, R, W are bucket sizes); no data-dependent control
+flow, so each kernel compiles once per bucket and runs entirely on device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.digest import (KEY_LANES, MAX_DIGEST, lex_eq, lex_less,
+                          searchsorted_left, searchsorted_right)
+from ..ops.rangemax import NEG_INF, build_sparse_table, range_max
+
+
+class WindowState(NamedTuple):
+    bk: jnp.ndarray    # uint32[CAP, 6]
+    bv: jnp.ndarray    # int32[CAP]
+    size: jnp.ndarray  # int32[]
+
+
+def make_window_state(cap: int, init_version_rel: int = 0) -> WindowState:
+    assert cap & (cap - 1) == 0, "capacity must be a power of two"
+    bk = np.broadcast_to(MAX_DIGEST, (cap, KEY_LANES)).copy()
+    bk[0] = 0  # digest(b"") = all zeros: the segment covering all keys
+    bv = np.full((cap,), int(NEG_INF), dtype=np.int32)
+    bv[0] = init_version_rel
+    return WindowState(jnp.asarray(bk), jnp.asarray(bv),
+                       jnp.asarray(np.int32(1)))
+
+
+# ---------------------------------------------------------------------------
+# Query
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def window_query(bk: jnp.ndarray, bv: jnp.ndarray,
+                 r_begin: jnp.ndarray, r_end: jnp.ndarray,
+                 r_snap: jnp.ndarray, r_valid: jnp.ndarray) -> jnp.ndarray:
+    """conflict[i] = valid[i] and max{V(k): k in [begin_i, end_i)} > snap_i.
+
+    The segment containing begin_i is included (its boundary key is <= begin),
+    matching the skip-list walk's start-side pyramid check."""
+    table = build_sparse_table(bv)
+    lo = searchsorted_right(bk, r_begin) - 1   # segment containing begin; >=0
+    hi = searchsorted_left(bk, r_end)          # first boundary >= end
+    maxv = range_max(table, lo, hi)
+    return r_valid & (maxv > r_snap)
+
+
+# ---------------------------------------------------------------------------
+# Insert (union of write ranges + parallel sorted merge)
+# ---------------------------------------------------------------------------
+
+def _union_ranges(w_begin, w_end, w_valid):
+    """Merge overlapping/touching [begin,end) ranges.
+
+    Returns (mb, me, m_valid): sorted disjoint merged ranges, padded MAX.
+    Endpoint sweep: +1 at begins, -1 at ends, begins first on ties; a merged
+    range starts where coverage hits 1 and ends where it returns to 0
+    (reference combineWriteConflictRanges, SkipList.cpp:996)."""
+    w = w_begin.shape[0]
+    max_row = jnp.asarray(np.broadcast_to(MAX_DIGEST, (w, KEY_LANES)))
+    b = jnp.where(w_valid[:, None], w_begin, max_row)
+    e = jnp.where(w_valid[:, None], w_end, max_row)
+    digests = jnp.concatenate([b, e], axis=0)                   # [2W, 6]
+    tie = jnp.concatenate([jnp.zeros((w,), jnp.int32),
+                           jnp.ones((w,), jnp.int32)])          # begins first
+    delta = jnp.concatenate([
+        jnp.where(w_valid, 1, 0).astype(jnp.int32),
+        jnp.where(w_valid, -1, 0).astype(jnp.int32)])
+    # lexicographic sort over 6 lanes + tie; delta rides along
+    ops = [digests[:, l] for l in range(KEY_LANES)] + [tie, delta]
+    sorted_ops = jax.lax.sort(ops, num_keys=KEY_LANES + 1)
+    s_digest = jnp.stack(sorted_ops[:KEY_LANES], axis=1)
+    s_delta = sorted_ops[KEY_LANES + 1]
+    cov = jnp.cumsum(s_delta)
+    is_start = (s_delta > 0) & (cov == 1)
+    is_end = (s_delta < 0) & (cov == 0)
+    # compact starts and ends to the front of [W]-sized arrays
+    def compact(mask):
+        rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        idx = jnp.where(mask, rank, 2 * w)  # out-of-bounds -> dropped
+        out = jnp.asarray(np.broadcast_to(MAX_DIGEST, (w, KEY_LANES)))
+        out = out.at[idx].set(s_digest, mode="drop")
+        return out
+    mb = compact(is_start)
+    me = compact(is_end)
+    m_count = jnp.sum(is_start.astype(jnp.int32))
+    m_valid = jnp.arange(w, dtype=jnp.int32) < m_count
+    return mb, me, m_valid
+
+
+@jax.jit
+def window_insert(state: WindowState, w_begin: jnp.ndarray, w_end: jnp.ndarray,
+                  w_valid: jnp.ndarray, now_rel: jnp.ndarray
+                  ) -> Tuple[WindowState, jnp.ndarray]:
+    """Set V(k) := now for k in each surviving write range.
+
+    Equivalent to the reference's per-range remove+insert
+    (SkipList.cpp:430-441): drop old boundaries inside [b,e), add boundary b
+    at `now` and boundary e continuing the prior version.  Returns (state,
+    overflow_flag); on overflow the state is unchanged and the host must GC
+    or grow capacity."""
+    bk, bv, size = state
+    cap, w = bk.shape[0], w_begin.shape[0]
+    idx_cap = jnp.arange(cap, dtype=jnp.int32)
+    live = idx_cap < size
+
+    mb, me, m_valid = _union_ranges(w_begin, w_end, w_valid)
+
+    # Version continuing after each merged end (on the OLD state).
+    slot = searchsorted_right(bk, me) - 1
+    cont_v = bv[slot]
+    # Is there already a boundary exactly at end?
+    p = searchsorted_left(bk, me)
+    present_end = lex_eq(bk[jnp.minimum(p, cap - 1)], me) & (p < size)
+
+    # Old boundaries strictly inside any merged range are dropped; a boundary
+    # equal to a begin is also dropped (replaced by the new begin entry).
+    cnt_b = searchsorted_right(mb, bk)   # merged begins <= bk[i]
+    cnt_e = searchsorted_right(me, bk)   # merged ends   <= bk[i]
+    inside = cnt_b > cnt_e
+    keep = live & ~inside
+
+    # Compact kept old entries.
+    kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    kept_count = jnp.sum(keep.astype(jnp.int32))
+    scatter_idx = jnp.where(keep, kept_rank, cap)
+    old_k = jnp.asarray(np.broadcast_to(MAX_DIGEST, (cap, KEY_LANES)))
+    old_k = old_k.at[scatter_idx].set(bk, mode="drop")
+    old_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
+    old_v = old_v.at[scatter_idx].set(bv, mode="drop")
+
+    # New entries: begins at now, ends at cont_v (suppressed if present).
+    end_valid = m_valid & ~present_end
+    max_row_w = jnp.asarray(np.broadcast_to(MAX_DIGEST, (w, KEY_LANES)))
+    nb = jnp.where(m_valid[:, None], mb, max_row_w)
+    ne = jnp.where(end_valid[:, None], me, max_row_w)
+    new_digest = jnp.concatenate([nb, ne], axis=0)              # [2W, 6]
+    new_v = jnp.concatenate([
+        jnp.where(m_valid, now_rel, NEG_INF).astype(jnp.int32),
+        jnp.where(end_valid, cont_v, NEG_INF).astype(jnp.int32)])
+    ops = [new_digest[:, l] for l in range(KEY_LANES)] + [new_v]
+    sorted_ops = jax.lax.sort(ops, num_keys=KEY_LANES)
+    new_digest = jnp.stack(sorted_ops[:KEY_LANES], axis=1)
+    new_v = sorted_ops[KEY_LANES]
+    new_valid = ~lex_eq(new_digest,
+                        jnp.asarray(MAX_DIGEST)[None, :].repeat(2 * w, 0))
+    new_count = jnp.sum(new_valid.astype(jnp.int32))
+
+    # Interleave positions: no duplicates exist between kept-old and new.
+    pos_new = searchsorted_left(old_k, new_digest) + jnp.arange(
+        2 * w, dtype=jnp.int32)
+    pos_old = idx_cap + searchsorted_left(new_digest, old_k)
+
+    out_k = jnp.asarray(np.broadcast_to(MAX_DIGEST, (cap, KEY_LANES)))
+    out_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
+    new_size = kept_count + new_count
+    overflow = new_size > cap
+
+    old_dst = jnp.where((idx_cap < kept_count) & ~overflow, pos_old, cap)
+    new_dst = jnp.where(new_valid & ~overflow, pos_new, cap)
+    out_k = out_k.at[old_dst].set(old_k, mode="drop")
+    out_k = out_k.at[new_dst].set(new_digest, mode="drop")
+    out_v = out_v.at[old_dst].set(old_v, mode="drop")
+    out_v = out_v.at[new_dst].set(new_v, mode="drop")
+
+    out_k = jnp.where(overflow, bk, out_k)
+    out_v = jnp.where(overflow, bv, out_v)
+    out_size = jnp.where(overflow, size, new_size).astype(jnp.int32)
+    return WindowState(out_k, out_v, out_size), overflow
+
+
+# ---------------------------------------------------------------------------
+# GC / rebase
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def window_gc(state: WindowState, oldest_rel: jnp.ndarray,
+              rebase_delta: jnp.ndarray) -> WindowState:
+    """removeBefore(oldest): drop boundary i when both it and its original
+    predecessor are below the floor (SkipList.cpp:576-607 wasAbove logic);
+    then shift all versions down by rebase_delta."""
+    bk, bv, size = state
+    cap = bk.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    live = idx < size
+    above = bv >= oldest_rel
+    prev_above = jnp.concatenate([jnp.ones((1,), bool), above[:-1]])
+    keep = live & ((idx == 0) | above | prev_above)
+
+    rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    dst = jnp.where(keep, rank, cap)
+    out_k = jnp.asarray(np.broadcast_to(MAX_DIGEST, (cap, KEY_LANES)))
+    out_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
+    out_k = out_k.at[dst].set(bk, mode="drop")
+    shifted = jnp.maximum(bv - rebase_delta, NEG_INF + 1)
+    out_v = out_v.at[dst].set(jnp.where(live, shifted, NEG_INF), mode="drop")
+    return WindowState(out_k, out_v, jnp.sum(keep.astype(jnp.int32)))

@@ -406,8 +406,11 @@ def _combinator(futures: List[Future], on_each: Callable) -> Future:
             if out.is_ready():
                 cleanup()
         cbs[i] = cb
-    # Attach after all cbs are recorded (a ready future fires immediately).
+    # Attach after all cbs are recorded (a ready future fires immediately);
+    # stop as soon as out resolves so no callback lingers on later inputs.
     for f, cb in zip(futures, cbs):
+        if out.is_ready():
+            break
         f.on_ready(cb)
     return out
 
@@ -435,6 +438,8 @@ def wait_all(futures: Iterable[Future]) -> Future:
 def wait_any(futures: Iterable[Future]) -> Future:
     """Resolves with (index, value) of the first ready future (choose/when)."""
     futures = list(futures)
+    if not futures:
+        return error_future(err("internal_error", "wait_any of empty list"))
 
     def on_each(out: Future, i: int, f: Future) -> None:
         if f.is_error():

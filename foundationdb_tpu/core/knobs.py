@@ -67,9 +67,9 @@ class ServerKnobs(KnobBase):
         self.RESOLVER_STATE_MEMORY_LIMIT = 1_000_000
         self.KEY_BYTES_PER_SAMPLE = 2e4
 
-        # Conflict-set backend selector -- OUR north-star gate. "cpu" = oracle
-        # skip-structure; "tpu" = JAX device kernel; "auto" = tpu for large
-        # batches with cpu fallback below TPU_CONFLICT_MIN_BATCH.
+        # Conflict-set backend selector -- OUR north-star gate. "cpu" = the
+        # Python oracle; "native" = C++ skip-structure; "tpu" = JAX device
+        # kernel over the HBM-resident window.
         self.CONFLICT_SET_BACKEND = "cpu"
         self.TPU_CONFLICT_MIN_BATCH = 64
         self.TPU_CONFLICT_CAPACITY = 1 << 20  # max resident history segments

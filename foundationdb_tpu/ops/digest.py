@@ -1,0 +1,131 @@
+"""Order-preserving fixed-width key digests (host encode + device compare).
+
+TPU kernels need fixed-width lanes; FDB keys are variable-length bytes (the
+reference's SkipList compares raw memory, SkipList.cpp:302 less()).  We embed
+keys into 24-byte digests = 6 big-endian uint32 lanes:
+
+    digest(k) = k[:23] zero-padded to 23 bytes || min(len(k), 24)
+
+For keys <= 23 bytes this is a strict order-embedding (the trailing length
+marker disambiguates prefixes: "a" < "a\\x00" holds because padding ties are
+broken by length).  Keys >= 24 bytes are truncated and share the marker 24;
+such collisions are handled conservatively: range begins round DOWN
+(enc_down) and range ends round UP (enc_up = enc+1ulp when truncated), so a
+digest-space range always covers the true key range.  Conservative widening
+can only create extra conflicts (aborts), never missed ones -- see
+tests/test_conflict_tpu.py::test_long_keys_conservative.
+
+Device-side helpers give lexicographic comparison over the 6 uint32 lanes and
+a vectorized lower/upper-bound binary search against the sorted boundary
+array.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+KEY_LANES = 6
+PREFIX_BYTES = 23  # bytes 0..22 of the key; byte 23 is the length marker
+DIGEST_BYTES = 4 * KEY_LANES
+
+# Digest of b"" is all zeros; all-0xFF is strictly above every real digest
+# (real marker byte <= 24), so it serves as the +inf padding sentinel.
+MAX_DIGEST = np.full((KEY_LANES,), 0xFFFFFFFF, dtype=np.uint32)
+MIN_DIGEST = np.zeros((KEY_LANES,), dtype=np.uint32)
+
+
+def is_truncated(key: bytes) -> bool:
+    return len(key) > PREFIX_BYTES
+
+
+def encode_keys(keys: Sequence[bytes], round_up: bool = False) -> np.ndarray:
+    """Encode keys -> uint32[N, 6]. round_up=True applies the +1ulp rounding
+    to truncated keys (for range *ends*)."""
+    n = len(keys)
+    buf = np.zeros((n, DIGEST_BYTES), dtype=np.uint8)
+    bump = np.zeros((n,), dtype=bool)
+    for i, k in enumerate(keys):
+        m = min(len(k), PREFIX_BYTES)
+        if m:
+            buf[i, :m] = np.frombuffer(k[:m], dtype=np.uint8)
+        buf[i, PREFIX_BYTES] = min(len(k), PREFIX_BYTES + 1)
+        if round_up and len(k) > PREFIX_BYTES:
+            bump[i] = True
+    lanes = buf.reshape(n, KEY_LANES, 4)
+    out = (lanes[:, :, 0].astype(np.uint32) << 24 |
+           lanes[:, :, 1].astype(np.uint32) << 16 |
+           lanes[:, :, 2].astype(np.uint32) << 8 |
+           lanes[:, :, 3].astype(np.uint32))
+    if round_up and bump.any():
+        out[bump] = _add_one_ulp(out[bump])
+    return out
+
+
+def _add_one_ulp(d: np.ndarray) -> np.ndarray:
+    """Add 1 to the 24-byte big-endian integer formed by the lanes."""
+    d = d.copy()
+    carry = np.ones(d.shape[0], dtype=bool)
+    for lane in range(KEY_LANES - 1, -1, -1):
+        d[carry, lane] = d[carry, lane] + np.uint32(1)
+        carry = carry & (d[:, lane] == 0)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Device-side lexicographic comparison and binary search
+# ---------------------------------------------------------------------------
+
+def lex_less(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a < b lexicographically. a, b: uint32[..., 6] -> bool[...]."""
+    lt = a[..., KEY_LANES - 1] < b[..., KEY_LANES - 1]
+    for lane in range(KEY_LANES - 2, -1, -1):
+        lt = jnp.where(a[..., lane] == b[..., lane], lt, a[..., lane] < b[..., lane])
+    return lt
+
+
+def lex_less_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return ~lex_less(b, a)
+
+
+def lex_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+def _searchsorted(sorted_keys: jnp.ndarray, queries: jnp.ndarray,
+                  side_left: bool) -> jnp.ndarray:
+    """Vectorized branchless binary search over uint32[CAP, 6] boundaries.
+
+    Returns, per query q: first index i with sorted_keys[i] >= q (left) or
+    sorted_keys[i] > q (right).  CAP must be a power of two (capacity arrays
+    are padded with MAX_DIGEST above the live size)."""
+    cap = sorted_keys.shape[0]
+    nbits = int(cap).bit_length() - 1
+    assert cap == 1 << nbits, f"capacity {cap} not a power of two"
+    nq = queries.shape[0]
+    lo = jnp.zeros((nq,), dtype=jnp.int32)  # invariant: keys[lo-1] < q <= keys[hi]
+    # Binary search maintaining: result in (lo, hi]; start hi = cap.
+    hi = jnp.full((nq,), cap, dtype=jnp.int32)
+    for _ in range(nbits + 1):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        mk = sorted_keys[jnp.minimum(mid, cap - 1)]  # gather [nq, 6]
+        if side_left:
+            go_right = lex_less(mk, queries)          # keys[mid] < q
+        else:
+            go_right = lex_less_eq(mk, queries)       # keys[mid] <= q
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return hi
+
+
+def searchsorted_left(sorted_keys: jnp.ndarray, queries: jnp.ndarray) -> jnp.ndarray:
+    return _searchsorted(sorted_keys, queries, True)
+
+
+def searchsorted_right(sorted_keys: jnp.ndarray, queries: jnp.ndarray) -> jnp.ndarray:
+    return _searchsorted(sorted_keys, queries, False)

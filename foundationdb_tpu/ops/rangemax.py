@@ -1,0 +1,46 @@
+"""Batched range-maximum queries via a sparse table (device-side).
+
+The reference's skip list answers "max write version over key range" by
+walking node pyramids with per-level max versions (SkipList.cpp:695
+CheckMax::advance).  The TPU formulation: segment versions live in a flat
+int32[CAP] array; we precompute the doubling sparse table
+M[j][i] = max(v[i .. i+2^j)) once per batch (O(CAP log CAP), embarrassingly
+parallel) and answer each query [lo, hi) with two gathers:
+max(M[j][lo], M[j][hi - 2^j]) where j = floor(log2(hi - lo)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.int32(-(1 << 31) + 1)
+
+
+def build_sparse_table(values: jnp.ndarray) -> jnp.ndarray:
+    """values: int32[CAP] -> M: int32[LOG+1, CAP]; CAP must be a power of 2."""
+    cap = values.shape[0]
+    log = max((cap - 1).bit_length(), 1)
+    rows = [values]
+    cur = values
+    for j in range(log):
+        shift = 1 << j
+        shifted = jnp.concatenate(
+            [cur[shift:], jnp.full((shift,), NEG_INF, dtype=cur.dtype)])
+        cur = jnp.maximum(cur, shifted)
+        rows.append(cur)
+    return jnp.stack(rows)
+
+
+def range_max(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """Per-query max(values[lo:hi]); empty ranges (hi<=lo) -> NEG_INF.
+
+    lo, hi: int32[N] with 0 <= lo, hi <= CAP."""
+    length = hi - lo
+    valid = length > 0
+    safe_len = jnp.maximum(length, 1)
+    # floor(log2(len)) via bit width
+    j = 31 - jax.lax.clz(safe_len.astype(jnp.int32))
+    left = table[j, lo]
+    right = table[j, jnp.maximum(hi - (1 << j), 0)]
+    return jnp.where(valid, jnp.maximum(left, right), NEG_INF)

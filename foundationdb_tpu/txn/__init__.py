@@ -1,0 +1,13 @@
+"""Transaction payload types (reference fdbclient/CommitTransaction.h)."""
+
+from .types import (ALL_KEYS, ALL_KEYS_WITH_SYSTEM, ATOMIC_OPS,
+                    INVALID_VERSION, MAX_VERSION, SYSTEM_KEYS, CommitResult,
+                    CommitTransactionRef, KeyRange, Mutation, MutationType,
+                    Version, key_after, single_key_range, strinc)
+
+__all__ = [
+    "ALL_KEYS", "ALL_KEYS_WITH_SYSTEM", "ATOMIC_OPS", "INVALID_VERSION",
+    "MAX_VERSION", "SYSTEM_KEYS", "CommitResult", "CommitTransactionRef",
+    "KeyRange", "Mutation", "MutationType", "Version", "key_after",
+    "single_key_range", "strinc",
+]

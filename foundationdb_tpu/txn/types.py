@@ -1,0 +1,158 @@
+"""Transaction payload types.
+
+Equivalents of the reference's fdbclient/CommitTransaction.h (MutationRef
+:55-96, CommitTransactionRef :179) and fdbclient/FDBTypes.h (KeyRangeRef,
+Version).  Keys are raw bytes, ordered lexicographically; ranges are
+half-open [begin, end).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import List, Optional, Tuple
+
+Version = int
+INVALID_VERSION = -1
+MAX_VERSION = (1 << 62) - 1
+
+
+def strinc(key: bytes) -> bytes:
+    """Smallest key strictly greater than every key with prefix `key`.
+
+    Reference: flow strinc() — strips trailing 0xff bytes then increments the
+    last byte. Raises if key is empty or all 0xff (no such key exists)."""
+    key = key.rstrip(b"\xff")
+    if not key:
+        raise ValueError("strinc on empty/all-0xff key")
+    return key[:-1] + bytes([key[-1] + 1])
+
+
+def key_after(key: bytes) -> bytes:
+    """Smallest key strictly greater than `key` (append \\x00)."""
+    return key + b"\x00"
+
+
+def single_key_range(key: bytes) -> "KeyRange":
+    return KeyRange(key, key_after(key))
+
+
+@dataclass(frozen=True, order=True)
+class KeyRange:
+    """Half-open key interval [begin, end); empty if begin >= end."""
+
+    begin: bytes
+    end: bytes
+
+    def __post_init__(self) -> None:
+        if self.begin > self.end:
+            from ..core.error import err
+            raise err("inverted_range", f"{self.begin!r} > {self.end!r}")
+
+    def empty(self) -> bool:
+        return self.begin >= self.end
+
+    def contains(self, key: bytes) -> bool:
+        return self.begin <= key < self.end
+
+    def overlaps(self, other: "KeyRange") -> bool:
+        return self.begin < other.end and other.begin < self.end
+
+    def intersect(self, other: "KeyRange") -> Optional["KeyRange"]:
+        b, e = max(self.begin, other.begin), min(self.end, other.end)
+        return KeyRange(b, e) if b < e else None
+
+
+# The whole legal keyspace. b"\xff"-prefixed keys are system metadata, as in
+# the reference (fdbclient/SystemData.cpp); b"\xff\xff" is the special keyspace.
+ALL_KEYS = KeyRange(b"", b"\xff")
+SYSTEM_KEYS = KeyRange(b"\xff", b"\xff\xff")
+ALL_KEYS_WITH_SYSTEM = KeyRange(b"", b"\xff\xff")
+
+
+class MutationType(IntEnum):
+    """Mutation op codes (reference fdbclient/CommitTransaction.h:55-96)."""
+
+    SetValue = 0
+    ClearRange = 1
+    AddValue = 2
+    DebugKeyRange = 3
+    DebugKey = 4
+    NoOp = 5
+    And = 6
+    Or = 7
+    Xor = 8
+    AppendIfFits = 9
+    AvailableForReuse = 10
+    Reserved_For_LogProtocolMessage = 11
+    Max = 12
+    Min = 13
+    SetVersionstampedKey = 14
+    SetVersionstampedValue = 15
+    ByteMin = 16
+    ByteMax = 17
+    MinV2 = 18
+    AndV2 = 19
+    CompareAndClear = 20
+
+
+ATOMIC_OPS = {
+    MutationType.AddValue, MutationType.And, MutationType.Or, MutationType.Xor,
+    MutationType.AppendIfFits, MutationType.Max, MutationType.Min,
+    MutationType.SetVersionstampedKey, MutationType.SetVersionstampedValue,
+    MutationType.ByteMin, MutationType.ByteMax, MutationType.MinV2,
+    MutationType.AndV2, MutationType.CompareAndClear,
+}
+
+
+@dataclass
+class Mutation:
+    """One mutation: (type, param1, param2).
+
+    SetValue: param1=key, param2=value. ClearRange: param1=begin, param2=end.
+    Atomic ops: param1=key, param2=operand."""
+
+    type: MutationType
+    param1: bytes
+    param2: bytes
+
+    def expected_size(self) -> int:
+        return len(self.param1) + len(self.param2) + 12
+
+    @staticmethod
+    def set_value(key: bytes, value: bytes) -> "Mutation":
+        return Mutation(MutationType.SetValue, key, value)
+
+    @staticmethod
+    def clear_range(begin: bytes, end: bytes) -> "Mutation":
+        return Mutation(MutationType.ClearRange, begin, end)
+
+
+@dataclass
+class CommitTransactionRef:
+    """A transaction as submitted for commit.
+
+    Reference: fdbclient/CommitTransaction.h:179 CommitTransactionRef with
+    read_conflict_ranges, write_conflict_ranges, mutations, read_snapshot,
+    report_conflicting_keys."""
+
+    read_conflict_ranges: List[KeyRange] = field(default_factory=list)
+    write_conflict_ranges: List[KeyRange] = field(default_factory=list)
+    mutations: List[Mutation] = field(default_factory=list)
+    read_snapshot: Version = 0
+    report_conflicting_keys: bool = False
+
+    def expected_size(self) -> int:
+        s = sum(len(r.begin) + len(r.end) for r in
+                self.read_conflict_ranges + self.write_conflict_ranges)
+        return s + sum(m.expected_size() for m in self.mutations)
+
+
+class CommitResult(IntEnum):
+    """Per-transaction resolver verdict.
+
+    Reference ConflictBatch::TransactionCommitResult (ConflictSet.h:41-45)."""
+
+    CONFLICT = 0
+    TOO_OLD = 1
+    COMMITTED = 2

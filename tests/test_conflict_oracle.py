@@ -1,0 +1,206 @@
+"""Oracle ConflictSet vs brute-force model (ConflictRange.actor.cpp style).
+
+The brute model tracks last-write versions per concrete key over a small
+finite key domain. Because all range endpoints are drawn from that domain,
+the piecewise version function is exactly determined by its values on the
+domain points, so the model is an exact executable spec."""
+
+import pytest
+
+from foundationdb_tpu.conflict.oracle import (OracleConflictSet,
+                                              VersionHistory,
+                                              combine_write_ranges)
+from foundationdb_tpu.core import DeterministicRandom
+from foundationdb_tpu.txn import (CommitResult, CommitTransactionRef,
+                                  KeyRange)
+
+
+def make_domain():
+    """Small ordered key universe; all endpoints come from here."""
+    alphabet = [b"a", b"b", b"c", b"d"]
+    keys = [b""]
+    for a in alphabet:
+        keys.append(a)
+        for b2 in alphabet:
+            keys.append(a + b2)
+    keys.append(b"\xff")
+    return sorted(set(keys))
+
+
+class BruteModel:
+    """Exact spec: per-domain-point versions + reference batch semantics."""
+
+    def __init__(self, domain):
+        self.domain = domain
+        self.v = {k: 0 for k in domain}
+        self.oldest = 0
+
+    def query_max(self, b, e):
+        vs = [self.v[k] for k in self.domain if b <= k < e]
+        return max(vs) if vs else -1 << 62
+
+    def resolve(self, txns, now, new_oldest=None):
+        n = len(txns)
+        too_old = [tr.read_snapshot < self.oldest and bool(tr.read_conflict_ranges)
+                   for tr in txns]
+        conflict = [False] * n
+        for t, tr in enumerate(txns):
+            if too_old[t]:
+                continue
+            for r in tr.read_conflict_ranges:
+                if self.query_max(r.begin, r.end) > tr.read_snapshot:
+                    conflict[t] = True
+                    break
+        surviving = []
+        for t, tr in enumerate(txns):
+            if conflict[t]:
+                continue
+            c = too_old[t]
+            if not c:
+                for r in tr.read_conflict_ranges:
+                    if any(r.begin < we and wb < r.end for wb, we in surviving):
+                        c = True
+                        break
+            conflict[t] = c
+            if not c:
+                surviving += [(w.begin, w.end) for w in tr.write_conflict_ranges
+                              if w.begin < w.end]
+        for wb, we in surviving:
+            for k in self.domain:
+                if wb <= k < we:
+                    self.v[k] = now
+        if new_oldest is not None and new_oldest > self.oldest:
+            self.oldest = new_oldest
+        return [CommitResult.TOO_OLD if too_old[t]
+                else CommitResult.CONFLICT if conflict[t]
+                else CommitResult.COMMITTED for t in range(n)]
+
+
+def random_range(rng, domain):
+    i = rng.random_int(0, len(domain) - 1)
+    j = rng.random_int(i + 1, len(domain))
+    return KeyRange(domain[i], domain[j])
+
+
+def random_txn(rng, domain, now, window):
+    snap = now - rng.random_int(0, window)
+    tr = CommitTransactionRef(read_snapshot=max(snap, 0))
+    for _ in range(rng.random_int(0, 4)):
+        tr.read_conflict_ranges.append(random_range(rng, domain))
+    for _ in range(rng.random_int(0, 3)):
+        tr.write_conflict_ranges.append(random_range(rng, domain))
+    return tr
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_oracle_matches_brute_model(seed):
+    rng = DeterministicRandom(seed)
+    domain = make_domain()
+    oracle = OracleConflictSet(0)
+    model = BruteModel(domain)
+    now = 0
+    for _ in range(60):
+        now += rng.random_int(1, 2_000_000)
+        batch = [random_txn(rng, domain, now, 4_000_000)
+                 for _ in range(rng.random_int(1, 12))]
+        new_oldest = now - 5_000_000 if rng.coinflip() else None
+        got = oracle.resolve(batch, now, new_oldest)
+        want = model.resolve(batch, now, new_oldest)
+        assert got == want, f"divergence at now={now}: {got} vs {want}"
+
+
+def test_version_history_basics():
+    h = VersionHistory(0)
+    h.insert(b"b", b"d", 10)
+    assert h.query_max(b"a", b"b") == 0
+    assert h.query_max(b"a", b"b\x00") == 10
+    assert h.query_max(b"b", b"c") == 10
+    assert h.query_max(b"d", b"e") == 0
+    h.insert(b"c", b"e", 20)
+    assert h.query_max(b"b", b"c") == 10
+    assert h.query_max(b"c", b"d") == 20
+    assert h.query_max(b"d", b"z") == 20
+    assert h.query_max(b"e", b"z") == 0
+    # overwrite interior fully
+    h.insert(b"a", b"z", 30)
+    assert h.query_max(b"", b"\xff") == 30
+    assert h.query_max(b"", b"a") == 0
+    assert h.query_max(b"z", b"\xff") == 0
+
+
+def test_version_history_point_writes():
+    h = VersionHistory(0)
+    h.insert(b"k", b"k\x00", 5)
+    assert h.query_max(b"k", b"k\x00") == 5
+    assert h.query_max(b"j", b"k") == 0
+    assert h.query_max(b"k\x00", b"l") == 0
+
+
+def test_remove_before_is_decision_invariant():
+    rng = DeterministicRandom(99)
+    domain = make_domain()
+    a, b = OracleConflictSet(0), OracleConflictSet(0)
+    now = 0
+    for _ in range(40):
+        now += rng.random_int(1, 1_000_000)
+        batch = [random_txn(rng, domain, now, 3_000_000)
+                 for _ in range(rng.random_int(1, 8))]
+        # a: GC aggressively every batch; b: advance floor but skip compaction
+        ra = a.resolve(batch, now, now - 3_000_000)
+        b.oldest_version = max(b.oldest_version, now - 3_000_000)
+        rb = b.resolve(batch, now, None)
+        assert ra == rb
+    assert a.history.segment_count() <= b.history.segment_count()
+
+
+def test_too_old_requires_read_ranges():
+    cs = OracleConflictSet(100)
+    w = CommitTransactionRef(read_snapshot=0,
+                             write_conflict_ranges=[KeyRange(b"a", b"b")])
+    assert cs.resolve([w], 200) == [CommitResult.COMMITTED]
+    r = CommitTransactionRef(read_snapshot=0,
+                             read_conflict_ranges=[KeyRange(b"a", b"b")])
+    assert cs.resolve([r], 300) == [CommitResult.TOO_OLD]
+
+
+def test_intra_batch_order_dependence():
+    """An aborted earlier writer does NOT block a later reader."""
+    cs = OracleConflictSet(0)
+    # seed history: write x at v10
+    seed = CommitTransactionRef(write_conflict_ranges=[KeyRange(b"x", b"y")],
+                                read_snapshot=0)
+    assert cs.resolve([seed], 10) == [CommitResult.COMMITTED]
+    # t0 reads x at snapshot 5 -> conflicts with v10 write; also writes k.
+    t0 = CommitTransactionRef(read_snapshot=5,
+                              read_conflict_ranges=[KeyRange(b"x", b"y")],
+                              write_conflict_ranges=[KeyRange(b"k", b"l")])
+    # t1 reads k at snapshot 15: t0 aborted, so no intra-batch conflict.
+    t1 = CommitTransactionRef(read_snapshot=15,
+                              read_conflict_ranges=[KeyRange(b"k", b"l")])
+    # t2 writes m and survives; t3 reads m -> intra-batch conflict.
+    t2 = CommitTransactionRef(read_snapshot=15,
+                              write_conflict_ranges=[KeyRange(b"m", b"n")])
+    t3 = CommitTransactionRef(read_snapshot=15,
+                              read_conflict_ranges=[KeyRange(b"m", b"n")])
+    got = cs.resolve([t0, t1, t2, t3], 20)
+    assert got == [CommitResult.CONFLICT, CommitResult.COMMITTED,
+                   CommitResult.COMMITTED, CommitResult.CONFLICT]
+
+
+def test_exact_snapshot_boundary():
+    """A write AT the snapshot version does not conflict (strict >)."""
+    cs = OracleConflictSet(0)
+    w = CommitTransactionRef(write_conflict_ranges=[KeyRange(b"a", b"b")])
+    cs.resolve([w], 10)
+    r_at = CommitTransactionRef(read_snapshot=10,
+                                read_conflict_ranges=[KeyRange(b"a", b"b")])
+    r_below = CommitTransactionRef(read_snapshot=9,
+                                   read_conflict_ranges=[KeyRange(b"a", b"b")])
+    assert cs.resolve([r_at], 11) == [CommitResult.COMMITTED]
+    assert cs.resolve([r_below], 12) == [CommitResult.CONFLICT]
+
+
+def test_combine_write_ranges():
+    got = combine_write_ranges([(b"c", b"e"), (b"a", b"b"), (b"b", b"c"),
+                                (b"d", b"f"), (b"x", b"x")])
+    assert got == [(b"a", b"f")]

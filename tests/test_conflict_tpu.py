@@ -1,0 +1,135 @@
+"""TPU conflict backend parity vs the CPU oracle (on virtual CPU devices).
+
+The contract (BASELINE.json): identical commit/abort decisions vs the
+SkipList-semantics baseline.  Short keys (<= 23 bytes) must match
+bit-for-bit; longer keys may only add conflicts (conservative), never miss."""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+from foundationdb_tpu.core import DeterministicRandom
+from foundationdb_tpu.txn import CommitResult, CommitTransactionRef, KeyRange
+
+from test_conflict_oracle import make_domain, random_txn
+
+
+@pytest.fixture(scope="module")
+def small_caps():
+    return dict(capacity=1 << 12)
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_tpu_matches_oracle_random(seed, small_caps):
+    rng = DeterministicRandom(seed)
+    domain = make_domain()
+    oracle = OracleConflictSet(0)
+    tpu = TpuConflictSet(0, **small_caps)
+    now = 0
+    for _ in range(30):
+        now += rng.random_int(1, 2_000_000)
+        batch = [random_txn(rng, domain, now, 4_000_000)
+                 for _ in range(rng.random_int(1, 10))]
+        new_oldest = now - 5_000_000 if rng.coinflip() else None
+        got = tpu.resolve(batch, now, new_oldest)
+        want = oracle.resolve(batch, now, new_oldest)
+        assert got == want, f"divergence at now={now}"
+
+
+def test_tpu_basic_sequence(small_caps):
+    tpu = TpuConflictSet(0, **small_caps)
+    w = CommitTransactionRef(write_conflict_ranges=[KeyRange(b"a", b"c")])
+    assert tpu.resolve([w], 100) == [CommitResult.COMMITTED]
+    r_old = CommitTransactionRef(read_snapshot=50,
+                                 read_conflict_ranges=[KeyRange(b"b", b"d")])
+    r_new = CommitTransactionRef(read_snapshot=100,
+                                 read_conflict_ranges=[KeyRange(b"b", b"d")])
+    r_miss = CommitTransactionRef(read_snapshot=50,
+                                  read_conflict_ranges=[KeyRange(b"c", b"d")])
+    assert tpu.resolve([r_old, r_new, r_miss], 200) == [
+        CommitResult.CONFLICT, CommitResult.COMMITTED, CommitResult.COMMITTED]
+
+
+def test_tpu_gc_and_rebase(small_caps):
+    """Window floor advances; decisions stay correct after GC + rebase."""
+    tpu = TpuConflictSet(0, capacity=1 << 12, gc_interval_batches=1)
+    oracle = OracleConflictSet(0)
+    rng = DeterministicRandom(7)
+    domain = make_domain()
+    now = 0
+    for i in range(25):
+        now += 1_000_000
+        batch = [random_txn(rng, domain, now, 3_000_000) for _ in range(6)]
+        new_oldest = now - 5_000_000
+        got = tpu.resolve(batch, now, new_oldest)
+        want = oracle.resolve(batch, now, new_oldest)
+        assert got == want
+    assert tpu.version_base > 0  # rebase actually happened
+    assert tpu.segment_count() < 1 << 12
+
+
+def test_long_keys_conservative(small_caps):
+    """Keys > 23 bytes: no missed conflicts; extra conflicts allowed."""
+    long_a = b"x" * 30
+    long_b = b"x" * 23 + b"zzz"        # same 23-byte prefix, digest-collides
+    tpu = TpuConflictSet(0, **small_caps)
+    w = CommitTransactionRef(
+        write_conflict_ranges=[KeyRange(long_a, long_a + b"\x00")])
+    assert tpu.resolve([w], 100) == [CommitResult.COMMITTED]
+    # True conflict on the same long key: MUST be caught.
+    r_hit = CommitTransactionRef(
+        read_snapshot=50,
+        read_conflict_ranges=[KeyRange(long_a, long_a + b"\x00")])
+    # Digest-collided read of a different key: conservative abort is allowed;
+    # commit would also be correct only if digests distinguished them.
+    r_collide = CommitTransactionRef(
+        read_snapshot=50,
+        read_conflict_ranges=[KeyRange(long_b, long_b + b"\x00")])
+    got = tpu.resolve([r_hit, r_collide], 200)
+    assert got[0] == CommitResult.CONFLICT        # no false negative
+    assert got[1] in (CommitResult.CONFLICT, CommitResult.COMMITTED)
+
+    # Short-key reads nearby must be unaffected by long-key widening.
+    r_short = CommitTransactionRef(
+        read_snapshot=50, read_conflict_ranges=[KeyRange(b"w", b"x")])
+    assert tpu.resolve([r_short], 300) == [CommitResult.COMMITTED]
+
+
+def test_tpu_intra_batch(small_caps):
+    tpu = TpuConflictSet(0, **small_caps)
+    t0 = CommitTransactionRef(read_snapshot=0,
+                              write_conflict_ranges=[KeyRange(b"k", b"l")])
+    t1 = CommitTransactionRef(read_snapshot=0,
+                              read_conflict_ranges=[KeyRange(b"k", b"l")])
+    assert tpu.resolve([t0, t1], 10) == [CommitResult.COMMITTED,
+                                         CommitResult.CONFLICT]
+
+
+def test_tpu_capacity_overflow_recovers():
+    """Filling the window past capacity forces GC; old segments vanish."""
+    tpu = TpuConflictSet(0, capacity=256, gc_interval_batches=1000)
+    now = 0
+    for i in range(40):
+        now += 1_000_000
+        # 10 disjoint point writes per batch -> ~20 boundaries/batch
+        txns = [CommitTransactionRef(write_conflict_ranges=[
+            KeyRange(b"%05d" % (i * 10 + j), b"%05d\x00" % (i * 10 + j))])
+            for j in range(10)]
+        res = tpu.resolve(txns, now, now - 3_000_000)
+        assert all(r == CommitResult.COMMITTED for r in res)
+    assert tpu.segment_count() <= 256
+
+
+def test_clear_matches_oracle(small_caps):
+    """clear(v) sets V(k)=v everywhere but leaves the window floor alone."""
+    tpu = TpuConflictSet(0, **small_caps)
+    oracle = OracleConflictSet(0)
+    for cs in (tpu, oracle):
+        cs.resolve([CommitTransactionRef(
+            write_conflict_ranges=[KeyRange(b"a", b"b")])], 100)
+        cs.clear(400)
+    r = CommitTransactionRef(read_snapshot=395,
+                             read_conflict_ranges=[KeyRange(b"q", b"r")])
+    got, want = tpu.resolve([r], 500), oracle.resolve([r], 500)
+    assert got == want == [CommitResult.CONFLICT]
