@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Headline benchmark: TPU-offloaded conflict-detection throughput.
+
+Replays a YCSB-A-style stream of commit batches (zipf point keys, read+write
+conflict ranges per transaction — BASELINE.json config 2) through the TPU
+ConflictSet backend and reports end-to-end resolved conflict ranges per
+second, against the 1M/s north-star target (BASELINE.md).
+
+Equivalent of the reference's `fdbserver -r skiplisttest` microbench
+(fdbserver/SkipList.cpp:1082 skipListTest — 500 batches, prints
+Mtransactions/sec & Mkeys/sec).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR_RANGES_PER_S = 1_000_000.0
+
+TXNS_PER_BATCH = 4096
+READS_PER_TXN = 2
+WRITES_PER_TXN = 1
+N_BATCHES = 64
+KEYSPACE = 1_000_000
+VERSIONS_PER_BATCH = 1_000
+PIPELINE_DEPTH = 8
+
+
+def _key(kid: int) -> bytes:
+    return b"k%014d" % kid
+
+
+def build_batches(rng: np.random.Generator):
+    from foundationdb_tpu.txn.types import (CommitTransactionRef, KeyRange,
+                                            key_after)
+
+    batches = []
+    version = 1_000
+    for _ in range(N_BATCHES):
+        prev = version
+        version += VERSIONS_PER_BATCH
+        kids = rng.zipf(1.2, size=TXNS_PER_BATCH * (READS_PER_TXN +
+                                                    WRITES_PER_TXN))
+        kids = (kids % KEYSPACE).astype(np.int64)
+        txns = []
+        p = 0
+        for _ in range(TXNS_PER_BATCH):
+            reads = []
+            for _ in range(READS_PER_TXN):
+                k = _key(int(kids[p])); p += 1
+                reads.append(KeyRange(k, key_after(k)))
+            writes = []
+            for _ in range(WRITES_PER_TXN):
+                k = _key(int(kids[p])); p += 1
+                writes.append(KeyRange(k, key_after(k)))
+            # Snapshot within the last ~2 batches: realistic contention.
+            snap = int(prev - rng.integers(0, 2 * VERSIONS_PER_BATCH))
+            txns.append(CommitTransactionRef(
+                read_conflict_ranges=reads, write_conflict_ranges=writes,
+                mutations=[], read_snapshot=max(snap, 0)))
+        batches.append((txns, version))
+    return batches
+
+
+def main() -> None:
+    backend = "tpu"
+    if len(sys.argv) > 1:
+        backend = sys.argv[1]
+    from foundationdb_tpu.conflict.api import new_conflict_set
+
+    rng = np.random.default_rng(2026)
+    batches = build_batches(rng)
+    window = 5 * VERSIONS_PER_BATCH  # MVCC floor trails ~5 batches
+
+    kwargs = {"capacity": 1 << 17} if backend == "tpu" else {}
+    cs = new_conflict_set(backend, **kwargs)
+
+    # Warmup: compile the fused step for this bucket shape.
+    for txns, version in batches[:3]:
+        cs.resolve(txns, version, new_oldest_version=max(version - window, 0))
+
+    pipelined = hasattr(cs, "resolve_async")
+    t0 = time.perf_counter()
+    n_ranges = 0
+    n_txns = 0
+    committed = 0
+    if pipelined:
+        # Keep PIPELINE_DEPTH batches in flight: the device-resident window
+        # state carries the batch-to-batch dependency, so dispatches overlap
+        # the host<->device round trip (reference proxies likewise keep
+        # multiple commit batches in flight across pipeline stages).
+        from collections import deque
+        inflight = deque()
+        for txns, version in batches[3:]:
+            inflight.append((txns, cs.resolve_async(
+                txns, version, new_oldest_version=max(version - window, 0))))
+            if len(inflight) > PIPELINE_DEPTH:
+                txns_done, h = inflight.popleft()
+                results = h.wait()
+                n_txns += len(txns_done)
+                n_ranges += len(txns_done) * (READS_PER_TXN + WRITES_PER_TXN)
+                committed += sum(1 for r in results if int(r) == 2)
+        while inflight:
+            txns_done, h = inflight.popleft()
+            results = h.wait()
+            n_txns += len(txns_done)
+            n_ranges += len(txns_done) * (READS_PER_TXN + WRITES_PER_TXN)
+            committed += sum(1 for r in results if int(r) == 2)
+    else:
+        for txns, version in batches[3:]:
+            results = cs.resolve(txns, version,
+                                 new_oldest_version=max(version - window, 0))
+            n_txns += len(txns)
+            n_ranges += len(txns) * (READS_PER_TXN + WRITES_PER_TXN)
+            committed += sum(1 for r in results if int(r) == 2)
+    dt = time.perf_counter() - t0
+
+    value = n_ranges / dt
+    print(json.dumps({
+        "metric": "conflict_range_checks_per_s",
+        "value": round(value, 1),
+        "unit": "ranges/s",
+        "vs_baseline": round(value / NORTH_STAR_RANGES_PER_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -54,8 +54,20 @@ class ConflictSet:
 
 def new_conflict_set(backend: Optional[str] = None,
                      oldest_version: Version = 0, **kwargs) -> ConflictSet:
-    """Factory honoring the CONFLICT_SET_BACKEND knob (north-star selector)."""
+    """Factory honoring the CONFLICT_SET_BACKEND knob (north-star selector).
+
+    "auto" resolves at creation time: the TPU backend when a JAX accelerator
+    is attached, otherwise the CPU oracle (the window state is a single
+    shared history, so the choice cannot vary per batch)."""
     backend = backend or server_knobs().CONFLICT_SET_BACKEND
+    if backend == "auto":
+        backend = "cpu"
+        try:
+            import jax
+            if jax.devices()[0].platform != "cpu":
+                backend = "tpu"
+        except Exception:
+            pass
     if backend == "cpu":
         from .oracle import OracleConflictSet
         return OracleConflictSet(oldest_version)
@@ -63,6 +75,10 @@ def new_conflict_set(backend: Optional[str] = None,
         from .tpu_backend import TpuConflictSet
         return TpuConflictSet(oldest_version, **kwargs)
     if backend == "native":
-        from .native import NativeConflictSet
+        try:
+            from .native import NativeConflictSet
+        except ImportError as e:
+            raise ValueError(
+                "native conflict backend not built (see cpp/)") from e
         return NativeConflictSet(oldest_version)
     raise ValueError(f"unknown conflict set backend {backend!r}")

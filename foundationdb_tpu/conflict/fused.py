@@ -1,0 +1,177 @@
+"""Fused single-dispatch conflict resolution kernel.
+
+One jitted device program per (txn, read, write) bucket shape that runs the
+ENTIRE resolveBatch data path of the reference resolver
+(fdbserver/Resolver.actor.cpp:104 + SkipList.cpp:909 detectConflicts):
+
+    too-old -> history query -> intra-batch fixpoint -> insert -> (GC)
+
+entirely on device.  The host ships TWO arrays per batch (one uint32 digest
+block, one int32 metadata block — each host->device transfer over the PCIe/
+tunnel link costs ~4ms of latency, so inputs are packed) and fetches one
+result array; nothing in the batch-to-batch dependency chain touches the
+host, so consecutive commit batches pipeline across the host<->device round
+trip exactly like the reference overlaps commit batches across pipeline
+stages (CommitProxyServer.actor.cpp:589,1075 gates).
+
+GC (reference removeBefore, SkipList.cpp:576 — lazy and amortized there too)
+runs every few batches under a metadata flag, not per batch: it is an O(CAP)
+compaction whose cost is independent of the batch, and deferring it is
+decision-invariant (merged segments all sit below the window floor).
+
+Intra-batch semantics (checkIntraBatchConflicts, SkipList.cpp:874-906) are
+order-sequential: a reader conflicts iff an EARLIER SURVIVING transaction in
+the same batch wrote an overlapping range.  The dependency structure is
+strictly lower-triangular in batch order, so Jacobi iteration — recomputing
+from the history-only baseline each round — converges to the unique
+sequential solution in at most chain-depth rounds (typically 1-2):
+
+    conflicted_{k+1}[t] = hist[t]  OR  exists read r of t, write w of s:
+                          s < t, not conflicted_k[s], overlap(r, w)
+
+Each round is an interval-overlap-MIN query batch (ops/segtree.py): rank all
+range endpoints into a gap universe, min-cover the gaps with active writers'
+transaction indices, then one range-min per read; conflict iff min < t.
+
+Metadata block layout (int32[2*R + 2*W... see offsets in make_resolve_step]):
+    r_txn[R], r_valid[R], w_txn[W], w_valid[W],
+    t_snap[T], t_has_reads[T], t_valid[T],
+    now_rel, oldest_rel, new_oldest_rel, rebase_delta, do_gc
+Digest block layout (uint32[2*R + 2*W, 6]): r_b, r_e, w_b, w_e.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.digest import KEY_LANES, MAX_DIGEST, searchsorted_left
+from ..ops.segtree import (INF_I32, build_min_table, interval_min_cover,
+                           range_min)
+from .window import WindowState, window_gc, window_insert, window_query
+
+from ..txn.types import CommitResult
+
+RES_CONFLICT = int(CommitResult.CONFLICT)
+RES_TOO_OLD = int(CommitResult.TOO_OLD)
+RES_COMMITTED = int(CommitResult.COMMITTED)
+RES_INVALID = -1
+
+N_SCALARS = 5  # now_rel, oldest_rel, new_oldest_rel, rebase_delta, do_gc
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(int(n - 1).bit_length(), 1)
+
+
+def meta_size(t_cap: int, r_cap: int, w_cap: int) -> int:
+    return 2 * r_cap + 2 * w_cap + 3 * t_cap + N_SCALARS
+
+
+@lru_cache(maxsize=64)
+def make_resolve_step(cap: int, t_cap: int, r_cap: int, w_cap: int):
+    """Build the jitted fused step for one bucket shape.
+
+    Returns fn(bk, bv, size, digests, meta)
+        -> (bk', bv', size', out) where out = int32[t_cap + 2] =
+           [codes..., overflow, live_boundary_count]."""
+    u_cap = _next_pow2(2 * (r_cap + w_cap))
+    log_u = u_cap.bit_length() - 1
+
+    def step(bk, bv, size, digests, meta):
+        # ---- unpack the two packed input blocks ---------------------------
+        r_b = digests[0:r_cap]
+        r_e = digests[r_cap:2 * r_cap]
+        w_b = digests[2 * r_cap:2 * r_cap + w_cap]
+        w_e = digests[2 * r_cap + w_cap:2 * r_cap + 2 * w_cap]
+        o = 0
+        r_txn = meta[o:o + r_cap]; o += r_cap
+        r_valid = meta[o:o + r_cap] != 0; o += r_cap
+        w_txn = meta[o:o + w_cap]; o += w_cap
+        w_valid = meta[o:o + w_cap] != 0; o += w_cap
+        t_snap = meta[o:o + t_cap]; o += t_cap
+        t_has_reads = meta[o:o + t_cap] != 0; o += t_cap
+        t_valid = meta[o:o + t_cap] != 0; o += t_cap
+        now_rel = meta[o]
+        oldest_rel = meta[o + 1]
+        new_oldest_rel = meta[o + 2]
+        rebase_delta = meta[o + 3]
+        do_gc = meta[o + 4] != 0
+
+        # ---- too-old: snapshot below the window floor (SkipList.cpp:819) --
+        too_old = t_valid & t_has_reads & (t_snap < oldest_rel)
+
+        # ---- history check (window query over the MVCC window) ------------
+        r_txn_c = jnp.clip(r_txn, 0, t_cap - 1)
+        r_live = r_valid & ~too_old[r_txn_c]
+        snap_r = t_snap[r_txn_c]
+        hist_bits = window_query(bk, bv, r_b, r_e, snap_r, r_live)
+        r_scatter = jnp.where(r_live, r_txn, t_cap)
+        hist_conflicted = jnp.zeros((t_cap,), bool).at[r_scatter].max(
+            hist_bits, mode="drop")
+
+        # ---- endpoint gap universe for intra-batch overlap tests ----------
+        pad = jnp.broadcast_to(jnp.asarray(MAX_DIGEST),
+                               (u_cap - digests.shape[0], KEY_LANES))
+        all_d = jnp.concatenate([digests, pad], axis=0)
+        ops = [all_d[:, l] for l in range(KEY_LANES)]
+        sorted_ops = jax.lax.sort(ops, num_keys=KEY_LANES)
+        universe = jnp.stack(sorted_ops, axis=1)            # [U, 6] sorted
+        r_pb = searchsorted_left(universe, r_b)
+        r_pe = searchsorted_left(universe, r_e)
+        w_pb = searchsorted_left(universe, w_b)
+        w_pe = searchsorted_left(universe, w_e)
+
+        w_txn_c = jnp.clip(w_txn, 0, t_cap - 1)
+        w_base_ok = w_valid & ~too_old[w_txn_c]
+
+        # ---- intra-batch fixpoint (Jacobi on the triangular system) -------
+        # Each iteration RECOMPUTES conflicts from the history-only baseline:
+        # a conflict inferred from a writer that itself turns out conflicted
+        # must be retractable, or chains (t1 w A; t2 r A w B; t3 r B) would
+        # wrongly abort t3.  Prefix-correctness of Jacobi on the triangular
+        # dependency system guarantees convergence in <= chain-depth rounds.
+        def body(carry):
+            conf, _ = carry
+            w_active = w_base_ok & ~conf[w_txn_c]
+            cover = interval_min_cover(w_pb, w_pe, w_txn, w_active, log_u)
+            table = build_min_table(cover)
+            m = range_min(table, r_pb, r_pe)
+            intra_hit = r_live & (m < r_txn)
+            new_conf = hist_conflicted.at[r_scatter].max(intra_hit, mode="drop")
+            changed = jnp.any(new_conf != conf)
+            return new_conf, changed
+
+        def cond(carry):
+            return carry[1]
+
+        conflicted, _ = jax.lax.while_loop(
+            cond, body, (hist_conflicted, True))
+
+        # ---- insert surviving writes at `now` -----------------------------
+        survivor = t_valid & ~too_old & ~conflicted
+        w_ins = w_valid & survivor[w_txn_c]
+        (bk2, bv2, size2), overflow = window_insert(
+            WindowState(bk, bv, size), w_b, w_e, w_ins, now_rel)
+
+        # ---- amortized GC / rebase (removeBefore, SkipList.cpp:576) -------
+        st3 = jax.lax.cond(
+            do_gc,
+            lambda s: window_gc(s, new_oldest_rel, rebase_delta),
+            lambda s: s,
+            WindowState(bk2, bv2, size2))
+
+        codes = jnp.where(
+            ~t_valid, RES_INVALID,
+            jnp.where(too_old, RES_TOO_OLD,
+                      jnp.where(conflicted, RES_CONFLICT, RES_COMMITTED))
+        ).astype(jnp.int32)
+        out = jnp.concatenate([
+            codes,
+            overflow.astype(jnp.int32)[None],
+            st3.size.astype(jnp.int32)[None]])
+        return st3.bk, st3.bv, st3.size, out
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))

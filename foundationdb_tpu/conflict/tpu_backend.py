@@ -1,31 +1,31 @@
 """TPU-offloaded ConflictSet: the north-star backend (BASELINE.json).
 
-Orchestrates the device window kernels (conflict/window.py) from the host:
-
-  per commit batch (reference Resolver.actor.cpp:104 resolveBatch):
-    1. host: too-old classification against the MVCC floor
-    2. device: batched history conflict check (window_query)
-    3. host: order-sequential intra-batch pass (conflict/intra.py)
-    4. device: insert surviving write ranges at the batch version
-    5. device: amortized removeBefore GC + int32 version rebase
+Drives the fused device kernel (conflict/fused.py), which runs the entire
+resolveBatch data path — too-old, history query, intra-batch fixpoint,
+insert, GC — in ONE device dispatch per commit batch.  The host's only jobs
+are encoding the batch into digest arrays and fetching the verdict array;
+the batch-to-batch dependency chain (window state) lives on device, so
+consecutive batches pipeline across the host<->device round trip via
+resolve_async() — the analog of the reference proxy keeping multiple commit
+batches in flight (CommitProxyServer.actor.cpp:589 pipeline gates).
 
 Batch arrays are padded to power-of-two buckets so XLA compiles one program
 per bucket (SURVEY.md §7 hard part 2).  Versions are int32 offsets from
-self.version_base (rebased during GC).  Decisions are bit-identical to the
-CPU oracle for keys <= 23 bytes; longer keys round conservatively (extra
-aborts possible, missed conflicts impossible) -- see ops/digest.py.
+self.version_base (rebased during the in-kernel GC).  Decisions are
+bit-identical to the CPU oracle for keys <= 23 bytes; longer keys round
+conservatively (extra aborts possible, missed conflicts impossible) — see
+ops/digest.py.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 
 from ..core.knobs import server_knobs
 from ..txn.types import CommitResult, CommitTransactionRef, Version
 from .api import ConflictSet
-from .intra import intra_batch_resolve
 
 _MIN_BUCKET = 256
 
@@ -37,21 +37,78 @@ def _bucket(n: int) -> int:
     return b
 
 
+class ResolveHandle:
+    """In-flight resolution of one batch; wait() returns the verdicts."""
+
+    def __init__(self, cs: "TpuConflictSet", out, n_txns: int, t_cap: int,
+                 retry_ctx: Optional[dict] = None) -> None:
+        self._cs = cs
+        self._out = out
+        self._n = n_txns
+        self._t_cap = t_cap
+        self._retry_ctx = retry_ctx
+        self._results: Optional[List[CommitResult]] = None
+        self._error: Optional[BaseException] = None
+
+    def wait(self) -> List[CommitResult]:
+        if self._error is not None:
+            raise self._error
+        if self._results is None:
+            arr = np.asarray(self._out)  # one d2h transfer, syncs the step
+            if self in self._cs._inflight:
+                self._cs._inflight.remove(self)
+                self._cs._live_boundaries = int(arr[self._t_cap + 1])
+            if bool(arr[self._t_cap]):  # insert overflowed
+                arr = self._handle_overflow()
+            self._results = [CommitResult(c) for c in arr[:self._n]]
+        return self._results
+
+    def _handle_overflow(self) -> np.ndarray:
+        """Emergency GC + one retry of the same batch (reference SkipList
+        overflow pressure is likewise relieved by forcing removeBefore).
+        Only possible when no later batch is in flight: a later batch was
+        resolved against a window missing this batch's writes."""
+        from ..core.error import err
+        cs = self._cs
+        if cs._inflight or self._retry_ctx is None:
+            self._error = err(
+                "internal_error",
+                "TPU conflict window capacity exceeded with later batches "
+                "in flight; raise TPU_CONFLICT_CAPACITY or gc interval")
+            raise self._error
+        cs._force_gc()
+        ctx = self._retry_ctx
+        h2 = cs._dispatch(ctx["enc"], ctx["now"], ctx["old_floor"],
+                          ctx["new_floor"], self._n, retry=True)
+        cs._inflight.remove(h2)
+        arr = np.asarray(h2._out)
+        cs._live_boundaries = int(arr[self._t_cap + 1])
+        if bool(arr[self._t_cap]):
+            self._error = err(
+                "internal_error",
+                "TPU conflict window capacity exceeded even after GC; "
+                "raise TPU_CONFLICT_CAPACITY")
+            raise self._error
+        return arr
+
+
 class TpuConflictSet(ConflictSet):
     def __init__(self, oldest_version: Version = 0,
                  capacity: Optional[int] = None,
                  gc_interval_batches: int = 8) -> None:
         super().__init__(oldest_version)
         import jax.numpy as jnp  # lazy: backend selectable without jax init
-        from . import window
-        self._w = window
+        from . import fused, window
         self._jnp = jnp
+        self._fused = fused
         self.capacity = capacity or int(server_knobs().TPU_CONFLICT_CAPACITY)
         self.version_base = oldest_version
-        self.state = window.make_window_state(self.capacity, 0)
-        self._batches_since_gc = 0
+        st = window.make_window_state(self.capacity, 0)
+        self.bk, self.bv, self.size = st.bk, st.bv, st.size
+        self._inflight: List[ResolveHandle] = []
+        self._live_boundaries = 1
         self._gc_interval = gc_interval_batches
-        self._pending_oldest: Optional[Version] = None
+        self._batches_since_gc = 0
 
     # An int32 offset span we never let live versions approach; beyond this
     # resolve() forces a rebase, and if the window floor lags so far behind
@@ -60,130 +117,164 @@ class TpuConflictSet(ConflictSet):
     # real conflict).
     _REL_LIMIT = (1 << 31) - (1 << 24)
 
-    # -- helpers ------------------------------------------------------------
     def _rel(self, v: Version) -> int:
-        """Absolute version -> int32 offset from version_base."""
         off = v - self.version_base
         if off >= self._REL_LIMIT:
             from ..core.error import err
             raise err("internal_error",
                       f"version offset {off} exceeds int32 window; "
                       "advance new_oldest_version to allow rebasing")
-        # Snapshots far below the base (already deep in TOO_OLD territory)
-        # may clamp upward safely: every comparison against them has the
-        # same outcome anywhere below the window floor.
         return int(max(off, -(1 << 31) + 2))
 
     def clear(self, version: Version) -> None:
         # Like the reference clearConflictSet (SkipList.cpp:797): V(k) :=
         # version everywhere; oldest_version is deliberately NOT changed.
+        if self._inflight:
+            from ..core.error import err
+            raise err("internal_error",
+                      "clear() with batches in flight; wait() them first")
+        from . import window
         self.version_base = version
-        self.state = self._w.make_window_state(self.capacity, 0)
-        self._pending_oldest = None
+        st = window.make_window_state(self.capacity, 0)
+        self.bk, self.bv, self.size = st.bk, st.bv, st.size
+        self._live_boundaries = 1
+        self._batches_since_gc = 0
 
-    # -- resolve ------------------------------------------------------------
-    def resolve(self, transactions: Sequence[CommitTransactionRef], now: Version,
-                new_oldest_version: Optional[Version] = None) -> List[CommitResult]:
-        from ..ops.digest import KEY_LANES, encode_keys
+    def _force_gc(self) -> None:
+        """Immediate out-of-band removeBefore + rebase (overflow pressure)."""
+        from .window import WindowState, window_gc
         jnp = self._jnp
-        # Proactive rebase long before the int32 offset space runs out.
-        if now - self.version_base >= (1 << 30):
-            self._run_gc(force=True)
-        n = len(transactions)
-        too_old = [bool(tr.read_snapshot < self.oldest_version and
-                        tr.read_conflict_ranges) for tr in transactions]
-        conflicted = [False] * n
+        delta = max(self.oldest_version - self.version_base, 0)
+        st = window_gc(WindowState(self.bk, self.bv, self.size),
+                       jnp.int32(self._rel(self.oldest_version)),
+                       jnp.int32(delta))
+        self.bk, self.bv, self.size = st.bk, st.bv, st.size
+        self.version_base += delta
+        self._batches_since_gc = 0
 
-        # --- gather read ranges of live txns -------------------------------
-        r_keys_b, r_keys_e, r_snap, r_txn = [], [], [], []
+    # -- batch encoding -----------------------------------------------------
+    def _encode_batch(self, transactions: Sequence[CommitTransactionRef]):
+        from ..ops.digest import KEY_LANES, MAX_DIGEST, encode_keys
+        n = len(transactions)
+        r_bk: List[bytes] = []
+        r_ek: List[bytes] = []
+        r_txn: List[int] = []
+        w_bk: List[bytes] = []
+        w_ek: List[bytes] = []
+        w_txn: List[int] = []
+        t_snap = np.empty((n,), dtype=np.int64)
+        t_has = np.empty((n,), dtype=bool)
         for t, tr in enumerate(transactions):
-            if too_old[t]:
-                continue
+            t_snap[t] = tr.read_snapshot
+            t_has[t] = bool(tr.read_conflict_ranges)
             for r in tr.read_conflict_ranges:
                 if r.begin < r.end:
-                    r_keys_b.append(r.begin)
-                    r_keys_e.append(r.end)
-                    r_snap.append(self._rel(tr.read_snapshot))
+                    r_bk.append(r.begin)
+                    r_ek.append(r.end)
                     r_txn.append(t)
-
-        # --- device history check ------------------------------------------
-        if r_keys_b:
-            rcap = _bucket(len(r_keys_b))
-            nb = np.zeros((rcap, KEY_LANES), dtype=np.uint32)
-            ne = np.zeros((rcap, KEY_LANES), dtype=np.uint32)
-            nb[:len(r_keys_b)] = encode_keys(r_keys_b)
-            ne[:len(r_keys_e)] = encode_keys(r_keys_e, round_up=True)
-            snap = np.zeros((rcap,), dtype=np.int32)
-            snap[:len(r_snap)] = r_snap
-            valid = np.zeros((rcap,), dtype=bool)
-            valid[:len(r_keys_b)] = True
-            bits = np.asarray(self._w.window_query(
-                self.state.bk, self.state.bv,
-                jnp.asarray(nb), jnp.asarray(ne),
-                jnp.asarray(snap), jnp.asarray(valid)))
-            for i, t in enumerate(r_txn):
-                if bits[i]:
-                    conflicted[t] = True
-
-        # --- host intra-batch pass -----------------------------------------
-        conflicted = intra_batch_resolve(transactions, conflicted, too_old)
-
-        # --- device insert of surviving writes -----------------------------
-        w_keys_b, w_keys_e = [], []
-        for t, tr in enumerate(transactions):
-            if too_old[t] or conflicted[t]:
-                continue
             for w in tr.write_conflict_ranges:
                 if w.begin < w.end:
-                    w_keys_b.append(w.begin)
-                    w_keys_e.append(w.end)
-        if w_keys_b:
-            wcap = _bucket(len(w_keys_b))
-            wb = np.zeros((wcap, KEY_LANES), dtype=np.uint32)
-            we = np.zeros((wcap, KEY_LANES), dtype=np.uint32)
-            wb[:len(w_keys_b)] = encode_keys(w_keys_b)
-            we[:len(w_keys_e)] = encode_keys(w_keys_e, round_up=True)
-            wvalid = np.zeros((wcap,), dtype=bool)
-            wvalid[:len(w_keys_b)] = True
-            self.state, overflow = self._w.window_insert(
-                self.state, jnp.asarray(wb), jnp.asarray(we),
-                jnp.asarray(wvalid), jnp.int32(self._rel(now)))
-            if bool(overflow):
-                # Emergency: force GC and retry once; if still full, fail loud.
-                self._run_gc(force=True)
-                self.state, overflow = self._w.window_insert(
-                    self.state, jnp.asarray(wb), jnp.asarray(we),
-                    jnp.asarray(wvalid), jnp.int32(self._rel(now)))
-                if bool(overflow):
-                    from ..core.error import err
-                    raise err("internal_error",
-                              "TPU conflict window capacity exceeded")
+                    w_bk.append(w.begin)
+                    w_ek.append(w.end)
+                    w_txn.append(t)
 
-        # --- window floor / GC ---------------------------------------------
-        if new_oldest_version is not None and new_oldest_version > self.oldest_version:
-            self.oldest_version = new_oldest_version
-            self._pending_oldest = new_oldest_version
-        self._batches_since_gc += 1
-        if self._pending_oldest is not None and (
-                self._batches_since_gc >= self._gc_interval):
-            self._run_gc()
+        t_cap = _bucket(n)
+        r_cap = _bucket(len(r_bk))
+        w_cap = _bucket(len(w_bk))
+        nr, nw = len(r_bk), len(w_bk)
 
-        return [CommitResult.TOO_OLD if too_old[t]
-                else CommitResult.CONFLICT if conflicted[t]
-                else CommitResult.COMMITTED for t in range(n)]
+        # Packed digest block: r_b | r_e | w_b | w_e (one h2d transfer).
+        digests = np.broadcast_to(
+            MAX_DIGEST, (2 * r_cap + 2 * w_cap, KEY_LANES)).copy()
+        if nr:
+            digests[:nr] = encode_keys(r_bk)
+            digests[r_cap:r_cap + nr] = encode_keys(r_ek, round_up=True)
+        if nw:
+            digests[2 * r_cap:2 * r_cap + nw] = encode_keys(w_bk)
+            digests[2 * r_cap + w_cap:2 * r_cap + w_cap + nw] = \
+                encode_keys(w_ek, round_up=True)
 
-    def _run_gc(self, force: bool = False) -> None:
-        self._batches_since_gc = 0
-        oldest = self._pending_oldest if self._pending_oldest is not None \
-            else self.oldest_version
-        self._pending_oldest = None
-        # Rebase so the int32 offset space stays centered on the live window.
-        delta = max(oldest - self.version_base, 0)
-        self.state = self._w.window_gc(
-            self.state, self._jnp.int32(self._rel(oldest)),
-            self._jnp.int32(delta))
+        # Packed int32 metadata block (second h2d transfer); scalar slots at
+        # the end are filled by _dispatch.
+        meta = np.zeros((self._fused.meta_size(t_cap, r_cap, w_cap),),
+                        dtype=np.int32)
+        o = 0
+        meta[o:o + nr] = r_txn; o += r_cap
+        meta[o:o + nr] = 1; o += r_cap
+        meta[o:o + nw] = w_txn; o += w_cap
+        meta[o:o + nw] = 1; o += w_cap
+        snap_off = o; o += t_cap
+        meta[o:o + n] = t_has; o += t_cap
+        meta[o:o + n] = 1; o += t_cap
+
+        return {"digests": digests, "meta": meta, "snap_off": snap_off,
+                "scalar_off": o, "t_snap_abs": t_snap,
+                "caps": (t_cap, r_cap, w_cap)}
+
+    def _dispatch(self, enc, now: Version, oldest_floor: Version,
+                  new_oldest: Version, n_txns: int,
+                  retry: bool = False) -> ResolveHandle:
+        jnp = self._jnp
+        t_cap, r_cap, w_cap = enc["caps"]
+        # Amortized GC cadence (reference removeBefore is likewise lazy);
+        # rebase rides the GC pass.  Deferring is decision-invariant: GC only
+        # merges segments wholly below the window floor.
+        if retry:
+            do_gc = False  # _force_gc just ran
+        else:
+            self._batches_since_gc += 1
+            do_gc = self._batches_since_gc >= self._gc_interval
+        delta = max(new_oldest - self.version_base, 0) if do_gc else 0
+
+        meta = enc["meta"]
+        so = enc["snap_off"]
+        off = np.clip(enc["t_snap_abs"] - self.version_base,
+                      -(1 << 31) + 2, None)
+        if off.size and off.max() >= self._REL_LIMIT:
+            from ..core.error import err
+            raise err("internal_error",
+                      "version offset exceeds int32 window; "
+                      "advance new_oldest_version to allow rebasing")
+        meta[so:so + n_txns] = off.astype(np.int32)
+        sc = enc["scalar_off"]
+        meta[sc:sc + 5] = (self._rel(now), self._rel(oldest_floor),
+                           self._rel(new_oldest), delta, int(do_gc))
+
+        step = self._fused.make_resolve_step(self.capacity, t_cap, r_cap, w_cap)
+        self.bk, self.bv, self.size, out = step(
+            self.bk, self.bv, self.size,
+            jnp.asarray(enc["digests"]), jnp.asarray(meta))
         self.version_base += delta
+        if do_gc:
+            self._batches_since_gc = 0
+        handle = ResolveHandle(
+            self, out, n_txns, t_cap,
+            retry_ctx=None if retry else {
+                "enc": enc, "now": now, "old_floor": oldest_floor,
+                "new_floor": new_oldest})
+        self._inflight.append(handle)
+        return handle
+
+    # -- public API ---------------------------------------------------------
+    def resolve_async(self, transactions: Sequence[CommitTransactionRef],
+                      now: Version,
+                      new_oldest_version: Optional[Version] = None
+                      ) -> ResolveHandle:
+        """Dispatch one batch; returns a handle whose wait() yields verdicts.
+
+        Batches MUST be dispatched in version order; the device window state
+        carries the dependency, so any number may be in flight."""
+        old_floor = self.oldest_version
+        new_floor = max(new_oldest_version or old_floor, old_floor)
+        enc = self._encode_batch(transactions)
+        h = self._dispatch(enc, now, old_floor, new_floor, len(transactions))
+        self.oldest_version = new_floor
+        return h
+
+    def resolve(self, transactions: Sequence[CommitTransactionRef], now: Version,
+                new_oldest_version: Optional[Version] = None) -> List[CommitResult]:
+        return self.resolve_async(transactions, now, new_oldest_version).wait()
 
     # -- introspection ------------------------------------------------------
     def segment_count(self) -> int:
-        return int(self.state.size)
+        return self._live_boundaries if not self._inflight else int(self.size)

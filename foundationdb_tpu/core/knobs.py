@@ -69,11 +69,10 @@ class ServerKnobs(KnobBase):
 
         # Conflict-set backend selector -- OUR north-star gate. "cpu" = the
         # Python oracle; "native" = C++ skip-structure; "tpu" = JAX device
-        # kernel over the HBM-resident window.
+        # kernel over the HBM-resident window; "auto" = tpu when an
+        # accelerator is attached, else cpu.
         self.CONFLICT_SET_BACKEND = "cpu"
-        self.TPU_CONFLICT_MIN_BATCH = 64
-        self.TPU_CONFLICT_CAPACITY = 1 << 20  # max resident history segments
-        self.TPU_CONFLICT_MAX_RANGES = 1 << 14  # per-batch padded range budget
+        self.TPU_CONFLICT_CAPACITY = 1 << 17  # max resident history segments
 
         # GRV / ratekeeper
         self.START_TRANSACTION_BATCH_INTERVAL_MIN = 1e-6
@@ -93,7 +92,6 @@ class ServerKnobs(KnobBase):
         self._rand("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX",
                    lambda r: r.random01() * 0.1 + 0.001)
         self._rand("RESOLVER_STATE_MEMORY_LIMIT", lambda r: 3e6)
-        self._rand("TPU_CONFLICT_MIN_BATCH", lambda r: r.random_int(1, 256))
 
 
 class ClientKnobs(KnobBase):

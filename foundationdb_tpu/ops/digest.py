@@ -45,17 +45,29 @@ def is_truncated(key: bytes) -> bool:
 
 def encode_keys(keys: Sequence[bytes], round_up: bool = False) -> np.ndarray:
     """Encode keys -> uint32[N, 6]. round_up=True applies the +1ulp rounding
-    to truncated keys (for range *ends*)."""
+    to truncated keys (for range *ends*).
+
+    Vectorized by grouping keys of equal length: one frombuffer + one fancy
+    assignment per distinct length (batches are dominated by one or two key
+    widths, so this is ~two numpy ops per batch instead of a per-key loop)."""
     n = len(keys)
     buf = np.zeros((n, DIGEST_BYTES), dtype=np.uint8)
     bump = np.zeros((n,), dtype=bool)
+    groups: dict = {}
     for i, k in enumerate(keys):
-        m = min(len(k), PREFIX_BYTES)
+        groups.setdefault(len(k), []).append(i)
+    for length, idxs in groups.items():
+        m = min(length, PREFIX_BYTES)
+        ii = np.asarray(idxs, dtype=np.intp)
         if m:
-            buf[i, :m] = np.frombuffer(k[:m], dtype=np.uint8)
-        buf[i, PREFIX_BYTES] = min(len(k), PREFIX_BYTES + 1)
-        if round_up and len(k) > PREFIX_BYTES:
-            bump[i] = True
+            if length <= PREFIX_BYTES:
+                data = b"".join(keys[i] for i in idxs)
+            else:
+                data = b"".join(keys[i][:m] for i in idxs)
+            buf[ii, :m] = np.frombuffer(data, dtype=np.uint8).reshape(-1, m)
+        buf[ii, PREFIX_BYTES] = min(length, PREFIX_BYTES + 1)
+        if round_up and length > PREFIX_BYTES:
+            bump[ii] = True
     lanes = buf.reshape(n, KEY_LANES, 4)
     out = (lanes[:, :, 0].astype(np.uint32) << 24 |
            lanes[:, :, 1].astype(np.uint32) << 16 |

@@ -1,0 +1,85 @@
+"""Static-shape interval min-cover structure (device-side).
+
+Answers, for a universe of U elementary gaps and a set of weighted intervals
+(span [l, r) with weight w), the query "min weight over intervals overlapping
+gap range [a, b)".  Used by the fused conflict kernel's intra-batch pass: the
+weight is the writer's transaction index, so a read range conflicts iff
+min-overlapping-writer < its own transaction index (strictly earlier writer).
+
+Construction is an iterative segment tree with all control flow static:
+  * span_update: each interval min-updates <= 2 nodes per level (log U levels,
+    two masked scatter-mins each);
+  * pushdown: one top-down level sweep propagates ancestor minima to leaves,
+    producing cover[g] = min weight over intervals covering gap g;
+  * range queries over cover[] then use the sparse range-min table.
+
+Everything is O((N + U) log U) with static shapes -- XLA compiles one program
+per (N, U) bucket, no data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+INF_I32 = jnp.int32((1 << 31) - 1)
+
+
+def interval_min_cover(l: jnp.ndarray, r: jnp.ndarray, w: jnp.ndarray,
+                       valid: jnp.ndarray, log_u: int) -> jnp.ndarray:
+    """cover[g] = min{w[i] : valid[i] and l[i] <= g < r[i]} (INF if none).
+
+    l, r: int32[N] spans over [0, U) with U = 1 << log_u; w: int32[N]."""
+    u = 1 << log_u
+    tree = jnp.full((2 * u,), INF_I32, dtype=jnp.int32)
+    wv = jnp.where(valid & (l < r), w, INF_I32)
+    li = jnp.clip(l, 0, u) + u
+    ri = jnp.clip(r, 0, u) + u
+    # Standard iterative decomposition, vectorized across intervals: at each
+    # level, an odd left cursor contributes node li (then li+=1), an odd right
+    # cursor contributes node ri-1 (then ri-=1); both cursors then halve.
+    for _ in range(log_u + 1):
+        active = li < ri
+        take_l = active & (li & 1 == 1)
+        take_r = active & (ri & 1 == 1)
+        idx_l = jnp.where(take_l, li, 0)          # node 0 is unused padding
+        idx_r = jnp.where(take_r, ri - 1, 0)
+        tree = tree.at[idx_l].min(jnp.where(take_l, wv, INF_I32))
+        tree = tree.at[idx_r].min(jnp.where(take_r, wv, INF_I32))
+        li = (li + (li & 1)) >> 1
+        ri = (ri - (ri & 1)) >> 1
+    # Pushdown: children inherit parent minima level by level.
+    for level in range(1, log_u + 1):
+        lo = 1 << level
+        parents = tree[lo >> 1: lo]
+        seg = tree[lo: 2 * lo]
+        seg = jnp.minimum(seg, jnp.repeat(parents, 2))
+        tree = tree.at[lo: 2 * lo].set(seg)
+    return tree[u: 2 * u]
+
+
+def build_min_table(values: jnp.ndarray) -> jnp.ndarray:
+    """Doubling sparse table for range-MIN (mirror of rangemax.py)."""
+    cap = values.shape[0]
+    log = max((cap - 1).bit_length(), 1)
+    rows = [values]
+    cur = values
+    for j in range(log):
+        shift = 1 << j
+        shifted = jnp.concatenate(
+            [cur[shift:], jnp.full((shift,), INF_I32, dtype=cur.dtype)])
+        cur = jnp.minimum(cur, shifted)
+        rows.append(cur)
+    return jnp.stack(rows)
+
+
+def range_min(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """Per-query min(values[lo:hi]); empty ranges -> INF."""
+    length = hi - lo
+    valid = length > 0
+    safe_len = jnp.maximum(length, 1)
+    j = 31 - jax.lax.clz(safe_len.astype(jnp.int32))
+    cap = table.shape[1]
+    left = table[j, jnp.clip(lo, 0, cap - 1)]
+    right = table[j, jnp.clip(hi - (1 << j), 0, cap - 1)]
+    return jnp.where(valid, jnp.minimum(left, right), INF_I32)

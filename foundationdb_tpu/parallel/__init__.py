@@ -1,0 +1,16 @@
+"""Multi-chip parallelism: key-range sharded conflict window over a Mesh.
+
+FDB's parallelism axes (SURVEY.md §2.5) map onto mesh axes:
+  * "kr"  — key-range sharding of conflict resolution (the resolver axis;
+            reference ProxyCommitData::keyResolvers fan-out with min-combine,
+            CommitProxyServer.actor.cpp:152-181,800-806).  Here: the conflict
+            window is sharded by digest range; per-shard partial conflict
+            bitmaps are OR-reduced with psum over ICI.
+  * "q"   — data parallelism over the query batch (independent read-range
+            checks of one commit batch spread across chips).
+"""
+
+from .sharded_window import (ShardedWindow, default_mesh_axes,
+                             make_conflict_mesh)
+
+__all__ = ["ShardedWindow", "make_conflict_mesh", "default_mesh_axes"]

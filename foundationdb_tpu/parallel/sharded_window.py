@@ -1,0 +1,181 @@
+"""Key-range-sharded conflict window across a device mesh.
+
+The reference scales conflict resolution by partitioning the keyspace across
+resolvers and min-combining their verdicts at the proxy
+(CommitProxyServer.actor.cpp:152-181 request fan-out, :800-806 min-combine;
+rebalancing masterserver.actor.cpp:1318).  The TPU formulation shards the
+same axis across chips inside ONE resolver:
+
+  * the digest space [0, 2^192) is split into D contiguous sub-ranges, one
+    per device along mesh axis "kr";
+  * each device holds a full window (conflict/window.py arrays) restricted
+    to its sub-range: inserts are CLIPPED to the owned range on-device, so
+    V_d(k) == V(k) exactly for k in shard d;
+  * a batch query is broadcast, clipped per shard, answered locally, and the
+    partial conflict bitmaps are OR-reduced (psum of int32) over "kr" — the
+    device-side analog of the proxy's min-combine;
+  * the query batch itself is data-parallel over mesh axis "q".
+
+All collectives ride ICI (psum inside shard_map over the mesh); the host
+only ships the batch once.  This is BASELINE.json config 5 ("sharded version
+window across 4 chips: psum-merged conflict bitmap").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.digest import KEY_LANES, MAX_DIGEST, lex_less
+from ..ops.rangemax import NEG_INF
+from .. import conflict  # noqa: F401  (keep package import order stable)
+from ..conflict.window import WindowState, window_gc, window_insert, window_query
+
+
+def default_mesh_axes(n_devices: int) -> Tuple[int, int]:
+    """Factor n into (kr, q): prefer up to 4 key-range shards, rest data."""
+    kr = 1
+    while kr < 4 and (n_devices % (kr * 2)) == 0:
+        kr *= 2
+    return kr, n_devices // kr
+
+
+def make_conflict_mesh(devices: Optional[Sequence] = None,
+                       n_devices: Optional[int] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    kr, q = default_mesh_axes(len(devices))
+    dev_array = np.asarray(devices).reshape(kr, q)
+    return Mesh(dev_array, ("kr", "q"))
+
+
+def digest_splits(n_shards: int) -> np.ndarray:
+    """uint32[n+1, 6] split points: shard d owns digest range [s[d], s[d+1]).
+
+    Even splits of the first lane; the last split is the MAX_DIGEST sentinel
+    (strictly above every real key digest)."""
+    splits = np.zeros((n_shards + 1, KEY_LANES), dtype=np.uint32)
+    for d in range(1, n_shards):
+        splits[d, 0] = np.uint32((d * (1 << 32)) // n_shards)
+    splits[n_shards] = MAX_DIGEST
+    return splits
+
+
+def _lex_max_rows(a: jnp.ndarray, b_row: jnp.ndarray) -> jnp.ndarray:
+    """Rowwise max(a[i], b_row) lexicographically; a: [N,6], b_row: [6]."""
+    b = jnp.broadcast_to(b_row, a.shape)
+    return jnp.where(lex_less(a, b)[:, None], b, a)
+
+
+def _lex_min_rows(a: jnp.ndarray, b_row: jnp.ndarray) -> jnp.ndarray:
+    b = jnp.broadcast_to(b_row, a.shape)
+    return jnp.where(lex_less(b, a)[:, None], b, a)
+
+
+class ShardedWindow:
+    """Host handle for a conflict window sharded over mesh axis "kr".
+
+    State arrays carry a leading shard axis of size D(kr):
+        bk:   uint32[D, CAP, 6]   sharded P("kr")
+        bv:   int32[D, CAP]       sharded P("kr")
+        size: int32[D]            sharded P("kr")
+    Queries/writes enter replicated; conflict bits leave sharded over "q".
+    """
+
+    def __init__(self, mesh: Mesh, capacity: int = 1 << 14) -> None:
+        assert "kr" in mesh.axis_names and "q" in mesh.axis_names
+        self.mesh = mesh
+        self.capacity = capacity
+        self.n_shards = mesh.shape["kr"]
+        splits = digest_splits(self.n_shards)
+        kr_sharding = NamedSharding(mesh, P("kr"))
+
+        d = self.n_shards
+        bk = np.broadcast_to(MAX_DIGEST, (d, capacity, KEY_LANES)).copy()
+        bv = np.full((d, capacity), int(NEG_INF), dtype=np.int32)
+        # Each shard's base segment starts at its own lower split and carries
+        # version 0 (== the window floor at creation).
+        bk[:, 0, :] = splits[:d]
+        bv[:, 0] = 0
+        size = np.ones((d,), dtype=np.int32)
+        self.bk = jax.device_put(bk, kr_sharding)
+        self.bv = jax.device_put(bv, kr_sharding)
+        self.size = jax.device_put(size, kr_sharding)
+        self.shard_lo = jax.device_put(splits[:d], kr_sharding)
+        self.shard_hi = jax.device_put(splits[1:], kr_sharding)
+        self._step = self._build_step()
+        self._gc = self._build_gc()
+
+    # -- jitted sharded programs -------------------------------------------
+    def _build_step(self):
+        mesh = self.mesh
+
+        def shard_fn(lo, hi, bk, bv, size,
+                     qb, qe, qsnap, qvalid, wb, we, wvalid, now_rel):
+            # block shapes: lo/hi [1,6]; bk [1,CAP,6]; bv [1,CAP]; size [1];
+            # queries sharded over "q": qb [R/Q, 6]; writes replicated [W, 6].
+            lo_r, hi_r = lo[0], hi[0]
+            bk0, bv0, size0 = bk[0], bv[0], size[0]
+            # --- query: clip to shard, answer locally, OR-reduce over kr ---
+            cqb = _lex_max_rows(qb, lo_r)
+            cqe = _lex_min_rows(qe, hi_r)
+            qv = qvalid & lex_less(cqb, cqe)
+            local_bits = window_query(bk0, bv0, cqb, cqe, qsnap, qv)
+            bits = jax.lax.psum(local_bits.astype(jnp.int32), "kr") > 0
+            # --- insert: clip writes to shard, merge locally ---------------
+            cwb = _lex_max_rows(wb, lo_r)
+            cwe = _lex_min_rows(we, hi_r)
+            wv = wvalid & lex_less(cwb, cwe)
+            (nbk, nbv, nsize), ovf = window_insert(
+                WindowState(bk0, bv0, size0), cwb, cwe, wv, now_rel)
+            ovf_any = jax.lax.psum(ovf.astype(jnp.int32), ("kr", "q")) > 0
+            return (bits, nbk[None], nbv[None], nsize[None], ovf_any)
+
+        mapped = jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P("kr"), P("kr"), P("kr"), P("kr"), P("kr"),
+                      P("q"), P("q"), P("q"), P("q"),
+                      P(), P(), P(), P()),
+            out_specs=(P("q"), P("kr"), P("kr"), P("kr"), P()),
+            check_vma=False)
+        return jax.jit(mapped)
+
+    def _build_gc(self):
+        mesh = self.mesh
+
+        def shard_fn(bk, bv, size, oldest_rel, delta):
+            st = window_gc(WindowState(bk[0], bv[0], size[0]), oldest_rel, delta)
+            return st.bk[None], st.bv[None], st.size[None]
+
+        mapped = jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P("kr"), P("kr"), P("kr"), P(), P()),
+            out_specs=(P("kr"), P("kr"), P("kr")),
+            check_vma=False)
+        return jax.jit(mapped)
+
+    # -- public API ---------------------------------------------------------
+    def resolve_step(self, qb, qe, qsnap, qvalid, wb, we, wvalid,
+                     now_rel: int):
+        """One fused device step: batched history check + insert of writes.
+
+        Array args are host numpy (or device) arrays, query batch padded to a
+        multiple of mesh axis "q".  Returns (bits[R] bool, overflow bool)."""
+        bits, self.bk, self.bv, self.size, ovf = self._step(
+            self.shard_lo, self.shard_hi, self.bk, self.bv, self.size,
+            jnp.asarray(qb), jnp.asarray(qe),
+            jnp.asarray(qsnap), jnp.asarray(qvalid),
+            jnp.asarray(wb), jnp.asarray(we), jnp.asarray(wvalid),
+            jnp.int32(now_rel))
+        return bits, ovf
+
+    def gc(self, oldest_rel: int, rebase_delta: int = 0) -> None:
+        self.bk, self.bv, self.size = self._gc(
+            self.bk, self.bv, self.size,
+            jnp.int32(oldest_rel), jnp.int32(rebase_delta))

@@ -53,7 +53,7 @@ def test_tpu_basic_sequence(small_caps):
 
 def test_tpu_gc_and_rebase(small_caps):
     """Window floor advances; decisions stay correct after GC + rebase."""
-    tpu = TpuConflictSet(0, capacity=1 << 12, gc_interval_batches=1)
+    tpu = TpuConflictSet(0, capacity=1 << 12)
     oracle = OracleConflictSet(0)
     rng = DeterministicRandom(7)
     domain = make_domain()
@@ -107,8 +107,11 @@ def test_tpu_intra_batch(small_caps):
 
 
 def test_tpu_capacity_overflow_recovers():
-    """Filling the window past capacity forces GC; old segments vanish."""
-    tpu = TpuConflictSet(0, capacity=256, gc_interval_batches=1000)
+    """Filling the window past capacity forces GC; old segments vanish.
+
+    gc_interval_batches is set huge so the amortized cadence never fires;
+    recovery must come from the overflow -> _force_gc -> retry path."""
+    tpu = TpuConflictSet(0, capacity=256, gc_interval_batches=1_000_000)
     now = 0
     for i in range(40):
         now += 1_000_000
