@@ -72,6 +72,7 @@ def main() -> None:
     if len(sys.argv) > 1:
         backend = sys.argv[1]
     from foundationdb_tpu.conflict.api import new_conflict_set
+    from foundationdb_tpu.txn.types import CommitResult
 
     rng = np.random.default_rng(2026)
     batches = build_batches(rng)
@@ -104,21 +105,28 @@ def main() -> None:
                 results = h.wait()
                 n_txns += len(txns_done)
                 n_ranges += len(txns_done) * (READS_PER_TXN + WRITES_PER_TXN)
-                committed += sum(1 for r in results if int(r) == 2)
+                committed += sum(1 for r in results
+                                 if r == CommitResult.COMMITTED)
         while inflight:
             txns_done, h = inflight.popleft()
             results = h.wait()
             n_txns += len(txns_done)
             n_ranges += len(txns_done) * (READS_PER_TXN + WRITES_PER_TXN)
-            committed += sum(1 for r in results if int(r) == 2)
+            committed += sum(1 for r in results
+                             if r == CommitResult.COMMITTED)
     else:
         for txns, version in batches[3:]:
             results = cs.resolve(txns, version,
                                  new_oldest_version=max(version - window, 0))
             n_txns += len(txns)
             n_ranges += len(txns) * (READS_PER_TXN + WRITES_PER_TXN)
-            committed += sum(1 for r in results if int(r) == 2)
+            committed += sum(1 for r in results
+                             if r == CommitResult.COMMITTED)
     dt = time.perf_counter() - t0
+
+    # Sanity: a broken contention config (0% or 100% commits) invalidates the
+    # throughput claim; surface it without touching the one-line JSON contract.
+    print(f"# commit_rate={committed / max(n_txns, 1):.3f}", file=sys.stderr)
 
     value = n_ranges / dt
     print(json.dumps({

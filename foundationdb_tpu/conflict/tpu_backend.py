@@ -41,11 +41,12 @@ class ResolveHandle:
     """In-flight resolution of one batch; wait() returns the verdicts."""
 
     def __init__(self, cs: "TpuConflictSet", out, n_txns: int, t_cap: int,
-                 retry_ctx: Optional[dict] = None) -> None:
+                 seq: int, retry_ctx: Optional[dict] = None) -> None:
         self._cs = cs
         self._out = out
         self._n = n_txns
         self._t_cap = t_cap
+        self._seq = seq
         self._retry_ctx = retry_ctx
         self._results: Optional[List[CommitResult]] = None
         self._error: Optional[BaseException] = None
@@ -66,11 +67,13 @@ class ResolveHandle:
     def _handle_overflow(self) -> np.ndarray:
         """Emergency GC + one retry of the same batch (reference SkipList
         overflow pressure is likewise relieved by forcing removeBefore).
-        Only possible when no later batch is in flight: a later batch was
-        resolved against a window missing this batch's writes."""
+        Only possible when no later batch was ever DISPATCHED after this one
+        (not merely still unwaited): a later batch was resolved against a
+        window missing this batch's writes, and the retry would in turn see
+        that batch's writes at a later version — both directions wrong."""
         from ..core.error import err
         cs = self._cs
-        if cs._inflight or self._retry_ctx is None:
+        if cs._dispatch_seq != self._seq or self._retry_ctx is None:
             self._error = err(
                 "internal_error",
                 "TPU conflict window capacity exceeded with later batches "
@@ -109,6 +112,7 @@ class TpuConflictSet(ConflictSet):
         self._live_boundaries = 1
         self._gc_interval = gc_interval_batches
         self._batches_since_gc = 0
+        self._dispatch_seq = 0
 
     # An int32 offset span we never let live versions approach; beyond this
     # resolve() forces a rebase, and if the window floor lags so far behind
@@ -224,6 +228,11 @@ class TpuConflictSet(ConflictSet):
         else:
             self._batches_since_gc += 1
             do_gc = self._batches_since_gc >= self._gc_interval
+            # Proactive rebase long before the int32 offset span is at risk,
+            # regardless of the configured GC cadence (a huge gc_interval
+            # must not be able to strand version_base).
+            if now - self.version_base >= (1 << 30):
+                do_gc = True
         delta = max(new_oldest - self.version_base, 0) if do_gc else 0
 
         meta = enc["meta"]
@@ -247,8 +256,9 @@ class TpuConflictSet(ConflictSet):
         self.version_base += delta
         if do_gc:
             self._batches_since_gc = 0
+        self._dispatch_seq += 1
         handle = ResolveHandle(
-            self, out, n_txns, t_cap,
+            self, out, n_txns, t_cap, self._dispatch_seq,
             retry_ctx=None if retry else {
                 "enc": enc, "now": now, "old_floor": oldest_floor,
                 "new_floor": new_oldest})

@@ -59,27 +59,17 @@ def interval_min_cover(l: jnp.ndarray, r: jnp.ndarray, w: jnp.ndarray,
 
 
 def build_min_table(values: jnp.ndarray) -> jnp.ndarray:
-    """Doubling sparse table for range-MIN (mirror of rangemax.py)."""
-    cap = values.shape[0]
-    log = max((cap - 1).bit_length(), 1)
-    rows = [values]
-    cur = values
-    for j in range(log):
-        shift = 1 << j
-        shifted = jnp.concatenate(
-            [cur[shift:], jnp.full((shift,), INF_I32, dtype=cur.dtype)])
-        cur = jnp.minimum(cur, shifted)
-        rows.append(cur)
-    return jnp.stack(rows)
+    """Doubling sparse table for range-MIN.
+
+    min(x) == -max(-x), so reuse the range-max sparse table on negated
+    values (the sentinels map onto each other: -INF_I32 == NEG_INF).
+    Pair only with range_min below — rows hold negated partial maxima."""
+    from .rangemax import build_sparse_table
+    return build_sparse_table(-values)
 
 
 def range_min(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
-    """Per-query min(values[lo:hi]); empty ranges -> INF."""
-    length = hi - lo
-    valid = length > 0
-    safe_len = jnp.maximum(length, 1)
-    j = 31 - jax.lax.clz(safe_len.astype(jnp.int32))
-    cap = table.shape[1]
-    left = table[j, jnp.clip(lo, 0, cap - 1)]
-    right = table[j, jnp.clip(hi - (1 << j), 0, cap - 1)]
-    return jnp.where(valid, jnp.minimum(left, right), INF_I32)
+    """Per-query min(values[lo:hi]) over a build_min_table table; empty
+    ranges -> INF.  lo, hi: int32[N] with 0 <= lo, hi <= CAP."""
+    from .rangemax import range_max
+    return -range_max(table, lo, hi)

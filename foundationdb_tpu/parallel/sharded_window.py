@@ -134,7 +134,16 @@ class ShardedWindow:
             wv = wvalid & lex_less(cwb, cwe)
             (nbk, nbv, nsize), ovf = window_insert(
                 WindowState(bk0, bv0, size0), cwb, cwe, wv, now_rel)
+            # All-or-nothing across shards: if ANY shard overflowed, every
+            # shard keeps its pre-insert state (window_insert's own
+            # unchanged-on-overflow contract, lifted to the mesh).  Otherwise
+            # a skewed batch would commit its writes on the non-full shards
+            # only, leaving V(k) wrong on part of the keyspace and making a
+            # gc()+retry falsely conflict with the batch's own inserts.
             ovf_any = jax.lax.psum(ovf.astype(jnp.int32), ("kr", "q")) > 0
+            nbk = jnp.where(ovf_any, bk0, nbk)
+            nbv = jnp.where(ovf_any, bv0, nbv)
+            nsize = jnp.where(ovf_any, size0, nsize)
             return (bits, nbk[None], nbv[None], nsize[None], ovf_any)
 
         mapped = jax.shard_map(
@@ -166,7 +175,10 @@ class ShardedWindow:
         """One fused device step: batched history check + insert of writes.
 
         Array args are host numpy (or device) arrays, query batch padded to a
-        multiple of mesh axis "q".  Returns (bits[R] bool, overflow bool)."""
+        multiple of mesh axis "q".  Returns (bits[R] bool, overflow bool).
+        On overflow the window is left UNCHANGED on every shard (the insert
+        is all-or-nothing across the mesh); the caller may gc() and re-issue
+        the identical step."""
         bits, self.bk, self.bv, self.size, ovf = self._step(
             self.shard_lo, self.shard_hi, self.bk, self.bv, self.size,
             jnp.asarray(qb), jnp.asarray(qe),

@@ -1,0 +1,17 @@
+"""RPC + simulation layer (reference fdbrpc/, see SURVEY.md §2.2).
+
+Typed request streams over a simulated network with deterministic latency,
+clogging, partitions, and process kill/reboot — the test vehicle for every
+layer above it, exactly as the reference's Sim2 is."""
+
+from .endpoint import Endpoint, NetworkAddress, ReplyPromise, RequestStream
+from .network import SimNetwork, get_network, set_network
+from .sim import SimProcess, Simulator, get_simulator, set_simulator
+from .failure_monitor import FailureMonitor
+
+__all__ = [
+    "Endpoint", "NetworkAddress", "ReplyPromise", "RequestStream",
+    "SimNetwork", "get_network", "set_network",
+    "SimProcess", "Simulator", "get_simulator", "set_simulator",
+    "FailureMonitor",
+]

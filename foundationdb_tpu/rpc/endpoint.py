@@ -1,0 +1,164 @@
+"""Endpoints and typed request streams.
+
+Reference: fdbrpc/FlowTransport.h:34 (Endpoint — a token-addressed receiver
+on a NetworkAddress) and fdbrpc/fdbrpc.h:595 (RequestStream<Req> — the typed
+RPC surface; each request carries a ReplyPromise serialized inside it).
+
+In this framework a RequestStream has a server half (a PromiseStream of
+incoming requests, registered on the network under a token) and a client
+half (an Endpoint that can be shipped inside interface structs).  Requests
+are plain objects; the network attaches a `reply` ReplyPromise before
+delivery, as the reference's transport deserializes a ReplyPromise from the
+request bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple, Optional
+
+from ..core.error import err
+from ..core.futures import Future, Promise, PromiseStream
+from ..core.scheduler import TaskPriority
+
+
+class NetworkAddress(NamedTuple):
+    """Process address (reference flow/network.h NetworkAddress)."""
+
+    ip: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+
+class Endpoint(NamedTuple):
+    """A token-addressed message target on a process."""
+
+    address: NetworkAddress
+    token: str
+
+    def __str__(self) -> str:
+        return f"{self.address}/{self.token}"
+
+
+class ReplyPromise:
+    """The reply half of one RPC (reference ReplyPromise<T>).
+
+    Created by the network at delivery; `send`/`send_error` routes the reply
+    back to the caller's process with network latency.  Dropping it unset
+    gives the caller broken_promise, like SAV destruction in the reference.
+    """
+
+    __slots__ = ("_send_fn", "_done")
+
+    def __init__(self, send_fn) -> None:
+        self._send_fn = send_fn
+        self._done = False
+
+    def send(self, value: Any = None) -> None:
+        if not self._done:
+            self._done = True
+            self._send_fn(value, None)
+
+    def send_error(self, e: BaseException) -> None:
+        if not self._done:
+            self._done = True
+            self._send_fn(None, e)
+
+    def is_set(self) -> bool:
+        return self._done
+
+    def __del__(self) -> None:
+        try:
+            if not self._done:
+                self.send_error(err("broken_promise"))
+        except Exception:  # pragma: no cover - interpreter shutdown
+            pass
+
+
+class RequestStream:
+    """Typed RPC stream. Server half owns the queue; client half is the
+    Endpoint (obtained via `.endpoint`, shippable inside interface structs).
+
+    Server:
+        rs = RequestStream("commit")
+        process.register(rs)
+        async for req in rs.queue: ... req.reply.send(...)
+    Client:
+        reply = await rs.get_reply(MyRequest(...))        # local handle, or
+        reply = await RequestStream.at(ep).get_reply(...) # remote endpoint
+    """
+
+    def __init__(self, name: str = "",
+                 priority: TaskPriority = TaskPriority.DefaultEndpoint) -> None:
+        self.name = name
+        self.priority = priority
+        self.queue: PromiseStream = PromiseStream()
+        self._endpoint: Optional[Endpoint] = None
+
+    # -- server side --------------------------------------------------------
+    def set_endpoint(self, ep: Endpoint) -> None:
+        self._endpoint = ep
+
+    @property
+    def endpoint(self) -> Endpoint:
+        if self._endpoint is None:
+            raise err("internal_error",
+                      f"RequestStream {self.name!r} not registered")
+        return self._endpoint
+
+    def deliver(self, request: Any) -> None:
+        self.queue.send(request)
+
+    # -- client side --------------------------------------------------------
+    @staticmethod
+    def at(ep: Endpoint, priority: TaskPriority = TaskPriority.DefaultEndpoint
+           ) -> "RequestStreamStub":
+        return RequestStreamStub(ep, priority)
+
+    def get_reply(self, request: Any,
+                  from_address: Optional[NetworkAddress] = None) -> Future:
+        return RequestStreamStub(self.endpoint, self.priority).get_reply(
+            request, from_address)
+
+    def send(self, request: Any,
+             from_address: Optional[NetworkAddress] = None) -> None:
+        RequestStreamStub(self.endpoint, self.priority).send(
+            request, from_address)
+
+
+@dataclass(frozen=True)
+class RequestStreamStub:
+    """Client handle to a remote RequestStream endpoint."""
+
+    ep: Endpoint
+    priority: TaskPriority = TaskPriority.DefaultEndpoint
+
+    def get_reply(self, request: Any,
+                  from_address: Optional[NetworkAddress] = None) -> Future:
+        """Send `request`; Future of the reply. broken_promise if the target
+        process is dead/rebooted (the transport-level failure signal the
+        reference maps to request_maybe_delivered in tryGetReply)."""
+        from .network import get_network
+        return get_network().send_request(self.ep, request, self.priority,
+                                          from_address)
+
+    async def try_get_reply(self, request: Any):
+        """get_reply, mapping transport failure to None (reference
+        tryGetReply returning ErrorOr with request_maybe_delivered)."""
+        from ..core.error import FdbError
+        try:
+            return await self.get_reply(request)
+        except FdbError as e:
+            if e.name in ("broken_promise", "connection_failed",
+                          "request_maybe_delivered"):
+                return None
+            raise
+
+    def send(self, request: Any,
+             from_address: Optional[NetworkAddress] = None) -> None:
+        """One-way send (no reply routing)."""
+        from .network import get_network
+        get_network().send_one_way(self.ep, request, self.priority,
+                                   from_address)

@@ -1,0 +1,179 @@
+"""Simulated network: deterministic latency, clogging, partitions.
+
+Reference: fdbrpc/sim2.actor.cpp — Sim2Conn (:181) models per-connection
+latency and delivery; SimClogging (:121) delays traffic between process
+pairs; FlowTransport delivers by endpoint token (FlowTransport.actor.cpp:919
+deliver()).  This module collapses transport + sim-network into one object:
+every message delivery is a scheduled callback on the shared deterministic
+event loop, with latency drawn from the deterministic RNG.
+
+Failure semantics (matching what upper layers can observe in the reference):
+  * target process dead / endpoint unregistered / pair partitioned
+      → caller's reply future gets broken_promise after ~latency
+        (the transport's connection-failure signal);
+  * receiver drops its ReplyPromise unset (actor cancelled by kill/reboot)
+      → broken_promise routed back;
+  * clogged pair → delivery (or reply) deferred until unclogged, never lost.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from ..core.error import err
+from ..core.futures import Future, Promise
+from ..core.rng import deterministic_random
+from ..core.scheduler import TaskPriority, get_event_loop
+from ..core.trace import Severity, TraceEvent
+from .endpoint import Endpoint, NetworkAddress, ReplyPromise, RequestStream
+
+
+class SimNetwork:
+    """All inter-process message passing in a simulation."""
+
+    MIN_LATENCY = 0.0001
+    MAX_LATENCY = 0.0015
+
+    def __init__(self) -> None:
+        # (address, token) -> (stream, epoch of registering process)
+        self._endpoints: Dict[Endpoint, Tuple[RequestStream, int]] = {}
+        # address -> SimProcess (set by Simulator)
+        self.processes: Dict[NetworkAddress, Any] = {}
+        # (ip, ip) -> virtual time until which the pair is clogged
+        self._clog_until: Dict[Tuple[str, str], float] = {}
+        self._partitioned: set = set()  # frozenset({ip, ip})
+        self.messages_sent = 0
+
+    # -- registration -------------------------------------------------------
+    def register(self, process, stream: RequestStream,
+                 token: Optional[str] = None) -> Endpoint:
+        token = token or (stream.name + ":" +
+                          deterministic_random().random_unique_id()[:16])
+        ep = Endpoint(process.address, token)
+        self._endpoints[ep] = (stream, process.epoch)
+        stream.set_endpoint(ep)
+        process._tokens.add(token)
+        return ep
+
+    def unregister_process(self, address: NetworkAddress) -> None:
+        """Drop every endpoint at `address` (process killed/rebooted)."""
+        for ep in [e for e in self._endpoints if e.address == address]:
+            del self._endpoints[ep]
+
+    # -- fault injection ----------------------------------------------------
+    def clog_pair(self, a: str, b: str, seconds: float) -> None:
+        """Delay all traffic between ips a and b for `seconds` (reference
+        ISimulator::clogPair, sim2 SimClogging)."""
+        until = get_event_loop().now() + seconds
+        for pair in ((a, b), (b, a)):
+            self._clog_until[pair] = max(self._clog_until.get(pair, 0.0), until)
+        TraceEvent("ClogPair", Severity.Info).detail("A", a).detail("B", b) \
+            .detail("Seconds", seconds).log()
+
+    def partition_pair(self, a: str, b: str) -> None:
+        self._partitioned.add(frozenset((a, b)))
+
+    def heal_partition(self, a: str, b: str) -> None:
+        self._partitioned.discard(frozenset((a, b)))
+
+    def heal_all(self) -> None:
+        self._partitioned.clear()
+        self._clog_until.clear()
+
+    # -- delivery -----------------------------------------------------------
+    def _latency(self) -> float:
+        rng = deterministic_random()
+        return (self.MIN_LATENCY +
+                rng.random01() * (self.MAX_LATENCY - self.MIN_LATENCY))
+
+    def _delivery_time(self, src: str, dst: str) -> Optional[float]:
+        """Virtual time at which a message sent now arrives, or None if the
+        pair is partitioned."""
+        if frozenset((src, dst)) in self._partitioned and src != dst:
+            return None
+        t = get_event_loop().now() + self._latency()
+        clog = self._clog_until.get((src, dst), 0.0)
+        return max(t, clog)
+
+    def _process_alive(self, address: NetworkAddress, epoch: int) -> bool:
+        p = self.processes.get(address)
+        return p is not None and p.alive and p.epoch == epoch
+
+    def send_request(self, ep: Endpoint, request: Any,
+                     priority: TaskPriority = TaskPriority.DefaultEndpoint,
+                     from_address: Optional[NetworkAddress] = None) -> Future:
+        """Deliver `request` to the endpoint; Future of its reply."""
+        loop = get_event_loop()
+        self.messages_sent += 1
+        reply_promise: Promise = Promise()
+        src_ip = from_address.ip if from_address else ep.address.ip
+        when = self._delivery_time(src_ip, ep.address.ip)
+
+        def fail() -> None:
+            if not reply_promise.is_set():
+                reply_promise.send_error(err("broken_promise"))
+
+        if when is None:  # partitioned: connection failure after a delay
+            loop.call_at(loop.now() + self._latency(), fail, priority)
+            return reply_promise.get_future()
+
+        def route_reply(value: Any, e: Optional[BaseException]) -> None:
+            # Reply path: receiver -> sender, re-clogged/partitioned/timed.
+            back = self._delivery_time(ep.address.ip, src_ip)
+
+            def deliver_reply() -> None:
+                if reply_promise.is_set():
+                    return
+                if e is not None:
+                    reply_promise.send_error(e)
+                else:
+                    reply_promise.send(value)
+
+            if back is None:
+                loop.call_at(loop.now() + self._latency(), fail, priority)
+            else:
+                loop.call_at(back, deliver_reply, priority)
+
+        def deliver() -> None:
+            entry = self._endpoints.get(ep)
+            if entry is None or not self._process_alive(ep.address, entry[1]):
+                fail()
+                return
+            stream, _ = entry
+            request.reply = ReplyPromise(route_reply)
+            stream.deliver(request)
+
+        loop.call_at(when, deliver, priority)
+        return reply_promise.get_future()
+
+    def send_one_way(self, ep: Endpoint, message: Any,
+                     priority: TaskPriority = TaskPriority.DefaultEndpoint,
+                     from_address: Optional[NetworkAddress] = None) -> None:
+        """Fire-and-forget delivery (reference sendUnreliable)."""
+        self.messages_sent += 1
+        src_ip = from_address.ip if from_address else ep.address.ip
+        when = self._delivery_time(src_ip, ep.address.ip)
+        if when is None:
+            return
+
+        def deliver() -> None:
+            entry = self._endpoints.get(ep)
+            if entry is None or not self._process_alive(ep.address, entry[1]):
+                return
+            entry[0].deliver(message)
+
+        get_event_loop().call_at(when, deliver, priority)
+
+
+_network: Optional[SimNetwork] = None
+
+
+def set_network(net: Optional[SimNetwork]) -> None:
+    global _network
+    _network = net
+
+
+def get_network() -> SimNetwork:
+    if _network is None:
+        raise err("internal_error", "no SimNetwork installed (set_network)")
+    return _network

@@ -1,15 +1,33 @@
 """Test configuration.
 
 Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding tests
-run without TPU hardware (the driver's dryrun does the same)."""
+run without TPU hardware (the driver's dryrun does the same).
+
+This must be robust to the axon TPU-tunnel site hook: that hook registers an
+'axon' PJRT plugin whose client creation can block on the tunnel, and jax
+initializes ALL registered plugins on the first backends() call — so merely
+setting JAX_PLATFORMS=cpu is not enough.  We deregister the axon factory
+before any backend initialization; tests are hermetic and never touch the
+tunnel.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:  # deregister the axon TPU-tunnel plugin (see module docstring)
+    import jax
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    # The site hook imports jax at interpreter start, latching
+    # JAX_PLATFORMS=axon into jax's config; override it explicitly.
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,176 @@
+"""RPC + simulator tests: delivery, latency, clog, partition, kill/reboot.
+
+Models the reference's fdbrpc behavior observable from above: typed
+request/reply, broken_promise on process death, clog delays, partitions as
+connection failures (SURVEY.md §2.2)."""
+
+import pytest
+
+from foundationdb_tpu.core import FdbError, wait_any
+from foundationdb_tpu.rpc import (RequestStream, SimProcess, Simulator,
+                                  set_simulator)
+from foundationdb_tpu.rpc.endpoint import RequestStreamStub
+from foundationdb_tpu.rpc.failure_monitor import (wait_failure_client,
+                                                  wait_failure_server)
+
+
+class EchoRequest:
+    def __init__(self, x):
+        self.x = x
+
+
+@pytest.fixture()
+def sim(loop):
+    s = Simulator()
+    set_simulator(s)
+    yield s
+    set_simulator(None)
+
+
+def start_echo_server(p: SimProcess) -> RequestStream:
+    rs = RequestStream("echo")
+    p.register(rs)
+
+    async def serve():
+        async for req in rs.queue:
+            req.reply.send(req.x * 2)
+
+    p.spawn(serve(), "echo")
+    return rs
+
+
+def test_request_reply(loop, sim):
+    server = sim.new_process(name="server")
+    client = sim.new_process(name="client")
+    rs = start_echo_server(server)
+
+    async def go():
+        stub = RequestStreamStub(rs.endpoint)
+        return await stub.get_reply(EchoRequest(21), client.address)
+
+    assert loop.run_until(loop.spawn(go()), timeout=5) == 42
+    assert loop.now() > 0  # latency took virtual time
+
+
+def test_dead_process_breaks_promise(loop, sim):
+    server = sim.new_process(name="server")
+    client = sim.new_process(name="client")
+    rs = start_echo_server(server)
+    ep = rs.endpoint
+    sim.kill_process(server)
+
+    async def go():
+        with pytest.raises(FdbError) as ei:
+            await RequestStreamStub(ep).get_reply(EchoRequest(1),
+                                                  client.address)
+        assert ei.value.name == "broken_promise"
+
+    loop.run_until(loop.spawn(go()), timeout=5)
+
+
+def test_reboot_invalidates_old_endpoints(loop, sim):
+    server = sim.new_process(name="server")
+    client = sim.new_process(name="client")
+    rs = start_echo_server(server)
+    old_ep = rs.endpoint
+    sim.reboot_process(server)
+    new_rs = start_echo_server(server)  # re-register after reboot
+
+    async def go():
+        with pytest.raises(FdbError):
+            await RequestStreamStub(old_ep).get_reply(EchoRequest(1),
+                                                      client.address)
+        # New endpoint works.
+        return await RequestStreamStub(new_rs.endpoint).get_reply(
+            EchoRequest(5), client.address)
+
+    assert loop.run_until(loop.spawn(go()), timeout=5) == 10
+
+
+def test_clog_delays_delivery(loop, sim):
+    server = sim.new_process(name="server")
+    client = sim.new_process(name="client")
+    rs = start_echo_server(server)
+    sim.clog_pair(client, server, 2.0)
+
+    async def go():
+        t0 = loop.now()
+        r = await RequestStreamStub(rs.endpoint).get_reply(
+            EchoRequest(3), client.address)
+        return r, loop.now() - t0
+
+    r, dt = loop.run_until(loop.spawn(go()), timeout=10)
+    assert r == 6
+    assert dt >= 2.0  # waited out the clog
+
+
+def test_partition_fails_connection(loop, sim):
+    server = sim.new_process(name="server")
+    client = sim.new_process(name="client")
+    rs = start_echo_server(server)
+    sim.partition(client, server)
+
+    async def go():
+        stub = RequestStreamStub(rs.endpoint)
+        r = await stub.try_get_reply(EchoRequest(1))  # note: from server ip
+        with pytest.raises(FdbError):
+            await stub.get_reply(EchoRequest(1), client.address)
+        sim.heal()
+        return await stub.get_reply(EchoRequest(4), client.address)
+
+    assert loop.run_until(loop.spawn(go()), timeout=10) == 8
+
+
+def test_wait_failure_detects_death(loop, sim):
+    server = sim.new_process(name="server")
+    rs = RequestStream("waitFailure")
+    server.register(rs)
+    server.spawn(wait_failure_server(rs), "wfServer")
+    ep = rs.endpoint
+
+    async def go():
+        watcher = loop.spawn(wait_failure_client(ep, timeout=0.5))
+        # Server alive: watcher must not fire within a few heartbeats.
+        idx, _ = await wait_any([watcher, loop.delay(2.0)])
+        assert idx == 1, "waitFailure fired on a live server"
+        sim.kill_process(server)
+        await watcher  # now it must return
+
+    loop.run_until(loop.spawn(go()), timeout=30)
+
+
+def test_kill_machine_kills_all(loop, sim):
+    p1 = sim.new_process(machineid="mA", name="p1")
+    p2 = sim.new_process(machineid="mA", name="p2")
+    p3 = sim.new_process(machineid="mB", name="p3")
+    sim.kill_machine("mA")
+    assert not p1.alive and not p2.alive and p3.alive
+
+
+def test_determinism_same_seed_same_timings(loop, sim):
+    # Two runs with the same seed produce identical reply timestamps.
+    def run_once():
+        from foundationdb_tpu.core import (DeterministicRandom, EventLoop,
+                                           set_deterministic_random,
+                                           set_event_loop)
+        lp = EventLoop(sim=True)
+        set_event_loop(lp)
+        set_deterministic_random(DeterministicRandom(7))
+        s = Simulator()
+        set_simulator(s)
+        server = s.new_process(name="server")
+        client = s.new_process(name="client")
+        rs = start_echo_server(server)
+        times = []
+
+        async def go():
+            stub = RequestStreamStub(rs.endpoint)
+            for i in range(20):
+                await stub.get_reply(EchoRequest(i), client.address)
+                times.append(lp.now())
+
+        lp.run_until(lp.spawn(go()), timeout=60)
+        set_simulator(None)
+        return times
+
+    assert run_once() == run_once()
