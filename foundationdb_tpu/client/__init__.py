@@ -1,0 +1,5 @@
+"""Client library: Database / Transaction (NativeAPI + RYW equivalents).
+
+Reference layer: fdbclient/ (SURVEY.md §2.3)."""
+
+from .database import Database, Transaction  # noqa: F401

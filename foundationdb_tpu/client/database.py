@@ -1,0 +1,363 @@
+"""Database / Transaction: the client API.
+
+Reference: fdbclient/NativeAPI.actor.cpp (Database/Transaction — GRV
+batching :2717, location cache :2334, getValue :2476, getRange :3311,
+tryCommit :5018, onError retry loop) layered with ReadYourWrites semantics
+(fdbclient/ReadYourWrites.actor.cpp): reads see the transaction's own
+uncommitted writes, and read/write conflict ranges accrue automatically.
+
+Usage:
+    db = Database(cluster)
+    async def work():
+        txn = db.create_transaction()
+        while True:
+            try:
+                v = await txn.get(b"counter")
+                txn.set(b"counter", bump(v))
+                await txn.commit()
+                return
+            except FdbError as e:
+                await txn.on_error(e)
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from ..core.error import FdbError, err
+from ..core.futures import Future
+from ..core.knobs import client_knobs
+from ..core.scheduler import delay
+from ..rpc.endpoint import RequestStream
+from ..server.interfaces import (CommitTransactionRequest,
+                                 GetKeyServerLocationsRequest,
+                                 GetKeyValuesRequest, GetReadVersionRequest,
+                                 GetValueRequest, TransactionPriority,
+                                 WatchValueRequest)
+from ..server.shardmap import RangeMap
+from ..txn.types import (CommitTransactionRef, KeyRange, MutationType,
+                         Version, key_after)
+from .writemap import WriteMap
+
+RETRYABLE = frozenset({
+    "not_committed", "transaction_too_old", "future_version",
+    "commit_unknown_result", "process_behind", "proxy_memory_limit_exceeded",
+    "broken_promise", "request_maybe_delivered", "connection_failed",
+})
+
+
+class Database:
+    """Client handle to a cluster (reference DatabaseContext)."""
+
+    def __init__(self, cluster: Any) -> None:
+        # `cluster` provides grv_proxies / commit_proxies interface lists
+        # (served by the cluster harness or, later, the coordinators).
+        self.cluster = cluster
+        self._location_cache: RangeMap = RangeMap(default=None)
+        self._rr = 0   # round-robin over proxies / replicas
+
+    # -- proxies -------------------------------------------------------------
+    def _grv_proxy(self):
+        proxies = self.cluster.grv_proxies
+        self._rr += 1
+        return proxies[self._rr % len(proxies)]
+
+    def _commit_proxy(self):
+        proxies = self.cluster.commit_proxies
+        self._rr += 1
+        return proxies[self._rr % len(proxies)]
+
+    # -- location cache (reference getKeyLocation :2334) ---------------------
+    async def get_key_location(self, key: bytes) -> List[Any]:
+        cached = self._location_cache.lookup(key)
+        if cached is not None:
+            return cached
+        proxy = self._commit_proxy()
+        reply = await RequestStream.at(
+            proxy.get_key_servers_locations.endpoint).get_reply(
+            GetKeyServerLocationsRequest(begin=key, end=key_after(key)))
+        for rng, ssis in reply.results:
+            self._location_cache.set_range(rng.begin, rng.end, ssis)
+        out = self._location_cache.lookup(key)
+        if out is None:
+            raise err("wrong_shard_server", f"no location for {key!r}")
+        return out
+
+    async def get_location_before(self, end: bytes
+                                  ) -> Tuple[bytes, bytes, List[Any]]:
+        """Shard containing the greatest key strictly below `end` (for
+        reverse scans)."""
+        b, e, ssis = self._location_cache.range_before(end)
+        if ssis is not None:
+            return b, e, ssis
+        proxy = self._commit_proxy()
+        reply = await RequestStream.at(
+            proxy.get_key_servers_locations.endpoint).get_reply(
+            GetKeyServerLocationsRequest(begin=b"", end=end, limit=1,
+                                         reverse=True))
+        for rng, team in reply.results:
+            self._location_cache.set_range(rng.begin, rng.end, team)
+        b, e, ssis = self._location_cache.range_before(end)
+        if ssis is None:
+            raise err("wrong_shard_server", f"no location before {end!r}")
+        return b, e, ssis
+
+    def invalidate_cache(self, key: bytes) -> None:
+        self._location_cache.set_range(key, key_after(key), None)
+
+    def create_transaction(self) -> "Transaction":
+        return Transaction(self)
+
+
+class Transaction:
+    """One transaction attempt chain (reference Transaction + RYW)."""
+
+    def __init__(self, db: Database) -> None:
+        self.db = db
+        self._backoff = client_knobs().DEFAULT_BACKOFF
+        self._reset()
+
+    def _reset(self) -> None:
+        """Clear per-attempt state (keeps backoff; see reset/on_error)."""
+        self._read_version: Optional[Future] = None
+        self.writes = WriteMap()
+        self.read_conflict_ranges: List[Tuple[bytes, bytes]] = []
+        self._extra_write_ranges: List[Tuple[bytes, bytes]] = []
+        self.committed_version: Version = -1
+        self.priority = TransactionPriority.DEFAULT
+
+    def reset(self) -> None:
+        self._reset()
+        self._backoff = client_knobs().DEFAULT_BACKOFF
+
+    # -- read version --------------------------------------------------------
+    def get_read_version(self) -> Future:
+        if self._read_version is None:
+            proxy = self.db._grv_proxy()
+            self._read_version = RequestStream.at(
+                proxy.get_consistent_read_version.endpoint).get_reply(
+                GetReadVersionRequest(priority=self.priority))
+        return self._read_version
+
+    async def _ensure_read_version(self) -> Version:
+        reply = await self.get_read_version()
+        return reply.version
+
+    # -- reads ---------------------------------------------------------------
+    async def get(self, key: bytes, snapshot: bool = False
+                  ) -> Optional[bytes]:
+        _check_key(key)
+        if not snapshot:
+            self.read_conflict_ranges.append((key, key_after(key)))
+        if self.writes.has_writes(key) and not self.writes.needs_base(key):
+            return self.writes.merge(key, None)
+        base = await self._storage_get(key)
+        return self.writes.merge(key, base)
+
+    async def _storage_get(self, key: bytes) -> Optional[bytes]:
+        version = await self._ensure_read_version()
+        ssis = await self.db.get_key_location(key)
+        if not ssis:
+            raise err("wrong_shard_server", f"no team for {key!r}")
+        self.db._rr += 1
+        ssi = ssis[self.db._rr % len(ssis)]
+        try:
+            reply = await RequestStream.at(ssi.get_value.endpoint).get_reply(
+                GetValueRequest(key=key, version=version))
+        except FdbError as e:
+            if e.name in ("broken_promise", "wrong_shard_server"):
+                self.db.invalidate_cache(key)
+            raise
+        return reply.value
+
+    async def get_range(self, begin: bytes, end: bytes, limit: int = 1000,
+                        reverse: bool = False, snapshot: bool = False
+                        ) -> List[Tuple[bytes, bytes]]:
+        """Range read with RYW overlay (reference getRange :3311).
+
+        The scan proceeds shard chunk by shard chunk from the iteration end
+        (begin for forward, end for reverse); each chunk's snapshot data is
+        complete for its covered span, so overlaying this transaction's
+        writes per-span cannot leave gaps even when the storage reply was
+        limit-truncated."""
+        if begin >= end:
+            return []
+        if not snapshot:
+            self.read_conflict_ranges.append((begin, end))
+        version = await self._ensure_read_version()
+        out: List[Tuple[bytes, bytes]] = []
+        if not reverse:
+            cursor = begin
+            while cursor < end and len(out) < limit:
+                data, covered_end = await self._fetch_chunk_forward(
+                    cursor, end, version, limit - len(out))
+                out.extend(self._merge_span(data, cursor, covered_end))
+                cursor = covered_end
+        else:
+            cursor = end
+            while cursor > begin and len(out) < limit:
+                data, covered_begin = await self._fetch_chunk_reverse(
+                    begin, cursor, version, limit - len(out))
+                merged = self._merge_span(sorted(data), covered_begin, cursor)
+                out.extend(reversed(merged))
+                cursor = covered_begin
+        return out[:limit]
+
+    async def _fetch_chunk_forward(
+            self, cursor: bytes, end: bytes, version: Version, limit: int
+    ) -> Tuple[List[Tuple[bytes, bytes]], bytes]:
+        """One storage fetch; returns (data, covered_end): the snapshot is
+        complete over [cursor, covered_end)."""
+        ssis = await self.db.get_key_location(cursor)
+        _, rng_e, _ = self.db._location_cache.range_containing(cursor)
+        shard_end = min(rng_e, end)
+        if not ssis:
+            raise err("wrong_shard_server")
+        self.db._rr += 1
+        ssi = ssis[self.db._rr % len(ssis)]
+        reply = await RequestStream.at(ssi.get_key_values.endpoint).get_reply(
+            GetKeyValuesRequest(begin=cursor, end=shard_end, version=version,
+                                limit=limit))
+        if reply.more and reply.data:
+            return reply.data, key_after(reply.data[-1][0])
+        return reply.data, shard_end
+
+    async def _fetch_chunk_reverse(
+            self, begin: bytes, cursor: bytes, version: Version, limit: int
+    ) -> Tuple[List[Tuple[bytes, bytes]], bytes]:
+        """One reverse storage fetch; returns (data descending,
+        covered_begin): complete over [covered_begin, cursor)."""
+        rng_b, _, ssis = await self.db.get_location_before(cursor)
+        shard_begin = max(rng_b, begin)
+        if not ssis:
+            raise err("wrong_shard_server")
+        self.db._rr += 1
+        ssi = ssis[self.db._rr % len(ssis)]
+        reply = await RequestStream.at(ssi.get_key_values.endpoint).get_reply(
+            GetKeyValuesRequest(begin=shard_begin, end=cursor,
+                                version=version, limit=limit, reverse=True))
+        if reply.more and reply.data:
+            return reply.data, reply.data[-1][0]   # inclusive smallest key
+        return reply.data, shard_begin
+
+    def _merge_span(self, base: List[Tuple[bytes, bytes]], begin: bytes,
+                    end: bytes) -> List[Tuple[bytes, bytes]]:
+        """Overlay writes onto a snapshot that is COMPLETE over [begin, end);
+        returns ascending merged items for exactly that span."""
+        if not self.writes.mutations:
+            return list(base)
+        merged = dict(base)
+        for _, cb, ce in self.writes.clears_in(begin, end):
+            for k in [k for k in merged if cb <= k < ce]:
+                del merged[k]
+        for key in self.writes.touched_keys_in(begin, end):
+            val = self.writes.merge(key, merged.get(key))
+            if val is None:
+                merged.pop(key, None)
+            else:
+                merged[key] = val
+        return sorted(merged.items())
+
+    async def watch(self, key: bytes) -> Future:
+        """Returns a future that fires when `key`'s value changes from its
+        value as of this transaction's read version (reference watches)."""
+        version = await self._ensure_read_version()
+        value = await self.get(key, snapshot=True)
+        ssis = await self.db.get_key_location(key)
+        ssi = ssis[0]
+        return RequestStream.at(ssi.watch_value.endpoint).get_reply(
+            WatchValueRequest(key=key, value=value, version=version))
+
+    # -- writes --------------------------------------------------------------
+    def set(self, key: bytes, value: bytes) -> None:
+        _check_key(key)
+        _check_value(value)
+        self.writes.set(key, value)
+
+    def clear(self, key: bytes, end: Optional[bytes] = None) -> None:
+        _check_key(key)
+        self.writes.clear(key, end if end is not None else key_after(key))
+
+    def atomic_op(self, op: MutationType, key: bytes, operand: bytes) -> None:
+        _check_key(key)
+        self.writes.atomic_op(op, key, operand)
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self.read_conflict_ranges.append((begin, end))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._extra_write_ranges.append((begin, end))
+
+    # -- commit (reference tryCommit :5018) ----------------------------------
+    async def commit(self) -> Version:
+        wcr = self.writes.write_conflict_ranges() + self._extra_write_ranges
+        if not self.writes.mutations and not wcr:
+            # Read-only: nothing to resolve (reference returns immediately).
+            self.committed_version = -1
+            return -1
+        read_snapshot = 0
+        if self.read_conflict_ranges:
+            read_snapshot = await self._ensure_read_version()
+        txn = CommitTransactionRef(
+            read_conflict_ranges=[KeyRange(b, e) for b, e in
+                                  _coalesce(self.read_conflict_ranges)],
+            write_conflict_ranges=[KeyRange(b, e) for b, e in
+                                   _coalesce(wcr)],
+            mutations=self.writes.mutations,
+            read_snapshot=read_snapshot)
+        if txn.expected_size() > client_knobs().TRANSACTION_SIZE_LIMIT:
+            raise err("transaction_too_large")
+        proxy = self.db._commit_proxy()
+        reply = await RequestStream.at(proxy.commit.endpoint).get_reply(
+            CommitTransactionRequest(transaction=txn))
+        self.committed_version = reply.version
+        return reply.version
+
+    # -- retry loop (reference onError) --------------------------------------
+    async def on_error(self, e: BaseException) -> None:
+        if not (isinstance(e, FdbError) and e.name in RETRYABLE):
+            raise e
+        knobs = client_knobs()
+        backoff = self._backoff
+        self._reset()
+        self._backoff = min(backoff * knobs.BACKOFF_GROWTH_RATE,
+                            knobs.DEFAULT_MAX_BACKOFF)
+        await delay(backoff)
+
+    async def run(self, fn) -> Any:
+        """Retry loop helper (reference runRYWTransaction): `fn(txn)` is an
+        async callable; retried on retryable errors after reset."""
+        while True:
+            try:
+                result = await fn(self)
+                await self.commit()
+                return result
+            except BaseException as e:  # noqa: BLE001
+                await self.on_error(e)
+
+
+def _check_key(key: bytes) -> None:
+    if len(key) > client_knobs().KEY_SIZE_LIMIT:
+        raise err("key_too_large")
+    if key >= b"\xff":
+        raise err("key_outside_legal_range")
+
+
+def _check_value(value: bytes) -> None:
+    if len(value) > client_knobs().VALUE_SIZE_LIMIT:
+        raise err("value_too_large")
+
+
+def _coalesce(ranges: List[Tuple[bytes, bytes]]
+              ) -> List[Tuple[bytes, bytes]]:
+    """Sort + merge overlapping conflict ranges."""
+    if not ranges:
+        return []
+    rs = sorted(r for r in ranges if r[0] < r[1])
+    out = [rs[0]] if rs else []
+    for b, e in rs[1:]:
+        if b <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((b, e))
+    return out

@@ -28,6 +28,7 @@ ERROR_CODES = {
     "success": 0,
     "end_of_stream": 1,
     "operation_failed": 1000,
+    "wrong_shard_server": 1001,
     "timed_out": 1004,
     "coordinated_state_conflict": 1005,
     "future_version": 1009,
@@ -42,6 +43,7 @@ ERROR_CODES = {
     "future_released": 1102,
     "connection_failed": 1026,
     "request_maybe_delivered": 1034,
+    "proxy_memory_limit_exceeded": 1042,
     "master_recovery_failed": 1201,
     "tlog_stopped": 1206,
     "worker_removed": 1202,

@@ -1,0 +1,4 @@
+"""Server roles: the transaction pipeline (master, proxies, resolver, TLog,
+storage server) plus their shared infrastructure.
+
+Reference layer: fdbserver/ (SURVEY.md §2.4)."""

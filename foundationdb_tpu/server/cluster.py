@@ -1,0 +1,132 @@
+"""SimCluster: wire all roles into one simulated cluster.
+
+Reference: fdbserver/SimulatedCluster.actor.cpp setupSimulatedSystem
+(:1755) — builds machines/processes and boots fdbd on each; recruitment is
+normally the cluster controller's job (ClusterController.actor.cpp).  This
+harness performs static recruitment (the post-recovery steady state):
+master, GRV proxies, commit proxies, resolvers, TLogs, and storage servers
+with an even key-range partition, so the transaction pipeline can be
+exercised end-to-end in deterministic simulation.  Dynamic recruitment /
+recovery arrives with the cluster controller role.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..core.scheduler import EventLoop, set_event_loop
+from ..rpc.sim import Simulator, set_simulator
+from ..txn.types import Version
+from .commit_proxy import CommitProxy, LogSystemClient
+from .grv_proxy import GrvProxy
+from .interfaces import Tag
+from .master import Master
+from .resolver import Resolver
+from .shardmap import RangeMap
+from .storage import StorageServer
+from .tlog import TLog
+
+
+def _split_points(n: int) -> List[bytes]:
+    """n-1 even single-byte boundaries partitioning [b'', b'\\xff')."""
+    return [bytes([(256 * i) // n]) for i in range(1, n)]
+
+
+class SimCluster:
+    """A booted simulated cluster + the client-facing interface bundle."""
+
+    def __init__(self, n_resolvers: int = 1, n_storage: int = 2,
+                 n_tlogs: int = 1, n_commit_proxies: int = 1,
+                 n_grv_proxies: int = 1, replication: int = 1,
+                 conflict_backend: Optional[str] = None,
+                 recovery_version: Version = 0,
+                 loop: Optional[EventLoop] = None) -> None:
+        self.loop = loop or EventLoop(sim=True)
+        set_event_loop(self.loop)
+        self.sim = Simulator()
+        set_simulator(self.sim)
+
+        self.master = Master(recovery_version=recovery_version)
+        self.tlogs = [TLog(f"log{i}", recovery_version)
+                      for i in range(n_tlogs)]
+        self.resolvers = [
+            Resolver(f"resolver{i}", recovery_version,
+                     backend=conflict_backend)
+            for i in range(n_resolvers)]
+        self.log_system = LogSystemClient([t.interface for t in self.tlogs])
+        self.storage = [StorageServer(f"ss{i}", tag=i,
+                                      log_system=self.log_system,
+                                      recovery_version=recovery_version)
+                        for i in range(n_storage)]
+
+        # Resolver key-space partition (reference keyResolvers; rebalanced
+        # dynamically by resolutionBalancing once that lands).
+        self.key_resolvers: RangeMap = RangeMap(default=0)
+        for i, b in enumerate(_split_points(n_resolvers)):
+            self.key_resolvers.set_range(b, b"\xff\xff", i + 1)
+
+        # Storage shard map: even partition, teams of `replication`
+        # consecutive tags (reference keyServers + team structure).
+        self.key_servers: RangeMap = RangeMap(default=None)
+        bounds = [b""] + _split_points(n_storage) + [b"\xff\xff"]
+        for i in range(n_storage):
+            team = [Tag((i + j) % n_storage) for j in range(replication)]
+            self.key_servers.set_range(bounds[i], bounds[i + 1], team)
+
+        storage_interfaces: Dict[Tag, object] = {
+            s.tag: s.interface for s in self.storage}
+        self.commit_proxies = [
+            CommitProxy(f"proxy{i}", self.master.interface,
+                        [r.interface for r in self.resolvers],
+                        self.log_system, self.key_resolvers,
+                        self.key_servers, storage_interfaces,
+                        recovery_version)
+            for i in range(n_commit_proxies)]
+        self.grv_proxies = [
+            GrvProxy(f"grv{i}", self.master.interface,
+                     [t.interface for t in self.tlogs])
+            for i in range(n_grv_proxies)]
+
+        # One simulated process per role instance (each a kill target).
+        self.processes = {}
+        roles = ([("master", self.master)] +
+                 [(t.id, t) for t in self.tlogs] +
+                 [(r.id, r) for r in self.resolvers] +
+                 [(s.id, s) for s in self.storage] +
+                 [(p.id, p) for p in self.commit_proxies] +
+                 [(g.id, g) for g in self.grv_proxies])
+        for name, role in roles:
+            proc = self.sim.new_process(name=name)
+            role.run(proc)
+            self.processes[name] = proc
+
+    # -- client bundle (what a Database needs) -------------------------------
+    @property
+    def grv_proxy_interfaces(self):
+        return [g.interface for g in self.grv_proxies]
+
+    @property
+    def commit_proxy_interfaces(self):
+        return [p.interface for p in self.commit_proxies]
+
+    def database(self):
+        from ..client.database import Database
+        return Database(_ClientCluster(self))
+
+    def run_until(self, future, timeout: Optional[float] = None):
+        return self.loop.run_until(future, timeout)
+
+
+class _ClientCluster:
+    """Adapter giving Database the proxy lists (later: MonitorLeader)."""
+
+    def __init__(self, cluster: SimCluster) -> None:
+        self._c = cluster
+
+    @property
+    def grv_proxies(self):
+        return self._c.grv_proxy_interfaces
+
+    @property
+    def commit_proxies(self):
+        return self._c.commit_proxy_interfaces

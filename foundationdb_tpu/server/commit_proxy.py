@@ -1,0 +1,326 @@
+"""CommitProxy: the commit pipeline — batch, resolve, log, reply.
+
+Reference: fdbserver/CommitProxyServer.actor.cpp — commitBatcher (:199)
+groups client CommitTransactionRequests by time/size; each batch runs the
+phases of CommitBatchContext (:413): preresolutionProcessing (:567, get a
+commit version from the master, gated on the previous batch entering
+resolution), getResolution (:660, shard each transaction's conflict ranges
+across resolvers via the keyResolvers range map, ResolutionRequestBuilder
+:88), postResolution (:1065, verdict = min across resolvers :800-806, then
+assignMutationsToStorageServers :891 routes each mutation to the TLog tags
+of the storage team owning its shard), transactionLogging (ILogSystem::push),
+and reply (CommitID or not_committed).  Batches pipeline: stage gates
+(latestLocalCommitBatchResolving/Logging) keep multiple batches in flight
+while preserving version order per stage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.futures import Future, wait_all
+from ..core.knobs import server_knobs
+from ..core.scheduler import delay, now, spawn
+from ..core.trace import Severity, TraceEvent
+from ..txn.types import (CommitResult, CommitTransactionRef, KeyRange,
+                         Mutation, MutationType, Version)
+from ..rpc.endpoint import RequestStream
+from .interfaces import (CommitID, CommitProxyInterface,
+                         CommitTransactionRequest, GetCommitVersionRequest,
+                         GetKeyServerLocationsReply, GetReadVersionRequest,
+                         ReportRawCommittedVersionRequest,
+                         ResolveTransactionBatchRequest, Tag,
+                         TLogCommitRequest)
+from .notified import NotifiedVersion
+from .shardmap import RangeMap
+
+
+class LogSystemClient:
+    """Client half of the tag-partitioned log system: push a version's
+    messages to every TLog and wait for durability (reference
+    ILogSystem::push, TagPartitionedLogSystem.actor.cpp).  Tags are
+    partitioned over TLogs by tag index; every TLog sees every version so
+    its version chain stays contiguous."""
+
+    def __init__(self, tlogs: List[Any]) -> None:
+        self.tlogs = tlogs  # TLogInterface list
+
+    def tlog_for_tag(self, tag: Tag) -> int:
+        return tag % len(self.tlogs)
+
+    def push(self, prev_version: Version, version: Version,
+             known_committed_version: Version,
+             messages: Dict[Tag, List[Mutation]]) -> Future:
+        per_log: List[Dict[Tag, List[Mutation]]] = [
+            {} for _ in self.tlogs]
+        for tag, msgs in messages.items():
+            per_log[self.tlog_for_tag(tag)][tag] = msgs
+        replies = []
+        for tlog, msgs in zip(self.tlogs, per_log):
+            replies.append(tlog.commit.get_reply(TLogCommitRequest(
+                prev_version=prev_version, version=version,
+                known_committed_version=known_committed_version,
+                messages=msgs)))
+        return wait_all(replies)
+
+    def pop(self, tag: Tag, to: Version) -> None:
+        from .interfaces import TLogPopRequest
+        self.tlogs[self.tlog_for_tag(tag)].pop.send(
+            TLogPopRequest(tag=tag, to=to, reply=False))
+
+
+class CommitProxy:
+    def __init__(self, proxy_id: str, master: Any, resolvers: List[Any],
+                 log_system: LogSystemClient,
+                 key_resolvers: RangeMap,
+                 key_servers: RangeMap,
+                 storage_interfaces: Optional[Dict[Tag, Any]] = None,
+                 recovery_version: Version = 0) -> None:
+        self.id = proxy_id
+        self.master = master            # MasterInterface
+        self.resolvers = resolvers      # [ResolverInterface]
+        self.log_system = log_system
+        # key -> resolver index (reference ProxyCommitData::keyResolvers).
+        self.key_resolvers = key_resolvers
+        # key -> [Tag] storage team (reference keyInfo/tagsForKey :926).
+        self.key_servers = key_servers
+        self.storage_interfaces = storage_interfaces or {}
+        self.interface = CommitProxyInterface(proxy_id)
+        self.committed_version = NotifiedVersion(recovery_version)
+        self.last_resolved_version: Version = recovery_version
+        self.version_request_num = 0
+        self.local_batch_number = 0
+        self.batch_resolving = NotifiedVersion(0)   # latest batch in resolution
+        self.batch_logging = NotifiedVersion(0)     # latest batch in logging
+        self.stats = {"commits": 0, "conflicts": 0, "too_old": 0,
+                      "batches": 0, "mutations": 0}
+        self.broken = False   # set on mid-batch infrastructure failure
+
+    # -- batcher (reference commitBatcher :199) ------------------------------
+    async def _commit_batcher(self) -> None:
+        knobs = server_knobs()
+        queue = self.interface.commit.queue
+        while True:
+            first = await queue.pop()
+            if self.broken:
+                from ..core.error import err
+                first.reply.send_error(err("commit_unknown_result"))
+                continue
+            batch = [first]
+            batch_bytes = first.transaction.expected_size()
+            deadline = now() + knobs.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
+            while (batch_bytes < knobs.COMMIT_TRANSACTION_BATCH_BYTES_MAX and
+                   len(batch) < knobs.COMMIT_TRANSACTION_BATCH_COUNT_MAX):
+                if not queue.empty():
+                    req = await queue.pop()
+                    batch.append(req)
+                    batch_bytes += req.transaction.expected_size()
+                    continue
+                remaining = deadline - now()
+                if remaining <= 0:
+                    break
+                await delay(remaining)
+            self.local_batch_number += 1
+            spawn(self._commit_batch(batch, self.local_batch_number),
+                  f"{self.id}.commitBatch")
+
+    # -- the batch pipeline --------------------------------------------------
+    async def _commit_batch(self, batch: List[CommitTransactionRequest],
+                            batch_num: int) -> None:
+        """Run one batch through the pipeline; a mid-batch infrastructure
+        failure (dead resolver/master/TLog) marks the proxy broken — clients
+        get commit_unknown_result and stage gates still advance so earlier
+        in-flight batches aren't wedged.  A broken proxy needs recovery (a
+        new epoch re-recruits it); it fast-fails instead of hanging."""
+        from ..core.error import err
+        try:
+            await self._commit_batch_impl(batch, batch_num)
+        except BaseException as e:  # noqa: BLE001 - must not wedge the gates
+            self.broken = True
+            TraceEvent("CommitProxyBatchFailed", Severity.Error).detail(
+                "Proxy", self.id).detail("Batch", batch_num).detail(
+                "Error", repr(e)).log()
+            self.batch_resolving.set_at_least(batch_num)
+            self.batch_logging.set_at_least(batch_num)
+            for req in batch:
+                if not req.reply.is_set():
+                    req.reply.send_error(err("commit_unknown_result"))
+
+    async def _commit_batch_impl(self, batch: List[CommitTransactionRequest],
+                                 batch_num: int) -> None:
+        self.stats["batches"] += 1
+
+        # Phase 1: pre-resolution. Gate: the previous batch must have entered
+        # resolution so master versions are requested in order (:589).
+        await self.batch_resolving.when_at_least(batch_num - 1)
+        self.version_request_num += 1
+        vreply = await RequestStream.at(
+            self.master.get_commit_version.endpoint).get_reply(
+            GetCommitVersionRequest(request_num=self.version_request_num,
+                                    proxy_id=self.id))
+        commit_version: Version = vreply.version
+        prev_version: Version = vreply.prev_version
+
+        # Phase 2: resolution — fan out to resolvers (:660).
+        requests, index_maps = self._build_resolution_requests(
+            batch, prev_version, commit_version)
+        self.batch_resolving.set_at_least(batch_num)  # next may fetch a version
+        resolution_futures = [
+            RequestStream.at(r.resolve.endpoint).get_reply(req)
+            for r, req in zip(self.resolvers, requests)]
+        resolutions = await wait_all(resolution_futures)
+        self.last_resolved_version = commit_version
+
+        # Phase 3: post-resolution. Gate on logging order (:1075).
+        await self.batch_logging.when_at_least(batch_num - 1)
+        verdicts = self._determine_committed(batch, index_maps, resolutions)
+        messages = self._assign_mutations_to_tags(
+            batch, verdicts, commit_version)
+        self.stats["mutations"] += sum(len(m) for m in messages.values())
+
+        # Phase 4: logging — push to TLogs, wait durable.
+        log_done = self.log_system.push(
+            prev_version, commit_version,
+            known_committed_version=self.committed_version.get(),
+            messages=messages)
+        self.batch_logging.set_at_least(batch_num)  # next may enter logging
+        await log_done
+
+        # Phase 5: reply. The TLog ack implies every lower version (from any
+        # proxy) is appended and covered by the same group fsync, so commit
+        # order is already serialized; just advance our committed frontier.
+        if commit_version > self.committed_version.get():
+            self.committed_version.set(commit_version)
+        # The master must learn the committed version BEFORE any client
+        # does: otherwise a later GRV could return a version below this
+        # commit (causal consistency; reference waits the report ack).
+        await RequestStream.at(
+            self.master.report_live_committed_version.endpoint).get_reply(
+            ReportRawCommittedVersionRequest(version=commit_version))
+        for req, verdict in zip(batch, verdicts):
+            if verdict == CommitResult.COMMITTED:
+                self.stats["commits"] += 1
+                req.reply.send(CommitID(version=commit_version,
+                                        txn_batch_id=batch_num))
+            elif verdict == CommitResult.TOO_OLD:
+                self.stats["too_old"] += 1
+                from ..core.error import err
+                req.reply.send_error(err("transaction_too_old"))
+            else:
+                self.stats["conflicts"] += 1
+                from ..core.error import err
+                req.reply.send_error(err("not_committed"))
+
+    # -- resolution request building (reference :88-181) ---------------------
+    def _clip_ranges(self, ranges: List[KeyRange], resolver_idx: int
+                     ) -> List[KeyRange]:
+        out = []
+        for r in ranges:
+            for b, e, idx in self.key_resolvers.intersecting(r.begin, r.end):
+                if idx == resolver_idx and b < e:
+                    out.append(KeyRange(b, e))
+        return out
+
+    def _build_resolution_requests(
+            self, batch: List[CommitTransactionRequest],
+            prev_version: Version, commit_version: Version
+    ) -> List[ResolveTransactionBatchRequest]:
+        """One request per resolver; each transaction's conflict ranges are
+        clipped to the ranges that resolver owns.  Every resolver receives
+        every batch (possibly with no transactions) to keep its version
+        chain contiguous.  A transaction index is carried implicitly: the
+        verdict array of resolver i aligns with the transactions we sent it;
+        _determine_committed re-aligns via the returned index maps."""
+        n = len(self.resolvers)
+        requests = [ResolveTransactionBatchRequest(
+            prev_version=prev_version, version=commit_version,
+            last_received_version=self.last_resolved_version,
+            transactions=[], proxy_id=self.id) for _ in range(n)]
+        index_maps: List[List[int]] = [[] for _ in range(n)]
+        for t_idx, req in enumerate(batch):
+            txn = req.transaction
+            touched = set()
+            for r in txn.read_conflict_ranges + txn.write_conflict_ranges:
+                for _, _, idx in self.key_resolvers.intersecting(r.begin,
+                                                                 r.end):
+                    touched.add(idx)
+            if not touched:
+                touched = {0}   # read-only/no-range txns: resolver 0 decides
+            for idx in touched:
+                clipped = CommitTransactionRef(
+                    read_conflict_ranges=self._clip_ranges(
+                        txn.read_conflict_ranges, idx),
+                    write_conflict_ranges=self._clip_ranges(
+                        txn.write_conflict_ranges, idx),
+                    mutations=[],
+                    read_snapshot=txn.read_snapshot,
+                    report_conflicting_keys=txn.report_conflicting_keys)
+                requests[idx].transactions.append(clipped)
+                index_maps[idx].append(t_idx)
+        return requests, index_maps
+
+    def _determine_committed(self, batch, index_maps, resolutions
+                             ) -> List[CommitResult]:
+        """Verdict = min over the resolvers that saw the transaction
+        (reference determineCommittedTransactions :792-806: commit iff ALL
+        resolvers said committed; TOO_OLD dominates CONFLICT)."""
+        verdicts = [CommitResult.COMMITTED] * len(batch)
+        for r_idx, reply in enumerate(resolutions):
+            for local_i, verdict in enumerate(reply.committed):
+                t_idx = index_maps[r_idx][local_i]
+                verdicts[t_idx] = min(verdicts[t_idx], verdict)
+        return verdicts
+
+    # -- mutation -> tag routing (reference :891-1034) -----------------------
+    def tags_for_key(self, key: bytes) -> List[Tag]:
+        return self.key_servers.lookup(key) or []
+
+    def _assign_mutations_to_tags(
+            self, batch: List[CommitTransactionRequest],
+            verdicts: List[CommitResult], commit_version: Version
+    ) -> Dict[Tag, List[Mutation]]:
+        messages: Dict[Tag, List[Mutation]] = {}
+        for req, verdict in zip(batch, verdicts):
+            if verdict != CommitResult.COMMITTED:
+                continue
+            for m in req.transaction.mutations:
+                if m.type == MutationType.ClearRange:
+                    # A clear can span shards: clip per intersecting shard
+                    # so each storage team gets only its part (:980-1010).
+                    for b, e, tags in self.key_servers.intersecting(
+                            m.param1, m.param2):
+                        if not tags:
+                            continue
+                        clipped = Mutation(MutationType.ClearRange, b, e)
+                        for tag in tags:
+                            messages.setdefault(tag, []).append(clipped)
+                else:
+                    for tag in self.tags_for_key(m.param1):
+                        messages.setdefault(tag, []).append(m)
+        return messages
+
+    # -- key server locations (reference :1488 doKeyServerLocationRequest) ---
+    async def _serve_locations(self) -> None:
+        async for req in self.interface.get_key_servers_locations.queue:
+            results = []
+            shards = list(self.key_servers.ranges())
+            if req.reverse:
+                shards.reverse()   # serve the LAST shards of the span first
+            for b, e, tags in shards:
+                # Full (unclipped) shard boundaries so the client's location
+                # cache covers whole shards, not just the queried span.
+                if e <= req.begin or b >= req.end:
+                    continue
+                ssis = [self.storage_interfaces[t] for t in (tags or [])
+                        if t in self.storage_interfaces]
+                results.append((KeyRange(b, e), ssis))
+                if len(results) >= req.limit:
+                    break
+            req.reply.send(GetKeyServerLocationsReply(results=results))
+
+    def run(self, process) -> None:
+        for s in self.interface.streams():
+            process.register(s)
+        process.spawn(self._commit_batcher(), f"{self.id}.batcher")
+        process.spawn(self._serve_locations(), f"{self.id}.locations")
+        TraceEvent("CommitProxyStarted").detail("Id", self.id).log()

@@ -1,0 +1,103 @@
+"""GrvProxy: the read-version endpoint.
+
+Reference: fdbserver/GrvProxyServer.actor.cpp — queueGetReadVersionRequests
+(:389) buckets incoming requests by priority; transactionStarter (:702)
+releases them in batches under the Ratekeeper budget; each batch confirms
+TLog-epoch liveness and asks the master for the max live committed version
+(getLiveCommittedVersion :527), replying with that version (sendGrvReplies
+:595).  The liveness confirm is what makes the read version *causally*
+consistent: a version is only handed out after the current log system
+quorum has acknowledged it is still the live epoch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..core.futures import Promise, wait_all
+from ..core.knobs import server_knobs
+from ..core.scheduler import delay, now, spawn
+from ..core.trace import TraceEvent
+from ..rpc.endpoint import RequestStream
+from .interfaces import (GetRawCommittedVersionRequest, GetReadVersionReply,
+                         GetReadVersionRequest, GrvProxyInterface,
+                         TLogConfirmRunningRequest, TransactionPriority)
+
+
+class GrvProxy:
+    def __init__(self, proxy_id: str, master: Any,
+                 tlogs: Optional[List[Any]] = None,
+                 ratekeeper: Optional[Any] = None) -> None:
+        self.id = proxy_id
+        self.master = master            # MasterInterface
+        self.tlogs = tlogs or []        # [TLogInterface] for liveness confirm
+        self.ratekeeper = ratekeeper    # Ratekeeper client handle (optional)
+        self.interface = GrvProxyInterface(proxy_id)
+        # Priority queues: immediate > default > batch (reference
+        # SystemTransactionQueue/DefaultQueue/BatchQueue).
+        self.queues: List[List[GetReadVersionRequest]] = [[], [], []]
+        self.transaction_budget = float("inf")
+        self.stats = {"grvs": 0, "batches": 0}
+        self._wakeup: Optional[Promise] = None
+
+    async def _queue_requests(self) -> None:
+        async for req in self.interface.get_consistent_read_version.queue:
+            pri = min(max(req.priority, TransactionPriority.BATCH),
+                      TransactionPriority.IMMEDIATE)
+            self.queues[pri].append(req)
+            if self._wakeup is not None:
+                w, self._wakeup = self._wakeup, None
+                w.send(None)
+
+    def _drain(self, budget: float) -> List[GetReadVersionRequest]:
+        out: List[GetReadVersionRequest] = []
+        for pri in (TransactionPriority.IMMEDIATE,
+                    TransactionPriority.DEFAULT, TransactionPriority.BATCH):
+            q = self.queues[pri]
+            while q and (budget > 0 or pri == TransactionPriority.IMMEDIATE):
+                req = q.pop(0)
+                out.append(req)
+                budget -= req.transaction_count
+        return out
+
+    async def _transaction_starter(self) -> None:
+        knobs = server_knobs()
+        while True:
+            if not any(self.queues):
+                # Sleep until a request arrives (no virtual-time polling).
+                self._wakeup = Promise()
+                await self._wakeup.get_future()
+            await delay(knobs.START_TRANSACTION_BATCH_INTERVAL_MIN)
+            if self.ratekeeper is not None:
+                self.transaction_budget = self.ratekeeper.current_budget(
+                    self.id)
+            batch = self._drain(self.transaction_budget)
+            if not batch:
+                continue
+            self.stats["batches"] += 1
+            spawn(self._reply_batch(batch), f"{self.id}.grvBatch")
+
+    async def _reply_batch(self, batch: List[GetReadVersionRequest]) -> None:
+        # Confirm log-system liveness + fetch live committed version in
+        # parallel (reference getLiveCommittedVersion :527).
+        confirms = [RequestStream.at(t.confirm_running.endpoint).get_reply(
+            TLogConfirmRunningRequest()) for t in self.tlogs]
+        version_f = RequestStream.at(
+            self.master.get_live_committed_version.endpoint).get_reply(
+            GetRawCommittedVersionRequest())
+        if confirms:
+            await wait_all(confirms)
+        vreply = await version_f
+        self.stats["grvs"] += len(batch)
+        if self.ratekeeper is not None:
+            self.ratekeeper.report_released(self.id, len(batch))
+        for req in batch:
+            req.reply.send(GetReadVersionReply(version=vreply.version,
+                                               locked=vreply.locked))
+
+    def run(self, process) -> None:
+        for s in self.interface.streams():
+            process.register(s)
+        process.spawn(self._queue_requests(), f"{self.id}.queue")
+        process.spawn(self._transaction_starter(), f"{self.id}.starter")
+        TraceEvent("GrvProxyStarted").detail("Id", self.id).log()

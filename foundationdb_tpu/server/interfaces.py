@@ -1,0 +1,322 @@
+"""Role interfaces: serializable structs of typed request streams.
+
+Reference: the interface headers shared between client and server —
+fdbclient/CommitProxyInterface.h:38, fdbclient/GrvProxyInterface.h,
+fdbclient/StorageServerInterface.h, fdbserver/ResolverInterface.h:33,
+fdbserver/MasterInterface.h, fdbserver/TLogInterface.h.  Each interface is a
+bundle of RequestStream endpoints a role registers on its process; clients
+hold the interface struct and call `.get_reply` on the streams.
+
+Tags: a mutation is routed at commit time to the TLog *tags* of the storage
+servers owning its shard (reference fdbclient/FDBTypes.h Tag,
+CommitProxyServer.actor.cpp:926 tagsForKey).  Here a Tag is a small int; the
+special TXS_TAG carries metadata transactions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.scheduler import TaskPriority
+from ..rpc.endpoint import RequestStream
+from ..txn.types import (CommitResult, CommitTransactionRef, KeyRange,
+                         Mutation, Version)
+
+Tag = int
+TXS_TAG: Tag = -1  # metadata/state transactions (reference txsTag)
+
+
+class TransactionPriority:
+    """GRV priorities (reference TransactionPriority, GrvProxyServer queues)."""
+
+    BATCH = 0
+    DEFAULT = 1
+    IMMEDIATE = 2
+
+
+# ---------------------------------------------------------------------------
+# Master (reference fdbserver/MasterInterface.h)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class GetCommitVersionRequest:
+    """Proxy -> master: allocate the next commit version for a batch.
+
+    request_num orders requests from one proxy (master replies in order);
+    reference MasterInterface.h GetCommitVersionRequest."""
+
+    request_num: int
+    proxy_id: str
+    reply: Any = None
+
+
+@dataclass
+class GetCommitVersionReply:
+    version: Version
+    prev_version: Version
+    resolver_changes: List[Tuple[KeyRange, int]] = field(default_factory=list)
+    resolver_changes_version: Version = 0
+
+
+@dataclass
+class ReportRawCommittedVersionRequest:
+    """Proxy -> master: a version is fully committed (logged) — advances the
+    liveCommittedVersion the GRV path reads (masterserver.actor.cpp:1217)."""
+
+    version: Version
+    locked: bool = False
+    reply: Any = None
+
+
+@dataclass
+class GetRawCommittedVersionRequest:
+    reply: Any = None
+
+
+@dataclass
+class GetRawCommittedVersionReply:
+    version: Version
+    locked: bool = False
+
+
+class MasterInterface:
+    def __init__(self) -> None:
+        self.get_commit_version = RequestStream(
+            "master.getCommitVersion", TaskPriority.ProxyGetRawCommittedVersion)
+        self.report_live_committed_version = RequestStream(
+            "master.reportLiveCommittedVersion",
+            TaskPriority.ProxyGetRawCommittedVersion)
+        self.get_live_committed_version = RequestStream(
+            "master.getLiveCommittedVersion",
+            TaskPriority.ProxyGetRawCommittedVersion)
+
+    def streams(self) -> List[RequestStream]:
+        return [self.get_commit_version, self.report_live_committed_version,
+                self.get_live_committed_version]
+
+
+# ---------------------------------------------------------------------------
+# Resolver (reference fdbserver/ResolverInterface.h:33,81-123)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ResolveTransactionBatchRequest:
+    prev_version: Version
+    version: Version
+    last_received_version: Version
+    transactions: List[CommitTransactionRef]
+    txn_state_transactions: List[int] = field(default_factory=list)
+    proxy_id: str = ""
+    reply: Any = None
+
+
+@dataclass
+class ResolveTransactionBatchReply:
+    committed: List[CommitResult]
+
+
+class ResolverInterface:
+    def __init__(self, resolver_id: str = "") -> None:
+        self.id = resolver_id
+        self.resolve = RequestStream(
+            "resolver.resolve", TaskPriority.ProxyResolverReply)
+
+    def streams(self) -> List[RequestStream]:
+        return [self.resolve]
+
+
+# ---------------------------------------------------------------------------
+# Commit proxy (reference fdbclient/CommitProxyInterface.h:38)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CommitTransactionRequest:
+    transaction: CommitTransactionRef
+    debug_id: str = ""
+    reply: Any = None
+
+
+@dataclass
+class CommitID:
+    """Successful commit reply (CommitProxyInterface.h:133)."""
+
+    version: Version
+    txn_batch_id: int = 0
+
+
+@dataclass
+class GetKeyServerLocationsRequest:
+    begin: bytes
+    end: bytes
+    limit: int = 100
+    reverse: bool = False
+    reply: Any = None
+
+
+@dataclass
+class GetKeyServerLocationsReply:
+    # [(range, [storage interfaces])] — shard boundaries with their teams.
+    results: List[Tuple[KeyRange, List[Any]]]
+
+
+class CommitProxyInterface:
+    def __init__(self, proxy_id: str = "") -> None:
+        self.id = proxy_id
+        self.commit = RequestStream("proxy.commit", TaskPriority.ProxyCommit)
+        self.get_key_servers_locations = RequestStream(
+            "proxy.getKeyServersLocations", TaskPriority.DefaultPromiseEndpoint)
+
+    def streams(self) -> List[RequestStream]:
+        return [self.commit, self.get_key_servers_locations]
+
+
+# ---------------------------------------------------------------------------
+# GRV proxy (reference fdbclient/GrvProxyInterface.h)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class GetReadVersionRequest:
+    priority: int = TransactionPriority.DEFAULT
+    transaction_count: int = 1
+    flags: int = 0
+    debug_id: str = ""
+    reply: Any = None
+
+    FLAG_CAUSAL_READ_RISKY = 1
+    FLAG_USE_MIN_KNOWN_COMMITTED = 2
+
+
+@dataclass
+class GetReadVersionReply:
+    version: Version
+    locked: bool = False
+
+
+class GrvProxyInterface:
+    def __init__(self, proxy_id: str = "") -> None:
+        self.id = proxy_id
+        self.get_consistent_read_version = RequestStream(
+            "grvproxy.getConsistentReadVersion",
+            TaskPriority.GetConsistentReadVersion)
+
+    def streams(self) -> List[RequestStream]:
+        return [self.get_consistent_read_version]
+
+
+# ---------------------------------------------------------------------------
+# TLog (reference fdbserver/TLogInterface.h)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TLogCommitRequest:
+    prev_version: Version
+    version: Version
+    known_committed_version: Version
+    # tag -> serialized mutation list for that tag at this version.
+    messages: Dict[Tag, List[Mutation]]
+    reply: Any = None
+
+
+@dataclass
+class TLogPeekRequest:
+    tag: Tag
+    begin: Version
+    reply: Any = None
+
+
+@dataclass
+class TLogPeekReply:
+    # [(version, [mutations])] for the tag, version-ascending.
+    messages: List[Tuple[Version, List[Mutation]]]
+    end: Version               # exclusive: peek again from here
+    max_known_version: Version
+
+
+@dataclass
+class TLogPopRequest:
+    tag: Tag
+    to: Version
+    reply: Any = None
+
+
+@dataclass
+class TLogConfirmRunningRequest:
+    reply: Any = None
+
+
+class TLogInterface:
+    def __init__(self, tlog_id: str = "") -> None:
+        self.id = tlog_id
+        self.commit = RequestStream("tlog.commit", TaskPriority.TLogCommit)
+        self.peek = RequestStream("tlog.peek", TaskPriority.TLogPeek)
+        self.pop = RequestStream("tlog.pop", TaskPriority.TLogPop)
+        self.confirm_running = RequestStream(
+            "tlog.confirmRunning", TaskPriority.TLogConfirmRunning)
+
+    def streams(self) -> List[RequestStream]:
+        return [self.commit, self.peek, self.pop, self.confirm_running]
+
+
+# ---------------------------------------------------------------------------
+# Storage server (reference fdbclient/StorageServerInterface.h)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class GetValueRequest:
+    key: bytes
+    version: Version
+    debug_id: str = ""
+    reply: Any = None
+
+
+@dataclass
+class GetValueReply:
+    value: Optional[bytes]
+    version: Version = 0
+
+
+@dataclass
+class GetKeyValuesRequest:
+    begin: bytes
+    end: bytes
+    version: Version
+    limit: int = 1000
+    limit_bytes: int = 1 << 20
+    reverse: bool = False
+    reply: Any = None
+
+
+@dataclass
+class GetKeyValuesReply:
+    data: List[Tuple[bytes, bytes]]
+    more: bool = False
+    version: Version = 0
+
+
+@dataclass
+class WatchValueRequest:
+    key: bytes
+    value: Optional[bytes]   # trigger when stored value differs from this
+    version: Version = 0
+    reply: Any = None
+
+
+@dataclass
+class WatchValueReply:
+    version: Version
+
+
+class StorageServerInterface:
+    def __init__(self, ss_id: str = "", tag: Tag = 0) -> None:
+        self.id = ss_id
+        self.tag = tag
+        self.get_value = RequestStream(
+            "storage.getValue", TaskPriority.DefaultPromiseEndpoint)
+        self.get_key_values = RequestStream(
+            "storage.getKeyValues", TaskPriority.DefaultPromiseEndpoint)
+        self.watch_value = RequestStream(
+            "storage.watchValue", TaskPriority.DefaultPromiseEndpoint)
+
+    def streams(self) -> List[RequestStream]:
+        return [self.get_value, self.get_key_values, self.watch_value]

@@ -1,0 +1,139 @@
+"""Master: commit-version allocator and live-committed-version registry.
+
+Reference: fdbserver/masterserver.actor.cpp — getVersion (:1126) allocates
+monotonic contiguous version windows at a rate of wall-clock x
+VERSIONS_PER_SECOND (gap-capped); serveLiveCommittedVersion (:1217) tracks
+the max fully-committed version for the GRV path.  The recovery state
+machine (masterCore :1670) lives in recovery.py; this module is the steady
+state ACCEPTING_COMMITS logic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..core.futures import Future, Promise
+from ..core.knobs import server_knobs
+from ..core.scheduler import now, spawn
+from ..core.trace import TraceEvent
+from ..txn.types import INVALID_VERSION, Version
+from .interfaces import (GetCommitVersionReply, GetCommitVersionRequest,
+                         GetRawCommittedVersionReply, MasterInterface)
+
+
+class _ProxyVersionState:
+    """Per-proxy request ordering + resend dedup (reference
+    MasterData::lastCommitProxyVersionReplies)."""
+
+    __slots__ = ("last_request_num", "replies", "waiters")
+
+    def __init__(self) -> None:
+        # Proxies number requests from 1; "0 already served" seeds the chain.
+        self.last_request_num = 0
+        self.replies: Dict[int, GetCommitVersionReply] = {}
+        self.waiters: Dict[int, Promise] = {}
+
+
+class Master:
+    """One master epoch's commit-version state."""
+
+    def __init__(self, recovery_version: Version = 0, epoch: int = 1) -> None:
+        self.epoch = epoch
+        self.version: Version = recovery_version       # last allocated
+        self.last_epoch_end: Version = recovery_version
+        self.live_committed_version: Version = recovery_version
+        self.last_version_time: float = 0.0
+        self.reference_version: Optional[Version] = None
+        self.proxy_states: Dict[str, _ProxyVersionState] = {}
+        self.interface = MasterInterface()
+        # Resolver key-range assignment changes to piggyback on the next
+        # version reply (reference resolver_changes piggyback :1175-1182).
+        self.resolution_changes: list = []
+        self.resolution_changes_version: Version = 0
+
+    # -- version allocation (reference getVersion :1126) ---------------------
+    def _allocate_version(self) -> GetCommitVersionReply:
+        knobs = server_knobs()
+        t1 = now()
+        if self.last_version_time == 0.0:
+            self.last_version_time = t1
+        to_add = max(1, min(int(knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS / 2),
+                            int(knobs.VERSIONS_PER_SECOND *
+                                (t1 - self.last_version_time))))
+        self.last_version_time = t1
+        prev = self.version
+        new_version = self.version + to_add
+        # Gap cap: don't run more than MAX_VERSIONS_IN_FLIGHT ahead of the
+        # fully-committed frontier.
+        max_allowed = self.live_committed_version + int(
+            knobs.MAX_VERSIONS_IN_FLIGHT)
+        new_version = max(prev + 1, min(new_version, max_allowed))
+        self.version = new_version
+        return GetCommitVersionReply(
+            version=new_version, prev_version=prev,
+            resolver_changes=list(self.resolution_changes),
+            resolver_changes_version=self.resolution_changes_version)
+
+    async def _serve_commit_versions(self) -> None:
+        async for req in self.interface.get_commit_version.queue:
+            st = self.proxy_states.setdefault(req.proxy_id,
+                                              _ProxyVersionState())
+            if req.request_num <= st.last_request_num:
+                # Resend of an already-answered request: reply from cache;
+                # if evicted, drop it — the ReplyPromise signals
+                # broken_promise (the reference asserts the cache holds it).
+                cached = st.replies.get(req.request_num)
+                if cached is not None:
+                    req.reply.send(cached)
+                continue
+            if req.request_num > st.last_request_num + 1:
+                # Out-of-order arrival: park until predecessors are served
+                # (the reference replies strictly in request_num order).
+                p: Promise = Promise()
+                st.waiters[req.request_num] = p
+                spawn(self._serve_parked(st, req, p.get_future()),
+                      "master.parkedVersionReq")
+                continue
+            self._reply_version(st, req)
+
+    async def _serve_parked(self, st: _ProxyVersionState,
+                            req: GetCommitVersionRequest,
+                            gate: Future) -> None:
+        await gate
+        self._reply_version(st, req)
+
+    def _reply_version(self, st: _ProxyVersionState,
+                       req: GetCommitVersionRequest) -> None:
+        reply = self._allocate_version()
+        st.last_request_num = req.request_num
+        st.replies[req.request_num] = reply
+        # Drop replies older than the one before this (proxy won't resend).
+        st.replies = {n: r for n, r in st.replies.items()
+                      if n >= req.request_num - 1}
+        req.reply.send(reply)
+        nxt = st.waiters.pop(req.request_num + 1, None)
+        if nxt is not None:
+            nxt.send(None)
+
+    # -- live committed version (reference :1217) ----------------------------
+    async def _serve_live_committed(self) -> None:
+        async for req in self.interface.get_live_committed_version.queue:
+            req.reply.send(GetRawCommittedVersionReply(
+                version=self.live_committed_version))
+
+    async def _serve_report_committed(self) -> None:
+        async for req in self.interface.report_live_committed_version.queue:
+            if req.version > self.live_committed_version:
+                self.live_committed_version = req.version
+            req.reply.send(None)
+
+    # -- lifecycle -----------------------------------------------------------
+    def run(self, process) -> None:
+        """Register streams + start serving actors on `process`."""
+        for s in self.interface.streams():
+            process.register(s)
+        process.spawn(self._serve_commit_versions(), "master.serveVersions")
+        process.spawn(self._serve_live_committed(), "master.serveLive")
+        process.spawn(self._serve_report_committed(), "master.serveReport")
+        TraceEvent("MasterStarted").detail("Epoch", self.epoch).detail(
+            "RecoveryVersion", self.version).log()

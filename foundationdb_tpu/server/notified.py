@@ -1,0 +1,49 @@
+"""NotifiedVersion: a monotonically increasing value with whenAtLeast waits.
+
+Reference: fdbclient/Notified.h (Notified<Version>) — the version-chaining
+primitive used by resolvers (Resolver.actor.cpp:148 waits
+self->version.whenAtLeast(req.prevVersion)), TLogs, and storage servers.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Tuple
+
+from ..core.futures import Future, Promise, ready_future
+
+
+class NotifiedVersion:
+    """Monotonic value; futures resolve when it reaches a threshold."""
+
+    __slots__ = ("_value", "_waiters", "_seq")
+
+    def __init__(self, value: int = 0) -> None:
+        self._value = value
+        self._waiters: List[Tuple[int, int, Promise]] = []  # heap by threshold
+        self._seq = 0
+
+    def get(self) -> int:
+        return self._value
+
+    def when_at_least(self, threshold: int) -> Future:
+        if self._value >= threshold:
+            return ready_future(self._value)
+        p: Promise = Promise()
+        self._seq += 1
+        heapq.heappush(self._waiters, (threshold, self._seq, p))
+        return p.get_future()
+
+    def set(self, value: int) -> None:
+        assert value >= self._value, \
+            f"NotifiedVersion moved backwards: {self._value} -> {value}"
+        self._value = value
+        while self._waiters and self._waiters[0][0] <= value:
+            _, _, p = heapq.heappop(self._waiters)
+            p.send(value)
+
+    def set_at_least(self, value: int) -> None:
+        """set() that tolerates stale (lower) values — for stage gates that
+        may be advanced out of order by failure paths."""
+        if value > self._value:
+            self.set(value)

@@ -1,0 +1,282 @@
+"""StorageServer: versioned in-memory storage replica.
+
+Reference: fdbserver/storageserver.actor.cpp — serves reads at versions
+inside the 5s MVCC window from a versioned map (:331-362), pulls mutations
+for its tag from the TLogs (update :3626), answers getValueQ (:1228) /
+getKeyValuesQ (:1929) after waiting for the requested version, triggers
+watches (:2622), and trims old versions as the window advances.  The
+versioned map mirrors fdbclient/VersionedMap.h:624 semantics (per-key
+version chains with tombstones) in a bisect-sorted dict — the disk engines
+(IKeyValueStore equivalents) attach below this in storage_engine.py.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Tuple
+
+from ..core.futures import AsyncTrigger, Future, wait_any
+from ..core.knobs import server_knobs
+from ..core.scheduler import delay, spawn
+from ..core.trace import Severity, TraceEvent
+from ..rpc.endpoint import RequestStream
+from ..txn.atomic import apply_atomic
+from ..txn.types import (ATOMIC_OPS, KeyRange, Mutation, MutationType,
+                         Version)
+from .interfaces import (GetKeyValuesReply, GetKeyValuesRequest,
+                         GetValueReply, GetValueRequest,
+                         StorageServerInterface, Tag, TLogPeekRequest,
+                         TLogPopRequest, WatchValueReply, WatchValueRequest)
+from .notified import NotifiedVersion
+
+_FUTURE_VERSION_TIMEOUT = 1.0   # reference: future_version after wait
+
+
+class VersionedMap:
+    """Per-key version chains with tombstones (None = cleared)."""
+
+    def __init__(self) -> None:
+        self._keys: List[bytes] = []
+        self._chains: Dict[bytes, List[Tuple[Version, Optional[bytes]]]] = {}
+        # GC work queue: (version, key) pushed when a chain grows history or
+        # a tombstone lands; forget_before only revisits these chains, so GC
+        # is amortized O(1) per mutation instead of O(total keys) per call.
+        self._gc_heap: List[Tuple[Version, bytes]] = []
+
+    def _chain(self, key: bytes) -> List[Tuple[Version, Optional[bytes]]]:
+        c = self._chains.get(key)
+        if c is None:
+            c = self._chains[key] = []
+            bisect.insort(self._keys, key)
+        return c
+
+    def set(self, key: bytes, value: Optional[bytes],
+            version: Version) -> None:
+        import heapq
+        c = self._chain(key)
+        if c and c[-1][0] == version:
+            c[-1] = (version, value)
+        else:
+            assert not c or c[-1][0] < version
+            c.append((version, value))
+        if len(c) > 1 or value is None:
+            heapq.heappush(self._gc_heap, (version, key))
+
+    def clear_range(self, begin: bytes, end: bytes, version: Version) -> None:
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        for key in self._keys[lo:hi]:
+            c = self._chains[key]
+            if c and c[-1][1] is not None:
+                self.set(key, None, version)
+
+    def get(self, key: bytes, version: Version) -> Optional[bytes]:
+        c = self._chains.get(key)
+        if not c:
+            return None
+        # Chains are short (one MVCC window); scan from newest.
+        for v, val in reversed(c):
+            if v <= version:
+                return val
+        return None
+
+    def latest(self, key: bytes) -> Optional[bytes]:
+        c = self._chains.get(key)
+        return c[-1][1] if c else None
+
+    def range_read(self, begin: bytes, end: bytes, version: Version,
+                   limit: int, limit_bytes: int, reverse: bool = False
+                   ) -> Tuple[List[Tuple[bytes, bytes]], bool]:
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        keys = self._keys[lo:hi]
+        if reverse:
+            keys = keys[::-1]
+        out: List[Tuple[bytes, bytes]] = []
+        nbytes = 0
+        for key in keys:
+            val = self.get(key, version)
+            if val is None:
+                continue
+            out.append((key, val))
+            nbytes += len(key) + len(val)
+            if len(out) >= limit or nbytes >= limit_bytes:
+                # `more` only if a further live key exists at this version.
+                return out, True
+        return out, False
+
+    def forget_before(self, version: Version) -> None:
+        """Drop history below `version`; keys whose only state is an old
+        tombstone disappear entirely (reference forgetVersionsBefore).
+        Only chains with queued GC work are visited (amortized; mirrors the
+        reference SkipList's lazy removeBefore)."""
+        import heapq
+        while self._gc_heap and self._gc_heap[0][0] <= version:
+            _, key = heapq.heappop(self._gc_heap)
+            c = self._chains.get(key)
+            if c is None:
+                continue
+            i = 0
+            # Keep the newest entry at/below `version` as the base state.
+            while i + 1 < len(c) and c[i + 1][0] <= version:
+                i += 1
+            if i > 0:
+                del c[:i]
+            if len(c) == 1 and c[0][1] is None and c[0][0] <= version:
+                del self._chains[key]
+                j = bisect.bisect_left(self._keys, key)
+                del self._keys[j]
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+
+class StorageServer:
+    def __init__(self, ss_id: str, tag: Tag, log_system,
+                 recovery_version: Version = 0) -> None:
+        self.id = ss_id
+        self.tag = tag
+        self.log_system = log_system    # LogSystemClient
+        self.interface = StorageServerInterface(ss_id, tag)
+        self.data = VersionedMap()
+        self.version = NotifiedVersion(recovery_version)
+        self.durable_version = NotifiedVersion(recovery_version)
+        self.oldest_version: Version = recovery_version
+        # key -> [AsyncTrigger, active-waiter-count]; entries removed when
+        # the last waiter leaves (no leak per ever-watched key).
+        self._watches: Dict[bytes, list] = {}
+        self.stats = {"reads": 0, "range_reads": 0, "mutations": 0,
+                      "watches": 0}
+
+    # -- mutation ingestion (reference update :3626) -------------------------
+    def _apply(self, m: Mutation, version: Version) -> None:
+        self.stats["mutations"] += 1
+        if m.type == MutationType.SetValue:
+            self.data.set(m.param1, m.param2, version)
+            self._trigger_watch(m.param1)
+        elif m.type == MutationType.ClearRange:
+            self.data.clear_range(m.param1, m.param2, version)
+            for key in list(self._watches):
+                if m.param1 <= key < m.param2:
+                    self._trigger_watch(key)
+        elif m.type in ATOMIC_OPS:
+            existing = self.data.latest(m.param1)
+            self.data.set(m.param1, apply_atomic(m.type, existing, m.param2),
+                          version)
+            self._trigger_watch(m.param1)
+        else:
+            TraceEvent("SSUnknownMutation", Severity.Warn).detail(
+                "Type", int(m.type)).log()
+
+    async def _pull_loop(self) -> None:
+        """The update actor: a peek cursor over this server's tag."""
+        knobs = server_knobs()
+        tlog = self.log_system.tlogs[self.log_system.tlog_for_tag(self.tag)]
+        fetch_from = self.version.get() + 1
+        while True:
+            reply = await RequestStream.at(tlog.peek.endpoint).get_reply(
+                TLogPeekRequest(tag=self.tag, begin=fetch_from))
+            new_version = self.version.get()
+            for version, msgs in reply.messages:
+                assert version > self.version.get()
+                for m in msgs:
+                    self._apply(m, version)
+                new_version = version
+            # Advance past empty versions too: the TLog's version frontier
+            # covers commits that had no mutations for our tag.
+            new_version = max(new_version, reply.max_known_version)
+            if new_version > self.version.get():
+                self.version.set(new_version)
+                self.oldest_version = max(
+                    self.oldest_version,
+                    new_version -
+                    int(knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS))
+                self.data.forget_before(self.oldest_version)
+                # Memory "durability": ack the log so it can trim (the disk
+                # engine path fsyncs first; see storage_engine.py).
+                self.durable_version.set(new_version)
+                self.log_system.pop(self.tag, new_version)
+            fetch_from = reply.end
+
+    # -- read path (reference getValueQ :1228, waitForVersion) ---------------
+    async def _wait_for_version(self, version: Version) -> None:
+        from ..core.error import err
+        if version < self.oldest_version:
+            raise err("transaction_too_old")
+        if version > self.version.get():
+            done = self.version.when_at_least(version)
+            timeout = delay(_FUTURE_VERSION_TIMEOUT)
+            idx, _ = await wait_any([done, timeout])
+            if idx == 1:
+                raise err("future_version")
+        if version < self.oldest_version:
+            raise err("transaction_too_old")
+
+    async def _get_value(self, req: GetValueRequest) -> None:
+        try:
+            await self._wait_for_version(req.version)
+            self.stats["reads"] += 1
+            req.reply.send(GetValueReply(
+                value=self.data.get(req.key, req.version),
+                version=req.version))
+        except Exception as e:   # noqa: BLE001 - errors propagate via reply
+            req.reply.send_error(e)
+
+    async def _get_key_values(self, req: GetKeyValuesRequest) -> None:
+        try:
+            await self._wait_for_version(req.version)
+            self.stats["range_reads"] += 1
+            data, more = self.data.range_read(
+                req.begin, req.end, req.version, req.limit, req.limit_bytes,
+                req.reverse)
+            req.reply.send(GetKeyValuesReply(data=data, more=more,
+                                             version=req.version))
+        except Exception as e:   # noqa: BLE001
+            req.reply.send_error(e)
+
+    # -- watches (reference watchValueQ, trigger :2622) ----------------------
+    def _trigger_watch(self, key: bytes) -> None:
+        entry = self._watches.get(key)
+        if entry is not None:
+            entry[0].trigger()
+
+    async def _watch_value(self, req: WatchValueRequest) -> None:
+        try:
+            await self._wait_for_version(req.version)
+            self.stats["watches"] += 1
+            entry = self._watches.get(req.key)
+            if entry is None:
+                entry = self._watches[req.key] = [AsyncTrigger(), 0]
+            entry[1] += 1
+            try:
+                while True:
+                    if self.data.latest(req.key) != req.value:
+                        req.reply.send(WatchValueReply(
+                            version=self.version.get()))
+                        return
+                    await entry[0].on_trigger()
+            finally:
+                entry[1] -= 1
+                if entry[1] <= 0 and self._watches.get(req.key) is entry:
+                    del self._watches[req.key]
+        except Exception as e:   # noqa: BLE001
+            req.reply.send_error(e)
+
+    # -- serving -------------------------------------------------------------
+    async def _serve(self, queue, handler) -> None:
+        async for req in queue:
+            spawn(handler(req), f"{self.id}.handler")
+
+    def run(self, process) -> None:
+        for s in self.interface.streams():
+            process.register(s)
+        process.spawn(self._pull_loop(), f"{self.id}.update")
+        process.spawn(self._serve(self.interface.get_value.queue,
+                                  self._get_value), f"{self.id}.getValue")
+        process.spawn(self._serve(self.interface.get_key_values.queue,
+                                  self._get_key_values),
+                      f"{self.id}.getKeyValues")
+        process.spawn(self._serve(self.interface.watch_value.queue,
+                                  self._watch_value), f"{self.id}.watch")
+        TraceEvent("StorageServerStarted").detail("Id", self.id).detail(
+            "Tag", self.tag).log()

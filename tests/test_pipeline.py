@@ -1,0 +1,384 @@
+"""End-to-end transaction pipeline tests in deterministic simulation.
+
+Exercises the full commit path — client GRV -> commit proxy batching ->
+master version allocation -> resolver conflict detection -> TLog push ->
+storage pull -> reads — mirroring how the reference tests the pipeline in
+simulation (SURVEY.md §3.1-3.3, workloads like Cycle/ConflictRange)."""
+
+import pytest
+
+from foundationdb_tpu.core import FdbError
+from foundationdb_tpu.server.cluster import SimCluster
+from foundationdb_tpu.server.shardmap import RangeMap
+from foundationdb_tpu.server.storage import VersionedMap
+from foundationdb_tpu.txn.types import MutationType
+
+
+@pytest.fixture()
+def cluster():
+    c = SimCluster(n_resolvers=1, n_storage=2, n_tlogs=1)
+    yield c
+    from foundationdb_tpu.core import set_event_loop
+    from foundationdb_tpu.rpc.sim import set_simulator
+    set_simulator(None)
+    set_event_loop(None)
+
+
+def run(cluster, coro, timeout=30):
+    return cluster.run_until(cluster.loop.spawn(coro), timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# RangeMap / VersionedMap units
+# ---------------------------------------------------------------------------
+
+def test_rangemap_split_and_lookup():
+    rm = RangeMap(default=0)
+    rm.set_range(b"c", b"m", 1)
+    rm.set_range(b"f", b"h", 2)
+    assert rm.lookup(b"a") == 0
+    assert rm.lookup(b"c") == 1
+    assert rm.lookup(b"f") == 2
+    assert rm.lookup(b"g\xff") == 2
+    assert rm.lookup(b"h") == 1
+    assert rm.lookup(b"m") == 0
+    spans = list(rm.intersecting(b"e", b"i"))
+    assert spans == [(b"e", b"f", 1), (b"f", b"h", 2), (b"h", b"i", 1)]
+
+
+def test_rangemap_coalesce():
+    rm = RangeMap(default=0)
+    rm.set_range(b"a", b"b", 1)
+    rm.set_range(b"b", b"c", 1)
+    assert len(list(rm.ranges())) == 3  # [,a) [a,c) [c,)
+    rm.set_range(b"a", b"c", 0)
+    assert len(list(rm.ranges())) == 1
+
+
+def test_versioned_map_mvcc():
+    vm = VersionedMap()
+    vm.set(b"k", b"v1", 10)
+    vm.set(b"k", b"v2", 20)
+    assert vm.get(b"k", 5) is None
+    assert vm.get(b"k", 10) == b"v1"
+    assert vm.get(b"k", 15) == b"v1"
+    assert vm.get(b"k", 25) == b"v2"
+    vm.clear_range(b"a", b"z", 30)
+    assert vm.get(b"k", 25) == b"v2"
+    assert vm.get(b"k", 30) is None
+    vm.forget_before(35)
+    assert vm.get(b"k", 40) is None
+    assert len(vm) == 0  # tombstone GC'd
+
+
+def test_versioned_map_range_read():
+    vm = VersionedMap()
+    for i in range(5):
+        vm.set(b"k%d" % i, b"v%d" % i, 10)
+    vm.set(b"k2", None, 20)
+    data, more = vm.range_read(b"k0", b"k9", 20, 10, 1 << 20)
+    assert [k for k, _ in data] == [b"k0", b"k1", b"k3", b"k4"]
+    data, more = vm.range_read(b"k0", b"k9", 10, 2, 1 << 20)
+    assert len(data) == 2 and more
+
+
+# ---------------------------------------------------------------------------
+# End-to-end pipeline
+# ---------------------------------------------------------------------------
+
+def test_commit_and_read(cluster):
+    db = cluster.database()
+
+    async def go():
+        txn = db.create_transaction()
+        txn.set(b"hello", b"world")
+        txn.set(b"foo", b"bar")
+        v = await txn.commit()
+        assert v > 0
+        txn2 = db.create_transaction()
+        assert await txn2.get(b"hello") == b"world"
+        assert await txn2.get(b"foo") == b"bar"
+        assert await txn2.get(b"missing") is None
+        return v
+
+    assert run(cluster, go()) > 0
+
+
+def test_read_your_writes(cluster):
+    db = cluster.database()
+
+    async def go():
+        txn = db.create_transaction()
+        txn.set(b"a", b"1")
+        assert await txn.get(b"a") == b"1"       # uncommitted, visible to us
+        txn.clear(b"a")
+        assert await txn.get(b"a") is None
+        txn.set(b"a", b"2")
+        assert await txn.get(b"a") == b"2"
+        await txn.commit()
+        txn2 = db.create_transaction()
+        assert await txn2.get(b"a") == b"2"
+
+    run(cluster, go())
+
+
+def test_conflict_aborts_second_writer(cluster):
+    db = cluster.database()
+
+    async def go():
+        # Both transactions read k then write it, overlapping in time:
+        # the second to commit must get not_committed.
+        t1 = db.create_transaction()
+        t2 = db.create_transaction()
+        await t1.get(b"k")
+        await t2.get(b"k")
+        t1.set(b"k", b"t1")
+        t2.set(b"k", b"t2")
+        await t1.commit()
+        with pytest.raises(FdbError) as ei:
+            await t2.commit()
+        assert ei.value.name == "not_committed"
+        # And the retry loop makes t2 succeed on a fresh snapshot.
+        await t2.on_error(ei.value)
+        await t2.get(b"k")
+        t2.set(b"k", b"t2")
+        await t2.commit()
+        t3 = db.create_transaction()
+        assert await t3.get(b"k") == b"t2"
+
+    run(cluster, go())
+
+
+def test_blind_writes_do_not_conflict(cluster):
+    db = cluster.database()
+
+    async def go():
+        t1 = db.create_transaction()
+        t2 = db.create_transaction()
+        t1.set(b"k", b"1")
+        t2.set(b"k", b"2")
+        await t1.commit()
+        await t2.commit()   # no read conflict ranges -> no conflict
+
+    run(cluster, go())
+
+
+def test_atomic_add_end_to_end(cluster):
+    db = cluster.database()
+
+    async def go():
+        import struct
+        t = db.create_transaction()
+        t.atomic_op(MutationType.AddValue, b"ctr", struct.pack("<q", 5))
+        await t.commit()
+        t = db.create_transaction()
+        t.atomic_op(MutationType.AddValue, b"ctr", struct.pack("<q", 7))
+        await t.commit()
+        t = db.create_transaction()
+        raw = await t.get(b"ctr")
+        assert struct.unpack("<q", raw)[0] == 12
+
+    run(cluster, go())
+
+
+def test_range_read_across_shards(cluster):
+    # n_storage=2 splits the keyspace at 0x80; write keys on both sides.
+    db = cluster.database()
+
+    async def go():
+        txn = db.create_transaction()
+        keys = [b"a1", b"a2", b"\x90x", b"\x90y"]
+        for i, k in enumerate(keys):
+            txn.set(k, b"v%d" % i)
+        await txn.commit()
+        t2 = db.create_transaction()
+        data = await t2.get_range(b"", b"\xfe")
+        assert [k for k, _ in data] == sorted(keys)
+        # Merge with uncommitted writes + clears.
+        t2.set(b"a15", b"new")
+        t2.clear(b"\x90x")
+        data = await t2.get_range(b"", b"\xfe")
+        assert [k for k, _ in data] == [b"a1", b"a15", b"a2", b"\x90y"]
+
+    run(cluster, go())
+
+
+def test_clear_range_end_to_end(cluster):
+    db = cluster.database()
+
+    async def go():
+        txn = db.create_transaction()
+        for i in range(6):
+            txn.set(b"row%d" % i, b"x")
+        await txn.commit()
+        t2 = db.create_transaction()
+        t2.clear(b"row1", b"row4")
+        await t2.commit()
+        t3 = db.create_transaction()
+        data = await t3.get_range(b"row", b"rox")
+        assert [k for k, _ in data] == [b"row0", b"row4", b"row5"]
+
+    run(cluster, go())
+
+
+def test_watch_fires_on_change(cluster):
+    db = cluster.database()
+
+    async def go():
+        t0 = db.create_transaction()
+        t0.set(b"w", b"0")
+        await t0.commit()
+        t1 = db.create_transaction()
+        watch_f = await t1.watch(b"w")
+        assert not watch_f.is_ready()
+        t2 = db.create_transaction()
+        t2.set(b"w", b"1")
+        await t2.commit()
+        await watch_f   # must fire now
+
+    run(cluster, go())
+
+
+def test_multi_resolver_and_proxy(cluster):
+    del cluster  # use a custom topology
+    c = SimCluster(n_resolvers=2, n_storage=4, n_tlogs=2,
+                   n_commit_proxies=2, n_grv_proxies=2, replication=2)
+    db = c.database()
+
+    async def go():
+        # Writes spanning both resolvers' ranges (split at 0x80).
+        txn = db.create_transaction()
+        txn.set(b"low", b"1")
+        txn.set(b"\x90high", b"2")
+        await txn.commit()
+        t2 = db.create_transaction()
+        assert await t2.get(b"low") == b"1"
+        assert await t2.get(b"\x90high") == b"2"
+        # Conflict via a range spanning both resolvers.
+        t3 = db.create_transaction()
+        await t3.get_range(b"", b"\xf0")
+        t3.set(b"probe", b"x")
+        t4 = db.create_transaction()
+        t4.set(b"\x90high", b"3")
+        await t4.commit()
+        with pytest.raises(FdbError):
+            await t3.commit()
+
+    c.run_until(c.loop.spawn(go()), timeout=30)
+    from foundationdb_tpu.core import set_event_loop
+    from foundationdb_tpu.rpc.sim import set_simulator
+    set_simulator(None)
+    set_event_loop(None)
+
+
+def test_run_retry_helper(cluster):
+    db = cluster.database()
+
+    async def go():
+        async def body(txn):
+            v = await txn.get(b"n")
+            n = int(v or b"0")
+            txn.set(b"n", b"%d" % (n + 1))
+            return n + 1
+
+        for expected in (1, 2, 3):
+            got = await db.create_transaction().run(body)
+            assert got == expected
+
+    run(cluster, go())
+
+
+def test_reverse_range_returns_last_keys(cluster):
+    db = cluster.database()
+
+    async def go():
+        txn = db.create_transaction()
+        for i in range(20):
+            txn.set(b"r%02d" % i, b"v%d" % i)
+        await txn.commit()
+        t2 = db.create_transaction()
+        data = await t2.get_range(b"r", b"s", limit=5, reverse=True)
+        assert [k for k, _ in data] == [b"r19", b"r18", b"r17", b"r16",
+                                        b"r15"]
+        # Reverse + RYW overlay.
+        t2.set(b"r99", b"new")
+        t2.clear(b"r19")
+        data = await t2.get_range(b"r", b"s", limit=3, reverse=True)
+        assert [k for k, _ in data] == [b"r99", b"r18", b"r17"]
+
+    run(cluster, go())
+
+
+def test_range_limit_with_clears_no_gaps(cluster):
+    db = cluster.database()
+
+    async def go():
+        txn = db.create_transaction()
+        for i in range(30):
+            txn.set(b"g%02d" % i, b"x")
+        await txn.commit()
+        t2 = db.create_transaction()
+        t2.clear(b"g00", b"g10")   # clears shrink the snapshot prefix
+        data = await t2.get_range(b"g", b"h", limit=5)
+        # Must be the first 5 surviving keys, contiguous — no gaps.
+        assert [k for k, _ in data] == [b"g10", b"g11", b"g12", b"g13",
+                                        b"g14"]
+
+    run(cluster, go())
+
+
+def test_conflict_only_transaction_resolves(cluster):
+    db = cluster.database()
+
+    async def go():
+        # Locking pattern: a txn with only an explicit write conflict range
+        # must go through the resolver (and conflict with a later reader).
+        t1 = db.create_transaction()
+        t2 = db.create_transaction()
+        await t2.get(b"lock")           # t2 reads before t1's "write"
+        t1.add_write_conflict_range(b"lock", b"lock\x00")
+        v = await t1.commit()
+        assert v > 0                    # really resolved, not skipped
+        t2.add_write_conflict_range(b"other", b"other\x00")
+        with pytest.raises(FdbError) as ei:
+            await t2.commit()
+        assert ei.value.name == "not_committed"
+
+    run(cluster, go())
+
+
+def test_backoff_is_capped(cluster):
+    db = cluster.database()
+
+    async def go():
+        from foundationdb_tpu.core.error import err as mkerr
+        from foundationdb_tpu.core.knobs import client_knobs
+        txn = db.create_transaction()
+        for _ in range(20):
+            await txn.on_error(mkerr("not_committed"))
+        assert txn._backoff <= client_knobs().DEFAULT_MAX_BACKOFF
+        assert txn._extra_write_ranges == []
+
+    run(cluster, go(), timeout=60)
+
+
+def test_transaction_too_old(cluster):
+    db = cluster.database()
+
+    async def go():
+        txn = db.create_transaction()
+        await txn.get(b"k")        # pins an old read version
+        # Push the version frontier way past the MVCC window (5s of
+        # versions at 1M/s = 5e6; sim time advance drives the master rate).
+        from foundationdb_tpu.core.scheduler import delay
+        for _ in range(8):
+            t = db.create_transaction()
+            t.set(b"filler", b"x")
+            await t.commit()
+            await delay(1.0)
+        txn.set(b"k", b"stale")
+        with pytest.raises(FdbError) as ei:
+            await txn.commit()
+        assert ei.value.name in ("transaction_too_old", "not_committed")
+
+    run(cluster, go(), timeout=60)
