@@ -46,24 +46,100 @@ RETRYABLE = frozenset({
 })
 
 
+class ClusterConnection:
+    """Dynamic cluster connection: tracks the elected cluster controller
+    via the coordinators and long-polls its ClientDBInfo (reference
+    MonitorLeader.actor.cpp + OpenDatabaseRequest)."""
+
+    def __init__(self, coordinators) -> None:
+        from ..core.futures import AsyncVar
+        from ..core.scheduler import spawn
+        from ..server.coordination import monitor_leader
+        from ..server.interfaces import ClientDBInfo
+        self.coordinators = coordinators
+        self.leader = AsyncVar(None)
+        self.client_info = AsyncVar(ClientDBInfo())
+        self._actors = [
+            spawn(monitor_leader(coordinators, self.leader),
+                  "client.monitorLeader"),
+            spawn(self._open_database_loop(), "client.openDatabase"),
+        ]
+
+    @property
+    def grv_proxies(self):
+        return self.client_info.get().grv_proxies
+
+    @property
+    def commit_proxies(self):
+        return self.client_info.get().commit_proxies
+
+    async def wait_ready(self) -> None:
+        while not (self.grv_proxies and self.commit_proxies):
+            await self.client_info.on_change()
+
+    async def _open_database_loop(self) -> None:
+        from ..core.futures import wait_any
+        from ..core.scheduler import delay
+        from ..server.interfaces import OpenDatabaseRequest
+        known_epoch = -1
+        while True:
+            leader = self.leader.get()
+            cc = leader.serialized_info if leader else None
+            if cc is None:
+                await self.leader.on_change()
+                continue
+            reply_f = RequestStream.at(cc.open_database.endpoint).get_reply(
+                OpenDatabaseRequest(known_epoch=known_epoch))
+            # Race the long-poll against a leader change: a parked poll on
+            # a deposed/dead CC must not strand us.
+            change_f = self.leader.on_change()
+            idx, _ = await wait_any([_swallow(reply_f), change_f])
+            if idx == 1:
+                continue
+            if reply_f.is_error():
+                await delay(0.5)
+                continue
+            info = reply_f.get()
+            known_epoch = info.epoch
+            self.client_info.set(info)
+
+    def close(self) -> None:
+        for a in self._actors:
+            if not a.is_ready():
+                a.cancel()
+
+
+from ..core.futures import swallow as _swallow
+
+
 class Database:
     """Client handle to a cluster (reference DatabaseContext)."""
 
     def __init__(self, cluster: Any) -> None:
-        # `cluster` provides grv_proxies / commit_proxies interface lists
-        # (served by the cluster harness or, later, the coordinators).
+        # `cluster` provides grv_proxies / commit_proxies interface lists —
+        # a static harness adapter or a ClusterConnection.
         self.cluster = cluster
         self._location_cache: RangeMap = RangeMap(default=None)
         self._rr = 0   # round-robin over proxies / replicas
 
     # -- proxies -------------------------------------------------------------
+    async def _await_ready(self) -> None:
+        waiter = getattr(self.cluster, "wait_ready", None)
+        if waiter is not None:
+            await waiter()
+
     def _grv_proxy(self):
         proxies = self.cluster.grv_proxies
+        if not proxies:
+            raise err("request_maybe_delivered", "no GRV proxies known yet")
         self._rr += 1
         return proxies[self._rr % len(proxies)]
 
     def _commit_proxy(self):
         proxies = self.cluster.commit_proxies
+        if not proxies:
+            raise err("request_maybe_delivered",
+                      "no commit proxies known yet")
         self._rr += 1
         return proxies[self._rr % len(proxies)]
 
@@ -139,9 +215,20 @@ class Transaction:
                 GetReadVersionRequest(priority=self.priority))
         return self._read_version
 
+    GRV_TIMEOUT = 5.0
+    COMMIT_TIMEOUT = 10.0
+
     async def _ensure_read_version(self) -> Version:
-        reply = await self.get_read_version()
-        return reply.version
+        from ..core.futures import wait_any
+        if self._read_version is None:
+            await self.db._await_ready()
+        f = self.get_read_version()
+        idx, _ = await wait_any([f, delay(self.GRV_TIMEOUT)])
+        if idx == 1:
+            # Recovery in flight: the proxy we asked is gone or wedged.
+            self._read_version = None
+            raise err("request_maybe_delivered", "GRV timed out")
+        return f.get().version
 
     # -- reads ---------------------------------------------------------------
     async def get(self, key: bytes, snapshot: bool = False
@@ -307,9 +394,25 @@ class Transaction:
             read_snapshot=read_snapshot)
         if txn.expected_size() > client_knobs().TRANSACTION_SIZE_LIMIT:
             raise err("transaction_too_large")
+        await self.db._await_ready()
         proxy = self.db._commit_proxy()
-        reply = await RequestStream.at(proxy.commit.endpoint).get_reply(
+        from ..core.futures import wait_any
+        f = RequestStream.at(proxy.commit.endpoint).get_reply(
             CommitTransactionRequest(transaction=txn))
+        try:
+            idx, _ = await wait_any([f, delay(self.COMMIT_TIMEOUT)])
+        except FdbError as e:
+            # The proxy may have logged the commit before dying: a lost
+            # reply means the outcome is UNKNOWN, never "didn't happen" —
+            # retrying as not-committed could double-apply (reference
+            # tryCommit maps these to commit_unknown_result).
+            if e.name in ("broken_promise", "connection_failed",
+                          "request_maybe_delivered"):
+                raise err("commit_unknown_result", f"commit lost: {e.name}")
+            raise
+        if idx == 1:
+            raise err("commit_unknown_result", "commit timed out")
+        reply = f.get()
         self.committed_version = reply.version
         return reply.version
 

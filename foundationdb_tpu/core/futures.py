@@ -151,6 +151,7 @@ class Promise(Generic[T]):
 
     def break_promise(self) -> None:
         if not self._sent and not self._future.is_ready():
+            self._sent = True   # spent: later send/send_error must no-op
             self._future._send_error(err("broken_promise"))
 
     def __del__(self) -> None:
@@ -472,6 +473,15 @@ def quorum(futures: Iterable[Future], n: int) -> Future:
                 out._send(None)
 
     return _combinator(futures, on_each)
+
+
+def swallow(f: Future) -> Future:
+    """Resolve (with None) when `f` resolves, success OR error — for racing
+    fallible futures inside wait_any/wait_all without error propagation.
+    Inspect `f` itself afterwards for the outcome."""
+    out: Future = Future()
+    f.on_ready(lambda fut: out._send(None) if not out.is_ready() else None)
+    return out
 
 
 def map_future(f: Future, fn: Callable[[Any], Any]) -> Future:

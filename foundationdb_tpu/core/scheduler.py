@@ -191,6 +191,12 @@ def get_event_loop() -> EventLoop:
     return _current
 
 
+def current_event_loop_or_none() -> Optional[EventLoop]:
+    """The installed loop, or None — for callbacks that may fire from the
+    garbage collector after their world was torn down."""
+    return _current
+
+
 def now() -> float:
     return get_event_loop().now()
 

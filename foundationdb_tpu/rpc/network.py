@@ -110,7 +110,8 @@ class SimNetwork:
         when = self._delivery_time(src_ip, ep.address.ip)
 
         def fail() -> None:
-            if not reply_promise.is_set():
+            if not reply_promise.is_set() and \
+                    not reply_promise.get_future().is_ready():
                 reply_promise.send_error(err("broken_promise"))
 
         if when is None:  # partitioned: connection failure after a delay
@@ -119,10 +120,20 @@ class SimNetwork:
 
         def route_reply(value: Any, e: Optional[BaseException]) -> None:
             # Reply path: receiver -> sender, re-clogged/partitioned/timed.
+            # May fire from the GC (dropped ReplyPromise) AFTER this sim
+            # world was torn down — never touch the current world's RNG or
+            # loop from a stale one (it would break determinism).
+            from ..core.scheduler import current_event_loop_or_none
+            if current_event_loop_or_none() is not loop:
+                if not reply_promise.is_set() and \
+                        not reply_promise.get_future().is_ready():
+                    reply_promise.send_error(err("broken_promise"))
+                return
             back = self._delivery_time(ep.address.ip, src_ip)
 
             def deliver_reply() -> None:
-                if reply_promise.is_set():
+                if reply_promise.is_set() or \
+                        reply_promise.get_future().is_ready():
                     return
                 if e is not None:
                     reply_promise.send_error(e)

@@ -118,7 +118,7 @@ class SimCluster:
 
 
 class _ClientCluster:
-    """Adapter giving Database the proxy lists (later: MonitorLeader)."""
+    """Adapter giving Database the proxy lists (static harness)."""
 
     def __init__(self, cluster: SimCluster) -> None:
         self._c = cluster
@@ -130,3 +130,121 @@ class _ClientCluster:
     @property
     def commit_proxies(self):
         return self._c.commit_proxy_interfaces
+
+
+class SimFdbCluster:
+    """The REAL topology: coordinators + fungible worker processes.  Every
+    worker campaigns for cluster controller through the coordinators; the
+    winning CC recruits a master, which runs recovery and recruits all
+    transaction-system roles onto workers (reference SimulatedCluster +
+    fdbd: every process is a worker; roles are placed dynamically).  Kill
+    any transaction-system process and the cluster recovers into a new
+    epoch — this harness is the substrate for chaos tests."""
+
+    def __init__(self, config=None, n_workers: int = 4,
+                 n_storage_workers: int = 2, n_coordinators: int = 3,
+                 loop: Optional[EventLoop] = None) -> None:
+        from ..core.futures import AsyncVar
+        from .cluster_controller import ClusterController
+        from .coordination import (CoordinationClientInterface,
+                                   CoordinationServer, try_become_leader)
+        from .interfaces import DatabaseConfiguration
+        from .worker import Worker
+
+        self.config = config or DatabaseConfiguration()
+        # Cold-boot recruitment should see the whole initial pool: storage
+        # workers plus at least one stateless (recoveries after kills still
+        # proceed with fewer — dead workers are dropped from the registry).
+        self.config.min_workers = max(self.config.min_workers,
+                                      min(n_storage_workers + 1, n_workers))
+        self.loop = loop or EventLoop(sim=True)
+        set_event_loop(self.loop)
+        self.sim = Simulator()
+        set_simulator(self.sim)
+
+        self.coordinators = []
+        self.coordinator_clients = []
+        for i in range(n_coordinators):
+            p = self.sim.new_process(name=f"coord{i}",
+                                     process_class="coordinator")
+            server = CoordinationServer(f"coord{i}")
+            server.run(p)
+            self.coordinators.append((p, server))
+            self.coordinator_clients.append(
+                CoordinationClientInterface(server))
+
+        self.workers = []
+        for i in range(n_workers):
+            pclass = "storage" if i < n_storage_workers else "stateless"
+            p = self.sim.new_process(name=f"worker{i}", process_class=pclass)
+            leader_var = AsyncVar(None)
+            # Only stateless workers campaign for CC (a storage worker
+            # winning would put the control plane on a data node), so only
+            # they need a candidate ClusterController at all.
+            if pclass == "stateless":
+                cc = ClusterController(f"cc.worker{i}",
+                                       self.coordinator_clients, self.config)
+                cc.register_streams(p)   # endpoints exist before any win
+                p.spawn(try_become_leader(self.coordinator_clients,
+                                          cc.interface, leader_var,
+                                          change_id=i),
+                        f"worker{i}.campaign")
+                p.spawn(self._cc_runner(p, cc, leader_var, i),
+                        f"worker{i}.ccRunner")
+            else:
+                cc = None
+                from .coordination import monitor_leader
+                p.spawn(monitor_leader(self.coordinator_clients, leader_var),
+                        f"worker{i}.monitorLeader")
+            worker = Worker(p, self.coordinator_clients,
+                            process_class=pclass, config=self.config)
+            worker.run(leader_var)
+            self.workers.append((p, worker, cc, leader_var))
+
+    @staticmethod
+    async def _cc_runner(process, cc, leader_var, my_change_id) -> None:
+        """Start the CC role while this worker holds the leadership; halt
+        it when deposed (a running deposed CC would recruit a competing
+        transaction system — transient dual leadership must converge)."""
+        started = False
+        while True:
+            leader = leader_var.get()
+            is_me = leader is not None and leader.change_id == my_change_id
+            if is_me and not started:
+                cc.run(process)
+                started = True
+            elif not is_me and started:
+                cc.halt()
+                started = False
+            await leader_var.on_change()
+
+    def database(self):
+        from ..client.database import ClusterConnection, Database
+        return Database(ClusterConnection(self.coordinator_clients))
+
+    def run_until(self, future, timeout: Optional[float] = None):
+        return self.loop.run_until(future, timeout)
+
+    # -- fault helpers for tests --------------------------------------------
+    def process_of(self, interface):
+        """The sim process hosting a role, located via any of its endpoint
+        addresses."""
+        for attr in vars(interface).values():
+            ep = getattr(attr, "_endpoint", None) or getattr(attr, "ep", None)
+            if ep is not None:
+                return self.sim.processes.get(ep.address)
+        return None
+
+    def current_cc(self):
+        """The ClusterController actually running (live leader)."""
+        for p, _w, cc, leader_var in self.workers:
+            if cc is None or not p.alive:
+                continue
+            leader = leader_var.get()
+            if leader is not None and \
+                    leader.serialized_info is cc.interface:
+                return cc
+        return None
+
+    def worker_process(self, i: int):
+        return self.workers[i][0]

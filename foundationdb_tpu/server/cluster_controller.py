@@ -1,0 +1,223 @@
+"""ClusterController: the elected singleton that recruits and monitors.
+
+Reference: fdbserver/ClusterController.actor.cpp — wins leader election via
+the coordinators (clusterControllerCore :4798), tracks registered workers,
+recruits the master (clusterWatchDatabase :3088) and restarts recovery when
+the master dies; broadcasts ServerDBInfo to all workers and ClientDBInfo to
+clients.  Recovery itself is the master's job (master.py); the CC only
+picks where it runs and replaces it on failure.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.error import FdbError
+from ..core.futures import AsyncVar, Promise
+from ..core.scheduler import delay, spawn
+from ..core.trace import Severity, TraceEvent
+from ..rpc.endpoint import RequestStream
+from .failure import WaitFailureRequest
+from .interfaces import (ClientDBInfo, ClusterControllerInterface,
+                         InitializeMasterRequest, MasterRegistrationRequest,
+                         ServerDBInfo, WorkerInterface)
+
+
+@dataclass
+class GetServerDBInfoRequest:
+    """Long-poll: replies (version, ServerDBInfo) when version >
+    known_version (the worker-side broadcast subscription)."""
+
+    known_version: int = -1
+    reply: Any = None
+
+
+class ClusterController:
+    def __init__(self, cc_id: str, coordinators, config) -> None:
+        self.id = cc_id
+        self._process = None
+        self.coordinators = coordinators
+        self.config = config
+        self.interface = ClusterControllerInterface(cc_id)
+        self.workers: Dict[str, Tuple[WorkerInterface, str]] = {}
+        self.db_info = ServerDBInfo()
+        self.db_info_version = 0
+        self._db_info_waiters: List[Promise] = []
+        self._client_waiters: List[Promise] = []
+        self._worker_arrived: List[Promise] = []
+        self._streams_registered = False
+        self._actors: List = []
+
+    # -- broadcast plumbing --------------------------------------------------
+    def _publish(self, info: ServerDBInfo) -> None:
+        self.db_info = info
+        self.db_info_version += 1
+        waiters, self._db_info_waiters = self._db_info_waiters, []
+        for p in waiters:
+            p.send(None)
+        cwaiters, self._client_waiters = self._client_waiters, []
+        for p in cwaiters:
+            p.send(None)
+
+    def client_db_info(self) -> ClientDBInfo:
+        return ClientDBInfo(epoch=self.db_info.epoch,
+                            grv_proxies=list(self.db_info.grv_proxies),
+                            commit_proxies=list(self.db_info.commit_proxies))
+
+    # -- serving -------------------------------------------------------------
+    async def _serve_register_worker(self) -> None:
+        async for req in self.interface.register_worker.queue:
+            if req.worker.id not in self.workers:
+                self._spawn(self._monitor_worker(req.worker.id, req.worker),
+                            f"{self.id}.monitorWorker")
+            self.workers[req.worker.id] = (req.worker, req.process_class)
+            arrived, self._worker_arrived = self._worker_arrived, []
+            for p in arrived:
+                p.send(None)
+            if req.reply is not None:
+                req.reply.send(None)
+
+    async def _monitor_worker(self, wid: str, iface: WorkerInterface) -> None:
+        """Drop dead workers from the recruitment pool (reference
+        workerAvailabilityWatch)."""
+        from .failure import wait_failure_of
+        await wait_failure_of(iface)
+        cur = self.workers.get(wid)
+        if cur is not None and cur[0] is iface:
+            del self.workers[wid]
+            TraceEvent("CCWorkerRemoved", Severity.Warn).detail(
+                "Worker", wid).log()
+
+    async def _serve_get_workers(self) -> None:
+        async for req in self.interface.get_workers.queue:
+            req.reply.send(list(self.workers.values()))
+
+    def _spawn(self, coro, name: str):
+        """Handlers must die with the CC's process (parked long-polls on a
+        dead CC would otherwise never break their reply promises) AND be
+        cancellable at halt() (a deposed CC must stop serving)."""
+        f = self._process.spawn(coro, name) if self._process is not None \
+            else spawn(coro, name)
+        self._actors.append(f)
+        return f
+
+    async def _serve_get_db_info(self) -> None:
+        async for req in self.interface.get_server_db_info.queue:
+            self._spawn(self._handle_get_db_info(req), f"{self.id}.getDbInfo")
+
+    async def _handle_get_db_info(self, req: GetServerDBInfoRequest) -> None:
+        while req.known_version >= self.db_info_version:
+            p: Promise = Promise()
+            self._db_info_waiters.append(p)
+            await p.get_future()
+        req.reply.send((self.db_info_version, self.db_info))
+
+    async def _serve_open_database(self) -> None:
+        async for req in self.interface.open_database.queue:
+            self._spawn(self._handle_open_database(req), f"{self.id}.openDb")
+
+    async def _handle_open_database(self, req) -> None:
+        while (self.db_info.epoch <= req.known_epoch or
+               self.db_info.recovery_state not in ("accepting_commits",
+                                                   "fully_recovered")):
+            p: Promise = Promise()
+            self._client_waiters.append(p)
+            await p.get_future()
+        req.reply.send(self.client_db_info())
+
+    async def _serve_master_registration(self) -> None:
+        async for req in self.interface.master_registration.queue:
+            if req.epoch == self.db_info.epoch:
+                self._publish(req.db_info)
+            req.reply.send(None)
+
+    # -- recruitment (reference clusterWatchDatabase :3088) ------------------
+    async def _wait_for_workers(self, n: int) -> None:
+        """Wait for the recruitment pool: at least `n` workers AND one
+        stateless-class worker — recruiting against a partial registry
+        would place transaction-system roles on storage workers, breaking
+        the placement invariant chaos tests rely on."""
+        def ready() -> bool:
+            if len(self.workers) < n:
+                return False
+            return any(cls in ("stateless", "unset")
+                       for _i, cls in self.workers.values())
+        while not ready():
+            p: Promise = Promise()
+            self._worker_arrived.append(p)
+            await p.get_future()
+
+    def _pick_master_worker(self) -> WorkerInterface:
+        # Prefer stateless-class workers; deterministic order by id.
+        items = sorted(self.workers.items())
+        for wid, (iface, pclass) in items:
+            if pclass in ("stateless", "master"):
+                return iface
+        return items[0][1][0]
+
+    async def _cluster_watch_database(self) -> None:
+        from .coordination import CoordinatedState
+        from .master import DBCoreState
+        while True:
+            try:
+                await self._wait_for_workers(self.config.min_workers)
+                # Determine next epoch from the durable core state.
+                cstate = CoordinatedState(self.coordinators)
+                prev: Optional[DBCoreState] = await cstate.read()
+                epoch = (prev.epoch + 1) if prev is not None else 1
+                worker = self._pick_master_worker()
+                self.db_info = ServerDBInfo(epoch=epoch,
+                                            recovery_state="recruiting")
+                self.db_info_version += 1
+                TraceEvent("CCRecruitMaster").detail("Epoch", epoch).detail(
+                    "Worker", worker.id).log()
+                miface = await RequestStream.at(
+                    worker.init_master.endpoint).get_reply(
+                    InitializeMasterRequest(epoch=epoch,
+                                            cc=self.interface))
+                # Wait for the master to die (recovery failure or process
+                # death) — then recruit a replacement.
+                await RequestStream.at(
+                    miface.wait_failure.endpoint).get_reply(
+                    WaitFailureRequest())
+            except FdbError as e:
+                TraceEvent("CCMasterDied", Severity.Warn).detail(
+                    "Error", e.name).log()
+                await delay(0.1)
+
+    def register_streams(self, process) -> None:
+        """Register endpoints without serving: a candidate CC's endpoints
+        must exist before it wins so early worker registrations queue
+        instead of failing (they drain when start() runs)."""
+        if self._streams_registered:
+            return
+        self._streams_registered = True
+        for s in self.interface.streams():
+            process.register(s)
+
+    def run(self, process) -> None:
+        self._process = process
+        self.register_streams(process)
+        self._spawn(self._serve_register_worker(), f"{self.id}.regWorker")
+        self._spawn(self._serve_get_workers(), f"{self.id}.getWorkers")
+        self._spawn(self._serve_get_db_info(), f"{self.id}.getDbInfo")
+        self._spawn(self._serve_open_database(), f"{self.id}.openDb")
+        self._spawn(self._serve_master_registration(), f"{self.id}.masterReg")
+        self._spawn(self._cluster_watch_database(), f"{self.id}.watchDb")
+        # On restart after a deposition, resume monitoring known workers.
+        for wid, (iface, _cls) in list(self.workers.items()):
+            self._spawn(self._monitor_worker(wid, iface),
+                        f"{self.id}.monitorWorker")
+        TraceEvent("ClusterControllerStarted").detail("Id", self.id).log()
+
+    def halt(self) -> None:
+        """Stop serving and recruiting (deposed: another CC won).  The
+        durable coordinated state fences our master's epoch; our in-memory
+        registry is kept for a potential re-election."""
+        TraceEvent("ClusterControllerHalted", Severity.Warn).detail(
+            "Id", self.id).log()
+        actors, self._actors = self._actors, []
+        for a in actors:
+            if not a.is_ready():
+                a.cancel()

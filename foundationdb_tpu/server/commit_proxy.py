@@ -36,17 +36,21 @@ from .shardmap import RangeMap
 
 
 class LogSystemClient:
-    """Client half of the tag-partitioned log system: push a version's
-    messages to every TLog and wait for durability (reference
-    ILogSystem::push, TagPartitionedLogSystem.actor.cpp).  Tags are
-    partitioned over TLogs by tag index; every TLog sees every version so
-    its version chain stays contiguous."""
+    """Client half of the tag-partitioned log system (reference
+    ILogSystem::push, TagPartitionedLogSystem.actor.cpp).  Each tag's
+    messages go to a team of `replication` TLogs; every TLog sees every
+    version (possibly with no messages) so its version chain stays
+    contiguous, and a push is durable only when ALL TLogs ack — which is
+    why one dead TLog stalls commits until recovery, exactly as in the
+    reference."""
 
-    def __init__(self, tlogs: List[Any]) -> None:
+    def __init__(self, tlogs: List[Any], replication: int = 1) -> None:
         self.tlogs = tlogs  # TLogInterface list
+        self.replication = max(1, min(replication, len(tlogs)))
 
-    def tlog_for_tag(self, tag: Tag) -> int:
-        return tag % len(self.tlogs)
+    def team_for_tag(self, tag: Tag) -> List[int]:
+        n = len(self.tlogs)
+        return [(tag + j) % n for j in range(self.replication)]
 
     def push(self, prev_version: Version, version: Version,
              known_committed_version: Version,
@@ -54,7 +58,8 @@ class LogSystemClient:
         per_log: List[Dict[Tag, List[Mutation]]] = [
             {} for _ in self.tlogs]
         for tag, msgs in messages.items():
-            per_log[self.tlog_for_tag(tag)][tag] = msgs
+            for i in self.team_for_tag(tag):
+                per_log[i][tag] = msgs
         replies = []
         for tlog, msgs in zip(self.tlogs, per_log):
             replies.append(tlog.commit.get_reply(TLogCommitRequest(
@@ -65,8 +70,24 @@ class LogSystemClient:
 
     def pop(self, tag: Tag, to: Version) -> None:
         from .interfaces import TLogPopRequest
-        self.tlogs[self.tlog_for_tag(tag)].pop.send(
-            TLogPopRequest(tag=tag, to=to, reply=False))
+        for i in self.team_for_tag(tag):
+            self.tlogs[i].pop.send(TLogPopRequest(tag=tag, to=to,
+                                                  reply=False))
+
+    async def peek_tag(self, tag: Tag, begin: Version):
+        """Peek one team member, failing over to replicas on dead TLogs
+        (reference peek cursor's best-server selection)."""
+        from ..core.error import FdbError
+        from .interfaces import TLogPeekRequest
+        last_err = None
+        for i in self.team_for_tag(tag):
+            try:
+                return await RequestStream.at(
+                    self.tlogs[i].peek.endpoint).get_reply(
+                    TLogPeekRequest(tag=tag, begin=begin))
+            except FdbError as e:
+                last_err = e
+        raise last_err
 
 
 class CommitProxy:
@@ -323,4 +344,7 @@ class CommitProxy:
             process.register(s)
         process.spawn(self._commit_batcher(), f"{self.id}.batcher")
         process.spawn(self._serve_locations(), f"{self.id}.locations")
+        from .failure import hold_wait_failure
+        process.spawn(hold_wait_failure(self.interface.wait_failure),
+                      f"{self.id}.waitFailure")
         TraceEvent("CommitProxyStarted").detail("Id", self.id).log()

@@ -1,0 +1,464 @@
+"""Coordinators: generation registers, leader election, coordinated state.
+
+Reference: fdbserver/Coordination.actor.cpp — each coordinator hosts a
+disk-backed *generation register* (localGenerationReg :106, interface
+GenerationRegInterface fdbserver/CoordinationInterface.h:40) and a *leader
+election register* (LeaderElectionRegInterface :132); coordinationServer
+(:722) serves both.  CoordinatedState (fdbserver/CoordinatedState.actor.cpp)
+layers a Paxos-like two-phase read/write of the DBCoreState over a majority
+quorum of generation registers; tryBecomeLeader (fdbserver/LeaderElection.h
+:40) elects the cluster controller.
+
+Generation-register protocol (single-decree, per key):
+  read(key, gen):  rgen := max(rgen, gen); reply (value, vgen, old_rgen)
+  write(key, kv, gen): accept iff gen >= rgen and gen > wgen;
+                       then (value, wgen) := (kv, gen), rgen := max(rgen, gen)
+A quorum read with a fresh unique gen followed by a quorum write at that
+same gen is linearizable: any later reader's quorum intersects ours.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.futures import Future, Promise, wait_all, wait_any
+from ..core.rng import deterministic_random
+from ..core.scheduler import TaskPriority, delay, spawn
+from ..core.trace import Severity, TraceEvent
+from ..rpc.endpoint import RequestStream
+
+
+# ---------------------------------------------------------------------------
+# Generation numbers: (battle counter, unique id) ordered lexicographically
+# (reference UniqueGeneration, CoordinationInterface.h:65)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True, order=True)
+class Generation:
+    battle: int = 0
+    uid: int = 0
+
+
+@dataclass
+class GenRegReadRequest:
+    key: bytes
+    gen: Generation
+    reply: Any = None
+
+
+@dataclass
+class GenRegReadReply:
+    value: Optional[bytes]
+    vgen: Generation     # generation that wrote `value`
+    rgen: Generation     # highest read generation seen (before this read)
+
+
+@dataclass
+class GenRegWriteRequest:
+    key: bytes
+    value: bytes
+    gen: Generation
+    reply: Any = None
+
+
+@dataclass
+class GenRegWriteReply:
+    gen: Generation      # the register's wgen after the attempt
+
+
+@dataclass
+class CandidacyRequest:
+    """Announce candidacy; replies when the known leader changes.
+    Reference CoordinationInterface.h:133 CandidacyRequest."""
+
+    key: bytes
+    my_info: "LeaderInfo"
+    known_leader_change_id: int
+    reply: Any = None
+
+
+@dataclass
+class LeaderHeartbeatRequest:
+    key: bytes
+    my_info: "LeaderInfo"
+    reply: Any = None
+
+
+@dataclass
+class LeaderGetRequest:
+    """Observe the nominee without becoming a candidate (the client side of
+    MonitorLeader; reference ElectionResultRequest / OpenDatabaseCoordRequest).
+    Replies when the nominee differs from known_leader_change_id."""
+
+    key: bytes
+    known_leader_change_id: int = -1
+    reply: Any = None
+
+
+@dataclass
+class LeaderInfo:
+    """A candidate/leader record (reference LeaderInfo: changeID orders
+    candidates; lower id wins — we use (priority, id))."""
+
+    change_id: int
+    serialized_info: Any = None      # the would-be CC's interface
+    forward: bool = False
+
+
+class CoordinationServer:
+    """One coordinator: generation registers + leader election state."""
+
+    def __init__(self, server_id: str = "coord") -> None:
+        self.id = server_id
+        # Generation register state per key.
+        self._reg: Dict[bytes, Tuple[Optional[bytes], Generation, Generation]] = {}
+        # Leader election per key: current nominee + waiting candidates.
+        self._nominee: Dict[bytes, Optional[LeaderInfo]] = {}
+        self._nominee_waiters: Dict[bytes, List[Promise]] = {}
+        self._candidates: Dict[bytes, Dict[int, LeaderInfo]] = {}
+        self._last_heartbeat: Dict[bytes, float] = {}
+        self.reg_read = RequestStream("coord.read", TaskPriority.Coordination)
+        self.reg_write = RequestStream("coord.write", TaskPriority.Coordination)
+        self.candidacy = RequestStream("coord.candidacy",
+                                       TaskPriority.Coordination)
+        self.heartbeat = RequestStream("coord.heartbeat",
+                                       TaskPriority.Coordination)
+        self.leader_get = RequestStream("coord.leaderGet",
+                                        TaskPriority.Coordination)
+
+    # -- generation register -------------------------------------------------
+    async def _serve_reads(self) -> None:
+        async for req in self.reg_read.queue:
+            value, vgen, rgen = self._reg.get(
+                req.key, (None, Generation(), Generation()))
+            new_rgen = max(rgen, req.gen)
+            self._reg[req.key] = (value, vgen, new_rgen)
+            req.reply.send(GenRegReadReply(value=value, vgen=vgen, rgen=rgen))
+
+    async def _serve_writes(self) -> None:
+        async for req in self.reg_write.queue:
+            value, vgen, rgen = self._reg.get(
+                req.key, (None, Generation(), Generation()))
+            if req.gen >= rgen and req.gen > vgen:
+                self._reg[req.key] = (req.value, req.gen,
+                                      max(rgen, req.gen))
+                req.reply.send(GenRegWriteReply(gen=req.gen))
+            else:
+                # Reject: reply with the winning generation so the caller
+                # knows it lost (reference replies wgen on both paths).
+                req.reply.send(GenRegWriteReply(gen=max(vgen, rgen)))
+
+    # -- leader election -----------------------------------------------------
+    def _best_candidate(self, key: bytes) -> Optional[LeaderInfo]:
+        cands = self._candidates.get(key, {})
+        if not cands:
+            return None
+        return min(cands.values(), key=lambda c: c.change_id)
+
+    def _set_nominee(self, key: bytes, nominee: Optional[LeaderInfo]) -> None:
+        from ..core.scheduler import now
+        cur = self._nominee.get(key)
+        cur_id = cur.change_id if cur else -1
+        new_id = nominee.change_id if nominee else -1
+        if cur_id == new_id:
+            return
+        self._nominee[key] = nominee
+        # Grace period: a fresh nominee gets a full heartbeat interval
+        # before the expiry loop may evict it.
+        self._last_heartbeat[key] = now()
+        waiters = self._nominee_waiters.pop(key, [])
+        for p in waiters:
+            p.send(nominee)
+
+    async def _serve_candidacy(self) -> None:
+        async for req in self.candidacy.queue:
+            spawn(self._handle_candidacy(req), f"{self.id}.candidacy")
+
+    async def _handle_candidacy(self, req: CandidacyRequest) -> None:
+        self._candidates.setdefault(req.key, {})[
+            req.my_info.change_id] = req.my_info
+        self._maybe_renominate(req.key)
+        nominee = self._nominee.get(req.key)
+        if nominee is not None and \
+                nominee.change_id != req.known_leader_change_id:
+            req.reply.send(nominee)
+            return
+        p: Promise = Promise()
+        self._nominee_waiters.setdefault(req.key, []).append(p)
+        req.reply.send(await p.get_future())
+
+    def _maybe_renominate(self, key: bytes) -> None:
+        from ..core.scheduler import now
+        cur = self._nominee.get(key)
+        best = self._best_candidate(key)
+        stale = (cur is not None and
+                 now() - self._last_heartbeat.get(key, 0.0) > 2.0)
+        if cur is None or stale or (
+                best is not None and best.change_id < cur.change_id):
+            self._set_nominee(key, best)
+
+    async def _serve_leader_get(self) -> None:
+        async for req in self.leader_get.queue:
+            spawn(self._handle_leader_get(req), f"{self.id}.leaderGet")
+
+    async def _handle_leader_get(self, req: LeaderGetRequest) -> None:
+        nominee = self._nominee.get(req.key)
+        if nominee is not None and \
+                nominee.change_id != req.known_leader_change_id:
+            req.reply.send(nominee)
+            return
+        p: Promise = Promise()
+        self._nominee_waiters.setdefault(req.key, []).append(p)
+        req.reply.send(await p.get_future())
+
+    async def _serve_heartbeat(self) -> None:
+        from ..core.scheduler import now
+        async for req in self.heartbeat.queue:
+            cur = self._nominee.get(req.key)
+            if cur is not None and cur.change_id == req.my_info.change_id:
+                self._last_heartbeat[req.key] = now()
+                req.reply.send(True)
+            else:
+                req.reply.send(False)    # deposed: stop being leader
+
+    async def _expiry_loop(self) -> None:
+        """Drop dead leaders whose heartbeats stopped (reference
+        leaderRegister's timeout logic)."""
+        from ..core.scheduler import now
+        while True:
+            await delay(1.0)
+            for key in list(self._nominee):
+                cur = self._nominee.get(key)
+                if cur is None:
+                    continue
+                if now() - self._last_heartbeat.get(key, 0.0) > 2.0:
+                    self._candidates.get(key, {}).pop(cur.change_id, None)
+                    self._set_nominee(key, self._best_candidate(key))
+
+    def streams(self) -> List[RequestStream]:
+        return [self.reg_read, self.reg_write, self.candidacy,
+                self.heartbeat, self.leader_get]
+
+    def run(self, process) -> None:
+        for s in self.streams():
+            process.register(s)
+        process.spawn(self._serve_reads(), f"{self.id}.reads")
+        process.spawn(self._serve_writes(), f"{self.id}.writes")
+        process.spawn(self._serve_candidacy(), f"{self.id}.candidacy")
+        process.spawn(self._serve_leader_get(), f"{self.id}.leaderGet")
+        process.spawn(self._serve_heartbeat(), f"{self.id}.heartbeat")
+        process.spawn(self._expiry_loop(), f"{self.id}.expiry")
+
+
+class CoordinationClientInterface:
+    """Client handle to one coordinator's streams."""
+
+    def __init__(self, server: CoordinationServer) -> None:
+        self.reg_read = server.reg_read.endpoint
+        self.reg_write = server.reg_write.endpoint
+        self.candidacy = server.candidacy.endpoint
+        self.heartbeat = server.heartbeat.endpoint
+        self.leader_get = server.leader_get.endpoint
+
+
+# ---------------------------------------------------------------------------
+# CoordinatedState: quorum read/write over the generation registers
+# (reference fdbserver/CoordinatedState.actor.cpp)
+# ---------------------------------------------------------------------------
+
+CSTATE_KEY = b"dbCoreState"
+
+
+class CoordinatedState:
+    """Two-phase quorum state machine over the coordinators."""
+
+    def __init__(self, coordinators: List[CoordinationClientInterface]) -> None:
+        self.coordinators = coordinators
+        self._gen: Optional[Generation] = None
+        self._battle = 0
+
+    @property
+    def _quorum(self) -> int:
+        return len(self.coordinators) // 2 + 1
+
+    async def _quorum_replies(self, futures: List[Future]) -> List[Any]:
+        """Wait for a majority of successful replies; error if impossible."""
+        from ..core.error import err
+        replies: List[Any] = []
+        failed = 0
+        pending = {i: f for i, f in enumerate(futures)}
+        while len(replies) < self._quorum:
+            if failed > len(futures) - self._quorum:
+                raise err("timed_out", "coordination quorum unreachable")
+            idx, _ = await wait_any([_swallow(f) for f in pending.values()])
+            key = list(pending.keys())[idx]
+            f = pending.pop(key)
+            if f.is_error():
+                failed += 1
+            else:
+                replies.append(f.get())
+        return replies
+
+    async def read(self) -> Optional[bytes]:
+        """Phase 1: quorum read; returns the value with the highest write
+        generation (the committed DBCoreState).  Retries with a strictly
+        higher battle number until our generation tops every rgen observed
+        at the quorum — classic Paxos prepare: a write at this generation
+        then only loses to a genuinely interleaving reader."""
+        while True:
+            self._battle += 1
+            gen = Generation(self._battle,
+                             deterministic_random().random_int(1, 1 << 30))
+            futures = [RequestStream.at(c.reg_read).get_reply(
+                GenRegReadRequest(key=CSTATE_KEY, gen=gen))
+                for c in self.coordinators]
+            replies = await self._quorum_replies(futures)
+            best: Optional[GenRegReadReply] = None
+            lost = False
+            for r in replies:
+                if best is None or r.vgen > best.vgen:
+                    best = r
+                # r.rgen is the register's high-water BEFORE our read: if
+                # it beats us, bump past it and try again.
+                if r.rgen >= gen:
+                    lost = True
+                self._battle = max(self._battle, r.rgen.battle)
+            if lost:
+                continue
+            self._gen = gen
+            return best.value if best else None
+
+    async def write(self, value: bytes) -> None:
+        """Phase 2: quorum write at the read generation.  Raises
+        coordinated_state_conflict if another writer won the race."""
+        from ..core.error import err
+        assert self._gen is not None, "read() before write()"
+        gen = self._gen
+        futures = [RequestStream.at(c.reg_write).get_reply(
+            GenRegWriteRequest(key=CSTATE_KEY, value=value, gen=gen))
+            for c in self.coordinators]
+        replies = await self._quorum_replies(futures)
+        if any(r.gen != gen for r in replies):
+            raise err("coordinated_state_conflict",
+                      "another process wrote the coordinated state")
+
+
+from ..core.futures import swallow as _swallow  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Leader election client (reference fdbserver/LeaderElection.h:40)
+# ---------------------------------------------------------------------------
+
+LEADER_KEY = b"clusterLeader"
+
+
+async def try_become_leader(coordinators: List[CoordinationClientInterface],
+                            my_info_payload: Any,
+                            out_current_leader,  # AsyncVar[LeaderInfo|None]
+                            change_id: Optional[int] = None) -> None:
+    """Campaign forever: register candidacy with every coordinator; whoever
+    a majority nominates is leader.  If WE are leader, heartbeat until
+    deposed; `out_current_leader` tracks the majority leader for observers.
+    Runs until cancelled."""
+    my_info = LeaderInfo(
+        change_id=(change_id if change_id is not None
+                   else deterministic_random().random_int(0, 1 << 30)),
+        serialized_info=my_info_payload)
+    known_change_id = -1
+    while True:
+        # One round: ask every coordinator who it nominates (given what we
+        # know); majority agreement on a change_id elects that candidate.
+        futures = [RequestStream.at(c.candidacy).get_reply(
+            CandidacyRequest(key=LEADER_KEY, my_info=my_info,
+                             known_leader_change_id=known_change_id))
+            for c in coordinators]
+        votes: Dict[int, int] = {}
+        infos: Dict[int, LeaderInfo] = {}
+        quorum = len(coordinators) // 2 + 1
+        pending = list(futures)
+        elected: Optional[LeaderInfo] = None
+        while pending and elected is None:
+            idx, _ = await wait_any([_swallow(f) for f in pending])
+            f = pending.pop(idx)
+            if f.is_error():
+                continue
+            nominee = f.get()
+            if nominee is None:
+                continue
+            votes[nominee.change_id] = votes.get(nominee.change_id, 0) + 1
+            infos[nominee.change_id] = nominee
+            if votes[nominee.change_id] >= quorum:
+                elected = nominee
+        if elected is None:
+            await delay(0.5)
+            continue
+        known_change_id = elected.change_id
+        out_current_leader.set(elected)
+        if elected.change_id == my_info.change_id:
+            TraceEvent("BecameLeader").detail("ChangeId",
+                                              my_info.change_id).log()
+            await _lead(coordinators, my_info)
+            # Deposed: campaign again.
+            TraceEvent("LeaderDeposed", Severity.Warn).detail(
+                "ChangeId", my_info.change_id).log()
+            out_current_leader.set(None)
+
+
+async def monitor_leader(coordinators: List[CoordinationClientInterface],
+                         out_leader) -> None:
+    """Track the elected leader without campaigning (reference
+    MonitorLeader): `out_leader` (AsyncVar) follows majority nominations.
+    Runs until cancelled."""
+    known_change_id = -1
+    quorum = len(coordinators) // 2 + 1
+    while True:
+        futures = [RequestStream.at(c.leader_get).get_reply(
+            LeaderGetRequest(key=LEADER_KEY,
+                             known_leader_change_id=known_change_id))
+            for c in coordinators]
+        votes: Dict[int, int] = {}
+        infos: Dict[int, LeaderInfo] = {}
+        pending = list(futures)
+        elected: Optional[LeaderInfo] = None
+        failed = 0
+        while pending and elected is None:
+            if failed > len(coordinators) - quorum:
+                break
+            idx, _ = await wait_any([_swallow(f) for f in pending])
+            f = pending.pop(idx)
+            if f.is_error():
+                failed += 1
+                continue
+            nominee = f.get()
+            if nominee is None:
+                continue
+            votes[nominee.change_id] = votes.get(nominee.change_id, 0) + 1
+            infos[nominee.change_id] = nominee
+            if votes[nominee.change_id] >= quorum:
+                elected = nominee
+        if elected is not None and elected.change_id != known_change_id:
+            known_change_id = elected.change_id
+            out_leader.set(elected)
+        elif elected is None:
+            await delay(0.5)
+
+
+async def _lead(coordinators: List[CoordinationClientInterface],
+                my_info: LeaderInfo) -> None:
+    """Heartbeat a majority every 0.5s; return when deposed."""
+    while True:
+        futures = [RequestStream.at(c.heartbeat).get_reply(
+            LeaderHeartbeatRequest(key=LEADER_KEY, my_info=my_info))
+            for c in coordinators]
+        acks = 0
+        pending = list(futures)
+        while pending:
+            idx, _ = await wait_any([_swallow(f) for f in pending])
+            f = pending.pop(idx)
+            if not f.is_error() and f.get() is True:
+                acks += 1
+        if acks < len(coordinators) // 2 + 1:
+            return
+        await delay(0.5)

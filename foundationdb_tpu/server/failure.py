@@ -1,0 +1,34 @@
+"""waitFailure servers/clients (reference fdbserver/WaitFailure.actor.cpp).
+
+A role registers a wait_failure stream and holds every request forever;
+when the hosting process dies (or the holder actor is cancelled), the held
+ReplyPromises break — delivering broken_promise to watchers.  The watcher
+side simply get_reply's and treats any error as "the role failed".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class WaitFailureRequest:
+    reply: Any = None
+
+
+async def hold_wait_failure(stream) -> None:
+    held = []
+    async for req in stream.queue:
+        held.append(req)
+
+
+async def wait_failure_of(interface) -> None:
+    """Resolves (normally) when the role behind `interface` fails."""
+    from ..core.error import FdbError
+    from ..rpc.endpoint import RequestStream
+    try:
+        await RequestStream.at(interface.wait_failure.endpoint).get_reply(
+            WaitFailureRequest())
+    except FdbError:
+        return

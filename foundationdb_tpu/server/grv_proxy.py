@@ -100,4 +100,7 @@ class GrvProxy:
             process.register(s)
         process.spawn(self._queue_requests(), f"{self.id}.queue")
         process.spawn(self._transaction_starter(), f"{self.id}.starter")
+        from .failure import hold_wait_failure
+        process.spawn(hold_wait_failure(self.interface.wait_failure),
+                      f"{self.id}.waitFailure")
         TraceEvent("GrvProxyStarted").detail("Id", self.id).log()

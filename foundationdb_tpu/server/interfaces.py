@@ -90,10 +90,28 @@ class MasterInterface:
         self.get_live_committed_version = RequestStream(
             "master.getLiveCommittedVersion",
             TaskPriority.ProxyGetRawCommittedVersion)
+        self.wait_failure = RequestStream(
+            "master.waitFailure", TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
         return [self.get_commit_version, self.report_live_committed_version,
-                self.get_live_committed_version]
+                self.get_live_committed_version, self.wait_failure]
+
+
+@dataclass
+class DatabaseConfiguration:
+    """Role counts + replication (reference
+    fdbclient/DatabaseConfiguration.h)."""
+
+    n_tlogs: int = 1
+    n_commit_proxies: int = 1
+    n_grv_proxies: int = 1
+    n_resolvers: int = 1
+    n_storage: int = 2
+    log_replication: int = 1
+    storage_replication: int = 1
+    conflict_backend: Optional[str] = None
+    min_workers: int = 1
 
 
 # ---------------------------------------------------------------------------
@@ -121,9 +139,11 @@ class ResolverInterface:
         self.id = resolver_id
         self.resolve = RequestStream(
             "resolver.resolve", TaskPriority.ProxyResolverReply)
+        self.wait_failure = RequestStream("resolver.waitFailure",
+                                          TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
-        return [self.resolve]
+        return [self.resolve, self.wait_failure]
 
 
 # ---------------------------------------------------------------------------
@@ -166,9 +186,12 @@ class CommitProxyInterface:
         self.commit = RequestStream("proxy.commit", TaskPriority.ProxyCommit)
         self.get_key_servers_locations = RequestStream(
             "proxy.getKeyServersLocations", TaskPriority.DefaultPromiseEndpoint)
+        self.wait_failure = RequestStream("proxy.waitFailure",
+                                          TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
-        return [self.commit, self.get_key_servers_locations]
+        return [self.commit, self.get_key_servers_locations,
+                self.wait_failure]
 
 
 # ---------------------------------------------------------------------------
@@ -199,9 +222,11 @@ class GrvProxyInterface:
         self.get_consistent_read_version = RequestStream(
             "grvproxy.getConsistentReadVersion",
             TaskPriority.GetConsistentReadVersion)
+        self.wait_failure = RequestStream("grvproxy.waitFailure",
+                                          TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
-        return [self.get_consistent_read_version]
+        return [self.get_consistent_read_version, self.wait_failure]
 
 
 # ---------------------------------------------------------------------------
@@ -245,6 +270,22 @@ class TLogConfirmRunningRequest:
     reply: Any = None
 
 
+@dataclass
+class TLogLockRequest:
+    """Master -> old-generation TLog at epoch end: stop accepting commits
+    and report state (reference TLogInterface lock / epoch end)."""
+
+    epoch: int
+    reply: Any = None
+
+
+@dataclass
+class TLogLockReply:
+    end_version: Version            # highest appended version
+    known_committed_version: Version
+    tags: Dict[Tag, Version]        # tag -> popped-through version
+
+
 class TLogInterface:
     def __init__(self, tlog_id: str = "") -> None:
         self.id = tlog_id
@@ -253,9 +294,13 @@ class TLogInterface:
         self.pop = RequestStream("tlog.pop", TaskPriority.TLogPop)
         self.confirm_running = RequestStream(
             "tlog.confirmRunning", TaskPriority.TLogConfirmRunning)
+        self.lock = RequestStream("tlog.lock", TaskPriority.TLogCommit)
+        self.wait_failure = RequestStream("tlog.waitFailure",
+                                          TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
-        return [self.commit, self.peek, self.pop, self.confirm_running]
+        return [self.commit, self.peek, self.pop, self.confirm_running,
+                self.lock, self.wait_failure]
 
 
 # ---------------------------------------------------------------------------
@@ -305,6 +350,170 @@ class WatchValueRequest:
 @dataclass
 class WatchValueReply:
     version: Version
+
+
+# ---------------------------------------------------------------------------
+# Worker / cluster controller / recruitment
+# (reference fdbserver/WorkerInterface.actor.h Initialize*Request,
+#  fdbserver/ClusterController.actor.cpp RegisterWorkerRequest,
+#  fdbserver/ServerDBInfo.h ServerDBInfo, fdbclient ClientDBInfo)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ServerDBInfo:
+    """Cluster-wide role directory broadcast by the cluster controller."""
+
+    epoch: int = 0                       # master generation (recoveryCount)
+    recovery_state: str = "unrecruited"  # see Master recovery states
+    recovery_version: Version = 0
+    master: Any = None                   # MasterInterface
+    grv_proxies: List[Any] = field(default_factory=list)
+    commit_proxies: List[Any] = field(default_factory=list)
+    resolvers: List[Any] = field(default_factory=list)
+    tlogs: List[Any] = field(default_factory=list)
+    storage_servers: Dict[Tag, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ClientDBInfo:
+    """What clients need (reference fdbclient ClientDBInfo)."""
+
+    epoch: int = 0
+    grv_proxies: List[Any] = field(default_factory=list)
+    commit_proxies: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class RegisterWorkerRequest:
+    worker: "WorkerInterface"
+    process_class: str = "unset"
+    reply: Any = None
+
+
+@dataclass
+class GetWorkersRequest:
+    reply: Any = None
+
+
+@dataclass
+class OpenDatabaseRequest:
+    """Client -> CC: get (and watch) the ClientDBInfo."""
+
+    known_epoch: int = -1
+    reply: Any = None
+
+
+@dataclass
+class InitializeMasterRequest:
+    epoch: int
+    cc: Any = None        # ClusterControllerInterface (for registration)
+    reply: Any = None     # -> MasterInterface
+
+
+@dataclass
+class InitializeTLogRequest:
+    tlog_id: str
+    recovery_version: Version
+    # tag -> old-generation TLogInterface holding that tag's data (for
+    # generation handoff); empty on cold start.
+    recover_tags: Dict[Tag, Any] = field(default_factory=dict)
+    recover_popped: Dict[Tag, Version] = field(default_factory=dict)
+    epoch: int = 0
+    reply: Any = None     # -> TLogInterface
+
+
+@dataclass
+class InitializeCommitProxyRequest:
+    proxy_id: str
+    epoch: int
+    master: Any
+    resolvers: List[Any]
+    tlogs: List[Any]
+    key_resolvers_ranges: List[Tuple[bytes, bytes, int]]
+    key_servers_ranges: List[Tuple[bytes, bytes, List[Tag]]]
+    storage_interfaces: Dict[Tag, Any]
+    recovery_version: Version
+    reply: Any = None     # -> CommitProxyInterface
+
+
+@dataclass
+class InitializeGrvProxyRequest:
+    proxy_id: str
+    epoch: int
+    master: Any
+    tlogs: List[Any]
+    reply: Any = None     # -> GrvProxyInterface
+
+
+@dataclass
+class InitializeResolverRequest:
+    resolver_id: str
+    epoch: int
+    recovery_version: Version
+    reply: Any = None     # -> ResolverInterface
+
+
+@dataclass
+class InitializeStorageRequest:
+    ss_id: str
+    tag: Tag
+    reply: Any = None     # -> StorageServerInterface
+
+
+class WorkerInterface:
+    """Per-process recruitment surface (reference WorkerInterface)."""
+
+    def __init__(self, worker_id: str = "") -> None:
+        self.id = worker_id
+        self.init_master = RequestStream("worker.initMaster",
+                                         TaskPriority.DefaultEndpoint)
+        self.init_tlog = RequestStream("worker.initTLog",
+                                       TaskPriority.DefaultEndpoint)
+        self.init_commit_proxy = RequestStream("worker.initCommitProxy",
+                                               TaskPriority.DefaultEndpoint)
+        self.init_grv_proxy = RequestStream("worker.initGrvProxy",
+                                            TaskPriority.DefaultEndpoint)
+        self.init_resolver = RequestStream("worker.initResolver",
+                                           TaskPriority.DefaultEndpoint)
+        self.init_storage = RequestStream("worker.initStorage",
+                                          TaskPriority.DefaultEndpoint)
+        self.wait_failure = RequestStream("worker.waitFailure",
+                                          TaskPriority.FailureMonitor)
+
+    def streams(self) -> List[RequestStream]:
+        return [self.init_master, self.init_tlog, self.init_commit_proxy,
+                self.init_grv_proxy, self.init_resolver, self.init_storage,
+                self.wait_failure]
+
+
+class ClusterControllerInterface:
+    def __init__(self, cc_id: str = "") -> None:
+        self.id = cc_id
+        self.register_worker = RequestStream(
+            "cc.registerWorker", TaskPriority.ClusterController)
+        self.get_workers = RequestStream(
+            "cc.getWorkers", TaskPriority.ClusterController)
+        self.open_database = RequestStream(
+            "cc.openDatabase", TaskPriority.ClusterController)
+        self.master_registration = RequestStream(
+            "cc.masterRegistration", TaskPriority.ClusterController)
+        self.get_server_db_info = RequestStream(
+            "cc.getServerDBInfo", TaskPriority.ClusterController)
+
+    def streams(self) -> List[RequestStream]:
+        return [self.register_worker, self.get_workers, self.open_database,
+                self.master_registration, self.get_server_db_info]
+
+
+@dataclass
+class MasterRegistrationRequest:
+    """Master -> CC: recovery progress + recruited role directory; CC folds
+    it into ServerDBInfo and rebroadcasts (reference
+    ClusterController clusterRegisterMaster)."""
+
+    epoch: int
+    db_info: ServerDBInfo
+    reply: Any = None
 
 
 class StorageServerInterface:

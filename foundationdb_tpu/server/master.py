@@ -1,24 +1,38 @@
-"""Master: commit-version allocator and live-committed-version registry.
+"""Master: commit-version allocator, live-committed-version registry, and
+the epoch recovery state machine.
 
 Reference: fdbserver/masterserver.actor.cpp — getVersion (:1126) allocates
 monotonic contiguous version windows at a rate of wall-clock x
 VERSIONS_PER_SECOND (gap-capped); serveLiveCommittedVersion (:1217) tracks
-the max fully-committed version for the GRV path.  The recovery state
-machine (masterCore :1670) lives in recovery.py; this module is the steady
-state ACCEPTING_COMMITS logic.
+the max fully-committed version for the GRV path; masterCore (:1670) runs
+recovery: READING_CSTATE -> LOCKING_CSTATE -> RECRUITING ->
+WRITING_CSTATE -> ACCEPTING_COMMITS.  Differences from the reference,
+deliberate for the in-memory log path: the txn-state metadata (shard map,
+storage directory) rides in the coordinated DBCoreState instead of being
+replayed from the txsTag log stream, and the first recovery transaction is
+subsumed by that write.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
 
+from ..core.error import FdbError, err
 from ..core.futures import Future, Promise
 from ..core.knobs import server_knobs
 from ..core.scheduler import now, spawn
-from ..core.trace import TraceEvent
+from ..core.trace import Severity, TraceEvent
+from ..rpc.endpoint import RequestStream
 from ..txn.types import INVALID_VERSION, Version
-from .interfaces import (GetCommitVersionReply, GetCommitVersionRequest,
-                         GetRawCommittedVersionReply, MasterInterface)
+from .interfaces import (DatabaseConfiguration, GetCommitVersionReply,
+                         GetCommitVersionRequest,
+                         GetRawCommittedVersionReply,
+                         InitializeCommitProxyRequest,
+                         InitializeGrvProxyRequest, InitializeResolverRequest,
+                         InitializeStorageRequest, InitializeTLogRequest,
+                         MasterInterface, MasterRegistrationRequest,
+                         ServerDBInfo, Tag, TLogLockRequest)
 
 
 class _ProxyVersionState:
@@ -128,6 +142,10 @@ class Master:
             req.reply.send(None)
 
     # -- lifecycle -----------------------------------------------------------
+    async def _serve_wait_failure(self) -> None:
+        from .failure import hold_wait_failure
+        await hold_wait_failure(self.interface.wait_failure)
+
     def run(self, process) -> None:
         """Register streams + start serving actors on `process`."""
         for s in self.interface.streams():
@@ -137,3 +155,249 @@ class Master:
         process.spawn(self._serve_report_committed(), "master.serveReport")
         TraceEvent("MasterStarted").detail("Epoch", self.epoch).detail(
             "RecoveryVersion", self.version).log()
+
+
+# ---------------------------------------------------------------------------
+# DBCoreState: what survives between epochs on the coordinators
+# (reference fdbserver/DBCoreState.h — ours also carries the txn-state
+# metadata; see module docstring)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DBCoreState:
+    epoch: int
+    recovery_version: Version
+    tlogs: List[Any] = field(default_factory=list)        # TLogInterface
+    log_replication: int = 1
+    storage_servers: Dict[Tag, Any] = field(default_factory=dict)
+    key_servers_ranges: List[Tuple[bytes, bytes, List[Tag]]] = \
+        field(default_factory=list)
+    n_resolvers: int = 1
+
+
+def _split_points(n: int) -> List[bytes]:
+    return [bytes([(256 * i) // n]) for i in range(1, n)]
+
+
+def _key_resolver_ranges(n_resolvers: int
+                         ) -> List[Tuple[bytes, bytes, int]]:
+    bounds = [b""] + _split_points(n_resolvers) + [b"\xff\xff"]
+    return [(bounds[i], bounds[i + 1], i) for i in range(n_resolvers)]
+
+
+# ---------------------------------------------------------------------------
+# The recovery state machine (reference masterCore :1670)
+# ---------------------------------------------------------------------------
+
+async def master_server(master: Master, process, coordinators,
+                        config: DatabaseConfiguration, cc_interface) -> None:
+    """One master epoch: recover, recruit, then serve until death."""
+    from .commit_proxy import LogSystemClient
+    from .coordination import CoordinatedState
+
+    children: List[Future] = []
+
+    def adopt(coro, name: str) -> Future:
+        f = process.spawn(coro, name)
+        children.append(f)
+        return f
+
+    try:
+        for s in master.interface.streams():
+            process.register(s)
+        adopt(master._serve_wait_failure(), "master.waitFailure")
+
+        # READING_CSTATE (:1678)
+        TraceEvent("MasterRecoveryState").detail("State",
+                                                 "reading_cstate").log()
+        cstate = CoordinatedState(coordinators)
+        prev: Optional[DBCoreState] = await cstate.read()
+
+        # LOCKING_CSTATE: lock the previous TLog generation (epoch end).
+        old_tag_holders: Dict[Tag, Any] = {}
+        old_popped: Dict[Tag, Version] = {}
+        recovery_version: Version = 0
+        if prev is not None:
+            TraceEvent("MasterRecoveryState").detail(
+                "State", "locking_cstate").detail("PrevEpoch",
+                                                  prev.epoch).log()
+            old_ls = LogSystemClient(prev.tlogs, prev.log_replication)
+            # Lock every old TLog in parallel: dead ones cost ONE failure
+            # delay total, not one each (reference locks concurrently).
+            from ..core.futures import swallow, wait_all
+            lock_futures = [RequestStream.at(t.lock.endpoint).get_reply(
+                TLogLockRequest(epoch=master.epoch)) for t in prev.tlogs]
+            await wait_all([swallow(f) for f in lock_futures])
+            locked: Dict[int, Any] = {
+                i: f.get() for i, f in enumerate(lock_futures)
+                if not f.is_error()}
+            if not locked:
+                raise err("master_recovery_failed", "no old TLogs reachable")
+            # Every tag needs a live holder; any team member suffices.
+            all_tags = set(prev.storage_servers.keys())
+            for tag in all_tags:
+                holder = next((i for i in old_ls.team_for_tag(tag)
+                               if i in locked), None)
+                if holder is None:
+                    raise err("master_recovery_failed",
+                              f"tag {tag} has no surviving TLog holder")
+                old_tag_holders[tag] = prev.tlogs[holder]
+                old_popped[tag] = locked[holder].tags.get(tag, 0)
+            # Every client-visible commit was acked by ALL old TLogs, so
+            # the min over locked end-versions is >= every visible commit.
+            recovery_version = min(r.end_version for r in locked.values())
+
+        master.version = recovery_version
+        master.last_epoch_end = recovery_version
+        master.live_committed_version = recovery_version
+
+        # RECRUITING (:1741): place roles on registered workers.
+        TraceEvent("MasterRecoveryState").detail(
+            "State", "recruiting").detail(
+            "RecoveryVersion", recovery_version).log()
+        from .interfaces import GetWorkersRequest
+        workers = await RequestStream.at(
+            cc_interface.get_workers.endpoint).get_reply(
+            GetWorkersRequest())
+        if not workers:
+            raise err("master_recovery_failed", "no workers registered")
+        # Placement pools by process class (reference fitness-based
+        # placement, ClusterController getWorkerForRoleInDatacenter):
+        # transaction-system roles avoid storage-class workers so chaos on
+        # the txn system never destroys storage state.
+        stateless = sorted((iface for iface, cls in workers
+                            if cls in ("stateless", "unset")),
+                           key=lambda x: x.id)
+        storage_pool = sorted((iface for iface, cls in workers
+                               if cls in ("storage", "unset")),
+                              key=lambda x: x.id)
+        w = sorted((iface for iface, _cls in workers), key=lambda x: x.id)
+        stateless = stateless or w
+        storage_pool = storage_pool or w
+        # Spread recruited roles AWAY from the master's own worker: killing
+        # the master must never also take out the only TLog copy.
+        others = [x for x in stateless if x.id != process.name] or stateless
+
+        def pick(i: int):
+            return others[i % len(others)]
+
+        def pick_storage(i: int):
+            return storage_pool[i % len(storage_pool)]
+
+        # First wave, all in parallel: new TLog generation (recovering
+        # surviving tag data), resolvers, and (cold boot) storage.
+        from ..core.futures import wait_all as _wait_all
+        new_ls_teams = LogSystemClient(
+            [None] * config.n_tlogs, config.log_replication)
+        tlog_futures = []
+        for i in range(config.n_tlogs):
+            my_tags = {t: h for t, h in old_tag_holders.items()
+                       if i in new_ls_teams.team_for_tag(t)}
+            tlog_futures.append(RequestStream.at(
+                pick(i).init_tlog.endpoint).get_reply(
+                InitializeTLogRequest(
+                    tlog_id=f"log{i}.e{master.epoch}",
+                    recovery_version=recovery_version,
+                    recover_tags=my_tags,
+                    recover_popped={t: old_popped.get(t, 0)
+                                    for t in my_tags},
+                    epoch=master.epoch)))
+        resolver_futures = [RequestStream.at(
+            pick(i + 1).init_resolver.endpoint).get_reply(
+            InitializeResolverRequest(
+                resolver_id=f"resolver{i}.e{master.epoch}",
+                epoch=master.epoch, recovery_version=recovery_version))
+            for i in range(config.n_resolvers)]
+        if prev is not None:
+            # Storage is long-lived: reuse the existing directory.
+            storage_servers = dict(prev.storage_servers)
+            key_servers_ranges = list(prev.key_servers_ranges)
+            storage_futures = []
+        else:
+            storage_futures = [RequestStream.at(
+                pick_storage(i).init_storage.endpoint).get_reply(
+                InitializeStorageRequest(ss_id=f"ss{i}", tag=i))
+                for i in range(config.n_storage)]
+        tlogs = await _wait_all(tlog_futures)
+        resolvers = await _wait_all(resolver_futures)
+        if prev is None:
+            ssis = await _wait_all(storage_futures)
+            storage_servers = dict(enumerate(ssis))
+            bounds = [b""] + _split_points(config.n_storage) + [b"\xff\xff"]
+            key_servers_ranges = []
+            for i in range(config.n_storage):
+                team = [Tag((i + j) % config.n_storage)
+                        for j in range(config.storage_replication)]
+                key_servers_ranges.append((bounds[i], bounds[i + 1], team))
+
+        # Second wave: proxies (commit + GRV) against the new log system.
+        key_resolvers_ranges = _key_resolver_ranges(config.n_resolvers)
+        commit_proxy_futures = [RequestStream.at(
+            pick(i).init_commit_proxy.endpoint).get_reply(
+            InitializeCommitProxyRequest(
+                proxy_id=f"proxy{i}.e{master.epoch}",
+                epoch=master.epoch, master=master.interface,
+                resolvers=resolvers, tlogs=tlogs,
+                key_resolvers_ranges=key_resolvers_ranges,
+                key_servers_ranges=key_servers_ranges,
+                storage_interfaces=storage_servers,
+                recovery_version=recovery_version))
+            for i in range(config.n_commit_proxies)]
+        grv_proxy_futures = [RequestStream.at(
+            pick(i + 1).init_grv_proxy.endpoint).get_reply(
+            InitializeGrvProxyRequest(
+                proxy_id=f"grv{i}.e{master.epoch}",
+                epoch=master.epoch, master=master.interface, tlogs=tlogs))
+            for i in range(config.n_grv_proxies)]
+        commit_proxies = await _wait_all(commit_proxy_futures)
+        grv_proxies = await _wait_all(grv_proxy_futures)
+
+        # WRITING_CSTATE (:1908): make the new generation durable.  A
+        # conflict means another master won the race — die; CC retries.
+        TraceEvent("MasterRecoveryState").detail("State",
+                                                 "writing_cstate").log()
+        await cstate.write(DBCoreState(
+            epoch=master.epoch, recovery_version=recovery_version,
+            tlogs=tlogs, log_replication=config.log_replication,
+            storage_servers=storage_servers,
+            key_servers_ranges=key_servers_ranges,
+            n_resolvers=config.n_resolvers))
+
+        # ACCEPTING_COMMITS (:1943): start the allocator + announce.
+        adopt(master._serve_commit_versions(), "master.serveVersions")
+        adopt(master._serve_live_committed(), "master.serveLive")
+        adopt(master._serve_report_committed(), "master.serveReport")
+        db_info = ServerDBInfo(
+            epoch=master.epoch, recovery_state="accepting_commits",
+            recovery_version=recovery_version, master=master.interface,
+            grv_proxies=grv_proxies, commit_proxies=commit_proxies,
+            resolvers=resolvers, tlogs=tlogs,
+            storage_servers=storage_servers)
+        await RequestStream.at(
+            cc_interface.master_registration.endpoint).get_reply(
+            MasterRegistrationRequest(epoch=master.epoch, db_info=db_info))
+        TraceEvent("MasterRecoveryState").detail(
+            "State", "accepting_commits").detail(
+            "Epoch", master.epoch).log()
+
+        # Steady state: serve until killed, or until any recruited
+        # transaction-system role fails — either way the epoch ends and the
+        # CC recruits a successor (reference: master dies on tlog_failed /
+        # commit_proxy_failed / resolver_failed).
+        from ..core.futures import wait_any as _wait_any
+        from .failure import wait_failure_of
+        role_failures = [
+            spawn(wait_failure_of(x), "master.roleWatch")
+            for x in (tlogs + resolvers + commit_proxies + grv_proxies)]
+        children.extend(role_failures)
+        idx, _ = await _wait_any(role_failures)
+        TraceEvent("MasterTerminated", Severity.Warn).detail(
+            "Epoch", master.epoch).detail(
+            "Reason", "recruited role failed").detail("RoleIdx", idx).log()
+    except FdbError as e:
+        TraceEvent("MasterRecoveryFailed", Severity.Warn).detail(
+            "Epoch", master.epoch).detail("Error", e.name).log()
+    finally:
+        for c in children:
+            if not c.is_ready():
+                c.cancel()

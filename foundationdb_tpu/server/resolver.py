@@ -101,5 +101,8 @@ class Resolver:
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._serve(), f"{self.id}.serve")
+        from .failure import hold_wait_failure
+        process.spawn(hold_wait_failure(self.interface.wait_failure),
+                      f"{self.id}.waitFailure")
         TraceEvent("ResolverStarted").detail("Id", self.id).detail(
             "Backend", type(self.conflict_set).__name__).log()

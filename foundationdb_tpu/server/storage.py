@@ -105,6 +105,21 @@ class VersionedMap:
                 return out, True
         return out, False
 
+    def rollback(self, version: Version) -> None:
+        """Drop all entries newer than `version` (reference storageserver
+        rollback at recovery: un-durable versions beyond the new epoch's
+        recovery version are discarded).  Rare — only at epoch change."""
+        dead: List[bytes] = []
+        for key, c in self._chains.items():
+            while c and c[-1][0] > version:
+                c.pop()
+            if not c:
+                dead.append(key)
+        for key in dead:
+            del self._chains[key]
+            j = bisect.bisect_left(self._keys, key)
+            del self._keys[j]
+
     def forget_before(self, version: Version) -> None:
         """Drop history below `version`; keys whose only state is an old
         tombstone disappear entirely (reference forgetVersionsBefore).
@@ -147,6 +162,8 @@ class StorageServer:
         self._watches: Dict[bytes, list] = {}
         self.stats = {"reads": 0, "range_reads": 0, "mutations": 0,
                       "watches": 0}
+        self._process = None
+        self._pull_actor = None
 
     # -- mutation ingestion (reference update :3626) -------------------------
     def _apply(self, m: Mutation, version: Version) -> None:
@@ -170,12 +187,19 @@ class StorageServer:
 
     async def _pull_loop(self) -> None:
         """The update actor: a peek cursor over this server's tag."""
+        from ..core.error import FdbError
         knobs = server_knobs()
-        tlog = self.log_system.tlogs[self.log_system.tlog_for_tag(self.tag)]
         fetch_from = self.version.get() + 1
         while True:
-            reply = await RequestStream.at(tlog.peek.endpoint).get_reply(
-                TLogPeekRequest(tag=self.tag, begin=fetch_from))
+            if self.log_system is None:
+                await delay(0.5)
+                continue
+            try:
+                reply = await self.log_system.peek_tag(self.tag, fetch_from)
+            except FdbError:
+                # Whole team unreachable: wait for recovery to re-target us.
+                await delay(0.5)
+                continue
             new_version = self.version.get()
             for version, msgs in reply.messages:
                 assert version > self.version.get()
@@ -185,6 +209,10 @@ class StorageServer:
             # Advance past empty versions too: the TLog's version frontier
             # covers commits that had no mutations for our tag.
             new_version = max(new_version, reply.max_known_version)
+            if new_version <= self.version.get():
+                # No progress (e.g. peeking a locked old-generation TLog):
+                # back off until recovery re-targets us.
+                await delay(0.05)
             if new_version > self.version.get():
                 self.version.set(new_version)
                 self.oldest_version = max(
@@ -262,15 +290,33 @@ class StorageServer:
         except Exception as e:   # noqa: BLE001
             req.reply.send_error(e)
 
+    # -- epoch change (reference: SS rejoins the new log system) -------------
+    def set_log_system(self, log_system, recovery_version: Version) -> None:
+        """Re-target the pull cursor to a new TLog generation; data applied
+        beyond the new epoch's recovery version is rolled back (it was never
+        globally committed)."""
+        if self._pull_actor is not None and not self._pull_actor.is_ready():
+            self._pull_actor.cancel()
+        self.log_system = log_system
+        if self.version.get() > recovery_version:
+            self.data.rollback(recovery_version)
+            # NotifiedVersion cannot go backwards; recreate at the floor.
+            self.version = NotifiedVersion(recovery_version)
+            self.durable_version = NotifiedVersion(recovery_version)
+        if self._process is not None:
+            self._pull_actor = self._process.spawn(
+                self._pull_loop(), f"{self.id}.update")
+
     # -- serving -------------------------------------------------------------
     async def _serve(self, queue, handler) -> None:
         async for req in queue:
             spawn(handler(req), f"{self.id}.handler")
 
     def run(self, process) -> None:
+        self._process = process
         for s in self.interface.streams():
             process.register(s)
-        process.spawn(self._pull_loop(), f"{self.id}.update")
+        self._pull_actor = process.spawn(self._pull_loop(), f"{self.id}.update")
         process.spawn(self._serve(self.interface.get_value.queue,
                                   self._get_value), f"{self.id}.getValue")
         process.spawn(self._serve(self.interface.get_key_values.queue,

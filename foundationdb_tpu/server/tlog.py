@@ -15,11 +15,13 @@ from __future__ import annotations
 from collections import deque
 from typing import Deque, Dict, List, Tuple
 
+from ..core.futures import Promise
 from ..core.scheduler import delay, get_event_loop
 from ..core.trace import TraceEvent
 from ..txn.types import Mutation, Version
-from .interfaces import (Tag, TLogCommitRequest, TLogInterface, TLogPeekReply,
-                         TLogPeekRequest, TLogPopRequest)
+from .interfaces import (Tag, TLogCommitRequest, TLogInterface,
+                         TLogLockReply, TLogPeekReply, TLogPeekRequest,
+                         TLogPopRequest)
 from .notified import NotifiedVersion
 
 _SIM_FSYNC_SECONDS = 0.0005
@@ -27,8 +29,9 @@ _SIM_FSYNC_SECONDS = 0.0005
 
 class TLog:
     def __init__(self, tlog_id: str = "log0",
-                 recovery_version: Version = 0) -> None:
+                 recovery_version: Version = 0, epoch: int = 1) -> None:
         self.id = tlog_id
+        self.epoch = epoch
         self.version = NotifiedVersion(recovery_version)       # appended
         self.durable_version = NotifiedVersion(recovery_version)  # fsynced
         self.known_committed_version: Version = recovery_version
@@ -38,11 +41,54 @@ class TLog:
         self.poppedtags: Dict[Tag, Version] = {}
         self.bytes_input = 0
         self._sync_running = False
+        self.stopped = False   # locked at epoch end; rejects new commits
+        self._stop_promise: Promise = Promise()  # fires when locked
+
+    # -- generation handoff --------------------------------------------------
+    async def recover_from(self, recover_tags: Dict[Tag, object],
+                           recover_popped: Dict[Tag, Version],
+                           recovery_version: Version) -> None:
+        """Pull each assigned tag's surviving data (<= recovery_version)
+        from an old-generation holder before serving (reference: new TLogs
+        recover via peek cursors over the previous generation)."""
+        from ..rpc.endpoint import RequestStream
+        for tag, old_iface in recover_tags.items():
+            popped = recover_popped.get(tag, 0)
+            reply = await RequestStream.at(old_iface.peek.endpoint).get_reply(
+                TLogPeekRequest(tag=tag, begin=popped + 1))
+            q = self.tag_data.setdefault(tag, deque())
+            for v, msgs in reply.messages:
+                if v <= recovery_version:
+                    q.append((v, msgs))
+            if popped:
+                self.poppedtags[tag] = popped
+        TraceEvent("TLogRecovered").detail("Id", self.id).detail(
+            "Tags", len(recover_tags)).detail(
+            "RecoveryVersion", recovery_version).log()
+
+    async def _lock(self, req) -> None:
+        """Epoch end (reference TLogLockResult): stop accepting commits."""
+        self.stopped = True
+        if not self._stop_promise.is_set():
+            self._stop_promise.send(None)   # wake parked peeks
+        TraceEvent("TLogLocked").detail("Id", self.id).detail(
+            "ByEpoch", req.epoch).detail("End", self.version.get()).log()
+        req.reply.send(TLogLockReply(
+            end_version=self.version.get(),
+            known_committed_version=self.known_committed_version,
+            tags=dict(self.poppedtags) | {
+                t: self.poppedtags.get(t, 0) for t in self.tag_data}))
 
     # -- commit (reference tLogCommit :2080) ---------------------------------
     async def _commit(self, req: TLogCommitRequest) -> None:
+        if self.stopped:
+            # Locked: drop the request; the proxy sees broken_promise and
+            # fails over (reference tlog_stopped error).
+            return
         if req.prev_version > self.version.get():
             await self.version.when_at_least(req.prev_version)
+        if self.stopped:
+            return
         if req.version <= self.version.get():
             # Duplicate append (proxy resend after reconnect): already have
             # it; just wait for durability below.
@@ -83,9 +129,13 @@ class TLog:
     # -- peek / pop ----------------------------------------------------------
     async def _peek(self, req: TLogPeekRequest) -> None:
         # Block until something exists at/after `begin` (reference peek
-        # parks the reply until the version advances).
-        if self.version.get() < req.begin:
-            await self.version.when_at_least(req.begin)
+        # parks the reply until the version advances) — unless locked, in
+        # which case no new data will ever come: answer immediately so
+        # generation-handoff peeks of fully-popped tags don't park forever.
+        if self.version.get() < req.begin and not self.stopped:
+            from ..core.futures import wait_any
+            await wait_any([self.version.when_at_least(req.begin),
+                            self._stop_promise.get_future()])
         out: List[Tuple[Version, List[Mutation]]] = []
         q = self.tag_data.get(req.tag)
         if q is not None:
@@ -124,7 +174,14 @@ class TLog:
 
     async def _serve_confirm(self) -> None:
         async for req in self.interface.confirm_running.queue:
-            req.reply.send(None)
+            if not self.stopped:
+                req.reply.send(None)
+            # stopped: drop -> broken_promise -> GRV proxy fails over.
+
+    async def _serve_lock(self) -> None:
+        from ..core.scheduler import spawn
+        async for req in self.interface.lock.queue:
+            spawn(self._lock(req), f"{self.id}.lock")
 
     def run(self, process) -> None:
         for s in self.interface.streams():
@@ -133,4 +190,9 @@ class TLog:
         process.spawn(self._serve_peek(), f"{self.id}.servePeek")
         process.spawn(self._serve_pop(), f"{self.id}.servePop")
         process.spawn(self._serve_confirm(), f"{self.id}.serveConfirm")
-        TraceEvent("TLogStarted").detail("Id", self.id).log()
+        process.spawn(self._serve_lock(), f"{self.id}.serveLock")
+        from .failure import hold_wait_failure
+        process.spawn(hold_wait_failure(self.interface.wait_failure),
+                      f"{self.id}.waitFailure")
+        TraceEvent("TLogStarted").detail("Id", self.id).detail(
+            "Epoch", self.epoch).log()

@@ -1,0 +1,186 @@
+"""Worker: the per-process recruitment surface.
+
+Reference: fdbserver/worker.actor.cpp — workerServer (:1215) registers with
+the cluster controller and instantiates roles on Initialize*Requests
+(:1617-1887); fdbd (:2365) boots it on every process.  Storage servers are
+long-lived across master epochs: the worker watches the broadcast
+ServerDBInfo and re-targets its storage roles' pull cursors to each new
+TLog generation (reference: SS rejoining the new log system).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..core.futures import AsyncVar
+from ..core.trace import Severity, TraceEvent
+from ..rpc.endpoint import RequestStream
+from .commit_proxy import CommitProxy, LogSystemClient
+from .grv_proxy import GrvProxy
+from .interfaces import (ClusterControllerInterface, RegisterWorkerRequest,
+                         ServerDBInfo, WorkerInterface)
+from .resolver import Resolver
+from .shardmap import RangeMap
+from .storage import StorageServer
+from .tlog import TLog
+
+
+class Worker:
+    def __init__(self, process, coordinators, process_class: str = "unset",
+                 config=None) -> None:
+        self.process = process
+        self.coordinators = coordinators
+        self.process_class = process_class
+        self.config = config
+        self.interface = WorkerInterface(process.name)
+        self.db_info: AsyncVar = AsyncVar(ServerDBInfo())
+        self.storage_roles: List[StorageServer] = []
+
+    # -- role instantiation --------------------------------------------------
+    async def _serve_init_master(self) -> None:
+        from .master import Master, master_server
+        async for req in self.interface.init_master.queue:
+            master = Master(epoch=req.epoch)
+            self.process.spawn(
+                master_server(master, self.process, self.coordinators,
+                              self.config, req.cc),
+                f"{self.process.name}.master")
+            req.reply.send(master.interface)
+
+    async def _serve_init_tlog(self) -> None:
+        async for req in self.interface.init_tlog.queue:
+            tlog = TLog(req.tlog_id, req.recovery_version, epoch=req.epoch)
+            tlog.run(self.process)
+            if req.recover_tags:
+                await tlog.recover_from(req.recover_tags, req.recover_popped,
+                                        req.recovery_version)
+            req.reply.send(tlog.interface)
+
+    async def _serve_init_commit_proxy(self) -> None:
+        async for req in self.interface.init_commit_proxy.queue:
+            key_resolvers: RangeMap = RangeMap(default=0)
+            for b, e, idx in req.key_resolvers_ranges:
+                key_resolvers.set_range(b, e, idx)
+            key_servers: RangeMap = RangeMap(default=None)
+            for b, e, tags in req.key_servers_ranges:
+                key_servers.set_range(b, e, tags)
+            proxy = CommitProxy(
+                req.proxy_id, req.master, req.resolvers,
+                LogSystemClient(req.tlogs,
+                                replication=self._log_replication()),
+                key_resolvers, key_servers, req.storage_interfaces,
+                req.recovery_version)
+            proxy.run(self.process)
+            req.reply.send(proxy.interface)
+
+    def _log_replication(self) -> int:
+        return getattr(self.config, "log_replication", 1) if self.config else 1
+
+    async def _serve_init_grv_proxy(self) -> None:
+        async for req in self.interface.init_grv_proxy.queue:
+            proxy = GrvProxy(req.proxy_id, req.master, req.tlogs)
+            proxy.run(self.process)
+            req.reply.send(proxy.interface)
+
+    async def _serve_init_resolver(self) -> None:
+        async for req in self.interface.init_resolver.queue:
+            backend = getattr(self.config, "conflict_backend", None) \
+                if self.config else None
+            r = Resolver(req.resolver_id, req.recovery_version,
+                         backend=backend)
+            r.run(self.process)
+            req.reply.send(r.interface)
+
+    async def _serve_init_storage(self) -> None:
+        async for req in self.interface.init_storage.queue:
+            info = self.db_info.get()
+            ls = LogSystemClient(info.tlogs,
+                                 replication=self._log_replication()) \
+                if info.tlogs else None
+            ss = StorageServer(req.ss_id, req.tag, ls)
+            ss.run(self.process)
+            self.storage_roles.append(ss)
+            req.reply.send(ss.interface)
+
+    async def _serve_wait_failure(self) -> None:
+        """Hold requests forever; process death breaks their promises —
+        the cross-process failure signal (reference WaitFailure.actor.cpp).
+        The held list must be LOCAL: it has to die with this actor so the
+        promises break when the process is killed."""
+        held = []
+        async for req in self.interface.wait_failure.queue:
+            held.append(req)
+
+    # -- ServerDBInfo watch: re-target storage pull cursors ------------------
+    async def _watch_db_info(self) -> None:
+        known_epoch = -1
+        while True:
+            info: ServerDBInfo = self.db_info.get()
+            if (info.epoch != known_epoch and info.tlogs and
+                    info.recovery_state in ("accepting_commits",
+                                            "fully_recovered")):
+                known_epoch = info.epoch
+                ls = LogSystemClient(info.tlogs,
+                                     replication=self._log_replication())
+                for ss in self.storage_roles:
+                    ss.set_log_system(ls, info.recovery_version)
+            await self.db_info.on_change()
+
+    # -- CC registration + ServerDBInfo subscription -------------------------
+    async def _register_loop(self, leader_var: AsyncVar) -> None:
+        """Register with each new cluster controller; long-poll its
+        ServerDBInfo broadcasts (reference registrationClient)."""
+        from .cluster_controller import GetServerDBInfoRequest
+        known_version = -1
+        cc: Optional[ClusterControllerInterface] = None
+        while True:
+            leader = leader_var.get()
+            new_cc = leader.serialized_info if leader else None
+            if new_cc is not cc:
+                cc = new_cc
+                known_version = -1
+                if cc is not None:
+                    RequestStream.at(cc.register_worker.endpoint).send(
+                        RegisterWorkerRequest(
+                            worker=self.interface,
+                            process_class=self.process_class))
+            if cc is None:
+                await leader_var.on_change()
+                continue
+            from ..core.futures import wait_any
+            reply_f = RequestStream.at(cc.get_server_db_info.endpoint
+                                       ).get_reply(
+                GetServerDBInfoRequest(known_version=known_version))
+            change_f = leader_var.on_change()
+            from ..core.futures import swallow
+            idx, _ = await wait_any([swallow(reply_f), change_f])
+            if idx == 1:
+                continue
+            if reply_f.is_error():
+                # CC unreachable: wait for a NEW leader — but only if the
+                # leader hasn't already changed (a wakeup between the error
+                # and this await would otherwise be lost forever).
+                cur = leader_var.get()
+                if (cur.serialized_info if cur else None) is cc:
+                    from ..core.scheduler import delay
+                    from ..core.futures import wait_any as _wa
+                    await _wa([leader_var.on_change(), delay(1.0)])
+                continue
+            version, info = reply_f.get()
+            known_version = version
+            self.db_info.set(info)
+
+    def run(self, leader_var: AsyncVar) -> None:
+        p = self.process
+        for s in self.interface.streams():
+            p.register(s)
+        p.spawn(self._serve_init_master(), f"{p.name}.initMaster")
+        p.spawn(self._serve_init_tlog(), f"{p.name}.initTLog")
+        p.spawn(self._serve_init_commit_proxy(), f"{p.name}.initProxy")
+        p.spawn(self._serve_init_grv_proxy(), f"{p.name}.initGrv")
+        p.spawn(self._serve_init_resolver(), f"{p.name}.initResolver")
+        p.spawn(self._serve_init_storage(), f"{p.name}.initStorage")
+        p.spawn(self._serve_wait_failure(), f"{p.name}.waitFailure")
+        p.spawn(self._watch_db_info(), f"{p.name}.watchDbInfo")
+        p.spawn(self._register_loop(leader_var), f"{p.name}.register")
+

@@ -271,6 +271,40 @@ def test_multi_resolver_and_proxy(cluster):
     set_event_loop(None)
 
 
+def test_pipeline_with_tpu_conflict_backend():
+    """North-star integration: the resolver's ConflictSet backend selector
+    set to the JAX device kernel, driven through the full commit path."""
+    c = SimCluster(n_resolvers=1, n_storage=1, n_tlogs=1,
+                   conflict_backend="tpu")
+    db = c.database()
+
+    async def go():
+        t1 = db.create_transaction()
+        t1.set(b"k", b"v0")
+        await t1.commit()
+        # Read-write conflict must be detected by the device kernel.
+        ta = db.create_transaction()
+        tb = db.create_transaction()
+        await ta.get(b"k")
+        await tb.get(b"k")
+        ta.set(b"k", b"a")
+        tb.set(b"k", b"b")
+        await ta.commit()
+        with pytest.raises(FdbError) as ei:
+            await tb.commit()
+        assert ei.value.name == "not_committed"
+        t3 = db.create_transaction()
+        assert await t3.get(b"k") == b"a"
+
+    c.run_until(c.loop.spawn(go()), timeout=30)
+    from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+    assert isinstance(c.resolvers[0].conflict_set, TpuConflictSet)
+    from foundationdb_tpu.core import set_event_loop
+    from foundationdb_tpu.rpc.sim import set_simulator
+    set_simulator(None)
+    set_event_loop(None)
+
+
 def test_run_retry_helper(cluster):
     db = cluster.database()
 

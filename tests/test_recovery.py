@@ -1,0 +1,150 @@
+"""Elastic recovery tests: dynamic recruitment + epoch-change recovery.
+
+Models the reference's fault-tolerance behavior (SURVEY.md §3.4, §5.3):
+any transaction-system failure ends the master's epoch; the cluster
+controller recruits a successor which locks the old TLog generation,
+recovers surviving tag data, and brings up a fresh transaction system —
+while committed data (on storage-class workers) survives."""
+
+import pytest
+
+from foundationdb_tpu.core import FdbError
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+
+@pytest.fixture()
+def teardown():
+    from foundationdb_tpu.core import (DeterministicRandom,
+                                       set_deterministic_random)
+    set_deterministic_random(DeterministicRandom(7))   # hermetic per test
+    yield
+    from foundationdb_tpu.core import set_event_loop
+    from foundationdb_tpu.rpc.sim import set_simulator
+    set_simulator(None)
+    set_event_loop(None)
+
+
+def make_cluster(**cfg):
+    n_workers = cfg.pop("n_workers", 5)
+    n_storage_workers = cfg.pop("n_storage_workers", 2)
+    config = DatabaseConfiguration(**cfg)
+    return SimFdbCluster(config=config, n_workers=n_workers,
+                         n_storage_workers=n_storage_workers)
+
+
+async def commit_kv(db, key, value):
+    t = db.create_transaction()   # reuse: backoff grows across retries
+    while True:
+        try:
+            t.set(key, value)
+            await t.commit()
+            return
+        except FdbError as e:
+            await t.on_error(e)
+
+
+async def read_key(db, key):
+    t = db.create_transaction()
+    while True:
+        try:
+            return await t.get(key)
+        except FdbError as e:
+            await t.on_error(e)
+
+
+def test_cold_boot_recruits_and_serves(teardown):
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        await commit_kv(db, b"boot", b"ok")
+        assert await read_key(db, b"boot") == b"ok"
+        cc = c.current_cc()
+        assert cc is not None
+        assert cc.db_info.epoch == 1
+        assert cc.db_info.recovery_state == "accepting_commits"
+
+    c.run_until(c.loop.spawn(go()), timeout=60)
+
+
+def test_master_worker_kill_triggers_recovery(teardown):
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        await commit_kv(db, b"before", b"1")
+        cc = c.current_cc()
+        epoch1 = cc.db_info.epoch
+        master_proc = c.process_of(cc.db_info.master)
+        assert master_proc is not None and master_proc.alive
+        c.sim.kill_process(master_proc)
+        # The new epoch must come up and serve; prior data must survive.
+        await commit_kv(db, b"after", b"2")
+        assert await read_key(db, b"before") == b"1"
+        assert await read_key(db, b"after") == b"2"
+        cc2 = c.current_cc()
+        assert cc2.db_info.epoch > epoch1
+
+    c.run_until(c.loop.spawn(go()), timeout=300)
+
+
+def test_resolver_worker_kill_triggers_recovery(teardown):
+    # Place the resolver on a different stateless worker than the master
+    # (master on stateless[0], resolvers on stateless[i+1]).
+    c = make_cluster(n_workers=6, n_storage_workers=2)
+    db = c.database()
+
+    async def go():
+        await commit_kv(db, b"k0", b"v0")
+        cc = c.current_cc()
+        resolver_proc = c.process_of(cc.db_info.resolvers[0])
+        master_proc = c.process_of(cc.db_info.master)
+        assert resolver_proc is not master_proc
+        c.sim.kill_process(resolver_proc)
+        await commit_kv(db, b"k1", b"v1")
+        assert await read_key(db, b"k0") == b"v0"
+        assert await read_key(db, b"k1") == b"v1"
+
+    c.run_until(c.loop.spawn(go()), timeout=300)
+
+
+def test_tlog_kill_with_replication_preserves_data(teardown):
+    c = make_cluster(n_workers=6, n_storage_workers=2,
+                     n_tlogs=2, log_replication=2)
+    db = c.database()
+
+    async def go():
+        for i in range(5):
+            await commit_kv(db, b"key%d" % i, b"val%d" % i)
+        cc = c.current_cc()
+        tlog_procs = [c.process_of(t) for t in cc.db_info.tlogs]
+        master_proc = c.process_of(cc.db_info.master)
+        victim = next(p for p in tlog_procs if p is not master_proc)
+        c.sim.kill_process(victim)
+        await commit_kv(db, b"post", b"recovery")
+        for i in range(5):
+            assert await read_key(db, b"key%d" % i) == b"val%d" % i
+        assert await read_key(db, b"post") == b"recovery"
+
+    c.run_until(c.loop.spawn(go()), timeout=300)
+
+
+def test_repeated_recoveries(teardown):
+    c = make_cluster(n_workers=7, n_storage_workers=2)
+    db = c.database()
+
+    async def go():
+        for round_num in range(3):
+            await commit_kv(db, b"round%d" % round_num, b"x")
+            cc = c.current_cc()
+            if cc is None:
+                continue
+            mp = c.process_of(cc.db_info.master)
+            if mp is not None and mp.alive:
+                c.sim.kill_process(mp)
+        await commit_kv(db, b"final", b"done")
+        for round_num in range(3):
+            assert await read_key(db, b"round%d" % round_num) == b"x"
+
+    c.run_until(c.loop.spawn(go()), timeout=600)
