@@ -103,6 +103,23 @@ class ClusterConnection:
             known_epoch = info.epoch
             self.client_info.set(info)
 
+    async def get_status(self) -> dict:
+        """Fetch the status JSON document from the cluster controller
+        (reference `fdbcli status json` / \\xff\\xff/status/json)."""
+        from ..server.status import StatusRequest
+        while True:
+            leader = self.leader.get()
+            cc = leader.serialized_info if leader else None
+            if cc is None:
+                await self.leader.on_change()
+                continue
+            try:
+                return await RequestStream.at(
+                    cc.get_status.endpoint).get_reply(StatusRequest())
+            except FdbError:
+                from ..core.scheduler import delay
+                await delay(0.5)
+
     def close(self) -> None:
         for a in self._actors:
             if not a.is_ready():

@@ -205,6 +205,8 @@ class ClusterController:
         self._spawn(self._serve_open_database(), f"{self.id}.openDb")
         self._spawn(self._serve_master_registration(), f"{self.id}.masterReg")
         self._spawn(self._cluster_watch_database(), f"{self.id}.watchDb")
+        from .status import serve_status
+        self._spawn(serve_status(self), f"{self.id}.status")
         # On restart after a deposition, resume monitoring known workers.
         for wid, (iface, _cls) in list(self.workers.items()):
             self._spawn(self._monitor_worker(wid, iface),

@@ -31,7 +31,8 @@ class GrvProxy:
         self.id = proxy_id
         self.master = master            # MasterInterface
         self.tlogs = tlogs or []        # [TLogInterface] for liveness confirm
-        self.ratekeeper = ratekeeper    # Ratekeeper client handle (optional)
+        self.ratekeeper = ratekeeper    # RatekeeperInterface (optional)
+        self._rate = float("inf")       # tps budget from the ratekeeper
         self.interface = GrvProxyInterface(proxy_id)
         # Priority queues: immediate > default > batch (reference
         # SystemTransactionQueue/DefaultQueue/BatchQueue).
@@ -49,33 +50,72 @@ class GrvProxy:
                 w, self._wakeup = self._wakeup, None
                 w.send(None)
 
-    def _drain(self, budget: float) -> List[GetReadVersionRequest]:
+    def _drain(self, budget: float):
+        """Release requests: IMMEDIATE always (and exempt from ratekeeper
+        accounting, as in the reference); default/batch only while budget
+        remains.  Returns (batch, charged) so the caller can carry any
+        overdraft forward as debt instead of erasing it."""
         out: List[GetReadVersionRequest] = []
-        for pri in (TransactionPriority.IMMEDIATE,
-                    TransactionPriority.DEFAULT, TransactionPriority.BATCH):
+        charged = 0
+        q = self.queues[TransactionPriority.IMMEDIATE]
+        while q:
+            out.append(q.pop(0))
+        for pri in (TransactionPriority.DEFAULT, TransactionPriority.BATCH):
             q = self.queues[pri]
-            while q and (budget > 0 or pri == TransactionPriority.IMMEDIATE):
+            while q and budget - charged > 0:
                 req = q.pop(0)
                 out.append(req)
-                budget -= req.transaction_count
-        return out
+                charged += req.transaction_count
+        return out, charged
 
     async def _transaction_starter(self) -> None:
+        from ..core.scheduler import now
         knobs = server_knobs()
+        last = now()
         while True:
             if not any(self.queues):
                 # Sleep until a request arrives (no virtual-time polling).
                 self._wakeup = Promise()
                 await self._wakeup.get_future()
             await delay(knobs.START_TRANSACTION_BATCH_INTERVAL_MIN)
-            if self.ratekeeper is not None:
-                self.transaction_budget = self.ratekeeper.current_budget(
-                    self.id)
-            batch = self._drain(self.transaction_budget)
+            # Token bucket: accrue budget at the ratekeeper's tps, capped
+            # at one lease's worth (reference transactionStarter :702).
+            t = now()
+            if self._rate != float("inf"):
+                self.transaction_budget = min(
+                    self.transaction_budget + self._rate * (t - last),
+                    self._rate)
+            else:
+                self.transaction_budget = float("inf")
+            last = t
+            batch, charged = self._drain(self.transaction_budget)
             if not batch:
                 continue
+            if self.transaction_budget != float("inf"):
+                # Deficit carries forward (may go negative): overdraft now
+                # means fewer releases later, keeping the long-run rate at
+                # the ratekeeper's tps.
+                self.transaction_budget -= charged
             self.stats["batches"] += 1
             spawn(self._reply_batch(batch), f"{self.id}.grvBatch")
+
+    async def _rate_updater(self) -> None:
+        """Fetch the tps budget from the ratekeeper (reference getRate
+        loop :288); on ratekeeper silence the last lease keeps being used
+        (and eventually recovery replaces everyone anyway)."""
+        from ..core.error import FdbError
+        from .ratekeeper import GetRateInfoRequest
+        while True:
+            try:
+                reply = await RequestStream.at(
+                    self.ratekeeper.get_rate_info.endpoint).get_reply(
+                    GetRateInfoRequest(proxy_id=self.id,
+                                       total_released=self.stats["grvs"]))
+                self._rate = reply.tps
+                wait = reply.lease_duration / 2
+            except FdbError:
+                wait = 0.5
+            await delay(wait)
 
     async def _reply_batch(self, batch: List[GetReadVersionRequest]) -> None:
         # Confirm log-system liveness + fetch live committed version in
@@ -89,8 +129,6 @@ class GrvProxy:
             await wait_all(confirms)
         vreply = await version_f
         self.stats["grvs"] += len(batch)
-        if self.ratekeeper is not None:
-            self.ratekeeper.report_released(self.id, len(batch))
         for req in batch:
             req.reply.send(GetReadVersionReply(version=vreply.version,
                                                locked=vreply.locked))
@@ -100,6 +138,8 @@ class GrvProxy:
             process.register(s)
         process.spawn(self._queue_requests(), f"{self.id}.queue")
         process.spawn(self._transaction_starter(), f"{self.id}.starter")
+        if self.ratekeeper is not None:
+            process.spawn(self._rate_updater(), f"{self.id}.rateUpdater")
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),
                       f"{self.id}.waitFailure")

@@ -372,6 +372,7 @@ class ServerDBInfo:
     resolvers: List[Any] = field(default_factory=list)
     tlogs: List[Any] = field(default_factory=list)
     storage_servers: Dict[Tag, Any] = field(default_factory=dict)
+    ratekeeper: Any = None
 
 
 @dataclass
@@ -442,7 +443,15 @@ class InitializeGrvProxyRequest:
     epoch: int
     master: Any
     tlogs: List[Any]
+    ratekeeper: Any = None    # RatekeeperInterface
     reply: Any = None     # -> GrvProxyInterface
+
+
+@dataclass
+class InitializeRatekeeperRequest:
+    rk_id: str
+    storage_interfaces: Dict[Tag, Any] = field(default_factory=dict)
+    reply: Any = None     # -> RatekeeperInterface
 
 
 @dataclass
@@ -477,13 +486,15 @@ class WorkerInterface:
                                            TaskPriority.DefaultEndpoint)
         self.init_storage = RequestStream("worker.initStorage",
                                           TaskPriority.DefaultEndpoint)
+        self.init_ratekeeper = RequestStream("worker.initRatekeeper",
+                                             TaskPriority.DefaultEndpoint)
         self.wait_failure = RequestStream("worker.waitFailure",
                                           TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
         return [self.init_master, self.init_tlog, self.init_commit_proxy,
                 self.init_grv_proxy, self.init_resolver, self.init_storage,
-                self.wait_failure]
+                self.init_ratekeeper, self.wait_failure]
 
 
 class ClusterControllerInterface:
@@ -499,10 +510,13 @@ class ClusterControllerInterface:
             "cc.masterRegistration", TaskPriority.ClusterController)
         self.get_server_db_info = RequestStream(
             "cc.getServerDBInfo", TaskPriority.ClusterController)
+        self.get_status = RequestStream(
+            "cc.getStatus", TaskPriority.ClusterController)
 
     def streams(self) -> List[RequestStream]:
         return [self.register_worker, self.get_workers, self.open_database,
-                self.master_registration, self.get_server_db_info]
+                self.master_registration, self.get_server_db_info,
+                self.get_status]
 
 
 @dataclass
@@ -526,6 +540,9 @@ class StorageServerInterface:
             "storage.getKeyValues", TaskPriority.DefaultPromiseEndpoint)
         self.watch_value = RequestStream(
             "storage.watchValue", TaskPriority.DefaultPromiseEndpoint)
+        self.queuing_metrics = RequestStream(
+            "storage.queuingMetrics", TaskPriority.DefaultEndpoint)
 
     def streams(self) -> List[RequestStream]:
-        return [self.get_value, self.get_key_values, self.watch_value]
+        return [self.get_value, self.get_key_values, self.watch_value,
+                self.queuing_metrics]

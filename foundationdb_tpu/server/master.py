@@ -330,7 +330,13 @@ async def master_server(master: Master, process, coordinators,
                         for j in range(config.storage_replication)]
                 key_servers_ranges.append((bounds[i], bounds[i + 1], team))
 
-        # Second wave: proxies (commit + GRV) against the new log system.
+        # Second wave: ratekeeper + proxies against the new log system.
+        from .interfaces import InitializeRatekeeperRequest
+        ratekeeper = await RequestStream.at(
+            pick(0).init_ratekeeper.endpoint).get_reply(
+            InitializeRatekeeperRequest(
+                rk_id=f"rk.e{master.epoch}",
+                storage_interfaces=storage_servers))
         key_resolvers_ranges = _key_resolver_ranges(config.n_resolvers)
         commit_proxy_futures = [RequestStream.at(
             pick(i).init_commit_proxy.endpoint).get_reply(
@@ -347,7 +353,8 @@ async def master_server(master: Master, process, coordinators,
             pick(i + 1).init_grv_proxy.endpoint).get_reply(
             InitializeGrvProxyRequest(
                 proxy_id=f"grv{i}.e{master.epoch}",
-                epoch=master.epoch, master=master.interface, tlogs=tlogs))
+                epoch=master.epoch, master=master.interface, tlogs=tlogs,
+                ratekeeper=ratekeeper))
             for i in range(config.n_grv_proxies)]
         commit_proxies = await _wait_all(commit_proxy_futures)
         grv_proxies = await _wait_all(grv_proxy_futures)
@@ -372,7 +379,7 @@ async def master_server(master: Master, process, coordinators,
             recovery_version=recovery_version, master=master.interface,
             grv_proxies=grv_proxies, commit_proxies=commit_proxies,
             resolvers=resolvers, tlogs=tlogs,
-            storage_servers=storage_servers)
+            storage_servers=storage_servers, ratekeeper=ratekeeper)
         await RequestStream.at(
             cc_interface.master_registration.endpoint).get_reply(
             MasterRegistrationRequest(epoch=master.epoch, db_info=db_info))
@@ -388,7 +395,8 @@ async def master_server(master: Master, process, coordinators,
         from .failure import wait_failure_of
         role_failures = [
             spawn(wait_failure_of(x), "master.roleWatch")
-            for x in (tlogs + resolvers + commit_proxies + grv_proxies)]
+            for x in (tlogs + resolvers + commit_proxies + grv_proxies +
+                      [ratekeeper])]
         children.extend(role_failures)
         idx, _ = await _wait_any(role_failures)
         TraceEvent("MasterTerminated", Severity.Warn).detail(

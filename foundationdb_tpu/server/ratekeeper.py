@@ -1,0 +1,172 @@
+"""Ratekeeper: cluster-wide transaction admission control.
+
+Reference: fdbserver/Ratekeeper.actor.cpp — the singleton tracks every
+storage server's queue depth and durability lag
+(trackStorageServerQueueInfo :610) and every TLog's queue, computes a
+cluster transactions-per-second budget (updateRate :991) with a
+spring-damped limit as queues approach their targets, and hands rates to
+the GRV proxies, which release queued transactions against the budget
+(GrvProxyServer getRate loop :288).
+
+Simplified spring model kept from the reference: the limit scales the
+current release rate by target_queue/current_queue as the worst storage
+queue (bytes of non-durable data) crosses (target - spring); below that
+the rate is unlimited (workload-bound).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List
+
+from ..core.knobs import server_knobs
+from ..core.scheduler import delay, spawn
+from ..core.trace import TraceEvent
+from ..rpc.endpoint import RequestStream
+from ..core.scheduler import TaskPriority
+
+
+@dataclass
+class GetRateInfoRequest:
+    """GRV proxy -> ratekeeper (reference GetRateInfoRequest)."""
+
+    proxy_id: str
+    total_released: int      # transactions this proxy released so far
+    reply: Any = None
+
+
+@dataclass
+class GetRateInfoReply:
+    tps: float               # this proxy's transactions-per-second budget
+    lease_duration: float    # budget valid this long (reference leaseDuration)
+
+
+@dataclass
+class StorageQueuingMetricsRequest:
+    reply: Any = None
+
+
+@dataclass
+class StorageQueuingMetricsReply:
+    queue_bytes: int         # non-durable bytes (version lag proxy)
+    durability_lag: int      # version - durable_version
+    stored_bytes: int = 0
+
+
+@dataclass
+class RatekeeperStatusRequest:
+    reply: Any = None
+
+
+@dataclass
+class RatekeeperStatusReply:
+    tps_limit: float
+    limit_reason: str
+    released_tps: float
+    worst_queue_bytes: int
+
+
+class RatekeeperInterface:
+    def __init__(self, rk_id: str = "rk") -> None:
+        self.id = rk_id
+        self.get_rate_info = RequestStream("rk.getRateInfo",
+                                           TaskPriority.DefaultEndpoint)
+        self.get_status = RequestStream("rk.getStatus",
+                                        TaskPriority.DefaultEndpoint)
+        self.wait_failure = RequestStream("rk.waitFailure",
+                                          TaskPriority.FailureMonitor)
+
+    def streams(self) -> List[RequestStream]:
+        return [self.get_rate_info, self.get_status, self.wait_failure]
+
+
+class Ratekeeper:
+    def __init__(self, rk_id: str, storage_interfaces: Dict[int, Any],
+                 poll_interval: float = 0.5) -> None:
+        self.id = rk_id
+        self.interface = RatekeeperInterface(rk_id)
+        self.storage_interfaces = storage_interfaces
+        self.poll_interval = poll_interval
+        self.tps_limit: float = float("inf")
+        self.limit_reason = "workload"
+        # Smoothed release rate across proxies (reference
+        # smoothReleasedTransactions).
+        self._proxy_released: Dict[str, int] = {}
+        self._released_window: List = []   # (time, total)
+        self.worst_queue_bytes = 0
+
+    # -- rate computation (reference updateRate :991) ------------------------
+    def _release_rate(self) -> float:
+        """Observed cluster release rate over the sampling window."""
+        if len(self._released_window) < 2:
+            return 0.0
+        (t0, n0), (t1, n1) = self._released_window[0], \
+            self._released_window[-1]
+        if t1 <= t0:
+            return 0.0
+        return max(0.0, (n1 - n0) / (t1 - t0))
+
+    def _update_rate(self) -> None:
+        knobs = server_knobs()
+        target = float(knobs.STORAGE_LIMIT_BYTES)
+        spring = max(target * 0.2, 1.0)
+        worst = float(self.worst_queue_bytes)
+        if worst <= target - spring:
+            self.tps_limit = float("inf")
+            self.limit_reason = "workload"
+            return
+        # Spring zone: scale the observed rate down proportionally to how
+        # deep into the spring the worst queue is; a full queue halts.
+        released = max(self._release_rate(), 1.0)
+        over = min(worst - (target - spring), spring)
+        factor = max(0.0, 1.0 - over / spring)
+        self.tps_limit = released * factor + 1.0
+        self.limit_reason = "storage_server_write_queue_size"
+
+    async def _poll_storage(self) -> None:
+        from ..core.futures import swallow, wait_all
+        while True:
+            # All servers polled in parallel: one clogged SS must not stall
+            # rate updates past the proxies' lease renewals.
+            futures = [RequestStream.at(
+                ssi.queuing_metrics.endpoint).get_reply(
+                StorageQueuingMetricsRequest())
+                for ssi in self.storage_interfaces.values()]
+            await wait_all([swallow(f) for f in futures])
+            worst = max((f.get().queue_bytes for f in futures
+                         if not f.is_error()), default=0)
+            self.worst_queue_bytes = worst
+            self._update_rate()
+            await delay(self.poll_interval)
+
+    async def _serve_rate_info(self) -> None:
+        from ..core.scheduler import now
+        async for req in self.interface.get_rate_info.queue:
+            self._proxy_released[req.proxy_id] = req.total_released
+            total = sum(self._proxy_released.values())
+            self._released_window.append((now(), total))
+            if len(self._released_window) > 20:
+                self._released_window.pop(0)
+            n_proxies = max(len(self._proxy_released), 1)
+            req.reply.send(GetRateInfoReply(
+                tps=self.tps_limit / n_proxies,
+                lease_duration=self.poll_interval * 2))
+
+    async def _serve_status(self) -> None:
+        async for req in self.interface.get_status.queue:
+            req.reply.send(RatekeeperStatusReply(
+                tps_limit=self.tps_limit,
+                limit_reason=self.limit_reason,
+                released_tps=self._release_rate(),
+                worst_queue_bytes=self.worst_queue_bytes))
+
+    def run(self, process) -> None:
+        for s in self.interface.streams():
+            process.register(s)
+        process.spawn(self._poll_storage(), f"{self.id}.pollStorage")
+        process.spawn(self._serve_rate_info(), f"{self.id}.serveRate")
+        process.spawn(self._serve_status(), f"{self.id}.serveStatus")
+        from .failure import hold_wait_failure
+        process.spawn(hold_wait_failure(self.interface.wait_failure),
+                      f"{self.id}.waitFailure")
+        TraceEvent("RatekeeperStarted").detail("Id", self.id).log()

@@ -1,0 +1,134 @@
+"""Status: the machine-readable cluster state document.
+
+Reference: fdbserver/Status.actor.cpp clusterGetStatus (:2684) aggregates
+worker/process/role metrics into the status JSON exposed via `fdbcli
+status json` and \\xff\\xff/status/json; schema documented in
+documentation/sphinx/source/mr-status-json-schemas.rst.inc.  This builder
+runs on the cluster controller and mirrors the top-level shape: cluster
+{recovery_state, workload, qos, data, processes, ...} + client.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+from ..core.scheduler import now
+from ..rpc.endpoint import RequestStream
+from .ratekeeper import StorageQueuingMetricsRequest
+
+
+@dataclass
+class StatusRequest:
+    reply: Any = None
+
+
+_RECOVERY_DESCRIPTIONS = {
+    "unrecruited": "Cluster controller has not recruited a master yet.",
+    "recruiting": "Recruiting a new transaction system.",
+    "accepting_commits": "The database is accepting commits.",
+    "fully_recovered": "The database is fully recovered.",
+}
+
+
+async def build_status(cc) -> Dict[str, Any]:
+    """Assemble the status document from the CC's view + live role polls
+    (all polls issued in parallel — one clogged role must not stall the
+    whole document)."""
+    from ..core.futures import swallow, wait_all
+    from .ratekeeper import RatekeeperStatusRequest
+    info = cc.db_info
+    tags = list(info.storage_servers.items())
+    ss_futures = [RequestStream.at(ssi.queuing_metrics.endpoint).get_reply(
+        StorageQueuingMetricsRequest()) for _tag, ssi in tags]
+    rk_future = None
+    if info.ratekeeper is not None:
+        rk_future = RequestStream.at(
+            info.ratekeeper.get_status.endpoint).get_reply(
+            RatekeeperStatusRequest())
+    await wait_all([swallow(f) for f in ss_futures +
+                    ([rk_future] if rk_future else [])])
+
+    storage_status = {}
+    total_kv_bytes = 0
+    worst_queue = 0
+    for (tag, ssi), f in zip(tags, ss_futures):
+        if f.is_error():
+            storage_status[str(tag)] = {"id": ssi.id, "reachable": False}
+            continue
+        m = f.get()
+        storage_status[str(tag)] = {
+            "id": ssi.id,
+            "stored_bytes": m.stored_bytes,
+            "input_queue_bytes": m.queue_bytes,
+            "durability_lag_versions": m.durability_lag,
+        }
+        total_kv_bytes += m.stored_bytes
+        worst_queue = max(worst_queue, m.queue_bytes)
+    rk = rk_future.get() if rk_future is not None and \
+        not rk_future.is_error() else None
+
+    processes = {}
+    for wid, (iface, pclass) in sorted(cc.workers.items()):
+        processes[wid] = {"class_type": pclass, "excluded": False}
+
+    return {
+        "client": {
+            "cluster_file": {"up_to_date": True},
+            "database_status": {
+                "available": info.recovery_state in ("accepting_commits",
+                                                     "fully_recovered"),
+                "healthy": info.recovery_state in ("accepting_commits",
+                                                   "fully_recovered"),
+            },
+        },
+        "cluster": {
+            "generation": info.epoch,
+            "recovery_state": {
+                "name": info.recovery_state,
+                "description": _RECOVERY_DESCRIPTIONS.get(
+                    info.recovery_state, info.recovery_state),
+            },
+            "database_available": info.recovery_state in (
+                "accepting_commits", "fully_recovered"),
+            "machines": {},
+            "processes": processes,
+            "workload": {
+                "transactions": {},
+                "operations": {},
+            },
+            "qos": {
+                "worst_queue_bytes_storage_server": worst_queue,
+                "transactions_per_second_limit":
+                    (None if rk is None or rk.tps_limit == float("inf")
+                     else rk.tps_limit),
+                "released_transactions_per_second":
+                    (None if rk is None else rk.released_tps),
+                "performance_limited_by": {
+                    "name": rk.limit_reason if rk else "workload"},
+            },
+            "data": {
+                "total_kv_size_bytes": total_kv_bytes,
+                "state": {"healthy": True, "name": "healthy"},
+            },
+            "layers": {"_valid": True},
+            "cluster_controller_timestamp": round(now(), 3),
+            "configuration": {
+                "logs": len(info.tlogs),
+                "resolvers": len(info.resolvers),
+                "commit_proxies": len(info.commit_proxies),
+                "grv_proxies": len(info.grv_proxies),
+                "storage_servers": len(info.storage_servers),
+            },
+        },
+    }
+
+
+async def serve_status(cc) -> None:
+    """The CC's status endpoint actor."""
+    async for req in cc.interface.get_status.queue:
+        cc._spawn(_answer(cc, req), f"{cc.id}.status")
+
+
+async def _answer(cc, req: StatusRequest) -> None:
+    req.reply.send(await build_status(cc))

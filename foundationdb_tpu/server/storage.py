@@ -262,6 +262,14 @@ class StorageServer:
         except Exception as e:   # noqa: BLE001
             req.reply.send_error(e)
 
+    async def _queuing_metrics(self, req) -> None:
+        from .ratekeeper import StorageQueuingMetricsReply
+        lag = self.version.get() - self.durable_version.get()
+        req.reply.send(StorageQueuingMetricsReply(
+            queue_bytes=lag * 64,            # approx bytes per version
+            durability_lag=lag,
+            stored_bytes=len(self.data)))
+
     # -- watches (reference watchValueQ, trigger :2622) ----------------------
     def _trigger_watch(self, key: bytes) -> None:
         entry = self._watches.get(key)
@@ -324,5 +332,8 @@ class StorageServer:
                       f"{self.id}.getKeyValues")
         process.spawn(self._serve(self.interface.watch_value.queue,
                                   self._watch_value), f"{self.id}.watch")
+        process.spawn(self._serve(self.interface.queuing_metrics.queue,
+                                  self._queuing_metrics),
+                      f"{self.id}.queuingMetrics")
         TraceEvent("StorageServerStarted").detail("Id", self.id).detail(
             "Tag", self.tag).log()

@@ -78,9 +78,17 @@ class Worker:
 
     async def _serve_init_grv_proxy(self) -> None:
         async for req in self.interface.init_grv_proxy.queue:
-            proxy = GrvProxy(req.proxy_id, req.master, req.tlogs)
+            proxy = GrvProxy(req.proxy_id, req.master, req.tlogs,
+                             ratekeeper=req.ratekeeper)
             proxy.run(self.process)
             req.reply.send(proxy.interface)
+
+    async def _serve_init_ratekeeper(self) -> None:
+        from .ratekeeper import Ratekeeper
+        async for req in self.interface.init_ratekeeper.queue:
+            rk = Ratekeeper(req.rk_id, req.storage_interfaces)
+            rk.run(self.process)
+            req.reply.send(rk.interface)
 
     async def _serve_init_resolver(self) -> None:
         async for req in self.interface.init_resolver.queue:
@@ -180,6 +188,7 @@ class Worker:
         p.spawn(self._serve_init_grv_proxy(), f"{p.name}.initGrv")
         p.spawn(self._serve_init_resolver(), f"{p.name}.initResolver")
         p.spawn(self._serve_init_storage(), f"{p.name}.initStorage")
+        p.spawn(self._serve_init_ratekeeper(), f"{p.name}.initRatekeeper")
         p.spawn(self._serve_wait_failure(), f"{p.name}.waitFailure")
         p.spawn(self._watch_db_info(), f"{p.name}.watchDbInfo")
         p.spawn(self._register_loop(leader_var), f"{p.name}.register")

@@ -1,0 +1,7 @@
+"""Deterministic-simulation test harness: workloads, specs, tester.
+
+Reference layer: fdbserver/workloads/ + fdbserver/tester.actor.cpp +
+tests/*.toml (SURVEY.md §4)."""
+
+from .workload import TestWorkload, register_workload, workload_registry  # noqa: F401
+from .tester import run_test, load_spec  # noqa: F401

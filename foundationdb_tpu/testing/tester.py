@@ -1,0 +1,96 @@
+"""The tester: runs a test spec (workload composition) against a simulated
+cluster through setup -> start -> quiescence -> check.
+
+Reference: fdbserver/tester.actor.cpp runTests (:1603) / runWorkload
+(:755) — reads a TOML spec (tests/*.toml), instantiates registered
+workloads, runs their phases (chaos workloads run concurrently with the
+invariant workloads' start phase), waits for quiescence (QuietDatabase:
+recovery settled, queues drained), then runs every workload's check.
+"""
+
+from __future__ import annotations
+
+import tomllib
+from typing import Any, Dict, List, Optional
+
+from ..core.error import FdbError
+from ..core.futures import wait_all
+from ..core.scheduler import delay, spawn
+from ..core.trace import Severity, TraceEvent
+from .workload import TestWorkload, workload_registry
+from . import workloads as _builtin  # noqa: F401 - populates the registry
+
+
+def load_spec(path_or_text: str) -> Dict[str, Any]:
+    """Parse a TOML test spec (reference tests/fast/*.toml layout):
+
+        [[test]]
+        testTitle = 'CycleTest'
+          [[test.workload]]
+          testName = 'Cycle'
+          nodeCount = 16
+          [[test.workload]]
+          testName = 'RandomClogging'
+    """
+    if "\n" in path_or_text or "[" in path_or_text.split("\n")[0]:
+        return tomllib.loads(path_or_text)
+    with open(path_or_text, "rb") as f:
+        return tomllib.load(f)
+
+
+async def quiet_database(cluster, db, timeout: float = 60.0) -> None:
+    """Wait for the cluster to settle (reference QuietDatabase.actor.cpp):
+    recovery complete and a probe transaction commits."""
+    from ..core.scheduler import now
+    deadline = now() + timeout
+    while now() < deadline:
+        cc = cluster.current_cc()
+        if cc is not None and cc.db_info.recovery_state in (
+                "accepting_commits", "fully_recovered"):
+            try:
+                t = db.create_transaction()
+                t.set(b"\x02quiet_probe", b"1")
+                await t.commit()
+                return
+            except FdbError:
+                pass
+        await delay(1.0)
+    raise FdbError(1004, "timed_out", "quiet_database timed out")
+
+
+async def run_test(cluster, spec: Dict[str, Any],
+                   db=None) -> Dict[str, Dict[str, float]]:
+    """Run one [[test]] entry; returns {workload: metrics}.  Raises
+    AssertionError if any workload's check fails."""
+    db = db or cluster.database()
+    all_metrics: Dict[str, Dict[str, float]] = {}
+    for test in spec.get("test", []):
+        title = test.get("testTitle", "unnamed")
+        TraceEvent("TestStart").detail("Title", title).log()
+        instances: List[TestWorkload] = []
+        for wconf in test.get("workload", []):
+            name = wconf["testName"]
+            cls = workload_registry.get(name)
+            if cls is None:
+                raise KeyError(f"unknown workload {name!r} "
+                               f"(registered: {sorted(workload_registry)})")
+            instances.append(cls(cluster, db, dict(wconf)))
+
+        # Phase 1: setup, sequentially (reference runs setup before start).
+        for w in instances:
+            await w.setup()
+        # Phase 2: start — ALL workloads concurrently (chaos + load mix).
+        await wait_all([spawn(w.start(), f"workload.{w.name}.start")
+                        for w in instances])
+        # Phase 3: quiescence.
+        await quiet_database(cluster, db)
+        # Phase 4: check.
+        for w in instances:
+            ok = await w.check()
+            TraceEvent("TestCheck",
+                       Severity.Info if ok else Severity.Error).detail(
+                "Workload", w.name).detail("Ok", ok).log()
+            assert ok, f"workload {w.name} check FAILED in test {title!r}"
+            all_metrics[w.name] = w.get_metrics()
+        TraceEvent("TestComplete").detail("Title", title).log()
+    return all_metrics

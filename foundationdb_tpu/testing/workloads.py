@@ -1,0 +1,221 @@
+"""Built-in workloads: invariant checkers, load generators, chaos injectors.
+
+Reference models:
+- Cycle         (fdbserver/workloads/Cycle.actor.cpp): a ring of keys;
+  transactions swap pointers; the ring must remain a single cycle under
+  any interleaving/chaos — THE serializability canary.
+- ReadWrite     (fdbserver/workloads/ReadWrite.actor.cpp): configurable
+  read/write load, reports ops/s.
+- Attrition     (fdbserver/workloads/MachineAttrition.actor.cpp): kills
+  random processes on an interval.
+- RandomClogging (fdbserver/workloads/RandomClogging.actor.cpp): clogs
+  random network pairs.
+- ConflictRange (fdbserver/workloads/ConflictRange.actor.cpp, simplified):
+  randomized cross-check of conflict behavior against an in-memory model.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List
+
+from ..core.error import FdbError
+from ..core.scheduler import delay, now, spawn
+from ..core.futures import wait_all
+from .workload import TestWorkload, register_workload
+
+
+@register_workload
+class CycleWorkload(TestWorkload):
+    name = "Cycle"
+
+    async def setup(self) -> None:
+        n = int(self.config.get("nodeCount", 16))
+        prefix = self.config.get("prefix", "cycle/").encode()
+
+        async def populate(t):
+            for i in range(n):
+                t.set(prefix + b"%06d" % i, prefix + b"%06d" % ((i + 1) % n))
+        await self.run_transaction(populate)
+
+    async def start(self) -> None:
+        n = int(self.config.get("nodeCount", 16))
+        actors = int(self.config.get("actorCount", 4))
+        duration = float(self.config.get("testDuration", 10.0))
+        prefix = self.config.get("prefix", "cycle/").encode()
+        rng = random.Random(int(self.config.get("seed", 1)))
+        deadline = now() + duration
+        swaps = [0]
+
+        async def swapper(seed: int) -> None:
+            r = random.Random(seed)
+            while now() < deadline:
+                async def swap(t):
+                    a = prefix + b"%06d" % r.randrange(n)
+                    b = await t.get(a)
+                    cv = await t.get(b)
+                    d = await t.get(cv)
+                    t.set(a, cv)
+                    t.set(b, d)
+                    t.set(cv, b)
+                await self.run_transaction(swap)
+                swaps[0] += 1
+        await wait_all([spawn(swapper(rng.randrange(1 << 30)))
+                        for _ in range(actors)])
+        self.metrics["swaps"] = swaps[0]
+
+    async def check(self) -> bool:
+        n = int(self.config.get("nodeCount", 16))
+        prefix = self.config.get("prefix", "cycle/").encode()
+
+        async def walk(t):
+            seen, k = set(), prefix + b"%06d" % 0
+            for _ in range(n):
+                if k in seen:
+                    return False
+                seen.add(k)
+                k = await t.get(k)
+                if k is None:
+                    return False
+            return k == prefix + b"%06d" % 0 and len(seen) == n
+        return await self.run_transaction(walk)
+
+
+@register_workload
+class ReadWriteWorkload(TestWorkload):
+    name = "ReadWrite"
+
+    async def setup(self) -> None:
+        n = int(self.config.get("nodeCount", 100))
+
+        async def populate(t):
+            for i in range(n):
+                t.set(b"rw/%08d" % i, b"v%08d" % i)
+        await self.run_transaction(populate)
+
+    async def start(self) -> None:
+        n = int(self.config.get("nodeCount", 100))
+        actors = int(self.config.get("actorCount", 4))
+        reads = int(self.config.get("readsPerTransaction", 4))
+        writes = int(self.config.get("writesPerTransaction", 2))
+        duration = float(self.config.get("testDuration", 10.0))
+        rng = random.Random(int(self.config.get("seed", 2)))
+        deadline = now() + duration
+        ops = [0]
+
+        async def worker(seed: int) -> None:
+            r = random.Random(seed)
+            while now() < deadline:
+                async def txn_fn(t):
+                    for _ in range(reads):
+                        await t.get(b"rw/%08d" % r.randrange(n))
+                    for _ in range(writes):
+                        t.set(b"rw/%08d" % r.randrange(n),
+                              b"u%010d" % r.randrange(1 << 30))
+                await self.run_transaction(txn_fn)
+                ops[0] += reads + writes
+        t0 = now()
+        await wait_all([spawn(worker(rng.randrange(1 << 30)))
+                        for _ in range(actors)])
+        elapsed = max(now() - t0, 1e-9)
+        self.metrics["operations"] = ops[0]
+        self.metrics["ops_per_sec"] = ops[0] / elapsed
+
+    async def check(self) -> bool:
+        async def count(t):
+            data = await t.get_range(b"rw/", b"rw0", limit=100000)
+            return len(data)
+        n = int(self.config.get("nodeCount", 100))
+        return await self.run_transaction(count) == n
+
+
+@register_workload
+class AttritionWorkload(TestWorkload):
+    """Kills random stateless-worker processes (reference MachineAttrition;
+    storage-class workers are spared until data distribution can re-
+    replicate lost shards)."""
+
+    name = "Attrition"
+
+    async def start(self) -> None:
+        interval = float(self.config.get("testDuration", 10.0)) / max(
+            int(self.config.get("machinesToKill", 2)), 1)
+        rng = random.Random(int(self.config.get("seed", 3)))
+        kills = 0
+        for _ in range(int(self.config.get("machinesToKill", 2))):
+            await delay(interval * (0.5 + rng.random()))
+            victims = [p for _p, w, _cc, _lv in self.cluster.workers
+                       if (p := _p).alive and w.process_class == "stateless"]
+            # Keep at least two stateless workers alive so recovery can
+            # always place a master + its transaction system.
+            if len(victims) <= 2:
+                continue
+            victim = victims[rng.randrange(len(victims))]
+            self.cluster.sim.kill_process(victim)
+            kills += 1
+        self.metrics["kills"] = kills
+
+
+@register_workload
+class RandomCloggingWorkload(TestWorkload):
+    """Clogs random process pairs (reference RandomClogging)."""
+
+    name = "RandomClogging"
+
+    async def start(self) -> None:
+        duration = float(self.config.get("testDuration", 10.0))
+        rng = random.Random(int(self.config.get("seed", 4)))
+        deadline = now() + duration
+        clogs = 0
+        while now() < deadline:
+            await delay(duration / 10 * (0.5 + rng.random()))
+            procs = self.cluster.sim.alive_processes()
+            if len(procs) >= 2:
+                a, b = rng.sample(procs, 2)
+                self.cluster.sim.clog_pair(a, b,
+                                           seconds=rng.random() * 2.0)
+                clogs += 1
+        self.metrics["clogs"] = clogs
+
+
+@register_workload
+class ConflictRangeWorkload(TestWorkload):
+    """Randomized serializability cross-check vs. an in-memory model
+    (reference ConflictRange.actor.cpp:31, simplified): one actor applies
+    random sets/clears through transactions AND to a local dict; after
+    quiescence the database must equal the model exactly."""
+
+    name = "ConflictRange"
+
+    async def start(self) -> None:
+        duration = float(self.config.get("testDuration", 5.0))
+        rng = random.Random(int(self.config.get("seed", 5)))
+        n = int(self.config.get("nodeCount", 50))
+        self.model: Dict[bytes, bytes] = {}
+        deadline = now() + duration
+        while now() < deadline:
+            op = rng.random()
+            if op < 0.6:
+                k = b"cr/%04d" % rng.randrange(n)
+                v = b"%08d" % rng.randrange(1 << 20)
+
+                async def do_set(t, k=k, v=v):
+                    t.set(k, v)
+                await self.run_transaction(do_set)
+                self.model[k] = v
+            else:
+                lo = rng.randrange(n)
+                hi = min(n, lo + rng.randrange(1, 8))
+                b, e = b"cr/%04d" % lo, b"cr/%04d" % hi
+
+                async def do_clear(t, b=b, e=e):
+                    t.clear(b, e)
+                await self.run_transaction(do_clear)
+                for k in [k for k in self.model if b <= k < e]:
+                    del self.model[k]
+
+    async def check(self) -> bool:
+        async def read_all(t):
+            return dict(await t.get_range(b"cr/", b"cr0", limit=100000))
+        actual = await self.run_transaction(read_all)
+        return actual == self.model

@@ -1,0 +1,116 @@
+"""Control-plane tests: ratekeeper admission control + status JSON.
+
+Reference behaviors modeled: Ratekeeper.actor.cpp spring-damped rate
+limiting consumed by GRV proxies; Status.actor.cpp clusterGetStatus
+document shape."""
+
+import json
+
+import pytest
+
+from foundationdb_tpu.core import FdbError
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+from foundationdb_tpu.server.ratekeeper import Ratekeeper
+
+
+@pytest.fixture()
+def teardown():
+    from foundationdb_tpu.core import (DeterministicRandom,
+                                       set_deterministic_random)
+    set_deterministic_random(DeterministicRandom(11))
+    yield
+    from foundationdb_tpu.core import set_event_loop
+    from foundationdb_tpu.rpc.sim import set_simulator
+    set_simulator(None)
+    set_event_loop(None)
+
+
+def test_ratekeeper_spring_model():
+    rk = Ratekeeper("rk-test", {})
+    from foundationdb_tpu.core.knobs import server_knobs
+    target = server_knobs().STORAGE_LIMIT_BYTES
+    # Healthy queues: unlimited.
+    rk.worst_queue_bytes = 0
+    rk._update_rate()
+    assert rk.tps_limit == float("inf")
+    assert rk.limit_reason == "workload"
+    # Queue deep in the spring: limited below the observed release rate.
+    rk._released_window = [(0.0, 0), (1.0, 1000)]   # 1000 tps observed
+    rk.worst_queue_bytes = int(target)              # fully saturated
+    rk._update_rate()
+    assert rk.tps_limit < 1000
+    assert rk.limit_reason == "storage_server_write_queue_size"
+    # Mid-spring: limit between 0 and observed rate.
+    rk.worst_queue_bytes = int(target * 0.9)
+    rk._update_rate()
+    assert 0 < rk.tps_limit <= 1001
+
+
+def test_grv_rate_budget_enforced(teardown):
+    """With the ratekeeper forced into limiting, GRV throughput is bounded
+    near the budget instead of being released instantly."""
+    c = SimFdbCluster(config=DatabaseConfiguration(),
+                      n_workers=5, n_storage_workers=2)
+    db = c.database()
+
+    async def go():
+        # Boot end-to-end first (proves the recruited ratekeeper + GRV rate
+        # lease path doesn't break normal traffic)...
+        t = db.create_transaction()
+        while True:
+            try:
+                t.set(b"x", b"1"); await t.commit(); break
+            except FdbError as e:
+                await t.on_error(e)
+        # ...then verify the token-bucket release math directly.
+        from foundationdb_tpu.server.grv_proxy import GrvProxy
+        from foundationdb_tpu.server.interfaces import (
+            GetReadVersionRequest, TransactionPriority)
+        gp = GrvProxy("gtest", None)
+        gp._rate = 10.0
+        # token accrual: 0.5s at 10 tps -> 5 tokens, capped at rate.
+        gp.queues[TransactionPriority.DEFAULT] = [
+            GetReadVersionRequest() for _ in range(20)]
+        gp.queues[TransactionPriority.IMMEDIATE] = [
+            GetReadVersionRequest(priority=TransactionPriority.IMMEDIATE)
+            for _ in range(3)]
+        budget = min(0.0 + gp._rate * 0.5, gp._rate)
+        batch, charged = gp._drain(budget)
+        # IMMEDIATE always released and NOT charged; default charged.
+        assert len(batch) == 3 + 5
+        assert charged == 5
+        assert len(gp.queues[TransactionPriority.DEFAULT]) == 15
+        # Fractional budget releases at most one txn and carries the debt.
+        batch, charged = gp._drain(0.1)
+        assert len(batch) == 1 and charged == 1
+        assert (0.1 - charged) < 0      # caller keeps the deficit
+
+    c.run_until(c.loop.spawn(go()), timeout=60)
+
+
+def test_status_json_document(teardown):
+    c = SimFdbCluster(config=DatabaseConfiguration(n_resolvers=2),
+                      n_workers=5, n_storage_workers=2)
+    db = c.database()
+
+    async def go():
+        t = db.create_transaction()
+        while True:
+            try:
+                t.set(b"statuskey", b"v"); await t.commit(); break
+            except FdbError as e:
+                await t.on_error(e)
+        status = await db.cluster.get_status()
+        json.dumps(status)   # must be JSON-serializable
+        assert status["client"]["database_status"]["available"]
+        cl = status["cluster"]
+        assert cl["recovery_state"]["name"] == "accepting_commits"
+        assert cl["generation"] >= 1
+        assert cl["configuration"]["resolvers"] == 2
+        assert cl["configuration"]["storage_servers"] == 2
+        assert len(cl["processes"]) == 5
+        assert cl["data"]["total_kv_size_bytes"] >= 0
+        assert "qos" in cl
+
+    c.run_until(c.loop.spawn(go()), timeout=60)

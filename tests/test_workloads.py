@@ -1,0 +1,72 @@
+"""Workload-harness tests: TOML specs driving chaos + invariant workloads
+against a full simulated cluster (reference `fdbserver -r simulation -f
+tests/fast/CycleTest.toml`, SURVEY.md §3.5/§4)."""
+
+import os
+
+import pytest
+
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+from foundationdb_tpu.testing import load_spec, run_test
+
+SPECS = os.path.join(os.path.dirname(__file__), "specs")
+
+
+@pytest.fixture()
+def teardown():
+    from foundationdb_tpu.core import (DeterministicRandom,
+                                       set_deterministic_random)
+    set_deterministic_random(DeterministicRandom(21))
+    yield
+    from foundationdb_tpu.core import set_event_loop
+    from foundationdb_tpu.rpc.sim import set_simulator
+    set_simulator(None)
+    set_event_loop(None)
+
+
+def test_cycle_spec_under_chaos(teardown):
+    c = SimFdbCluster(config=DatabaseConfiguration(n_tlogs=2,
+                                                   log_replication=2),
+                      n_workers=7, n_storage_workers=2)
+    spec = load_spec(os.path.join(SPECS, "CycleTest.toml"))
+
+    async def go():
+        metrics = await run_test(c, spec)
+        assert metrics["Cycle"]["swaps"] > 0
+        assert metrics["Attrition"]["kills"] >= 1
+        return metrics
+
+    metrics = c.run_until(c.loop.spawn(go()), timeout=1200)
+    print("metrics:", metrics)
+
+
+def test_serializability_spec(teardown):
+    c = SimFdbCluster(config=DatabaseConfiguration(n_resolvers=2),
+                      n_workers=5, n_storage_workers=2)
+    spec = load_spec(os.path.join(SPECS, "SerializabilityTest.toml"))
+
+    async def go():
+        return await run_test(c, spec)
+
+    metrics = c.run_until(c.loop.spawn(go()), timeout=600)
+    assert metrics["ReadWrite"]["operations"] > 0
+
+
+def test_unknown_workload_rejected(teardown):
+    c = SimFdbCluster(config=DatabaseConfiguration())
+    spec = load_spec("""
+[[test]]
+testTitle = 'Bogus'
+  [[test.workload]]
+  testName = 'DoesNotExist'
+""")
+
+    async def go():
+        try:
+            await run_test(c, spec)
+        except KeyError as e:
+            return str(e)
+        return None
+
+    assert "DoesNotExist" in c.run_until(c.loop.spawn(go()), timeout=30)
