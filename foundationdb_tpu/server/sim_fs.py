@@ -1,0 +1,138 @@
+"""Simulated filesystem with power-loss semantics.
+
+Reference: fdbrpc/AsyncFileNonDurable.actor.h (:128) — in simulation,
+writes land in a pending buffer and only become durable on sync(); a
+machine "power loss" (kill without clean shutdown) drops or corrupts
+un-synced data (corruption logic :511-552), which is how the simulator
+proves recovery code handles torn writes.  IAsyncFile equivalent surface:
+read / write / truncate / sync / size.
+
+Determinism: loss decisions draw from the deterministic RNG at kill time,
+so a failing seed replays identically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..core.error import err
+from ..core.rng import deterministic_random
+from ..core.scheduler import delay
+from ..core.trace import Severity, TraceEvent
+
+_SIM_WRITE_LATENCY = 0.0002
+_SIM_SYNC_LATENCY = 0.0005
+
+
+class SimFile:
+    """One simulated file: durable bytes + pending (un-synced) writes."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.durable = bytearray()
+        # [(offset, bytes)] applied on sync, lossy on power failure.
+        self.pending: List[Tuple[int, bytes]] = []
+        self.pending_truncate: Optional[int] = None
+        self.open = True
+
+    # -- IAsyncFile surface --------------------------------------------------
+    async def write(self, offset: int, data: bytes) -> None:
+        self._check_open()
+        await delay(_SIM_WRITE_LATENCY)
+        self.pending.append((offset, bytes(data)))
+
+    async def truncate(self, size: int) -> None:
+        self._check_open()
+        self.pending_truncate = size
+
+    async def sync(self) -> None:
+        self._check_open()
+        await delay(_SIM_SYNC_LATENCY)
+        self._apply_pending()
+
+    async def read(self, offset: int, length: int) -> bytes:
+        """Reads see the would-be-synced view (OS page cache semantics)."""
+        self._check_open()
+        img = self._cache_view()
+        return bytes(img[offset:offset + length])
+
+    def size(self) -> int:
+        return len(self._cache_view())
+
+    # -- internals -----------------------------------------------------------
+    def _check_open(self) -> None:
+        if not self.open:
+            raise err("operation_failed", f"file {self.name} closed")
+
+    def _apply_write(self, buf: bytearray, offset: int, data: bytes) -> None:
+        if len(buf) < offset + len(data):
+            buf.extend(b"\x00" * (offset + len(data) - len(buf)))
+        buf[offset:offset + len(data)] = data
+
+    def _apply_pending(self) -> None:
+        for offset, data in self.pending:
+            self._apply_write(self.durable, offset, data)
+        if self.pending_truncate is not None:
+            del self.durable[self.pending_truncate:]
+        self.pending = []
+        self.pending_truncate = None
+
+    def _cache_view(self) -> bytearray:
+        img = bytearray(self.durable)
+        for offset, data in self.pending:
+            self._apply_write(img, offset, data)
+        if self.pending_truncate is not None:
+            del img[self.pending_truncate:]
+        return img
+
+    def power_fail(self) -> None:
+        """Un-synced writes are independently kept, dropped, or corrupted
+        (reference AsyncFileNonDurable :511-552: full/partial/corrupt)."""
+        rng = deterministic_random()
+        survivors: List[Tuple[int, bytes]] = []
+        for offset, data in self.pending:
+            roll = rng.random()
+            if roll < 0.5:
+                survivors.append((offset, data))          # made it to disk
+            elif roll < 0.8:
+                continue                                   # dropped
+            else:                                          # torn/corrupt
+                cut = rng.random_int(0, max(len(data) - 1, 0))
+                garbled = bytearray(data[:cut])
+                if garbled and rng.random() < 0.5:
+                    i = rng.random_int(0, len(garbled) - 1)
+                    garbled[i] ^= 1 << rng.random_int(0, 7)
+                survivors.append((offset, bytes(garbled)))
+        for offset, data in survivors:
+            self._apply_write(self.durable, offset, data)
+        self.pending = []
+        self.pending_truncate = None
+        TraceEvent("SimFilePowerFail", Severity.Warn).detail(
+            "File", self.name).detail("Survived", len(survivors)).log()
+
+
+class SimFileSystem:
+    """Per-machine file namespace; survives process reboot, subject to
+    power_fail on unclean kills."""
+
+    def __init__(self) -> None:
+        self.files: Dict[str, SimFile] = {}
+
+    def open(self, name: str, create: bool = True) -> SimFile:
+        f = self.files.get(name)
+        if f is None:
+            if not create:
+                raise err("operation_failed", f"no such file {name}")
+            f = self.files[name] = SimFile(name)
+        f.open = True
+        return f
+
+    def exists(self, name: str) -> bool:
+        return name in self.files
+
+    def delete(self, name: str) -> None:
+        self.files.pop(name, None)
+
+    def power_fail_all(self) -> None:
+        for f in self.files.values():
+            f.power_fail()
