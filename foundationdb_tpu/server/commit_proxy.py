@@ -284,7 +284,8 @@ class CommitProxy:
                              ) -> List[CommitResult]:
         """Verdict = min over the resolvers that saw the transaction
         (reference determineCommittedTransactions :792-806: commit iff ALL
-        resolvers said committed; TOO_OLD dominates CONFLICT)."""
+        resolvers said committed; CONFLICT=0 < TOO_OLD=1, so under min()
+        CONFLICT dominates TOO_OLD)."""
         verdicts = [CommitResult.COMMITTED] * len(batch)
         for r_idx, reply in enumerate(resolutions):
             for local_i, verdict in enumerate(reply.committed):

@@ -13,7 +13,7 @@ so a failing seed replays identically.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from ..core.error import err
 from ..core.rng import deterministic_random
@@ -30,20 +30,22 @@ class SimFile:
     def __init__(self, name: str) -> None:
         self.name = name
         self.durable = bytearray()
-        # [(offset, bytes)] applied on sync, lossy on power failure.
-        self.pending: List[Tuple[int, bytes]] = []
-        self.pending_truncate: Optional[int] = None
+        # Ordered op log applied on sync, lossy on power failure.  Writes
+        # and truncates must replay in issue order: truncate(0) followed by
+        # a write must not be reordered to empty the file.
+        # Each op is ("w", offset, data) or ("t", size, b"").
+        self.pending: List[Tuple[str, int, bytes]] = []
         self.open = True
 
     # -- IAsyncFile surface --------------------------------------------------
     async def write(self, offset: int, data: bytes) -> None:
         self._check_open()
         await delay(_SIM_WRITE_LATENCY)
-        self.pending.append((offset, bytes(data)))
+        self.pending.append(("w", offset, bytes(data)))
 
     async def truncate(self, size: int) -> None:
         self._check_open()
-        self.pending_truncate = size
+        self.pending.append(("t", size, b""))
 
     async def sync(self) -> None:
         self._check_open()
@@ -69,46 +71,55 @@ class SimFile:
             buf.extend(b"\x00" * (offset + len(data) - len(buf)))
         buf[offset:offset + len(data)] = data
 
+    def _apply_op(self, buf: bytearray, op: str, arg: int, data: bytes
+                  ) -> None:
+        if op == "w":
+            self._apply_write(buf, arg, data)
+        else:
+            del buf[arg:]
+
     def _apply_pending(self) -> None:
-        for offset, data in self.pending:
-            self._apply_write(self.durable, offset, data)
-        if self.pending_truncate is not None:
-            del self.durable[self.pending_truncate:]
+        for op, arg, data in self.pending:
+            self._apply_op(self.durable, op, arg, data)
         self.pending = []
-        self.pending_truncate = None
 
     def _cache_view(self) -> bytearray:
         img = bytearray(self.durable)
-        for offset, data in self.pending:
-            self._apply_write(img, offset, data)
-        if self.pending_truncate is not None:
-            del img[self.pending_truncate:]
+        for op, arg, data in self.pending:
+            self._apply_op(img, op, arg, data)
         return img
 
     def power_fail(self) -> None:
-        """Un-synced writes are independently kept, dropped, or corrupted
-        (reference AsyncFileNonDurable :511-552: full/partial/corrupt)."""
+        """Un-synced ops are independently kept, dropped, or (for writes)
+        corrupted (reference AsyncFileNonDurable :511-552: full/partial/
+        corrupt), in issue order so surviving ops replay consistently."""
         rng = deterministic_random()
-        survivors: List[Tuple[int, bytes]] = []
-        for offset, data in self.pending:
-            roll = rng.random()
+        survived = 0
+        for op, arg, data in self.pending:
+            roll = rng.random01()
+            if op == "t":
+                # Metadata op: either reached disk or not.
+                if roll < 0.5:
+                    self._apply_op(self.durable, op, arg, data)
+                    survived += 1
+                continue
             if roll < 0.5:
-                survivors.append((offset, data))          # made it to disk
+                self._apply_write(self.durable, arg, data)   # made it
+                survived += 1
             elif roll < 0.8:
-                continue                                   # dropped
-            else:                                          # torn/corrupt
-                cut = rng.random_int(0, max(len(data) - 1, 0))
+                continue                                      # dropped
+            else:                                             # torn/corrupt
+                cut = rng.random_int(0, len(data) + 1)
                 garbled = bytearray(data[:cut])
-                if garbled and rng.random() < 0.5:
-                    i = rng.random_int(0, len(garbled) - 1)
-                    garbled[i] ^= 1 << rng.random_int(0, 7)
-                survivors.append((offset, bytes(garbled)))
-        for offset, data in survivors:
-            self._apply_write(self.durable, offset, data)
+                if garbled and rng.random01() < 0.5:
+                    i = rng.random_int(0, len(garbled))
+                    garbled[i] ^= 1 << rng.random_int(0, 8)
+                if garbled:
+                    self._apply_write(self.durable, arg, bytes(garbled))
+                    survived += 1
         self.pending = []
-        self.pending_truncate = None
         TraceEvent("SimFilePowerFail", Severity.Warn).detail(
-            "File", self.name).detail("Survived", len(survivors)).log()
+            "File", self.name).detail("Survived", survived).log()
 
 
 class SimFileSystem:

@@ -33,13 +33,14 @@ def test_disk_queue_roundtrip(loop, fs):
         q2 = DiskQueue(fs.open("q"))
         recs = await q2.recover()
         assert recs == [(s1, b"alpha"), (s2, b"beta")]
-        # pop is durable via the next append's header.
+        # pop is durable via the next append's header; pop(s1) trims
+        # records <= s1 only, so beta survives.
         q2.pop(s1)
         q2.push(b"gamma")
         await q2.commit()
         q3 = DiskQueue(fs.open("q"))
         recs = await q3.recover()
-        assert [p for _s, p in recs] == [b"gamma"]
+        assert [p for _s, p in recs] == [b"beta", b"gamma"]
 
     run(loop, go())
 
