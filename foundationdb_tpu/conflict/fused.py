@@ -1,43 +1,48 @@
-"""Fused single-dispatch conflict resolution kernel.
+"""Fused two-tier conflict resolution kernels (per-batch step + merge).
 
-One jitted device program per (txn, read, write) bucket shape that runs the
-ENTIRE resolveBatch data path of the reference resolver
-(fdbserver/Resolver.actor.cpp:104 + SkipList.cpp:909 detectConflicts):
+The reference resolver's data path (fdbserver/Resolver.actor.cpp:104 +
+SkipList.cpp:909 detectConflicts) is reformulated as TWO jitted device
+programs over a tiered window:
 
-    too-old -> history query -> intra-batch fixpoint -> insert -> (GC)
+  BASE   bk/bv[CAP]   large merged history, immutable between merges, with a
+                      precomputed doubling range-max table (built at merge
+                      time only — the analog of the skip list's per-level max
+                      versions, SkipList.cpp:695, amortized instead of
+                      rebuilt per batch)
+  DELTA  dk/dv[DCAP]  small sorted segment array absorbing the last few
+                      batches' write insertions (DCAP << CAP)
 
-entirely on device.  The host ships TWO arrays per batch (one uint32 digest
-block, one int32 metadata block — each host->device transfer over the PCIe/
-tunnel link costs ~4ms of latency, so inputs are packed) and fetches one
-result array; nothing in the batch-to-batch dependency chain touches the
-host, so consecutive commit batches pipeline across the host<->device round
-trip exactly like the reference overlaps commit batches across pipeline
-stages (CommitProxyServer.actor.cpp:589,1075 gates).
+Per-batch step (make_resolve_step):
+    too-old -> history query -> intra-batch fixpoint -> insert into DELTA
+  History max over [b,e) = max(base range-max via the stored table, delta
+  range-max via a table built over DCAP).  This is EXACT, not conservative:
+  wherever delta covers a key its version is newer than base's (versions are
+  monotone), so pointwise max(base_V, delta_V) equals the true V(k).
+  Per-batch device work is O(batch * log CAP + DCAP log DCAP) — independent
+  of CAP except for binary-search probes.
 
-GC (reference removeBefore, SkipList.cpp:576 — lazy and amortized there too)
-runs every few batches under a metadata flag, not per batch: it is an O(CAP)
-compaction whose cost is independent of the batch, and deferring it is
-decision-invariant (merged segments all sit below the window floor).
+Merge step (make_merge_step), host-scheduled every few batches or when the
+delta approaches capacity: overlay delta onto base (boundary union, pointwise
+max), removeBefore GC vs the window floor (SkipList.cpp:576 — lazy there
+too), version rebase, rebuild the base table, reset delta.  O(CAP log CAP)
+amortized over the merge interval.
+
+Overflow (merged size > CAP) sets a sticky flag carried through the state;
+the host surfaces it as an error at the next wait().  There is no silent
+clamping: a set flag means verdicts after the overflow are untrusted.
 
 Intra-batch semantics (checkIntraBatchConflicts, SkipList.cpp:874-906) are
 order-sequential: a reader conflicts iff an EARLIER SURVIVING transaction in
 the same batch wrote an overlapping range.  The dependency structure is
 strictly lower-triangular in batch order, so Jacobi iteration — recomputing
 from the history-only baseline each round — converges to the unique
-sequential solution in at most chain-depth rounds (typically 1-2):
+sequential solution in at most chain-depth rounds (typically 1-2).
 
-    conflicted_{k+1}[t] = hist[t]  OR  exists read r of t, write w of s:
-                          s < t, not conflicted_k[s], overlap(r, w)
-
-Each round is an interval-overlap-MIN query batch (ops/segtree.py): rank all
-range endpoints into a gap universe, min-cover the gaps with active writers'
-transaction indices, then one range-min per read; conflict iff min < t.
-
-Metadata block layout (int32[2*R + 2*W... see offsets in make_resolve_step]):
+Metadata block layout (int32, offsets in make_resolve_step):
     r_txn[R], r_valid[R], w_txn[W], w_valid[W],
-    t_snap[T], t_has_reads[T], t_valid[T],
-    now_rel, oldest_rel, new_oldest_rel, rebase_delta, do_gc
-Digest block layout (uint32[2*R + 2*W, 6]): r_b, r_e, w_b, w_e.
+    t_snap[T], t_has_reads[T], t_valid[T], now_rel, oldest_rel
+Digest block layout (planar uint32[6, 2*R + 2*W]): r_b | r_e | w_b | w_e
+column sections.
 """
 
 from __future__ import annotations
@@ -46,20 +51,28 @@ from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from ..ops.digest import KEY_LANES, MAX_DIGEST, searchsorted_left
-from ..ops.segtree import (INF_I32, build_min_table, interval_min_cover,
-                           range_min)
-from .window import WindowState, window_gc, window_insert, window_query
-
+from ..ops.digest import (KEY_LANES, MAX_DIGEST, lex_eq, searchsorted_left,
+                          searchsorted_right)
+from ..ops.rangemax import NEG_INF, build_sparse_table, range_max
+from ..ops.segtree import (build_min_table, interval_min_cover, range_min)
 from ..txn.types import CommitResult
+from .window import WindowState, make_window_state, window_insert
 
 RES_CONFLICT = int(CommitResult.CONFLICT)
 RES_TOO_OLD = int(CommitResult.TOO_OLD)
 RES_COMMITTED = int(CommitResult.COMMITTED)
 RES_INVALID = -1
 
-N_SCALARS = 5  # now_rel, oldest_rel, new_oldest_rel, rebase_delta, do_gc
+N_SCALARS = 2  # now_rel, oldest_rel
+
+# Per-batch output layout: [codes[t_cap], flag, delta_size, base_size];
+# the host reads out[t_cap + OUT_*].
+OUT_FLAG = 0
+OUT_DSIZE = 1
+OUT_BSIZE = 2
+OUT_EXTRA = 3
 
 
 def _next_pow2(n: int) -> int:
@@ -70,22 +83,29 @@ def meta_size(t_cap: int, r_cap: int, w_cap: int) -> int:
     return 2 * r_cap + 2 * w_cap + 3 * t_cap + N_SCALARS
 
 
-@lru_cache(maxsize=64)
-def make_resolve_step(cap: int, t_cap: int, r_cap: int, w_cap: int):
-    """Build the jitted fused step for one bucket shape.
+def make_delta_state(d_cap: int) -> WindowState:
+    """Fresh transparent delta: one segment covering all keys at NEG_INF."""
+    return make_window_state(d_cap, int(NEG_INF))
 
-    Returns fn(bk, bv, size, digests, meta)
-        -> (bk', bv', size', out) where out = int32[t_cap + 2] =
-           [codes..., overflow, live_boundary_count]."""
+
+@lru_cache(maxsize=64)
+def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
+                      w_cap: int):
+    """Build the jitted per-batch step for one bucket shape.
+
+    fn(bk, bv, table, size, dk, dv, dsize, flag, digests, meta)
+      -> (dk', dv', dsize', flag', out)
+    where out = int32[t_cap + 3] = [codes..., flag, delta_size, base_size].
+    Base arrays pass through untouched (read-only)."""
     u_cap = _next_pow2(2 * (r_cap + w_cap))
     log_u = u_cap.bit_length() - 1
 
-    def step(bk, bv, size, digests, meta):
+    def step(bk, bv, table, size, dk, dv, dsize, flag, digests, meta):
         # ---- unpack the two packed input blocks ---------------------------
-        r_b = digests[0:r_cap]
-        r_e = digests[r_cap:2 * r_cap]
-        w_b = digests[2 * r_cap:2 * r_cap + w_cap]
-        w_e = digests[2 * r_cap + w_cap:2 * r_cap + 2 * w_cap]
+        r_b = digests[:, 0:r_cap]
+        r_e = digests[:, r_cap:2 * r_cap]
+        w_b = digests[:, 2 * r_cap:2 * r_cap + w_cap]
+        w_e = digests[:, 2 * r_cap + w_cap:2 * r_cap + 2 * w_cap]
         o = 0
         r_txn = meta[o:o + r_cap]; o += r_cap
         r_valid = meta[o:o + r_cap] != 0; o += r_cap
@@ -96,29 +116,33 @@ def make_resolve_step(cap: int, t_cap: int, r_cap: int, w_cap: int):
         t_valid = meta[o:o + t_cap] != 0; o += t_cap
         now_rel = meta[o]
         oldest_rel = meta[o + 1]
-        new_oldest_rel = meta[o + 2]
-        rebase_delta = meta[o + 3]
-        do_gc = meta[o + 4] != 0
 
         # ---- too-old: snapshot below the window floor (SkipList.cpp:819) --
         too_old = t_valid & t_has_reads & (t_snap < oldest_rel)
 
-        # ---- history check (window query over the MVCC window) ------------
+        # ---- history check: max(base, delta) range-max > snapshot ---------
         r_txn_c = jnp.clip(r_txn, 0, t_cap - 1)
         r_live = r_valid & ~too_old[r_txn_c]
         snap_r = t_snap[r_txn_c]
-        hist_bits = window_query(bk, bv, r_b, r_e, snap_r, r_live)
+        lo_b = searchsorted_right(bk, r_b) - 1   # segment containing begin
+        hi_b = searchsorted_left(bk, r_e)        # first boundary >= end
+        max_base = range_max(table, lo_b, hi_b)
+        dtable = build_sparse_table(dv)          # DCAP log DCAP: cheap
+        lo_d = searchsorted_right(dk, r_b) - 1
+        hi_d = searchsorted_left(dk, r_e)
+        max_delta = range_max(dtable, lo_d, hi_d)
+        hist_bits = r_live & (jnp.maximum(max_base, max_delta) > snap_r)
         r_scatter = jnp.where(r_live, r_txn, t_cap)
         hist_conflicted = jnp.zeros((t_cap,), bool).at[r_scatter].max(
             hist_bits, mode="drop")
 
         # ---- endpoint gap universe for intra-batch overlap tests ----------
-        pad = jnp.broadcast_to(jnp.asarray(MAX_DIGEST),
-                               (u_cap - digests.shape[0], KEY_LANES))
-        all_d = jnp.concatenate([digests, pad], axis=0)
-        ops = [all_d[:, l] for l in range(KEY_LANES)]
+        pad = jnp.broadcast_to(jnp.asarray(MAX_DIGEST)[:, None],
+                               (KEY_LANES, u_cap - digests.shape[1]))
+        all_d = jnp.concatenate([digests, pad], axis=1)
+        ops = [all_d[l] for l in range(KEY_LANES)]
         sorted_ops = jax.lax.sort(ops, num_keys=KEY_LANES)
-        universe = jnp.stack(sorted_ops, axis=1)            # [U, 6] sorted
+        universe = jnp.stack(sorted_ops, axis=0)            # [6, U] sorted
         r_pb = searchsorted_left(universe, r_b)
         r_pe = searchsorted_left(universe, r_e)
         w_pb = searchsorted_left(universe, w_b)
@@ -137,10 +161,11 @@ def make_resolve_step(cap: int, t_cap: int, r_cap: int, w_cap: int):
             conf, _ = carry
             w_active = w_base_ok & ~conf[w_txn_c]
             cover = interval_min_cover(w_pb, w_pe, w_txn, w_active, log_u)
-            table = build_min_table(cover)
-            m = range_min(table, r_pb, r_pe)
+            mtable = build_min_table(cover)
+            m = range_min(mtable, r_pb, r_pe)
             intra_hit = r_live & (m < r_txn)
-            new_conf = hist_conflicted.at[r_scatter].max(intra_hit, mode="drop")
+            new_conf = hist_conflicted.at[r_scatter].max(intra_hit,
+                                                         mode="drop")
             changed = jnp.any(new_conf != conf)
             return new_conf, changed
 
@@ -150,18 +175,12 @@ def make_resolve_step(cap: int, t_cap: int, r_cap: int, w_cap: int):
         conflicted, _ = jax.lax.while_loop(
             cond, body, (hist_conflicted, True))
 
-        # ---- insert surviving writes at `now` -----------------------------
+        # ---- insert surviving writes into the DELTA at `now` --------------
         survivor = t_valid & ~too_old & ~conflicted
         w_ins = w_valid & survivor[w_txn_c]
-        (bk2, bv2, size2), overflow = window_insert(
-            WindowState(bk, bv, size), w_b, w_e, w_ins, now_rel)
-
-        # ---- amortized GC / rebase (removeBefore, SkipList.cpp:576) -------
-        st3 = jax.lax.cond(
-            do_gc,
-            lambda s: window_gc(s, new_oldest_rel, rebase_delta),
-            lambda s: s,
-            WindowState(bk2, bv2, size2))
+        (dk2, dv2, dsize2), overflow = window_insert(
+            WindowState(dk, dv, dsize), w_b, w_e, w_ins, now_rel)
+        flag2 = flag | overflow.astype(jnp.int32)
 
         codes = jnp.where(
             ~t_valid, RES_INVALID,
@@ -169,9 +188,98 @@ def make_resolve_step(cap: int, t_cap: int, r_cap: int, w_cap: int):
                       jnp.where(conflicted, RES_CONFLICT, RES_COMMITTED))
         ).astype(jnp.int32)
         out = jnp.concatenate([
-            codes,
-            overflow.astype(jnp.int32)[None],
-            st3.size.astype(jnp.int32)[None]])
-        return st3.bk, st3.bv, st3.size, out
+            codes, flag2[None],
+            dsize2.astype(jnp.int32)[None],
+            size.astype(jnp.int32)[None]])
+        return dk2, dv2, dsize2, flag2, out
 
-    return jax.jit(step, donate_argnums=(0, 1, 2))
+    return jax.jit(step, donate_argnums=(4, 5, 6, 7, 8, 9))
+
+
+@lru_cache(maxsize=16)
+def make_merge_step(cap: int, d_cap: int):
+    """Build the jitted merge: overlay delta onto base + GC + rebase + table.
+
+    fn(bk, bv, size, dk, dv, dsize, flag, scalars)
+      -> (bk', bv', table', size', dk0, dv0, dsize0, flag')
+    scalars = int32[2] = [new_oldest_rel, rebase_delta]."""
+    s_cap = cap + d_cap  # scratch rows for the pre-GC merged sequence
+
+    def merge(bk, bv, size, dk, dv, dsize, flag, scalars):
+        new_oldest_rel = scalars[0]
+        rebase_delta = scalars[1]
+        idx_b = jnp.arange(cap, dtype=jnp.int32)
+        idx_d = jnp.arange(d_cap, dtype=jnp.int32)
+        live_b = idx_b < size
+        live_d = idx_d < dsize
+
+        # Pointwise-max values at every boundary of either tier.  Where delta
+        # covers a key its version is newer than base's, so max == overlay.
+        slot_db = jnp.clip(searchsorted_right(dk, bk) - 1, 0, d_cap - 1)
+        v_b = jnp.maximum(bv, dv[slot_db])
+        slot_bd = jnp.clip(searchsorted_right(bk, dk) - 1, 0, cap - 1)
+        v_d = jnp.maximum(dv, bv[slot_bd])
+
+        # Dedup: a base boundary with an equal live delta boundary is dropped
+        # (the delta copy carries the same merged value).
+        p = searchsorted_left(dk, bk)
+        dup_b = (p < dsize) & lex_eq(dk[:, jnp.minimum(p, d_cap - 1)], bk)
+        keep_b = live_b & ~dup_b
+
+        # Merged-order positions via cross ranks (no equal keys remain
+        # between the kept-base and live-delta sequences).
+        rank_b = jnp.cumsum(keep_b.astype(jnp.int32)) - 1
+        d_before = jnp.minimum(searchsorted_left(dk, bk), dsize)
+        pos_b = jnp.where(keep_b, rank_b + d_before, s_cap)
+        b_before_raw = jnp.minimum(searchsorted_left(bk, dk), size)
+        drop_prefix = jnp.cumsum(dup_b.astype(jnp.int32))  # inclusive
+        drops_before = jnp.where(
+            b_before_raw > 0,
+            drop_prefix[jnp.clip(b_before_raw - 1, 0, cap - 1)], 0)
+        pos_d = jnp.where(live_d, idx_d + b_before_raw - drops_before, s_cap)
+
+        sk = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
+                                         (KEY_LANES, s_cap)))
+        sv = jnp.full((s_cap,), NEG_INF, dtype=jnp.int32)
+        sk = sk.at[:, pos_b].set(bk, mode="drop")
+        sv = sv.at[pos_b].set(jnp.where(keep_b, v_b, NEG_INF), mode="drop")
+        sk = sk.at[:, pos_d].set(dk, mode="drop")
+        sv = sv.at[pos_d].set(jnp.where(live_d, v_d, NEG_INF), mode="drop")
+        m_size = (jnp.sum(keep_b.astype(jnp.int32)) +
+                  jnp.sum(live_d.astype(jnp.int32)))
+
+        # removeBefore GC on the merged sequence (SkipList.cpp:576 wasAbove
+        # logic: drop a boundary when it and its predecessor are both below
+        # the floor) + version rebase.  Decision-invariant: snapshots below
+        # the floor are classified too-old before ever querying.
+        idx_s = jnp.arange(s_cap, dtype=jnp.int32)
+        live_s = idx_s < m_size
+        above = sv >= new_oldest_rel
+        prev_above = jnp.concatenate([jnp.ones((1,), bool), above[:-1]])
+        keep_s = live_s & ((idx_s == 0) | above | prev_above)
+        rank_s = jnp.cumsum(keep_s.astype(jnp.int32)) - 1
+        final_size = jnp.sum(keep_s.astype(jnp.int32))
+        overflow = final_size > cap
+        dst = jnp.where(keep_s, rank_s, s_cap)
+
+        out_k = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
+                                            (KEY_LANES, cap)))
+        out_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
+        shifted = jnp.maximum(sv - rebase_delta, NEG_INF + 1)
+        out_k = out_k.at[:, dst].set(sk, mode="drop")
+        out_v = out_v.at[dst].set(jnp.where(live_s, shifted, NEG_INF),
+                                  mode="drop")
+        # On overflow the state is poisoned (entries dropped); the sticky
+        # flag makes every later wait() fail loudly rather than mis-verdict.
+        flag2 = flag | overflow.astype(jnp.int32)
+        table = build_sparse_table(out_v)
+        new_size = jnp.minimum(final_size, cap).astype(jnp.int32)
+
+        ndk = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
+                                          (KEY_LANES, d_cap))
+                          ).at[:, 0].set(jnp.zeros((KEY_LANES,), jnp.uint32))
+        ndv = jnp.full((d_cap,), NEG_INF, dtype=jnp.int32)
+        ndsize = jnp.int32(1)
+        return out_k, out_v, table, new_size, ndk, ndv, ndsize, flag2
+
+    return jax.jit(merge, donate_argnums=(0, 1, 2, 3, 4, 5, 6))

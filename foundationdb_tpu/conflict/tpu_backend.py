@@ -1,20 +1,29 @@
 """TPU-offloaded ConflictSet: the north-star backend (BASELINE.json).
 
-Drives the fused device kernel (conflict/fused.py), which runs the entire
-resolveBatch data path — too-old, history query, intra-batch fixpoint,
-insert, GC — in ONE device dispatch per commit batch.  The host's only jobs
-are encoding the batch into digest arrays and fetching the verdict array;
-the batch-to-batch dependency chain (window state) lives on device, so
-consecutive batches pipeline across the host<->device round trip via
-resolve_async() — the analog of the reference proxy keeping multiple commit
-batches in flight (CommitProxyServer.actor.cpp:589 pipeline gates).
+Drives the two-tier device kernels (conflict/fused.py): a lean per-batch
+step whose cost scales with the batch (plus log-capacity binary-search
+probes), and an amortized merge/GC step the host schedules every few batches
+or when the small delta tier approaches capacity.  The batch-to-batch
+dependency chain (delta state) lives on device, so consecutive batches
+pipeline across the host<->device round trip via resolve_async() — the
+analog of the reference proxy keeping multiple commit batches in flight
+(CommitProxyServer.actor.cpp:589 pipeline gates).
+
+Host work per batch is vectorized numpy only: callers either hand over a
+columnar EncodedBatch (zero Python loops — the bulk/bench path) or
+CommitTransactionRef objects (converted by EncodedBatch.from_transactions).
 
 Batch arrays are padded to power-of-two buckets so XLA compiles one program
 per bucket (SURVEY.md §7 hard part 2).  Versions are int32 offsets from
-self.version_base (rebased during the in-kernel GC).  Decisions are
-bit-identical to the CPU oracle for keys <= 23 bytes; longer keys round
-conservatively (extra aborts possible, missed conflicts impossible) — see
-ops/digest.py.
+self.version_base (rebased during merges).  Decisions are bit-identical to
+the CPU oracle for keys <= 23 bytes; longer keys round conservatively (extra
+aborts possible, missed conflicts impossible) — see ops/digest.py.
+
+Capacity overflow (live boundaries > capacity at a merge) sets a sticky
+device-side flag surfaced as an error at the next wait(); with the window
+floor advancing normally the merge GC keeps the state bounded and the flag
+never fires (reference RESOLVER_STATE_MEMORY_LIMIT plays the same role,
+Resolver.actor.cpp:126-135).
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ import numpy as np
 from ..core.knobs import server_knobs
 from ..txn.types import CommitResult, CommitTransactionRef, Version
 from .api import ConflictSet
+from .encoded import EncodedBatch
 
 _MIN_BUCKET = 256
 
@@ -40,85 +50,55 @@ def _bucket(n: int) -> int:
 class ResolveHandle:
     """In-flight resolution of one batch; wait() returns the verdicts."""
 
-    def __init__(self, cs: "TpuConflictSet", out, n_txns: int, t_cap: int,
-                 seq: int, retry_ctx: Optional[dict] = None) -> None:
+    def __init__(self, cs: "TpuConflictSet", out, n_txns: int,
+                 t_cap: int) -> None:
         self._cs = cs
         self._out = out
         self._n = n_txns
         self._t_cap = t_cap
-        self._seq = seq
-        self._retry_ctx = retry_ctx
         self._results: Optional[List[CommitResult]] = None
-        self._error: Optional[BaseException] = None
 
     def wait(self) -> List[CommitResult]:
-        if self._error is not None:
-            raise self._error
         if self._results is None:
+            from .fused import OUT_BSIZE, OUT_DSIZE, OUT_FLAG
             arr = np.asarray(self._out)  # one d2h transfer, syncs the step
             if self in self._cs._inflight:
                 self._cs._inflight.remove(self)
-                self._cs._live_boundaries = int(arr[self._t_cap + 1])
-            if bool(arr[self._t_cap]):  # insert overflowed
-                arr = self._handle_overflow()
+                self._cs._live_boundaries = int(
+                    arr[self._t_cap + OUT_DSIZE] +
+                    arr[self._t_cap + OUT_BSIZE])
+            if int(arr[self._t_cap + OUT_FLAG]):
+                from ..core.error import err
+                raise err(
+                    "internal_error",
+                    "TPU conflict window capacity exceeded; raise "
+                    "TPU_CONFLICT_CAPACITY or advance new_oldest_version")
             self._results = [CommitResult(c) for c in arr[:self._n]]
         return self._results
-
-    def _handle_overflow(self) -> np.ndarray:
-        """Emergency GC + one retry of the same batch (reference SkipList
-        overflow pressure is likewise relieved by forcing removeBefore).
-        Only possible when no later batch was ever DISPATCHED after this one
-        (not merely still unwaited): a later batch was resolved against a
-        window missing this batch's writes, and the retry would in turn see
-        that batch's writes at a later version — both directions wrong."""
-        from ..core.error import err
-        cs = self._cs
-        if cs._dispatch_seq != self._seq or self._retry_ctx is None:
-            self._error = err(
-                "internal_error",
-                "TPU conflict window capacity exceeded with later batches "
-                "in flight; raise TPU_CONFLICT_CAPACITY or gc interval")
-            raise self._error
-        cs._force_gc()
-        ctx = self._retry_ctx
-        h2 = cs._dispatch(ctx["enc"], ctx["now"], ctx["old_floor"],
-                          ctx["new_floor"], self._n, retry=True)
-        cs._inflight.remove(h2)
-        arr = np.asarray(h2._out)
-        cs._live_boundaries = int(arr[self._t_cap + 1])
-        if bool(arr[self._t_cap]):
-            self._error = err(
-                "internal_error",
-                "TPU conflict window capacity exceeded even after GC; "
-                "raise TPU_CONFLICT_CAPACITY")
-            raise self._error
-        return arr
 
 
 class TpuConflictSet(ConflictSet):
     def __init__(self, oldest_version: Version = 0,
                  capacity: Optional[int] = None,
+                 delta_capacity: Optional[int] = None,
                  gc_interval_batches: int = 8) -> None:
         super().__init__(oldest_version)
         import jax.numpy as jnp  # lazy: backend selectable without jax init
-        from . import fused, window
+        from . import fused
         self._jnp = jnp
         self._fused = fused
         self.capacity = capacity or int(server_knobs().TPU_CONFLICT_CAPACITY)
-        self.version_base = oldest_version
-        st = window.make_window_state(self.capacity, 0)
-        self.bk, self.bv, self.size = st.bk, st.bv, st.size
+        self.d_cap = min(delta_capacity or max(4096, self.capacity // 8),
+                         self.capacity)
         self._inflight: List[ResolveHandle] = []
-        self._live_boundaries = 1
         self._gc_interval = gc_interval_batches
-        self._batches_since_gc = 0
-        self._dispatch_seq = 0
+        self._reset_state(oldest_version)
 
     # An int32 offset span we never let live versions approach; beyond this
-    # resolve() forces a rebase, and if the window floor lags so far behind
-    # that rebasing cannot help, we fail loudly rather than clamp silently
-    # (a clamp could equate a write version and a later snapshot and miss a
-    # real conflict).
+    # resolve() forces a merge/rebase, and if the window floor lags so far
+    # behind that rebasing cannot help, we fail loudly rather than clamp
+    # silently (a clamp could equate a write version and a later snapshot
+    # and miss a real conflict).
     _REL_LIMIT = (1 << 31) - (1 << 24)
 
     def _rel(self, v: Version) -> int:
@@ -130,6 +110,25 @@ class TpuConflictSet(ConflictSet):
                       "advance new_oldest_version to allow rebasing")
         return int(max(off, -(1 << 31) + 2))
 
+    def _reset_state(self, version: Version) -> None:
+        """(Re)build the full device state: base at V(k)=version, table over
+        it, transparent delta, cleared sticky flag, reset merge scheduling."""
+        from .window import make_window_state
+        from ..ops.rangemax import build_sparse_table
+        self.version_base = version
+        st = make_window_state(self.capacity, 0)
+        self.bk, self.bv, self.size = st.bk, st.bv, st.size
+        self.table = build_sparse_table(self.bv)
+        dst = self._fused.make_delta_state(self.d_cap)
+        self.dk, self.dv, self.dsize = dst.bk, dst.bv, dst.size
+        self.flag = self._jnp.int32(0)
+        self._live_boundaries = 1
+        self._batches_since_merge = 0
+        # Sound upper bound on delta occupancy (insert adds <= 2W+0 net new
+        # boundaries per batch); drives proactive merge scheduling so the
+        # in-kernel overflow flag never fires in normal operation.
+        self._delta_bound = 1
+
     def clear(self, version: Version) -> None:
         # Like the reference clearConflictSet (SkipList.cpp:797): V(k) :=
         # version everywhere; oldest_version is deliberately NOT changed.
@@ -137,103 +136,83 @@ class TpuConflictSet(ConflictSet):
             from ..core.error import err
             raise err("internal_error",
                       "clear() with batches in flight; wait() them first")
-        from . import window
-        self.version_base = version
-        st = window.make_window_state(self.capacity, 0)
-        self.bk, self.bv, self.size = st.bk, st.bv, st.size
-        self._live_boundaries = 1
-        self._batches_since_gc = 0
+        self._reset_state(version)
 
-    def _force_gc(self) -> None:
-        """Immediate out-of-band removeBefore + rebase (overflow pressure)."""
-        from .window import WindowState, window_gc
-        jnp = self._jnp
-        delta = max(self.oldest_version - self.version_base, 0)
-        st = window_gc(WindowState(self.bk, self.bv, self.size),
-                       jnp.int32(self._rel(self.oldest_version)),
-                       jnp.int32(delta))
-        self.bk, self.bv, self.size = st.bk, st.bv, st.size
-        self.version_base += delta
-        self._batches_since_gc = 0
+    # -- merge scheduling ---------------------------------------------------
+    def merge(self) -> None:
+        """Overlay delta onto base, GC vs the window floor, rebase, rebuild
+        the base range-max table, reset delta.  Fully async (no sync)."""
+        delta_reb = max(self.oldest_version - self.version_base, 0)
+        scalars = np.asarray(
+            [self._rel(self.oldest_version), delta_reb], dtype=np.int32)
+        mstep = self._fused.make_merge_step(self.capacity, self.d_cap)
+        (self.bk, self.bv, self.table, self.size,
+         self.dk, self.dv, self.dsize, self.flag) = mstep(
+            self.bk, self.bv, self.size, self.dk, self.dv, self.dsize,
+            self.flag, self._jnp.asarray(scalars))
+        self.version_base += delta_reb
+        self._batches_since_merge = 0
+        self._delta_bound = 1
 
-    # -- batch encoding -----------------------------------------------------
-    def _encode_batch(self, transactions: Sequence[CommitTransactionRef]):
-        from ..ops.digest import KEY_LANES, MAX_DIGEST, encode_keys
-        n = len(transactions)
-        r_bk: List[bytes] = []
-        r_ek: List[bytes] = []
-        r_txn: List[int] = []
-        w_bk: List[bytes] = []
-        w_ek: List[bytes] = []
-        w_txn: List[int] = []
-        t_snap = np.empty((n,), dtype=np.int64)
-        t_has = np.empty((n,), dtype=bool)
-        for t, tr in enumerate(transactions):
-            t_snap[t] = tr.read_snapshot
-            t_has[t] = bool(tr.read_conflict_ranges)
-            for r in tr.read_conflict_ranges:
-                if r.begin < r.end:
-                    r_bk.append(r.begin)
-                    r_ek.append(r.end)
-                    r_txn.append(t)
-            for w in tr.write_conflict_ranges:
-                if w.begin < w.end:
-                    w_bk.append(w.begin)
-                    w_ek.append(w.end)
-                    w_txn.append(t)
+    def _grow_delta(self, needed: int) -> None:
+        """Re-provision the (empty, just-merged) delta tier at a larger
+        bucket; happens when a batch's write count outgrows the current
+        delta capacity."""
+        self.d_cap = min(_bucket(needed), self.capacity)
+        dst = self._fused.make_delta_state(self.d_cap)
+        self.dk, self.dv, self.dsize = dst.bk, dst.bv, dst.size
 
+    # -- batch packing ------------------------------------------------------
+    def _pack(self, enc: EncodedBatch):
+        """Bucket-pad the columnar batch into the two device input blocks."""
+        from ..ops.digest import max_digest_block
+        n = enc.n_txns
+        nr = enc.r_txn.shape[0]
+        nw = enc.w_txn.shape[0]
         t_cap = _bucket(n)
-        r_cap = _bucket(len(r_bk))
-        w_cap = _bucket(len(w_bk))
-        nr, nw = len(r_bk), len(w_bk)
+        r_cap = _bucket(nr)
+        w_cap = _bucket(nw)
 
-        # Packed digest block: r_b | r_e | w_b | w_e (one h2d transfer).
-        digests = np.broadcast_to(
-            MAX_DIGEST, (2 * r_cap + 2 * w_cap, KEY_LANES)).copy()
-        if nr:
-            digests[:nr] = encode_keys(r_bk)
-            digests[r_cap:r_cap + nr] = encode_keys(r_ek, round_up=True)
-        if nw:
-            digests[2 * r_cap:2 * r_cap + nw] = encode_keys(w_bk)
-            digests[2 * r_cap + w_cap:2 * r_cap + w_cap + nw] = \
-                encode_keys(w_ek, round_up=True)
+        # Packed digest block: r_b | r_e | w_b | w_e (one h2d transfer);
+        # planar uint32[6, 2R+2W].
+        digests = max_digest_block(2 * r_cap + 2 * w_cap)
+        digests[:, :nr] = enc.r_begin
+        digests[:, r_cap:r_cap + nr] = enc.r_end
+        digests[:, 2 * r_cap:2 * r_cap + nw] = enc.w_begin
+        digests[:, 2 * r_cap + w_cap:2 * r_cap + w_cap + nw] = enc.w_end
 
         # Packed int32 metadata block (second h2d transfer); scalar slots at
         # the end are filled by _dispatch.
         meta = np.zeros((self._fused.meta_size(t_cap, r_cap, w_cap),),
                         dtype=np.int32)
         o = 0
-        meta[o:o + nr] = r_txn; o += r_cap
+        meta[o:o + nr] = enc.r_txn; o += r_cap
         meta[o:o + nr] = 1; o += r_cap
-        meta[o:o + nw] = w_txn; o += w_cap
+        meta[o:o + nw] = enc.w_txn; o += w_cap
         meta[o:o + nw] = 1; o += w_cap
         snap_off = o; o += t_cap
-        meta[o:o + n] = t_has; o += t_cap
+        meta[o:o + n] = enc.t_has_reads; o += t_cap
         meta[o:o + n] = 1; o += t_cap
 
         return {"digests": digests, "meta": meta, "snap_off": snap_off,
-                "scalar_off": o, "t_snap_abs": t_snap,
+                "scalar_off": o, "t_snap_abs": enc.t_snap, "nw": nw,
                 "caps": (t_cap, r_cap, w_cap)}
 
     def _dispatch(self, enc, now: Version, oldest_floor: Version,
-                  new_oldest: Version, n_txns: int,
-                  retry: bool = False) -> ResolveHandle:
+                  n_txns: int) -> ResolveHandle:
         jnp = self._jnp
         t_cap, r_cap, w_cap = enc["caps"]
-        # Amortized GC cadence (reference removeBefore is likewise lazy);
-        # rebase rides the GC pass.  Deferring is decision-invariant: GC only
-        # merges segments wholly below the window floor.
-        if retry:
-            do_gc = False  # _force_gc just ran
-        else:
-            self._batches_since_gc += 1
-            do_gc = self._batches_since_gc >= self._gc_interval
-            # Proactive rebase long before the int32 offset span is at risk,
-            # regardless of the configured GC cadence (a huge gc_interval
-            # must not be able to strand version_base).
-            if now - self.version_base >= (1 << 30):
-                do_gc = True
-        delta = max(new_oldest - self.version_base, 0) if do_gc else 0
+        need = 2 * enc["nw"] + 2
+        if (self._delta_bound + need > self.d_cap
+                or self._batches_since_merge >= self._gc_interval
+                # Proactive rebase long before the int32 offset span is at
+                # risk, regardless of the merge cadence.
+                or now - self.version_base >= (1 << 30)):
+            self.merge()
+        if need > self.d_cap:
+            self._grow_delta(need)
+        self._delta_bound += need
+        self._batches_since_merge += 1
 
         meta = enc["meta"]
         so = enc["snap_off"]
@@ -246,45 +225,56 @@ class TpuConflictSet(ConflictSet):
                       "advance new_oldest_version to allow rebasing")
         meta[so:so + n_txns] = off.astype(np.int32)
         sc = enc["scalar_off"]
-        meta[sc:sc + 5] = (self._rel(now), self._rel(oldest_floor),
-                           self._rel(new_oldest), delta, int(do_gc))
+        meta[sc:sc + 2] = (self._rel(now), self._rel(oldest_floor))
 
-        step = self._fused.make_resolve_step(self.capacity, t_cap, r_cap, w_cap)
-        self.bk, self.bv, self.size, out = step(
-            self.bk, self.bv, self.size,
+        step = self._fused.make_resolve_step(
+            self.capacity, self.d_cap, t_cap, r_cap, w_cap)
+        self.dk, self.dv, self.dsize, self.flag, out = step(
+            self.bk, self.bv, self.table, self.size,
+            self.dk, self.dv, self.dsize, self.flag,
             jnp.asarray(enc["digests"]), jnp.asarray(meta))
-        self.version_base += delta
-        if do_gc:
-            self._batches_since_gc = 0
-        self._dispatch_seq += 1
-        handle = ResolveHandle(
-            self, out, n_txns, t_cap, self._dispatch_seq,
-            retry_ctx=None if retry else {
-                "enc": enc, "now": now, "old_floor": oldest_floor,
-                "new_floor": new_oldest})
+        handle = ResolveHandle(self, out, n_txns, t_cap)
         self._inflight.append(handle)
         return handle
 
     # -- public API ---------------------------------------------------------
+    def resolve_encoded_async(self, batch: EncodedBatch, now: Version,
+                              new_oldest_version: Optional[Version] = None
+                              ) -> ResolveHandle:
+        """Dispatch one pre-encoded batch; wait() on the handle for verdicts.
+
+        Batches MUST be dispatched in version order; the device delta state
+        carries the dependency, so any number may be in flight."""
+        old_floor = self.oldest_version
+        new_floor = max(new_oldest_version or old_floor, old_floor)
+        h = self._dispatch(self._pack(batch), now, old_floor, batch.n_txns)
+        self.oldest_version = new_floor
+        return h
+
     def resolve_async(self, transactions: Sequence[CommitTransactionRef],
                       now: Version,
                       new_oldest_version: Optional[Version] = None
                       ) -> ResolveHandle:
-        """Dispatch one batch; returns a handle whose wait() yields verdicts.
+        return self.resolve_encoded_async(
+            EncodedBatch.from_transactions(transactions), now,
+            new_oldest_version)
 
-        Batches MUST be dispatched in version order; the device window state
-        carries the dependency, so any number may be in flight."""
-        old_floor = self.oldest_version
-        new_floor = max(new_oldest_version or old_floor, old_floor)
-        enc = self._encode_batch(transactions)
-        h = self._dispatch(enc, now, old_floor, new_floor, len(transactions))
-        self.oldest_version = new_floor
-        return h
+    def resolve(self, transactions: Sequence[CommitTransactionRef],
+                now: Version,
+                new_oldest_version: Optional[Version] = None
+                ) -> List[CommitResult]:
+        return self.resolve_async(transactions, now,
+                                  new_oldest_version).wait()
 
-    def resolve(self, transactions: Sequence[CommitTransactionRef], now: Version,
-                new_oldest_version: Optional[Version] = None) -> List[CommitResult]:
-        return self.resolve_async(transactions, now, new_oldest_version).wait()
+    def resolve_encoded(self, batch: EncodedBatch, now: Version,
+                        new_oldest_version: Optional[Version] = None
+                        ) -> List[CommitResult]:
+        return self.resolve_encoded_async(batch, now,
+                                          new_oldest_version).wait()
 
     # -- introspection ------------------------------------------------------
     def segment_count(self) -> int:
-        return self._live_boundaries if not self._inflight else int(self.size)
+        """Upper bound on live boundaries as of the last wait()ed batch
+        (base + delta; cross-tier duplicate boundaries count twice, and a
+        merge dispatched since then is not yet reflected)."""
+        return self._live_boundaries

@@ -3,11 +3,12 @@
 TPU-first reformulation of the reference skip list (fdbserver/SkipList.cpp).
 The skip list maintains a piecewise-constant function V(key) = version of the
 last write covering key, as nodes with per-level max versions.  Here the same
-function is a pair of HBM-resident capacity-padded arrays:
+function is a pair of HBM-resident capacity-padded arrays (planar layout,
+see ops/digest.py):
 
-    bk: uint32[CAP, 6]  sorted boundary digests (padding = MAX_DIGEST)
-    bv: int32[CAP]      version of segment [bk[i], bk[i+1])  (padding NEG_INF)
-    size: int32[]       live boundary count
+    bk: uint32[6, CAP] sorted boundary digests (padding = MAX_DIGEST)
+    bv: int32[CAP]     version of segment [bk[:,i], bk[:,i+1]) (pad NEG_INF)
+    size: int32[]      live boundary count
 
 Versions are int32 offsets from a host-held base (the 5s MVCC window spans
 5e6 versions, ServerKnobs VERSIONS_PER_SECOND; int32 gives ~35min before a
@@ -29,28 +30,27 @@ flow, so each kernel compiles once per bucket and runs entirely on device.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.digest import (KEY_LANES, MAX_DIGEST, lex_eq, lex_less,
+from ..ops.digest import (KEY_LANES, MAX_DIGEST, lex_eq, max_digest_block,
                           searchsorted_left, searchsorted_right)
 from ..ops.rangemax import NEG_INF, build_sparse_table, range_max
 
 
 class WindowState(NamedTuple):
-    bk: jnp.ndarray    # uint32[CAP, 6]
+    bk: jnp.ndarray    # uint32[6, CAP]
     bv: jnp.ndarray    # int32[CAP]
     size: jnp.ndarray  # int32[]
 
 
 def make_window_state(cap: int, init_version_rel: int = 0) -> WindowState:
     assert cap & (cap - 1) == 0, "capacity must be a power of two"
-    bk = np.broadcast_to(MAX_DIGEST, (cap, KEY_LANES)).copy()
-    bk[0] = 0  # digest(b"") = all zeros: the segment covering all keys
+    bk = max_digest_block(cap)
+    bk[:, 0] = 0  # digest(b"") = all zeros: the segment covering all keys
     bv = np.full((cap,), int(NEG_INF), dtype=np.int32)
     bv[0] = init_version_rel
     return WindowState(jnp.asarray(bk), jnp.asarray(bv),
@@ -83,34 +83,36 @@ def window_query(bk: jnp.ndarray, bv: jnp.ndarray,
 def _union_ranges(w_begin, w_end, w_valid):
     """Merge overlapping/touching [begin,end) ranges.
 
-    Returns (mb, me, m_valid): sorted disjoint merged ranges, padded MAX.
-    Endpoint sweep: +1 at begins, -1 at ends, begins first on ties; a merged
-    range starts where coverage hits 1 and ends where it returns to 0
-    (reference combineWriteConflictRanges, SkipList.cpp:996)."""
-    w = w_begin.shape[0]
-    max_row = jnp.asarray(np.broadcast_to(MAX_DIGEST, (w, KEY_LANES)))
-    b = jnp.where(w_valid[:, None], w_begin, max_row)
-    e = jnp.where(w_valid[:, None], w_end, max_row)
-    digests = jnp.concatenate([b, e], axis=0)                   # [2W, 6]
+    w_begin/w_end: uint32[6, W] planar.  Returns (mb, me, m_valid): sorted
+    disjoint merged ranges, padded MAX.  Endpoint sweep: +1 at begins, -1 at
+    ends, begins first on ties; a merged range starts where coverage hits 1
+    and ends where it returns to 0 (reference combineWriteConflictRanges,
+    SkipList.cpp:996)."""
+    w = w_begin.shape[1]
+    max_col = jnp.asarray(MAX_DIGEST)[:, None]
+    b = jnp.where(w_valid[None, :], w_begin, max_col)
+    e = jnp.where(w_valid[None, :], w_end, max_col)
+    digests = jnp.concatenate([b, e], axis=1)                   # [6, 2W]
     tie = jnp.concatenate([jnp.zeros((w,), jnp.int32),
                            jnp.ones((w,), jnp.int32)])          # begins first
     delta = jnp.concatenate([
         jnp.where(w_valid, 1, 0).astype(jnp.int32),
         jnp.where(w_valid, -1, 0).astype(jnp.int32)])
     # lexicographic sort over 6 lanes + tie; delta rides along
-    ops = [digests[:, l] for l in range(KEY_LANES)] + [tie, delta]
+    ops = [digests[l] for l in range(KEY_LANES)] + [tie, delta]
     sorted_ops = jax.lax.sort(ops, num_keys=KEY_LANES + 1)
-    s_digest = jnp.stack(sorted_ops[:KEY_LANES], axis=1)
+    s_digest = jnp.stack(sorted_ops[:KEY_LANES], axis=0)        # [6, 2W]
     s_delta = sorted_ops[KEY_LANES + 1]
     cov = jnp.cumsum(s_delta)
     is_start = (s_delta > 0) & (cov == 1)
     is_end = (s_delta < 0) & (cov == 0)
-    # compact starts and ends to the front of [W]-sized arrays
+    # compact starts and ends to the front of [6, W]-sized arrays
     def compact(mask):
         rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
         idx = jnp.where(mask, rank, 2 * w)  # out-of-bounds -> dropped
-        out = jnp.asarray(np.broadcast_to(MAX_DIGEST, (w, KEY_LANES)))
-        out = out.at[idx].set(s_digest, mode="drop")
+        out = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
+                                          (KEY_LANES, w)))
+        out = out.at[:, idx].set(s_digest, mode="drop")
         return out
     mb = compact(is_start)
     me = compact(is_end)
@@ -131,7 +133,7 @@ def window_insert(state: WindowState, w_begin: jnp.ndarray, w_end: jnp.ndarray,
     overflow_flag); on overflow the state is unchanged and the host must GC
     or grow capacity."""
     bk, bv, size = state
-    cap, w = bk.shape[0], w_begin.shape[0]
+    cap, w = bk.shape[1], w_begin.shape[1]
     idx_cap = jnp.arange(cap, dtype=jnp.int32)
     live = idx_cap < size
 
@@ -142,7 +144,7 @@ def window_insert(state: WindowState, w_begin: jnp.ndarray, w_end: jnp.ndarray,
     cont_v = bv[slot]
     # Is there already a boundary exactly at end?
     p = searchsorted_left(bk, me)
-    present_end = lex_eq(bk[jnp.minimum(p, cap - 1)], me) & (p < size)
+    present_end = lex_eq(bk[:, jnp.minimum(p, cap - 1)], me) & (p < size)
 
     # Old boundaries strictly inside any merged range are dropped; a boundary
     # equal to a begin is also dropped (replaced by the new begin entry).
@@ -155,26 +157,26 @@ def window_insert(state: WindowState, w_begin: jnp.ndarray, w_end: jnp.ndarray,
     kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
     kept_count = jnp.sum(keep.astype(jnp.int32))
     scatter_idx = jnp.where(keep, kept_rank, cap)
-    old_k = jnp.asarray(np.broadcast_to(MAX_DIGEST, (cap, KEY_LANES)))
-    old_k = old_k.at[scatter_idx].set(bk, mode="drop")
+    old_k = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
+                                        (KEY_LANES, cap)))
+    old_k = old_k.at[:, scatter_idx].set(bk, mode="drop")
     old_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
     old_v = old_v.at[scatter_idx].set(bv, mode="drop")
 
     # New entries: begins at now, ends at cont_v (suppressed if present).
     end_valid = m_valid & ~present_end
-    max_row_w = jnp.asarray(np.broadcast_to(MAX_DIGEST, (w, KEY_LANES)))
-    nb = jnp.where(m_valid[:, None], mb, max_row_w)
-    ne = jnp.where(end_valid[:, None], me, max_row_w)
-    new_digest = jnp.concatenate([nb, ne], axis=0)              # [2W, 6]
+    max_col = jnp.asarray(MAX_DIGEST)[:, None]
+    nb = jnp.where(m_valid[None, :], mb, max_col)
+    ne = jnp.where(end_valid[None, :], me, max_col)
+    new_digest = jnp.concatenate([nb, ne], axis=1)              # [6, 2W]
     new_v = jnp.concatenate([
         jnp.where(m_valid, now_rel, NEG_INF).astype(jnp.int32),
         jnp.where(end_valid, cont_v, NEG_INF).astype(jnp.int32)])
-    ops = [new_digest[:, l] for l in range(KEY_LANES)] + [new_v]
+    ops = [new_digest[l] for l in range(KEY_LANES)] + [new_v]
     sorted_ops = jax.lax.sort(ops, num_keys=KEY_LANES)
-    new_digest = jnp.stack(sorted_ops[:KEY_LANES], axis=1)
+    new_digest = jnp.stack(sorted_ops[:KEY_LANES], axis=0)
     new_v = sorted_ops[KEY_LANES]
-    new_valid = ~lex_eq(new_digest,
-                        jnp.asarray(MAX_DIGEST)[None, :].repeat(2 * w, 0))
+    new_valid = ~lex_eq(new_digest, jnp.asarray(MAX_DIGEST)[:, None])
     new_count = jnp.sum(new_valid.astype(jnp.int32))
 
     # Interleave positions: no duplicates exist between kept-old and new.
@@ -182,15 +184,16 @@ def window_insert(state: WindowState, w_begin: jnp.ndarray, w_end: jnp.ndarray,
         2 * w, dtype=jnp.int32)
     pos_old = idx_cap + searchsorted_left(new_digest, old_k)
 
-    out_k = jnp.asarray(np.broadcast_to(MAX_DIGEST, (cap, KEY_LANES)))
+    out_k = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
+                                        (KEY_LANES, cap)))
     out_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
     new_size = kept_count + new_count
     overflow = new_size > cap
 
     old_dst = jnp.where((idx_cap < kept_count) & ~overflow, pos_old, cap)
     new_dst = jnp.where(new_valid & ~overflow, pos_new, cap)
-    out_k = out_k.at[old_dst].set(old_k, mode="drop")
-    out_k = out_k.at[new_dst].set(new_digest, mode="drop")
+    out_k = out_k.at[:, old_dst].set(old_k, mode="drop")
+    out_k = out_k.at[:, new_dst].set(new_digest, mode="drop")
     out_v = out_v.at[old_dst].set(old_v, mode="drop")
     out_v = out_v.at[new_dst].set(new_v, mode="drop")
 
@@ -211,7 +214,7 @@ def window_gc(state: WindowState, oldest_rel: jnp.ndarray,
     predecessor are below the floor (SkipList.cpp:576-607 wasAbove logic);
     then shift all versions down by rebase_delta."""
     bk, bv, size = state
-    cap = bk.shape[0]
+    cap = bk.shape[1]
     idx = jnp.arange(cap, dtype=jnp.int32)
     live = idx < size
     above = bv >= oldest_rel
@@ -220,9 +223,10 @@ def window_gc(state: WindowState, oldest_rel: jnp.ndarray,
 
     rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
     dst = jnp.where(keep, rank, cap)
-    out_k = jnp.asarray(np.broadcast_to(MAX_DIGEST, (cap, KEY_LANES)))
+    out_k = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
+                                        (KEY_LANES, cap)))
     out_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
-    out_k = out_k.at[dst].set(bk, mode="drop")
+    out_k = out_k.at[:, dst].set(bk, mode="drop")
     shifted = jnp.maximum(bv - rebase_delta, NEG_INF + 1)
     out_v = out_v.at[dst].set(jnp.where(live, shifted, NEG_INF), mode="drop")
     return WindowState(out_k, out_v, jnp.sum(keep.astype(jnp.int32)))

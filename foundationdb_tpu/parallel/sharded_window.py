@@ -67,22 +67,23 @@ def digest_splits(n_shards: int) -> np.ndarray:
     return splits
 
 
-def _lex_max_rows(a: jnp.ndarray, b_row: jnp.ndarray) -> jnp.ndarray:
-    """Rowwise max(a[i], b_row) lexicographically; a: [N,6], b_row: [6]."""
-    b = jnp.broadcast_to(b_row, a.shape)
-    return jnp.where(lex_less(a, b)[:, None], b, a)
+def _lex_max_cols(a: jnp.ndarray, b_col: jnp.ndarray) -> jnp.ndarray:
+    """Columnwise max(a[:, i], b_col) lexicographically; a: [6, N] planar,
+    b_col: [6]."""
+    b = jnp.broadcast_to(b_col[:, None], a.shape)
+    return jnp.where(lex_less(a, b)[None, :], b, a)
 
 
-def _lex_min_rows(a: jnp.ndarray, b_row: jnp.ndarray) -> jnp.ndarray:
-    b = jnp.broadcast_to(b_row, a.shape)
-    return jnp.where(lex_less(b, a)[:, None], b, a)
+def _lex_min_cols(a: jnp.ndarray, b_col: jnp.ndarray) -> jnp.ndarray:
+    b = jnp.broadcast_to(b_col[:, None], a.shape)
+    return jnp.where(lex_less(b, a)[None, :], b, a)
 
 
 class ShardedWindow:
     """Host handle for a conflict window sharded over mesh axis "kr".
 
     State arrays carry a leading shard axis of size D(kr):
-        bk:   uint32[D, CAP, 6]   sharded P("kr")
+        bk:   uint32[D, 6, CAP]   sharded P("kr") (planar, ops/digest.py)
         bv:   int32[D, CAP]       sharded P("kr")
         size: int32[D]            sharded P("kr")
     Queries/writes enter replicated; conflict bits leave sharded over "q".
@@ -97,11 +98,12 @@ class ShardedWindow:
         kr_sharding = NamedSharding(mesh, P("kr"))
 
         d = self.n_shards
-        bk = np.broadcast_to(MAX_DIGEST, (d, capacity, KEY_LANES)).copy()
+        bk = np.broadcast_to(MAX_DIGEST[None, :, None],
+                             (d, KEY_LANES, capacity)).copy()
         bv = np.full((d, capacity), int(NEG_INF), dtype=np.int32)
         # Each shard's base segment starts at its own lower split and carries
         # version 0 (== the window floor at creation).
-        bk[:, 0, :] = splits[:d]
+        bk[:, :, 0] = splits[:d]
         bv[:, 0] = 0
         size = np.ones((d,), dtype=np.int32)
         self.bk = jax.device_put(bk, kr_sharding)
@@ -118,19 +120,19 @@ class ShardedWindow:
 
         def shard_fn(lo, hi, bk, bv, size,
                      qb, qe, qsnap, qvalid, wb, we, wvalid, now_rel):
-            # block shapes: lo/hi [1,6]; bk [1,CAP,6]; bv [1,CAP]; size [1];
-            # queries sharded over "q": qb [R/Q, 6]; writes replicated [W, 6].
+            # block shapes: lo/hi [1,6]; bk [1,6,CAP]; bv [1,CAP]; size [1];
+            # queries sharded over "q": qb [6, R/Q]; writes replicated [6, W].
             lo_r, hi_r = lo[0], hi[0]
             bk0, bv0, size0 = bk[0], bv[0], size[0]
             # --- query: clip to shard, answer locally, OR-reduce over kr ---
-            cqb = _lex_max_rows(qb, lo_r)
-            cqe = _lex_min_rows(qe, hi_r)
+            cqb = _lex_max_cols(qb, lo_r)
+            cqe = _lex_min_cols(qe, hi_r)
             qv = qvalid & lex_less(cqb, cqe)
             local_bits = window_query(bk0, bv0, cqb, cqe, qsnap, qv)
             bits = jax.lax.psum(local_bits.astype(jnp.int32), "kr") > 0
             # --- insert: clip writes to shard, merge locally ---------------
-            cwb = _lex_max_rows(wb, lo_r)
-            cwe = _lex_min_rows(we, hi_r)
+            cwb = _lex_max_cols(wb, lo_r)
+            cwe = _lex_min_cols(we, hi_r)
             wv = wvalid & lex_less(cwb, cwe)
             (nbk, nbv, nsize), ovf = window_insert(
                 WindowState(bk0, bv0, size0), cwb, cwe, wv, now_rel)
@@ -149,7 +151,7 @@ class ShardedWindow:
         mapped = jax.shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P("kr"), P("kr"), P("kr"), P("kr"), P("kr"),
-                      P("q"), P("q"), P("q"), P("q"),
+                      P(None, "q"), P(None, "q"), P("q"), P("q"),
                       P(), P(), P(), P()),
             out_specs=(P("q"), P("kr"), P("kr"), P("kr"), P()),
             check_vma=False)

@@ -107,10 +107,11 @@ def test_tpu_intra_batch(small_caps):
 
 
 def test_tpu_capacity_overflow_recovers():
-    """Filling the window past capacity forces GC; old segments vanish.
+    """Filling the window past capacity stays correct and bounded.
 
-    gc_interval_batches is set huge so the amortized cadence never fires;
-    recovery must come from the overflow -> _force_gc -> retry path."""
+    gc_interval_batches is set huge so the scheduled merge cadence never
+    fires; recovery must come from the delta-occupancy-bound merge scheduling
+    plus the merge GC dropping sub-floor segments."""
     tpu = TpuConflictSet(0, capacity=256, gc_interval_batches=1_000_000)
     now = 0
     for i in range(40):
@@ -121,7 +122,29 @@ def test_tpu_capacity_overflow_recovers():
             for j in range(10)]
         res = tpu.resolve(txns, now, now - 3_000_000)
         assert all(r == CommitResult.COMMITTED for r in res)
+    # Observe a merged state: GC must have kept the window far below the
+    # 40-batch * 20-boundary total.
+    tpu.merge()
+    probe = [CommitTransactionRef(write_conflict_ranges=[
+        KeyRange(b"zz", b"zz\x00")])]
+    tpu.resolve(probe, now + 1, now - 3_000_000)
     assert tpu.segment_count() <= 256
+
+
+def test_tpu_overflow_flag_raises():
+    """With the window floor pinned at 0, merge GC cannot drop anything, so
+    overflowing the capacity must surface the sticky in-kernel flag as an
+    error at wait() — never silent mis-verdicts."""
+    import pytest
+    tpu = TpuConflictSet(0, capacity=256, delta_capacity=256)
+    now = 0
+    with pytest.raises(Exception, match="capacity exceeded"):
+        for i in range(40):
+            now += 1_000
+            txns = [CommitTransactionRef(write_conflict_ranges=[
+                KeyRange(b"%05d" % (i * 10 + j), b"%05d\x00" % (i * 10 + j))])
+                for j in range(10)]
+            tpu.resolve(txns, now)  # floor never advances
 
 
 def test_clear_matches_oracle(small_caps):
