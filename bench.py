@@ -40,7 +40,7 @@ VERSIONS_PER_BATCH = 1_000
 WINDOW_BATCHES = 5         # MVCC floor trails this many batches
 PIPELINE_DEPTH = 8
 CAPACITY = 1 << 21
-DELTA_CAPACITY = 1 << 19
+DELTA_CAPACITY = 1 << 20
 
 
 def gen_batch(rng: np.random.Generator, version: int, prev: int):
@@ -75,6 +75,7 @@ def gen_batch(rng: np.random.Generator, version: int, prev: int):
         r_begin=begin[:, :nr], r_end=end[:, :nr],
         w_txn=np.arange(t, dtype=np.int32),
         w_begin=begin[:, nr:], w_end=end[:, nr:],
+        all_point=True,
     )
     return enc, kids, snaps
 
@@ -114,7 +115,8 @@ def main() -> None:
 
     window = WINDOW_BATCHES * VERSIONS_PER_BATCH
     rng = np.random.default_rng(2026)
-    total = N_WARMUP + N_BATCHES + N_LATENCY
+    total = (N_WARMUP + N_PARITY if backend == "cpu"
+             else N_WARMUP + N_BATCHES + N_LATENCY)
     batches = []
     version = 1_000
     for _ in range(total):
@@ -158,24 +160,24 @@ def main() -> None:
     n_txns = 0
     committed = 0
     tpu_results = []
+    committed_code = int(CommitResult.COMMITTED)
     t0 = time.perf_counter()
     for v, enc, kids, snaps in batches[N_WARMUP:N_WARMUP + N_BATCHES]:
         inflight.append((enc, cs.resolve_encoded_async(enc, v, floor(v))))
         if len(inflight) > PIPELINE_DEPTH:
             enc_done, h = inflight.popleft()
-            results = h.wait()
-            tpu_results.append(results)
+            codes = h.wait_codes()
+            tpu_results.append(codes)
             n_txns += enc_done.n_txns
             n_ranges += enc_done.n_ranges
-            committed += sum(1 for r in results
-                             if r == CommitResult.COMMITTED)
+            committed += int(np.sum(codes == committed_code))
     while inflight:
         enc_done, h = inflight.popleft()
-        results = h.wait()
-        tpu_results.append(results)
+        codes = h.wait_codes()
+        tpu_results.append(codes)
         n_txns += enc_done.n_txns
         n_ranges += enc_done.n_ranges
-        committed += sum(1 for r in results if r == CommitResult.COMMITTED)
+        committed += int(np.sum(codes == committed_code))
     dt = time.perf_counter() - t0
     value = n_ranges / dt
 
@@ -183,7 +185,7 @@ def main() -> None:
     lats = []
     for v, enc, kids, snaps in batches[N_WARMUP + N_BATCHES:]:
         t1 = time.perf_counter()
-        cs.resolve_encoded(enc, v, floor(v))
+        cs.resolve_encoded_async(enc, v, floor(v)).wait_codes()
         lats.append(time.perf_counter() - t1)
     p50_ms = float(np.percentile(lats, 50) * 1e3)
 
@@ -201,7 +203,8 @@ def main() -> None:
         oracle_ranges += enc.n_ranges
         if N_WARMUP <= i < N_WARMUP + N_PARITY:
             got = tpu_results[i - N_WARMUP]
-            mismatches += sum(1 for a, b in zip(got, want) if a != b)
+            want_codes = np.asarray([int(r) for r in want], dtype=np.int8)
+            mismatches += int(np.sum(got != want_codes))
     oracle_rate = oracle_ranges / oracle_dt
     if mismatches:
         print(f"PARITY FAILURE: {mismatches} verdicts differ from the "

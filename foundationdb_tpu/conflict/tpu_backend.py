@@ -56,24 +56,46 @@ class ResolveHandle:
         self._out = out
         self._n = n_txns
         self._t_cap = t_cap
+        self._depoch = cs._delta_epoch
+        self._seq = cs._seq
+        self._codes: Optional[np.ndarray] = None
         self._results: Optional[List[CommitResult]] = None
 
-    def wait(self) -> List[CommitResult]:
-        if self._results is None:
+    def wait_codes(self) -> np.ndarray:
+        """int8[n_txns] verdict codes (CommitResult values) — the zero-copy
+        bulk path; wait() wraps these in CommitResult objects."""
+        if self._codes is None:
             from .fused import OUT_BSIZE, OUT_DSIZE, OUT_FLAG
             arr = np.asarray(self._out)  # one d2h transfer, syncs the step
+            extras = arr[self._t_cap:self._t_cap + 12].copy().view(np.int32)
             if self in self._cs._inflight:
                 self._cs._inflight.remove(self)
                 self._cs._live_boundaries = int(
-                    arr[self._t_cap + OUT_DSIZE] +
-                    arr[self._t_cap + OUT_BSIZE])
-            if int(arr[self._t_cap + OUT_FLAG]):
+                    extras[OUT_DSIZE] + extras[OUT_BSIZE])
+                # Tighten the host's sound delta-occupancy bound with the
+                # actual device size: actual at this batch + the worst-case
+                # growth of batches dispatched since.  Skipped if a merge
+                # re-provisioned the delta after this batch was dispatched.
+                cs = self._cs
+                if (self._depoch == cs._delta_epoch
+                        and self._seq > cs._corrected_seq):
+                    cs._corrected_seq = self._seq
+                    for s in [s for s in cs._needs if s <= self._seq]:
+                        del cs._needs[s]
+                    cs._delta_bound = (int(extras[OUT_DSIZE]) +
+                                       sum(cs._needs.values()))
+            if int(extras[OUT_FLAG]):
                 from ..core.error import err
                 raise err(
                     "internal_error",
                     "TPU conflict window capacity exceeded; raise "
                     "TPU_CONFLICT_CAPACITY or advance new_oldest_version")
-            self._results = [CommitResult(c) for c in arr[:self._n]]
+            self._codes = arr[:self._n]
+        return self._codes
+
+    def wait(self) -> List[CommitResult]:
+        if self._results is None:
+            self._results = [CommitResult(c) for c in self.wait_codes()]
         return self._results
 
 
@@ -88,8 +110,9 @@ class TpuConflictSet(ConflictSet):
         self._jnp = jnp
         self._fused = fused
         self.capacity = capacity or int(server_knobs().TPU_CONFLICT_CAPACITY)
-        self.d_cap = min(delta_capacity or max(4096, self.capacity // 8),
-                         self.capacity)
+        self._d_cap0 = min(delta_capacity or max(4096, self.capacity // 8),
+                           self.capacity)
+        self.d_cap = self._d_cap0
         self._inflight: List[ResolveHandle] = []
         self._gc_interval = gc_interval_batches
         self._reset_state(oldest_version)
@@ -126,8 +149,14 @@ class TpuConflictSet(ConflictSet):
         self._batches_since_merge = 0
         # Sound upper bound on delta occupancy (insert adds <= 2W+0 net new
         # boundaries per batch); drives proactive merge scheduling so the
-        # in-kernel overflow flag never fires in normal operation.
+        # in-kernel overflow flag never fires in normal operation.  The
+        # bound is tightened with actual device-reported sizes as handles
+        # are waited (see ResolveHandle.wait_codes).
         self._delta_bound = 1
+        self._delta_epoch = getattr(self, "_delta_epoch", 0) + 1
+        self._seq = getattr(self, "_seq", 0)
+        self._corrected_seq = getattr(self, "_corrected_seq", 0)
+        self._needs: dict = {}
 
     def clear(self, version: Version) -> None:
         # Like the reference clearConflictSet (SkipList.cpp:797): V(k) :=
@@ -150,9 +179,17 @@ class TpuConflictSet(ConflictSet):
          self.dk, self.dv, self.dsize, self.flag) = mstep(
             self.bk, self.bv, self.size, self.dk, self.dv, self.dsize,
             self.flag, self._jnp.asarray(scalars))
+        if self.d_cap != self._d_cap0:
+            # The delta is empty post-merge: shrink an outlier-batch growth
+            # back so later batches don't keep paying the larger tier.
+            self.d_cap = self._d_cap0
+            dst = self._fused.make_delta_state(self.d_cap)
+            self.dk, self.dv, self.dsize = dst.bk, dst.bv, dst.size
         self.version_base += delta_reb
         self._batches_since_merge = 0
         self._delta_bound = 1
+        self._delta_epoch += 1
+        self._needs.clear()
 
     def _grow_delta(self, needed: int) -> None:
         """Re-provision the (empty, just-merged) delta tier at a larger
@@ -196,7 +233,8 @@ class TpuConflictSet(ConflictSet):
 
         return {"digests": digests, "meta": meta, "snap_off": snap_off,
                 "scalar_off": o, "t_snap_abs": enc.t_snap, "nw": nw,
-                "caps": (t_cap, r_cap, w_cap)}
+                "caps": (t_cap, r_cap, w_cap),
+                "all_point": bool(enc.all_point)}
 
     def _dispatch(self, enc, now: Version, oldest_floor: Version,
                   n_txns: int) -> ResolveHandle:
@@ -212,6 +250,8 @@ class TpuConflictSet(ConflictSet):
         if need > self.d_cap:
             self._grow_delta(need)
         self._delta_bound += need
+        self._seq += 1
+        self._needs[self._seq] = need
         self._batches_since_merge += 1
 
         meta = enc["meta"]
@@ -228,7 +268,8 @@ class TpuConflictSet(ConflictSet):
         meta[sc:sc + 2] = (self._rel(now), self._rel(oldest_floor))
 
         step = self._fused.make_resolve_step(
-            self.capacity, self.d_cap, t_cap, r_cap, w_cap)
+            self.capacity, self.d_cap, t_cap, r_cap, w_cap,
+            enc["all_point"])
         self.dk, self.dv, self.dsize, self.flag, out = step(
             self.bk, self.bv, self.table, self.size,
             self.dk, self.dv, self.dsize, self.flag,

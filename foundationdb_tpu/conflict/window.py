@@ -36,8 +36,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.digest import (KEY_LANES, MAX_DIGEST, lex_eq, max_digest_block,
-                          searchsorted_left, searchsorted_right)
+from ..ops.digest import (KEY_LANES, MAX_DIGEST, ROW_PAD, gather_cols,
+                          lex_eq, max_digest_block, planar_to_rows,
+                          rows_to_planar, searchsorted_left,
+                          searchsorted_right)
 from ..ops.rangemax import NEG_INF, build_sparse_table, range_max
 
 
@@ -106,14 +108,15 @@ def _union_ranges(w_begin, w_end, w_valid):
     cov = jnp.cumsum(s_delta)
     is_start = (s_delta > 0) & (cov == 1)
     is_end = (s_delta < 0) & (cov == 0)
-    # compact starts and ends to the front of [6, W]-sized arrays
+    # compact starts and ends to the front of [6, W]-sized arrays (row-space
+    # scatters: one row write per element instead of 6 strided lane writes)
+    s_rows = planar_to_rows(s_digest)
+    max_row = jnp.full((w, ROW_PAD), 0xFFFFFFFF, dtype=jnp.uint32)
+
     def compact(mask):
         rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
-        idx = jnp.where(mask, rank, 2 * w)  # out-of-bounds -> dropped
-        out = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
-                                          (KEY_LANES, w)))
-        out = out.at[:, idx].set(s_digest, mode="drop")
-        return out
+        idx = jnp.where(mask, rank, w)  # out-of-bounds -> dropped
+        return rows_to_planar(max_row.at[idx].set(s_rows, mode="drop"))
     mb = compact(is_start)
     me = compact(is_end)
     m_count = jnp.sum(is_start.astype(jnp.int32))
@@ -144,7 +147,8 @@ def window_insert(state: WindowState, w_begin: jnp.ndarray, w_end: jnp.ndarray,
     cont_v = bv[slot]
     # Is there already a boundary exactly at end?
     p = searchsorted_left(bk, me)
-    present_end = lex_eq(bk[:, jnp.minimum(p, cap - 1)], me) & (p < size)
+    present_end = lex_eq(gather_cols(bk, jnp.minimum(p, cap - 1)), me) & (
+        p < size)
 
     # Old boundaries strictly inside any merged range are dropped; a boundary
     # equal to a begin is also dropped (replaced by the new begin entry).
@@ -153,13 +157,14 @@ def window_insert(state: WindowState, w_begin: jnp.ndarray, w_end: jnp.ndarray,
     inside = cnt_b > cnt_e
     keep = live & ~inside
 
-    # Compact kept old entries.
+    # Compact kept old entries (row-space scatter).
     kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
     kept_count = jnp.sum(keep.astype(jnp.int32))
     scatter_idx = jnp.where(keep, kept_rank, cap)
-    old_k = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
-                                        (KEY_LANES, cap)))
-    old_k = old_k.at[:, scatter_idx].set(bk, mode="drop")
+    max_rows_cap = jnp.full((cap, ROW_PAD), 0xFFFFFFFF, dtype=jnp.uint32)
+    old_rows = max_rows_cap.at[scatter_idx].set(planar_to_rows(bk),
+                                                mode="drop")
+    old_k = rows_to_planar(old_rows)
     old_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
     old_v = old_v.at[scatter_idx].set(bv, mode="drop")
 
@@ -184,16 +189,16 @@ def window_insert(state: WindowState, w_begin: jnp.ndarray, w_end: jnp.ndarray,
         2 * w, dtype=jnp.int32)
     pos_old = idx_cap + searchsorted_left(new_digest, old_k)
 
-    out_k = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
-                                        (KEY_LANES, cap)))
     out_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
     new_size = kept_count + new_count
     overflow = new_size > cap
 
     old_dst = jnp.where((idx_cap < kept_count) & ~overflow, pos_old, cap)
     new_dst = jnp.where(new_valid & ~overflow, pos_new, cap)
-    out_k = out_k.at[:, old_dst].set(old_k, mode="drop")
-    out_k = out_k.at[:, new_dst].set(new_digest, mode="drop")
+    out_rows = max_rows_cap.at[old_dst].set(old_rows, mode="drop")
+    out_rows = out_rows.at[new_dst].set(planar_to_rows(new_digest),
+                                        mode="drop")
+    out_k = rows_to_planar(out_rows)
     out_v = out_v.at[old_dst].set(old_v, mode="drop")
     out_v = out_v.at[new_dst].set(new_v, mode="drop")
 
@@ -223,10 +228,10 @@ def window_gc(state: WindowState, oldest_rel: jnp.ndarray,
 
     rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
     dst = jnp.where(keep, rank, cap)
-    out_k = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
-                                        (KEY_LANES, cap)))
+    out_rows = jnp.full((cap, ROW_PAD), 0xFFFFFFFF, dtype=jnp.uint32)
+    out_k = rows_to_planar(
+        out_rows.at[dst].set(planar_to_rows(bk), mode="drop"))
     out_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
-    out_k = out_k.at[:, dst].set(bk, mode="drop")
     shifted = jnp.maximum(bv - rebase_delta, NEG_INF + 1)
     out_v = out_v.at[dst].set(jnp.where(live, shifted, NEG_INF), mode="drop")
     return WindowState(out_k, out_v, jnp.sum(keep.astype(jnp.int32)))

@@ -37,6 +37,42 @@ def test_tpu_matches_oracle_random(seed, small_caps):
         assert got == want, f"divergence at now={now}"
 
 
+def random_point_txn(rng, nkeys, now, window):
+    """Point-only transaction over a hot keyspace: every range is
+    [k, k+\\x00), so EncodedBatch marks the batch all_point and the device
+    takes the scatter-min fast path (fused.py make_resolve_step)."""
+    snap = now - rng.random_int(0, window)
+    tr = CommitTransactionRef(read_snapshot=max(snap, 0))
+    for _ in range(rng.random_int(0, 4)):
+        k = b"k%03d" % rng.random_int(0, nkeys - 1)
+        tr.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+    for _ in range(rng.random_int(0, 3)):
+        k = b"k%03d" % rng.random_int(0, nkeys - 1)
+        tr.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+    return tr
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_tpu_point_path_matches_oracle(seed, small_caps):
+    from foundationdb_tpu.conflict.encoded import EncodedBatch
+    rng = DeterministicRandom(seed)
+    oracle = OracleConflictSet(0)
+    tpu = TpuConflictSet(0, **small_caps)
+    now = 0
+    for _ in range(20):
+        now += rng.random_int(1, 2_000_000)
+        # Hot 12-key space + up to 24 txns forces deep intra-batch chains
+        # (writer aborts retracting downstream conflicts) through the
+        # point fast path.
+        batch = [random_point_txn(rng, 12, now, 4_000_000)
+                 for _ in range(rng.random_int(1, 24))]
+        assert EncodedBatch.from_transactions(batch).all_point
+        new_oldest = now - 5_000_000 if rng.coinflip() else None
+        got = tpu.resolve(batch, now, new_oldest)
+        want = oracle.resolve(batch, now, new_oldest)
+        assert got == want, f"point-path divergence at now={now}"
+
+
 def test_tpu_basic_sequence(small_caps):
     tpu = TpuConflictSet(0, **small_caps)
     w = CommitTransactionRef(write_conflict_ranges=[KeyRange(b"a", b"c")])
