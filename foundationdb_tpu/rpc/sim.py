@@ -67,12 +67,19 @@ class SimProcess:
 
 
 class Machine:
-    """A simulated machine hosting processes (reference MachineInfo)."""
+    """A simulated machine hosting processes (reference MachineInfo).
+
+    Each machine owns a SimFileSystem: durable state survives process
+    kills/reboots on the same machine, and an unclean power failure drops
+    or corrupts un-synced writes (server/sim_fs.py, reference
+    AsyncFileNonDurable)."""
 
     def __init__(self, machineid: str, dcid: str) -> None:
         self.machineid = machineid
         self.dcid = dcid
         self.processes: List[SimProcess] = []
+        from ..server.sim_fs import SimFileSystem
+        self.fs = SimFileSystem()
 
 
 class Simulator:
@@ -109,6 +116,10 @@ class Simulator:
     def alive_processes(self) -> List[SimProcess]:
         return [p for p in self.processes.values() if p.alive]
 
+    def fs_for(self, p: SimProcess):
+        """The SimFileSystem of the machine hosting `p` (durable state)."""
+        return self.machines[p.locality.machineid].fs
+
     # -- faults (reference simulator.h:226-243, :375-376) --------------------
     def kill_process(self, p: SimProcess) -> None:
         """Permanently stop a process (KillType KillInstantly)."""
@@ -138,6 +149,21 @@ class Simulator:
     def kill_machine(self, machineid: str) -> None:
         for p in self.machines[machineid].processes:
             self.kill_process(p)
+
+    def power_fail_machine(self, machineid: str) -> None:
+        """Unclean machine loss: processes die AND un-synced file writes
+        are dropped/corrupted (reference KillType::RebootAndDelete-adjacent
+        semantics; the disk damage is what distinguishes this from
+        kill_machine)."""
+        m = self.machines[machineid]
+        m.fs.power_fail_all()
+        for p in m.processes:
+            self.kill_process(p)
+
+    def power_fail_all(self) -> None:
+        """Whole-cluster power loss (the restarting-test scenario)."""
+        for machineid in list(self.machines):
+            self.power_fail_machine(machineid)
 
     def kill_datacenter(self, dcid: str) -> None:
         for m in self.machines.values():

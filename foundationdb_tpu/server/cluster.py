@@ -144,12 +144,7 @@ class SimFdbCluster:
     def __init__(self, config=None, n_workers: int = 4,
                  n_storage_workers: int = 2, n_coordinators: int = 3,
                  loop: Optional[EventLoop] = None) -> None:
-        from ..core.futures import AsyncVar
-        from .cluster_controller import ClusterController
-        from .coordination import (CoordinationClientInterface,
-                                   CoordinationServer, try_become_leader)
         from .interfaces import DatabaseConfiguration
-        from .worker import Worker
 
         self.config = config or DatabaseConfiguration()
         # Cold-boot recruitment should see the whole initial pool: storage
@@ -157,26 +152,45 @@ class SimFdbCluster:
         # proceed with fewer — dead workers are dropped from the registry).
         self.config.min_workers = max(self.config.min_workers,
                                       min(n_storage_workers + 1, n_workers))
+        self.n_workers = n_workers
+        self.n_storage_workers = n_storage_workers
+        self.n_coordinators = n_coordinators
         self.loop = loop or EventLoop(sim=True)
         set_event_loop(self.loop)
         self.sim = Simulator()
         set_simulator(self.sim)
+        self._boot()
+
+    def _boot(self) -> None:
+        """(Re)create coordinator and worker processes.  Machine ids are
+        stable across calls, so a second _boot after power_fail_all() finds
+        each machine's surviving files (coordinator registers, TLog queues,
+        storage engines) and recovers from them."""
+        from ..core.futures import AsyncVar
+        from .cluster_controller import ClusterController
+        from .coordination import (CoordinationClientInterface,
+                                   CoordinationServer, monitor_leader,
+                                   try_become_leader)
+        from .worker import Worker
 
         self.coordinators = []
         self.coordinator_clients = []
-        for i in range(n_coordinators):
+        for i in range(self.n_coordinators):
             p = self.sim.new_process(name=f"coord{i}",
+                                     machineid=f"mach.coord{i}",
                                      process_class="coordinator")
-            server = CoordinationServer(f"coord{i}")
+            server = CoordinationServer(f"coord{i}", fs=self.sim.fs_for(p))
             server.run(p)
             self.coordinators.append((p, server))
             self.coordinator_clients.append(
                 CoordinationClientInterface(server))
 
         self.workers = []
-        for i in range(n_workers):
-            pclass = "storage" if i < n_storage_workers else "stateless"
-            p = self.sim.new_process(name=f"worker{i}", process_class=pclass)
+        for i in range(self.n_workers):
+            pclass = "storage" if i < self.n_storage_workers else "stateless"
+            p = self.sim.new_process(name=f"worker{i}",
+                                     machineid=f"mach.worker{i}",
+                                     process_class=pclass)
             leader_var = AsyncVar(None)
             # Only stateless workers campaign for CC (a storage worker
             # winning would put the control plane on a data node), so only
@@ -193,13 +207,20 @@ class SimFdbCluster:
                         f"worker{i}.ccRunner")
             else:
                 cc = None
-                from .coordination import monitor_leader
                 p.spawn(monitor_leader(self.coordinator_clients, leader_var),
                         f"worker{i}.monitorLeader")
             worker = Worker(p, self.coordinator_clients,
                             process_class=pclass, config=self.config)
             worker.run(leader_var)
             self.workers.append((p, worker, cc, leader_var))
+
+    def power_fail_reboot(self) -> None:
+        """Whole-cluster unclean power loss + restart (reference
+        tests/restarting/ SaveAndKill + second-binary restart): un-synced
+        writes are dropped/corrupted per machine, every process dies, and a
+        fresh boot must recover exclusively from durable files."""
+        self.sim.power_fail_all()
+        self._boot()
 
     @staticmethod
     async def _cc_runner(process, cc, leader_var, my_change_id) -> None:

@@ -21,7 +21,7 @@ from ..rpc.endpoint import RequestStream
 from .failure import WaitFailureRequest
 from .interfaces import (ClientDBInfo, ClusterControllerInterface,
                          InitializeMasterRequest, MasterRegistrationRequest,
-                         ServerDBInfo, WorkerInterface)
+                         ServerDBInfo, WorkerInterface, WorkerRegistration)
 
 
 @dataclass
@@ -40,7 +40,7 @@ class ClusterController:
         self.coordinators = coordinators
         self.config = config
         self.interface = ClusterControllerInterface(cc_id)
-        self.workers: Dict[str, Tuple[WorkerInterface, str]] = {}
+        self.workers: Dict[str, WorkerRegistration] = {}
         self.db_info = ServerDBInfo()
         self.db_info_version = 0
         self._db_info_waiters: List[Promise] = []
@@ -71,7 +71,9 @@ class ClusterController:
             if req.worker.id not in self.workers:
                 self._spawn(self._monitor_worker(req.worker.id, req.worker),
                             f"{self.id}.monitorWorker")
-            self.workers[req.worker.id] = (req.worker, req.process_class)
+            self.workers[req.worker.id] = WorkerRegistration(
+                req.worker, req.process_class,
+                req.recovered_logs, req.recovered_storage)
             arrived, self._worker_arrived = self._worker_arrived, []
             for p in arrived:
                 p.send(None)
@@ -84,7 +86,7 @@ class ClusterController:
         from .failure import wait_failure_of
         await wait_failure_of(iface)
         cur = self.workers.get(wid)
-        if cur is not None and cur[0] is iface:
+        if cur is not None and cur.worker is iface:
             del self.workers[wid]
             TraceEvent("CCWorkerRemoved", Severity.Warn).detail(
                 "Worker", wid).log()
@@ -141,8 +143,8 @@ class ClusterController:
         def ready() -> bool:
             if len(self.workers) < n:
                 return False
-            return any(cls in ("stateless", "unset")
-                       for _i, cls in self.workers.values())
+            return any(reg.process_class in ("stateless", "unset")
+                       for reg in self.workers.values())
         while not ready():
             p: Promise = Promise()
             self._worker_arrived.append(p)
@@ -151,10 +153,10 @@ class ClusterController:
     def _pick_master_worker(self) -> WorkerInterface:
         # Prefer stateless-class workers; deterministic order by id.
         items = sorted(self.workers.items())
-        for wid, (iface, pclass) in items:
-            if pclass in ("stateless", "master"):
-                return iface
-        return items[0][1][0]
+        for wid, reg in items:
+            if reg.process_class in ("stateless", "master"):
+                return reg.worker
+        return items[0][1].worker
 
     async def _cluster_watch_database(self) -> None:
         from .coordination import CoordinatedState
@@ -164,7 +166,8 @@ class ClusterController:
                 await self._wait_for_workers(self.config.min_workers)
                 # Determine next epoch from the durable core state.
                 cstate = CoordinatedState(self.coordinators)
-                prev: Optional[DBCoreState] = await cstate.read()
+                prev: Optional[DBCoreState] = DBCoreState.coerce(
+                    await cstate.read())
                 epoch = (prev.epoch + 1) if prev is not None else 1
                 worker = self._pick_master_worker()
                 self.db_info = ServerDBInfo(epoch=epoch,
@@ -208,8 +211,8 @@ class ClusterController:
         from .status import serve_status
         self._spawn(serve_status(self), f"{self.id}.status")
         # On restart after a deposition, resume monitoring known workers.
-        for wid, (iface, _cls) in list(self.workers.items()):
-            self._spawn(self._monitor_worker(wid, iface),
+        for wid, reg in list(self.workers.items()):
+            self._spawn(self._monitor_worker(wid, reg.worker),
                         f"{self.id}.monitorWorker")
         TraceEvent("ClusterControllerStarted").detail("Id", self.id).log()
 

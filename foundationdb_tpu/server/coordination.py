@@ -107,12 +107,27 @@ class LeaderInfo:
 
 
 class CoordinationServer:
-    """One coordinator: generation registers + leader election state."""
+    """One coordinator: generation registers + leader election state.
 
-    def __init__(self, server_id: str = "coord") -> None:
+    With `fs` set, generation registers persist through a durable engine
+    (reference Coordination.actor.cpp:106 localGenerationReg over
+    OnDemandStore): every register mutation — including read-generation
+    bumps, which are promises not to accept older writers — is fsynced
+    before the reply, and a rebooted coordinator recovers them.  Leader
+    election state is ephemeral by design (the reference's leader registers
+    are in-memory too)."""
+
+    def __init__(self, server_id: str = "coord", fs=None) -> None:
         self.id = server_id
-        # Generation register state per key.
+        # Generation register state per key.  The stored value is the live
+        # object for in-memory readers PLUS its packed bytes mirror; after
+        # a reboot only the packed form survives (callers unpack).
         self._reg: Dict[bytes, Tuple[Optional[bytes], Generation, Generation]] = {}
+        self._store = None
+        self._ready: Promise = Promise()
+        if fs is not None:
+            from .kvstore import KVStoreMemory
+            self._store = KVStoreMemory(fs, f"coord-{server_id}")
         # Leader election per key: current nominee + waiting candidates.
         self._nominee: Dict[bytes, Optional[LeaderInfo]] = {}
         self._nominee_waiters: Dict[bytes, List[Promise]] = {}
@@ -128,21 +143,65 @@ class CoordinationServer:
                                         TaskPriority.Coordination)
 
     # -- generation register -------------------------------------------------
+    async def _startup(self) -> None:
+        """Recover persisted registers before serving (reference
+        OnDemandStore recovery).  After a reboot only the packed byte form
+        of each value survives; readers unpack it."""
+        if self._store is not None:
+            from ..core.wire import Reader
+            await self._store.recover()
+            for k, blob in self._store.read_range(b"reg/", b"reg0"):
+                r = Reader(blob)
+                value = r.bytes_() if r.u8() else None
+                vgen = Generation(r.i64(), r.i64())
+                rgen = Generation(r.i64(), r.i64())
+                self._reg[k[len(b"reg/"):]] = (value, vgen, rgen)
+            TraceEvent("CoordinationRecovered").detail(
+                "Id", self.id).detail("Keys", len(self._reg)).log()
+        self._ready.send(None)
+
+    async def _persist(self, key: bytes) -> None:
+        """Fsync one register's state before any reply that promises it."""
+        if self._store is None:
+            return
+        from ..core.wire import Writer
+        value, vgen, rgen = self._reg[key]
+        if isinstance(value, (bytes, bytearray)):
+            packed = bytes(value)
+        elif value is not None and hasattr(value, "pack"):
+            packed = value.pack()
+        else:
+            packed = None
+        w = Writer().u8(1 if packed is not None else 0)
+        if packed is not None:
+            w.bytes_(packed)
+        w.i64(vgen.battle).i64(vgen.uid).i64(rgen.battle).i64(rgen.uid)
+        self._store.set(b"reg/" + key, w.done())
+        await self._store.commit()
+
     async def _serve_reads(self) -> None:
+        await self._ready.get_future()
         async for req in self.reg_read.queue:
             value, vgen, rgen = self._reg.get(
                 req.key, (None, Generation(), Generation()))
             new_rgen = max(rgen, req.gen)
             self._reg[req.key] = (value, vgen, new_rgen)
+            if new_rgen != rgen:
+                # The bumped read generation is a durable promise to reject
+                # older writers; fsync before replying.  Unchanged
+                # registers need no re-fsync.
+                await self._persist(req.key)
             req.reply.send(GenRegReadReply(value=value, vgen=vgen, rgen=rgen))
 
     async def _serve_writes(self) -> None:
+        await self._ready.get_future()
         async for req in self.reg_write.queue:
             value, vgen, rgen = self._reg.get(
                 req.key, (None, Generation(), Generation()))
             if req.gen >= rgen and req.gen > vgen:
                 self._reg[req.key] = (req.value, req.gen,
                                       max(rgen, req.gen))
+                await self._persist(req.key)
                 req.reply.send(GenRegWriteReply(gen=req.gen))
             else:
                 # Reject: reply with the winning generation so the caller
@@ -243,6 +302,7 @@ class CoordinationServer:
     def run(self, process) -> None:
         for s in self.streams():
             process.register(s)
+        process.spawn(self._startup(), f"{self.id}.startup")
         process.spawn(self._serve_reads(), f"{self.id}.reads")
         process.spawn(self._serve_writes(), f"{self.id}.writes")
         process.spawn(self._serve_candidacy(), f"{self.id}.candidacy")

@@ -388,7 +388,23 @@ class ClientDBInfo:
 class RegisterWorkerRequest:
     worker: "WorkerInterface"
     process_class: str = "unset"
+    # Disk-recovered roles this worker re-instantiated at boot (reference:
+    # a rebooted fdbd scans its data directory and brings old-generation
+    # TLogs and storage servers back before registering).  The master's
+    # recovery resolves DBCoreState ids/tags against these.
+    recovered_logs: Dict[str, Any] = field(default_factory=dict)
+    recovered_storage: Dict[int, Any] = field(default_factory=dict)
     reply: Any = None
+
+
+@dataclass
+class WorkerRegistration:
+    """One CC registry entry, returned by get_workers."""
+
+    worker: "WorkerInterface"
+    process_class: str = "unset"
+    recovered_logs: Dict[str, Any] = field(default_factory=dict)
+    recovered_storage: Dict[int, Any] = field(default_factory=dict)
 
 
 @dataclass

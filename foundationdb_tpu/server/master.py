@@ -167,12 +167,67 @@ class Master:
 class DBCoreState:
     epoch: int
     recovery_version: Version
-    tlogs: List[Any] = field(default_factory=list)        # TLogInterface
+    tlogs: List[Any] = field(default_factory=list)        # TLogInterface|None
     log_replication: int = 1
     storage_servers: Dict[Tag, Any] = field(default_factory=dict)
     key_servers_ranges: List[Tuple[bytes, bytes, List[Tag]]] = \
         field(default_factory=list)
     n_resolvers: int = 1
+    # Durable identities mirroring the interface lists: live interface
+    # objects don't survive a power failure, so pack() stores ids and the
+    # rebooted master re-resolves them against worker-recovered roles
+    # (reference DBCoreState stores TLog UIDs, not endpoints, for the same
+    # reason).
+    tlog_ids: List[str] = field(default_factory=list)
+    storage_ids: Dict[Tag, str] = field(default_factory=dict)
+
+    def pack(self) -> bytes:
+        from ..core.wire import Writer
+        w = Writer().u32(self.epoch).i64(self.recovery_version)
+        w.u8(self.log_replication).u8(self.n_resolvers)
+        tlog_ids = self.tlog_ids or [t.id for t in self.tlogs]
+        w.u16(len(tlog_ids))
+        for tid in tlog_ids:
+            w.str_(tid)
+        storage_ids = self.storage_ids or {
+            tag: s.id for tag, s in self.storage_servers.items()}
+        w.u16(len(storage_ids))
+        for tag, sid in storage_ids.items():
+            w.u32(tag).str_(sid)
+        w.u16(len(self.key_servers_ranges))
+        for b, e, team in self.key_servers_ranges:
+            w.bytes_(b).bytes_(e).u16(len(team))
+            for t in team:
+                w.u32(t)
+        return w.done()
+
+    @staticmethod
+    def coerce(raw) -> "Optional[DBCoreState]":
+        """Normalize a CoordinatedState read: live DBCoreState objects pass
+        through; the packed byte form (what survives a coordinator reboot)
+        is unpacked; None stays None."""
+        if isinstance(raw, (bytes, bytearray)):
+            return DBCoreState.unpack(raw)
+        return raw
+
+    @classmethod
+    def unpack(cls, blob: bytes) -> "DBCoreState":
+        from ..core.wire import Reader
+        r = Reader(blob)
+        epoch, rv = r.u32(), r.i64()
+        log_rep, n_res = r.u8(), r.u8()
+        tlog_ids = [r.str_() for _ in range(r.u16())]
+        storage_ids = {r.u32(): r.str_() for _ in range(r.u16())}
+        ranges = []
+        for _ in range(r.u16()):
+            b, e = r.bytes_(), r.bytes_()
+            team = [r.u32() for _ in range(r.u16())]
+            ranges.append((b, e, team))
+        return cls(epoch=epoch, recovery_version=rv,
+                   tlogs=[None] * len(tlog_ids), log_replication=log_rep,
+                   storage_servers={t: None for t in storage_ids},
+                   key_servers_ranges=ranges, n_resolvers=n_res,
+                   tlog_ids=tlog_ids, storage_ids=storage_ids)
 
 
 def _split_points(n: int) -> List[bytes]:
@@ -207,11 +262,27 @@ async def master_server(master: Master, process, coordinators,
             process.register(s)
         adopt(master._serve_wait_failure(), "master.waitFailure")
 
-        # READING_CSTATE (:1678)
+        # READING_CSTATE (:1678).  After a full-cluster power failure the
+        # coordinators return the PACKED DBCoreState (live interfaces died
+        # with their processes); unpack ids and re-resolve below.
         TraceEvent("MasterRecoveryState").detail("State",
                                                  "reading_cstate").log()
         cstate = CoordinatedState(coordinators)
-        prev: Optional[DBCoreState] = await cstate.read()
+        prev: Optional[DBCoreState] = DBCoreState.coerce(await cstate.read())
+
+        # Worker registry first: rebooted workers report disk-recovered
+        # old-generation TLogs and storage servers keyed by id/tag.
+        from .interfaces import GetWorkersRequest
+        workers = await RequestStream.at(
+            cc_interface.get_workers.endpoint).get_reply(
+            GetWorkersRequest())
+        if not workers:
+            raise err("master_recovery_failed", "no workers registered")
+        recovered_logs: Dict[str, Any] = {}
+        recovered_storage: Dict[Tag, Any] = {}
+        for reg in workers:
+            recovered_logs.update(reg.recovered_logs)
+            recovered_storage.update(reg.recovered_storage)
 
         # LOCKING_CSTATE: lock the previous TLog generation (epoch end).
         old_tag_holders: Dict[Tag, Any] = {}
@@ -221,15 +292,20 @@ async def master_server(master: Master, process, coordinators,
             TraceEvent("MasterRecoveryState").detail(
                 "State", "locking_cstate").detail("PrevEpoch",
                                                   prev.epoch).log()
-            old_ls = LogSystemClient(prev.tlogs, prev.log_replication)
+            tlog_ids = prev.tlog_ids or [t.id for t in prev.tlogs]
+            old_tlogs = [recovered_logs.get(tid) or prev.tlogs[i]
+                         for i, tid in enumerate(tlog_ids)]
+            old_ls = LogSystemClient(old_tlogs, prev.log_replication)
             # Lock every old TLog in parallel: dead ones cost ONE failure
             # delay total, not one each (reference locks concurrently).
             from ..core.futures import swallow, wait_all
-            lock_futures = [RequestStream.at(t.lock.endpoint).get_reply(
-                TLogLockRequest(epoch=master.epoch)) for t in prev.tlogs]
-            await wait_all([swallow(f) for f in lock_futures])
+            lock_futures = {
+                i: RequestStream.at(t.lock.endpoint).get_reply(
+                    TLogLockRequest(epoch=master.epoch))
+                for i, t in enumerate(old_tlogs) if t is not None}
+            await wait_all([swallow(f) for f in lock_futures.values()])
             locked: Dict[int, Any] = {
-                i: f.get() for i, f in enumerate(lock_futures)
+                i: f.get() for i, f in lock_futures.items()
                 if not f.is_error()}
             if not locked:
                 raise err("master_recovery_failed", "no old TLogs reachable")
@@ -241,7 +317,7 @@ async def master_server(master: Master, process, coordinators,
                 if holder is None:
                     raise err("master_recovery_failed",
                               f"tag {tag} has no surviving TLog holder")
-                old_tag_holders[tag] = prev.tlogs[holder]
+                old_tag_holders[tag] = old_tlogs[holder]
                 old_popped[tag] = locked[holder].tags.get(tag, 0)
             # Every client-visible commit was acked by ALL old TLogs, so
             # the min over locked end-versions is >= every visible commit.
@@ -255,23 +331,17 @@ async def master_server(master: Master, process, coordinators,
         TraceEvent("MasterRecoveryState").detail(
             "State", "recruiting").detail(
             "RecoveryVersion", recovery_version).log()
-        from .interfaces import GetWorkersRequest
-        workers = await RequestStream.at(
-            cc_interface.get_workers.endpoint).get_reply(
-            GetWorkersRequest())
-        if not workers:
-            raise err("master_recovery_failed", "no workers registered")
         # Placement pools by process class (reference fitness-based
         # placement, ClusterController getWorkerForRoleInDatacenter):
         # transaction-system roles avoid storage-class workers so chaos on
         # the txn system never destroys storage state.
-        stateless = sorted((iface for iface, cls in workers
-                            if cls in ("stateless", "unset")),
+        stateless = sorted((reg.worker for reg in workers
+                            if reg.process_class in ("stateless", "unset")),
                            key=lambda x: x.id)
-        storage_pool = sorted((iface for iface, cls in workers
-                               if cls in ("storage", "unset")),
+        storage_pool = sorted((reg.worker for reg in workers
+                               if reg.process_class in ("storage", "unset")),
                               key=lambda x: x.id)
-        w = sorted((iface for iface, _cls in workers), key=lambda x: x.id)
+        w = sorted((reg.worker for reg in workers), key=lambda x: x.id)
         stateless = stateless or w
         storage_pool = storage_pool or w
         # Spread recruited roles AWAY from the master's own worker: killing
@@ -309,8 +379,16 @@ async def master_server(master: Master, process, coordinators,
                 epoch=master.epoch, recovery_version=recovery_version))
             for i in range(config.n_resolvers)]
         if prev is not None:
-            # Storage is long-lived: reuse the existing directory.
-            storage_servers = dict(prev.storage_servers)
+            # Storage is long-lived: reuse the existing servers — live
+            # interfaces when their processes survived, disk-recovered
+            # replacements (same tag) after a reboot.
+            storage_servers = {}
+            for tag, iface in prev.storage_servers.items():
+                resolved = recovered_storage.get(tag) or iface
+                if resolved is None:
+                    raise err("master_recovery_failed",
+                              f"storage tag {tag} not yet re-registered")
+                storage_servers[tag] = resolved
             key_servers_ranges = list(prev.key_servers_ranges)
             storage_futures = []
         else:

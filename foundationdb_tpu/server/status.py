@@ -69,8 +69,8 @@ async def build_status(cc) -> Dict[str, Any]:
         not rk_future.is_error() else None
 
     processes = {}
-    for wid, (iface, pclass) in sorted(cc.workers.items()):
-        processes[wid] = {"class_type": pclass, "excluded": False}
+    for wid, reg in sorted(cc.workers.items()):
+        processes[wid] = {"class_type": reg.process_class, "excluded": False}
 
     return {
         "client": {

@@ -6,8 +6,11 @@ for its tag from the TLogs (update :3626), answers getValueQ (:1228) /
 getKeyValuesQ (:1929) after waiting for the requested version, triggers
 watches (:2622), and trims old versions as the window advances.  The
 versioned map mirrors fdbclient/VersionedMap.h:624 semantics (per-key
-version chains with tombstones) in a bisect-sorted dict — the disk engines
-(IKeyValueStore equivalents) attach below this in storage_engine.py.
+version chains with tombstones) in a bisect-sorted dict.  A durable
+IKeyValueStore engine (kvstore.py) attaches below the MVCC window: the
+updateStorage actor batches applied mutations into it, fsyncs, advances
+durable_version and lets the TLog trim; a rebooted worker reconstructs the
+role from the engine via from_engine().
 """
 
 from __future__ import annotations
@@ -146,9 +149,13 @@ class VersionedMap:
         return len(self._keys)
 
 
+_META_KEY = b"\xff\xff/storageMeta"    # above every shard-map range end
+_UPDATE_STORAGE_INTERVAL = 0.05        # reference updateStorage cadence
+
+
 class StorageServer:
     def __init__(self, ss_id: str, tag: Tag, log_system,
-                 recovery_version: Version = 0) -> None:
+                 recovery_version: Version = 0, engine=None) -> None:
         self.id = ss_id
         self.tag = tag
         self.log_system = log_system    # LogSystemClient
@@ -164,22 +171,70 @@ class StorageServer:
                       "watches": 0}
         self._process = None
         self._pull_actor = None
+        # Durable engine (IKeyValueStore) — None = memory-only role.
+        # Mutations queue here (atomics pre-resolved to their results) until
+        # the updateStorage actor batches them into the engine.
+        self.engine = engine
+        self._durable_pending: List[Tuple[Version, int, bytes, bytes]] = []
+        # Epoch of the log system that fed this server's data; rollback on
+        # set_log_system applies only when crossing to a NEWER epoch (data
+        # beyond the epoch boundary may never have been committed) — never
+        # when rejoining the same generation after a reboot.
+        self.log_epoch = 0
+        self._rebuild_f = None   # in-flight epoch-rollback engine re-image
+
+    @classmethod
+    async def from_engine(cls, engine) -> Optional["StorageServer"]:
+        """Reboot path: reconstruct a storage server from its durable
+        engine (reference storageserver restore from IKeyValueStore at
+        worker boot).  Returns None for an engine with no metadata (power
+        fail before the role's first commit)."""
+        from ..core.wire import Reader
+        await engine.recover()
+        raw = engine.read_value(_META_KEY)
+        if raw is None:
+            return None
+        r = Reader(raw)
+        ss_id, tag, durable = r.str_(), r.u32(), r.i64()
+        log_epoch = r.u32() if not r.at_end() else 0
+        ss = cls(ss_id, tag, None, recovery_version=durable, engine=engine)
+        ss.log_epoch = log_epoch
+        for k, v in engine.read_range(b"", b"\xff\xff"):
+            ss.data.set(k, v, durable)
+        TraceEvent("StorageRecoveredFromDisk").detail("Id", ss_id).detail(
+            "Tag", tag).detail("Version", durable).detail(
+            "Keys", len(ss.data)).log()
+        return ss
+
+    def _meta_blob(self, version: Version) -> bytes:
+        from ..core.wire import Writer
+        return (Writer().str_(self.id).u32(self.tag).i64(version)
+                .u32(self.log_epoch).done())
 
     # -- mutation ingestion (reference update :3626) -------------------------
     def _apply(self, m: Mutation, version: Version) -> None:
         self.stats["mutations"] += 1
         if m.type == MutationType.SetValue:
             self.data.set(m.param1, m.param2, version)
+            if self.engine is not None:
+                self._durable_pending.append((version, 0, m.param1, m.param2))
             self._trigger_watch(m.param1)
         elif m.type == MutationType.ClearRange:
             self.data.clear_range(m.param1, m.param2, version)
+            if self.engine is not None:
+                self._durable_pending.append((version, 1, m.param1, m.param2))
             for key in list(self._watches):
                 if m.param1 <= key < m.param2:
                     self._trigger_watch(key)
         elif m.type in ATOMIC_OPS:
             existing = self.data.latest(m.param1)
-            self.data.set(m.param1, apply_atomic(m.type, existing, m.param2),
-                          version)
+            result = apply_atomic(m.type, existing, m.param2)
+            self.data.set(m.param1, result, version)
+            if self.engine is not None:
+                # Atomics are resolved once here; the engine logs the result
+                # (reference: the SS update path expands atomic ops before
+                # the versioned data reaches updateStorage).
+                self._durable_pending.append((version, 0, m.param1, result))
             self._trigger_watch(m.param1)
         else:
             TraceEvent("SSUnknownMutation", Severity.Warn).detail(
@@ -220,11 +275,49 @@ class StorageServer:
                     new_version -
                     int(knobs.MAX_READ_TRANSACTION_LIFE_VERSIONS))
                 self.data.forget_before(self.oldest_version)
-                # Memory "durability": ack the log so it can trim (the disk
-                # engine path fsyncs first; see storage_engine.py).
-                self.durable_version.set(new_version)
-                self.log_system.pop(self.tag, new_version)
+                if self.engine is None:
+                    # Memory-only role: "durable" as soon as applied, so the
+                    # log can trim immediately.  The engine path advances
+                    # durable_version from _update_storage_loop after fsync
+                    # (reference updateStorage :4002).
+                    self.durable_version.set(new_version)
+                    self.log_system.pop(self.tag, new_version)
             fetch_from = reply.end
+
+    async def _update_storage_loop(self) -> None:
+        """Batch applied mutations into the durable engine, fsync, advance
+        the durable frontier, then let the TLog trim (reference
+        updateStorage storageserver.actor.cpp:4002: makes versions durable
+        in batches behind the in-memory MVCC window)."""
+        while True:
+            await delay(_UPDATE_STORAGE_INTERVAL)
+            if self._rebuild_f is not None and not self._rebuild_f.is_ready():
+                continue                     # epoch rollback re-image running
+            target = self.version.get()
+            dv = self.durable_version
+            epoch0 = self.log_epoch
+            if target <= dv.get():
+                continue
+            batch, self._durable_pending = self._durable_pending, []
+            for _v, op, a, b in batch:
+                if op == 0:
+                    if b is None:
+                        self.engine.clear(a, a + b"\x00")
+                    else:
+                        self.engine.set(a, b)
+                else:
+                    self.engine.clear(a, b)
+            self.engine.set(_META_KEY, self._meta_blob(target))
+            await self.engine.commit()
+            if self.durable_version is not dv or self.log_epoch != epoch0:
+                # An epoch rollback happened during the fsync: `target` may
+                # lie beyond the new recovery version.  Do NOT advance the
+                # frontier or pop the new generation at it — the rebuild
+                # actor re-images the engine at the rollback point.
+                continue
+            dv.set(target)
+            if self.log_system is not None:
+                self.log_system.pop(self.tag, target)
 
     # -- read path (reference getValueQ :1228, waitForVersion) ---------------
     async def _wait_for_version(self, version: Version) -> None:
@@ -299,21 +392,49 @@ class StorageServer:
             req.reply.send_error(e)
 
     # -- epoch change (reference: SS rejoins the new log system) -------------
-    def set_log_system(self, log_system, recovery_version: Version) -> None:
-        """Re-target the pull cursor to a new TLog generation; data applied
-        beyond the new epoch's recovery version is rolled back (it was never
-        globally committed)."""
+    def set_log_system(self, log_system, recovery_version: Version,
+                       epoch: int = 0) -> None:
+        """Re-target the pull cursor to a new TLog generation.  When
+        crossing into a NEWER epoch, data applied beyond the new epoch's
+        recovery version is rolled back (it may never have been globally
+        committed); rejoining the SAME generation after a reboot keeps the
+        durable image untouched — its versions come from this epoch's live
+        log system and are hidden from reads above the GRV frontier
+        anyway."""
         if self._pull_actor is not None and not self._pull_actor.is_ready():
             self._pull_actor.cancel()
         self.log_system = log_system
-        if self.version.get() > recovery_version:
+        crossing = epoch > self.log_epoch
+        self.log_epoch = max(self.log_epoch, epoch)
+        if crossing and self.version.get() > recovery_version:
             self.data.rollback(recovery_version)
             # NotifiedVersion cannot go backwards; recreate at the floor.
+            rolled_durable = self.durable_version.get() > recovery_version
             self.version = NotifiedVersion(recovery_version)
             self.durable_version = NotifiedVersion(recovery_version)
+            self._durable_pending = [
+                e for e in self._durable_pending if e[0] <= recovery_version]
+            if self.engine is not None and rolled_durable and \
+                    self._process is not None:
+                # Rare epoch-change path: durable state ran ahead of the new
+                # recovery version; rewrite the engine from the rolled-back
+                # image (the reference instead persists rollback records —
+                # this engine is small enough to re-image).  The update
+                # loop pauses until the re-image commits.
+                self._rebuild_f = self._process.spawn(
+                    self._rebuild_engine(recovery_version),
+                    f"{self.id}.rebuildEngine")
         if self._process is not None:
             self._pull_actor = self._process.spawn(
                 self._pull_loop(), f"{self.id}.update")
+
+    async def _rebuild_engine(self, version: Version) -> None:
+        self.engine.clear(b"", b"\xff\xff\xff")
+        for k, v in self.data.range_read(b"", b"\xff\xff", version,
+                                         1 << 30, 1 << 40)[0]:
+            self.engine.set(k, v)
+        self.engine.set(_META_KEY, self._meta_blob(version))
+        await self.engine.commit()
 
     # -- serving -------------------------------------------------------------
     async def _serve(self, queue, handler) -> None:
@@ -325,6 +446,9 @@ class StorageServer:
         for s in self.interface.streams():
             process.register(s)
         self._pull_actor = process.spawn(self._pull_loop(), f"{self.id}.update")
+        if self.engine is not None:
+            process.spawn(self._update_storage_loop(),
+                          f"{self.id}.updateStorage")
         process.spawn(self._serve(self.interface.get_value.queue,
                                   self._get_value), f"{self.id}.getValue")
         process.spawn(self._serve(self.interface.get_key_values.queue,

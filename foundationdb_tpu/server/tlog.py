@@ -13,12 +13,14 @@ the previous fsync was in flight).
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Dict, List, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..core.futures import Promise
 from ..core.scheduler import delay, get_event_loop
 from ..core.trace import TraceEvent
-from ..txn.types import Mutation, Version
+from ..core.wire import Reader, Writer
+from ..txn.types import Mutation, MutationType, Version
+from .disk_queue import DiskQueue
 from .interfaces import (Tag, TLogCommitRequest, TLogInterface,
                          TLogLockReply, TLogPeekReply, TLogPeekRequest,
                          TLogPopRequest)
@@ -27,9 +29,42 @@ from .notified import NotifiedVersion
 _SIM_FSYNC_SECONDS = 0.0005
 
 
+def _pack_commit(version: Version, prev_version: Version,
+                 known_committed: Version,
+                 popped: Dict[Tag, Version],
+                 messages: Dict[Tag, List[Mutation]]) -> bytes:
+    """One DiskQueue record per committed version (the reference packs
+    version blocks into DiskQueue pages, TLogServer.actor.cpp:293
+    TLogQueueEntry)."""
+    w = Writer().i64(version).i64(prev_version).i64(known_committed)
+    w.u16(len(popped))
+    for tag, v in popped.items():
+        w.u32(tag).i64(v)
+    w.u16(len(messages))
+    for tag, msgs in messages.items():
+        w.u32(tag).u32(len(msgs))
+        for m in msgs:
+            w.u8(int(m.type)).bytes_(m.param1).bytes_(m.param2)
+    return w.done()
+
+
+def _unpack_commit(blob: bytes):
+    r = Reader(blob)
+    version, prev_version, known_committed = r.i64(), r.i64(), r.i64()
+    popped = {r.u32(): r.i64() for _ in range(r.u16())}
+    messages: Dict[Tag, List[Mutation]] = {}
+    for _ in range(r.u16()):
+        tag = r.u32()
+        msgs = [Mutation(MutationType(r.u8()), r.bytes_(), r.bytes_())
+                for _ in range(r.u32())]
+        messages[tag] = msgs
+    return version, prev_version, known_committed, popped, messages
+
+
 class TLog:
     def __init__(self, tlog_id: str = "log0",
-                 recovery_version: Version = 0, epoch: int = 1) -> None:
+                 recovery_version: Version = 0, epoch: int = 1,
+                 disk_queue: Optional[DiskQueue] = None) -> None:
         self.id = tlog_id
         self.epoch = epoch
         self.version = NotifiedVersion(recovery_version)       # appended
@@ -43,6 +78,42 @@ class TLog:
         self._sync_running = False
         self.stopped = False   # locked at epoch end; rejects new commits
         self._stop_promise: Promise = Promise()  # fires when locked
+        # Durable backing (None = pure in-memory mode for static harnesses;
+        # fsync is then just a simulated latency).
+        self.disk_queue = disk_queue
+        # (version, queue seq) per pushed record, for pop-driven trimming.
+        self._record_seqs: Deque[Tuple[Version, int]] = deque()
+
+    @classmethod
+    async def from_disk(cls, tlog_id: str, disk_queue: DiskQueue,
+                        epoch: int = 0) -> "TLog":
+        """Reconstruct a (previous-generation) TLog from its DiskQueue after
+        a reboot: replay surviving commit records in order.  The instance
+        serves peek/lock for the new master's recovery (reference: a
+        rebooted worker re-instantiates TLogs from disk before registering,
+        worker.actor.cpp data-directory scan)."""
+        records = await disk_queue.recover()
+        t = cls(tlog_id, 0, epoch=epoch, disk_queue=disk_queue)
+        for seq, blob in records:
+            version, _prev, kcv, popped, messages = _unpack_commit(blob)
+            for tag, v in popped.items():
+                t.poppedtags[tag] = max(t.poppedtags.get(tag, 0), v)
+            for tag, msgs in messages.items():
+                t.tag_data.setdefault(tag, deque()).append((version, msgs))
+                t.bytes_input += sum(m.expected_size() for m in msgs)
+            t.known_committed_version = max(t.known_committed_version, kcv)
+            t._record_seqs.append((version, seq))
+            if version > t.version.get():
+                t.version.set(version)
+        t.durable_version.set(t.version.get())
+        for tag, popped_v in t.poppedtags.items():
+            q = t.tag_data.get(tag)
+            while q and q[0][0] <= popped_v:
+                q.popleft()
+        TraceEvent("TLogRecoveredFromDisk").detail("Id", tlog_id).detail(
+            "Version", t.version.get()).detail(
+            "Records", len(records)).log()
+        return t
 
     # -- generation handoff --------------------------------------------------
     async def recover_from(self, recover_tags: Dict[Tag, object],
@@ -50,7 +121,15 @@ class TLog:
                            recovery_version: Version) -> None:
         """Pull each assigned tag's surviving data (<= recovery_version)
         from an old-generation holder before serving (reference: new TLogs
-        recover via peek cursors over the previous generation)."""
+        recover via peek cursors over the previous generation).
+
+        The carried data is re-persisted into THIS generation's disk queue
+        before recovery completes: once the new DBCoreState is written,
+        only this generation is locked at the next reboot, so un-popped
+        old-generation data must already be durable here or an acked commit
+        could vanish (the reference instead keeps old generations alive
+        until fully popped; re-persisting is the simpler equivalent for a
+        non-spilling log)."""
         from ..rpc.endpoint import RequestStream
         for tag, old_iface in recover_tags.items():
             popped = recover_popped.get(tag, 0)
@@ -62,6 +141,19 @@ class TLog:
                     q.append((v, msgs))
             if popped:
                 self.poppedtags[tag] = popped
+        if self.disk_queue is not None:
+            by_version: Dict[Version, Dict[Tag, List[Mutation]]] = {}
+            for tag, q in self.tag_data.items():
+                for v, msgs in q:
+                    by_version.setdefault(v, {})[tag] = msgs
+            prev_v = 0
+            for v in sorted(by_version):
+                seq = self.disk_queue.push(_pack_commit(
+                    v, prev_v, self.known_committed_version,
+                    dict(self.poppedtags), by_version[v]))
+                self._record_seqs.append((v, seq))
+                prev_v = v
+            await self.disk_queue.commit()
         TraceEvent("TLogRecovered").detail("Id", self.id).detail(
             "Tags", len(recover_tags)).detail(
             "RecoveryVersion", recovery_version).log()
@@ -105,6 +197,12 @@ class TLog:
                 self.bytes_input += sum(m.expected_size() for m in msgs)
             self.known_committed_version = max(self.known_committed_version,
                                                req.known_committed_version)
+            if self.disk_queue is not None:
+                seq = self.disk_queue.push(_pack_commit(
+                    req.version, req.prev_version,
+                    self.known_committed_version, dict(self.poppedtags),
+                    req.messages))
+                self._record_seqs.append((req.version, seq))
             self.version.set(req.version)
             self._start_sync()
         await self.durable_version.when_at_least(req.version)
@@ -112,7 +210,9 @@ class TLog:
 
     def _start_sync(self) -> None:
         """Group fsync: one in-flight sync persists everything appended so
-        far (reference doQueueCommit batching)."""
+        far (reference doQueueCommit batching).  With a DiskQueue the sync
+        is a real buffered-write + fsync of the pushed records; commits ack
+        only after their version is on disk."""
         if self._sync_running:
             return
         self._sync_running = True
@@ -120,7 +220,10 @@ class TLog:
         async def sync() -> None:
             while self.durable_version.get() < self.version.get():
                 target = self.version.get()
-                await delay(_SIM_FSYNC_SECONDS)
+                if self.disk_queue is not None:
+                    await self.disk_queue.commit()
+                else:
+                    await delay(_SIM_FSYNC_SECONDS)
                 self.durable_version.set(target)
             self._sync_running = False
 
@@ -154,8 +257,22 @@ class TLog:
             if q is not None:
                 while q and q[0][0] <= req.to:
                     q.popleft()
+            self._trim_queue()
         if req.reply is not None:
             req.reply.send(None)
+
+    def _trim_queue(self) -> None:
+        """Trim disk records once every tag with data has popped past them
+        (the trim frontier is persisted with the next append — the
+        reference's lazy page-header popped location)."""
+        if self.disk_queue is None or not self.tag_data:
+            return
+        fully = min(self.poppedtags.get(t, 0) for t in self.tag_data)
+        last_seq = 0
+        while self._record_seqs and self._record_seqs[0][0] <= fully:
+            _, last_seq = self._record_seqs.popleft()
+        if last_seq:
+            self.disk_queue.pop(last_seq)
 
     # -- serving -------------------------------------------------------------
     async def _serve_commit(self) -> None:

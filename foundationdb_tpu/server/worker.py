@@ -35,6 +35,49 @@ class Worker:
         self.interface = WorkerInterface(process.name)
         self.db_info: AsyncVar = AsyncVar(ServerDBInfo())
         self.storage_roles: List[StorageServer] = []
+        # Disk-recovered roles found by the boot scan, reported to the CC
+        # in RegisterWorkerRequest so master recovery can resolve them.
+        self.recovered_logs: Dict[str, Any] = {}
+        self.recovered_storage: Dict[int, Any] = {}
+        from ..core.futures import Promise
+        self._scanned: Promise = Promise()
+
+    def _fs(self):
+        from ..rpc.sim import get_simulator
+        return get_simulator().fs_for(self.process)
+
+    # -- boot-time disk scan (reference worker.actor.cpp data-dir scan) ------
+    async def _boot_scan(self) -> None:
+        """Re-instantiate durable roles from this machine's filesystem:
+        old-generation TLogs (peek/lock service for the next recovery) and
+        storage servers.  Runs before CC registration so the recovered maps
+        ride the RegisterWorkerRequest."""
+        from .disk_queue import DiskQueue
+        from .kvstore import open_kv_store
+        try:
+            fs = self._fs()
+            for name in sorted(fs.files):
+                if name.startswith("tlog-") and name.endswith(".wal"):
+                    tlog_id = name[len("tlog-"):-len(".wal")]
+                    tlog = await TLog.from_disk(
+                        tlog_id, DiskQueue(fs.open(name)))
+                    tlog.run(self.process)
+                    self.recovered_logs[tlog_id] = tlog.interface
+                elif name.startswith("storage-") and name.endswith(".wal"):
+                    engine = open_kv_store("memory", fs, name[:-len(".wal")])
+                    ss = await StorageServer.from_engine(engine)
+                    if ss is None:
+                        continue
+                    ss.run(self.process)
+                    self.storage_roles.append(ss)
+                    self.recovered_storage[ss.tag] = ss.interface
+            if self.recovered_logs or self.recovered_storage:
+                TraceEvent("WorkerBootScan").detail(
+                    "Worker", self.process.name).detail(
+                    "TLogs", len(self.recovered_logs)).detail(
+                    "Storage", len(self.recovered_storage)).log()
+        finally:
+            self._scanned.send(None)
 
     # -- role instantiation --------------------------------------------------
     async def _serve_init_master(self) -> None:
@@ -48,13 +91,39 @@ class Worker:
             req.reply.send(master.interface)
 
     async def _serve_init_tlog(self) -> None:
+        from .disk_queue import DiskQueue
         async for req in self.interface.init_tlog.queue:
-            tlog = TLog(req.tlog_id, req.recovery_version, epoch=req.epoch)
+            # A failed recovery attempt at the same epoch may have left a
+            # partial WAL under this id; a fresh generation must not write
+            # over a stale synced tail the recovery scan could walk into.
+            self._fs().delete(f"tlog-{req.tlog_id}.wal")
+            queue = DiskQueue(self._fs().open(f"tlog-{req.tlog_id}.wal"))
+            tlog = TLog(req.tlog_id, req.recovery_version, epoch=req.epoch,
+                        disk_queue=queue)
             tlog.run(self.process)
             if req.recover_tags:
                 await tlog.recover_from(req.recover_tags, req.recover_popped,
                                         req.recovery_version)
+            self._gc_tlog_files(req.epoch)
             req.reply.send(tlog.interface)
+
+    def _gc_tlog_files(self, epoch: int) -> None:
+        """Delete local TLog files two or more generations old: epoch e
+        carried every surviving record of e-1 into its own durable queue
+        (TLog.recover_from), so e-2 and older can never be locked again."""
+        fs = self._fs()
+        for name in list(fs.files):
+            if not (name.startswith("tlog-") and name.endswith(".wal")):
+                continue
+            tid = name[len("tlog-"):-len(".wal")]
+            if ".e" not in tid:
+                continue
+            try:
+                file_epoch = int(tid.rsplit(".e", 1)[1])
+            except ValueError:
+                continue
+            if file_epoch <= epoch - 2:
+                fs.delete(name)
 
     async def _serve_init_commit_proxy(self) -> None:
         async for req in self.interface.init_commit_proxy.queue:
@@ -100,12 +169,25 @@ class Worker:
             req.reply.send(r.interface)
 
     async def _serve_init_storage(self) -> None:
+        from .kvstore import open_kv_store
+        from .storage import _META_KEY
         async for req in self.interface.init_storage.queue:
             info = self.db_info.get()
             ls = LogSystemClient(info.tlogs,
                                  replication=self._log_replication()) \
                 if info.tlogs else None
-            ss = StorageServer(req.ss_id, req.tag, ls)
+            # init_storage only happens before any commit was ever acked
+            # (cold boot / failed first recovery): stale files are safe to
+            # wipe, and must be (same stale-tail hazard as init_tlog).
+            self._fs().delete(f"storage-{req.tag}.wal")
+            self._fs().delete(f"storage-{req.tag}.snap")
+            engine = open_kv_store("memory", self._fs(),
+                                   f"storage-{req.tag}")
+            ss = StorageServer(req.ss_id, req.tag, ls, engine=engine)
+            # Seed the engine's identity metadata durably before serving so
+            # a power failure at any later point finds a recoverable store.
+            engine.set(_META_KEY, ss._meta_blob(0))
+            await engine.commit()
             ss.run(self.process)
             self.storage_roles.append(ss)
             req.reply.send(ss.interface)
@@ -131,7 +213,7 @@ class Worker:
                 ls = LogSystemClient(info.tlogs,
                                      replication=self._log_replication())
                 for ss in self.storage_roles:
-                    ss.set_log_system(ls, info.recovery_version)
+                    ss.set_log_system(ls, info.recovery_version, info.epoch)
             await self.db_info.on_change()
 
     # -- CC registration + ServerDBInfo subscription -------------------------
@@ -139,6 +221,7 @@ class Worker:
         """Register with each new cluster controller; long-poll its
         ServerDBInfo broadcasts (reference registrationClient)."""
         from .cluster_controller import GetServerDBInfoRequest
+        await self._scanned.get_future()   # recovered maps must be complete
         known_version = -1
         cc: Optional[ClusterControllerInterface] = None
         while True:
@@ -151,7 +234,9 @@ class Worker:
                     RequestStream.at(cc.register_worker.endpoint).send(
                         RegisterWorkerRequest(
                             worker=self.interface,
-                            process_class=self.process_class))
+                            process_class=self.process_class,
+                            recovered_logs=dict(self.recovered_logs),
+                            recovered_storage=dict(self.recovered_storage)))
             if cc is None:
                 await leader_var.on_change()
                 continue
@@ -182,6 +267,7 @@ class Worker:
         p = self.process
         for s in self.interface.streams():
             p.register(s)
+        p.spawn(self._boot_scan(), f"{p.name}.bootScan")
         p.spawn(self._serve_init_master(), f"{p.name}.initMaster")
         p.spawn(self._serve_init_tlog(), f"{p.name}.initTLog")
         p.spawn(self._serve_init_commit_proxy(), f"{p.name}.initProxy")

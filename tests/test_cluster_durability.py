@@ -1,0 +1,130 @@
+"""Cluster-level durability: acked commits survive whole-cluster power loss.
+
+The restarting-test scenario (reference tests/restarting/ + the simulator's
+power-loss file semantics, fdbrpc/AsyncFileNonDurable.actor.h): a cluster
+takes commits, every machine loses power uncleanly (un-synced writes
+dropped/corrupted), the cluster reboots from durable files only —
+coordinator generation registers, TLog disk queues, storage engines — and
+every acknowledged commit must still be readable.  In-flight (un-acked)
+transactions may or may not survive; what's forbidden is losing an ack."""
+
+import pytest
+
+from foundationdb_tpu.core import FdbError
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+
+def make_cluster(**cfg):
+    n_workers = cfg.pop("n_workers", 5)
+    n_storage_workers = cfg.pop("n_storage_workers", 2)
+    config = DatabaseConfiguration(**cfg)
+    return SimFdbCluster(config=config, n_workers=n_workers,
+                         n_storage_workers=n_storage_workers)
+
+
+def test_power_fail_reboot_preserves_acked_commits(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+    acked = {}
+
+    async def load():
+        for i in range(30):
+            k, v = b"key%03d" % i, b"value%03d" % i
+            await commit_kv(db, k, v)
+            acked[k] = v
+
+    c.run_until(c.loop.spawn(load()), timeout=120)
+    assert len(acked) == 30
+
+    c.power_fail_reboot()
+
+    db2 = c.database()
+
+    async def check():
+        for k, v in acked.items():
+            assert await read_key(db2, k) == v, f"lost acked key {k!r}"
+        # The recovered cluster accepts new commits in a later epoch.
+        await commit_kv(db2, b"after-reboot", b"yes")
+        assert await read_key(db2, b"after-reboot") == b"yes"
+        cc = c.current_cc()
+        assert cc is not None and cc.db_info.epoch >= 2
+
+    c.run_until(c.loop.spawn(check()), timeout=120)
+
+
+def test_power_fail_reboot_twice(teardown):  # noqa: F811
+    """Two consecutive power-fail/reboot cycles: generation handoff must
+    re-persist carried data (TLog.recover_from), or the second reboot
+    loses commits from before the first."""
+    c = make_cluster()
+    db = c.database()
+
+    c.run_until(c.loop.spawn(commit_kv(db, b"gen1", b"a")), timeout=60)
+    c.power_fail_reboot()
+
+    db2 = c.database()
+
+    async def mid():
+        assert await read_key(db2, b"gen1") == b"a"
+        await commit_kv(db2, b"gen2", b"b")
+
+    c.run_until(c.loop.spawn(mid()), timeout=120)
+    c.power_fail_reboot()
+
+    db3 = c.database()
+
+    async def final():
+        assert await read_key(db3, b"gen1") == b"a"
+        assert await read_key(db3, b"gen2") == b"b"
+
+    c.run_until(c.loop.spawn(final()), timeout=120)
+
+
+def test_storage_worker_power_fail_recovers_from_engine(teardown):  # noqa: F811
+    """One storage machine power-fails; its worker reboots, recovers the
+    storage role from the engine files, and the data stays readable
+    through the recovered replica."""
+    c = make_cluster(n_workers=5, n_storage_workers=1, n_storage=1)
+    db = c.database()
+
+    async def load():
+        for i in range(10):
+            await commit_kv(db, b"s%02d" % i, b"v%02d" % i)
+
+    c.run_until(c.loop.spawn(load()), timeout=60)
+
+    # Power-fail the single storage machine, then reboot it in place.
+    c.sim.power_fail_machine("mach.worker0")
+    from foundationdb_tpu.core.futures import AsyncVar
+    from foundationdb_tpu.server.coordination import monitor_leader
+    from foundationdb_tpu.server.worker import Worker
+    p = c.sim.new_process(name="worker0", machineid="mach.worker0",
+                          process_class="storage")
+    leader_var = AsyncVar(None)
+    p.spawn(monitor_leader(c.coordinator_clients, leader_var),
+            "worker0.monitorLeader")
+    w = Worker(p, c.coordinator_clients, process_class="storage",
+               config=c.config)
+    w.run(leader_var)
+
+    async def check():
+        from foundationdb_tpu.core.scheduler import delay
+        # Wait for the rebooted worker to re-register with its recovered
+        # storage role, then force an epoch change: recovery resolves the
+        # storage tag to the recovered interface (until DataDistribution
+        # lands, re-registration is adopted at recovery time).
+        while True:
+            cc = c.current_cc()
+            reg = cc.workers.get("worker0") if cc is not None else None
+            if reg is not None and reg.recovered_storage:
+                break
+            await delay(0.1)
+        master_proc = c.process_of(c.current_cc().db_info.master)
+        c.sim.kill_process(master_proc)
+        for i in range(10):
+            assert await read_key(db, b"s%02d" % i) == b"v%02d" % i
+
+    c.run_until(c.loop.spawn(check()), timeout=120)
