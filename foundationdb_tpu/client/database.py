@@ -211,13 +211,18 @@ class Transaction:
         self._reset()
 
     def _reset(self) -> None:
-        """Clear per-attempt state (keeps backoff; see reset/on_error)."""
+        """Clear per-attempt state (keeps backoff and options; see
+        reset/on_error)."""
         self._read_version: Optional[Future] = None
         self.writes = WriteMap()
         self.read_conflict_ranges: List[Tuple[bytes, bytes]] = []
         self._extra_write_ranges: List[Tuple[bytes, bytes]] = []
         self.committed_version: Version = -1
         self.priority = TransactionPriority.DEFAULT
+        # Reference ACCESS_SYSTEM_KEYS transaction option: \xff keys are
+        # rejected unless explicitly enabled (management/DD transactions).
+        if not hasattr(self, "access_system_keys"):
+            self.access_system_keys = False
 
     def reset(self) -> None:
         self._reset()
@@ -250,7 +255,7 @@ class Transaction:
     # -- reads ---------------------------------------------------------------
     async def get(self, key: bytes, snapshot: bool = False
                   ) -> Optional[bytes]:
-        _check_key(key)
+        _check_key(key, self.access_system_keys)
         if not snapshot:
             self.read_conflict_ranges.append((key, key_after(key)))
         if self.writes.has_writes(key) and not self.writes.needs_base(key):
@@ -374,16 +379,16 @@ class Transaction:
 
     # -- writes --------------------------------------------------------------
     def set(self, key: bytes, value: bytes) -> None:
-        _check_key(key)
+        _check_key(key, self.access_system_keys)
         _check_value(value)
         self.writes.set(key, value)
 
     def clear(self, key: bytes, end: Optional[bytes] = None) -> None:
-        _check_key(key)
+        _check_key(key, self.access_system_keys)
         self.writes.clear(key, end if end is not None else key_after(key))
 
     def atomic_op(self, op: MutationType, key: bytes, operand: bytes) -> None:
-        _check_key(key)
+        _check_key(key, self.access_system_keys)
         self.writes.atomic_op(op, key, operand)
 
     def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
@@ -456,10 +461,10 @@ class Transaction:
                 await self.on_error(e)
 
 
-def _check_key(key: bytes) -> None:
+def _check_key(key: bytes, allow_system: bool = False) -> None:
     if len(key) > client_knobs().KEY_SIZE_LIMIT:
         raise err("key_too_large")
-    if key >= b"\xff":
+    if key >= (b"\xff\xff" if allow_system else b"\xff"):
         raise err("key_outside_legal_range")
 
 

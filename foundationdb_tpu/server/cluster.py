@@ -51,7 +51,9 @@ class SimCluster:
                       for i in range(n_tlogs)]
         self.resolvers = [
             Resolver(f"resolver{i}", recovery_version,
-                     backend=conflict_backend)
+                     backend=conflict_backend,
+                     proxy_ids=[f"proxy{i}" for i in
+                                range(n_commit_proxies)])
             for i in range(n_resolvers)]
         self.log_system = LogSystemClient([t.interface for t in self.tlogs])
         self.storage = [StorageServer(f"ss{i}", tag=i,
@@ -79,7 +81,10 @@ class SimCluster:
             CommitProxy(f"proxy{i}", self.master.interface,
                         [r.interface for r in self.resolvers],
                         self.log_system, self.key_resolvers,
-                        self.key_servers, storage_interfaces,
+                        # Each proxy owns its shard-map copy: committed
+                        # metadata mutations update it independently (the
+                        # resolver state-txn stream keeps copies aligned).
+                        self.key_servers.copy(), storage_interfaces,
                         recovery_version)
             for i in range(n_commit_proxies)]
         self.grv_proxies = [

@@ -116,6 +116,9 @@ class CommitProxy:
         self.stats = {"commits": 0, "conflicts": 0, "too_old": 0,
                       "batches": 0, "mutations": 0}
         self.broken = False   # set on mid-batch infrastructure failure
+        # Exactly-once cursor over foreign state transactions (version,
+        # origin proxy, seq); see _apply_foreign_state.
+        self._state_hwm: Tuple[Version, str, int] = (-1, "", -1)
 
     # -- batcher (reference commitBatcher :199) ------------------------------
     async def _commit_batcher(self) -> None:
@@ -194,6 +197,7 @@ class CommitProxy:
 
         # Phase 3: post-resolution. Gate on logging order (:1075).
         await self.batch_logging.when_at_least(batch_num - 1)
+        self._apply_foreign_state(resolutions)
         verdicts = self._determine_committed(batch, index_maps, resolutions)
         messages = self._assign_mutations_to_tags(
             batch, verdicts, commit_version)
@@ -258,13 +262,26 @@ class CommitProxy:
             last_received_version=self.last_resolved_version,
             transactions=[], proxy_id=self.id) for _ in range(n)]
         index_maps: List[List[int]] = [[] for _ in range(n)]
+        from .system_data import SYSTEM_KEYS_BEGIN
         for t_idx, req in enumerate(batch):
             txn = req.transaction
+            # Metadata-bearing ("state") transactions go to EVERY resolver
+            # with their mutations attached: each resolver records them with
+            # its local verdict and streams them to the other proxies
+            # (reference ResolutionRequestBuilder sends state txns to all
+            # resolvers; Resolver.actor.cpp:220-249).
+            is_state = any(
+                m.param1 >= SYSTEM_KEYS_BEGIN or
+                (m.type == MutationType.ClearRange
+                 and m.param2 > SYSTEM_KEYS_BEGIN)
+                for m in txn.mutations)
             touched = set()
             for r in txn.read_conflict_ranges + txn.write_conflict_ranges:
                 for _, _, idx in self.key_resolvers.intersecting(r.begin,
                                                                  r.end):
                     touched.add(idx)
+            if is_state:
+                touched = set(range(n))
             if not touched:
                 touched = {0}   # read-only/no-range txns: resolver 0 decides
             for idx in touched:
@@ -273,12 +290,44 @@ class CommitProxy:
                         txn.read_conflict_ranges, idx),
                     write_conflict_ranges=self._clip_ranges(
                         txn.write_conflict_ranges, idx),
-                    mutations=[],
+                    mutations=list(txn.mutations) if is_state else [],
                     read_snapshot=txn.read_snapshot,
                     report_conflicting_keys=txn.report_conflicting_keys)
+                if is_state:
+                    requests[idx].txn_state_transactions.append(
+                        len(requests[idx].transactions))
                 requests[idx].transactions.append(clipped)
                 index_maps[idx].append(t_idx)
         return requests, index_maps
+
+    def _apply_foreign_state(self, resolutions) -> None:
+        """Apply other proxies' committed metadata mutations to this
+        proxy's shard map (reference applyMetadataEffect :737): every
+        resolver reports each state txn with its LOCAL verdict; the global
+        verdict is the AND (min) across resolvers.  Entries are applied in
+        (version, origin, seq) order exactly once — a high-water mark
+        guards against re-delivery from pipelined batches whose
+        last_received_version lagged."""
+        from .system_data import apply_key_servers_mutation
+        merged: Dict[Tuple[Version, str, int], List] = {}
+        for reply in resolutions:
+            for version, origin, seq, mutations, verdict in \
+                    reply.state_transactions:
+                key = (version, origin, seq)
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = [mutations, verdict]
+                else:
+                    cur[1] = min(cur[1], verdict)
+        for key in sorted(merged):
+            if key <= self._state_hwm or key[1] == self.id:
+                continue
+            self._state_hwm = key
+            mutations, verdict = merged[key]
+            if verdict != CommitResult.COMMITTED:
+                continue
+            for m in mutations:
+                apply_key_servers_mutation(self.key_servers, m)
 
     def _determine_committed(self, batch, index_maps, resolutions
                              ) -> List[CommitResult]:
@@ -301,11 +350,24 @@ class CommitProxy:
             self, batch: List[CommitTransactionRequest],
             verdicts: List[CommitResult], commit_version: Version
     ) -> Dict[Tag, List[Mutation]]:
+        from .system_data import (SYSTEM_KEYS_BEGIN, TXS_TAG,
+                                  apply_key_servers_mutation)
         messages: Dict[Tag, List[Mutation]] = {}
         for req, verdict in zip(batch, verdicts):
             if verdict != CommitResult.COMMITTED:
                 continue
             for m in req.transaction.mutations:
+                # Metadata side effects FIRST (ApplyMetadataMutation.cpp:
+                # 52-61): a committed \xff/keyServers/ mutation updates this
+                # proxy's shard map before any later mutation is routed, and
+                # additionally rides TXS_TAG so the next recovery replays it
+                # onto the DBCoreState baseline.  The mutation itself still
+                # routes to storage below like any key.
+                if m.param1 >= SYSTEM_KEYS_BEGIN or (
+                        m.type == MutationType.ClearRange
+                        and m.param2 > SYSTEM_KEYS_BEGIN):
+                    if apply_key_servers_mutation(self.key_servers, m):
+                        messages.setdefault(TXS_TAG, []).append(m)
                 if m.type == MutationType.ClearRange:
                     # A clear can span shards: clip per intersecting shard
                     # so each storage team gets only its part (:980-1010).

@@ -24,7 +24,9 @@ from ..txn.types import (CommitResult, CommitTransactionRef, KeyRange,
                          Mutation, Version)
 
 Tag = int
-TXS_TAG: Tag = -1  # metadata/state transactions (reference txsTag)
+# Metadata/state transactions tag (reference txsTag).  u32-max-adjacent so
+# it packs through the wire format; system_data re-exports this.
+TXS_TAG: Tag = 0xFFFFFFFE
 
 
 class TransactionPriority:
@@ -132,6 +134,13 @@ class ResolveTransactionBatchRequest:
 @dataclass
 class ResolveTransactionBatchReply:
     committed: List[CommitResult]
+    # State transactions (metadata-bearing, reference Resolver.actor.cpp
+    # :220-249): entries (version, origin_proxy_id, seq, mutations,
+    # local_verdict) for every state txn resolved since the requesting
+    # proxy's last_received_version.  Each proxy ANDs the per-resolver
+    # verdicts and applies committed foreign entries to its shard map
+    # (reference CommitProxyServer.actor.cpp:737 applyMetadataEffect).
+    state_transactions: List[Any] = field(default_factory=list)
 
 
 class ResolverInterface:
@@ -475,6 +484,10 @@ class InitializeResolverRequest:
     resolver_id: str
     epoch: int
     recovery_version: Version
+    # Commit proxies of this epoch: the resolver pre-registers them so
+    # state-transaction trimming waits for proxies that haven't sent a
+    # batch yet (a late first batch must still see earlier metadata).
+    proxy_ids: List[str] = field(default_factory=list)
     reply: Any = None     # -> ResolverInterface
 
 

@@ -173,6 +173,10 @@ class DBCoreState:
     key_servers_ranges: List[Tuple[bytes, bytes, List[Tag]]] = \
         field(default_factory=list)
     n_resolvers: int = 1
+    # Version at which key_servers_ranges was snapshotted: recovery replays
+    # TXS_TAG metadata deltas with version > map_version on top of it
+    # (reference: txnStateStore recovered from the txsTag stream).
+    map_version: Version = 0
     # Durable identities mirroring the interface lists: live interface
     # objects don't survive a power failure, so pack() stores ids and the
     # rebooted master re-resolves them against worker-recovered roles
@@ -184,6 +188,7 @@ class DBCoreState:
     def pack(self) -> bytes:
         from ..core.wire import Writer
         w = Writer().u32(self.epoch).i64(self.recovery_version)
+        w.i64(self.map_version)
         w.u8(self.log_replication).u8(self.n_resolvers)
         tlog_ids = self.tlog_ids or [t.id for t in self.tlogs]
         w.u16(len(tlog_ids))
@@ -215,6 +220,7 @@ class DBCoreState:
         from ..core.wire import Reader
         r = Reader(blob)
         epoch, rv = r.u32(), r.i64()
+        map_version = r.i64()
         log_rep, n_res = r.u8(), r.u8()
         tlog_ids = [r.str_() for _ in range(r.u16())]
         storage_ids = {r.u32(): r.str_() for _ in range(r.u16())}
@@ -227,7 +233,8 @@ class DBCoreState:
                    tlogs=[None] * len(tlog_ids), log_replication=log_rep,
                    storage_servers={t: None for t in storage_ids},
                    key_servers_ranges=ranges, n_resolvers=n_res,
-                   tlog_ids=tlog_ids, storage_ids=storage_ids)
+                   tlog_ids=tlog_ids, storage_ids=storage_ids,
+                   map_version=map_version)
 
 
 def _split_points(n: int) -> List[bytes]:
@@ -323,6 +330,42 @@ async def master_server(master: Master, process, coordinators,
             # the min over locked end-versions is >= every visible commit.
             recovery_version = min(r.end_version for r in locked.values())
 
+            # Replay metadata deltas committed since the baseline snapshot
+            # (TXS_TAG stream; reference txnStateStore seeding,
+            # CommitProxyServer.actor.cpp:57) so the shard map recruited
+            # below reflects every committed boundary change of the old
+            # epoch — no static rewiring.
+            from .shardmap import RangeMap
+            from .system_data import TXS_TAG, apply_key_servers_mutation
+            from .interfaces import TLogPeekRequest
+            map_rm: RangeMap = RangeMap(default=None)
+            for b, e, team in prev.key_servers_ranges:
+                map_rm.set_range(b, e, team)
+            txs_holder = next((i for i in old_ls.team_for_tag(TXS_TAG)
+                               if i in locked), None)
+            if txs_holder is None:
+                # Without the txs stream we cannot know whether boundary
+                # changes were committed since the snapshot; adopting the
+                # stale map could misroute mutations.
+                raise err("master_recovery_failed",
+                          "txs tag has no surviving TLog holder")
+            txs = await RequestStream.at(
+                old_tlogs[txs_holder].peek.endpoint).get_reply(
+                TLogPeekRequest(tag=TXS_TAG, begin=prev.map_version + 1))
+            n_deltas = 0
+            for v, msgs in txs.messages:
+                if prev.map_version < v <= recovery_version:
+                    for m in msgs:
+                        apply_key_servers_mutation(map_rm, m)
+                        n_deltas += 1
+            if n_deltas:
+                TraceEvent("MasterTxnStateReplayed").detail(
+                    "Deltas", n_deltas).detail(
+                    "FromVersion", prev.map_version).log()
+            prev.key_servers_ranges = [
+                (b, e, team) for b, e, team in map_rm.ranges()
+                if team is not None]
+
         master.version = recovery_version
         master.last_epoch_end = recovery_version
         master.live_committed_version = recovery_version
@@ -372,11 +415,14 @@ async def master_server(master: Master, process, coordinators,
                     recover_popped={t: old_popped.get(t, 0)
                                     for t in my_tags},
                     epoch=master.epoch)))
+        epoch_proxy_ids = [f"proxy{i}.e{master.epoch}"
+                           for i in range(config.n_commit_proxies)]
         resolver_futures = [RequestStream.at(
             pick(i + 1).init_resolver.endpoint).get_reply(
             InitializeResolverRequest(
                 resolver_id=f"resolver{i}.e{master.epoch}",
-                epoch=master.epoch, recovery_version=recovery_version))
+                epoch=master.epoch, recovery_version=recovery_version,
+                proxy_ids=epoch_proxy_ids))
             for i in range(config.n_resolvers)]
         if prev is not None:
             # Storage is long-lived: reuse the existing servers — live
@@ -446,7 +492,8 @@ async def master_server(master: Master, process, coordinators,
             tlogs=tlogs, log_replication=config.log_replication,
             storage_servers=storage_servers,
             key_servers_ranges=key_servers_ranges,
-            n_resolvers=config.n_resolvers))
+            n_resolvers=config.n_resolvers,
+            map_version=recovery_version))
 
         # ACCEPTING_COMMITS (:1943): start the allocator + announce.
         adopt(master._serve_commit_versions(), "master.serveVersions")

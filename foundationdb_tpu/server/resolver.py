@@ -36,15 +36,26 @@ class _ProxyInfo:
 class Resolver:
     def __init__(self, resolver_id: str = "r0",
                  recovery_version: Version = 0,
-                 backend: Optional[str] = None, **backend_kwargs) -> None:
+                 backend: Optional[str] = None,
+                 proxy_ids: Optional[List[str]] = None,
+                 **backend_kwargs) -> None:
         self.id = resolver_id
         self.version = NotifiedVersion(recovery_version)
         self.interface = ResolverInterface(resolver_id)
         self.conflict_set: ConflictSet = new_conflict_set(
             backend, oldest_version=recovery_version, **backend_kwargs)
         self.proxy_infos: Dict[str, _ProxyInfo] = {}
+        for pid in proxy_ids or []:
+            info = _ProxyInfo()
+            info.last_received_version = recovery_version
+            self.proxy_infos[pid] = info
         self.total_state_bytes = 0
         self.resolved_batches = 0
+        # Accumulated state transactions for cross-proxy metadata broadcast
+        # (reference :220-249): (version, origin_proxy, seq, mutations,
+        # local_verdict), version-ascending; trimmed once every registered
+        # proxy's last_received_version has passed.
+        self.state_txns: List[tuple] = []
 
     async def _resolve_batch(self, req: ResolveTransactionBatchRequest) -> None:
         proxy = self.proxy_infos.setdefault(req.proxy_id, _ProxyInfo())
@@ -72,8 +83,23 @@ class Resolver:
                          int(knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS))
         committed = self.conflict_set.resolve(
             req.transactions, req.version, new_oldest_version=new_oldest)
-        reply = ResolveTransactionBatchReply(committed=committed)
+        # Foreign state txns resolved since this proxy last heard from us
+        # (strictly before this batch's version; ours are appended below).
+        lrv = req.last_received_version
+        reply = ResolveTransactionBatchReply(
+            committed=committed,
+            state_transactions=[e for e in self.state_txns
+                                if e[0] > lrv and e[1] != req.proxy_id])
         self.resolved_batches += 1
+
+        # Record this batch's state transactions with OUR local verdict;
+        # other proxies AND the verdicts across all resolvers.
+        for seq, t_idx in enumerate(req.txn_state_transactions):
+            entry = (req.version, req.proxy_id, seq,
+                     req.transactions[t_idx].mutations, committed[t_idx])
+            self.state_txns.append(entry)
+            self.total_state_bytes += sum(
+                m.expected_size() for m in entry[3])
 
         # Cache for resend dedup; trim acknowledged batches
         # (reference :175 outstandingBatches, trimmed by lastReceivedVersion).
@@ -84,6 +110,16 @@ class Resolver:
         for v in [v for v in proxy.outstanding
                   if v < proxy.last_received_version]:
             del proxy.outstanding[v]
+        # Trim state txns every live proxy has received (memory bound;
+        # reference RESOLVER_STATE_MEMORY_LIMIT backpressure :126-135).
+        min_lrv = min(p.last_received_version
+                      for p in self.proxy_infos.values())
+        if self.state_txns and self.state_txns[0][0] <= min_lrv:
+            kept = [e for e in self.state_txns if e[0] > min_lrv]
+            self.total_state_bytes -= sum(
+                sum(m.expected_size() for m in e[3])
+                for e in self.state_txns[:len(self.state_txns) - len(kept)])
+            self.state_txns = kept
 
         # Advance the chain BEFORE the reply lands: the next batch resolves
         # while this reply is in flight (pipeline parallelism of batches).

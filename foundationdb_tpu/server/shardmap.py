@@ -65,6 +65,12 @@ class RangeMap(Generic[T]):
     def ranges(self) -> Iterator[Tuple[bytes, bytes, T]]:
         yield from self.intersecting(b"", self.end_key)
 
+    def copy(self) -> "RangeMap[T]":
+        out: RangeMap[T] = RangeMap(end_key=self.end_key)
+        out._bounds = list(self._bounds)
+        out._values = list(self._values)
+        return out
+
     # -- updates -------------------------------------------------------------
     def set_range(self, begin: bytes, end: bytes, value: T) -> None:
         """Assign `value` to [begin, end), splitting boundaries as needed."""

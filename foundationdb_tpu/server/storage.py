@@ -409,13 +409,16 @@ class StorageServer:
         if crossing and self.version.get() > recovery_version:
             self.data.rollback(recovery_version)
             # NotifiedVersion cannot go backwards; recreate at the floor.
-            rolled_durable = self.durable_version.get() > recovery_version
             self.version = NotifiedVersion(recovery_version)
             self.durable_version = NotifiedVersion(recovery_version)
             self._durable_pending = [
                 e for e in self._durable_pending if e[0] <= recovery_version]
-            if self.engine is not None and rolled_durable and \
-                    self._process is not None:
+            # Re-image unconditionally: durable_version may understate what
+            # the engine holds when an _update_storage_loop flush is still
+            # in flight — its commit could persist rolled-back mutations
+            # after this check, so the re-image (serialized behind it on
+            # the engine) must always land.
+            if self.engine is not None and self._process is not None:
                 # Rare epoch-change path: durable state ran ahead of the new
                 # recovery version; rewrite the engine from the rolled-back
                 # image (the reference instead persists rollback records —

@@ -81,8 +81,10 @@ class TLog:
         # Durable backing (None = pure in-memory mode for static harnesses;
         # fsync is then just a simulated latency).
         self.disk_queue = disk_queue
-        # (version, queue seq) per pushed record, for pop-driven trimming.
-        self._record_seqs: Deque[Tuple[Version, int]] = deque()
+        # (version, queue seq, tags in record) per pushed record, for
+        # pop-driven trimming.
+        self._record_seqs: Deque[Tuple[Version, int, frozenset]] = \
+            deque()
 
     @classmethod
     async def from_disk(cls, tlog_id: str, disk_queue: DiskQueue,
@@ -102,7 +104,7 @@ class TLog:
                 t.tag_data.setdefault(tag, deque()).append((version, msgs))
                 t.bytes_input += sum(m.expected_size() for m in msgs)
             t.known_committed_version = max(t.known_committed_version, kcv)
-            t._record_seqs.append((version, seq))
+            t._record_seqs.append((version, seq, frozenset(messages)))
             if version > t.version.get():
                 t.version.set(version)
         t.durable_version.set(t.version.get())
@@ -151,7 +153,8 @@ class TLog:
                 seq = self.disk_queue.push(_pack_commit(
                     v, prev_v, self.known_committed_version,
                     dict(self.poppedtags), by_version[v]))
-                self._record_seqs.append((v, seq))
+                self._record_seqs.append((v, seq,
+                                          frozenset(by_version[v])))
                 prev_v = v
             await self.disk_queue.commit()
         TraceEvent("TLogRecovered").detail("Id", self.id).detail(
@@ -202,7 +205,8 @@ class TLog:
                     req.version, req.prev_version,
                     self.known_committed_version, dict(self.poppedtags),
                     req.messages))
-                self._record_seqs.append((req.version, seq))
+                self._record_seqs.append(
+                    (req.version, seq, frozenset(req.messages)))
             self.version.set(req.version)
             self._start_sync()
         await self.durable_version.when_at_least(req.version)
@@ -262,15 +266,22 @@ class TLog:
             req.reply.send(None)
 
     def _trim_queue(self) -> None:
-        """Trim disk records once every tag with data has popped past them
-        (the trim frontier is persisted with the next append — the
-        reference's lazy page-header popped location)."""
-        if self.disk_queue is None or not self.tag_data:
+        """Trim disk records from the front while every tag each record
+        carries has popped past it (the trim frontier is persisted with the
+        next append — the reference's lazy page-header popped location).
+        TXS_TAG records are popped only at recovery, so a queue holding
+        metadata mutations retains everything after the first un-popped one
+        (the reference spills instead; metadata is rare enough that this
+        stays small between recoveries)."""
+        if self.disk_queue is None:
             return
-        fully = min(self.poppedtags.get(t, 0) for t in self.tag_data)
         last_seq = 0
-        while self._record_seqs and self._record_seqs[0][0] <= fully:
-            _, last_seq = self._record_seqs.popleft()
+        while self._record_seqs:
+            version, seq, tags = self._record_seqs[0]
+            if not all(self.poppedtags.get(t, 0) >= version for t in tags):
+                break
+            self._record_seqs.popleft()
+            last_seq = seq
         if last_seq:
             self.disk_queue.pop(last_seq)
 

@@ -164,7 +164,7 @@ class Worker:
             backend = getattr(self.config, "conflict_backend", None) \
                 if self.config else None
             r = Resolver(req.resolver_id, req.recovery_version,
-                         backend=backend)
+                         backend=backend, proxy_ids=req.proxy_ids)
             r.run(self.process)
             req.reply.send(r.interface)
 

@@ -1,0 +1,126 @@
+"""Metadata through the pipeline: \\xff system keys drive the shard map.
+
+Reference: fdbclient/SystemData.cpp key conventions +
+fdbserver/ApplyMetadataMutation.cpp:52-61 — a committed
+`\\xff/keyServers/` mutation updates every proxy's routing table, rides
+the TXS_TAG stream for recovery replay, and is serializable like any
+transaction.  These tests prove a shard-map change mid-run reroutes new
+mutations with no static rewiring, propagates across proxies via the
+resolver state-transaction stream, and survives both an epoch change and
+a whole-cluster power-fail reboot."""
+
+import pytest
+
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+from foundationdb_tpu.server.system_data import (key_servers_key,
+                                                 key_servers_value)
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+
+def make_cluster(**cfg):
+    n_workers = cfg.pop("n_workers", 5)
+    n_storage_workers = cfg.pop("n_storage_workers", 2)
+    config = DatabaseConfiguration(**cfg)
+    return SimFdbCluster(config=config, n_workers=n_workers,
+                        n_storage_workers=n_storage_workers)
+
+
+async def move_range(db, begin, end, team, restore_team):
+    """One metadata transaction assigning [begin, end) to `team` (the
+    following range keeps `restore_team`) — what MoveKeys will issue."""
+    from foundationdb_tpu.core import FdbError
+    t = db.create_transaction()
+    t.access_system_keys = True
+    while True:
+        try:
+            t.set(key_servers_key(begin), key_servers_value(team))
+            t.set(key_servers_key(end), key_servers_value(restore_team))
+            await t.commit()
+            return
+        except FdbError as e:
+            await t.on_error(e)
+
+
+def storage_role(cluster, tag):
+    for _p, w, _cc, _lv in cluster.workers:
+        for ss in w.storage_roles:
+            if ss.tag == tag:
+                return ss
+    return None
+
+
+def test_shard_map_change_reroutes(teardown):  # noqa: F811
+    c = make_cluster(n_storage=2)
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.core.scheduler import delay
+        await commit_kv(db, b"warm", b"up")
+        # Initially [b"", \x80) -> tag 0, so b"zz/..." lives on tag 0.
+        await commit_kv(db, b"zz/before", b"old")
+        # Move [zz/, zz0) to tag 1's team, transactionally.
+        await move_range(db, b"zz/", b"zz0", [1], [0])
+        await commit_kv(db, b"zz/x", b"routed")
+        assert await read_key(db, b"zz/x") == b"routed"
+        await delay(0.3)   # let storage pulls drain
+        ss0, ss1 = storage_role(c, 0), storage_role(c, 1)
+        assert ss1.data.get(b"zz/x", ss1.version.get()) == b"routed", \
+            "new writes must route to the newly assigned team"
+        assert ss0.data.get(b"zz/x", ss0.version.get()) is None
+        # The pre-move key stayed where it was written.
+        assert ss0.data.get(b"zz/before", ss0.version.get()) == b"old"
+
+    c.run_until(c.loop.spawn(go()), timeout=120)
+
+
+def test_metadata_survives_epoch_change_and_reboot(teardown):  # noqa: F811
+    c = make_cluster(n_storage=2)
+    db = c.database()
+
+    async def phase1():
+        await commit_kv(db, b"warm", b"up")
+        await move_range(db, b"zz/", b"zz0", [1], [0])
+        await commit_kv(db, b"zz/a", b"1")
+        # Epoch change: the new master must replay the TXS_TAG deltas and
+        # seed the new proxies with the CURRENT map.
+        cc = c.current_cc()
+        c.sim.kill_process(c.process_of(cc.db_info.master))
+        await commit_kv(db, b"zz/b", b"2")
+        from foundationdb_tpu.core.scheduler import delay
+        await delay(0.3)
+        ss1 = storage_role(c, 1)
+        assert ss1.data.get(b"zz/b", ss1.version.get()) == b"2", \
+            "post-recovery writes must still route to the moved team"
+
+    c.run_until(c.loop.spawn(phase1()), timeout=120)
+
+    c.power_fail_reboot()
+    db2 = c.database()
+
+    async def phase2():
+        assert await read_key(db2, b"zz/a") == b"1"
+        assert await read_key(db2, b"zz/b") == b"2"
+        await commit_kv(db2, b"zz/c", b"3")
+        from foundationdb_tpu.core.scheduler import delay
+        await delay(0.3)
+        ss1 = storage_role(c, 1)
+        assert ss1.data.get(b"zz/c", ss1.version.get()) == b"3", \
+            "the moved boundary must survive a power-fail reboot"
+
+    c.run_until(c.loop.spawn(phase2()), timeout=120)
+
+
+def test_system_keys_require_option(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.core import FdbError
+        t = db.create_transaction()
+        with pytest.raises(FdbError) as ei:
+            t.set(b"\xff/keyServers/x", b"v")
+        assert ei.value.name == "key_outside_legal_range"
+
+    c.run_until(c.loop.spawn(go()), timeout=30)
