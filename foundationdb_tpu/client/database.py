@@ -43,6 +43,7 @@ RETRYABLE = frozenset({
     "not_committed", "transaction_too_old", "future_version",
     "commit_unknown_result", "process_behind", "proxy_memory_limit_exceeded",
     "broken_promise", "request_maybe_delivered", "connection_failed",
+    "wrong_shard_server",
 })
 
 
@@ -197,6 +198,12 @@ class Database:
 
     def invalidate_cache(self, key: bytes) -> None:
         self._location_cache.set_range(key, key_after(key), None)
+
+    async def get_shard_location(self, key: bytes):
+        """(shard_begin, shard_end, [StorageServerInterface]) for the shard
+        containing `key` — the ConsistencyCheck/audit surface."""
+        await self.get_key_location(key)
+        return self._location_cache.range_containing(key)
 
     def create_transaction(self) -> "Transaction":
         return Transaction(self)

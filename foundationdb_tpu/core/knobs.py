@@ -74,6 +74,10 @@ class ServerKnobs(KnobBase):
         self.CONFLICT_SET_BACKEND = "cpu"
         self.TPU_CONFLICT_CAPACITY = 1 << 17  # max resident history segments
 
+        # Data distribution (reference DD_SHARD_SIZE_GRANULARITY etc.)
+        self.DD_SHARD_SPLIT_BYTES = 1 << 20   # split a shard above this
+        self.DD_METRICS_INTERVAL = 0.5        # shard-size poll cadence
+
         # GRV / ratekeeper
         self.START_TRANSACTION_BATCH_INTERVAL_MIN = 1e-6
         self.START_TRANSACTION_BATCH_INTERVAL_MAX = 0.010

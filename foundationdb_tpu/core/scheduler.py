@@ -62,6 +62,7 @@ class TaskPriority(IntEnum):
     DiskRead = 5010
     DefaultEndpoint = 5000
     UnknownEndpoint = 4000
+    FetchKeys = 3910
     MoveKeys = 3550
     DataDistribution = 3500
     StorageServer = 3000

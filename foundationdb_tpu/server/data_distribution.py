@@ -1,0 +1,326 @@
+"""DataDistributor: shard tracking, MoveKeys, team re-replication.
+
+Reference: fdbserver/DataDistribution.actor.cpp (DDTeamCollection :629,
+storageServerTracker :4488, teamTracker :3506),
+fdbserver/DataDistributionTracker.actor.cpp (split/merge on size) and
+fdbserver/MoveKeys.actor.cpp (two-phase shard handoff through
+`\\xff/keyServers/` transactions).  The TPU-native reformulation keeps the
+reference's core invariants with a simpler surface:
+
+  * Shard moves are TRANSACTIONS: phase 1 sets the shard's team to
+    old ∪ new (both receive fresh writes), destinations fetchKeys a
+    snapshot from a surviving source, phase 2 sets the final team and the
+    vacated replicas drop the range.  Readers are never wrong: a
+    destination rejects reads (wrong_shard_server) until its snapshot is
+    complete, and sources after phase 2 reject reads so clients refresh
+    their location caches.
+  * Re-replication: when a storage server dies, every shard whose team
+    contains it is re-assigned to surviving members plus a healthy
+    replacement, with data fetched from the survivors (teamTracker's
+    "unhealthy team" path).
+  * Split: shards whose byte size exceeds DD_SHARD_SPLIT_BYTES split at a
+    mid-bytes key (DataDistributionTracker shardSplitter) — a pure
+    metadata transaction, no data movement.
+
+The DD is a master-recruited singleton (like Ratekeeper here; the
+reference hangs it off the CC) and runs its metadata transactions through
+the ordinary client — the same serializable commit path as everyone else.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..core.error import FdbError
+from ..core.knobs import server_knobs
+from ..core.scheduler import delay
+from ..core.trace import Severity, TraceEvent
+from ..rpc.endpoint import RequestStream
+from .interfaces import (DataDistributorInterface, FetchKeysRequest,
+                         GetShardMetricsRequest, RemoveShardRequest, Tag)
+from .system_data import key_servers_key, key_servers_value
+
+
+class BoundaryMap:
+    """DD's shard map: explicit boundaries that are NEVER coalesced.
+
+    RangeMap merges adjacent equal-valued ranges — correct for routing,
+    wrong here: a same-team split boundary is real DD state (it bounds
+    tracking granularity).  Note the routing maps and the DBCoreState
+    snapshot DO coalesce same-team boundaries, so after an epoch change
+    the new DD re-derives splits from size metrics (the reference persists
+    every boundary in the database; a fidelity gap to close with the
+    serverKeys keyspace)."""
+
+    def __init__(self) -> None:
+        import bisect
+        self._bisect = bisect
+        self._bounds = [b""]
+        self._teams = [None]
+
+    def set_boundary(self, key: bytes, team) -> None:
+        i = self._bisect.bisect_left(self._bounds, key)
+        if i < len(self._bounds) and self._bounds[i] == key:
+            self._teams[i] = team
+        else:
+            self._bounds.insert(i, key)
+            self._teams.insert(i, team)
+
+    def lookup(self, key: bytes):
+        return self._teams[self._bisect.bisect_right(self._bounds, key) - 1]
+
+    def shard_end(self, begin: bytes) -> bytes:
+        i = self._bisect.bisect_right(self._bounds, begin)
+        return self._bounds[i] if i < len(self._bounds) else b"\xff\xff"
+
+    def ranges(self):
+        for i, b in enumerate(self._bounds):
+            e = (self._bounds[i + 1] if i + 1 < len(self._bounds)
+                 else b"\xff\xff")
+            yield b, e, self._teams[i]
+
+    def __len__(self) -> int:
+        return len(self._bounds)
+
+
+class _Lock:
+    """Minimal async mutex: relocation operations (moves, splits) must not
+    interleave across awaits, or a split could commit a stale-team
+    boundary mid-move (reference serializes through the MoveKeys lock,
+    MoveKeys.actor.cpp takeMoveKeysLock)."""
+
+    def __init__(self) -> None:
+        self._locked = False
+        self._waiters: List = []
+
+    async def __aenter__(self) -> None:
+        from ..core.futures import Promise
+        while self._locked:
+            p = Promise()
+            self._waiters.append(p)
+            await p.get_future()
+        self._locked = True
+
+    async def __aexit__(self, *exc) -> None:
+        self._locked = False
+        if self._waiters:
+            self._waiters.pop(0).send(None)
+
+
+class DataDistributor:
+    def __init__(self, dd_id: str, db, storage_interfaces: Dict[Tag, Any],
+                 key_servers_ranges, replication: int = 1) -> None:
+        self.id = dd_id
+        self.db = db                      # client Database (metadata txns)
+        self.interface = DataDistributorInterface(dd_id)
+        self.storage = dict(storage_interfaces)
+        self.replication = replication
+        self.map = BoundaryMap()
+        for b, e, team in key_servers_ranges:
+            self.map.set_boundary(b, list(team))
+        self.healthy = set(self.storage)
+        self.moves_in_flight = 0
+        self._relocation_lock = _Lock()
+        # Per-shard-begin poll backoff: shards well under the split
+        # threshold are re-measured exponentially less often (the
+        # reference's byte sampling makes metrics O(changes); this bounds
+        # our exact-scan fallback).
+        self._poll_backoff: Dict[bytes, List[int]] = {}
+        self.stats = {"splits": 0, "moves": 0, "rereplications": 0}
+
+    # -- metadata transactions ----------------------------------------------
+    async def _commit_boundaries(self, sets) -> None:
+        """One serializable txn writing keyServers boundaries; retried."""
+        t = self.db.create_transaction()
+        t.access_system_keys = True
+        while True:
+            try:
+                for boundary, team in sets:
+                    t.set(key_servers_key(boundary), key_servers_value(team))
+                await t.commit()
+                return
+            except FdbError as e:
+                await t.on_error(e)
+
+    # -- MoveKeys (reference MoveKeys.actor.cpp two-phase handoff) -----------
+    async def move_shard(self, begin: bytes, end: bytes,
+                         new_team: List[Tag]) -> None:
+        """Relocate [begin, end) — must be an existing whole shard — onto
+        new_team, fetching data from the current team's survivors."""
+        async with self._relocation_lock:
+            await self._move_shard_locked(begin, end, new_team)
+
+    async def _move_shard_locked(self, begin: bytes, end: bytes,
+                                 new_team: List[Tag]) -> None:
+        old_team = list(self.map.lookup(begin) or [])
+        union = old_team + [t for t in new_team if t not in old_team]
+        self.moves_in_flight += 1
+        phase1_done = False
+        try:
+            # Phase 1 (startMoveKeys): both teams receive fresh writes.
+            await self._commit_boundaries([(begin, union)])
+            self.map.set_boundary(begin, union)
+            phase1_done = True
+            # fetchKeys on every new member, sourced from live old members.
+            sources = [self.storage[t] for t in old_team
+                       if t in self.healthy and t in self.storage]
+            fetches = []
+            for t in new_team:
+                if t in old_team:
+                    continue
+                fetches.append(RequestStream.at(
+                    self.storage[t].fetch_keys.endpoint).get_reply(
+                    FetchKeysRequest(begin=begin, end=end,
+                                     sources=sources)))
+            from ..core.futures import wait_all
+            await wait_all(fetches)
+            # Phase 2 (finishMoveKeys): final ownership.
+            await self._commit_boundaries([(begin, list(new_team))])
+            self.map.set_boundary(begin, list(new_team))
+            for t in old_team:
+                if t in new_team or t not in self.healthy:
+                    continue
+                RequestStream.at(
+                    self.storage[t].remove_shard.endpoint).send(
+                    RemoveShardRequest(begin=begin, end=end))
+            self.stats["moves"] += 1
+            TraceEvent("DDMovedShard").detail("Begin", begin).detail(
+                "End", end).detail("From", old_team).detail(
+                "To", new_team).log()
+        except BaseException:
+            if phase1_done:
+                # Roll the boundary back so the shard isn't left pointing
+                # at a destination that disowned it (failed fetch); the
+                # caller decides whether to retry with other members.
+                try:
+                    await self._commit_boundaries([(begin, old_team)])
+                    self.map.set_boundary(begin, old_team)
+                except FdbError as e:
+                    TraceEvent("DDMoveRollbackFailed",
+                               Severity.Error).detail(
+                        "Begin", begin).detail("Error", e.name).log()
+            raise
+        finally:
+            self.moves_in_flight -= 1
+
+    # -- re-replication (reference teamTracker unhealthy path) ---------------
+    async def _handle_storage_failure(self, dead_tag: Tag) -> None:
+        self.healthy.discard(dead_tag)
+        TraceEvent("DDStorageFailed", Severity.Warn).detail(
+            "Tag", dead_tag).log()
+        for begin, _e, _t in list(self.map.ranges()):
+            # Fresh lookups: a concurrent split/move may have changed this
+            # shard since the snapshot above.
+            team = self.map.lookup(begin)
+            end = self.map.shard_end(begin)
+            if not team or dead_tag not in team:
+                continue
+            survivors = [t for t in team if t in self.healthy]
+            if not survivors:
+                TraceEvent("DDShardUnrecoverable", Severity.Error).detail(
+                    "Begin", begin).detail("End", end).log()
+                continue
+            candidates = sorted(self.healthy - set(team))
+            new_team = survivors + candidates[:max(
+                0, min(self.replication, len(self.healthy)) -
+                len(survivors))]
+            if set(new_team) == set(team):
+                continue
+            try:
+                await self.move_shard(begin, end, new_team)
+                self.stats["rereplications"] += 1
+            except FdbError as e:
+                TraceEvent("DDRereplicationFailed", Severity.Warn).detail(
+                    "Begin", begin).detail("Error", e.name).log()
+
+    async def _failure_monitor(self, tag: Tag, ssi) -> None:
+        from .failure import wait_failure_of
+        await wait_failure_of(ssi)
+        if tag in self.healthy:
+            await self._handle_storage_failure(tag)
+
+    # -- shard-size tracking (reference DataDistributionTracker) -------------
+    async def _split_loop(self) -> None:
+        knobs = server_knobs()
+        while True:
+            await delay(float(knobs.DD_METRICS_INTERVAL))
+            if self.moves_in_flight:
+                continue   # don't split a shard mid-relocation
+            for begin, end, team in list(self.map.ranges()):
+                if not team:
+                    continue
+                backoff = self._poll_backoff.get(begin)
+                if backoff is not None and backoff[1] > 0:
+                    backoff[1] -= 1
+                    continue
+                holder = next((t for t in team if t in self.healthy), None)
+                if holder is None:
+                    continue
+                try:
+                    total, split_key = await RequestStream.at(
+                        self.storage[holder].shard_metrics.endpoint
+                    ).get_reply(GetShardMetricsRequest(
+                        begin=begin, end=end,
+                        split_threshold=int(knobs.DD_SHARD_SPLIT_BYTES)))
+                except FdbError:
+                    continue
+                if total < int(knobs.DD_SHARD_SPLIT_BYTES) // 2:
+                    # Cold shard: double its poll backoff (cap 32 sweeps).
+                    b = self._poll_backoff.setdefault(begin, [1, 0])
+                    b[0] = min(b[0] * 2, 32)
+                    b[1] = b[0]
+                else:
+                    self._poll_backoff.pop(begin, None)
+                if split_key is None or not begin < split_key < end:
+                    continue
+                async with self._relocation_lock:
+                    # Re-validate under the lock: a move/split may have
+                    # changed this shard while metrics were in flight — a
+                    # stale-team boundary would strand the tail range.
+                    if (self.map.lookup(begin) != team or
+                            self.map.shard_end(begin) != end or
+                            self.halted):
+                        continue
+                    # Pure metadata split: same team both sides.
+                    await self._commit_boundaries([(split_key, list(team))])
+                    self.map.set_boundary(split_key, list(team))
+                    self.stats["splits"] += 1
+                    TraceEvent("DDShardSplit").detail(
+                        "At", split_key).detail("Bytes", total).log()
+
+    async def _check_removed(self, db_info_var, epoch: int) -> None:
+        """Halt when a newer epoch recruits a different DD (reference
+        checkRemoved, Resolver.actor.cpp:357-366): a deposed distributor
+        must not keep issuing moves against the new generation's state."""
+        while True:
+            info = db_info_var.get()
+            if info.epoch > epoch and \
+                    info.data_distributor is not self.interface:
+                TraceEvent("DataDistributorHalted").detail(
+                    "Id", self.id).detail("NewEpoch", info.epoch).log()
+                self.halted = True
+                for a in self._actors:
+                    if not a.is_ready():
+                        a.cancel()
+                return
+            await db_info_var.on_change()
+
+    def run(self, process, db_info_var=None, epoch: int = 0) -> None:
+        self.halted = False
+        self._actors = []
+        for s in self.interface.streams():
+            process.register(s)
+        for tag, ssi in self.storage.items():
+            self._actors.append(process.spawn(
+                self._failure_monitor(tag, ssi), f"{self.id}.ssTracker"))
+        self._actors.append(process.spawn(self._split_loop(),
+                                          f"{self.id}.shardTracker"))
+        from .failure import hold_wait_failure
+        process.spawn(hold_wait_failure(self.interface.wait_failure),
+                      f"{self.id}.waitFailure")
+        if db_info_var is not None:
+            process.spawn(self._check_removed(db_info_var, epoch),
+                          f"{self.id}.checkRemoved")
+        TraceEvent("DataDistributorStarted").detail("Id", self.id).detail(
+            "Shards", len(self.map)).detail(
+            "Storage", len(self.storage)).log()

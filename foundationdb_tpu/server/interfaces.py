@@ -382,6 +382,7 @@ class ServerDBInfo:
     tlogs: List[Any] = field(default_factory=list)
     storage_servers: Dict[Tag, Any] = field(default_factory=dict)
     ratekeeper: Any = None
+    data_distributor: Any = None
 
 
 @dataclass
@@ -492,6 +493,76 @@ class InitializeResolverRequest:
 
 
 @dataclass
+class FetchKeysRequest:
+    """DD -> destination SS: become a replica of [begin, end) by fetching
+    a snapshot from one of `sources` (reference fetchKeys,
+    storageserver.actor.cpp:107-123 phase doc)."""
+
+    begin: bytes = b""
+    end: bytes = b""
+    sources: List[Any] = field(default_factory=list)  # StorageServerInterface
+    reply: Any = None
+
+
+@dataclass
+class FetchShardRequest:
+    """Destination SS -> source SS: full snapshot of [begin, end) at the
+    source's current version."""
+
+    begin: bytes = b""
+    end: bytes = b""
+    reply: Any = None    # -> FetchShardReply
+
+
+@dataclass
+class FetchShardReply:
+    data: List[Any] = field(default_factory=list)   # [(key, value)]
+    version: Version = 0
+
+
+@dataclass
+class GetShardMetricsRequest:
+    """DD -> SS: byte size of [begin, end) and, if above split_threshold,
+    a key splitting the range's bytes roughly in half (reference
+    StorageMetrics waitMetrics/getSplitPoints)."""
+
+    begin: bytes = b""
+    end: bytes = b""
+    split_threshold: int = 1 << 30
+    reply: Any = None    # -> (bytes, Optional[split_key])
+
+
+@dataclass
+class RemoveShardRequest:
+    """DD -> SS after a move completes: stop owning [begin, end) and drop
+    its data (reference removeDataRange)."""
+
+    begin: bytes = b""
+    end: bytes = b""
+    reply: Any = None
+
+
+@dataclass
+class InitializeDataDistributorRequest:
+    dd_id: str = ""
+    epoch: int = 0
+    storage_interfaces: Dict[Tag, Any] = field(default_factory=dict)
+    key_servers_ranges: List[Any] = field(default_factory=list)
+    replication: int = 1
+    reply: Any = None    # -> DataDistributorInterface
+
+
+class DataDistributorInterface:
+    def __init__(self, dd_id: str = "") -> None:
+        self.id = dd_id
+        self.wait_failure = RequestStream("dd.waitFailure",
+                                          TaskPriority.FailureMonitor)
+
+    def streams(self) -> List[RequestStream]:
+        return [self.wait_failure]
+
+
+@dataclass
 class InitializeStorageRequest:
     ss_id: str
     tag: Tag
@@ -517,13 +588,16 @@ class WorkerInterface:
                                           TaskPriority.DefaultEndpoint)
         self.init_ratekeeper = RequestStream("worker.initRatekeeper",
                                              TaskPriority.DefaultEndpoint)
+        self.init_data_distributor = RequestStream(
+            "worker.initDataDistributor", TaskPriority.DefaultEndpoint)
         self.wait_failure = RequestStream("worker.waitFailure",
                                           TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
         return [self.init_master, self.init_tlog, self.init_commit_proxy,
                 self.init_grv_proxy, self.init_resolver, self.init_storage,
-                self.init_ratekeeper, self.wait_failure]
+                self.init_ratekeeper, self.init_data_distributor,
+                self.wait_failure]
 
 
 class ClusterControllerInterface:
@@ -571,7 +645,19 @@ class StorageServerInterface:
             "storage.watchValue", TaskPriority.DefaultPromiseEndpoint)
         self.queuing_metrics = RequestStream(
             "storage.queuingMetrics", TaskPriority.DefaultEndpoint)
+        # Data-distribution surface (reference fetchKeys/ShardMetrics):
+        self.fetch_keys = RequestStream(
+            "storage.fetchKeys", TaskPriority.FetchKeys)
+        self.fetch_shard = RequestStream(
+            "storage.fetchShard", TaskPriority.FetchKeys)
+        self.shard_metrics = RequestStream(
+            "storage.shardMetrics", TaskPriority.DefaultEndpoint)
+        self.remove_shard = RequestStream(
+            "storage.removeShard", TaskPriority.DefaultEndpoint)
+        self.wait_failure = RequestStream("storage.waitFailure",
+                                          TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
         return [self.get_value, self.get_key_values, self.watch_value,
-                self.queuing_metrics]
+                self.queuing_metrics, self.fetch_keys, self.fetch_shard,
+                self.shard_metrics, self.remove_shard, self.wait_failure]

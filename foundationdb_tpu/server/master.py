@@ -454,13 +454,21 @@ async def master_server(master: Master, process, coordinators,
                         for j in range(config.storage_replication)]
                 key_servers_ranges.append((bounds[i], bounds[i + 1], team))
 
-        # Second wave: ratekeeper + proxies against the new log system.
-        from .interfaces import InitializeRatekeeperRequest
+        # Second wave: ratekeeper + data distributor + proxies.
+        from .interfaces import (InitializeDataDistributorRequest,
+                                 InitializeRatekeeperRequest)
         ratekeeper = await RequestStream.at(
             pick(0).init_ratekeeper.endpoint).get_reply(
             InitializeRatekeeperRequest(
                 rk_id=f"rk.e{master.epoch}",
                 storage_interfaces=storage_servers))
+        data_distributor = await RequestStream.at(
+            pick(2).init_data_distributor.endpoint).get_reply(
+            InitializeDataDistributorRequest(
+                dd_id=f"dd.e{master.epoch}", epoch=master.epoch,
+                storage_interfaces=storage_servers,
+                key_servers_ranges=key_servers_ranges,
+                replication=config.storage_replication))
         key_resolvers_ranges = _key_resolver_ranges(config.n_resolvers)
         commit_proxy_futures = [RequestStream.at(
             pick(i).init_commit_proxy.endpoint).get_reply(
@@ -504,7 +512,8 @@ async def master_server(master: Master, process, coordinators,
             recovery_version=recovery_version, master=master.interface,
             grv_proxies=grv_proxies, commit_proxies=commit_proxies,
             resolvers=resolvers, tlogs=tlogs,
-            storage_servers=storage_servers, ratekeeper=ratekeeper)
+            storage_servers=storage_servers, ratekeeper=ratekeeper,
+            data_distributor=data_distributor)
         await RequestStream.at(
             cc_interface.master_registration.endpoint).get_reply(
             MasterRegistrationRequest(epoch=master.epoch, db_info=db_info))

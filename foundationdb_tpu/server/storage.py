@@ -108,6 +108,22 @@ class VersionedMap:
                 return out, True
         return out, False
 
+    def range_bytes(self, begin: bytes, end: bytes, version: Version
+                    ) -> Tuple[int, int]:
+        """(bytes, live key count) over [begin, end) at `version` without
+        materializing the values list (shard-metrics polling path)."""
+        lo = bisect.bisect_left(self._keys, begin)
+        hi = bisect.bisect_left(self._keys, end)
+        total = 0
+        n = 0
+        for key in self._keys[lo:hi]:
+            val = self.get(key, version)
+            if val is None:
+                continue
+            total += len(key) + len(val)
+            n += 1
+        return total, n
+
     def rollback(self, version: Version) -> None:
         """Drop all entries newer than `version` (reference storageserver
         rollback at recovery: un-durable versions beyond the new epoch's
@@ -153,6 +169,16 @@ _META_KEY = b"\xff\xff/storageMeta"    # above every shard-map range end
 _UPDATE_STORAGE_INTERVAL = 0.05        # reference updateStorage cadence
 
 
+class _Fetch:
+    """Identity-equality marker for one in-flight fetchKeys (prevents the
+    shard RangeMap from coalescing two adjacent distinct fetches)."""
+
+    __slots__ = ("buffer",)
+
+    def __init__(self) -> None:
+        self.buffer = []   # [(version, Mutation)] arriving during the fetch
+
+
 class StorageServer:
     def __init__(self, ss_id: str, tag: Tag, log_system,
                  recovery_version: Version = 0, engine=None) -> None:
@@ -182,6 +208,13 @@ class StorageServer:
         # when rejoining the same generation after a reboot.
         self.log_epoch = 0
         self._rebuild_f = None   # in-flight epoch-rollback engine re-image
+        # Shard ownership (reference storageserver shard availability map):
+        # value = ("owned", min_read_version) | ("fetching", buffer list).
+        # Cold boot owns everything; DD moves flip ranges via
+        # fetch_keys/remove_shard.  Reads need an owned range whose
+        # min_read_version <= the read version, else wrong_shard_server.
+        from .shardmap import RangeMap
+        self.shards: RangeMap = RangeMap(default=("owned", 0))
 
     @classmethod
     async def from_engine(cls, engine) -> Optional["StorageServer"]:
@@ -213,6 +246,28 @@ class StorageServer:
 
     # -- mutation ingestion (reference update :3626) -------------------------
     def _apply(self, m: Mutation, version: Version) -> None:
+        """Apply one pulled mutation, buffering portions that land in a
+        range currently being fetched (applied after the snapshot lands —
+        reference fetchKeys phase-2 buffering); clears spanning both
+        fetching and owned ranges split along shard-state boundaries."""
+        if m.type == MutationType.ClearRange:
+            pieces = list(self.shards.intersecting(m.param1, m.param2))
+            if any(st[0] == "fetching" for _b, _e, st in pieces):
+                for b, e, st in pieces:
+                    cm = Mutation(MutationType.ClearRange, b, e)
+                    if st[0] == "fetching":
+                        st[1].buffer.append((version, cm))
+                    else:
+                        self._apply_direct(cm, version)
+                return
+        else:
+            st = self.shards.lookup(m.param1)
+            if st[0] == "fetching":
+                st[1].buffer.append((version, m))
+                return
+        self._apply_direct(m, version)
+
+    def _apply_direct(self, m: Mutation, version: Version) -> None:
         self.stats["mutations"] += 1
         if m.type == MutationType.SetValue:
             self.data.set(m.param1, m.param2, version)
@@ -333,9 +388,21 @@ class StorageServer:
         if version < self.oldest_version:
             raise err("transaction_too_old")
 
+    def _check_owned(self, begin: bytes, end: bytes,
+                     version: Version) -> None:
+        """Reads must hit owned ranges whose fetched snapshot (if any) is
+        complete below the read version (reference: shard availability
+        checks raise wrong_shard_server so the client refreshes its
+        location cache and retries)."""
+        from ..core.error import err
+        for _b, _e, st in self.shards.intersecting(begin, end):
+            if st[0] != "owned" or version < st[1]:
+                raise err("wrong_shard_server")
+
     async def _get_value(self, req: GetValueRequest) -> None:
         try:
             await self._wait_for_version(req.version)
+            self._check_owned(req.key, req.key + b"\x00", req.version)
             self.stats["reads"] += 1
             req.reply.send(GetValueReply(
                 value=self.data.get(req.key, req.version),
@@ -346,6 +413,7 @@ class StorageServer:
     async def _get_key_values(self, req: GetKeyValuesRequest) -> None:
         try:
             await self._wait_for_version(req.version)
+            self._check_owned(req.begin, req.end, req.version)
             self.stats["range_reads"] += 1
             data, more = self.data.range_read(
                 req.begin, req.end, req.version, req.limit, req.limit_bytes,
@@ -354,6 +422,91 @@ class StorageServer:
                                              version=req.version))
         except Exception as e:   # noqa: BLE001
             req.reply.send_error(e)
+
+    # -- data-distribution surface (reference fetchKeys :107-123) ------------
+    async def _fetch_keys(self, req) -> None:
+        """Become a replica of [begin, end): buffer live mutations, pull a
+        snapshot from a source replica, apply snapshot then buffered tail,
+        then open the range for reads at versions >= the merge point."""
+        from ..core.error import FdbError, err
+        from .interfaces import FetchShardRequest
+        fetch = _Fetch()
+        self.shards.set_range(req.begin, req.end, ("fetching", fetch))
+        try:
+            reply = None
+            last: Optional[BaseException] = None
+            for src in req.sources:
+                try:
+                    reply = await RequestStream.at(
+                        src.fetch_shard.endpoint).get_reply(
+                        FetchShardRequest(begin=req.begin, end=req.end))
+                    break
+                except FdbError as e:
+                    last = e
+            if reply is None:
+                raise last or err("operation_failed", "no fetch source")
+            vf = reply.version
+            # Residual data from a previous tenure of this range (vacated
+            # then re-acquired) must not survive under the snapshot
+            # (reference fetchKeys clears the range before loading).
+            self.data.clear_range(req.begin, req.end, vf)
+            if self.engine is not None:
+                self._durable_pending.append((vf, 1, req.begin, req.end))
+            for k, v in reply.data:
+                c = self.data._chains.get(k)
+                if c is None or c[-1][0] <= vf:
+                    self.data.set(k, v, vf)
+                    if self.engine is not None:
+                        self._durable_pending.append((vf, 0, k, v))
+            for version, m in fetch.buffer:
+                # Effects at versions <= vf are already inside the snapshot.
+                if version > vf:
+                    self._apply_direct(m, version)
+            min_read = max(vf, self.version.get())
+            self.shards.set_range(req.begin, req.end, ("owned", min_read))
+            TraceEvent("SSFetchKeysDone").detail("Id", self.id).detail(
+                "Begin", req.begin).detail("End", req.end).detail(
+                "Keys", len(reply.data)).detail("MinRead", min_read).log()
+            req.reply.send(None)
+        except BaseException as e:  # noqa: BLE001
+            # Failed fetch: disown the range (DD retries elsewhere).
+            self.shards.set_range(req.begin, req.end, ("absent", 0))
+            req.reply.send_error(e)
+
+    async def _fetch_shard(self, req) -> None:
+        """Serve a snapshot of [begin, end) at our current version."""
+        from .interfaces import FetchShardReply
+        v = self.version.get()
+        data, _more = self.data.range_read(req.begin, req.end, v,
+                                           1 << 30, 1 << 40)
+        req.reply.send(FetchShardReply(data=data, version=v))
+
+    async def _shard_metrics(self, req) -> None:
+        v = self.version.get()
+        total, n = self.data.range_bytes(req.begin, req.end, v)
+        split_key = None
+        if total > req.split_threshold and n >= 2:
+            acc = 0
+            lo = bisect.bisect_left(self.data._keys, req.begin)
+            hi = bisect.bisect_left(self.data._keys, req.end)
+            for k in self.data._keys[lo:hi]:
+                val = self.data.get(k, v)
+                if val is None:
+                    continue
+                acc += len(k) + len(val)
+                if acc * 2 >= total:
+                    if k > req.begin:
+                        split_key = k
+                    break
+        req.reply.send((total, split_key))
+
+    async def _remove_shard(self, req) -> None:
+        self.shards.set_range(req.begin, req.end, ("absent", 0))
+        self.data.clear_range(req.begin, req.end, self.version.get())
+        if self.engine is not None:
+            self._durable_pending.append(
+                (self.version.get(), 1, req.begin, req.end))
+        req.reply.send(None)
 
     async def _queuing_metrics(self, req) -> None:
         from .ratekeeper import StorageQueuingMetricsReply
@@ -462,5 +615,18 @@ class StorageServer:
         process.spawn(self._serve(self.interface.queuing_metrics.queue,
                                   self._queuing_metrics),
                       f"{self.id}.queuingMetrics")
+        process.spawn(self._serve(self.interface.fetch_keys.queue,
+                                  self._fetch_keys), f"{self.id}.fetchKeys")
+        process.spawn(self._serve(self.interface.fetch_shard.queue,
+                                  self._fetch_shard), f"{self.id}.fetchShard")
+        process.spawn(self._serve(self.interface.shard_metrics.queue,
+                                  self._shard_metrics),
+                      f"{self.id}.shardMetrics")
+        process.spawn(self._serve(self.interface.remove_shard.queue,
+                                  self._remove_shard),
+                      f"{self.id}.removeShard")
+        from .failure import hold_wait_failure
+        process.spawn(hold_wait_failure(self.interface.wait_failure),
+                      f"{self.id}.waitFailure")
         TraceEvent("StorageServerStarted").detail("Id", self.id).detail(
             "Tag", self.tag).log()

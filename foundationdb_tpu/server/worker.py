@@ -159,6 +159,17 @@ class Worker:
             rk.run(self.process)
             req.reply.send(rk.interface)
 
+    async def _serve_init_data_distributor(self) -> None:
+        from ..client.database import ClusterConnection, Database
+        from .data_distribution import DataDistributor
+        async for req in self.interface.init_data_distributor.queue:
+            db = Database(ClusterConnection(self.coordinators))
+            dd = DataDistributor(req.dd_id, db, req.storage_interfaces,
+                                 req.key_servers_ranges,
+                                 replication=req.replication)
+            dd.run(self.process, db_info_var=self.db_info, epoch=req.epoch)
+            req.reply.send(dd.interface)
+
     async def _serve_init_resolver(self) -> None:
         async for req in self.interface.init_resolver.queue:
             backend = getattr(self.config, "conflict_backend", None) \
@@ -275,6 +286,8 @@ class Worker:
         p.spawn(self._serve_init_resolver(), f"{p.name}.initResolver")
         p.spawn(self._serve_init_storage(), f"{p.name}.initStorage")
         p.spawn(self._serve_init_ratekeeper(), f"{p.name}.initRatekeeper")
+        p.spawn(self._serve_init_data_distributor(),
+                f"{p.name}.initDataDistributor")
         p.spawn(self._serve_wait_failure(), f"{p.name}.waitFailure")
         p.spawn(self._watch_db_info(), f"{p.name}.watchDbInfo")
         p.spawn(self._register_loop(leader_var), f"{p.name}.register")

@@ -219,3 +219,57 @@ class ConflictRangeWorkload(TestWorkload):
             return dict(await t.get_range(b"cr/", b"cr0", limit=100000))
         actual = await self.run_transaction(read_all)
         return actual == self.model
+
+
+@register_workload
+class ConsistencyCheckWorkload(TestWorkload):
+    """Replica audit (reference fdbserver/workloads/ConsistencyCheck
+    .actor.cpp:31, core check): for every shard, read the full range at one
+    read version from EVERY team replica and require byte-identical
+    results.  Retries wrong_shard_server/future_version (a replica may
+    still be fetching after a move)."""
+
+    name = "ConsistencyCheck"
+
+    async def check(self) -> bool:
+        from ..rpc.endpoint import RequestStream
+        from ..server.interfaces import GetKeyValuesRequest
+        shards_audited = 0
+        cursor = b""
+        while cursor < b"\xff":
+            b, e, ssis = await self.db.get_shard_location(cursor)
+            if not ssis:
+                cursor = e
+                continue
+            while True:
+                t = self.db.create_transaction()
+                try:
+                    version = await t._ensure_read_version()
+                    replies = []
+                    for ssi in ssis:
+                        replies.append(await RequestStream.at(
+                            ssi.get_key_values.endpoint).get_reply(
+                            GetKeyValuesRequest(
+                                begin=max(b, cursor), end=min(e, b"\xff"),
+                                version=version, limit=1 << 30,
+                                limit_bytes=1 << 40)))
+                    first = replies[0].data
+                    for i, r in enumerate(replies[1:], 1):
+                        if r.data != first:
+                            raise AssertionError(
+                                f"replica divergence in [{b!r},{e!r}): "
+                                f"replica 0 has {len(first)} kvs, "
+                                f"replica {i} has {len(r.data)}")
+                    shards_audited += 1
+                    break
+                except FdbError as ex:
+                    if ex.name not in ("wrong_shard_server", "future_version",
+                                       "broken_promise", "transaction_too_old",
+                                       "request_maybe_delivered"):
+                        raise
+                    await delay(0.1)
+                    self.db.invalidate_cache(max(b, cursor))
+                    b, e, ssis = await self.db.get_shard_location(cursor)
+            cursor = e
+        self.metrics["shards_audited"] = shards_audited
+        return True

@@ -1,0 +1,115 @@
+"""Data distribution v1: shard split under load, MoveKeys, re-replication.
+
+Reference: fdbserver/DataDistribution.actor.cpp (teamTracker :3506),
+DataDistributionTracker.actor.cpp (split on size), MoveKeys.actor.cpp
+(two-phase handoff).  VERDICT round-2 done-criteria: a replication=2
+cluster kills one storage server and a ConsistencyCheck-style replica
+audit passes after re-replication; a hot shard splits under load."""
+
+import pytest
+
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+
+def make_cluster(**cfg):
+    n_workers = cfg.pop("n_workers", 6)
+    n_storage_workers = cfg.pop("n_storage_workers", 3)
+    config = DatabaseConfiguration(**cfg)
+    return SimFdbCluster(config=config, n_workers=n_workers,
+                         n_storage_workers=n_storage_workers)
+
+
+def current_dd(cluster):
+    cc = cluster.current_cc()
+    dd_iface = cc.db_info.data_distributor
+    import gc
+    from foundationdb_tpu.server.data_distribution import DataDistributor
+    for o in gc.get_objects():
+        if isinstance(o, DataDistributor) and o.interface is dd_iface:
+            return o
+    return None
+
+
+async def consistency_audit(cluster, db):
+    from foundationdb_tpu.testing.workloads import ConsistencyCheckWorkload
+    w = ConsistencyCheckWorkload(cluster, db, {})
+    assert await w.check()
+    return w.metrics["shards_audited"]
+
+
+def test_hot_shard_splits_under_load(teardown):  # noqa: F811
+    knobs = server_knobs()
+    old = knobs.DD_SHARD_SPLIT_BYTES
+    knobs.DD_SHARD_SPLIT_BYTES = 2000
+    try:
+        c = make_cluster(n_storage=2)
+        db = c.database()
+
+        async def go():
+            from foundationdb_tpu.core.scheduler import delay
+            # ~6KB into the first shard (keys < \x80): must split.
+            for i in range(60):
+                await commit_kv(db, b"hot/%04d" % i, b"v" * 80)
+            dd = current_dd(c)
+            deadline = 30.0
+            while dd.stats["splits"] == 0 and deadline > 0:
+                await delay(0.5)
+                deadline -= 0.5
+            assert dd.stats["splits"] >= 1, "hot shard never split"
+            # Routing still works after the metadata split.
+            await commit_kv(db, b"hot/post", b"ok")
+            assert await read_key(db, b"hot/post") == b"ok"
+            assert await read_key(db, b"hot/0000") == b"v" * 80
+        c.run_until(c.loop.spawn(go()), timeout=300)
+    finally:
+        knobs.DD_SHARD_SPLIT_BYTES = old
+
+
+def test_storage_death_rereplication_and_audit(teardown):  # noqa: F811
+    c = make_cluster(n_storage=3, storage_replication=2)
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.core.scheduler import delay
+        for i in range(40):
+            await commit_kv(db, b"rr/%04d" % i, b"val%04d" % i)
+        await commit_kv(db, b"\x90spread", b"hi")   # second region too
+        # Kill one storage server's process (power-fail its machine).
+        dd = current_dd(c)
+        assert dd is not None
+        c.sim.power_fail_machine("mach.worker0")
+        # DD notices, re-replicates every shard that lost a replica.
+        deadline = 60.0
+        while deadline > 0:
+            await delay(0.5)
+            deadline -= 0.5
+            dd = current_dd(c) or dd
+            if dd.stats["rereplications"] > 0 and dd.moves_in_flight == 0:
+                break
+        assert dd.stats["rereplications"] > 0, "no re-replication happened"
+        # Every key still readable; every shard's replicas byte-identical.
+        for i in range(40):
+            assert await read_key(db, b"rr/%04d" % i) == b"val%04d" % i
+        audited = await consistency_audit(c, db)
+        assert audited >= 1
+
+    c.run_until(c.loop.spawn(go()), timeout=300)
+
+
+def test_consistency_audit_clean_cluster(teardown):  # noqa: F811
+    c = make_cluster(n_storage=2, storage_replication=2)
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.core.scheduler import delay
+        for i in range(20):
+            await commit_kv(db, b"cc/%03d" % i, b"v%03d" % i)
+        await delay(0.3)   # let replicas drain
+        audited = await consistency_audit(c, db)
+        assert audited >= 2
+
+    c.run_until(c.loop.spawn(go()), timeout=120)
