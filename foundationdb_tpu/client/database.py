@@ -226,6 +226,12 @@ class Transaction:
         self._extra_write_ranges: List[Tuple[bytes, bytes]] = []
         self.committed_version: Version = -1
         self.priority = TransactionPriority.DEFAULT
+        # Per-attempt versionstamp future: dropped on reset, so waiters of
+        # a failed attempt see broken_promise (reference: the versionstamp
+        # future errors when the transaction is reset).
+        self._versionstamp_promise = None
+        self._committed_stamp = None
+        self._committed_readonly = False
         # Reference ACCESS_SYSTEM_KEYS transaction option: \xff keys are
         # rejected unless explicitly enabled (management/DD transactions).
         if not hasattr(self, "access_system_keys"):
@@ -265,6 +271,8 @@ class Transaction:
         _check_key(key, self.access_system_keys)
         if not snapshot:
             self.read_conflict_ranges.append((key, key_after(key)))
+        if self.writes.is_unreadable(key):
+            raise err("accessed_unreadable")
         if self.writes.has_writes(key) and not self.writes.needs_base(key):
             return self.writes.merge(key, None)
         base = await self._storage_get(key)
@@ -398,6 +406,52 @@ class Transaction:
         _check_key(key, self.access_system_keys)
         self.writes.atomic_op(op, key, operand)
 
+    # -- versionstamped operations (reference CommitTransaction.h:55-96,
+    # versionstamp future NativeAPI.actor.cpp:5094) -------------------------
+    def set_versionstamped_key(self, key_template: bytes, offset: int,
+                               value: bytes) -> None:
+        """Set a key whose 10-byte slot at `offset` is replaced with the
+        commit versionstamp (8B big-endian version + 2B batch index) by
+        the commit proxy.  `key_template[offset:offset+10]` is the
+        placeholder."""
+        _check_key(key_template, self.access_system_keys)
+        _check_value(value)
+        if not 0 <= offset <= len(key_template) - 10:
+            raise err("client_invalid_operation",
+                      "versionstamp slot out of range")
+        self.writes.atomic_op(
+            MutationType.SetVersionstampedKey,
+            key_template + offset.to_bytes(4, "little"), value)
+
+    def set_versionstamped_value(self, key: bytes, value_template: bytes,
+                                 offset: int = 0) -> None:
+        """Set `key` to a value whose 10-byte slot at `offset` becomes the
+        commit versionstamp."""
+        _check_key(key, self.access_system_keys)
+        _check_value(value_template)
+        if not 0 <= offset <= len(value_template) - 10:
+            raise err("client_invalid_operation",
+                      "versionstamp slot out of range")
+        self.writes.atomic_op(
+            MutationType.SetVersionstampedValue, key,
+            value_template + offset.to_bytes(4, "little"))
+
+    def get_versionstamp(self) -> Future:
+        """Future for this attempt's 10-byte versionstamp; resolves after
+        a successful commit, errors on a read-only commit (no commit
+        version exists), and breaks on reset."""
+        if self._versionstamp_promise is None:
+            from ..core.futures import Promise
+            self._versionstamp_promise = Promise()
+            if self._committed_stamp is not None:
+                self._versionstamp_promise.send(self._committed_stamp)
+            elif self.committed_version == -1 and \
+                    self._committed_readonly:
+                self._versionstamp_promise.send_error(
+                    err("operation_failed",
+                        "read-only transaction has no versionstamp"))
+        return self._versionstamp_promise.get_future()
+
     def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
         self.read_conflict_ranges.append((begin, end))
 
@@ -410,6 +464,12 @@ class Transaction:
         if not self.writes.mutations and not wcr:
             # Read-only: nothing to resolve (reference returns immediately).
             self.committed_version = -1
+            self._committed_readonly = True
+            if self._versionstamp_promise is not None and \
+                    not self._versionstamp_promise.is_set():
+                self._versionstamp_promise.send_error(
+                    err("operation_failed",
+                        "read-only transaction has no versionstamp"))
             return -1
         read_snapshot = 0
         if self.read_conflict_ranges:
@@ -443,6 +503,12 @@ class Transaction:
             raise err("commit_unknown_result", "commit timed out")
         reply = f.get()
         self.committed_version = reply.version
+        from ..txn.types import make_versionstamp
+        self._committed_stamp = make_versionstamp(reply.version,
+                                                  reply.txn_batch_index)
+        if self._versionstamp_promise is not None and \
+                not self._versionstamp_promise.is_set():
+            self._versionstamp_promise.send(self._committed_stamp)
         return reply.version
 
     # -- retry loop (reference onError) --------------------------------------

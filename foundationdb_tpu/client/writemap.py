@@ -71,6 +71,7 @@ class WriteMap:
 
     def merge(self, key: bytes, base: Optional[bytes]) -> Optional[bytes]:
         """Value as seen by this transaction, given snapshot value `base`."""
+        from ..core.error import err
         clear_seq = self._last_clear_seq(key)
         val = None if clear_seq >= 0 else base
         for seq, typ, param2 in self._key_ops.get(key, []):
@@ -78,6 +79,11 @@ class WriteMap:
                 continue
             if typ == MutationType.SetValue:
                 val = param2
+            elif typ in (MutationType.SetVersionstampedKey,
+                         MutationType.SetVersionstampedValue):
+                # The final key/value is unknown until commit (reference
+                # RYW raises accessed_unreadable for these).
+                raise err("accessed_unreadable")
             else:
                 val = apply_atomic(typ, val, param2)
         return val
@@ -91,11 +97,36 @@ class WriteMap:
         return [(s, max(b, begin), min(e, end))
                 for s, b, e in self._clears if b < end and begin < e]
 
+    def is_unreadable(self, key: bytes) -> bool:
+        """True when this txn's ops make `key` unreadable (a versionstamped
+        op whose result is unknown until commit) — checked before any
+        storage round-trip."""
+        clear_seq = self._last_clear_seq(key)
+        return any(typ in (MutationType.SetVersionstampedKey,
+                           MutationType.SetVersionstampedValue)
+                   for seq, typ, _p in self._key_ops.get(key, [])
+                   if seq > clear_seq)
+
     def write_conflict_ranges(self) -> List[Tuple[bytes, bytes]]:
-        """Minimal covering ranges of all mutations (point -> [k, k+\\0))."""
+        """Minimal covering ranges of all mutations (point -> [k, k+\\0)).
+
+        A SetVersionstampedKey's final key is unknown until commit; its
+        conflict range covers EVERY possible stamp in the 10-byte slot
+        (reference getVersionstampKeyRange) — guarding the placeholder
+        template instead would let a concurrent reader of the formed key
+        commit without conflicting."""
         from ..txn.types import key_after
-        out = [(m.param1, key_after(m.param1))
-               for m in self.mutations if m.type != MutationType.ClearRange]
-        out += [(m.param1, m.param2) for m in self.mutations
-                if m.type == MutationType.ClearRange and m.param1 < m.param2]
+        out = []
+        for m in self.mutations:
+            if m.type == MutationType.ClearRange:
+                if m.param1 < m.param2:
+                    out.append((m.param1, m.param2))
+            elif m.type == MutationType.SetVersionstampedKey:
+                body = m.param1[:-4]
+                off = int.from_bytes(m.param1[-4:], "little")
+                lo = body[:off] + b"\x00" * 10 + body[off + 10:]
+                hi = body[:off] + b"\xff" * 10 + body[off + 10:]
+                out.append((lo, key_after(hi)))
+            else:
+                out.append((m.param1, key_after(m.param1)))
         return out

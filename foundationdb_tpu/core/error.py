@@ -37,6 +37,7 @@ ERROR_CODES = {
     "not_committed": 1020,
     "commit_unknown_result": 1021,
     "transaction_cancelled": 1025,
+    "accessed_unreadable": 1036,
     "transaction_timed_out": 1031,
     "broken_promise": 1100,
     "operation_cancelled": 1101,

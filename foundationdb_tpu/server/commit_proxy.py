@@ -90,6 +90,17 @@ class LogSystemClient:
         raise last_err
 
 
+def _splice_stamp(data: bytes, stamp: bytes) -> bytes:
+    """Replace the 10-byte slot addressed by the trailing 4-byte
+    little-endian offset with the versionstamp, dropping the suffix."""
+    off = int.from_bytes(data[-4:], "little")
+    body = data[:-4]
+    if off + 10 > len(body):
+        # Malformed offset: clamp to append semantics rather than corrupt.
+        return body + stamp
+    return body[:off] + stamp + body[off + 10:]
+
+
 class CommitProxy:
     def __init__(self, proxy_id: str, master: Any, resolvers: List[Any],
                  log_system: LogSystemClient,
@@ -222,11 +233,12 @@ class CommitProxy:
         await RequestStream.at(
             self.master.report_live_committed_version.endpoint).get_reply(
             ReportRawCommittedVersionRequest(version=commit_version))
-        for req, verdict in zip(batch, verdicts):
+        for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
             if verdict == CommitResult.COMMITTED:
                 self.stats["commits"] += 1
                 req.reply.send(CommitID(version=commit_version,
-                                        txn_batch_id=batch_num))
+                                        txn_batch_id=batch_num,
+                                        txn_batch_index=t_idx))
             elif verdict == CommitResult.TOO_OLD:
                 self.stats["too_old"] += 1
                 from ..core.error import err
@@ -353,10 +365,27 @@ class CommitProxy:
         from .system_data import (SYSTEM_KEYS_BEGIN, TXS_TAG,
                                   apply_key_servers_mutation)
         messages: Dict[Tag, List[Mutation]] = {}
-        for req, verdict in zip(batch, verdicts):
+        for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
             if verdict != CommitResult.COMMITTED:
                 continue
+            stamp = None   # built lazily per transaction
             for m in req.transaction.mutations:
+                if m.type in (MutationType.SetVersionstampedKey,
+                              MutationType.SetVersionstampedValue):
+                    # Substitute the 10-byte versionstamp (8B big-endian
+                    # commit version + 2B batch index) at the 4-byte
+                    # little-endian offset suffix (reference
+                    # CommitTransaction.h:55-96 transformed at the proxy).
+                    if stamp is None:
+                        from ..txn.types import make_versionstamp
+                        stamp = make_versionstamp(commit_version, t_idx)
+                    if m.type == MutationType.SetVersionstampedKey:
+                        m = Mutation(MutationType.SetValue,
+                                     _splice_stamp(m.param1, stamp),
+                                     m.param2)
+                    else:
+                        m = Mutation(MutationType.SetValue, m.param1,
+                                     _splice_stamp(m.param2, stamp))
                 # Metadata side effects FIRST (ApplyMetadataMutation.cpp:
                 # 52-61): a committed \xff/keyServers/ mutation updates this
                 # proxy's shard map before any later mutation is routed, and

@@ -172,6 +172,9 @@ class CommitID:
 
     version: Version
     txn_batch_id: int = 0
+    # This transaction's order within its commit batch — the low 2 bytes
+    # of its versionstamp (reference CommitID transactionBatchIndex).
+    txn_batch_index: int = 0
 
 
 @dataclass

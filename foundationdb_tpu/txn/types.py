@@ -70,6 +70,14 @@ SYSTEM_KEYS = KeyRange(b"\xff", b"\xff\xff")
 ALL_KEYS_WITH_SYSTEM = KeyRange(b"", b"\xff\xff")
 
 
+def make_versionstamp(version: int, batch_index: int) -> bytes:
+    """The 10-byte versionstamp: 8B big-endian commit version + 2B
+    big-endian transaction batch index (reference CommitTransaction.h:55).
+    Shared by the commit proxy (key/value splice) and the client's
+    versionstamp future so the two can never drift."""
+    return version.to_bytes(8, "big") + batch_index.to_bytes(2, "big")
+
+
 class MutationType(IntEnum):
     """Mutation op codes (reference fdbclient/CommitTransaction.h:55-96)."""
 

@@ -1,0 +1,114 @@
+"""Versionstamped operations end-to-end.
+
+Reference: fdbclient/CommitTransaction.h:55-96 (SetVersionstampedKey/Value
+transformed at the commit proxy: the 10-byte slot addressed by a 4-byte
+little-endian offset suffix becomes 8B big-endian commit version + 2B
+batch index) and NativeAPI.actor.cpp:5094 (the client's versionstamp
+future resolves after the commit)."""
+
+import pytest
+
+from foundationdb_tpu.core import FdbError
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+
+def make_cluster():
+    return SimFdbCluster(config=DatabaseConfiguration(), n_workers=5,
+                         n_storage_workers=2)
+
+
+def test_versionstamped_key_and_future(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        t = db.create_transaction()
+        template = b"vs/" + b"\x00" * 10          # slot at offset 3
+        while True:
+            try:
+                t.set_versionstamped_key(template, 3, b"payload")
+                vs_f = t.get_versionstamp()
+                v = await t.commit()
+                break
+            except FdbError as e:
+                await t.on_error(e)
+        stamp = vs_f.get()
+        assert len(stamp) == 10
+        assert int.from_bytes(stamp[:8], "big") == v
+        # The formed key exists with the stamp spliced in.
+        expected_key = b"vs/" + stamp
+        t2 = db.create_transaction()
+        got = await t2.get(expected_key)
+        assert got == b"payload"
+        # And nothing was written under the raw template.
+        assert await t2.get(template) is None
+
+    c.run_until(c.loop.spawn(go()), timeout=60)
+
+
+def test_versionstamped_value(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        t = db.create_transaction()
+        tmpl = b"prefix-" + b"\x00" * 10 + b"-suffix"
+        while True:
+            try:
+                t.set_versionstamped_value(b"vv/key", tmpl, 7)
+                vs_f = t.get_versionstamp()
+                await t.commit()
+                break
+            except FdbError as e:
+                await t.on_error(e)
+        stamp = vs_f.get()
+        got = await read_key(db, b"vv/key")
+        assert got == b"prefix-" + stamp + b"-suffix"
+
+    c.run_until(c.loop.spawn(go()), timeout=60)
+
+
+def test_versionstamps_are_ordered_and_unique(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        stamps = []
+        for i in range(6):
+            t = db.create_transaction()
+            while True:
+                try:
+                    t.set_versionstamped_key(b"ord/" + b"\x00" * 10, 4,
+                                             b"%d" % i)
+                    f = t.get_versionstamp()
+                    await t.commit()
+                    break
+                except FdbError as e:
+                    await t.on_error(e)
+            stamps.append(f.get())
+        assert stamps == sorted(stamps)
+        assert len(set(stamps)) == len(stamps)
+        # All six formed keys are readable in stamp order.
+        t2 = db.create_transaction()
+        kvs = await t2.get_range(b"ord/", b"ord0", limit=100)
+        assert [v for _k, v in kvs] == [b"%d" % i for i in range(6)]
+
+    c.run_until(c.loop.spawn(go()), timeout=60)
+
+
+def test_ryw_read_of_versionstamped_key_is_unreadable(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        t = db.create_transaction()
+        tmpl = b"ur/" + b"\x00" * 10
+        t.set_versionstamped_key(tmpl, 3, b"v")
+        with pytest.raises(FdbError) as ei:
+            await t.get(tmpl + (3).to_bytes(4, "little"))
+        assert ei.value.name == "accessed_unreadable"
+
+    c.run_until(c.loop.spawn(go()), timeout=60)
