@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.futures import Future, wait_all
+from ..core.buggify import buggify
 from ..core.knobs import server_knobs
 from ..core.scheduler import delay, now, spawn
 from ..core.trace import Severity, TraceEvent
@@ -143,6 +144,13 @@ class CommitProxy:
                 continue
             batch = [first]
             batch_bytes = first.transaction.expected_size()
+            if buggify("proxy.earlyBatchClose"):
+                # Single-transaction batches stress the per-batch paths
+                # (reference BUGGIFY on batching knobs).
+                self.local_batch_number += 1
+                spawn(self._commit_batch(batch, self.local_batch_number),
+                      f"{self.id}.commitBatch")
+                continue
             deadline = now() + knobs.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
             while (batch_bytes < knobs.COMMIT_TRANSACTION_BATCH_BYTES_MAX and
                    len(batch) < knobs.COMMIT_TRANSACTION_BATCH_COUNT_MAX):

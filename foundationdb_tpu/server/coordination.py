@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.futures import Future, Promise, wait_all, wait_any
+from ..core.buggify import buggify
 from ..core.rng import deterministic_random
 from ..core.scheduler import TaskPriority, delay, spawn
 from ..core.trace import Severity, TraceEvent
@@ -164,6 +165,8 @@ class CoordinationServer:
         """Fsync one register's state before any reply that promises it."""
         if self._store is None:
             return
+        if buggify("coord.slowDisk"):
+            await delay(0.05)
         from ..core.wire import Writer
         value, vgen, rgen = self._reg[key]
         if isinstance(value, (bytes, bytearray)):

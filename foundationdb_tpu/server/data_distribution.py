@@ -113,6 +113,9 @@ class DataDistributor:
         self.id = dd_id
         self.db = db                      # client Database (metadata txns)
         self.interface = DataDistributorInterface(dd_id)
+        # Sim-side backref so workloads/tests can reach the live role from
+        # the broadcast interface without scanning the heap.
+        self.interface.role = self
         self.storage = dict(storage_interfaces)
         self.replication = replication
         self.map = BoundaryMap()

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from ..conflict.api import ConflictSet, new_conflict_set
+from ..core.buggify import buggify
 from ..core.knobs import server_knobs
 from ..core.trace import TraceEvent
 from ..txn.types import Version
@@ -58,6 +59,9 @@ class Resolver:
         self.state_txns: List[tuple] = []
 
     async def _resolve_batch(self, req: ResolveTransactionBatchRequest) -> None:
+        if buggify("resolver.slowBatch"):
+            from ..core.scheduler import delay
+            await delay(0.02)   # stalls the version chain (pipeline stress)
         proxy = self.proxy_infos.setdefault(req.proxy_id, _ProxyInfo())
 
         # Order by version chain: wait for our version to catch up to the

@@ -19,6 +19,7 @@ import bisect
 from typing import Dict, List, Optional, Tuple
 
 from ..core.futures import AsyncTrigger, Future, wait_any
+from ..core.buggify import buggify
 from ..core.knobs import server_knobs
 from ..core.scheduler import delay, spawn
 from ..core.trace import Severity, TraceEvent
@@ -304,6 +305,8 @@ class StorageServer:
             if self.log_system is None:
                 await delay(0.5)
                 continue
+            if buggify("storage.slowPull"):
+                await delay(0.05)   # lagging replica (reference BUGGIFY)
             try:
                 reply = await self.log_system.peek_tag(self.tag, fetch_from)
             except FdbError:
@@ -346,6 +349,8 @@ class StorageServer:
         in batches behind the in-memory MVCC window)."""
         while True:
             await delay(_UPDATE_STORAGE_INTERVAL)
+            if buggify("storage.slowDurable"):
+                continue   # stretched durability lag (reference BUGGIFY)
             if self._rebuild_f is not None and not self._rebuild_f.is_ready():
                 continue                     # epoch rollback re-image running
             target = self.version.get()

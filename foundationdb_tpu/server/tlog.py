@@ -15,6 +15,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..core.buggify import buggify
 from ..core.futures import Promise
 from ..core.scheduler import delay, get_event_loop
 from ..core.trace import TraceEvent
@@ -224,6 +225,10 @@ class TLog:
         async def sync() -> None:
             while self.durable_version.get() < self.version.get():
                 target = self.version.get()
+                if buggify("tlog.slowFsync"):
+                    # Rare-path chaos: a stalling disk stretches group
+                    # commit windows (reference BUGGIFY in doQueueCommit).
+                    await delay(0.05)
                 if self.disk_queue is not None:
                     await self.disk_queue.commit()
                 else:

@@ -273,3 +273,251 @@ class ConsistencyCheckWorkload(TestWorkload):
             cursor = e
         self.metrics["shards_audited"] = shards_audited
         return True
+
+
+@register_workload
+class ApiCorrectnessWorkload(TestWorkload):
+    """Randomized API exerciser vs an in-memory model (reference
+    ApiCorrectness.actor.cpp, simplified): sets, clears, clear-ranges,
+    atomic adds and range reads through real transactions, mirrored into a
+    dict; RYW is spot-checked inside each transaction and the database
+    must equal the model at the end.
+
+    Every transaction also writes a unique txn-id key, so a
+    commit_unknown_result is resolved by re-reading it — the reference
+    pattern for idempotent retries under chaos."""
+
+    name = "ApiCorrectness"
+
+    TXID_KEY = b"api\x00txid"
+
+    async def start(self) -> None:
+        from ..txn.types import MutationType
+        duration = float(self.config.get("testDuration", 5.0))
+        rng = random.Random(int(self.config.get("seed", 6)))
+        n = int(self.config.get("nodeCount", 40))
+        self.model: Dict[bytes, bytes] = {}
+        deadline = now() + duration
+        ops = 0
+        while now() < deadline:
+            ops += 1
+            txid = b"%020d" % ops
+            result: Dict[str, Dict[bytes, bytes]] = {}
+            t = self.db.create_transaction()
+            while True:
+                # Staged state is rebuilt PER ATTEMPT: a failed attempt's
+                # ops must not leak into the model.
+                staged = dict(self.model)
+                try:
+                    t.set(self.TXID_KEY, txid)
+                    for _ in range(rng.randrange(1, 6)):
+                        r = rng.random()
+                        k = b"api/%04d" % rng.randrange(n)
+                        if r < 0.4:
+                            v = b"%010d" % rng.randrange(1 << 30)
+                            t.set(k, v)
+                            staged[k] = v
+                        elif r < 0.55:
+                            t.clear(k)
+                            staged.pop(k, None)
+                        elif r < 0.7:
+                            lo = rng.randrange(n)
+                            hi = min(n, lo + rng.randrange(1, 6))
+                            b, e = b"api/%04d" % lo, b"api/%04d" % hi
+                            t.clear(b, e)
+                            for kk in [kk for kk in staged if b <= kk < e]:
+                                del staged[kk]
+                        elif r < 0.85:
+                            t.atomic_op(MutationType.AddValue, k,
+                                        (1).to_bytes(8, "little"))
+                            old = int.from_bytes(staged.get(k, b""),
+                                                 "little")
+                            staged[k] = ((old + 1) & ((1 << 64) - 1)
+                                         ).to_bytes(8, "little")
+                        else:
+                            got = await t.get(k)
+                            assert got == staged.get(k), \
+                                f"RYW mismatch on {k!r}: {got!r}"
+                    await t.commit()
+                    result["staged"] = staged
+                    break
+                except FdbError as e:
+                    if e.name == "commit_unknown_result":
+                        # Resolve the ambiguity via the txn-id marker.
+                        check = self.db.create_transaction()
+                        while True:
+                            try:
+                                seen = await check.get(self.TXID_KEY)
+                                break
+                            except FdbError as e2:
+                                await check.on_error(e2)
+                        if seen == txid:
+                            result["staged"] = staged
+                            break
+                        t.reset()
+                        continue
+                    await t.on_error(e)
+            self.model = result["staged"]
+        self.metrics["transactions"] = ops
+
+    async def check(self) -> bool:
+        async def read_all(t):
+            return dict(await t.get_range(b"api/", b"api0", limit=100000))
+        actual = await self.run_transaction(read_all)
+        return actual == self.model
+
+
+@register_workload
+class RollbackWorkload(TestWorkload):
+    """Forces epoch changes mid-load by killing the current master's
+    process (reference Rollback.actor.cpp forces recoveries; our analog
+    exercises the same rollback/epoch paths in storage and resolvers)."""
+
+    name = "Rollback"
+
+    async def start(self) -> None:
+        duration = float(self.config.get("testDuration", 8.0))
+        n_recoveries = int(self.config.get("recoveries", 2))
+        rng = random.Random(int(self.config.get("seed", 7)))
+        deadline = now() + duration
+        forced = 0
+        for _ in range(n_recoveries):
+            await delay(duration / (n_recoveries + 1) *
+                        (0.7 + 0.6 * rng.random()))
+            if now() >= deadline:
+                break
+            cc = self.cluster.current_cc()
+            if cc is None or cc.db_info.master is None:
+                continue
+            proc = self.cluster.process_of(cc.db_info.master)
+            if proc is not None and proc.alive:
+                self.cluster.sim.kill_process(proc)
+                forced += 1
+        self.metrics["recoveries_forced"] = forced
+
+
+@register_workload
+class ChangeConfigWorkload(TestWorkload):
+    """Changes the database configuration mid-run and forces a recovery to
+    adopt it (reference ChangeConfig.actor.cpp): flips resolver and commit
+    proxy counts, then verifies the new epoch recruited the new counts."""
+
+    name = "ChangeConfig"
+
+    async def start(self) -> None:
+        await delay(float(self.config.get("delayBefore", 2.0)))
+        cfg = self.cluster.config
+        self.want_resolvers = 3 - cfg.n_resolvers if cfg.n_resolvers in (1, 2) \
+            else 2
+        self.want_proxies = 3 - cfg.n_commit_proxies \
+            if cfg.n_commit_proxies in (1, 2) else 2
+        cfg.n_resolvers = self.want_resolvers
+        cfg.n_commit_proxies = self.want_proxies
+        cc = self.cluster.current_cc()
+        if cc is not None and cc.db_info.master is not None:
+            proc = self.cluster.process_of(cc.db_info.master)
+            if proc is not None:
+                self.cluster.sim.kill_process(proc)
+        self.metrics["changed"] = 1
+
+    async def check(self) -> bool:
+        from ..core.scheduler import now as _now
+        deadline = _now() + 30.0
+        while _now() < deadline:
+            cc = self.cluster.current_cc()
+            if cc is not None and cc.db_info.recovery_state in (
+                    "accepting_commits", "fully_recovered"):
+                info = cc.db_info
+                if (len(info.resolvers) == self.want_resolvers and
+                        len(info.commit_proxies) == self.want_proxies):
+                    return True
+            await delay(0.5)
+        return False
+
+
+@register_workload
+class RandomMoveKeysWorkload(TestWorkload):
+    """Random live shard relocations through the DataDistributor under
+    load (reference RandomMoveKeys.actor.cpp)."""
+
+    name = "RandomMoveKeys"
+
+    def _dd(self):
+        cc = self.cluster.current_cc()
+        if cc is None or cc.db_info.data_distributor is None:
+            return None
+        dd = getattr(cc.db_info.data_distributor, "role", None)
+        if dd is not None and not getattr(dd, "halted", False):
+            return dd
+        return None
+
+    async def start(self) -> None:
+        duration = float(self.config.get("testDuration", 8.0))
+        moves = int(self.config.get("moves", 4))
+        rng = random.Random(int(self.config.get("seed", 8)))
+        deadline = now() + duration
+        done = 0
+        for _ in range(moves):
+            await delay(duration / (moves + 1) * (0.5 + rng.random()))
+            if now() >= deadline:
+                break
+            dd = self._dd()
+            if dd is None or not dd.healthy:
+                continue
+            shards = [(b, e, t) for b, e, t in dd.map.ranges() if t]
+            if not shards:
+                continue
+            b, e, team = shards[rng.randrange(len(shards))]
+            # New team: same size, random healthy members.
+            size = min(len(team), len(dd.healthy))
+            new_team = rng.sample(sorted(dd.healthy), size)
+            try:
+                await dd.move_shard(b, e, new_team)
+                done += 1
+            except FdbError:
+                pass
+        self.metrics["moves"] = done
+
+
+@register_workload
+class WatchesWorkload(TestWorkload):
+    """Watch semantics under load (reference WatchAndWait.actor.cpp):
+    one actor watches keys, another mutates them; every watch must fire."""
+
+    name = "Watches"
+
+    async def start(self) -> None:
+        n = int(self.config.get("watchCount", 8))
+        fired = [0]
+
+        async def waiter(i: int) -> None:
+            key = b"watch/%03d" % i
+
+            async def get_watch(t):
+                # Under chaos the watch registration can land after the
+                # touch: a value already b"touched" counts as fired (the
+                # change we were waiting for has been observed).
+                if await t.get(key, snapshot=True) == b"touched":
+                    return None
+                f = await t.watch(key)
+                await t.commit()
+                return f
+            f = await self.run_transaction(get_watch)
+            if f is not None:
+                await f
+            fired[0] += 1
+
+        async def toucher() -> None:
+            await delay(0.5)
+            for i in range(n):
+                async def set_fn(t, i=i):
+                    t.set(b"watch/%03d" % i, b"touched")
+                await self.run_transaction(set_fn)
+
+        await wait_all([spawn(waiter(i)) for i in range(n)] +
+                       [spawn(toucher())])
+        self.metrics["watches_fired"] = fired[0]
+
+    async def check(self) -> bool:
+        return self.metrics.get("watches_fired", 0) == int(
+            self.config.get("watchCount", 8))

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Simulation ensemble runner: (spec, seed, buggify) tuples in sequence.
+
+Reference: contrib/TestHarness/Program.cs.cmake — the C# orchestrator that
+picks random (test file, seed, buggify) tuples, runs `fdbserver -r
+simulation` for each, and triages failures.  Here every run is a fresh
+deterministic event loop + simulated cluster in-process; a failure is
+reproducible from its printed (spec, seed, buggify) tuple:
+
+    python scripts/run_ensemble.py --seeds 5 --specs tests/specs
+    python scripts/run_ensemble.py --spec tests/specs/CycleTest.toml --seed 17
+"""
+
+import argparse
+import glob
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_one(spec_path: str, seed: int, buggify: bool) -> dict:
+    from foundationdb_tpu.core import (DeterministicRandom, enable_buggify,
+                                       set_deterministic_random,
+                                       set_event_loop)
+    from foundationdb_tpu.rpc.sim import set_simulator
+    from foundationdb_tpu.server.cluster import SimFdbCluster
+    from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+    from foundationdb_tpu.testing import load_spec, run_test
+
+    set_deterministic_random(DeterministicRandom(seed))
+    enable_buggify(buggify)
+    try:
+        # MoveKeys-style specs need spare storage teams to actually move
+        # data; everything else runs on the lean default topology.
+        if "MoveKeys" in os.path.basename(spec_path):
+            config = DatabaseConfiguration(
+                n_tlogs=2, log_replication=2, n_storage=3,
+                storage_replication=2)
+            n_workers, n_storage_workers = 8, 3
+        else:
+            config = DatabaseConfiguration(
+                n_tlogs=2, log_replication=2, n_storage=2,
+                storage_replication=2)
+            n_workers, n_storage_workers = 7, 2
+        cluster = SimFdbCluster(config=config, n_workers=n_workers,
+                                n_storage_workers=n_storage_workers)
+        spec = load_spec(open(spec_path).read())
+
+        async def go():
+            return await run_test(cluster, spec)
+
+        return cluster.run_until(cluster.loop.spawn(go()), timeout=1800)
+    finally:
+        enable_buggify(False)
+        set_simulator(None)
+        set_event_loop(None)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--specs", default="tests/specs",
+                    help="directory of .toml specs (default tests/specs)")
+    ap.add_argument("--spec", default=None, help="run one spec file only")
+    ap.add_argument("--seeds", type=int, default=3,
+                    help="seeds per spec (default 3)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="run one seed only (repro mode)")
+    ap.add_argument("--no-buggify", action="store_true")
+    args = ap.parse_args()
+
+    specs = [args.spec] if args.spec else sorted(
+        glob.glob(os.path.join(args.specs, "*.toml")))
+    seeds = [args.seed] if args.seed is not None else \
+        [100 + i for i in range(args.seeds)]
+
+    failures = []
+    total = 0
+    for spec_path in specs:
+        for seed in seeds:
+            buggify = (not args.no_buggify) and seed % 2 == 0
+            total += 1
+            tag = (f"{os.path.basename(spec_path)} seed={seed} "
+                   f"buggify={buggify}")
+            t0 = time.time()
+            try:
+                run_one(spec_path, seed, buggify)
+                print(f"PASS {tag} ({time.time() - t0:.1f}s)")
+            except BaseException:
+                print(f"FAIL {tag} ({time.time() - t0:.1f}s)")
+                traceback.print_exc()
+                failures.append(tag)
+    print(f"\n{total - len(failures)}/{total} passed")
+    for f in failures:
+        print(f"  FAILED: {f}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

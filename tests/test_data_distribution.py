@@ -25,13 +25,9 @@ def make_cluster(**cfg):
 
 def current_dd(cluster):
     cc = cluster.current_cc()
-    dd_iface = cc.db_info.data_distributor
-    import gc
-    from foundationdb_tpu.server.data_distribution import DataDistributor
-    for o in gc.get_objects():
-        if isinstance(o, DataDistributor) and o.interface is dd_iface:
-            return o
-    return None
+    if cc is None or cc.db_info.data_distributor is None:
+        return None
+    return getattr(cc.db_info.data_distributor, "role", None)
 
 
 async def consistency_audit(cluster, db):

@@ -70,3 +70,51 @@ testTitle = 'Bogus'
         return None
 
     assert "DoesNotExist" in c.run_until(c.loop.spawn(go()), timeout=30)
+
+
+def _run_spec(spec_name, buggify=False, **cluster_kw):
+    from foundationdb_tpu.core import enable_buggify
+    cfg = cluster_kw.pop("config", None) or DatabaseConfiguration(
+        n_tlogs=2, log_replication=2)
+    c = SimFdbCluster(config=cfg,
+                      n_workers=cluster_kw.pop("n_workers", 7),
+                      n_storage_workers=cluster_kw.pop("n_storage_workers", 2))
+    spec = load_spec(os.path.join(SPECS, spec_name))
+    enable_buggify(buggify)
+    try:
+        async def go():
+            return await run_test(c, spec)
+        return c.run_until(c.loop.spawn(go()), timeout=1200)
+    finally:
+        enable_buggify(False)
+
+
+def test_api_correctness_spec(teardown):
+    m = _run_spec("ApiCorrectnessTest.toml", buggify=True)
+    assert m["ApiCorrectness"]["transactions"] > 0
+
+
+def test_rollback_spec(teardown):
+    m = _run_spec("RollbackTest.toml", buggify=True)
+    assert m["Rollback"]["recoveries_forced"] >= 1
+    assert m["Cycle"]["swaps"] > 0
+
+
+def test_change_config_spec(teardown):
+    m = _run_spec("ChangeConfigTest.toml")
+    assert m["ChangeConfig"]["changed"] == 1
+
+
+def test_movekeys_cycle_spec(teardown):
+    m = _run_spec("MoveKeysCycle.toml",
+                  config=DatabaseConfiguration(
+                      n_tlogs=2, log_replication=2, n_storage=3,
+                      storage_replication=2),
+                  n_workers=8, n_storage_workers=3)
+    assert m["RandomMoveKeys"]["moves"] >= 1
+    assert m["ConsistencyCheck"]["shards_audited"] >= 1
+
+
+def test_watches_spec(teardown):
+    m = _run_spec("WatchesTest.toml")
+    assert m["Watches"]["watches_fired"] == 8
