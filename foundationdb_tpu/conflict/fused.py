@@ -54,8 +54,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.digest import (KEY_LANES, MAX_DIGEST, ROW_PAD, gather_cols,
-                          lex_eq, planar_to_rows, rows_to_planar,
-                          searchsorted_left, searchsorted_right)
+                          lex_eq, planar_to_rows, rank_count,
+                          rows_to_planar, searchsorted_left,
+                          searchsorted_right)
 from ..ops.rangemax import NEG_INF, build_sparse_table, range_max
 from ..ops.segtree import (build_min_table, interval_min_cover, range_min)
 from ..txn.types import CommitResult
@@ -255,14 +256,17 @@ def make_merge_step(cap: int, d_cap: int):
 
         # Pointwise-max values at every boundary of either tier.  Where delta
         # covers a key its version is newer than base's, so max == overlay.
-        slot_db = jnp.clip(searchsorted_right(dk, bk) - 1, 0, d_cap - 1)
+        # CAP-many searches into the small delta run as duals (few searches
+        # + histogram cumsum, ops/digest.py rank_count).
+        slot_db = jnp.clip(
+            rank_count(searchsorted_left(bk, dk), cap) - 1, 0, d_cap - 1)
         v_b = jnp.maximum(bv, dv[slot_db])
         slot_bd = jnp.clip(searchsorted_right(bk, dk) - 1, 0, cap - 1)
         v_d = jnp.maximum(dv, bv[slot_bd])
 
         # Dedup: a base boundary with an equal live delta boundary is dropped
         # (the delta copy carries the same merged value).
-        p = searchsorted_left(dk, bk)
+        p = rank_count(searchsorted_right(bk, dk), cap)
         dup_b = (p < dsize) & lex_eq(
             gather_cols(dk, jnp.minimum(p, d_cap - 1)), bk)
         keep_b = live_b & ~dup_b
@@ -270,7 +274,7 @@ def make_merge_step(cap: int, d_cap: int):
         # Merged-order positions via cross ranks (no equal keys remain
         # between the kept-base and live-delta sequences).
         rank_b = jnp.cumsum(keep_b.astype(jnp.int32)) - 1
-        d_before = jnp.minimum(searchsorted_left(dk, bk), dsize)
+        d_before = jnp.minimum(p, dsize)
         pos_b = jnp.where(keep_b, rank_b + d_before, s_cap)
         b_before_raw = jnp.minimum(searchsorted_left(bk, dk), size)
         drop_prefix = jnp.cumsum(dup_b.astype(jnp.int32))  # inclusive

@@ -38,7 +38,7 @@ import numpy as np
 
 from ..ops.digest import (KEY_LANES, MAX_DIGEST, ROW_PAD, gather_cols,
                           lex_eq, max_digest_block, planar_to_rows,
-                          rows_to_planar, searchsorted_left,
+                          rank_count, rows_to_planar, searchsorted_left,
                           searchsorted_right)
 from ..ops.rangemax import NEG_INF, build_sparse_table, range_max
 
@@ -152,8 +152,11 @@ def window_insert(state: WindowState, w_begin: jnp.ndarray, w_end: jnp.ndarray,
 
     # Old boundaries strictly inside any merged range are dropped; a boundary
     # equal to a begin is also dropped (replaced by the new begin entry).
-    cnt_b = searchsorted_right(mb, bk)   # merged begins <= bk[i]
-    cnt_e = searchsorted_right(me, bk)   # merged ends   <= bk[i]
+    # Counts come from the dual direction (few queries into the big array +
+    # histogram cumsum) — searching per-capacity-entry into the small merged
+    # arrays costs log-probes times CAP gathers.
+    cnt_b = rank_count(searchsorted_left(bk, mb), cap)  # merged begins <= bk[i]
+    cnt_e = rank_count(p, cap)                          # merged ends   <= bk[i]
     inside = cnt_b > cnt_e
     keep = live & ~inside
 
@@ -187,7 +190,8 @@ def window_insert(state: WindowState, w_begin: jnp.ndarray, w_end: jnp.ndarray,
     # Interleave positions: no duplicates exist between kept-old and new.
     pos_new = searchsorted_left(old_k, new_digest) + jnp.arange(
         2 * w, dtype=jnp.int32)
-    pos_old = idx_cap + searchsorted_left(new_digest, old_k)
+    pos_old = idx_cap + rank_count(
+        searchsorted_right(old_k, new_digest), cap)
 
     out_v = jnp.full((cap,), NEG_INF, dtype=jnp.int32)
     new_size = kept_count + new_count

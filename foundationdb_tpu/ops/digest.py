@@ -173,6 +173,28 @@ def gather_cols(planar: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return rows_to_planar(planar_to_rows(planar)[idx])
 
 
+def rank_count(positions: jnp.ndarray, out_len: int) -> jnp.ndarray:
+    """counts[i] = #{j : positions[j] <= i} for i in [0, out_len).
+
+    The dual of a binary search with MANY queries into a SMALL array —
+    note the tie side FLIPS across the duality:
+
+        searchsorted_right(small, big) == rank_count(
+            searchsorted_left(big, small), len(big))
+        searchsorted_left(small, big)  == rank_count(
+            searchsorted_right(big, small), len(big))
+
+    (#{j: small_j <= big_i} counts j with left-pos <= i; #{j: small_j <
+    big_i} counts j with right-pos <= i.)  Costs one histogram scatter-add
+    + one cumsum instead of log2(len(small)) gathers per big element.
+    Entries with positions[j] >= out_len are never counted (padding
+    convention: pad queries resolve to the pad region).  Property-tested
+    in tests/test_conflict_tpu.py::test_rank_count_duality."""
+    hist = jnp.zeros((out_len + 1,), jnp.int32).at[
+        jnp.clip(positions, 0, out_len)].add(1)
+    return jnp.cumsum(hist[:out_len])
+
+
 def _searchsorted(sorted_keys: jnp.ndarray, queries: jnp.ndarray,
                   side_left: bool) -> jnp.ndarray:
     """Vectorized branchless binary search.

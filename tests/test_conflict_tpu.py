@@ -195,3 +195,22 @@ def test_clear_matches_oracle(small_caps):
                              read_conflict_ranges=[KeyRange(b"q", b"r")])
     got, want = tpu.resolve([r], 500), oracle.resolve([r], 500)
     assert got == want == [CommitResult.CONFLICT]
+
+
+def test_rank_count_duality():
+    """rank_count's side-flipping duality vs numpy searchsorted on random
+    TIED arrays (the docstring contract, ops/digest.py)."""
+    import numpy as np
+    from foundationdb_tpu.ops.digest import rank_count
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        big = np.sort(rng.integers(0, 8, size=rng.integers(1, 40)))
+        small = np.sort(rng.integers(0, 8, size=rng.integers(0, 20)))
+        left_pos = np.searchsorted(big, small, "left").astype(np.int32)
+        right_pos = np.searchsorted(big, small, "right").astype(np.int32)
+        got_right = np.asarray(rank_count(left_pos, len(big)))
+        got_left = np.asarray(rank_count(right_pos, len(big)))
+        want_right = np.searchsorted(small, big, "right")
+        want_left = np.searchsorted(small, big, "left")
+        assert (got_right == want_right).all(), (big, small)
+        assert (got_left == want_left).all(), (big, small)
