@@ -1,0 +1,146 @@
+"""Log-scale latency histograms + counter collections with periodic
+trace emission.
+
+Reference: flow/Histogram.h:59 (32-bucket power-of-two histogram; the
+commit path hangs them off every stage, CommitProxyServer.actor.cpp:403-409)
+and fdbrpc/Stats.h:70-183 (Counter/CounterCollection + traceCounters'
+periodic rate emission).  These feed the status JSON's latency_statistics
+and the north-star p50 resolve tracking.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+_N_BUCKETS = 40
+_BASE = 1e-6          # bucket 0 upper bound: 1us; bucket i: 1us * 2^i
+
+
+class Histogram:
+    """Power-of-two log-scale histogram of seconds (reference Histogram.h).
+
+    Bucket i counts samples in (BASE*2^(i-1), BASE*2^i]; percentiles are
+    bucket upper bounds (exact enough for p50/p95/p99 reporting)."""
+
+    def __init__(self, group: str = "", op: str = "") -> None:
+        self.group = group
+        self.op = op
+        self.buckets = [0] * _N_BUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        self.max = max(self.max, seconds)
+        self.min = seconds if self.min is None else min(self.min, seconds)
+        i = 0
+        bound = _BASE
+        while seconds > bound and i < _N_BUCKETS - 1:
+            bound *= 2
+            i += 1
+        self.buckets[i] += 1
+
+    def percentile(self, p: float) -> float:
+        """Upper bound of the bucket containing the p-quantile (0..1)."""
+        if self.count == 0:
+            return 0.0
+        target = max(1, int(self.count * p))
+        acc = 0
+        bound = _BASE
+        for i, c in enumerate(self.buckets):
+            acc += c
+            if acc >= target:
+                return bound
+            bound *= 2
+        return bound
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def to_status(self) -> Dict[str, float]:
+        """The status-JSON latency_statistics shape (reference
+        mr-status latency_statistics docs)."""
+        return {"count": self.count, "mean": self.mean,
+                "min": self.min or 0.0, "max": self.max,
+                "p50": self.percentile(0.50),
+                "p95": self.percentile(0.95),
+                "p99": self.percentile(0.99)}
+
+    def clear(self) -> None:
+        self.buckets = [0] * _N_BUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = 0.0
+
+
+class Counter:
+    """Monotonic counter with rate-since-last-emission (Stats.h:70)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.value = 0
+        self._last_value = 0
+
+    def add(self, n: int = 1) -> None:
+        self.value += n
+
+    def rate_and_roll(self, dt: float) -> float:
+        d = self.value - self._last_value
+        self._last_value = self.value
+        return d / dt if dt > 0 else 0.0
+
+
+class CounterCollection:
+    """Named counters + histograms for one role instance; emit() traces
+    rates on a cadence (reference traceCounters, Stats.h:183)."""
+
+    def __init__(self, group: str, role_id: str) -> None:
+        self.group = group
+        self.role_id = role_id
+        self.counters: Dict[str, Counter] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name)
+        return c
+
+    def histogram(self, name: str) -> Histogram:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram(self.group, name)
+        return h
+
+    async def emit_loop(self, interval: float = 5.0) -> None:
+        """Periodic TraceEvent with each counter's rate and histogram p50s
+        (the reference's traceCounters actor)."""
+        from .scheduler import delay, now
+        from .trace import TraceEvent
+        last = now()
+        while True:
+            await delay(interval)
+            t = now()
+            dt = t - last
+            last = t
+            ev = TraceEvent(f"{self.group}Metrics").detail(
+                "Id", self.role_id).detail("Elapsed", round(dt, 3))
+            for name, c in self.counters.items():
+                ev.detail(name, c.value).detail(
+                    f"{name}PerSec", round(c.rate_and_roll(dt), 2))
+            for name, h in self.histograms.items():
+                ev.detail(f"{name}P50", h.percentile(0.50)).detail(
+                    f"{name}P99", h.percentile(0.99))
+            ev.log()
+
+    def to_status(self) -> Dict[str, object]:
+        return {
+            "counters": {n: c.value for n, c in self.counters.items()},
+            "latency_statistics": {n: h.to_status()
+                                   for n, h in self.histograms.items()},
+        }

@@ -127,6 +127,12 @@ class CommitProxy:
         self.batch_logging = NotifiedVersion(0)     # latest batch in logging
         self.stats = {"commits": 0, "conflicts": 0, "too_old": 0,
                       "batches": 0, "mutations": 0}
+        # Latency histograms + counters with periodic trace emission
+        # (reference CommitProxyServer.actor.cpp:403-409 stage histograms,
+        # fdbrpc/Stats.h traceCounters).
+        from ..core.histogram import CounterCollection
+        self.metrics = CounterCollection("CommitProxy", proxy_id)
+        self.interface.role = self   # sim-side backref for status/tests
         self.broken = False   # set on mid-batch infrastructure failure
         # Exactly-once cursor over foreign state transactions (version,
         # origin proxy, seq); see _apply_foreign_state.
@@ -192,6 +198,7 @@ class CommitProxy:
     async def _commit_batch_impl(self, batch: List[CommitTransactionRequest],
                                  batch_num: int) -> None:
         self.stats["batches"] += 1
+        t_start = now()
 
         # Phase 1: pre-resolution. Gate: the previous batch must have entered
         # resolution so master versions are requested in order (:589).
@@ -211,7 +218,9 @@ class CommitProxy:
         resolution_futures = [
             RequestStream.at(r.resolve.endpoint).get_reply(req)
             for r, req in zip(self.resolvers, requests)]
+        t_res = now()
         resolutions = await wait_all(resolution_futures)
+        self.metrics.histogram("Resolution").record(now() - t_res)
         self.last_resolved_version = commit_version
 
         # Phase 3: post-resolution. Gate on logging order (:1075).
@@ -228,7 +237,9 @@ class CommitProxy:
             known_committed_version=self.committed_version.get(),
             messages=messages)
         self.batch_logging.set_at_least(batch_num)  # next may enter logging
+        t_log = now()
         await log_done
+        self.metrics.histogram("TLogLogging").record(now() - t_log)
 
         # Phase 5: reply. The TLog ack implies every lower version (from any
         # proxy) is appended and covered by the same group fsync, so commit
@@ -241,9 +252,12 @@ class CommitProxy:
         await RequestStream.at(
             self.master.report_live_committed_version.endpoint).get_reply(
             ReportRawCommittedVersionRequest(version=commit_version))
+        self.metrics.histogram("Commit").record(now() - t_start)
+        self.metrics.counter("TxnCommitBatches").add(1)
         for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
             if verdict == CommitResult.COMMITTED:
                 self.stats["commits"] += 1
+                self.metrics.counter("TxnCommitted").add(1)
                 req.reply.send(CommitID(version=commit_version,
                                         txn_batch_id=batch_num,
                                         txn_batch_index=t_idx))
@@ -443,6 +457,7 @@ class CommitProxy:
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._commit_batcher(), f"{self.id}.batcher")
+        process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
         process.spawn(self._serve_locations(), f"{self.id}.locations")
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),

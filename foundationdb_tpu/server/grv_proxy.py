@@ -39,6 +39,9 @@ class GrvProxy:
         self.queues: List[List[GetReadVersionRequest]] = [[], [], []]
         self.transaction_budget = float("inf")
         self.stats = {"grvs": 0, "batches": 0}
+        from ..core.histogram import CounterCollection
+        self.metrics = CounterCollection("GrvProxy", proxy_id)
+        self.interface.role = self   # sim-side backref for status/tests
         self._wakeup: Optional[Promise] = None
 
     async def _queue_requests(self) -> None:
@@ -118,6 +121,7 @@ class GrvProxy:
             await delay(wait)
 
     async def _reply_batch(self, batch: List[GetReadVersionRequest]) -> None:
+        _t0 = now()
         # Confirm log-system liveness + fetch live committed version in
         # parallel (reference getLiveCommittedVersion :527).
         confirms = [RequestStream.at(t.confirm_running.endpoint).get_reply(
@@ -129,6 +133,8 @@ class GrvProxy:
             await wait_all(confirms)
         vreply = await version_f
         self.stats["grvs"] += len(batch)
+        self.metrics.counter("TxnStarted").add(len(batch))
+        self.metrics.histogram("GRVLatency").record(now() - _t0)
         for req in batch:
             req.reply.send(GetReadVersionReply(version=vreply.version,
                                                locked=vreply.locked))
@@ -137,6 +143,7 @@ class GrvProxy:
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._queue_requests(), f"{self.id}.queue")
+        process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
         process.spawn(self._transaction_starter(), f"{self.id}.starter")
         if self.ratekeeper is not None:
             process.spawn(self._rate_updater(), f"{self.id}.rateUpdater")

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 from ..conflict.api import ConflictSet, new_conflict_set
 from ..core.buggify import buggify
 from ..core.knobs import server_knobs
+from ..core.scheduler import now
 from ..core.trace import TraceEvent
 from ..txn.types import Version
 from .interfaces import (ResolverInterface, ResolveTransactionBatchReply,
@@ -52,6 +53,9 @@ class Resolver:
             self.proxy_infos[pid] = info
         self.total_state_bytes = 0
         self.resolved_batches = 0
+        from ..core.histogram import CounterCollection
+        self.metrics = CounterCollection("Resolver", resolver_id)
+        self.interface.role = self   # sim-side backref for status/tests
         # Accumulated state transactions for cross-proxy metadata broadcast
         # (reference :220-249): (version, origin_proxy, seq, mutations,
         # local_verdict), version-ascending; trimmed once every registered
@@ -85,8 +89,11 @@ class Resolver:
         new_oldest = max(self.conflict_set.oldest_version,
                          req.version -
                          int(knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS))
+        _t0 = now()
         committed = self.conflict_set.resolve(
             req.transactions, req.version, new_oldest_version=new_oldest)
+        self.metrics.histogram("Resolve").record(now() - _t0)
+        self.metrics.counter("TxnResolved").add(len(req.transactions))
         # Foreign state txns resolved since this proxy last heard from us
         # (strictly before this batch's version; ours are appended below).
         lrv = req.last_received_version
@@ -141,6 +148,7 @@ class Resolver:
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._serve(), f"{self.id}.serve")
+        process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),
                       f"{self.id}.waitFailure")

@@ -72,6 +72,23 @@ async def build_status(cc) -> Dict[str, Any]:
     for wid, reg in sorted(cc.workers.items()):
         processes[wid] = {"class_type": reg.process_class, "excluded": False}
 
+    # Role latency/counter metrics via the sim-side interface backrefs
+    # (reference: roles push TDMetrics / the status collector polls each
+    # worker; here the collections are read in place).
+    roles = {}
+    for kind, ifaces in (
+            ("commit_proxies", info.commit_proxies),
+            ("grv_proxies", info.grv_proxies),
+            ("resolvers", info.resolvers),
+            ("storage_servers", list(info.storage_servers.values()))):
+        entries = {}
+        for iface in ifaces:
+            role = getattr(iface, "role", None)
+            metrics = getattr(role, "metrics", None)
+            if metrics is not None:
+                entries[metrics.role_id] = metrics.to_status()
+        roles[kind] = entries
+
     return {
         "client": {
             "cluster_file": {"up_to_date": True},
@@ -112,6 +129,7 @@ async def build_status(cc) -> Dict[str, Any]:
                 "state": {"healthy": True, "name": "healthy"},
             },
             "layers": {"_valid": True},
+            "roles": roles,
             "cluster_controller_timestamp": round(now(), 3),
             "configuration": {
                 "logs": len(info.tlogs),

@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Tuple
 from ..core.futures import AsyncTrigger, Future, wait_any
 from ..core.buggify import buggify
 from ..core.knobs import server_knobs
-from ..core.scheduler import delay, spawn
+from ..core.scheduler import delay, now, spawn
 from ..core.trace import Severity, TraceEvent
 from ..rpc.endpoint import RequestStream
 from ..txn.atomic import apply_atomic
@@ -198,6 +198,9 @@ class StorageServer:
                       "watches": 0}
         self._process = None
         self._pull_actor = None
+        from ..core.histogram import CounterCollection
+        self.metrics = CounterCollection("StorageServer", ss_id)
+        self.interface.role = self   # sim-side backref for status/tests
         # Durable engine (IKeyValueStore) — None = memory-only role.
         # Mutations queue here (atomics pre-resolved to their results) until
         # the updateStorage actor batches them into the engine.
@@ -405,10 +408,12 @@ class StorageServer:
                 raise err("wrong_shard_server")
 
     async def _get_value(self, req: GetValueRequest) -> None:
+        _t0 = now()
         try:
             await self._wait_for_version(req.version)
             self._check_owned(req.key, req.key + b"\x00", req.version)
             self.stats["reads"] += 1
+            self.metrics.histogram("ReadLatency").record(now() - _t0)
             req.reply.send(GetValueReply(
                 value=self.data.get(req.key, req.version),
                 version=req.version))
@@ -610,6 +615,7 @@ class StorageServer:
         if self.engine is not None:
             process.spawn(self._update_storage_loop(),
                           f"{self.id}.updateStorage")
+        process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
         process.spawn(self._serve(self.interface.get_value.queue,
                                   self._get_value), f"{self.id}.getValue")
         process.spawn(self._serve(self.interface.get_key_values.queue,

@@ -1,0 +1,59 @@
+"""Latency histograms + counters with periodic emission (reference
+flow/Histogram.h:59, fdbrpc/Stats.h:70-183 traceCounters) and their
+surfacing in the status JSON's roles section."""
+
+import json
+
+import pytest
+
+from foundationdb_tpu.core.histogram import CounterCollection, Histogram
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+
+def test_histogram_percentiles():
+    h = Histogram("t", "x")
+    for us in [1, 10, 100, 1000, 10000]:
+        for _ in range(20):
+            h.record(us * 1e-6)
+    assert h.count == 100
+    # p50 falls in the 100us bucket's range (log-scale upper bounds).
+    assert 64e-6 <= h.percentile(0.50) <= 256e-6
+    assert h.percentile(0.99) >= 8e-3
+    s = h.to_status()
+    assert s["count"] == 100 and s["min"] > 0 and s["max"] >= 1e-2
+
+    c = CounterCollection("G", "r1")
+    c.counter("ops").add(5)
+    c.counter("ops").add(3)
+    assert c.counter("ops").value == 8
+    assert c.counter("ops").rate_and_roll(2.0) == 4.0
+    assert c.counter("ops").rate_and_roll(2.0) == 0.0
+
+
+def test_status_includes_role_latencies(teardown):  # noqa: F811
+    c = SimFdbCluster(config=DatabaseConfiguration(),
+                      n_workers=5, n_storage_workers=2)
+    db = c.database()
+
+    async def go():
+        for i in range(10):
+            await commit_kv(db, b"m%02d" % i, b"v")
+            await read_key(db, b"m%02d" % i)
+        status = await db.cluster.get_status()
+        json.dumps(status)
+        roles = status["cluster"]["roles"]
+        cp = next(iter(roles["commit_proxies"].values()))
+        assert cp["counters"]["TxnCommitted"] >= 10
+        commit_lat = cp["latency_statistics"]["Commit"]
+        assert commit_lat["count"] >= 1 and commit_lat["p50"] > 0
+        grv = next(iter(roles["grv_proxies"].values()))
+        assert grv["counters"]["TxnStarted"] >= 10
+        res = next(iter(roles["resolvers"].values()))
+        assert res["latency_statistics"]["Resolve"]["count"] >= 1
+        ss = next(iter(roles["storage_servers"].values()))
+        assert ss["latency_statistics"]["ReadLatency"]["count"] >= 1
+
+    c.run_until(c.loop.spawn(go()), timeout=60)
