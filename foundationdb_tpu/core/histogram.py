@@ -136,6 +136,10 @@ class CounterCollection:
             for name, h in self.histograms.items():
                 ev.detail(f"{name}P50", h.percentile(0.50)).detail(
                     f"{name}P99", h.percentile(0.99))
+                # Reference Histogram::writeToLog clears on emission so
+                # each report (and to_status) reflects the current
+                # interval, not a lifetime-diluted distribution.
+                h.clear()
             ev.log()
 
     def to_status(self) -> Dict[str, object]:

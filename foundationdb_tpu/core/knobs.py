@@ -74,6 +74,11 @@ class ServerKnobs(KnobBase):
         self.CONFLICT_SET_BACKEND = "cpu"
         self.TPU_CONFLICT_CAPACITY = 1 << 17  # max resident history segments
 
+        # Resolution balancing (reference masterserver.actor.cpp:1318)
+        self.RESOLUTION_BALANCING_INTERVAL = 0.5
+        self.RESOLUTION_BALANCING_MIN_LOAD = 50   # ranges/poll to bother
+        self.RESOLUTION_BALANCING_RATIO = 1.5     # max/min load trigger
+
         # Data distribution (reference DD_SHARD_SIZE_GRANULARITY etc.)
         self.DD_SHARD_SPLIT_BYTES = 1 << 20   # split a shard above this
         self.DD_METRICS_INTERVAL = 0.5        # shard-size poll cadence

@@ -113,8 +113,21 @@ class CommitProxy:
         self.master = master            # MasterInterface
         self.resolvers = resolvers      # [ResolverInterface]
         self.log_system = log_system
-        # key -> resolver index (reference ProxyCommitData::keyResolvers).
-        self.key_resolvers = key_resolvers
+        # key -> OWNERSHIP HISTORY: tuple of (version, resolver_idx),
+        # newest first (reference ProxyCommitData::keyResolvers, a
+        # KeyRangeMap keyed by version:  CommitProxyServer.actor.cpp:154-181
+        # sends a range to every resolver that owned it within the MVCC
+        # window, so old-snapshot conflict checks reach the resolver
+        # holding that span's write history).  Accepts a plain int map
+        # (recruitment shape) and normalizes.
+        hist_map: RangeMap = RangeMap(default=((recovery_version, 0),))
+        for b, e, v in key_resolvers.ranges():
+            if isinstance(v, int):
+                hist_map.set_range(b, e, ((recovery_version, v),))
+            else:
+                hist_map.set_range(b, e, tuple(v))
+        self.key_resolvers = hist_map
+        self._resolver_changes_hwm: Version = 0
         # key -> [Tag] storage team (reference keyInfo/tagsForKey :926).
         self.key_servers = key_servers
         self.storage_interfaces = storage_interfaces or {}
@@ -125,11 +138,10 @@ class CommitProxy:
         self.local_batch_number = 0
         self.batch_resolving = NotifiedVersion(0)   # latest batch in resolution
         self.batch_logging = NotifiedVersion(0)     # latest batch in logging
-        self.stats = {"commits": 0, "conflicts": 0, "too_old": 0,
-                      "batches": 0, "mutations": 0}
         # Latency histograms + counters with periodic trace emission
         # (reference CommitProxyServer.actor.cpp:403-409 stage histograms,
-        # fdbrpc/Stats.h traceCounters).
+        # fdbrpc/Stats.h traceCounters).  Counters are the single source of
+        # truth; `stats` below is a read-only compatibility view.
         from ..core.histogram import CounterCollection
         self.metrics = CounterCollection("CommitProxy", proxy_id)
         self.interface.role = self   # sim-side backref for status/tests
@@ -137,6 +149,15 @@ class CommitProxy:
         # Exactly-once cursor over foreign state transactions (version,
         # origin proxy, seq); see _apply_foreign_state.
         self._state_hwm: Tuple[Version, str, int] = (-1, "", -1)
+
+    @property
+    def stats(self):
+        c = self.metrics.counter
+        return {"commits": c("TxnCommitted").value,
+                "conflicts": c("TxnConflicted").value,
+                "too_old": c("TxnTooOld").value,
+                "batches": c("TxnCommitBatches").value,
+                "mutations": c("Mutations").value}
 
     # -- batcher (reference commitBatcher :199) ------------------------------
     async def _commit_batcher(self) -> None:
@@ -197,7 +218,7 @@ class CommitProxy:
 
     async def _commit_batch_impl(self, batch: List[CommitTransactionRequest],
                                  batch_num: int) -> None:
-        self.stats["batches"] += 1
+        self.metrics.counter("TxnCommitBatches").add(1)
         t_start = now()
 
         # Phase 1: pre-resolution. Gate: the previous batch must have entered
@@ -210,6 +231,8 @@ class CommitProxy:
                                     proxy_id=self.id))
         commit_version: Version = vreply.version
         prev_version: Version = vreply.prev_version
+        if vreply.resolver_changes:
+            self._apply_resolver_changes(vreply.resolver_changes)
 
         # Phase 2: resolution — fan out to resolvers (:660).
         requests, index_maps = self._build_resolution_requests(
@@ -229,7 +252,8 @@ class CommitProxy:
         verdicts = self._determine_committed(batch, index_maps, resolutions)
         messages = self._assign_mutations_to_tags(
             batch, verdicts, commit_version)
-        self.stats["mutations"] += sum(len(m) for m in messages.values())
+        self.metrics.counter("Mutations").add(
+            sum(len(m) for m in messages.values()))
 
         # Phase 4: logging — push to TLogs, wait durable.
         log_done = self.log_system.push(
@@ -253,30 +277,66 @@ class CommitProxy:
             self.master.report_live_committed_version.endpoint).get_reply(
             ReportRawCommittedVersionRequest(version=commit_version))
         self.metrics.histogram("Commit").record(now() - t_start)
-        self.metrics.counter("TxnCommitBatches").add(1)
         for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
             if verdict == CommitResult.COMMITTED:
-                self.stats["commits"] += 1
                 self.metrics.counter("TxnCommitted").add(1)
                 req.reply.send(CommitID(version=commit_version,
                                         txn_batch_id=batch_num,
                                         txn_batch_index=t_idx))
             elif verdict == CommitResult.TOO_OLD:
-                self.stats["too_old"] += 1
+                self.metrics.counter("TxnTooOld").add(1)
                 from ..core.error import err
                 req.reply.send_error(err("transaction_too_old"))
             else:
-                self.stats["conflicts"] += 1
+                self.metrics.counter("TxnConflicted").add(1)
                 from ..core.error import err
                 req.reply.send_error(err("not_committed"))
 
     # -- resolution request building (reference :88-181) ---------------------
-    def _clip_ranges(self, ranges: List[KeyRange], resolver_idx: int
-                     ) -> List[KeyRange]:
+    def _apply_resolver_changes(self, changes) -> None:
+        """Adopt master-piggybacked resolver boundary moves exactly once,
+        in change-version order (reference :1175-1182)."""
+        for kr, idx, v in sorted(changes, key=lambda c: c[2]):
+            if v <= self._resolver_changes_hwm:
+                continue
+            self._resolver_changes_hwm = v
+            floor = v - int(
+                server_knobs().MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+            for b, e, hist in list(self.key_resolvers.intersecting(
+                    kr.begin, kr.end)):
+                # Prepend the new owner; trim history below the MVCC
+                # window (the entry at/below the floor is the owner at
+                # window start and must be kept).
+                kept = [(v, idx)]
+                for hv, hidx in tuple(hist or ()):
+                    kept.append((hv, hidx))
+                    if hv <= floor:
+                        break
+                self.key_resolvers.set_range(b, e, tuple(kept))
+            TraceEvent("ProxyResolverChange").detail(
+                "Proxy", self.id).detail("Begin", kr.begin).detail(
+                "End", kr.end).detail("To", idx).detail("Version", v).log()
+
+    @staticmethod
+    def _eligible(hist, floor: Version) -> List[int]:
+        """Resolvers owning any part of the MVCC window above `floor`:
+        walk newest-first; the first entry at/below the floor is the owner
+        at window start and terminates the walk."""
+        out = []
+        for v, idx in hist:
+            if idx not in out:
+                out.append(idx)
+            if v <= floor:
+                break
+        return out
+
+    def _clip_ranges(self, ranges: List[KeyRange], resolver_idx: int,
+                     floor: Version) -> List[KeyRange]:
         out = []
         for r in ranges:
-            for b, e, idx in self.key_resolvers.intersecting(r.begin, r.end):
-                if idx == resolver_idx and b < e:
+            for b, e, hist in self.key_resolvers.intersecting(r.begin,
+                                                              r.end):
+                if b < e and resolver_idx in self._eligible(hist, floor):
                     out.append(KeyRange(b, e))
         return out
 
@@ -297,6 +357,8 @@ class CommitProxy:
             transactions=[], proxy_id=self.id) for _ in range(n)]
         index_maps: List[List[int]] = [[] for _ in range(n)]
         from .system_data import SYSTEM_KEYS_BEGIN
+        floor = commit_version - int(
+            server_knobs().MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
         for t_idx, req in enumerate(batch):
             txn = req.transaction
             # Metadata-bearing ("state") transactions go to EVERY resolver
@@ -311,9 +373,9 @@ class CommitProxy:
                 for m in txn.mutations)
             touched = set()
             for r in txn.read_conflict_ranges + txn.write_conflict_ranges:
-                for _, _, idx in self.key_resolvers.intersecting(r.begin,
-                                                                 r.end):
-                    touched.add(idx)
+                for _, _, hist in self.key_resolvers.intersecting(r.begin,
+                                                                  r.end):
+                    touched.update(self._eligible(hist, floor))
             if is_state:
                 touched = set(range(n))
             if not touched:
@@ -321,9 +383,9 @@ class CommitProxy:
             for idx in touched:
                 clipped = CommitTransactionRef(
                     read_conflict_ranges=self._clip_ranges(
-                        txn.read_conflict_ranges, idx),
+                        txn.read_conflict_ranges, idx, floor),
                     write_conflict_ranges=self._clip_ranges(
-                        txn.write_conflict_ranges, idx),
+                        txn.write_conflict_ranges, idx, floor),
                     mutations=list(txn.mutations) if is_state else [],
                     read_snapshot=txn.read_snapshot,
                     report_conflicting_keys=txn.report_conflicting_keys)

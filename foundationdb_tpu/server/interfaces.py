@@ -57,7 +57,9 @@ class GetCommitVersionRequest:
 class GetCommitVersionReply:
     version: Version
     prev_version: Version
-    resolver_changes: List[Tuple[KeyRange, int]] = field(default_factory=list)
+    # (KeyRange, resolver_idx, change_version) triples
+    resolver_changes: List[Tuple[KeyRange, int, Version]] = \
+        field(default_factory=list)
     resolver_changes_version: Version = 0
 
 
@@ -143,16 +145,40 @@ class ResolveTransactionBatchReply:
     state_transactions: List[Any] = field(default_factory=list)
 
 
+@dataclass
+class ResolutionMetricsRequest:
+    """Master -> resolver: conflict ranges resolved since the last poll
+    (reference ResolutionMetricsRequest, Resolver.actor.cpp:341)."""
+
+    reply: Any = None    # -> int
+
+
+@dataclass
+class ResolutionSplitRequest:
+    """Master -> resolver: a key splitting the measured load of
+    [begin, end) roughly at `fraction` (reference ResolutionSplitRequest,
+    Resolver.actor.cpp:348)."""
+
+    begin: bytes = b""
+    end: bytes = b""
+    fraction: float = 0.5
+    reply: Any = None    # -> Optional[bytes]
+
+
 class ResolverInterface:
     def __init__(self, resolver_id: str = "") -> None:
         self.id = resolver_id
         self.resolve = RequestStream(
             "resolver.resolve", TaskPriority.ProxyResolverReply)
+        self.metrics = RequestStream("resolver.metrics",
+                                     TaskPriority.ResolutionMetrics)
+        self.split = RequestStream("resolver.split",
+                                   TaskPriority.ResolutionMetrics)
         self.wait_failure = RequestStream("resolver.waitFailure",
                                           TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
-        return [self.resolve, self.wait_failure]
+        return [self.resolve, self.metrics, self.split, self.wait_failure]
 
 
 # ---------------------------------------------------------------------------

@@ -21,10 +21,10 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..core.error import FdbError, err
 from ..core.futures import Future, Promise
 from ..core.knobs import server_knobs
-from ..core.scheduler import now, spawn
+from ..core.scheduler import delay, now, spawn
 from ..core.trace import Severity, TraceEvent
 from ..rpc.endpoint import RequestStream
-from ..txn.types import INVALID_VERSION, Version
+from ..txn.types import INVALID_VERSION, KeyRange, Version
 from .interfaces import (DatabaseConfiguration, GetCommitVersionReply,
                          GetCommitVersionRequest,
                          GetRawCommittedVersionReply,
@@ -39,13 +39,17 @@ class _ProxyVersionState:
     """Per-proxy request ordering + resend dedup (reference
     MasterData::lastCommitProxyVersionReplies)."""
 
-    __slots__ = ("last_request_num", "replies", "waiters")
+    __slots__ = ("last_request_num", "replies", "waiters",
+                 "last_change_seen")
 
     def __init__(self) -> None:
         # Proxies number requests from 1; "0 already served" seeds the chain.
         self.last_request_num = 0
         self.replies: Dict[int, GetCommitVersionReply] = {}
         self.waiters: Dict[int, Promise] = {}
+        # Highest resolver-change version delivered to this proxy; gates
+        # change GC — an idle proxy must never miss a boundary move.
+        self.last_change_seen: Version = 0
 
 
 class Master:
@@ -60,10 +64,14 @@ class Master:
         self.reference_version: Optional[Version] = None
         self.proxy_states: Dict[str, _ProxyVersionState] = {}
         self.interface = MasterInterface()
-        # Resolver key-range assignment changes to piggyback on the next
-        # version reply (reference resolver_changes piggyback :1175-1182).
+        # Resolver key-range assignment changes to piggyback on version
+        # replies (reference resolver_changes piggyback :1175-1182):
+        # entries (KeyRange, resolver_idx, change_version), GC'd once older
+        # than the MVCC window (every live proxy polls versions far more
+        # often than that).
         self.resolution_changes: list = []
         self.resolution_changes_version: Version = 0
+        self.expected_proxies: list = []   # ids recruited this epoch
 
     # -- version allocation (reference getVersion :1126) ---------------------
     def _allocate_version(self) -> GetCommitVersionReply:
@@ -83,6 +91,18 @@ class Master:
             knobs.MAX_VERSIONS_IN_FLIGHT)
         new_version = max(prev + 1, min(new_version, max_allowed))
         self.version = new_version
+        if self.resolution_changes:
+            # GC only changes EVERY recruited proxy has been handed (a
+            # version-age GC would let an idle proxy miss a move and keep
+            # routing conflict ranges to the old resolver — a
+            # serializability hole).
+            seen = [self.proxy_states[pid].last_change_seen
+                    if pid in self.proxy_states else 0
+                    for pid in (self.expected_proxies or
+                                list(self.proxy_states))]
+            floor = min(seen) if seen else 0
+            self.resolution_changes = [
+                c for c in self.resolution_changes if c[2] > floor]
         return GetCommitVersionReply(
             version=new_version, prev_version=prev,
             resolver_changes=list(self.resolution_changes),
@@ -119,6 +139,8 @@ class Master:
     def _reply_version(self, st: _ProxyVersionState,
                        req: GetCommitVersionRequest) -> None:
         reply = self._allocate_version()
+        st.last_change_seen = max(st.last_change_seen,
+                                  reply.resolver_changes_version)
         st.last_request_num = req.request_num
         st.replies[req.request_num] = reply
         # Drop replies older than the one before this (proxy won't resend).
@@ -245,6 +267,61 @@ def _key_resolver_ranges(n_resolvers: int
                          ) -> List[Tuple[bytes, bytes, int]]:
     bounds = [b""] + _split_points(n_resolvers) + [b"\xff\xff"]
     return [(bounds[i], bounds[i + 1], i) for i in range(n_resolvers)]
+
+
+async def resolution_balancing(master: Master, resolvers: List[Any],
+                               key_resolver_ranges) -> None:
+    """Rebalance resolver key ranges by measured load (reference
+    masterserver.actor.cpp:1318 resolutionBalancing + the resolver's
+    metrics/split endpoints).  When the busiest resolver's sampled range
+    load exceeds the least-busy's by RESOLUTION_BALANCING_RATIO, its
+    hottest owned range is split at the load midpoint and the upper part
+    moves; the change piggybacks on version replies, and proxies keep the
+    per-version ownership history so old-snapshot conflict checks still
+    reach the resolvers that held the range inside the MVCC window."""
+    from .interfaces import ResolutionMetricsRequest, ResolutionSplitRequest
+    from .shardmap import RangeMap
+    from ..core.futures import swallow, wait_all
+    knobs = server_knobs()
+    owned: RangeMap = RangeMap(default=0)
+    for b, e, idx in key_resolver_ranges:
+        owned.set_range(b, e, idx)
+    while True:
+        await delay(float(knobs.RESOLUTION_BALANCING_INTERVAL))
+        futures = [RequestStream.at(r.metrics.endpoint).get_reply(
+            ResolutionMetricsRequest()) for r in resolvers]
+        await wait_all([swallow(f) for f in futures])
+        if any(f.is_error() for f in futures):
+            continue
+        loads = [f.get() for f in futures]
+        hi = max(range(len(loads)), key=lambda i: loads[i])
+        lo = min(range(len(loads)), key=lambda i: loads[i])
+        if loads[hi] < int(knobs.RESOLUTION_BALANCING_MIN_LOAD) or \
+                loads[hi] < loads[lo] * float(
+                    knobs.RESOLUTION_BALANCING_RATIO) or hi == lo:
+            continue
+        # Split the busiest resolver's hottest owned range at its load
+        # midpoint (the first range with enough samples to split); the
+        # upper half moves to the least-busy resolver.
+        src_ranges = [(b, e) for b, e, idx in owned.ranges() if idx == hi]
+        split = b = e = None
+        for rb, re_ in src_ranges:
+            cand = await RequestStream.at(
+                resolvers[hi].split.endpoint).get_reply(
+                ResolutionSplitRequest(begin=rb, end=re_, fraction=0.5))
+            if cand is not None and rb < cand < re_:
+                b, e, split = rb, re_, cand
+                break
+        if split is None:
+            continue
+        owned.set_range(split, e, lo)
+        master.resolution_changes_version = master.version + 1
+        master.resolution_changes.append(
+            (KeyRange(split, e), lo, master.resolution_changes_version))
+        TraceEvent("ResolutionBalanced").detail(
+            "From", hi).detail("To", lo).detail(
+            "SplitKey", split).detail("End", e).detail(
+            "Loads", loads).log()
 
 
 # ---------------------------------------------------------------------------
@@ -417,6 +494,7 @@ async def master_server(master: Master, process, coordinators,
                     epoch=master.epoch)))
         epoch_proxy_ids = [f"proxy{i}.e{master.epoch}"
                            for i in range(config.n_commit_proxies)]
+        master.expected_proxies = epoch_proxy_ids
         resolver_futures = [RequestStream.at(
             pick(i + 1).init_resolver.endpoint).get_reply(
             InitializeResolverRequest(
@@ -507,6 +585,8 @@ async def master_server(master: Master, process, coordinators,
         adopt(master._serve_commit_versions(), "master.serveVersions")
         adopt(master._serve_live_committed(), "master.serveLive")
         adopt(master._serve_report_committed(), "master.serveReport")
+        adopt(resolution_balancing(master, resolvers, key_resolvers_ranges),
+              "master.resolutionBalancing")
         db_info = ServerDBInfo(
             epoch=master.epoch, recovery_state="accepting_commits",
             recovery_version=recovery_version, master=master.interface,

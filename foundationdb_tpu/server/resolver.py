@@ -56,6 +56,13 @@ class Resolver:
         from ..core.histogram import CounterCollection
         self.metrics = CounterCollection("Resolver", resolver_id)
         self.interface.role = self   # sim-side backref for status/tests
+        # Load sampling for resolutionBalancing (reference iops samples,
+        # Resolver.actor.cpp:191-198): every SAMPLE_EVERY'th conflict
+        # range's begin key is tallied; counts halve when the table is
+        # full, bounding memory while preserving the distribution.
+        self._ranges_since_poll = 0
+        self._sample_counts: Dict[bytes, int] = {}
+        self._sample_tick = 0
         # Accumulated state transactions for cross-proxy metadata broadcast
         # (reference :220-249): (version, origin_proxy, seq, mutations,
         # local_verdict), version-ascending; trimmed once every registered
@@ -94,6 +101,7 @@ class Resolver:
             req.transactions, req.version, new_oldest_version=new_oldest)
         self.metrics.histogram("Resolve").record(now() - _t0)
         self.metrics.counter("TxnResolved").add(len(req.transactions))
+        self._sample_batch(req.transactions)
         # Foreign state txns resolved since this proxy last heard from us
         # (strictly before this batch's version; ours are appended below).
         lrv = req.last_received_version
@@ -137,6 +145,55 @@ class Resolver:
         self.version.set(req.version)
         req.reply.send(reply)
 
+    SAMPLE_EVERY = 8
+    SAMPLE_TABLE_MAX = 4096
+
+    def _sample_batch(self, transactions) -> None:
+        for txn in transactions:
+            for r in txn.read_conflict_ranges + txn.write_conflict_ranges:
+                self._ranges_since_poll += 1
+                self._sample_tick += 1
+                if self._sample_tick % self.SAMPLE_EVERY:
+                    continue
+                c = self._sample_counts
+                c[r.begin] = c.get(r.begin, 0) + 1
+                if len(c) > self.SAMPLE_TABLE_MAX:
+                    self._sample_counts = {
+                        k: v // 2 for k, v in c.items() if v >= 2}
+
+    async def _serve_metrics(self) -> None:
+        polls = 0
+        async for req in self.interface.metrics.queue:
+            n, self._ranges_since_poll = self._ranges_since_poll, 0
+            polls += 1
+            if polls % 8 == 0:
+                # Periodic decay so splits track RECENT load, not all-time
+                # (a shifted hotspot must not be masked by history) — but
+                # slow enough that single-hit samples from unique-key
+                # workloads survive a few polls.
+                self._sample_counts = {
+                    k: v // 2 for k, v in self._sample_counts.items()
+                    if v >= 2}
+            req.reply.send(n)
+
+    async def _serve_split(self) -> None:
+        """Key splitting [begin, end)'s sampled load at `fraction`
+        (reference ResolutionSplitRequest handling)."""
+        async for req in self.interface.split.queue:
+            inside = sorted((k, v) for k, v in self._sample_counts.items()
+                            if req.begin <= k < req.end)
+            total = sum(v for _k, v in inside)
+            split_key = None
+            if total > 0:
+                acc = 0
+                for k, v in inside:
+                    acc += v
+                    if acc >= total * req.fraction:
+                        if req.begin < k < req.end:
+                            split_key = k
+                        break
+            req.reply.send(split_key)
+
     async def _serve(self) -> None:
         async for req in self.interface.resolve.queue:
             # Spawn per request: chained batches must be able to wait for
@@ -148,6 +205,8 @@ class Resolver:
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._serve(), f"{self.id}.serve")
+        process.spawn(self._serve_metrics(), f"{self.id}.resolutionMetrics")
+        process.spawn(self._serve_split(), f"{self.id}.resolutionSplit")
         process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),

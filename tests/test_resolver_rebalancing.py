@@ -1,0 +1,115 @@
+"""Resolver key-range rebalancing under a skewed workload.
+
+Reference: fdbserver/masterserver.actor.cpp:1318 resolutionBalancing +
+Resolver.actor.cpp:341-348 metrics/split endpoints.  VERDICT round-2
+done-criterion: a skewed workload moves resolver boundaries live while
+conflict verdicts stay correct — checked here via exactly-one-wins
+semantics on conflicting pairs that straddle the moved boundary, and an
+old-snapshot read that must still conflict through the PREVIOUS owner's
+window (the proxy's per-version ownership history)."""
+
+import pytest
+
+from foundationdb_tpu.core import FdbError
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+
+def make_cluster():
+    return SimFdbCluster(
+        config=DatabaseConfiguration(n_resolvers=2),
+        n_workers=6, n_storage_workers=2)
+
+
+def proxy_roles(cluster):
+    cc = cluster.current_cc()
+    return [p.role for p in cc.db_info.commit_proxies]
+
+
+def test_skewed_load_moves_boundary_with_correct_verdicts(teardown):  # noqa: F811
+    knobs = server_knobs()
+    old_min = knobs.RESOLUTION_BALANCING_MIN_LOAD
+    knobs.RESOLUTION_BALANCING_MIN_LOAD = 20
+    try:
+        c = make_cluster()
+        db = c.database()
+
+        async def go():
+            from foundationdb_tpu.core.scheduler import delay
+            # Heavy skew: all load below \x80 -> resolver 0's range.
+            moved = False
+            for round_ in range(120):
+                for i in range(10):
+                    await commit_kv(db, b"skew/%03d" % (round_ * 10 + i),
+                                    b"x")
+                proxies = proxy_roles(c)
+                if any(p._resolver_changes_hwm > 0 for p in proxies):
+                    moved = True
+                    break
+            assert moved, "no resolver boundary change was adopted"
+            # After the move: conflicting pairs still behave exactly-one-
+            # wins wherever the key lands.
+            for i in range(12):
+                key = b"skew/%03d" % (i * 17)
+                t1 = db.create_transaction()
+                t2 = db.create_transaction()
+                await t1.get(key)
+                await t2.get(key)
+                t1.set(key, b"a")
+                t2.set(key, b"b")
+                outcomes = []
+                for t in (t1, t2):
+                    try:
+                        await t.commit()
+                        outcomes.append("ok")
+                    except FdbError as e:
+                        assert e.name == "not_committed", e.name
+                        outcomes.append("conflict")
+                assert outcomes == ["ok", "conflict"], outcomes
+            await delay(0.2)
+
+        c.run_until(c.loop.spawn(go()), timeout=600)
+    finally:
+        knobs.RESOLUTION_BALANCING_MIN_LOAD = old_min
+
+
+def test_old_snapshot_conflicts_across_moved_boundary(teardown):  # noqa: F811
+    """A read at a snapshot taken BEFORE a boundary move must still see
+    conflicts recorded by the previous owner: the proxy's ownership
+    history routes the check to every in-window owner."""
+    knobs = server_knobs()
+    old_min = knobs.RESOLUTION_BALANCING_MIN_LOAD
+    knobs.RESOLUTION_BALANCING_MIN_LOAD = 20
+    try:
+        c = make_cluster()
+        db = c.database()
+
+        async def go():
+            # Old-snapshot reader: grab a read version FIRST.
+            t_old = db.create_transaction()
+            await t_old.get(b"skew/000")          # snapshot pinned now
+            # Writer commits to the key, then skewed load forces a move.
+            await commit_kv(db, b"skew/000", b"new")
+            moved = False
+            for round_ in range(120):
+                for i in range(10):
+                    await commit_kv(db, b"skew/%03d" % (round_ * 10 + i + 1),
+                                    b"x")
+                if any(p._resolver_changes_hwm > 0 for p in proxy_roles(c)):
+                    moved = True
+                    break
+            assert moved, "no boundary move happened"
+            # The old-snapshot txn now writes: its read of skew/000 at the
+            # old snapshot MUST conflict with the committed write even if
+            # skew/000's range moved to the other resolver since.
+            t_old.set(b"probe", b"1")
+            with pytest.raises(FdbError) as ei:
+                await t_old.commit()
+            assert ei.value.name in ("not_committed", "transaction_too_old")
+
+        c.run_until(c.loop.spawn(go()), timeout=600)
+    finally:
+        knobs.RESOLUTION_BALANCING_MIN_LOAD = old_min
