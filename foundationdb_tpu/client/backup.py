@@ -1,0 +1,250 @@
+"""Backup and restore: range snapshot + mutation-log capture to a file
+container, restore into an empty cluster.
+
+Reference: fdbclient/FileBackupAgent.actor.cpp (snapshot + log files into a
+BackupContainer; restore replays snapshot then logs) and
+fdbserver/BackupWorker.actor.cpp:1033 (a worker pulling mutations from the
+log system and writing partitioned log files).  The TPU-native shape:
+
+  * Activation is a TRANSACTION: submit() sets `\\xff/backupStarted`, which
+    every commit proxy applies as a metadata side effect — from that commit
+    version on, all user mutations additionally ride BACKUP_TAG.
+  * A backup worker peeks BACKUP_TAG from the log system, appends
+    (version, mutations) records to the container's log file, and pops so
+    the TLogs can trim.  One stream in exact batch order: no cross-replica
+    dedup problems, and unresolved atomic ops replay correctly.
+  * snapshot() reads the whole user keyspace in chunks at ONE read version
+    (MVCC gives consistency); restore loads the snapshot then replays log
+    records with snapshot_version < version <= end_version.
+
+Container layout on a SimFileSystem: `<name>.meta` (versions),
+`<name>.snapshot` (k/v records at snapshot_version), `<name>.log`
+((version, mutations) records), all in core/wire.py framing.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..core.error import FdbError, err
+from ..core.scheduler import delay
+from ..core.trace import TraceEvent
+from ..core.wire import Reader, Writer
+from ..txn.types import Mutation, MutationType, Version
+from ..server.system_data import BACKUP_STARTED_KEY, BACKUP_TAG
+
+
+class BackupContainer:
+    """One named backup in a simulated filesystem directory."""
+
+    def __init__(self, fs, name: str) -> None:
+        self.fs = fs
+        self.name = name
+        self._log_offset = 0
+
+    # -- writing -------------------------------------------------------------
+    async def write_meta(self, start: Version, snapshot: Version,
+                         end: Version) -> None:
+        f = self.fs.open(f"{self.name}.meta")
+        await f.truncate(0)
+        await f.write(0, Writer().i64(start).i64(snapshot).i64(end).done())
+        await f.sync()
+
+    async def read_meta(self) -> Tuple[Version, Version, Version]:
+        f = self.fs.open(f"{self.name}.meta", create=False)
+        r = Reader(await f.read(0, f.size()))
+        return r.i64(), r.i64(), r.i64()
+
+    async def write_snapshot(self, version: Version,
+                             kvs: List[Tuple[bytes, bytes]]) -> None:
+        w = Writer().i64(version).u32(len(kvs))
+        for k, v in kvs:
+            w.bytes_(k).bytes_(v)
+        f = self.fs.open(f"{self.name}.snapshot")
+        await f.truncate(0)
+        await f.write(0, w.done())
+        await f.sync()
+
+    async def read_snapshot(self) -> Tuple[Version, List]:
+        f = self.fs.open(f"{self.name}.snapshot", create=False)
+        r = Reader(await f.read(0, f.size()))
+        version = r.i64()
+        kvs = [(r.bytes_(), r.bytes_()) for _ in range(r.u32())]
+        return version, kvs
+
+    async def append_log(self, version: Version,
+                         mutations: List[Mutation]) -> None:
+        w = Writer().i64(version).u32(len(mutations))
+        for m in mutations:
+            w.u8(int(m.type)).bytes_(m.param1).bytes_(m.param2)
+        blob = w.done()
+        f = self.fs.open(f"{self.name}.log")
+        await f.write(self._log_offset, Writer().u32(len(blob)).done() + blob)
+        self._log_offset += 4 + len(blob)
+        await f.sync()
+
+    async def read_log(self) -> List[Tuple[Version, List[Mutation]]]:
+        f = self.fs.open(f"{self.name}.log", create=False)
+        data = await f.read(0, f.size())
+        out = []
+        off = 0
+        while off + 4 <= len(data):
+            (n,) = (int.from_bytes(data[off:off + 4], "little"),)
+            if off + 4 + n > len(data):
+                break   # torn tail (backup stopped uncleanly)
+            r = Reader(data[off + 4:off + 4 + n])
+            version = r.i64()
+            muts = [Mutation(MutationType(r.u8()), r.bytes_(), r.bytes_())
+                    for _ in range(r.u32())]
+            out.append((version, muts))
+            off += 4 + n
+        return out
+
+
+class FileBackupAgent:
+    """Drives one backup of a simulated cluster (reference BackupAgent)."""
+
+    def __init__(self, cluster, db, fs, name: str = "backup") -> None:
+        self.cluster = cluster
+        self.db = db
+        self.container = BackupContainer(fs, name)
+        self.start_version: Version = 0
+        self.snapshot_version: Version = 0
+        self.end_version: Version = 0
+        self._worker_f = None
+        self._worker_stop = False
+        self._frontier: Version = 0   # highest log-system version seen
+
+    async def _set_backup_flag(self, on: bool) -> Version:
+        t = self.db.create_transaction()
+        t.access_system_keys = True
+        while True:
+            try:
+                t.set(BACKUP_STARTED_KEY, b"1" if on else b"0")
+                return await t.commit()
+            except FdbError as e:
+                await t.on_error(e)
+
+    async def _backup_worker(self) -> None:
+        """Pull BACKUP_TAG and append log records (reference
+        BackupWorker.actor.cpp:1033 pull loop)."""
+        fetch_from = self.start_version + 1
+        while True:
+            cc = self.cluster.current_cc()
+            info = cc.db_info if cc is not None else None
+            if info is None or not info.tlogs:
+                await delay(0.2)
+                continue
+            from ..server.commit_proxy import LogSystemClient
+            ls = LogSystemClient(info.tlogs, getattr(
+                self.cluster.config, "log_replication", 1))
+            try:
+                reply = await ls.peek_tag(BACKUP_TAG, fetch_from)
+            except FdbError:
+                await delay(0.2)
+                continue
+            for version, msgs in reply.messages:
+                if version >= fetch_from:
+                    await self.container.append_log(version, msgs)
+                    self.end_version = max(self.end_version, version)
+            self._frontier = max(self._frontier, reply.max_known_version)
+            if reply.messages:
+                last = reply.messages[-1][0]
+                fetch_from = max(fetch_from, last + 1)
+                ls.pop(BACKUP_TAG, last)
+            elif self._worker_stop:
+                return
+            else:
+                await delay(0.05)
+
+    async def submit(self) -> None:
+        """Activate mutation capture, then write a consistent snapshot
+        (ongoing writes land in the log stream meanwhile)."""
+        self.start_version = await self._set_backup_flag(True)
+        self.end_version = self.start_version
+        self._worker_f = self.cluster.loop.spawn(
+            self._backup_worker(), "backupWorker")
+        # Chunked full-range snapshot at one read version.
+        t = self.db.create_transaction()
+        while True:
+            try:
+                kvs = []
+                cursor = b""
+                while True:
+                    chunk = await t.get_range(cursor, b"\xff", limit=1000)
+                    kvs.extend(chunk)
+                    if len(chunk) < 1000:
+                        break
+                    cursor = chunk[-1][0] + b"\x00"
+                self.snapshot_version = (await t.get_read_version()).version
+                break
+            except FdbError as e:
+                await t.on_error(e)
+        await self.container.write_snapshot(self.snapshot_version, kvs)
+        TraceEvent("BackupSnapshotDone").detail(
+            "Keys", len(kvs)).detail("Version", self.snapshot_version).log()
+
+    async def stop(self) -> Version:
+        """Deactivate capture and drain the worker; the backup restores to
+        any state up to the returned end version."""
+        stop_version = await self._set_backup_flag(False)
+        # Drain: the worker's view of the log stream must pass the stop
+        # commit (end_version only advances on captured mutations; the
+        # frontier advances on every peek).
+        while self._frontier < stop_version:
+            await delay(0.05)
+        self.end_version = max(self.end_version, stop_version)
+        self._worker_stop = True
+        await self._worker_f
+        await self.container.write_meta(self.start_version,
+                                        self.snapshot_version,
+                                        self.end_version)
+        TraceEvent("BackupComplete").detail(
+            "Start", self.start_version).detail(
+            "Snapshot", self.snapshot_version).detail(
+            "End", self.end_version).log()
+        return self.end_version
+
+
+async def restore(db, fs, name: str = "backup") -> int:
+    """Restore a container into an (empty) cluster: snapshot state, then
+    log replay for versions after the snapshot (reference FileBackupAgent
+    restore tasks).  Returns the number of restored mutations."""
+    container = BackupContainer(fs, name)
+    _start, snapshot_version, end_version = await container.read_meta()
+    sv, kvs = await container.read_snapshot()
+    applied = 0
+    # Snapshot in chunked transactions.
+    for i in range(0, len(kvs), 500):
+        t = db.create_transaction()
+        while True:
+            try:
+                for k, v in kvs[i:i + 500]:
+                    t.set(k, v)
+                await t.commit()
+                applied += min(500, len(kvs) - i)
+                break
+            except FdbError as e:
+                await t.on_error(e)
+    # Log replay in version order, preserving intra-version mutation order.
+    for version, muts in await container.read_log():
+        if not sv < version <= end_version:
+            continue
+        t = db.create_transaction()
+        while True:
+            try:
+                for m in muts:
+                    if m.type == MutationType.SetValue:
+                        t.set(m.param1, m.param2)
+                    elif m.type == MutationType.ClearRange:
+                        t.clear(m.param1, m.param2)
+                    else:
+                        t.atomic_op(m.type, m.param1, m.param2)
+                await t.commit()
+                applied += len(muts)
+                break
+            except FdbError as e:
+                await t.on_error(e)
+    TraceEvent("RestoreComplete").detail("Snapshot", len(kvs)).detail(
+        "Mutations", applied).log()
+    return applied

@@ -146,6 +146,9 @@ class CommitProxy:
         self.metrics = CounterCollection("CommitProxy", proxy_id)
         self.interface.role = self   # sim-side backref for status/tests
         self.broken = False   # set on mid-batch infrastructure failure
+        # While a backup is active (\xff/backupStarted set), every user
+        # mutation additionally rides BACKUP_TAG for the backup worker.
+        self.backup_active = False
         # Exactly-once cursor over foreign state transactions (version,
         # origin proxy, seq); see _apply_foreign_state.
         self._state_hwm: Tuple[Version, str, int] = (-1, "", -1)
@@ -396,6 +399,23 @@ class CommitProxy:
                 index_maps[idx].append(t_idx)
         return requests, index_maps
 
+    def _apply_metadata(self, m: Mutation) -> bool:
+        """Side effects of one committed \xff mutation on this proxy
+        (reference ApplyMetadataMutation.cpp): shard-map boundaries and the
+        backup-active flag.  True if the mutation was metadata."""
+        from .system_data import (BACKUP_STARTED_KEY,
+                                  apply_key_servers_mutation)
+        handled = apply_key_servers_mutation(self.key_servers, m)
+        if m.type == MutationType.SetValue and \
+                m.param1 == BACKUP_STARTED_KEY:
+            self.backup_active = m.param2 == b"1"
+            handled = True
+        elif m.type == MutationType.ClearRange and \
+                m.param1 <= BACKUP_STARTED_KEY < m.param2:
+            self.backup_active = False
+            handled = True
+        return handled
+
     def _apply_foreign_state(self, resolutions) -> None:
         """Apply other proxies' committed metadata mutations to this
         proxy's shard map (reference applyMetadataEffect :737): every
@@ -404,7 +424,6 @@ class CommitProxy:
         (version, origin, seq) order exactly once — a high-water mark
         guards against re-delivery from pipelined batches whose
         last_received_version lagged."""
-        from .system_data import apply_key_servers_mutation
         merged: Dict[Tuple[Version, str, int], List] = {}
         for reply in resolutions:
             for version, origin, seq, mutations, verdict in \
@@ -423,7 +442,7 @@ class CommitProxy:
             if verdict != CommitResult.COMMITTED:
                 continue
             for m in mutations:
-                apply_key_servers_mutation(self.key_servers, m)
+                self._apply_metadata(m)
 
     def _determine_committed(self, batch, index_maps, resolutions
                              ) -> List[CommitResult]:
@@ -446,8 +465,7 @@ class CommitProxy:
             self, batch: List[CommitTransactionRequest],
             verdicts: List[CommitResult], commit_version: Version
     ) -> Dict[Tag, List[Mutation]]:
-        from .system_data import (SYSTEM_KEYS_BEGIN, TXS_TAG,
-                                  apply_key_servers_mutation)
+        from .system_data import BACKUP_TAG, SYSTEM_KEYS_BEGIN, TXS_TAG
         messages: Dict[Tag, List[Mutation]] = {}
         for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
             if verdict != CommitResult.COMMITTED:
@@ -479,8 +497,19 @@ class CommitProxy:
                 if m.param1 >= SYSTEM_KEYS_BEGIN or (
                         m.type == MutationType.ClearRange
                         and m.param2 > SYSTEM_KEYS_BEGIN):
-                    if apply_key_servers_mutation(self.key_servers, m):
+                    if self._apply_metadata(m):
                         messages.setdefault(TXS_TAG, []).append(m)
+                if self.backup_active and m.param1 < SYSTEM_KEYS_BEGIN:
+                    # Active backup: the user-space portion of every
+                    # mutation rides BACKUP_TAG (post versionstamp
+                    # transform); a clear spanning into \xff is clipped to
+                    # its user part so restores still see the deletion.
+                    bm = m
+                    if m.type == MutationType.ClearRange and \
+                            m.param2 > SYSTEM_KEYS_BEGIN:
+                        bm = Mutation(MutationType.ClearRange, m.param1,
+                                      SYSTEM_KEYS_BEGIN)
+                    messages.setdefault(BACKUP_TAG, []).append(bm)
                 if m.type == MutationType.ClearRange:
                     # A clear can span shards: clip per intersecting shard
                     # so each storage team gets only its part (:980-1010).

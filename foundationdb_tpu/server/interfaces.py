@@ -489,6 +489,7 @@ class InitializeCommitProxyRequest:
     key_servers_ranges: List[Tuple[bytes, bytes, List[Tag]]]
     storage_interfaces: Dict[Tag, Any]
     recovery_version: Version
+    backup_active: bool = False
     reply: Any = None     # -> CommitProxyInterface
 
 

@@ -199,6 +199,7 @@ class DBCoreState:
     # TXS_TAG metadata deltas with version > map_version on top of it
     # (reference: txnStateStore recovered from the txsTag stream).
     map_version: Version = 0
+    backup_active: bool = False
     # Durable identities mirroring the interface lists: live interface
     # objects don't survive a power failure, so pack() stores ids and the
     # rebooted master re-resolves them against worker-recovered roles
@@ -211,6 +212,7 @@ class DBCoreState:
         from ..core.wire import Writer
         w = Writer().u32(self.epoch).i64(self.recovery_version)
         w.i64(self.map_version)
+        w.u8(1 if self.backup_active else 0)
         w.u8(self.log_replication).u8(self.n_resolvers)
         tlog_ids = self.tlog_ids or [t.id for t in self.tlogs]
         w.u16(len(tlog_ids))
@@ -243,6 +245,7 @@ class DBCoreState:
         r = Reader(blob)
         epoch, rv = r.u32(), r.i64()
         map_version = r.i64()
+        backup_active = r.u8() != 0
         log_rep, n_res = r.u8(), r.u8()
         tlog_ids = [r.str_() for _ in range(r.u16())]
         storage_ids = {r.u32(): r.str_() for _ in range(r.u16())}
@@ -256,7 +259,7 @@ class DBCoreState:
                    storage_servers={t: None for t in storage_ids},
                    key_servers_ranges=ranges, n_resolvers=n_res,
                    tlog_ids=tlog_ids, storage_ids=storage_ids,
-                   map_version=map_version)
+                   map_version=map_version, backup_active=backup_active)
 
 
 def _split_points(n: int) -> List[bytes]:
@@ -395,6 +398,11 @@ async def master_server(master: Master, process, coordinators,
                 raise err("master_recovery_failed", "no old TLogs reachable")
             # Every tag needs a live holder; any team member suffices.
             all_tags = set(prev.storage_servers.keys())
+            if prev.backup_active:
+                # Un-pulled backup-stream data must survive the epoch
+                # change or the log capture would have a hole.
+                from .system_data import BACKUP_TAG
+                all_tags.add(BACKUP_TAG)
             for tag in all_tags:
                 holder = next((i for i in old_ls.team_for_tag(tag)
                                if i in locked), None)
@@ -429,11 +437,24 @@ async def master_server(master: Master, process, coordinators,
             txs = await RequestStream.at(
                 old_tlogs[txs_holder].peek.endpoint).get_reply(
                 TLogPeekRequest(tag=TXS_TAG, begin=prev.map_version + 1))
+            from .system_data import BACKUP_STARTED_KEY
+            from ..txn.types import MutationType as _MT
             n_deltas = 0
             for v, msgs in txs.messages:
                 if prev.map_version < v <= recovery_version:
                     for m in msgs:
-                        apply_key_servers_mutation(map_rm, m)
+                        if m.type == _MT.SetValue and \
+                                m.param1 == BACKUP_STARTED_KEY:
+                            prev.backup_active = m.param2 == b"1"
+                        else:
+                            # A clear can span backupStarted AND the
+                            # keyServers range: apply BOTH effects, like
+                            # the proxies' _apply_metadata did at commit.
+                            if m.type == _MT.ClearRange and \
+                                    m.param1 <= BACKUP_STARTED_KEY \
+                                    < m.param2:
+                                prev.backup_active = False
+                            apply_key_servers_mutation(map_rm, m)
                         n_deltas += 1
             if n_deltas:
                 TraceEvent("MasterTxnStateReplayed").detail(
@@ -557,7 +578,8 @@ async def master_server(master: Master, process, coordinators,
                 key_resolvers_ranges=key_resolvers_ranges,
                 key_servers_ranges=key_servers_ranges,
                 storage_interfaces=storage_servers,
-                recovery_version=recovery_version))
+                recovery_version=recovery_version,
+                backup_active=prev.backup_active if prev else False))
             for i in range(config.n_commit_proxies)]
         grv_proxy_futures = [RequestStream.at(
             pick(i + 1).init_grv_proxy.endpoint).get_reply(
@@ -579,7 +601,8 @@ async def master_server(master: Master, process, coordinators,
             storage_servers=storage_servers,
             key_servers_ranges=key_servers_ranges,
             n_resolvers=config.n_resolvers,
-            map_version=recovery_version))
+            map_version=recovery_version,
+            backup_active=prev.backup_active if prev else False))
 
         # ACCEPTING_COMMITS (:1943): start the allocator + announce.
         adopt(master._serve_commit_versions(), "master.serveVersions")

@@ -158,8 +158,7 @@ class Resolver:
                 c = self._sample_counts
                 c[r.begin] = c.get(r.begin, 0) + 1
                 if len(c) > self.SAMPLE_TABLE_MAX:
-                    self._sample_counts = {
-                        k: v // 2 for k, v in c.items() if v >= 2}
+                    self._decay_samples()
 
     async def _serve_metrics(self) -> None:
         polls = 0
@@ -171,10 +170,13 @@ class Resolver:
                 # (a shifted hotspot must not be masked by history) — but
                 # slow enough that single-hit samples from unique-key
                 # workloads survive a few polls.
-                self._sample_counts = {
-                    k: v // 2 for k, v in self._sample_counts.items()
-                    if v >= 2}
+                self._decay_samples()
             req.reply.send(n)
+
+    def _decay_samples(self) -> None:
+        self._sample_counts = {k: v // 2
+                               for k, v in self._sample_counts.items()
+                               if v >= 2}
 
     async def _serve_split(self) -> None:
         """Key splitting [begin, end)'s sampled load at `fraction`
@@ -188,9 +190,12 @@ class Resolver:
                 acc = 0
                 for k, v in inside:
                     acc += v
-                    if acc >= total * req.fraction:
-                        if req.begin < k < req.end:
-                            split_key = k
+                    # Walk past the fraction point to the first VALID
+                    # split key: a head-heavy range whose first key holds
+                    # the mass must still split (at the next sample).
+                    if acc >= total * req.fraction and \
+                            req.begin < k < req.end:
+                        split_key = k
                         break
             req.reply.send(split_key)
 

@@ -27,6 +27,12 @@ SYSTEM_KEYS_END = b"\xff\xff"
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 KEY_SERVERS_END = b"\xff/keyServers0"
 SERVER_LIST_PREFIX = b"\xff/serverList/"
+BACKUP_STARTED_KEY = b"\xff/backupStarted"
+
+# All user mutations additionally ride this tag while a backup is active
+# (reference: backup workers pull dedicated backup tags from the log
+# system, BackupWorker.actor.cpp:1033).  Must fit the wire u32.
+BACKUP_TAG: Tag = 0xFFFFFFFD
 
 
 def key_servers_key(key: bytes) -> bytes:

@@ -139,6 +139,7 @@ class Worker:
                                 replication=self._log_replication()),
                 key_resolvers, key_servers, req.storage_interfaces,
                 req.recovery_version)
+            proxy.backup_active = req.backup_active
             proxy.run(self.process)
             req.reply.send(proxy.interface)
 
