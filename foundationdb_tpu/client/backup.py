@@ -193,7 +193,11 @@ class FileBackupAgent:
         # frontier advances on every peek).
         while self._frontier < stop_version:
             await delay(0.05)
-        self.end_version = max(self.end_version, stop_version)
+        # A user transaction batched AFTER the flag-off mutation shares
+        # commit version stop_version but is not captured; the backup only
+        # claims coverage through stop_version - 1.
+        self.end_version = max(min(self.end_version, stop_version - 1),
+                               self.snapshot_version)
         self._worker_stop = True
         await self._worker_f
         await self.container.write_meta(self.start_version,
@@ -226,13 +230,20 @@ async def restore(db, fs, name: str = "backup") -> int:
                 break
             except FdbError as e:
                 await t.on_error(e)
-    # Log replay in version order, preserving intra-version mutation order.
-    for version, muts in await container.read_log():
+    # Log replay in version order, preserving intra-version mutation
+    # order.  Each record's transaction also writes a progress marker so a
+    # commit_unknown_result can be disambiguated instead of re-applying
+    # (atomic ops are not idempotent).
+    progress_key = b"\xff/restoreProgress/" + name.encode()
+    for idx, (version, muts) in enumerate(await container.read_log()):
         if not sv < version <= end_version:
             continue
+        marker = b"%020d" % idx
         t = db.create_transaction()
+        t.access_system_keys = True
         while True:
             try:
+                t.set(progress_key, marker)
                 for m in muts:
                     if m.type == MutationType.SetValue:
                         t.set(m.param1, m.param2)
@@ -244,7 +255,31 @@ async def restore(db, fs, name: str = "backup") -> int:
                 applied += len(muts)
                 break
             except FdbError as e:
+                if e.name == "commit_unknown_result":
+                    check = db.create_transaction()
+                    check.access_system_keys = True
+                    while True:
+                        try:
+                            seen = await check.get(progress_key)
+                            break
+                        except FdbError as e2:
+                            await check.on_error(e2)
+                    if seen == marker:
+                        applied += len(muts)
+                        break
+                    t.reset()
+                    continue
                 await t.on_error(e)
+    # Drop the marker so the restored keyspace matches the source.
+    t = db.create_transaction()
+    t.access_system_keys = True
+    while True:
+        try:
+            t.clear(progress_key)
+            await t.commit()
+            break
+        except FdbError as e:
+            await t.on_error(e)
     TraceEvent("RestoreComplete").detail("Snapshot", len(kvs)).detail(
         "Mutations", applied).log()
     return applied

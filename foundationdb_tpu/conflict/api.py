@@ -75,10 +75,6 @@ def new_conflict_set(backend: Optional[str] = None,
         from .tpu_backend import TpuConflictSet
         return TpuConflictSet(oldest_version, **kwargs)
     if backend == "native":
-        try:
-            from .native import NativeConflictSet
-        except ImportError as e:
-            raise ValueError(
-                "native conflict backend not built (see cpp/)") from e
+        from .native import NativeConflictSet
         return NativeConflictSet(oldest_version)
     raise ValueError(f"unknown conflict set backend {backend!r}")

@@ -1,0 +1,89 @@
+"""Native C++ conflict-set backend (ctypes over a C ABI).
+
+The CPU performance baseline the reference implements as
+fdbserver/SkipList.cpp — here an ordered-boundary-map formulation compiled
+from conflict/native_src/conflict.cpp, loaded via ctypes (no pybind11 in
+this image).  Builds lazily with g++ on first use and caches the shared
+object next to the source; decisions are bit-identical to the Python
+oracle (randomized parity in tests/test_conflict_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+from ..core.wire import Writer
+from ..txn.types import CommitResult, CommitTransactionRef, Version
+from .api import ConflictSet
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "native_src")
+_SRC = os.path.join(_SRC_DIR, "conflict.cpp")
+_SO = os.path.join(_SRC_DIR, "libconflict.so")
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO) or \
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+             _SRC, "-o", _SO],
+            check=True, capture_output=True)
+    lib = ctypes.CDLL(_SO)
+    lib.cs_new.restype = ctypes.c_void_p
+    lib.cs_new.argtypes = [ctypes.c_int64]
+    lib.cs_free.argtypes = [ctypes.c_void_p]
+    lib.cs_segment_count.restype = ctypes.c_int64
+    lib.cs_segment_count.argtypes = [ctypes.c_void_p]
+    lib.cs_resolve.restype = ctypes.c_int
+    lib.cs_resolve.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int64, ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+class NativeConflictSet(ConflictSet):
+    def __init__(self, oldest_version: Version = 0) -> None:
+        super().__init__(oldest_version)
+        self._lib = _load()
+        self._h = self._lib.cs_new(oldest_version)
+
+    def __del__(self):  # noqa: D105
+        if getattr(self, "_h", None):
+            self._lib.cs_free(self._h)
+            self._h = None
+
+    def resolve(self, transactions: Sequence[CommitTransactionRef],
+                now: Version,
+                new_oldest_version: Optional[Version] = None
+                ) -> List[CommitResult]:
+        new_floor = max(new_oldest_version or self.oldest_version,
+                        self.oldest_version)
+        w = Writer().i64(now).i64(new_floor).u32(len(transactions))
+        for t in transactions:
+            w.i64(t.read_snapshot)
+            reads = [r for r in t.read_conflict_ranges if r.begin < r.end]
+            w.u32(len(reads))
+            for r in reads:
+                w.bytes_(r.begin).bytes_(r.end)
+            writes = [x for x in t.write_conflict_ranges if x.begin < x.end]
+            w.u32(len(writes))
+            for x in writes:
+                w.bytes_(x.begin).bytes_(x.end)
+        req = w.done()
+        out = ctypes.create_string_buffer(max(len(transactions), 1))
+        rc = self._lib.cs_resolve(self._h, req, len(req), out)
+        if rc != 0:
+            raise RuntimeError(f"native cs_resolve failed: {rc}")
+        self.oldest_version = new_floor
+        return [CommitResult(b) for b in out.raw[:len(transactions)]]
+
+    def segment_count(self) -> int:
+        return int(self._lib.cs_segment_count(self._h))

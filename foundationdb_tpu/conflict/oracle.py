@@ -5,7 +5,7 @@ resolver backend).  Where the reference uses a skip list of keys with
 per-level max versions (fdbserver/SkipList.cpp), we store the equivalent
 piecewise-constant version function directly: a sorted list of boundary keys
 with the version of the segment starting at each boundary.  Same decisions,
-simpler invariants; the native C++ backend (native/) is the performance CPU
+simpler invariants; the native C++ backend (native.py + native_src/) is the performance CPU
 path, this one is the readable truth.
 """
 

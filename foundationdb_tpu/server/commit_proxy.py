@@ -34,6 +34,8 @@ from .interfaces import (CommitID, CommitProxyInterface,
                          TLogCommitRequest)
 from .notified import NotifiedVersion
 from .shardmap import RangeMap
+from .system_data import (BACKUP_TAG, SYSTEM_KEYS_BEGIN, TXS_TAG,
+                          apply_metadata_mutation)
 
 
 class LogSystemClient:
@@ -403,17 +405,9 @@ class CommitProxy:
         """Side effects of one committed \xff mutation on this proxy
         (reference ApplyMetadataMutation.cpp): shard-map boundaries and the
         backup-active flag.  True if the mutation was metadata."""
-        from .system_data import (BACKUP_STARTED_KEY,
-                                  apply_key_servers_mutation)
-        handled = apply_key_servers_mutation(self.key_servers, m)
-        if m.type == MutationType.SetValue and \
-                m.param1 == BACKUP_STARTED_KEY:
-            self.backup_active = m.param2 == b"1"
-            handled = True
-        elif m.type == MutationType.ClearRange and \
-                m.param1 <= BACKUP_STARTED_KEY < m.param2:
-            self.backup_active = False
-            handled = True
+        handled, backup_flag = apply_metadata_mutation(self.key_servers, m)
+        if backup_flag is not None:
+            self.backup_active = backup_flag
         return handled
 
     def _apply_foreign_state(self, resolutions) -> None:
@@ -465,7 +459,6 @@ class CommitProxy:
             self, batch: List[CommitTransactionRequest],
             verdicts: List[CommitResult], commit_version: Version
     ) -> Dict[Tag, List[Mutation]]:
-        from .system_data import BACKUP_TAG, SYSTEM_KEYS_BEGIN, TXS_TAG
         messages: Dict[Tag, List[Mutation]] = {}
         for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
             if verdict != CommitResult.COMMITTED:

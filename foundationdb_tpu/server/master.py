@@ -437,25 +437,29 @@ async def master_server(master: Master, process, coordinators,
             txs = await RequestStream.at(
                 old_tlogs[txs_holder].peek.endpoint).get_reply(
                 TLogPeekRequest(tag=TXS_TAG, begin=prev.map_version + 1))
-            from .system_data import BACKUP_STARTED_KEY
-            from ..txn.types import MutationType as _MT
+            from .system_data import apply_metadata_mutation
             n_deltas = 0
             for v, msgs in txs.messages:
                 if prev.map_version < v <= recovery_version:
                     for m in msgs:
-                        if m.type == _MT.SetValue and \
-                                m.param1 == BACKUP_STARTED_KEY:
-                            prev.backup_active = m.param2 == b"1"
-                        else:
-                            # A clear can span backupStarted AND the
-                            # keyServers range: apply BOTH effects, like
-                            # the proxies' _apply_metadata did at commit.
-                            if m.type == _MT.ClearRange and \
-                                    m.param1 <= BACKUP_STARTED_KEY \
-                                    < m.param2:
-                                prev.backup_active = False
-                            apply_key_servers_mutation(map_rm, m)
+                        _h, backup_flag = apply_metadata_mutation(map_rm, m)
+                        if backup_flag is not None:
+                            prev.backup_active = backup_flag
                         n_deltas += 1
+            # The flag may have turned ON since the durable snapshot: the
+            # old generation's un-pulled backup stream must still carry
+            # over or the capture would have a hole (the pre-lock check
+            # used the STALE flag).
+            from .system_data import BACKUP_TAG
+            if prev.backup_active and BACKUP_TAG not in old_tag_holders:
+                holder = next((i for i in old_ls.team_for_tag(BACKUP_TAG)
+                               if i in locked), None)
+                if holder is None:
+                    raise err("master_recovery_failed",
+                              "backup tag has no surviving TLog holder")
+                old_tag_holders[BACKUP_TAG] = old_tlogs[holder]
+                old_popped[BACKUP_TAG] = locked[holder].tags.get(
+                    BACKUP_TAG, 0)
             if n_deltas:
                 TraceEvent("MasterTxnStateReplayed").detail(
                     "Deltas", n_deltas).detail(

@@ -57,6 +57,25 @@ def is_system_key(key: bytes) -> bool:
     return key >= SYSTEM_KEYS_BEGIN
 
 
+def apply_metadata_mutation(key_servers: RangeMap, m: Mutation):
+    """Interpret one committed \xff mutation (the shared core of
+    ApplyMetadataMutation.cpp): updates the shard map in place and reports
+    any backup-flag change.  Returns (handled, backup_flag) where
+    backup_flag is None (unchanged) or the new bool value.  Used by BOTH
+    the commit proxies at commit time and the master's recovery replay —
+    one interpretation, no divergence."""
+    backup_flag = None
+    handled = apply_key_servers_mutation(key_servers, m)
+    if m.type == MutationType.SetValue and m.param1 == BACKUP_STARTED_KEY:
+        backup_flag = m.param2 == b"1"
+        handled = True
+    elif m.type == MutationType.ClearRange and \
+            m.param1 <= BACKUP_STARTED_KEY < m.param2:
+        backup_flag = False
+        handled = True
+    return handled, backup_flag
+
+
 def apply_key_servers_mutation(key_servers: RangeMap, m: Mutation) -> bool:
     """Apply one committed `\\xff/keyServers/` mutation to a shard map.
 

@@ -1,0 +1,47 @@
+"""Native C++ conflict backend: randomized parity vs the Python oracle
+(the same contract the TPU backend holds; reference SkipList.cpp)."""
+
+import shutil
+
+import pytest
+
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.core import DeterministicRandom
+
+from test_conflict_oracle import make_domain, random_txn
+from test_conflict_tpu import random_point_txn
+
+needs_gxx = pytest.mark.skipif(shutil.which("g++") is None,
+                               reason="no g++ toolchain")
+
+
+@needs_gxx
+@pytest.mark.parametrize("seed", [41, 42, 43, 44])
+def test_native_matches_oracle_random(seed):
+    from foundationdb_tpu.conflict.native import NativeConflictSet
+    rng = DeterministicRandom(seed)
+    domain = make_domain()
+    oracle = OracleConflictSet(0)
+    native = NativeConflictSet(0)
+    now = 0
+    for i in range(60):
+        now += rng.random_int(1, 2_000_000)
+        if i % 2:
+            batch = [random_point_txn(rng, 12, now, 4_000_000)
+                     for _ in range(rng.random_int(1, 24))]
+        else:
+            batch = [random_txn(rng, domain, now, 4_000_000)
+                     for _ in range(rng.random_int(1, 10))]
+        new_oldest = now - 5_000_000 if rng.coinflip() else None
+        got = native.resolve(batch, now, new_oldest)
+        want = oracle.resolve(batch, now, new_oldest)
+        assert got == want, f"native divergence at batch {i} (now={now})"
+    assert native.segment_count() >= 1
+
+
+@needs_gxx
+def test_native_backend_selector():
+    from foundationdb_tpu.conflict.api import new_conflict_set
+    from foundationdb_tpu.conflict.native import NativeConflictSet
+    cs = new_conflict_set("native", oldest_version=0)
+    assert isinstance(cs, NativeConflictSet)
