@@ -243,6 +243,14 @@ async def restore(db, fs, name: str = "backup") -> int:
         t.access_system_keys = True
         while True:
             try:
+                # Read the marker INSIDE this attempt: it both resolves a
+                # prior commit_unknown_result AND adds a read conflict
+                # range, so a late-landing earlier attempt forces
+                # not_committed here instead of double-applying.
+                seen = await t.get(progress_key)
+                if seen == marker:
+                    applied += len(muts)
+                    break
                 t.set(progress_key, marker)
                 for m in muts:
                     if m.type == MutationType.SetValue:
@@ -255,20 +263,6 @@ async def restore(db, fs, name: str = "backup") -> int:
                 applied += len(muts)
                 break
             except FdbError as e:
-                if e.name == "commit_unknown_result":
-                    check = db.create_transaction()
-                    check.access_system_keys = True
-                    while True:
-                        try:
-                            seen = await check.get(progress_key)
-                            break
-                        except FdbError as e2:
-                            await check.on_error(e2)
-                    if seen == marker:
-                        applied += len(muts)
-                        break
-                    t.reset()
-                    continue
                 await t.on_error(e)
     # Drop the marker so the restored keyspace matches the source.
     t = db.create_transaction()

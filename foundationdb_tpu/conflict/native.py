@@ -5,7 +5,10 @@ fdbserver/SkipList.cpp — here an ordered-boundary-map formulation compiled
 from conflict/native_src/conflict.cpp, loaded via ctypes (no pybind11 in
 this image).  Builds lazily with g++ on first use and caches the shared
 object next to the source; decisions are bit-identical to the Python
-oracle (randomized parity in tests/test_conflict_native.py).
+oracle for well-formed conflict ranges (randomized parity in
+tests/test_conflict_native.py); degenerate ranges (begin >= end) are
+dropped before encoding, with the unfiltered read presence preserved for
+the too-old classification.
 """
 
 from __future__ import annotations
@@ -70,6 +73,10 @@ class NativeConflictSet(ConflictSet):
         for t in transactions:
             w.i64(t.read_snapshot)
             reads = [r for r in t.read_conflict_ranges if r.begin < r.end]
+            # Unfiltered read presence: the too-old classification counts a
+            # txn as reading even if all its ranges are degenerate (the
+            # oracle/api.py contract).
+            w.u8(1 if t.read_conflict_ranges else 0)
             w.u32(len(reads))
             for r in reads:
                 w.bytes_(r.begin).bytes_(r.end)

@@ -11,8 +11,9 @@
 //
 // Batch request layout (all little-endian):
 //   i64 now; i64 new_oldest; u32 n_txns;
-//   per txn: i64 snapshot; u32 n_reads; per read:  u32 blen,b / u32 elen,e
-//                          u32 n_writes; per write: u32 blen,b / u32 elen,e
+//   per txn: i64 snapshot; u8 has_reads;
+//            u32 n_reads;  per read:  u32 blen,b / u32 elen,e
+//            u32 n_writes; per write: u32 blen,b / u32 elen,e
 // Reply: one byte per txn (CommitResult: 0 conflict, 1 too-old, 2 committed).
 
 #include <cstdint>
@@ -117,11 +118,13 @@ int cs_resolve(void* h, const uint8_t* req, int64_t req_len, uint8_t* out) {
     struct Range { Key b, e; };
     struct Txn {
         int64_t snapshot;
+        bool has_reads;
         std::vector<Range> reads, writes;
     };
     std::vector<Txn> txns(n_txns);
     for (uint32_t t = 0; t < n_txns; t++) {
         txns[t].snapshot = rd_i64(p);
+        txns[t].has_reads = *p++ != 0;
         uint32_t nr = rd_u32(p);
         txns[t].reads.resize(nr);
         for (uint32_t i = 0; i < nr; i++) {
@@ -170,7 +173,7 @@ int cs_resolve(void* h, const uint8_t* req, int64_t req_len, uint8_t* out) {
     for (uint32_t t = 0; t < n_txns; t++) {
         const Txn& txn = txns[t];
         uint8_t verdict = 2;  // committed
-        if (!txn.reads.empty() && txn.snapshot < cs.oldest) {
+        if (txn.has_reads && txn.snapshot < cs.oldest) {
             verdict = 1;  // too old (SkipList.cpp:826)
         } else {
             for (const Range& r : txn.reads) {

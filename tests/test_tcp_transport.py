@@ -1,0 +1,91 @@
+"""Real TCP transport over two OS processes.
+
+Reference: fdbrpc/FlowTransport.actor.cpp — token-addressed delivery over
+real sockets with a version handshake.  The server process hosts a durable
+KV service (KVStoreMemory semantics, in-memory here); the client (this
+test process) round-trips sets/gets through the wire format across a real
+process boundary."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SERVER = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+from foundationdb_tpu.rpc.transport import (TcpTransport, TOKEN_KV_GET,
+                                            TOKEN_KV_SET, pack_value_reply,
+                                            unpack_kv_set)
+from foundationdb_tpu.core.wire import Reader
+
+store = {}
+t = TcpTransport("127.0.0.1", 0)
+
+def do_set(payload):
+    k, v = unpack_kv_set(payload)
+    store[k] = v
+    return pack_value_reply(b"ok")
+
+def do_get(payload):
+    k = Reader(payload).bytes_()
+    return pack_value_reply(store.get(k))
+
+t.register(TOKEN_KV_SET, do_set)
+t.register(TOKEN_KV_GET, do_get)
+print("PORT %%d" %% t.address[1], flush=True)
+import time
+while True:
+    time.sleep(1)
+"""
+
+
+def test_kv_roundtrip_across_os_processes():
+    proc = subprocess.Popen(
+        [sys.executable, "-c", SERVER % {"repo": REPO}],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+
+        from foundationdb_tpu.rpc.transport import (
+            TcpTransport, TOKEN_KV_GET, TOKEN_KV_SET, pack_kv_get,
+            pack_kv_set, unpack_value_reply)
+        client = TcpTransport("127.0.0.1", 0)
+        addr = ("127.0.0.1", port)
+        try:
+            for i in range(50):
+                r = client.request(addr, TOKEN_KV_SET,
+                                   pack_kv_set(b"k%03d" % i, b"v%03d" % i))
+                assert unpack_value_reply(r) == b"ok"
+            for i in range(50):
+                r = client.request(addr, TOKEN_KV_GET,
+                                   pack_kv_get(b"k%03d" % i))
+                assert unpack_value_reply(r) == b"v%03d" % i
+            r = client.request(addr, TOKEN_KV_GET, pack_kv_get(b"missing"))
+            assert unpack_value_reply(r) is None
+        finally:
+            client.close()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_handshake_rejects_version_mismatch():
+    import socket
+    import struct
+
+    from foundationdb_tpu.rpc.transport import MAGIC, TcpTransport
+    server = TcpTransport("127.0.0.1", 0)
+    try:
+        s = socket.create_connection(server.address)
+        s.sendall(struct.pack("<IH", MAGIC, 999))   # wrong version
+        s.settimeout(5.0)
+        assert s.recv(16) == b""                    # closed on us
+    finally:
+        server.close()
