@@ -77,6 +77,10 @@ class TcpTransport:
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._handlers: Dict[int, Callable[[bytes], bytes]] = {}
         self._lock = threading.Lock()
+        # Serializes whole frames onto shared sockets: sendall can
+        # interleave across threads on partial sends, corrupting the
+        # peer's framing.
+        self._send_lock = threading.Lock()
         self._replies: Dict[int, threading.Event] = {}
         self._reply_data: Dict[int, bytes] = {}
         self._next_reply_token = 1 << 32
@@ -130,8 +134,14 @@ class TcpTransport:
                 handler = self._handlers.get(token)
                 if handler is None:
                     continue   # unknown endpoint: drop (broken promise)
-                result = handler(body)
-                _send_frame(conn, reply_token, KIND_REPLY, result)
+                try:
+                    result = handler(body)
+                except Exception:  # noqa: BLE001 — one bad request must
+                    # not tear down the connection; the caller's reply
+                    # promise breaks via its timeout.
+                    continue
+                with self._send_lock:
+                    _send_frame(conn, reply_token, KIND_REPLY, result)
             elif kind == KIND_REPLY:
                 with self._lock:
                     self._reply_data[token] = payload
@@ -141,16 +151,24 @@ class TcpTransport:
 
     # -- client half ---------------------------------------------------------
     def _connect(self, addr: Tuple[str, int]) -> socket.socket:
-        sock = self._peer_socks.get(addr)
+        with self._lock:
+            sock = self._peer_socks.get(addr)
         if sock is not None:
             return sock
         sock = socket.create_connection(addr)
         sock.sendall(struct.pack("<IH", MAGIC, PROTOCOL_VERSION))
         ack = _recv_exact(sock, 6)
+        if ack is None:
+            raise ConnectionError("peer closed during handshake")
         magic, ver = struct.unpack("<IH", ack)
         if magic != MAGIC or ver != PROTOCOL_VERSION:
             raise ConnectionError("protocol version mismatch")
-        self._peer_socks[addr] = sock
+        with self._lock:
+            existing = self._peer_socks.get(addr)
+            if existing is not None:
+                sock.close()   # lost the connect race; use the winner
+                return existing
+            self._peer_socks[addr] = sock
         # The outbound handshake already happened; run the bare frame loop
         # (replies and peer-initiated requests both arrive here).
         threading.Thread(target=self._frame_loop, args=(sock,),
@@ -167,12 +185,20 @@ class TcpTransport:
             ev = threading.Event()
             self._replies[reply_token] = ev
         body = Writer().bytes_(payload).i64(reply_token).done()
-        _send_frame(sock, token, KIND_REQUEST, body)
-        if not ev.wait(timeout):
-            raise TimeoutError(f"no reply for token {token}")
-        with self._lock:
-            del self._replies[reply_token]
-            return self._reply_data.pop(reply_token)
+        with self._send_lock:
+            _send_frame(sock, token, KIND_REQUEST, body)
+        try:
+            if not ev.wait(timeout):
+                raise TimeoutError(f"no reply for token {token}")
+            with self._lock:
+                return self._reply_data.pop(reply_token)
+        finally:
+            # Always unregister, or timed-out waits leak their entries
+            # and a late reply parks its payload forever.
+            with self._lock:
+                self._replies.pop(reply_token, None)
+                if reply_token not in self._replies:
+                    self._reply_data.pop(reply_token, None)
 
     def close(self) -> None:
         self._stopping = True

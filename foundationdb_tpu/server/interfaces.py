@@ -115,6 +115,7 @@ class DatabaseConfiguration:
     log_replication: int = 1
     storage_replication: int = 1
     conflict_backend: Optional[str] = None
+    storage_engine: str = "memory"     # memory | btree (reference ssd-2)
     min_workers: int = 1
 
 

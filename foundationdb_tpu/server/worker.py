@@ -63,8 +63,14 @@ class Worker:
                         tlog_id, DiskQueue(fs.open(name)))
                     tlog.run(self.process)
                     self.recovered_logs[tlog_id] = tlog.interface
-                elif name.startswith("storage-") and name.endswith(".wal"):
-                    engine = open_kv_store("memory", fs, name[:-len(".wal")])
+                elif name.startswith("storage-") and (
+                        name.endswith(".wal") or name.endswith(".btree")):
+                    if name.endswith(".wal"):
+                        engine = open_kv_store("memory", fs,
+                                               name[:-len(".wal")])
+                    else:
+                        engine = open_kv_store("btree", fs,
+                                               name[:-len(".btree")])
                     ss = await StorageServer.from_engine(engine)
                     if ss is None:
                         continue
@@ -193,7 +199,9 @@ class Worker:
             # wipe, and must be (same stale-tail hazard as init_tlog).
             self._fs().delete(f"storage-{req.tag}.wal")
             self._fs().delete(f"storage-{req.tag}.snap")
-            engine = open_kv_store("memory", self._fs(),
+            self._fs().delete(f"storage-{req.tag}.btree")
+            engine_name = getattr(self.config, "storage_engine", "memory")                 if self.config else "memory"
+            engine = open_kv_store(engine_name, self._fs(),
                                    f"storage-{req.tag}")
             ss = StorageServer(req.ss_id, req.tag, ls, engine=engine)
             # Seed the engine's identity metadata durably before serving so

@@ -1,0 +1,133 @@
+"""COW B-tree engine: model parity under random ops + power-fail recovery
+(reference VersionedBTree.actor.cpp semantics at IKeyValueStore scope)."""
+
+import pytest
+
+from foundationdb_tpu.core import (DeterministicRandom, EventLoop,
+                                   set_deterministic_random, set_event_loop)
+from foundationdb_tpu.server.kvstore import open_kv_store
+from foundationdb_tpu.server.sim_fs import SimFileSystem
+
+_loop = None
+
+
+def drive(coro):
+    return _loop.run_until(_loop.spawn(coro), timeout=60)
+
+
+def fresh_loop():
+    global _loop
+    _loop = EventLoop(sim=True)
+    set_event_loop(_loop)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_btree_random_ops_vs_model_with_power_fail(seed):
+    fresh_loop()
+    set_deterministic_random(DeterministicRandom(seed))
+    rng = DeterministicRandom(seed * 101)
+    fs = SimFileSystem()
+    eng = open_kv_store("btree", fs, "bt")
+    drive(eng.recover())
+    model = {}
+    durable_model = {}
+    for round_ in range(30):
+        for _ in range(rng.random_int(1, 30)):
+            r = rng.random01()
+            k = b"k%04d" % rng.random_int(0, 300)
+            if r < 0.7:
+                v = b"v%06d" % rng.random_int(0, 1 << 20)
+                eng.set(k, v)
+                model[k] = v
+            else:
+                hi = b"k%04d" % rng.random_int(0, 300)
+                lo, hi = min(k, hi), max(k, hi)
+                eng.clear(lo, hi)
+                for kk in [kk for kk in model if lo <= kk < hi]:
+                    del model[kk]
+        if rng.coinflip():
+            drive(eng.commit())
+            durable_model = dict(model)
+        if round_ % 7 == 3:
+            # Unclean power failure + fresh engine over the same file.
+            fs.power_fail_all()
+            eng = open_kv_store("btree", fs, "bt")
+            drive(eng.recover())
+            model = dict(durable_model)
+            # Full scan must equal the last durably committed state.
+            assert dict(eng.read_range(b"", b"\xff")) == durable_model
+        # Point reads against the in-flight model after commit only.
+    drive(eng.commit())
+    assert dict(eng.read_range(b"", b"\xff")) == model
+    for k, v in list(model.items())[:20]:
+        assert eng.read_value(k) == v
+    assert eng.read_value(b"missing") is None
+
+
+def test_btree_splits_and_range_reads():
+    fresh_loop()
+    set_deterministic_random(DeterministicRandom(9))
+    fs = SimFileSystem()
+    eng = open_kv_store("btree", fs, "big")
+    drive(eng.recover())
+    # Enough data to force multiple levels of page splits.
+    for i in range(2000):
+        eng.set(b"key%06d" % i, b"x" * 50)
+        if i % 100 == 99:
+            drive(eng.commit())
+    drive(eng.commit())
+    assert eng.page_count > 10   # really paged
+    data = eng.read_range(b"key000500", b"key000600")
+    assert len(data) == 100
+    assert data[0][0] == b"key000500" and data[-1][0] == b"key000599"
+    assert eng.read_range(b"", b"\xff", limit=5).__len__() == 5
+    # Survives recovery wholesale.
+    eng2 = open_kv_store("btree", fs, "big")
+    drive(eng2.recover())
+    assert len(eng2.read_range(b"", b"\xff")) == 2000
+
+
+def test_cluster_on_btree_engine_survives_power_fail():
+    """A full cluster storing on the B-tree engine: acked commits survive a
+    whole-cluster power-fail reboot (the memory-engine durability test's
+    criterion, on the second engine)."""
+    from foundationdb_tpu.core import set_event_loop
+    from foundationdb_tpu.rpc.sim import set_simulator
+    from foundationdb_tpu.server.cluster import SimFdbCluster
+    from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_recovery import commit_kv, read_key
+
+    set_deterministic_random(DeterministicRandom(88))
+    c = SimFdbCluster(config=DatabaseConfiguration(storage_engine="btree"),
+                      n_workers=5, n_storage_workers=2)
+    db = c.database()
+    try:
+        async def load():
+            for i in range(25):
+                await commit_kv(db, b"bt/%03d" % i, b"val%03d" % i)
+        c.run_until(c.loop.spawn(load()), timeout=120)
+
+        c.power_fail_reboot()
+        db2 = c.database()
+
+        async def check():
+            for i in range(25):
+                assert await read_key(db2, b"bt/%03d" % i) == b"val%03d" % i
+            await commit_kv(db2, b"bt/after", b"ok")
+            assert await read_key(db2, b"bt/after") == b"ok"
+        c.run_until(c.loop.spawn(check()), timeout=120)
+    finally:
+        set_simulator(None)
+        set_event_loop(None)
+
+
+# KNOWN ISSUE (next round): with storage_engine="btree", n_storage=3,
+# storage_replication=2, a whole-cluster power-fail reboot leaves the new
+# epoch's DataDistributor seeing spurious failure-monitor fires for two of
+# the three recovered storage interfaces (healthy shrinks to one tag and
+# re-replication chases ghosts).  The memory engine under the identical
+# scenario keeps all three healthy, and the btree cluster itself serves
+# reads correctly after the reboot — the defect is in the monitor/
+# registration path for btree-recovered roles, not in the engine's data.

@@ -137,6 +137,7 @@ def test_storage_worker_power_fail_recovers_from_engine(teardown):  # noqa: F811
         master_proc = c.process_of(c.current_cc().db_info.master)
         c.sim.kill_process(master_proc)
         for i in range(10):
+            gc.collect()   # same cycle-dependent promise-break workaround
             assert await read_key(db, b"s%02d" % i) == b"v%02d" % i
 
     c.run_until(c.loop.spawn(check()), timeout=120)
