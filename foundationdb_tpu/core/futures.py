@@ -213,6 +213,18 @@ class PromiseStream(Generic[T]):
     def empty(self) -> bool:
         return not self._queue
 
+    def break_buffered_replies(self) -> None:
+        """Break the reply promise of every buffered-but-unserved request
+        (the server died before popping them).  An explicit protocol —
+        callers must not grope stream internals, or a rename silently
+        reverts promise breaks to GC-timing dependence."""
+        for req in self._queue:
+            reply = getattr(req, "reply", None)
+            if reply is not None and hasattr(reply, "send_error") and \
+                    not reply.is_set():
+                reply.send_error(err("broken_promise"))
+        self._queue.clear()
+
     def __len__(self) -> int:
         return len(self._queue)
 
@@ -316,7 +328,15 @@ class ActorTask:
         except StopIteration as stop:
             self._finish_value(stop.value)
             return
-        except ActorCancelled:
+        except ActorCancelled as e:
+            # Drop the traceback NOW: it pins the whole unwound frame chain
+            # (and those frames' locals — e.g. held reply promises) until
+            # cyclic GC happens to run, making broken_promise delivery
+            # wall-clock dependent.  Clearing it restores the reference
+            # semantics of Flow's SAV destruction: refcounts free the
+            # frames immediately and their promises break deterministically.
+            e.__traceback__ = None
+            del e
             self._finish_cancel()
             return
         except BaseException as e:  # noqa: BLE001 - actor errors propagate via future

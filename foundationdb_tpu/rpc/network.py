@@ -56,9 +56,16 @@ class SimNetwork:
         return ep
 
     def unregister_process(self, address: NetworkAddress) -> None:
-        """Drop every endpoint at `address` (process killed/rebooted)."""
+        """Drop every endpoint at `address` (process killed/rebooted).
+
+        Buffered-but-unserved requests get their reply promises broken
+        DETERMINISTICALLY here: leaving them to reply-wrapper __del__ means
+        a request caught in a reference cycle only breaks when cyclic GC
+        happens to run — observed as wall-clock-dependent post-kill stalls
+        (the reference's SAV destruction is deterministic by refcount)."""
         for ep in [e for e in self._endpoints if e.address == address]:
-            del self._endpoints[ep]
+            stream, _epoch = self._endpoints.pop(ep)
+            stream.queue.break_buffered_replies()
 
     # -- fault injection ----------------------------------------------------
     def clog_pair(self, a: str, b: str, seconds: float) -> None:

@@ -144,10 +144,11 @@ class TcpTransport:
                     _send_frame(conn, reply_token, KIND_REPLY, result)
             elif kind == KIND_REPLY:
                 with self._lock:
-                    self._reply_data[token] = payload
                     ev = self._replies.get(token)
-                if ev is not None:
-                    ev.set()
+                    if ev is None:
+                        continue   # late reply after timeout: drop, don't leak
+                    self._reply_data[token] = payload
+                ev.set()
 
     # -- client half ---------------------------------------------------------
     def _connect(self, addr: Tuple[str, int]) -> socket.socket:
@@ -193,12 +194,12 @@ class TcpTransport:
             with self._lock:
                 return self._reply_data.pop(reply_token)
         finally:
-            # Always unregister, or timed-out waits leak their entries
-            # and a late reply parks its payload forever.
+            # Always unregister both entries, or timed-out waits leak
+            # (late replies are dropped at the frame loop once the wait
+            # entry is gone).
             with self._lock:
                 self._replies.pop(reply_token, None)
-                if reply_token not in self._replies:
-                    self._reply_data.pop(reply_token, None)
+                self._reply_data.pop(reply_token, None)
 
     def close(self) -> None:
         self._stopping = True

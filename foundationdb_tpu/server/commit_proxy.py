@@ -148,6 +148,7 @@ class CommitProxy:
         self.metrics = CounterCollection("CommitProxy", proxy_id)
         self.interface.role = self   # sim-side backref for status/tests
         self.broken = False   # set on mid-batch infrastructure failure
+        self._process = None   # owning SimProcess; set in run()
         # While a backup is active (\xff/backupStarted set), every user
         # mutation additionally rides BACKUP_TAG for the backup worker.
         self.backup_active = False
@@ -180,8 +181,9 @@ class CommitProxy:
                 # Single-transaction batches stress the per-batch paths
                 # (reference BUGGIFY on batching knobs).
                 self.local_batch_number += 1
-                spawn(self._commit_batch(batch, self.local_batch_number),
-                      f"{self.id}.commitBatch")
+                self._spawn(self._commit_batch(batch,
+                                                self.local_batch_number),
+                            f"{self.id}.commitBatch")
                 continue
             deadline = now() + knobs.COMMIT_TRANSACTION_BATCH_INTERVAL_MIN
             while (batch_bytes < knobs.COMMIT_TRANSACTION_BATCH_BYTES_MAX and
@@ -196,8 +198,8 @@ class CommitProxy:
                     break
                 await delay(remaining)
             self.local_batch_number += 1
-            spawn(self._commit_batch(batch, self.local_batch_number),
-                  f"{self.id}.commitBatch")
+            self._spawn(self._commit_batch(batch, self.local_batch_number),
+                        f"{self.id}.commitBatch")
 
     # -- the batch pipeline --------------------------------------------------
     async def _commit_batch(self, batch: List[CommitTransactionRequest],
@@ -296,6 +298,16 @@ class CommitProxy:
                 self.metrics.counter("TxnConflicted").add(1)
                 from ..core.error import err
                 req.reply.send_error(err("not_committed"))
+
+    def _spawn(self, coro, name: str):
+        """Handlers are PROCESS-scoped: a killed process must cancel its
+        in-flight request actors so their held reply promises break
+        deterministically — a ghost handler in a reference cycle only
+        breaks its promises when cyclic GC happens to run (observed as
+        post-reboot stalls)."""
+        if self._process is not None:
+            return self._process.spawn(coro, name)
+        return spawn(coro, name)
 
     # -- resolution request building (reference :88-181) ---------------------
     def _apply_resolver_changes(self, changes) -> None:
@@ -538,6 +550,7 @@ class CommitProxy:
             req.reply.send(GetKeyServerLocationsReply(results=results))
 
     def run(self, process) -> None:
+        self._process = process
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._commit_batcher(), f"{self.id}.batcher")

@@ -235,7 +235,8 @@ class CoordinationServer:
 
     async def _serve_candidacy(self) -> None:
         async for req in self.candidacy.queue:
-            spawn(self._handle_candidacy(req), f"{self.id}.candidacy")
+            self._process.spawn(self._handle_candidacy(req),
+                                f"{self.id}.candidacy")
 
     async def _handle_candidacy(self, req: CandidacyRequest) -> None:
         self._candidates.setdefault(req.key, {})[
@@ -262,7 +263,8 @@ class CoordinationServer:
 
     async def _serve_leader_get(self) -> None:
         async for req in self.leader_get.queue:
-            spawn(self._handle_leader_get(req), f"{self.id}.leaderGet")
+            self._process.spawn(self._handle_leader_get(req),
+                                f"{self.id}.leaderGet")
 
     async def _handle_leader_get(self, req: LeaderGetRequest) -> None:
         nominee = self._nominee.get(req.key)
@@ -303,6 +305,7 @@ class CoordinationServer:
                 self.heartbeat, self.leader_get]
 
     def run(self, process) -> None:
+        self._process = process
         for s in self.streams():
             process.register(s)
         process.spawn(self._startup(), f"{self.id}.startup")

@@ -100,7 +100,8 @@ class GrvProxy:
                 # the ratekeeper's tps.
                 self.transaction_budget -= charged
             self.stats["batches"] += 1
-            spawn(self._reply_batch(batch), f"{self.id}.grvBatch")
+            self._process.spawn(self._reply_batch(batch),
+                                f"{self.id}.grvBatch")
 
     async def _rate_updater(self) -> None:
         """Fetch the tps budget from the ratekeeper (reference getRate
@@ -140,6 +141,7 @@ class GrvProxy:
                                                locked=vreply.locked))
 
     def run(self, process) -> None:
+        self._process = process
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._queue_requests(), f"{self.id}.queue")

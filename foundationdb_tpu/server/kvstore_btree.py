@@ -22,6 +22,7 @@ fsync — the reference's "commit is one header write" invariant.
 
 from __future__ import annotations
 
+import bisect
 import zlib
 from typing import Dict, List, Optional, Tuple
 
@@ -127,7 +128,6 @@ class KVStoreBTree(IKeyValueStore):
             return self._alloc(_Node(_LEAF, [key], [value]))
         node = await self._read_node(page_id)
         if node.kind == _LEAF:
-            import bisect
             i = bisect.bisect_left(node.keys, key)
             keys, values = list(node.keys), list(node.values)
             if i < len(keys) and keys[i] == key:
@@ -136,7 +136,6 @@ class KVStoreBTree(IKeyValueStore):
                 keys.insert(i, key)
                 values.insert(i, value)
             return self._finish(_Node(_LEAF, keys, values))
-        import bisect
         ci = bisect.bisect_right(node.keys, key)
         new_child = await self._cow_set(node.children[ci], key, value)
         return self._replace_child(node, ci, new_child)
@@ -179,18 +178,22 @@ class KVStoreBTree(IKeyValueStore):
         if node.kind == _LEAF:
             pairs = [(k, v) for k, v in zip(node.keys, node.values)
                      if not begin <= k < end]
+            if len(pairs) == len(node.keys):
+                return page_id     # nothing cleared: no COW churn
             if not pairs:
                 return 0
             return self._alloc(_Node(_LEAF, [k for k, _ in pairs],
                                      [v for _, v in pairs]))
-        import bisect
         lo = bisect.bisect_right(node.keys, begin)
         hi = bisect.bisect_left(node.keys, end) + 1
         keys: List[bytes] = []
         children: List[int] = []
+        changed = False
         for ci, child in enumerate(node.children):
             if lo <= ci < hi:
-                child = await self._cow_clear(child, begin, end)
+                new_child = await self._cow_clear(child, begin, end)
+                changed = changed or new_child != child
+                child = new_child
             if child != 0:
                 if children:
                     # Separator between the previous kept child and this
@@ -199,6 +202,8 @@ class KVStoreBTree(IKeyValueStore):
                     # this one (ci > 0 whenever a child was already kept).
                     keys.append(node.keys[ci - 1])
                 children.append(child)
+        if not changed:
+            return page_id         # subtree untouched: keep the old pages
         if not children:
             return 0
         if len(children) == 1:
@@ -254,7 +259,6 @@ class KVStoreBTree(IKeyValueStore):
         page_id = self.root
         while page_id != 0:
             node = await self._read_node(page_id)
-            import bisect
             if node.kind == _LEAF:
                 i = bisect.bisect_left(node.keys, key)
                 if i < len(node.keys) and node.keys[i] == key:
@@ -281,7 +285,6 @@ class KVStoreBTree(IKeyValueStore):
                     if len(out) >= limit:
                         return
             return
-        import bisect
         lo = bisect.bisect_right(node.keys, begin)
         hi = bisect.bisect_left(node.keys, end) + 1
         for ci in range(lo, min(hi, len(node.children))):

@@ -72,6 +72,7 @@ class Master:
         self.resolution_changes: list = []
         self.resolution_changes_version: Version = 0
         self.expected_proxies: list = []   # ids recruited this epoch
+        self._process = None
 
     # -- version allocation (reference getVersion :1126) ---------------------
     def _allocate_version(self) -> GetCommitVersionReply:
@@ -125,8 +126,10 @@ class Master:
                 # (the reference replies strictly in request_num order).
                 p: Promise = Promise()
                 st.waiters[req.request_num] = p
-                spawn(self._serve_parked(st, req, p.get_future()),
-                      "master.parkedVersionReq")
+                target = (self._process.spawn if self._process is not None
+                          else spawn)
+                target(self._serve_parked(st, req, p.get_future()),
+                       "master.parkedVersionReq")
                 continue
             self._reply_version(st, req)
 
@@ -170,6 +173,7 @@ class Master:
 
     def run(self, process) -> None:
         """Register streams + start serving actors on `process`."""
+        self._process = process
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._serve_commit_versions(), "master.serveVersions")
@@ -345,6 +349,7 @@ async def master_server(master: Master, process, coordinators,
         return f
 
     try:
+        master._process = process
         for s in master.interface.streams():
             process.register(s)
         adopt(master._serve_wait_failure(), "master.waitFailure")

@@ -202,11 +202,14 @@ class Resolver:
     async def _serve(self) -> None:
         async for req in self.interface.resolve.queue:
             # Spawn per request: chained batches must be able to wait for
-            # their predecessors without blocking the queue.
-            from ..core.scheduler import spawn
-            spawn(self._resolve_batch(req), f"{self.id}.resolveBatch")
+            # their predecessors without blocking the queue.  PROCESS-
+            # scoped: ghosts of killed resolvers must break their reply
+            # promises deterministically, not at the next cyclic GC.
+            self._process.spawn(self._resolve_batch(req),
+                                f"{self.id}.resolveBatch")
 
     def run(self, process) -> None:
+        self._process = process
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._serve(), f"{self.id}.serve")

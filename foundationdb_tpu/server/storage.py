@@ -605,7 +605,9 @@ class StorageServer:
     # -- serving -------------------------------------------------------------
     async def _serve(self, queue, handler) -> None:
         async for req in queue:
-            spawn(handler(req), f"{self.id}.handler")
+            # Process-scoped: see CommitProxy._spawn (ghost handlers must
+            # break reply promises deterministically on kill).
+            self._process.spawn(handler(req), f"{self.id}.handler")
 
     def run(self, process) -> None:
         self._process = process

@@ -292,14 +292,12 @@ class TLog:
 
     # -- serving -------------------------------------------------------------
     async def _serve_commit(self) -> None:
-        from ..core.scheduler import spawn
         async for req in self.interface.commit.queue:
-            spawn(self._commit(req), f"{self.id}.commit")
+            self._process.spawn(self._commit(req), f"{self.id}.commit")
 
     async def _serve_peek(self) -> None:
-        from ..core.scheduler import spawn
         async for req in self.interface.peek.queue:
-            spawn(self._peek(req), f"{self.id}.peek")
+            self._process.spawn(self._peek(req), f"{self.id}.peek")
 
     async def _serve_pop(self) -> None:
         async for req in self.interface.pop.queue:
@@ -312,11 +310,11 @@ class TLog:
             # stopped: drop -> broken_promise -> GRV proxy fails over.
 
     async def _serve_lock(self) -> None:
-        from ..core.scheduler import spawn
         async for req in self.interface.lock.queue:
-            spawn(self._lock(req), f"{self.id}.lock")
+            self._process.spawn(self._lock(req), f"{self.id}.lock")
 
     def run(self, process) -> None:
+        self._process = process
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._serve_commit(), f"{self.id}.serveCommit")

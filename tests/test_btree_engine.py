@@ -122,12 +122,3 @@ def test_cluster_on_btree_engine_survives_power_fail():
         set_simulator(None)
         set_event_loop(None)
 
-
-# KNOWN ISSUE (next round): with storage_engine="btree", n_storage=3,
-# storage_replication=2, a whole-cluster power-fail reboot leaves the new
-# epoch's DataDistributor seeing spurious failure-monitor fires for two of
-# the three recovered storage interfaces (healthy shrinks to one tag and
-# re-replication chases ghosts).  The memory engine under the identical
-# scenario keeps all three healthy, and the btree cluster itself serves
-# reads correctly after the reboot — the defect is in the monitor/
-# registration path for btree-recovered roles, not in the engine's data.

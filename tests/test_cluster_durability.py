@@ -111,24 +111,12 @@ def test_storage_worker_power_fail_recovers_from_engine(teardown):  # noqa: F811
     w.run(leader_var)
 
     async def check():
-        import gc
-
         from foundationdb_tpu.core.scheduler import delay
         # Wait for the rebooted worker to re-register with its recovered
         # storage role, then force an epoch change: recovery resolves the
         # storage tag to the recovered interface (until DataDistribution
         # lands, re-registration is adopted at recovery time).
-        #
-        # The explicit gc.collect() works around a known liveness issue:
-        # some reply promise abandoned by the power-failed worker sits in a
-        # reference CYCLE, so its broken_promise only fires on cyclic GC —
-        # whose timing is wall-clock/allocation dependent (observed:
-        # pytest's assertion rewriter importing sibling modules shifted GC
-        # enough to stall this loop past the sim deadline).  The principled
-        # fix is breaking the cycle so refcounting delivers the break
-        # deterministically, like the reference's SAV destruction.
         while True:
-            gc.collect()
             cc = c.current_cc()
             reg = cc.workers.get("worker0") if cc is not None else None
             if reg is not None and reg.recovered_storage:
@@ -137,7 +125,6 @@ def test_storage_worker_power_fail_recovers_from_engine(teardown):  # noqa: F811
         master_proc = c.process_of(c.current_cc().db_info.master)
         c.sim.kill_process(master_proc)
         for i in range(10):
-            gc.collect()   # same cycle-dependent promise-break workaround
             assert await read_key(db, b"s%02d" % i) == b"v%02d" % i
 
     c.run_until(c.loop.spawn(check()), timeout=120)
