@@ -65,6 +65,19 @@ class SimProcess:
             if not a.is_ready():
                 a.cancel()
 
+    def die(self, reason: str = "") -> None:
+        """Process suicide from within one of its own actors (reference:
+        io_error and other fatal role errors kill the whole fdbserver
+        process).  Deferred so the calling actor isn't cancelled
+        mid-step; watchers see broken promises / failure monitors fire."""
+        if not self.alive:
+            return
+        TraceEvent("ProcessSuicide", Severity.Warn).detail(
+            "Process", self.name).detail("Reason", reason).log()
+        self.alive = False
+        self.shutdown_signal.set("kill")
+        get_event_loop().call_soon(self._halt)
+
 
 class Machine:
     """A simulated machine hosting processes (reference MachineInfo).

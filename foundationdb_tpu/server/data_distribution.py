@@ -132,16 +132,18 @@ class DataDistributor:
         self.stats = {"splits": 0, "moves": 0, "rereplications": 0}
 
     # -- metadata transactions ----------------------------------------------
-    async def _commit_boundaries(self, sets) -> None:
-        """One serializable txn writing keyServers boundaries; retried."""
+    async def _commit_boundaries(self, sets) -> int:
+        """One serializable txn writing keyServers boundaries; retried.
+        Returns the commit version (the MoveKeys phase-1 version: sources
+        must serve fetch snapshots at or above it, or writes routed only
+        to the old team in (snapshot, phase1] would be lost)."""
         t = self.db.create_transaction()
         t.access_system_keys = True
         while True:
             try:
                 for boundary, team in sets:
                     t.set(key_servers_key(boundary), key_servers_value(team))
-                await t.commit()
-                return
+                return await t.commit()
             except FdbError as e:
                 await t.on_error(e)
 
@@ -161,10 +163,13 @@ class DataDistributor:
         phase1_done = False
         try:
             # Phase 1 (startMoveKeys): both teams receive fresh writes.
-            await self._commit_boundaries([(begin, union)])
+            move_version = await self._commit_boundaries([(begin, union)])
             self.map.set_boundary(begin, union)
             phase1_done = True
             # fetchKeys on every new member, sourced from live old members.
+            # move_version floors the snapshot: a source lagging phase 1
+            # would otherwise serve a snapshot missing mutations that were
+            # routed only to the old team.
             sources = [self.storage[t] for t in old_team
                        if t in self.healthy and t in self.storage]
             fetches = []
@@ -174,7 +179,8 @@ class DataDistributor:
                 fetches.append(RequestStream.at(
                     self.storage[t].fetch_keys.endpoint).get_reply(
                     FetchKeysRequest(begin=begin, end=end,
-                                     sources=sources)))
+                                     sources=sources,
+                                     min_version=move_version)))
             from ..core.futures import wait_all
             await wait_all(fetches)
             # Phase 2 (finishMoveKeys): final ownership.
@@ -229,12 +235,23 @@ class DataDistributor:
                 len(survivors))]
             if set(new_team) == set(team):
                 continue
-            try:
-                await self.move_shard(begin, end, new_team)
-                self.stats["rereplications"] += 1
-            except FdbError as e:
-                TraceEvent("DDRereplicationFailed", Severity.Warn).detail(
-                    "Begin", begin).detail("Error", e.name).log()
+            # Bounded retries with backoff: a fetch can fail transiently
+            # (future_version from a source lagging the phase-1 commit, a
+            # source dying mid-snapshot) and the sources typically catch
+            # up moments later — a single attempt would leave the shard
+            # under-replicated forever (reference DD requeues failed
+            # relocations, DataDistributionQueue.actor.cpp).
+            for attempt in range(5):
+                try:
+                    await self.move_shard(begin, end, new_team)
+                    self.stats["rereplications"] += 1
+                    break
+                except FdbError as e:
+                    TraceEvent("DDRereplicationFailed",
+                               Severity.Warn).detail(
+                        "Begin", begin).detail("Error", e.name).detail(
+                        "Attempt", attempt).log()
+                    await delay(0.5 * (1 << attempt))
 
     async def _failure_monitor(self, tag: Tag, ssi) -> None:
         from .failure import wait_failure_of

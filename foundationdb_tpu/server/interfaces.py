@@ -532,16 +532,22 @@ class FetchKeysRequest:
     begin: bytes = b""
     end: bytes = b""
     sources: List[Any] = field(default_factory=list)  # StorageServerInterface
+    # MoveKeys phase-1 commit version: the snapshot must be served at or
+    # above it (mutations in (snapshot, phase1] were routed only to the
+    # old team and would otherwise be lost — reference MoveKeys/fetchKeys
+    # version discipline).
+    min_version: Version = 0
     reply: Any = None
 
 
 @dataclass
 class FetchShardRequest:
     """Destination SS -> source SS: full snapshot of [begin, end) at the
-    source's current version."""
+    source's current version, floored at min_version."""
 
     begin: bytes = b""
     end: bytes = b""
+    min_version: Version = 0
     reply: Any = None    # -> FetchShardReply
 
 

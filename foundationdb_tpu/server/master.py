@@ -322,7 +322,12 @@ async def resolution_balancing(master: Master, resolvers: List[Any],
         if split is None:
             continue
         owned.set_range(split, e, lo)
-        master.resolution_changes_version = master.version + 1
+        # Strictly increasing: two balancing moves with no intervening
+        # commit-version allocation must not share a change version, or
+        # proxies (whose _resolver_changes_hwm dedups by version) would
+        # drop the second while the master's `owned` map adopts it.
+        master.resolution_changes_version = max(
+            master.version + 1, master.resolution_changes_version + 1)
         master.resolution_changes.append(
             (KeyRange(split, e), lo, master.resolution_changes_version))
         TraceEvent("ResolutionBalanced").detail(

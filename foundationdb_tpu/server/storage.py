@@ -362,16 +362,30 @@ class StorageServer:
             if target <= dv.get():
                 continue
             batch, self._durable_pending = self._durable_pending, []
-            for _v, op, a, b in batch:
-                if op == 0:
-                    if b is None:
-                        self.engine.clear(a, a + b"\x00")
+            try:
+                for _v, op, a, b in batch:
+                    if op == 0:
+                        if b is None:
+                            self.engine.clear(a, a + b"\x00")
+                        else:
+                            self.engine.set(a, b)
                     else:
-                        self.engine.set(a, b)
-                else:
-                    self.engine.clear(a, b)
-            self.engine.set(_META_KEY, self._meta_blob(target))
-            await self.engine.commit()
+                        self.engine.clear(a, b)
+                self.engine.set(_META_KEY, self._meta_blob(target))
+                await self.engine.commit()
+            except Exception as e:  # noqa: BLE001
+                # A dying durability actor must be LOUD: if this loop
+                # silently stopped, durable_version would freeze and TLog
+                # trim halt while the cluster kept acking commits — which
+                # a later power-fail then loses.  An engine commit error
+                # is process-fatal (reference: io_error kills fdbserver),
+                # so failure monitors fire and DD re-replicates.
+                TraceEvent("SSUpdateStorageError", Severity.Error).detail(
+                    "Id", self.id).detail("Error", repr(e)).log()
+                if self._process is not None and \
+                        hasattr(self._process, "die"):
+                    self._process.die(f"SSUpdateStorageError:{e!r}")
+                raise
             if self.durable_version is not dv or self.log_epoch != epoch0:
                 # An epoch rollback happened during the fsync: `target` may
                 # lie beyond the new recovery version.  Do NOT advance the
@@ -449,7 +463,8 @@ class StorageServer:
                 try:
                     reply = await RequestStream.at(
                         src.fetch_shard.endpoint).get_reply(
-                        FetchShardRequest(begin=req.begin, end=req.end))
+                        FetchShardRequest(begin=req.begin, end=req.end,
+                                          min_version=req.min_version))
                     break
                 except FdbError as e:
                     last = e
@@ -484,8 +499,21 @@ class StorageServer:
             req.reply.send_error(e)
 
     async def _fetch_shard(self, req) -> None:
-        """Serve a snapshot of [begin, end) at our current version."""
+        """Serve a snapshot of [begin, end) at our current version,
+        floored at req.min_version (the MoveKeys phase-1 commit): a
+        snapshot below phase 1 would miss mutations routed only to the
+        old team, permanently diverging the destination replica."""
         from .interfaces import FetchShardReply
+        if self.version.get() < req.min_version:
+            try:
+                # Bounded wait: a live-but-stalled source (e.g. stuck
+                # peeking a locked old-generation TLog) must raise
+                # future_version so the destination falls through to the
+                # next source instead of wedging the move forever.
+                await self._wait_for_version(req.min_version)
+            except Exception as e:  # noqa: BLE001
+                req.reply.send_error(e)
+                return
         v = self.version.get()
         data, _more = self.data.range_read(req.begin, req.end, v,
                                            1 << 30, 1 << 40)

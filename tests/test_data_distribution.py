@@ -109,3 +109,79 @@ def test_consistency_audit_clean_cluster(teardown):  # noqa: F811
         assert audited >= 2
 
     c.run_until(c.loop.spawn(go()), timeout=120)
+
+
+def test_fetch_shard_floors_snapshot_at_min_version(teardown):  # noqa: F811
+    """ADVICE r3 (high): a fetch-shard snapshot served below the MoveKeys
+    phase-1 commit version would miss mutations routed only to the old
+    team.  The source must wait until its applied version reaches
+    req.min_version before serving."""
+    from foundationdb_tpu.core import EventLoop, set_event_loop
+    from foundationdb_tpu.core.futures import Promise
+    from foundationdb_tpu.server.interfaces import FetchShardRequest
+    from foundationdb_tpu.server.storage import StorageServer
+
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    ss = StorageServer("ss-test", tag=0, log_system=None)
+    ss.shards.set_range(b"", b"\xff\xff", ("owned", 0))
+    ss.data.set(b"a", b"old", 5)
+    ss.version.set(5)
+
+    p = Promise()
+    req = FetchShardRequest(begin=b"", end=b"\xff", min_version=10,
+                            reply=p)
+    from foundationdb_tpu.core.scheduler import delay
+    f = lp.spawn(ss._fetch_shard(req))
+    lp.run_until(delay(0.1))
+    assert not p.get_future().is_ready(), \
+        "snapshot served below the phase-1 floor"
+    # The lagging source catches up: a write at v8 (the in-between window
+    # the floor exists to capture) then the phase-1 version itself.
+    ss.data.set(b"b", b"in-between", 8)
+    ss.version.set(10)
+    reply = lp.run_until(p.get_future(), timeout=5)
+    lp.run_until(f, timeout=5)
+    assert reply.version >= 10
+    assert (b"b", b"in-between") in reply.data
+
+
+def test_fetch_shard_stalled_source_raises_future_version(teardown):  # noqa: F811
+    """A live-but-stalled source must raise future_version (bounded wait)
+    so the destination falls through to its next source instead of
+    wedging the move forever."""
+    from foundationdb_tpu.core import EventLoop, set_event_loop
+    from foundationdb_tpu.core.error import FdbError
+    from foundationdb_tpu.core.futures import Promise
+    from foundationdb_tpu.server.interfaces import FetchShardRequest
+    from foundationdb_tpu.server.storage import StorageServer
+
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    ss = StorageServer("ss-test", tag=0, log_system=None)
+    ss.version.set(5)
+    p = Promise()
+    f = lp.spawn(ss._fetch_shard(FetchShardRequest(
+        begin=b"", end=b"\xff", min_version=10, reply=p)))
+    lp.run_until(f, timeout=30)
+    assert p.get_future().is_error()
+    try:
+        p.get_future().get()
+    except FdbError as e:
+        assert e.name == "future_version"
+
+
+def test_resolution_change_versions_strictly_increase(teardown):  # noqa: F811
+    """ADVICE r3 (low): two balancing moves with no intervening commit
+    must not share a change version (proxies dedup by version and would
+    silently drop the second change)."""
+    from foundationdb_tpu.server.master import Master
+
+    m = Master.__new__(Master)
+    m.version = 100
+    m.resolution_changes_version = 0
+    # Mimic the balancing assignment twice with no version allocation.
+    for _ in range(2):
+        m.resolution_changes_version = max(
+            m.version + 1, m.resolution_changes_version + 1)
+    assert m.resolution_changes_version == 102
