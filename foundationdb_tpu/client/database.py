@@ -559,3 +559,27 @@ def _coalesce(ranges: List[Tuple[bytes, bytes]]
         else:
             out.append((b, e))
     return out
+
+
+def open_cluster(cluster_spec: str, ip: str = "127.0.0.1"):
+    """Real-mode client bootstrap (reference fdb_c fdb_setup_network +
+    cluster-file open): installs a real-IO EventLoop and RealNetwork in
+    this process and returns (loop, Database) connected to the
+    coordinators in `cluster_spec` ("host:port,host:port,...").  Drive
+    transactions with loop.run_until(loop.spawn(coro))."""
+    from ..core.rng import DeterministicRandom, set_deterministic_random
+    from ..core.scheduler import EventLoop, set_event_loop
+    from ..rpc.network import set_network
+    from ..rpc.real_network import RealNetwork
+    from ..server.coordination import CoordinationClientInterface
+    from ..server.fdbserver import parse_coordinators
+
+    loop = EventLoop(sim=False)
+    set_event_loop(loop)
+    import os
+    set_deterministic_random(DeterministicRandom(os.getpid() & 0x7FFFFFFF))
+    net = RealNetwork(loop, ip, 0)
+    set_network(net)
+    coords = [CoordinationClientInterface.at_address(a)
+              for a in parse_coordinators(cluster_spec)]
+    return loop, Database(ClusterConnection(coords))

@@ -83,6 +83,74 @@ class EventLoop:
         self._seq = 0
         self._tasks: set = set()
         self._stopped = False
+        # Real-IO reactor half (reference Net2: boost::asio reactor fused
+        # with the task queue, Net2.actor.cpp:1400 Net2::run).  Only used
+        # in real mode; sim mode has no file descriptors by construction.
+        self._selector = None
+        self._io_cbs: dict = {}   # fd -> [reader_cb, writer_cb]
+
+    # -- real-IO reactor (real mode only) ------------------------------------
+    def _sel(self):
+        if self._selector is None:
+            import selectors
+            self._selector = selectors.DefaultSelector()
+        return self._selector
+
+    def _io_update(self, fileobj) -> None:
+        import selectors
+        sel = self._sel()
+        cbs = self._io_cbs.get(fileobj)
+        mask = 0
+        if cbs is not None:
+            if cbs[0] is not None:
+                mask |= selectors.EVENT_READ
+            if cbs[1] is not None:
+                mask |= selectors.EVENT_WRITE
+        try:
+            if mask == 0:
+                self._io_cbs.pop(fileobj, None)
+                sel.unregister(fileobj)
+            else:
+                sel.modify(fileobj, mask, fileobj)
+        except KeyError:
+            if mask:
+                sel.register(fileobj, mask, fileobj)
+
+    def add_reader(self, fileobj, cb: Callable[[], None]) -> None:
+        self._io_cbs.setdefault(fileobj, [None, None])[0] = cb
+        self._io_update(fileobj)
+
+    def remove_reader(self, fileobj) -> None:
+        if fileobj in self._io_cbs:
+            self._io_cbs[fileobj][0] = None
+            self._io_update(fileobj)
+
+    def add_writer(self, fileobj, cb: Callable[[], None]) -> None:
+        self._io_cbs.setdefault(fileobj, [None, None])[1] = cb
+        self._io_update(fileobj)
+
+    def remove_writer(self, fileobj) -> None:
+        if fileobj in self._io_cbs:
+            self._io_cbs[fileobj][1] = None
+            self._io_update(fileobj)
+
+    def _poll_io(self, timeout: Optional[float]) -> bool:
+        """Wait up to `timeout` for IO readiness; dispatch callbacks.
+        Returns True if any callback ran."""
+        import selectors
+        events = self._sel().select(timeout)
+        ran = False
+        for key, mask in events:
+            cbs = self._io_cbs.get(key.fileobj)
+            if cbs is None:
+                continue
+            if (mask & selectors.EVENT_READ) and cbs[0] is not None:
+                cbs[0]()
+                ran = True
+            if (mask & selectors.EVENT_WRITE) and cbs[1] is not None:
+                cbs[1]()
+                ran = True
+        return ran
 
     # -- time ---------------------------------------------------------------
     def now(self) -> float:
@@ -146,24 +214,62 @@ class EventLoop:
             self._time = end
 
     def _step_once(self, deadline: Optional[float]) -> bool:
-        """Run one scheduled callback; returns False if nothing to run."""
-        if not self._heap:
-            return False
-        when, negprio, seq, fn = self._heap[0]
-        if deadline is not None and when > deadline:
-            if self.sim:
-                self._time = deadline
-            return False
-        heapq.heappop(self._heap)
+        """Run one scheduled callback (or a batch of ready IO callbacks in
+        real mode); returns False if nothing to run before `deadline`."""
         if self.sim:
+            if not self._heap:
+                return False
+            when, negprio, seq, fn = self._heap[0]
+            if deadline is not None and when > deadline:
+                self._time = deadline
+                return False
+            heapq.heappop(self._heap)
             if when > self._time:
                 self._time = when
-        else:
-            delta = when - self.now()
-            if delta > 0:
-                _time.sleep(delta)
+            fn()
+            return True
+        # Real mode: fuse the timer heap with the IO reactor.  Wait for
+        # whichever comes first — the next timer, the deadline, or IO
+        # readiness — dispatching IO as it arrives (reference Net2::run).
+        has_io = bool(self._io_cbs)
+        while True:
+            now = self.now()
+            when = self._heap[0][0] if self._heap else None
+            if when is not None and when <= now:
+                break                       # a timer is due: run it below
+            target = when
+            if deadline is not None:
+                target = deadline if target is None else min(target, deadline)
+            if not has_io:
+                if when is None:
+                    return False            # no work at all
+                if deadline is not None and when > deadline:
+                    return False            # nothing before the deadline
+                _time.sleep(when - now)
+                break
+            timeout = None if target is None else max(0.0, target - now)
+            if self._poll_io(timeout):
+                return True                 # IO callbacks ran (may schedule)
+            if deadline is not None and self.now() >= deadline \
+                    and (when is None or when > deadline):
+                return False
+            if when is None:
+                continue                    # pure-IO loop: keep waiting
+        when, negprio, seq, fn = heapq.heappop(self._heap)
         fn()
         return True
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def run_forever(self) -> None:
+        """Serve until stop(): the real-mode process main loop."""
+        self._stopped = False
+        while not self._stopped:
+            if not self._step_once(None):
+                if not self._io_cbs and not self._heap:
+                    return   # truly no work left and no IO sources
+
 
     def drain(self, max_steps: int = 10_000_000) -> None:
         """Run until no work remains (sim only)."""

@@ -67,6 +67,15 @@ class SimNetwork:
             stream, _epoch = self._endpoints.pop(ep)
             stream.queue.break_buffered_replies()
 
+    def unregister_stream(self, stream: RequestStream) -> None:
+        """Drop ONE stream's endpoint (a replaced role halting while its
+        process lives on): senders from then on get broken_promise instead
+        of buffering into a queue nobody serves."""
+        ep = stream._endpoint
+        if ep is not None:
+            self._endpoints.pop(ep, None)
+        stream.queue.break_buffered_replies()
+
     # -- fault injection ----------------------------------------------------
     def clog_pair(self, a: str, b: str, seconds: float) -> None:
         """Delay all traffic between ips a and b for `seconds` (reference

@@ -1,0 +1,305 @@
+"""Tagged wire serialization for the full role-interface surface.
+
+Reference: the reference ships every request/reply/interface struct through
+FlowTransport using the classic field-order serializer plus the tagged
+(schema-evolving) ObjectSerializer (flow/serialize.h, flow/flat_buffers.h,
+fdbrpc/fdbrpc.h:595 — RequestStreams serialize as their Endpoint tokens).
+
+This module is the Python analog for the real TCP transport: a TYPE-TAGGED
+recursive encoder over the framework's value vocabulary, with a registry of
+message/interface classes keyed by stable class name.  No pickle: the format
+is explicit, versioned by the transport handshake, and only constructs
+registered types — a malformed peer frame cannot execute arbitrary code.
+
+Encoding rules:
+  * scalars: None, bool, int (i64 or big-int bytes), float, bytes, str
+  * containers: list, tuple, dict (encoded recursively)
+  * Enum / IntEnum: class name + value
+  * dataclasses (requests/replies, KeyRange, Mutation, ...): class name +
+    declared fields, SKIPPING the `reply` field — the transport carries the
+    reply token out-of-band, exactly like the reference's ReplyPromise
+    embedded token (fdbrpc.h: ReplyPromise serializes as an endpoint)
+  * interface classes (bundles of RequestStreams; registered explicitly):
+    instance __dict__, skipping private attrs and the sim-only `role`
+    backref; RequestStream values encode as their Endpoint
+  * RequestStream / RequestStreamStub: the Endpoint (address + token);
+    decoded as a client-half RequestStream whose endpoint is set — callers
+    use `.endpoint`, `.get_reply`, `.send` exactly as with a local stream
+  * FdbError: code + name + message (error replies)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+from typing import Any, Callable, Dict, Optional
+
+from ..core.error import ERROR_CODES, FdbError
+from ..core.wire import Reader, Writer
+from .endpoint import Endpoint, NetworkAddress, RequestStream, RequestStreamStub
+
+# -- type tags ---------------------------------------------------------------
+T_NONE = 0
+T_TRUE = 1
+T_FALSE = 2
+T_INT = 3          # i64
+T_BIGINT = 4       # arbitrary precision (sign byte + magnitude bytes)
+T_FLOAT = 5
+T_BYTES = 6
+T_STR = 7
+T_LIST = 8
+T_TUPLE = 9
+T_DICT = 10
+T_DATACLASS = 11
+T_OBJECT = 12      # registered plain class (interfaces): __dict__ encoding
+T_STREAM = 13      # RequestStream/Stub -> Endpoint
+T_ENDPOINT = 14
+T_ADDRESS = 15
+T_ENUM = 16
+T_ERROR = 17
+
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+# class name -> class, for dataclasses, interfaces, enums
+_REGISTRY: Dict[str, type] = {}
+_IS_INTERFACE: Dict[str, bool] = {}
+# cache: class -> list of field names to ship (dataclasses, minus `reply`)
+_FIELDS: Dict[type, list] = {}
+
+
+def register(cls: type, interface: bool = False) -> type:
+    """Register a class for wire transport.  `interface=True` marks plain
+    (non-dataclass) classes encoded via __dict__ (role interfaces)."""
+    name = cls.__name__
+    prev = _REGISTRY.get(name)
+    if prev is not None and prev is not cls:
+        raise FdbError(ERROR_CODES["internal_error"],
+                       message=f"serde name collision: {name}")
+    _REGISTRY[name] = cls
+    _IS_INTERFACE[name] = interface
+    return cls
+
+
+def register_module(mod) -> None:
+    """Register every dataclass and every *Interface class defined in
+    `mod` (classes merely imported into it are skipped)."""
+    for obj in vars(mod).values():
+        if not isinstance(obj, type) or obj.__module__ != mod.__name__:
+            continue
+        if dataclasses.is_dataclass(obj) or issubclass(obj, Enum):
+            register(obj)
+        elif obj.__name__.endswith("Interface"):
+            register(obj, interface=True)
+
+
+def _ship_fields(cls: type) -> list:
+    names = _FIELDS.get(cls)
+    if names is None:
+        names = [f.name for f in dataclasses.fields(cls)
+                 if f.name != "reply"]
+        _FIELDS[cls] = names
+    return names
+
+
+def encode_value(w: Writer, v: Any) -> None:
+    if v is None:
+        w.u8(T_NONE)
+    elif v is True:
+        w.u8(T_TRUE)
+    elif v is False:
+        w.u8(T_FALSE)
+    elif isinstance(v, Enum):
+        w.u8(T_ENUM).str_(type(v).__name__)
+        encode_value(w, v.value)
+    elif isinstance(v, int):
+        if _I64_MIN <= v <= _I64_MAX:
+            w.u8(T_INT).i64(v)
+        else:
+            neg = v < 0
+            mag = (-v if neg else v)
+            w.u8(T_BIGINT).u8(1 if neg else 0).bytes_(
+                mag.to_bytes((mag.bit_length() + 7) // 8, "little"))
+    elif isinstance(v, float):
+        import struct
+        w.u8(T_FLOAT)
+        w._parts.append(struct.pack("<d", v))
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        w.u8(T_BYTES).bytes_(bytes(v))
+    elif isinstance(v, str):
+        w.u8(T_STR).str_(v)
+    elif isinstance(v, (RequestStream, RequestStreamStub)):
+        ep = v.endpoint if isinstance(v, RequestStream) else v.ep
+        w.u8(T_STREAM)
+        _encode_endpoint(w, ep)
+    elif isinstance(v, Endpoint):
+        w.u8(T_ENDPOINT)
+        _encode_endpoint(w, v)
+    elif isinstance(v, NetworkAddress):
+        w.u8(T_ADDRESS).str_(v.ip).u32(v.port)
+    elif isinstance(v, FdbError):
+        w.u8(T_ERROR).u32(v.code).str_(v.name).str_(str(v))
+    elif isinstance(v, tuple):
+        w.u8(T_TUPLE).u32(len(v))
+        for x in v:
+            encode_value(w, x)
+    elif isinstance(v, list):
+        w.u8(T_LIST).u32(len(v))
+        for x in v:
+            encode_value(w, x)
+    elif isinstance(v, dict):
+        w.u8(T_DICT).u32(len(v))
+        for k, x in v.items():
+            encode_value(w, k)
+            encode_value(w, x)
+    elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+        cls = type(v)
+        name = cls.__name__
+        if _REGISTRY.get(name) is not cls:
+            raise FdbError(ERROR_CODES["internal_error"],
+                           message=f"unregistered dataclass {name}")
+        w.u8(T_DATACLASS).str_(name)
+        names = _ship_fields(cls)
+        w.u32(len(names))
+        for fname in names:
+            w.str_(fname)
+            encode_value(w, getattr(v, fname))
+    elif _REGISTRY.get(type(v).__name__) is type(v):
+        # registered interface class: __dict__ minus private/sim-only attrs
+        w.u8(T_OBJECT).str_(type(v).__name__)
+        items = [(k, x) for k, x in vars(v).items()
+                 if not k.startswith("_") and k != "role"]
+        w.u32(len(items))
+        for k, x in items:
+            w.str_(k)
+            encode_value(w, x)
+    else:
+        raise FdbError(ERROR_CODES["internal_error"],
+                       message=f"cannot serialize {type(v).__name__}")
+
+
+def _encode_endpoint(w: Writer, ep: Endpoint) -> None:
+    w.str_(ep.address.ip).u32(ep.address.port).str_(ep.token)
+
+
+def _decode_endpoint(r: Reader) -> Endpoint:
+    ip = r.str_()
+    port = r.u32()
+    token = r.str_()
+    return Endpoint(NetworkAddress(ip, port), token)
+
+
+def decode_value(r: Reader) -> Any:
+    tag = r.u8()
+    if tag == T_NONE:
+        return None
+    if tag == T_TRUE:
+        return True
+    if tag == T_FALSE:
+        return False
+    if tag == T_INT:
+        return r.i64()
+    if tag == T_BIGINT:
+        neg = r.u8()
+        v = int.from_bytes(r.bytes_(), "little")
+        return -v if neg else v
+    if tag == T_FLOAT:
+        import struct
+        v = struct.unpack_from("<d", r._d, r._o)[0]
+        r._o += 8
+        return v
+    if tag == T_BYTES:
+        return r.bytes_()
+    if tag == T_STR:
+        return r.str_()
+    if tag == T_STREAM:
+        ep = _decode_endpoint(r)
+        rs = RequestStream(ep.token.split(":", 1)[0])
+        rs.set_endpoint(ep)
+        return rs
+    if tag == T_ENDPOINT:
+        return _decode_endpoint(r)
+    if tag == T_ADDRESS:
+        ip = r.str_()
+        return NetworkAddress(ip, r.u32())
+    if tag == T_ERROR:
+        code = r.u32()
+        name = r.str_()
+        msg = r.str_()
+        return FdbError(code, name, msg)
+    if tag == T_TUPLE:
+        return tuple(decode_value(r) for _ in range(r.u32()))
+    if tag == T_LIST:
+        return [decode_value(r) for _ in range(r.u32())]
+    if tag == T_DICT:
+        n = r.u32()
+        out = {}
+        for _ in range(n):
+            k = decode_value(r)
+            out[k] = decode_value(r)
+        return out
+    if tag == T_ENUM:
+        cls = _required(r.str_())
+        return cls(decode_value(r))
+    if tag == T_DATACLASS:
+        cls = _required(r.str_())
+        n = r.u32()
+        kw = {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        for _ in range(n):
+            fname = r.str_()
+            val = decode_value(r)
+            if fname in known:     # unknown fields: skip (schema evolution)
+                kw[fname] = val
+        return cls(**kw)
+    if tag == T_OBJECT:
+        cls = _required(r.str_())
+        obj = cls.__new__(cls)
+        for _ in range(r.u32()):
+            k = r.str_()
+            setattr(obj, k, decode_value(r))
+        return obj
+    raise FdbError(ERROR_CODES["internal_error"],
+                   message=f"bad serde tag {tag}")
+
+
+def _required(name: str) -> type:
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        raise FdbError(ERROR_CODES["internal_error"],
+                       message=f"unknown serde type {name!r}")
+    return cls
+
+
+def encode_message(v: Any) -> bytes:
+    w = Writer()
+    encode_value(w, v)
+    return w.done()
+
+
+def decode_message(b: bytes) -> Any:
+    return decode_value(Reader(b))
+
+
+_bootstrapped = False
+
+
+def bootstrap_registry() -> None:
+    """Register every module that defines wire-visible types.  Idempotent;
+    called by the real transport at startup."""
+    global _bootstrapped
+    if _bootstrapped:
+        return
+    _bootstrapped = True
+    import importlib
+    for modname in (
+            "foundationdb_tpu.txn.types",
+            "foundationdb_tpu.server.interfaces",
+            "foundationdb_tpu.server.coordination",
+            "foundationdb_tpu.server.cluster_controller",
+            "foundationdb_tpu.server.master",
+            "foundationdb_tpu.server.ratekeeper",
+            "foundationdb_tpu.server.failure",
+            "foundationdb_tpu.server.status",
+            "foundationdb_tpu.server.data_distribution",
+    ):
+        register_module(importlib.import_module(modname))

@@ -20,6 +20,7 @@ from ..core.trace import Severity, TraceEvent
 from ..rpc.endpoint import RequestStream
 from .failure import WaitFailureRequest
 from .interfaces import (ClientDBInfo, ClusterControllerInterface,
+                         same_incarnation,
                          InitializeMasterRequest, MasterRegistrationRequest,
                          ServerDBInfo, WorkerInterface, WorkerRegistration)
 
@@ -68,7 +69,13 @@ class ClusterController:
     # -- serving -------------------------------------------------------------
     async def _serve_register_worker(self) -> None:
         async for req in self.interface.register_worker.queue:
-            if req.worker.id not in self.workers:
+            cur = self.workers.get(req.worker.id)
+            # Monitor every NEW INCARNATION (endpoint change), not just new
+            # ids: a rebooted worker whose re-registration beats the old
+            # monitor's broken-promise delivery would otherwise end up
+            # registered but unmonitored — its later death never removes
+            # it and recoveries recruit onto the corpse.
+            if cur is None or not same_incarnation(cur.worker, req.worker):
                 self._spawn(self._monitor_worker(req.worker.id, req.worker),
                             f"{self.id}.monitorWorker")
             self.workers[req.worker.id] = WorkerRegistration(
@@ -82,11 +89,16 @@ class ClusterController:
 
     async def _monitor_worker(self, wid: str, iface: WorkerInterface) -> None:
         """Drop dead workers from the recruitment pool (reference
-        workerAvailabilityWatch)."""
+        workerAvailabilityWatch).  Same-incarnation is judged by ENDPOINT,
+        not object identity: over the real transport every re-registration
+        (workers re-announce whenever their hosted role set changes)
+        delivers a fresh deserialized interface copy, and an identity
+        check would make dead workers unremovable — recoveries then
+        recruit onto corpses forever."""
         from .failure import wait_failure_of
         await wait_failure_of(iface)
         cur = self.workers.get(wid)
-        if cur is not None and cur.worker is iface:
+        if cur is not None and same_incarnation(cur.worker, iface):
             del self.workers[wid]
             TraceEvent("CCWorkerRemoved", Severity.Warn).detail(
                 "Worker", wid).log()
@@ -145,10 +157,35 @@ class ClusterController:
                 return False
             return any(reg.process_class in ("stateless", "unset")
                        for reg in self.workers.values())
+        if not ready():
+            TraceEvent("CCWaitingForWorkers").detail(
+                "Have", len(self.workers)).detail("Need", n).log()
         while not ready():
             p: Promise = Promise()
             self._worker_arrived.append(p)
             await p.get_future()
+        # Grace window for placement quality: the minimum pool may be all
+        # stateless workers that registered first; recruiting storage onto
+        # them just because the storage-class workers are 100ms late
+        # wrecks placement (observed: all storage tags on one stateless
+        # worker).  Bounded — a cluster genuinely without storage-class
+        # workers proceeds after the grace.
+        from ..core.futures import wait_any
+        from ..core.scheduler import get_event_loop
+
+        def storage_capable() -> int:
+            return sum(1 for r in self.workers.values()
+                       if r.process_class in ("storage", "unset"))
+
+        want = max(1, min(
+            getattr(self.config, "n_storage", 1),
+            sum(1 for r in self.workers.values()) + 4))
+        deadline = get_event_loop().now() + 2.0
+        while storage_capable() < want and \
+                get_event_loop().now() < deadline:
+            p = Promise()
+            self._worker_arrived.append(p)
+            await wait_any([p.get_future(), delay(0.25)])
 
     def _pick_master_worker(self) -> WorkerInterface:
         # Prefer stateless-class workers; deterministic order by id.
@@ -186,8 +223,15 @@ class ClusterController:
                     WaitFailureRequest())
             except FdbError as e:
                 TraceEvent("CCMasterDied", Severity.Warn).detail(
-                    "Error", e.name).log()
+                    "Error", e.name).detail("Message", str(e)).log()
                 await delay(0.1)
+            except Exception as e:  # noqa: BLE001 — the watch loop must
+                # NEVER die silently: a wedged CC stops all recruitment
+                # while still holding leadership (observed in real-mode
+                # cold-boot races).  Log loudly and keep recruiting.
+                TraceEvent("CCWatchDatabaseError", Severity.Error).detail(
+                    "Error", repr(e)).log()
+                await delay(0.5)
 
     def register_streams(self, process) -> None:
         """Register endpoints without serving: a candidate CC's endpoints

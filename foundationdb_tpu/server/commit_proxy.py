@@ -148,6 +148,7 @@ class CommitProxy:
         self.metrics = CounterCollection("CommitProxy", proxy_id)
         self.interface.role = self   # sim-side backref for status/tests
         self.broken = False   # set on mid-batch infrastructure failure
+        self._wait_failure_actor = None
         self._process = None   # owning SimProcess; set in run()
         # While a backup is active (\xff/backupStarted set), every user
         # mutation additionally rides BACKUP_TAG for the backup worker.
@@ -222,6 +223,15 @@ class CommitProxy:
             for req in batch:
                 if not req.reply.is_set():
                     req.reply.send_error(err("commit_unknown_result"))
+            # A broken proxy must DIE VISIBLY (reference: proxies die on
+            # tlog_stopped / resolver failure, taking the master with them
+            # so the CC recruits a fresh epoch).  Observed deadlock without
+            # this: an epoch whose TLog was locked by a superseded-but-
+            # healthy rival generation limps forever — commits fail, the
+            # master never ends, no new epoch is ever recruited.
+            if self._wait_failure_actor is not None and \
+                    not self._wait_failure_actor.is_ready():
+                self._wait_failure_actor.cancel()
 
     async def _commit_batch_impl(self, batch: List[CommitTransactionRequest],
                                  batch_num: int) -> None:
@@ -415,11 +425,25 @@ class CommitProxy:
 
     def _apply_metadata(self, m: Mutation) -> bool:
         """Side effects of one committed \xff mutation on this proxy
-        (reference ApplyMetadataMutation.cpp): shard-map boundaries and the
-        backup-active flag.  True if the mutation was metadata."""
+        (reference ApplyMetadataMutation.cpp): shard-map boundaries, the
+        backup-active flag, and storage-server registry (serverTag) rejoin
+        updates.  True if the mutation was metadata."""
         handled, backup_flag = apply_metadata_mutation(self.key_servers, m)
         if backup_flag is not None:
             self.backup_active = backup_flag
+        from .system_data import parse_server_tag_mutation
+        st = parse_server_tag_mutation(m)
+        if st is not None:
+            tag, iface = st
+            # Same incarnation (matching endpoints): keep the object we
+            # already hold — in simulation that is the live role object
+            # (with its status backref), and churning it for a decoded
+            # copy gains nothing.
+            from .interfaces import same_incarnation
+            cur = self.storage_interfaces.get(tag)
+            if not same_incarnation(cur, iface):
+                self.storage_interfaces[tag] = iface
+            handled = True
         return handled
 
     def _apply_foreign_state(self, resolutions) -> None:
@@ -557,6 +581,7 @@ class CommitProxy:
         process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
         process.spawn(self._serve_locations(), f"{self.id}.locations")
         from .failure import hold_wait_failure
-        process.spawn(hold_wait_failure(self.interface.wait_failure),
-                      f"{self.id}.waitFailure")
+        self._wait_failure_actor = process.spawn(
+            hold_wait_failure(self.interface.wait_failure),
+            f"{self.id}.waitFailure")
         TraceEvent("CommitProxyStarted").detail("Id", self.id).log()

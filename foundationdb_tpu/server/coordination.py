@@ -306,8 +306,12 @@ class CoordinationServer:
 
     def run(self, process) -> None:
         self._process = process
-        for s in self.streams():
-            process.register(s)
+        # Well-known tokens (reference WLTOKEN_* in
+        # fdbclient/CoordinationInterface.h:49): coordination endpoints must
+        # be addressable from the coordinator ADDRESS alone — it is the
+        # bootstrap surface every other discovery hangs off.
+        for s, tok in zip(self.streams(), WELL_KNOWN_COORD_TOKENS):
+            process.register(s, token=tok)
         process.spawn(self._startup(), f"{self.id}.startup")
         process.spawn(self._serve_reads(), f"{self.id}.reads")
         process.spawn(self._serve_writes(), f"{self.id}.writes")
@@ -315,6 +319,11 @@ class CoordinationServer:
         process.spawn(self._serve_leader_get(), f"{self.id}.leaderGet")
         process.spawn(self._serve_heartbeat(), f"{self.id}.heartbeat")
         process.spawn(self._expiry_loop(), f"{self.id}.expiry")
+
+
+WELL_KNOWN_COORD_TOKENS = (
+    "wl:coord.read", "wl:coord.write", "wl:coord.candidacy",
+    "wl:coord.heartbeat", "wl:coord.leaderGet")
 
 
 class CoordinationClientInterface:
@@ -326,6 +335,19 @@ class CoordinationClientInterface:
         self.candidacy = server.candidacy.endpoint
         self.heartbeat = server.heartbeat.endpoint
         self.leader_get = server.leader_get.endpoint
+
+    @classmethod
+    def at_address(cls, address) -> "CoordinationClientInterface":
+        """Endpoints derived from a coordinator address alone — the
+        cluster-file bootstrap path (reference ClusterConnectionString:
+        clients reach coordinators knowing only host:port, via the
+        well-known tokens)."""
+        from ..rpc.endpoint import Endpoint
+        self = cls.__new__(cls)
+        (self.reg_read, self.reg_write, self.candidacy, self.heartbeat,
+         self.leader_get) = (Endpoint(address, t)
+                             for t in WELL_KNOWN_COORD_TOKENS)
+        return self
 
 
 # ---------------------------------------------------------------------------

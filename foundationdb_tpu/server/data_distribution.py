@@ -256,8 +256,44 @@ class DataDistributor:
     async def _failure_monitor(self, tag: Tag, ssi) -> None:
         from .failure import wait_failure_of
         await wait_failure_of(ssi)
-        if tag in self.healthy:
+        # Ignore stale monitors: a rejoin may have replaced the interface.
+        if self.storage.get(tag) is ssi and tag in self.healthy:
             await self._handle_storage_failure(tag)
+
+    async def _registry_scan(self) -> None:
+        """Poll the serverTag registry (reference serverListKeys watch in
+        DDTeamCollection): a rebooted storage server commits its recovered
+        interface there; re-admit the tag — new interface, healthy again,
+        fresh failure monitor — so re-replication and moves can use it."""
+        from .system_data import (SERVER_TAG_END, SERVER_TAG_PREFIX,
+                                  decode_server_tag_value)
+        knobs = server_knobs()
+        while True:
+            await delay(float(knobs.DD_METRICS_INTERVAL))
+            try:
+                t = self.db.create_transaction()
+                t.access_system_keys = True
+                rows = await t.get_range(SERVER_TAG_PREFIX, SERVER_TAG_END)
+            except FdbError:
+                continue
+            for k, v in rows:
+                tag = int(k[len(SERVER_TAG_PREFIX):])
+                try:
+                    iface = decode_server_tag_value(v)
+                except FdbError:
+                    continue
+                cur = self.storage.get(tag)
+                cur_ep = getattr(getattr(cur, "wait_failure", None),
+                                 "endpoint", None) if cur else None
+                new_ep = getattr(iface.wait_failure, "endpoint", None)
+                if cur is not None and cur_ep == new_ep:
+                    continue
+                self.storage[tag] = iface
+                self.healthy.add(tag)
+                self._actors.append(self._process.spawn(
+                    self._failure_monitor(tag, iface),
+                    f"{self.id}.ssTracker"))
+                TraceEvent("DDStorageRejoined").detail("Tag", tag).log()
 
     # -- shard-size tracking (reference DataDistributionTracker) -------------
     async def _split_loop(self) -> None:
@@ -309,13 +345,26 @@ class DataDistributor:
                         "At", split_key).detail("Bytes", total).log()
 
     async def _check_removed(self, db_info_var, epoch: int) -> None:
-        """Halt when a newer epoch recruits a different DD (reference
-        checkRemoved, Resolver.actor.cpp:357-366): a deposed distributor
-        must not keep issuing moves against the new generation's state."""
+        """Halt when the announced transaction system carries a different
+        DD (reference checkRemoved, Resolver.actor.cpp:357-366): a deposed
+        or orphaned distributor must not keep issuing moves against the
+        live generation's state.  Covers BOTH a newer epoch and a FAILED
+        same-epoch recovery attempt whose successor succeeded (the orphan
+        case); identity is by endpoint, not object — announcements may be
+        deserialized copies on the real transport."""
+        def _same(a, b) -> bool:
+            if a is b:
+                return True
+            ea = getattr(getattr(a, "wait_failure", None), "_endpoint", None)
+            eb = getattr(getattr(b, "wait_failure", None), "_endpoint", None)
+            return ea is not None and ea == eb
         while True:
             info = db_info_var.get()
-            if info.epoch > epoch and \
-                    info.data_distributor is not self.interface:
+            if info.data_distributor is not None and \
+                    info.epoch >= epoch and \
+                    info.recovery_state in ("accepting_commits",
+                                            "fully_recovered") and \
+                    not _same(info.data_distributor, self.interface):
                 TraceEvent("DataDistributorHalted").detail(
                     "Id", self.id).detail("NewEpoch", info.epoch).log()
                 self.halted = True
@@ -328,6 +377,7 @@ class DataDistributor:
     def run(self, process, db_info_var=None, epoch: int = 0) -> None:
         self.halted = False
         self._actors = []
+        self._process = process
         for s in self.interface.streams():
             process.register(s)
         for tag, ssi in self.storage.items():
@@ -335,6 +385,8 @@ class DataDistributor:
                 self._failure_monitor(tag, ssi), f"{self.id}.ssTracker"))
         self._actors.append(process.spawn(self._split_loop(),
                                           f"{self.id}.shardTracker"))
+        self._actors.append(process.spawn(self._registry_scan(),
+                                          f"{self.id}.registryScan"))
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),
                       f"{self.id}.waitFailure")

@@ -1,0 +1,170 @@
+"""fdbserver: one real OS process of the cluster.
+
+Reference: fdbserver/fdbserver.actor.cpp:1655 main / worker.actor.cpp:2365
+fdbd() — a process locks its data dir, optionally serves coordination,
+campaigns for (or monitors) the cluster controller through the
+coordinators, and runs workerServer so the CC can recruit any role onto it.
+
+This is the REAL deployment plane: the same Worker / ClusterController /
+Coordination code that runs under deterministic simulation runs here over
+the real-IO reactor (core/scheduler.py) and the real TCP network
+(rpc/real_network.py).  Start one process per role-capable node:
+
+    python -m foundationdb_tpu.server.fdbserver \
+        --port 4500 --coordinators 127.0.0.1:4500 \
+        --datadir /tmp/fdb0 --class coordinator [--config '{"...": ...}']
+
+The first coordinator-class process whose --port appears in --coordinators
+serves the generation registers; stateless workers campaign for CC; the
+winning CC recruits master/proxies/resolvers/TLogs/storage exactly as in
+simulation.  Clients connect with client.database.connect("host:port,...").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from ..core.futures import AsyncVar
+from ..core.rng import DeterministicRandom, set_deterministic_random
+from ..core.scheduler import EventLoop, set_event_loop
+from ..core.trace import TraceEvent
+from ..rpc.endpoint import NetworkAddress
+from ..rpc.network import set_network
+from ..rpc.real_network import RealNetwork, RealProcess
+from .coordination import (CoordinationClientInterface, CoordinationServer,
+                           monitor_leader, try_become_leader)
+from .real_fs import RealFileSystem
+
+
+def parse_coordinators(spec: str) -> List[NetworkAddress]:
+    out = []
+    for part in spec.split(","):
+        host, port = part.strip().rsplit(":", 1)
+        out.append(NetworkAddress(host, int(port)))
+    return out
+
+
+def build_config(config_json: Optional[str]):
+    from .interfaces import DatabaseConfiguration
+    cfg = DatabaseConfiguration()
+    if config_json:
+        for k, v in json.loads(config_json).items():
+            setattr(cfg, k, v)
+    return cfg
+
+
+async def _cc_runner(process, cc, leader_var, my_change_id) -> None:
+    """Run the CC role while this process holds leadership; halt on
+    deposition (mirrors SimFdbCluster._cc_runner)."""
+    started = False
+    while True:
+        leader = leader_var.get()
+        is_me = leader is not None and leader.change_id == my_change_id
+        if is_me and not started:
+            cc.run(process)
+            started = True
+        elif not is_me and started:
+            cc.halt()
+            started = False
+        await leader_var.on_change()
+
+
+def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
+          process_class: str = "stateless", config=None,
+          ip: str = "127.0.0.1", name: str = "", seed: int = 0) -> None:
+    """Boot this process and serve forever."""
+    from .cluster_controller import ClusterController
+    from .worker import Worker
+
+    import os
+    from ..core.trace import Tracer, set_tracer
+    os.makedirs(datadir, exist_ok=True)
+    set_tracer(Tracer(path=os.path.join(datadir, "trace.jsonl")))
+    loop = EventLoop(sim=False)
+    set_event_loop(loop)
+    # Seed uniquely PER INCARNATION: a rebooted process must not regenerate
+    # its predecessor's endpoint tokens (stale requests could misdeliver to
+    # the new incarnation's streams), and its CC candidacy must carry a NEW
+    # change_id or leader monitors — which only react to change_id changes
+    # — would never observe the re-election.
+    import time as _time
+    set_deterministic_random(DeterministicRandom(
+        seed or ((os.getpid() << 16) ^ (_time.time_ns() & 0x7FFFFFFF)
+                 ) & 0x7FFFFFFF))
+    net = RealNetwork(loop, ip, port)
+    set_network(net)
+    fs = RealFileSystem(datadir)
+    proc = RealProcess(loop, net, name=name or f"fdbserver:{port}",
+                       process_class=process_class, fs=fs)
+
+    is_coordinator = any(c.ip == ip and c.port == port
+                         for c in coordinators)
+    if is_coordinator:
+        coord = CoordinationServer(f"coord.{port}", fs=fs)
+        coord.run(proc)
+
+    coord_clients = [CoordinationClientInterface.at_address(a)
+                     for a in coordinators]
+    leader_var: AsyncVar = AsyncVar(None)
+    # Stateless workers campaign for CC (a storage worker winning would put
+    # the control plane on a data node) — same policy as the simulation.
+    if process_class == "stateless":
+        from ..core.rng import deterministic_random
+        cc = ClusterController(f"cc.{port}", coord_clients, config)
+        cc.register_streams(proc)
+        # Random change_id: unique per incarnation (see seed note above).
+        change_id = deterministic_random().random_int(0, 1 << 30)
+        proc.spawn(try_become_leader(coord_clients, cc.interface,
+                                     leader_var, change_id=change_id),
+                   f"{proc.name}.campaign")
+        proc.spawn(_cc_runner(proc, cc, leader_var, change_id),
+                   f"{proc.name}.ccRunner")
+    else:
+        proc.spawn(monitor_leader(coord_clients, leader_var),
+                   f"{proc.name}.monitorLeader")
+
+    worker = Worker(proc, coord_clients, process_class=process_class,
+                    config=config)
+    worker.run(leader_var)
+
+    async def _flush_trace() -> None:
+        from ..core.scheduler import delay
+        from ..core.trace import get_tracer
+        while True:
+            await delay(0.5)
+            get_tracer().flush()
+
+    proc.spawn(_flush_trace(), f"{proc.name}.traceFlush")
+    TraceEvent("FdbServerStarted").detail("Address", str(proc.address)) \
+        .detail("Class", process_class).detail(
+        "Coordinator", is_coordinator).log()
+    loop.run_forever()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="fdbserver")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--ip", default="127.0.0.1")
+    ap.add_argument("--coordinators", required=True,
+                    help="comma-separated host:port list")
+    ap.add_argument("--datadir", required=True)
+    ap.add_argument("--class", dest="process_class", default="stateless",
+                    choices=["stateless", "storage", "coordinator"])
+    ap.add_argument("--config", default=None,
+                    help="DatabaseConfiguration overrides as JSON")
+    ap.add_argument("--name", default="")
+    args = ap.parse_args(argv)
+    # "coordinator" class == a stateless worker that also serves
+    # coordination if its address is in the coordinator list.
+    pclass = ("stateless" if args.process_class == "coordinator"
+              else args.process_class)
+    serve(args.port, parse_coordinators(args.coordinators), args.datadir,
+          process_class=pclass, config=build_config(args.config),
+          ip=args.ip, name=args.name)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,17 @@ Tag = int
 TXS_TAG: Tag = 0xFFFFFFFE
 
 
+def same_incarnation(a, b) -> bool:
+    """Do two interface handles name the SAME role incarnation?  Judged by
+    the wait_failure endpoint — wire deserialization makes object identity
+    meaningless across messages (every decode is a fresh copy)."""
+    if a is b:
+        return a is not None
+    ea = getattr(getattr(a, "wait_failure", None), "_endpoint", None)
+    eb = getattr(getattr(b, "wait_failure", None), "_endpoint", None)
+    return ea is not None and ea == eb
+
+
 class TransactionPriority:
     """GRV priorities (reference TransactionPriority, GrvProxyServer queues)."""
 

@@ -447,15 +447,32 @@ async def master_server(master: Master, process, coordinators,
             txs = await RequestStream.at(
                 old_tlogs[txs_holder].peek.endpoint).get_reply(
                 TLogPeekRequest(tag=TXS_TAG, begin=prev.map_version + 1))
-            from .system_data import apply_metadata_mutation
+            from .system_data import (apply_metadata_mutation,
+                                      parse_server_tag_mutation)
             n_deltas = 0
+            replayed_rejoins = {}
             for v, msgs in txs.messages:
                 if prev.map_version < v <= recovery_version:
                     for m in msgs:
                         _h, backup_flag = apply_metadata_mutation(map_rm, m)
                         if backup_flag is not None:
                             prev.backup_active = backup_flag
+                        st = parse_server_tag_mutation(m)
+                        if st is not None:
+                            # Storage rejoin committed since the cstate
+                            # snapshot: the registry interface supersedes
+                            # the snapshot's (a boot-time re-registration
+                            # this recovery observes directly still wins).
+                            replayed_rejoins[st[0]] = st[1]
                         n_deltas += 1
+            from .interfaces import same_incarnation
+            prev.storage_servers = {
+                t: (replayed_rejoins[t]
+                    if t in replayed_rejoins and
+                    not same_incarnation(prev.storage_servers.get(t),
+                                         replayed_rejoins[t])
+                    else i)
+                for t, i in prev.storage_servers.items()}
             # The flag may have turned ON since the durable snapshot: the
             # old generation's un-pulled backup stream must still carry
             # over or the capture would have a hole (the pre-lock check
@@ -655,7 +672,13 @@ async def master_server(master: Master, process, coordinators,
             "Reason", "recruited role failed").detail("RoleIdx", idx).log()
     except FdbError as e:
         TraceEvent("MasterRecoveryFailed", Severity.Warn).detail(
-            "Epoch", master.epoch).detail("Error", e.name).log()
+            "Epoch", master.epoch).detail("Error", e.name).detail(
+            "Message", str(e)).log()
+    except Exception as e:  # noqa: BLE001 — log non-Fdb errors loudly;
+        # the CC treats any master death the same (broken_promise) but the
+        # cause must be visible in the trace.
+        TraceEvent("MasterRecoveryFailed", Severity.Error).detail(
+            "Epoch", master.epoch).detail("Error", repr(e)).log()
     finally:
         for c in children:
             if not c.is_ready():

@@ -1,0 +1,106 @@
+"""Real filesystem with the SimFileSystem surface.
+
+Reference: fdbrpc/IAsyncFile.h — the same IAsyncFile interface is served by
+AsyncFileKAIO (real disk) and AsyncFileNonDurable (simulation).  Here the
+durable roles (DiskQueue, kvstore engines, coordination registers) are
+written against the SimFile surface (server/sim_fs.py); this module serves
+that surface from a real directory so the identical role code runs in real
+OS processes (server/fdbserver.py).
+
+IO is synchronous under the async signatures: writes/fsyncs on a local SSD
+are bounded and the durable actors already batch them (the reference's KAIO
+threadpool is an optimization this deployment plane can adopt later; the
+semantics — data is durable only after sync() — are identical).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from ..core.error import err
+
+
+class RealFile:
+    """One file opened read-write; pwrite/pread + fsync."""
+
+    def __init__(self, path: str, name: str) -> None:
+        self.name = name
+        self._path = path
+        flags = os.O_RDWR | os.O_CREAT
+        self._fd = os.open(path, flags, 0o644)
+        self.open = True
+
+    async def write(self, offset: int, data: bytes) -> None:
+        self._check_open()
+        os.pwrite(self._fd, bytes(data), offset)
+
+    async def truncate(self, size: int) -> None:
+        self._check_open()
+        os.ftruncate(self._fd, size)
+
+    async def sync(self) -> None:
+        self._check_open()
+        os.fsync(self._fd)
+
+    async def read(self, offset: int, length: int) -> bytes:
+        self._check_open()
+        return os.pread(self._fd, length, offset)
+
+    def size(self) -> int:
+        return os.fstat(self._fd).st_size
+
+    def _check_open(self) -> None:
+        if not self.open:
+            raise err("operation_failed", f"file {self.name} closed")
+
+    def close(self) -> None:
+        if self.open:
+            self.open = False
+            os.close(self._fd)
+
+
+class RealFileSystem:
+    """A directory as the per-process durable namespace."""
+
+    def __init__(self, datadir: str) -> None:
+        self.datadir = datadir
+        os.makedirs(datadir, exist_ok=True)
+        self._open_files = {}
+
+    @property
+    def files(self) -> List[str]:
+        return sorted(os.listdir(self.datadir))
+
+    def _path(self, name: str) -> str:
+        # Durable role files are flat names (tlog-X.wal, storage-N.btree);
+        # refuse anything that would escape the datadir.
+        if "/" in name or name.startswith("."):
+            raise err("operation_failed", f"bad file name {name!r}")
+        return os.path.join(self.datadir, name)
+
+    def open(self, name: str, create: bool = True):
+        f = self._open_files.get(name)
+        if f is not None and f.open:
+            return f
+        path = self._path(name)
+        if not create and not os.path.exists(path):
+            raise err("operation_failed", f"no such file {name}")
+        f = RealFile(path, name)
+        self._open_files[name] = f
+        return f
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self._path(name))
+
+    def delete(self, name: str) -> None:
+        # POSIX unlink semantics, same as SimFileSystem.delete: an already
+        # OPEN handle stays valid (writes go to the orphaned inode).  A
+        # replaced role still flushing through its old handle must not
+        # start raising — it gets halted separately; closing here would
+        # turn a benign orphan write into a process-fatal engine error.
+        self._open_files.pop(name, None)
+        try:
+            os.unlink(self._path(name))
+        except FileNotFoundError:
+            pass

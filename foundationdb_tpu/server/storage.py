@@ -621,6 +621,8 @@ class StorageServer:
         if self._process is not None:
             self._pull_actor = self._process.spawn(
                 self._pull_loop(), f"{self.id}.update")
+            if hasattr(self, "_role_actors"):
+                self._role_actors.append(self._pull_actor)
 
     async def _rebuild_engine(self, version: Version) -> None:
         self.engine.clear(b"", b"\xff\xff\xff")
@@ -639,35 +641,63 @@ class StorageServer:
 
     def run(self, process) -> None:
         self._process = process
+        a = self._role_actors = []
         for s in self.interface.streams():
             process.register(s)
         self._pull_actor = process.spawn(self._pull_loop(), f"{self.id}.update")
+        a.append(self._pull_actor)
         if self.engine is not None:
-            process.spawn(self._update_storage_loop(),
-                          f"{self.id}.updateStorage")
-        process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
-        process.spawn(self._serve(self.interface.get_value.queue,
-                                  self._get_value), f"{self.id}.getValue")
-        process.spawn(self._serve(self.interface.get_key_values.queue,
-                                  self._get_key_values),
-                      f"{self.id}.getKeyValues")
-        process.spawn(self._serve(self.interface.watch_value.queue,
-                                  self._watch_value), f"{self.id}.watch")
-        process.spawn(self._serve(self.interface.queuing_metrics.queue,
-                                  self._queuing_metrics),
-                      f"{self.id}.queuingMetrics")
-        process.spawn(self._serve(self.interface.fetch_keys.queue,
-                                  self._fetch_keys), f"{self.id}.fetchKeys")
-        process.spawn(self._serve(self.interface.fetch_shard.queue,
-                                  self._fetch_shard), f"{self.id}.fetchShard")
-        process.spawn(self._serve(self.interface.shard_metrics.queue,
-                                  self._shard_metrics),
-                      f"{self.id}.shardMetrics")
-        process.spawn(self._serve(self.interface.remove_shard.queue,
-                                  self._remove_shard),
-                      f"{self.id}.removeShard")
+            a.append(process.spawn(self._update_storage_loop(),
+                                   f"{self.id}.updateStorage"))
+        a.append(process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics"))
+        a.append(process.spawn(self._serve(self.interface.get_value.queue,
+                                           self._get_value),
+                               f"{self.id}.getValue"))
+        a.append(process.spawn(self._serve(self.interface.get_key_values.queue,
+                                           self._get_key_values),
+                               f"{self.id}.getKeyValues"))
+        a.append(process.spawn(self._serve(self.interface.watch_value.queue,
+                                           self._watch_value),
+                               f"{self.id}.watch"))
+        a.append(process.spawn(self._serve(
+            self.interface.queuing_metrics.queue, self._queuing_metrics),
+            f"{self.id}.queuingMetrics"))
+        a.append(process.spawn(self._serve(self.interface.fetch_keys.queue,
+                                           self._fetch_keys),
+                               f"{self.id}.fetchKeys"))
+        a.append(process.spawn(self._serve(self.interface.fetch_shard.queue,
+                                           self._fetch_shard),
+                               f"{self.id}.fetchShard"))
+        a.append(process.spawn(self._serve(self.interface.shard_metrics.queue,
+                                           self._shard_metrics),
+                               f"{self.id}.shardMetrics"))
+        a.append(process.spawn(self._serve(self.interface.remove_shard.queue,
+                                           self._remove_shard),
+                               f"{self.id}.removeShard"))
         from .failure import hold_wait_failure
-        process.spawn(hold_wait_failure(self.interface.wait_failure),
-                      f"{self.id}.waitFailure")
+        a.append(process.spawn(hold_wait_failure(self.interface.wait_failure),
+                               f"{self.id}.waitFailure"))
         TraceEvent("StorageServerStarted").detail("Id", self.id).detail(
+            "Tag", self.tag).log()
+
+    def halt(self) -> None:
+        """Tear down a REPLACED storage role (a failed recovery recruited a
+        successor with the same tag): cancel every actor, UNREGISTER the
+        endpoints (so later requests — including wait_failure monitors —
+        get broken_promise instead of buffering into queues nobody serves)
+        and break already-buffered reply promises so callers fail over
+        instead of hanging.  A halted orphan must not keep pulling — and
+        POPPING — the shared tag, or it could trim log data its successor
+        has not applied yet."""
+        for f in getattr(self, "_role_actors", []):
+            if not f.is_ready():
+                f.cancel()
+        from ..rpc.network import get_network
+        net = get_network()
+        for s in self.interface.streams():
+            if hasattr(net, "unregister_stream"):
+                net.unregister_stream(s)
+            else:
+                s.queue.break_buffered_replies()
+        TraceEvent("StorageServerHalted").detail("Id", self.id).detail(
             "Tag", self.tag).log()

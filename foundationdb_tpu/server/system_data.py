@@ -27,6 +27,13 @@ SYSTEM_KEYS_END = b"\xff\xff"
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 KEY_SERVERS_END = b"\xff/keyServers0"
 SERVER_LIST_PREFIX = b"\xff/serverList/"
+# Tag -> serialized StorageServerInterface: the storage-server registry
+# (reference serverListKey, SystemData.cpp serverListKeyFor).  A rebooted
+# storage process REJOINS by committing its disk-recovered interface here;
+# proxies apply the mutation to their tag-routing maps (no epoch bounce)
+# and the data distributor re-admits the tag on its registry scan.
+SERVER_TAG_PREFIX = b"\xff/serverTag/"
+SERVER_TAG_END = b"\xff/serverTag0"
 BACKUP_STARTED_KEY = b"\xff/backupStarted"
 
 # All user mutations additionally ride this tag while a backup is active
@@ -55,6 +62,31 @@ def decode_key_servers_value(blob: bytes) -> List[Tag]:
 
 def is_system_key(key: bytes) -> bool:
     return key >= SYSTEM_KEYS_BEGIN
+
+
+def server_tag_key(tag: Tag) -> bytes:
+    return SERVER_TAG_PREFIX + b"%010d" % tag
+
+
+def server_tag_value(interface) -> bytes:
+    from ..rpc import serde
+    serde.bootstrap_registry()
+    return serde.encode_message(interface)
+
+
+def decode_server_tag_value(blob: bytes):
+    from ..rpc import serde
+    serde.bootstrap_registry()
+    return serde.decode_message(blob)
+
+
+def parse_server_tag_mutation(m: Mutation):
+    """(tag, interface) if `m` is a serverTag registry write, else None."""
+    if m.type != MutationType.SetValue or \
+            not m.param1.startswith(SERVER_TAG_PREFIX):
+        return None
+    tag = int(m.param1[len(SERVER_TAG_PREFIX):])
+    return tag, decode_server_tag_value(m.param2)
 
 
 def apply_metadata_mutation(key_servers: RangeMap, m: Mutation):

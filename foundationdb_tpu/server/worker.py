@@ -35,14 +35,26 @@ class Worker:
         self.interface = WorkerInterface(process.name)
         self.db_info: AsyncVar = AsyncVar(ServerDBInfo())
         self.storage_roles: List[StorageServer] = []
-        # Disk-recovered roles found by the boot scan, reported to the CC
-        # in RegisterWorkerRequest so master recovery can resolve them.
+        # EVERY log/storage role hosted on this worker, keyed by id/tag:
+        # disk-recovered roles from the boot scan AND live recruited ones.
+        # Reported to the CC in RegisterWorkerRequest so master recovery
+        # can re-resolve a PACKED cstate's role ids to live interfaces —
+        # a restarted coordinator returns ids only, and the roles it names
+        # may be alive on workers that never rebooted (so a boot-scan-only
+        # registry cannot resolve them).
         self.recovered_logs: Dict[str, Any] = {}
         self.recovered_storage: Dict[int, Any] = {}
+        self._current_cc = None
         from ..core.futures import Promise
         self._scanned: Promise = Promise()
 
     def _fs(self):
+        # Real-mode processes carry their machine filesystem directly
+        # (server/real_fs.py); sim processes share their machine's
+        # SimFileSystem through the simulator registry.
+        fs = getattr(self.process, "fs", None)
+        if fs is not None:
+            return fs
         from ..rpc.sim import get_simulator
         return get_simulator().fs_for(self.process)
 
@@ -82,36 +94,99 @@ class Worker:
                     "Worker", self.process.name).detail(
                     "TLogs", len(self.recovered_logs)).detail(
                     "Storage", len(self.recovered_storage)).log()
+            if self.recovered_storage:
+                self._rejoin_f = self.process.spawn(
+                    self._rejoin_storage(), f"{self.process.name}.ssRejoin")
         finally:
             self._scanned.send(None)
 
-    # -- role instantiation --------------------------------------------------
-    async def _serve_init_master(self) -> None:
-        from .master import Master, master_server
-        async for req in self.interface.init_master.queue:
-            master = Master(epoch=req.epoch)
-            self.process.spawn(
-                master_server(master, self.process, self.coordinators,
-                              self.config, req.cc),
-                f"{self.process.name}.master")
-            req.reply.send(master.interface)
+    async def _commit_server_tags(self, tags: Dict[int, Any]) -> None:
+        """Write {tag: interface} into the serverTag registry through the
+        database (reference: storage servers update serverListKey via a
+        transaction, storageserver.actor.cpp storageServerRejoin /
+        SystemData serverListKeyFor).  Proxies apply the mutation to their
+        routing maps and the DD's registry scan follows it — so the
+        registry must ALWAYS carry the newest incarnation, for rejoins
+        AND fresh recruitments alike (a stale entry would let the scan
+        resurrect a halted orphan interface).  Retries forever: the
+        commit pipeline may itself still be recovering."""
+        from ..client.database import ClusterConnection, Database
+        from ..core.error import FdbError
+        from ..core.scheduler import delay
+        from .system_data import server_tag_key, server_tag_value
+        db = Database(ClusterConnection(self.coordinators))
+        try:
+            t = db.create_transaction()
+            t.access_system_keys = True
+            while True:
+                try:
+                    for tag, iface in tags.items():
+                        t.set(server_tag_key(tag), server_tag_value(iface))
+                    await t.commit()
+                    TraceEvent("SSRegistryCommitted").detail(
+                        "Worker", self.process.name).detail(
+                        "Tags", sorted(tags)).log()
+                    return
+                except FdbError as e:
+                    await t.on_error(e)
+                except Exception as e:  # noqa: BLE001 — e.g. pipeline not
+                    # up yet (no proxies): plain backoff, then retry
+                    TraceEvent("SSRegistryRetry").detail(
+                        "Error", repr(e)).log()
+                    await delay(1.0)
+                    t = db.create_transaction()
+                    t.access_system_keys = True
+        finally:
+            close = getattr(db.cluster, "close", None)
+            if close is not None:
+                close()
 
-    async def _serve_init_tlog(self) -> None:
+    async def _rejoin_storage(self) -> None:
+        await self._commit_server_tags(dict(self.recovered_storage))
+
+    # -- role instantiation --------------------------------------------------
+    async def _serve_inits(self, queue, handler, name: str) -> None:
+        """Guarded init-request loop: one failing recruitment must reply
+        its error and NOT kill the serve loop — a dead loop silently
+        breaks every later recruitment on this worker (observed: one
+        failed init_tlog wedged all subsequent epoch recoveries)."""
+        async for req in queue:
+            try:
+                await handler(req)
+            except Exception as e:  # noqa: BLE001
+                TraceEvent("WorkerInitFailed", Severity.Error).detail(
+                    "Init", name).detail(
+                    "Worker", self.process.name).detail(
+                    "Error", repr(e)).log()
+                if req.reply is not None and not req.reply.is_set():
+                    req.reply.send_error(e)
+
+    async def _init_master(self, req) -> None:
+        from .master import Master, master_server
+        master = Master(epoch=req.epoch)
+        self.process.spawn(
+            master_server(master, self.process, self.coordinators,
+                          self.config, req.cc),
+            f"{self.process.name}.master")
+        req.reply.send(master.interface)
+
+    async def _init_tlog(self, req) -> None:
         from .disk_queue import DiskQueue
-        async for req in self.interface.init_tlog.queue:
-            # A failed recovery attempt at the same epoch may have left a
-            # partial WAL under this id; a fresh generation must not write
-            # over a stale synced tail the recovery scan could walk into.
-            self._fs().delete(f"tlog-{req.tlog_id}.wal")
-            queue = DiskQueue(self._fs().open(f"tlog-{req.tlog_id}.wal"))
-            tlog = TLog(req.tlog_id, req.recovery_version, epoch=req.epoch,
-                        disk_queue=queue)
-            tlog.run(self.process)
-            if req.recover_tags:
-                await tlog.recover_from(req.recover_tags, req.recover_popped,
-                                        req.recovery_version)
-            self._gc_tlog_files(req.epoch)
-            req.reply.send(tlog.interface)
+        # A failed recovery attempt at the same epoch may have left a
+        # partial WAL under this id; a fresh generation must not write
+        # over a stale synced tail the recovery scan could walk into.
+        self._fs().delete(f"tlog-{req.tlog_id}.wal")
+        queue = DiskQueue(self._fs().open(f"tlog-{req.tlog_id}.wal"))
+        tlog = TLog(req.tlog_id, req.recovery_version, epoch=req.epoch,
+                    disk_queue=queue)
+        tlog.run(self.process)
+        if req.recover_tags:
+            await tlog.recover_from(req.recover_tags, req.recover_popped,
+                                    req.recovery_version)
+        self._gc_tlog_files(req.epoch)
+        self.recovered_logs[req.tlog_id] = tlog.interface
+        self._announce_roles()
+        req.reply.send(tlog.interface)
 
     def _gc_tlog_files(self, epoch: int) -> None:
         """Delete local TLog files two or more generations old: epoch e
@@ -131,86 +206,115 @@ class Worker:
             if file_epoch <= epoch - 2:
                 fs.delete(name)
 
-    async def _serve_init_commit_proxy(self) -> None:
-        async for req in self.interface.init_commit_proxy.queue:
-            key_resolvers: RangeMap = RangeMap(default=0)
-            for b, e, idx in req.key_resolvers_ranges:
-                key_resolvers.set_range(b, e, idx)
-            key_servers: RangeMap = RangeMap(default=None)
-            for b, e, tags in req.key_servers_ranges:
-                key_servers.set_range(b, e, tags)
-            proxy = CommitProxy(
-                req.proxy_id, req.master, req.resolvers,
-                LogSystemClient(req.tlogs,
-                                replication=self._log_replication()),
-                key_resolvers, key_servers, req.storage_interfaces,
-                req.recovery_version)
-            proxy.backup_active = req.backup_active
-            proxy.run(self.process)
-            req.reply.send(proxy.interface)
+    async def _init_commit_proxy(self, req) -> None:
+        key_resolvers: RangeMap = RangeMap(default=0)
+        for b, e, idx in req.key_resolvers_ranges:
+            key_resolvers.set_range(b, e, idx)
+        key_servers: RangeMap = RangeMap(default=None)
+        for b, e, tags in req.key_servers_ranges:
+            key_servers.set_range(b, e, tags)
+        proxy = CommitProxy(
+            req.proxy_id, req.master, req.resolvers,
+            LogSystemClient(req.tlogs,
+                            replication=self._log_replication()),
+            key_resolvers, key_servers, req.storage_interfaces,
+            req.recovery_version)
+        proxy.backup_active = req.backup_active
+        proxy.run(self.process)
+        req.reply.send(proxy.interface)
 
     def _log_replication(self) -> int:
         return getattr(self.config, "log_replication", 1) if self.config else 1
 
-    async def _serve_init_grv_proxy(self) -> None:
-        async for req in self.interface.init_grv_proxy.queue:
-            proxy = GrvProxy(req.proxy_id, req.master, req.tlogs,
-                             ratekeeper=req.ratekeeper)
-            proxy.run(self.process)
-            req.reply.send(proxy.interface)
+    async def _init_grv_proxy(self, req) -> None:
+        proxy = GrvProxy(req.proxy_id, req.master, req.tlogs,
+                         ratekeeper=req.ratekeeper)
+        proxy.run(self.process)
+        req.reply.send(proxy.interface)
 
-    async def _serve_init_ratekeeper(self) -> None:
+    async def _init_ratekeeper(self, req) -> None:
         from .ratekeeper import Ratekeeper
-        async for req in self.interface.init_ratekeeper.queue:
-            rk = Ratekeeper(req.rk_id, req.storage_interfaces)
-            rk.run(self.process)
-            req.reply.send(rk.interface)
+        rk = Ratekeeper(req.rk_id, req.storage_interfaces)
+        rk.run(self.process)
+        req.reply.send(rk.interface)
 
-    async def _serve_init_data_distributor(self) -> None:
+    async def _init_data_distributor(self, req) -> None:
         from ..client.database import ClusterConnection, Database
         from .data_distribution import DataDistributor
-        async for req in self.interface.init_data_distributor.queue:
-            db = Database(ClusterConnection(self.coordinators))
-            dd = DataDistributor(req.dd_id, db, req.storage_interfaces,
-                                 req.key_servers_ranges,
-                                 replication=req.replication)
-            dd.run(self.process, db_info_var=self.db_info, epoch=req.epoch)
-            req.reply.send(dd.interface)
+        db = Database(ClusterConnection(self.coordinators))
+        dd = DataDistributor(req.dd_id, db, req.storage_interfaces,
+                             req.key_servers_ranges,
+                             replication=req.replication)
+        dd.run(self.process, db_info_var=self.db_info, epoch=req.epoch)
+        req.reply.send(dd.interface)
 
-    async def _serve_init_resolver(self) -> None:
-        async for req in self.interface.init_resolver.queue:
-            backend = getattr(self.config, "conflict_backend", None) \
-                if self.config else None
-            r = Resolver(req.resolver_id, req.recovery_version,
-                         backend=backend, proxy_ids=req.proxy_ids)
-            r.run(self.process)
-            req.reply.send(r.interface)
+    async def _init_resolver(self, req) -> None:
+        backend = getattr(self.config, "conflict_backend", None) \
+            if self.config else None
+        r = Resolver(req.resolver_id, req.recovery_version,
+                     backend=backend, proxy_ids=req.proxy_ids)
+        r.run(self.process)
+        req.reply.send(r.interface)
 
-    async def _serve_init_storage(self) -> None:
+    async def _init_storage(self, req) -> None:
         from .kvstore import open_kv_store
         from .storage import _META_KEY
-        async for req in self.interface.init_storage.queue:
-            info = self.db_info.get()
-            ls = LogSystemClient(info.tlogs,
-                                 replication=self._log_replication()) \
-                if info.tlogs else None
-            # init_storage only happens before any commit was ever acked
-            # (cold boot / failed first recovery): stale files are safe to
-            # wipe, and must be (same stale-tail hazard as init_tlog).
-            self._fs().delete(f"storage-{req.tag}.wal")
-            self._fs().delete(f"storage-{req.tag}.snap")
-            self._fs().delete(f"storage-{req.tag}.btree")
-            engine_name = getattr(self.config, "storage_engine", "memory")                 if self.config else "memory"
-            engine = open_kv_store(engine_name, self._fs(),
-                                   f"storage-{req.tag}")
-            ss = StorageServer(req.ss_id, req.tag, ls, engine=engine)
-            # Seed the engine's identity metadata durably before serving so
-            # a power failure at any later point finds a recoverable store.
-            engine.set(_META_KEY, ss._meta_blob(0))
-            await engine.commit()
-            ss.run(self.process)
-            self.storage_roles.append(ss)
-            req.reply.send(ss.interface)
+        # A previous recruitment of this tag on this worker (an earlier
+        # recovery attempt that later failed) is now REPLACED: halt it
+        # before wiping its files, or the orphan keeps pulling — and
+        # popping — the shared tag alongside its successor.
+        for old in [s for s in self.storage_roles if s.tag == req.tag]:
+            old.halt()
+            self.storage_roles.remove(old)
+        # A still-retrying rejoin commit carries the REPLACED
+        # interface; cancel it so it cannot land after (and clobber)
+        # this recruitment's registry write.
+        rejoin_f = getattr(self, "_rejoin_f", None)
+        if rejoin_f is not None and not rejoin_f.is_ready():
+            rejoin_f.cancel()
+        info = self.db_info.get()
+        ls = LogSystemClient(info.tlogs,
+                             replication=self._log_replication()) \
+            if info.tlogs else None
+        # init_storage only happens before any commit was ever acked
+        # (cold boot / failed first recovery): stale files are safe to
+        # wipe, and must be (same stale-tail hazard as init_tlog).
+        self._fs().delete(f"storage-{req.tag}.wal")
+        self._fs().delete(f"storage-{req.tag}.snap")
+        self._fs().delete(f"storage-{req.tag}.btree")
+        engine_name = getattr(self.config, "storage_engine", "memory")                 if self.config else "memory"
+        engine = open_kv_store(engine_name, self._fs(),
+                               f"storage-{req.tag}")
+        ss = StorageServer(req.ss_id, req.tag, ls, engine=engine)
+        # Seed the engine's identity metadata durably before serving so
+        # a power failure at any later point finds a recoverable store.
+        engine.set(_META_KEY, ss._meta_blob(0))
+        await engine.commit()
+        ss.run(self.process)
+        self.storage_roles.append(ss)
+        self.recovered_storage[req.tag] = ss.interface
+        self._announce_roles()
+        # Keep the serverTag registry on the NEWEST incarnation: a
+        # stale rejoin entry from a replaced role must not win the
+        # DD's registry scan over this recruitment.
+        self.process.spawn(
+            self._commit_server_tags({req.tag: ss.interface}),
+            f"{self.process.name}.ssRegistry")
+        req.reply.send(ss.interface)
+
+    def _announce_roles(self) -> None:
+        """Refresh the CC's registry entry after this worker's hosted role
+        set changed (reference registrationClient re-registers on change):
+        a master recovering from a PACKED cstate resolves role ids through
+        these registrations."""
+        if self._current_cc is None:
+            return
+        RequestStream.at(self._current_cc.register_worker.endpoint).send(
+            RegisterWorkerRequest(
+                worker=self.interface,
+                process_class=self.process_class,
+                recovered_logs=dict(self.recovered_logs),
+                recovered_storage=dict(self.recovered_storage)))
 
     async def _serve_wait_failure(self) -> None:
         """Hold requests forever; process death breaks their promises —
@@ -249,14 +353,13 @@ class Worker:
             new_cc = leader.serialized_info if leader else None
             if new_cc is not cc:
                 cc = new_cc
+                self._current_cc = cc
                 known_version = -1
                 if cc is not None:
-                    RequestStream.at(cc.register_worker.endpoint).send(
-                        RegisterWorkerRequest(
-                            worker=self.interface,
-                            process_class=self.process_class,
-                            recovered_logs=dict(self.recovered_logs),
-                            recovered_storage=dict(self.recovered_storage)))
+                    TraceEvent("WorkerRegistering").detail(
+                        "Worker", self.process.name).detail(
+                        "CC", getattr(cc, "id", "?")).log()
+                    self._announce_roles()
             if cc is None:
                 await leader_var.on_change()
                 continue
@@ -288,15 +391,22 @@ class Worker:
         for s in self.interface.streams():
             p.register(s)
         p.spawn(self._boot_scan(), f"{p.name}.bootScan")
-        p.spawn(self._serve_init_master(), f"{p.name}.initMaster")
-        p.spawn(self._serve_init_tlog(), f"{p.name}.initTLog")
-        p.spawn(self._serve_init_commit_proxy(), f"{p.name}.initProxy")
-        p.spawn(self._serve_init_grv_proxy(), f"{p.name}.initGrv")
-        p.spawn(self._serve_init_resolver(), f"{p.name}.initResolver")
-        p.spawn(self._serve_init_storage(), f"{p.name}.initStorage")
-        p.spawn(self._serve_init_ratekeeper(), f"{p.name}.initRatekeeper")
-        p.spawn(self._serve_init_data_distributor(),
-                f"{p.name}.initDataDistributor")
+        inits = [
+            (self.interface.init_master, self._init_master, "master"),
+            (self.interface.init_tlog, self._init_tlog, "tlog"),
+            (self.interface.init_commit_proxy, self._init_commit_proxy,
+             "commitProxy"),
+            (self.interface.init_grv_proxy, self._init_grv_proxy, "grvProxy"),
+            (self.interface.init_resolver, self._init_resolver, "resolver"),
+            (self.interface.init_storage, self._init_storage, "storage"),
+            (self.interface.init_ratekeeper, self._init_ratekeeper,
+             "ratekeeper"),
+            (self.interface.init_data_distributor,
+             self._init_data_distributor, "dataDistributor"),
+        ]
+        for stream, handler, name in inits:
+            p.spawn(self._serve_inits(stream.queue, handler, name),
+                    f"{p.name}.init:{name}")
         p.spawn(self._serve_wait_failure(), f"{p.name}.waitFailure")
         p.spawn(self._watch_db_info(), f"{p.name}.watchDbInfo")
         p.spawn(self._register_loop(leader_var), f"{p.name}.register")

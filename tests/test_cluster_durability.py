@@ -116,10 +116,14 @@ def test_storage_worker_power_fail_recovers_from_engine(teardown):  # noqa: F811
         # storage role, then force an epoch change: recovery resolves the
         # storage tag to the recovered interface (until DataDistribution
         # lands, re-registration is adopted at recovery time).
+        # Workers announce LIVE roles too, so recovered_storage alone no
+        # longer distinguishes the rebooted incarnation — match on the new
+        # process address.
         while True:
             cc = c.current_cc()
             reg = cc.workers.get("worker0") if cc is not None else None
-            if reg is not None and reg.recovered_storage:
+            if reg is not None and reg.recovered_storage and \
+                    reg.worker.init_storage.endpoint.address == p.address:
                 break
             await delay(0.1)
         master_proc = c.process_of(c.current_cc().db_info.master)

@@ -1,0 +1,152 @@
+"""Real multi-process cluster: OS processes, real sockets, real disks.
+
+VERDICT round-3 item 2 done-criteria: a pytest spawning >= 3 OS processes
+on localhost (coordinator + roles), passing CycleTest, then killing one
+process and observing recovery over real sockets.  Reference:
+flow/Net2.actor.cpp:1400 (real reactor), fdbrpc/FlowTransport.actor.cpp:355,
+:919 (wire handshake + token dispatch) — here core/scheduler.py reactor +
+rpc/real_network.py + rpc/serde.py carrying the FULL role-interface surface
+(the same Worker/CC/Coordination code that runs under simulation).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 47400
+COORDS = f"127.0.0.1:{BASE_PORT}"
+CONFIG = json.dumps({"n_storage": 2, "min_workers": 3})
+
+NAMES = {"coord0": (BASE_PORT, "stateless"),
+         "stateless1": (BASE_PORT + 1, "stateless"),
+         "storage0": (BASE_PORT + 2, "storage"),
+         "storage1": (BASE_PORT + 3, "storage")}
+
+
+def _spawn(base, name, suffix=""):
+    port, pclass = NAMES[name]
+    cmd = [sys.executable, "-m", "foundationdb_tpu.server.fdbserver",
+           "--port", str(port), "--coordinators", COORDS,
+           "--datadir", os.path.join(base, name), "--class", pclass,
+           "--config", CONFIG, "--name", name + suffix]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=open(os.path.join(base, name + suffix + ".out"), "wb"),
+        stderr=subprocess.STDOUT)
+
+
+@pytest.fixture
+def real_cluster(tmp_path):
+    base = str(tmp_path)
+    procs = {n: _spawn(base, n) for n in NAMES}
+    # Client world in THIS process: real loop + real network.
+    from foundationdb_tpu.client.database import open_cluster
+    from foundationdb_tpu.core.scheduler import set_event_loop
+    from foundationdb_tpu.rpc.network import set_network
+    time.sleep(2.5)
+    dead = {n: p.poll() for n, p in procs.items() if p.poll() is not None}
+    assert not dead, f"processes died at boot: {dead}"
+    loop, db = open_cluster(COORDS)
+    try:
+        yield base, procs, loop, db
+    finally:
+        for p in procs.values():
+            p.kill()
+        for p in procs.values():
+            p.wait()
+        set_network(None)
+        set_event_loop(None)
+
+
+async def _commit_kv(db, k, v):
+    t = db.create_transaction()
+    while True:
+        try:
+            t.set(k, v)
+            return await t.commit()
+        except Exception as e:
+            await t.on_error(e)
+
+
+async def _read_key(db, k):
+    t = db.create_transaction()
+    while True:
+        try:
+            return await t.get(k)
+        except Exception as e:
+            await t.on_error(e)
+
+
+def test_real_cluster_cycle_and_kill_recovery(real_cluster):
+    base, procs, loop, db = real_cluster
+    from foundationdb_tpu.testing.workloads import CycleWorkload
+
+    async def cycle_phase():
+        w = CycleWorkload(None, db, {"testDuration": 2.0, "actorCount": 2,
+                                     "nodeCount": 12})
+        await w.setup()
+        await w.start()
+        assert await w.check(), "cycle invariant violated"
+        return w.metrics.get("swaps", 0)
+
+    swaps = loop.run_until(loop.spawn(cycle_phase()), timeout=90)
+    assert swaps > 0, "no swap transactions committed"
+
+    # Kill the process hosting the TLog — a transaction-system member —
+    # and restart it from its datadir (the fdbmonitor role).  The master
+    # locks the disk-recovered old TLog generation and recovers into a new
+    # epoch; committed data must survive.
+    victim = next(n for n in NAMES
+                  if os.path.isdir(os.path.join(base, n)) and
+                  any(f.startswith("tlog-")
+                      for f in os.listdir(os.path.join(base, n))))
+    procs[victim].kill()
+    procs[victim].wait()
+    time.sleep(1.0)
+    procs[victim] = _spawn(base, victim, suffix=".r2")
+
+    async def post_kill():
+        await _commit_kv(db, b"post-kill", b"recovered")
+        assert await _read_key(db, b"post-kill") == b"recovered"
+        w = CycleWorkload(None, db, {"nodeCount": 12})
+        assert await w.check(), "cycle invariant violated after recovery"
+        return "ok"
+
+    assert loop.run_until(loop.spawn(post_kill()), timeout=120) == "ok"
+
+
+def test_real_cluster_storage_restart_preserves_data(real_cluster):
+    base, procs, loop, db = real_cluster
+
+    async def phase1():
+        for i in range(20):
+            await _commit_kv(db, b"sk%03d" % i, b"sv%03d" % i)
+        return "ok"
+
+    assert loop.run_until(loop.spawn(phase1()), timeout=90) == "ok"
+
+    # Kill the process hosting storage engines and restart it; its engine
+    # files re-image the storage roles and reads must return committed data.
+    victim = next(n for n in NAMES
+                  if os.path.isdir(os.path.join(base, n)) and
+                  any(f.startswith("storage-")
+                      for f in os.listdir(os.path.join(base, n))))
+    procs[victim].kill()
+    procs[victim].wait()
+    time.sleep(1.0)
+    procs[victim] = _spawn(base, victim, suffix=".r2")
+
+    async def phase2():
+        assert await _read_key(db, b"sk007") == b"sv007"
+        await _commit_kv(db, b"sk100", b"sv100")
+        assert await _read_key(db, b"sk100") == b"sv100"
+        return "ok"
+
+    assert loop.run_until(loop.spawn(phase2()), timeout=120) == "ok"
