@@ -166,8 +166,18 @@ def _force_cpu_backend() -> None:
 
 def child_main(backend: str) -> None:
     """The actual measurement (runs in a subprocess; see module doc)."""
+    global TXNS_PER_BATCH, N_BATCHES, N_LATENCY, CAPACITY, DELTA_CAPACITY
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         _force_cpu_backend()
+    if os.environ.get("BENCH_SMALL") == "1":
+        # Degraded (XLA-CPU fallback) sizing: the full TPU-scale stream
+        # takes >35min on one CPU core — a smaller, still-parity-checked
+        # configuration beats emitting no number at all.
+        TXNS_PER_BATCH = 20_000
+        N_BATCHES = 6
+        N_LATENCY = 3
+        CAPACITY = 1 << 19
+        DELTA_CAPACITY = 1 << 18
     from foundationdb_tpu.conflict.oracle import OracleConflictSet
     from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
     from foundationdb_tpu.txn.types import CommitResult
@@ -394,8 +404,10 @@ def parent_main(backend: str) -> None:
             errors.append(
                 f"axon/TPU backend unreachable after {PROBE_ATTEMPTS} "
                 f"probes x {PROBE_TIMEOUT_S}s")
-        # Degraded mode: same kernels, same parity assertions, XLA CPU.
+        # Degraded mode: same kernels, same parity assertions, XLA CPU,
+        # smaller stream (a full-size run exceeds any sane timeout there).
         print("# falling back to JAX CPU backend", file=sys.stderr)
+        os.environ["BENCH_SMALL"] = "1"
         parsed, note = _run_child("tpu", "cpu", CPU_CHILD_TIMEOUT_S)
         if parsed is not None:
             parsed["error"] = ("TPU unavailable; measured on XLA-CPU "

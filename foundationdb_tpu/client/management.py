@@ -1,0 +1,61 @@
+"""Management API: operator actions as ordinary transactions.
+
+Reference: fdbclient/ManagementAPI.actor.cpp — excludeServers /
+includeServers write the exclusion list under `\\xff/conf/`; the data
+distributor reacts by draining data off excluded servers.  Everything
+here is a plain serializable transaction: the operator surface has no
+private channel into the cluster (the point of "configuration as data").
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..core.error import FdbError
+from ..server.system_data import EXCLUDED_END, EXCLUDED_PREFIX, excluded_key
+
+
+async def _retrying(db, fn):
+    t = db.create_transaction()
+    t.access_system_keys = True
+    while True:
+        try:
+            r = await fn(t)
+            await t.commit()
+            return r
+        except FdbError as e:
+            await t.on_error(e)
+
+
+async def exclude_servers(db, tags: Iterable[int]) -> None:
+    """Mark storage servers (by tag) excluded: the DD drains every shard
+    off them; they stop being placement candidates immediately
+    (reference excludeServers)."""
+    async def go(t):
+        for tag in tags:
+            t.set(excluded_key(tag), b"1")
+    await _retrying(db, go)
+
+
+async def include_servers(db, tags: Iterable[int] = None) -> None:
+    """Re-admit excluded servers (None = everyone; reference
+    includeServers)."""
+    async def go(t):
+        if tags is None:
+            t.clear(EXCLUDED_PREFIX, EXCLUDED_END)
+        else:
+            for tag in tags:
+                t.clear(excluded_key(tag))
+    await _retrying(db, go)
+
+
+async def excluded_servers(db) -> List[int]:
+    t = db.create_transaction()
+    t.access_system_keys = True
+    while True:
+        try:
+            rows = await t.get_range(EXCLUDED_PREFIX, EXCLUDED_END)
+            return [int(k[len(EXCLUDED_PREFIX):]) for k, v in rows
+                    if v == b"1"]
+        except FdbError as e:
+            await t.on_error(e)

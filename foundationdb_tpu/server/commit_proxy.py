@@ -434,15 +434,18 @@ class CommitProxy:
         from .system_data import parse_server_tag_mutation
         st = parse_server_tag_mutation(m)
         if st is not None:
-            tag, iface = st
-            # Same incarnation (matching endpoints): keep the object we
-            # already hold — in simulation that is the live role object
-            # (with its status backref), and churning it for a decoded
-            # copy gains nothing.
             from .interfaces import same_incarnation
-            cur = self.storage_interfaces.get(tag)
-            if not same_incarnation(cur, iface):
-                self.storage_interfaces[tag] = iface
+            for tag, iface in st:
+                if iface is None:
+                    self.storage_interfaces.pop(tag, None)  # retired
+                    continue
+                # Same incarnation (matching endpoints): keep the object
+                # we already hold — in simulation that is the live role
+                # object (with its status backref), and churning it for a
+                # decoded copy gains nothing.
+                cur = self.storage_interfaces.get(tag)
+                if not same_incarnation(cur, iface):
+                    self.storage_interfaces[tag] = iface
             handled = True
         return handled
 

@@ -122,6 +122,16 @@ class DataDistributor:
         for b, e, team in key_servers_ranges:
             self.map.set_boundary(b, list(team))
         self.healthy = set(self.storage)
+        self.excluded: set = set()
+        # Desired storage-server count (the configured pool size): lost
+        # servers are REPLACED until the healthy pool is back at this
+        # size, spare workers permitting.
+        self.desired_storage = len(self.storage)
+        # Never reissued: retired/excluded tags keep their identity (a
+        # reborn tag would inherit stale per-tag state like exclusion).
+        self._max_tag_seen = max(list(self.storage) + [-1])
+        self._draining = False
+        self._db_info_var = None
         self.moves_in_flight = 0
         self._relocation_lock = _Lock()
         # Per-shard-begin poll backoff: shards well under the split
@@ -212,11 +222,69 @@ class DataDistributor:
         finally:
             self.moves_in_flight -= 1
 
+    # -- storage recruitment (reference DDTeamCollection recruitment,
+    # DataDistribution.actor.cpp:629,4488) -----------------------------------
+    async def _recruit_replacement(self) -> Optional[Tag]:
+        """Recruit a brand-new storage server (fresh tag) on an idle
+        storage-capable worker; returns the new tag or None.  The worker's
+        init_storage commits the serverTag registry entry, so proxies
+        learn the tag's interface and the next recovery carries it."""
+        from .interfaces import GetWorkersRequest, InitializeStorageRequest
+        info = self._db_info_var.get() if self._db_info_var else None
+        cc = getattr(info, "cluster_controller", None) if info else None
+        if cc is None:
+            return None
+        try:
+            regs = await RequestStream.at(
+                cc.get_workers.endpoint).get_reply(GetWorkersRequest())
+        except FdbError:
+            return None
+        live_tags = {t for t in self.storage if t in self.healthy}
+        idle = None
+        for reg in regs:
+            if reg.process_class != "storage":
+                continue
+            hosted = set(reg.recovered_storage) & live_tags
+            if hosted:
+                continue            # already hosts a live storage role
+            idle = reg.worker
+            break
+        if idle is None:
+            return None
+        self._max_tag_seen = max(self._max_tag_seen,
+                                 max(list(self.storage) + [-1]),
+                                 max(self.excluded, default=-1))
+        new_tag = self._max_tag_seen + 1
+        self._max_tag_seen = new_tag
+        try:
+            ssi = await RequestStream.at(
+                idle.init_storage.endpoint).get_reply(
+                InitializeStorageRequest(ss_id=f"ss{new_tag}", tag=new_tag))
+        except FdbError as e:
+            TraceEvent("DDRecruitFailed", Severity.Warn).detail(
+                "Worker", idle.id).detail("Error", e.name).log()
+            return None
+        self.storage[new_tag] = ssi
+        self.healthy.add(new_tag)
+        self._actors.append(self._process.spawn(
+            self._failure_monitor(new_tag, ssi), f"{self.id}.ssTracker"))
+        TraceEvent("DDStorageRecruited").detail("Tag", new_tag).detail(
+            "Worker", idle.id).log()
+        return new_tag
+
     # -- re-replication (reference teamTracker unhealthy path) ---------------
     async def _handle_storage_failure(self, dead_tag: Tag) -> None:
         self.healthy.discard(dead_tag)
         TraceEvent("DDStorageFailed", Severity.Warn).detail(
             "Tag", dead_tag).log()
+        # Capacity first (reference storageServerTracker: a lost server is
+        # REPLACED, not just worked around): with a spare worker available
+        # the pool returns to full strength and re-replication below can
+        # use the recruit.
+        while len([t for t in self.healthy if t not in self.excluded]) < \
+                self.desired_storage:
+            if await self._recruit_replacement() is None:
+                break      # no idle storage worker: degrade gracefully
         for begin, _e, _t in list(self.map.ranges()):
             # Fresh lookups: a concurrent split/move may have changed this
             # shard since the snapshot above.
@@ -229,7 +297,7 @@ class DataDistributor:
                 TraceEvent("DDShardUnrecoverable", Severity.Error).detail(
                     "Begin", begin).detail("End", end).log()
                 continue
-            candidates = sorted(self.healthy - set(team))
+            candidates = sorted(self.healthy - set(team) - self.excluded)
             new_team = survivors + candidates[:max(
                 0, min(self.replication, len(self.healthy)) -
                 len(survivors))]
@@ -252,6 +320,38 @@ class DataDistributor:
                         "Begin", begin).detail("Error", e.name).detail(
                         "Attempt", attempt).log()
                     await delay(0.5 * (1 << attempt))
+        await self._maybe_retire(dead_tag)
+
+    async def _maybe_retire(self, dead_tag: Tag) -> None:
+        """Remove a dead, fully-drained server from the system: clear its
+        serverTag registry entry (proxies drop the interface; the next
+        recovery stops carrying the tag) and forget it locally (reference
+        removeStorageServer clearing serverListKey after the relocations
+        complete)."""
+        if dead_tag in self.healthy:
+            return
+        for begin, _e, _t in self.map.ranges():
+            team = self.map.lookup(begin)
+            if team and dead_tag in team:
+                return          # still referenced: not fully drained
+        from .system_data import excluded_key, server_tag_key
+        t = self.db.create_transaction()
+        t.access_system_keys = True
+        while True:
+            try:
+                t.clear(server_tag_key(dead_tag))
+                # A retired tag's exclusion entry is dead state; tags are
+                # also never reissued (_max_tag_seen), belt and braces.
+                t.clear(excluded_key(dead_tag))
+                await t.commit()
+                break
+            except FdbError as e:
+                try:
+                    await t.on_error(e)
+                except FdbError:
+                    return      # non-retryable: retire on a later sweep
+        self.storage.pop(dead_tag, None)
+        TraceEvent("DDStorageRetired").detail("Tag", dead_tag).log()
 
     async def _failure_monitor(self, tag: Tag, ssi) -> None:
         from .failure import wait_failure_of
@@ -264,8 +364,11 @@ class DataDistributor:
         """Poll the serverTag registry (reference serverListKeys watch in
         DDTeamCollection): a rebooted storage server commits its recovered
         interface there; re-admit the tag — new interface, healthy again,
-        fresh failure monitor — so re-replication and moves can use it."""
-        from .system_data import (SERVER_TAG_END, SERVER_TAG_PREFIX,
+        fresh failure monitor — so re-replication and moves can use it.
+        Also follows the exclusion list (reference excludedServersPrefix):
+        excluded servers are drained and never picked for new teams."""
+        from .system_data import (EXCLUDED_END, EXCLUDED_PREFIX,
+                                  SERVER_TAG_END, SERVER_TAG_PREFIX,
                                   decode_server_tag_value)
         knobs = server_knobs()
         while True:
@@ -274,8 +377,27 @@ class DataDistributor:
                 t = self.db.create_transaction()
                 t.access_system_keys = True
                 rows = await t.get_range(SERVER_TAG_PREFIX, SERVER_TAG_END)
+                ex_rows = await t.get_range(EXCLUDED_PREFIX, EXCLUDED_END)
             except FdbError:
                 continue
+            excluded = {int(k[len(EXCLUDED_PREFIX):]) for k, v in ex_rows
+                        if v == b"1"}
+            newly = excluded - self.excluded
+            self.excluded = excluded
+            if excluded:
+                self._max_tag_seen = max(self._max_tag_seen,
+                                         max(excluded))
+            if newly:
+                TraceEvent("DDServersExcluded").detail(
+                    "Tags", sorted(newly)).log()
+            # (Re)start the drain whenever excluded tags still appear in
+            # any team — a drain that stalled for capacity resumes when a
+            # spare worker shows up, not only on a fresh exclusion.
+            if excluded and not self._draining and any(
+                    set(self.map.lookup(b) or ()) & excluded
+                    for b, _e, _t in self.map.ranges()):
+                self._process.spawn(self._drain_excluded(),
+                                    f"{self.id}.drainExcluded")
             for k, v in rows:
                 tag = int(k[len(SERVER_TAG_PREFIX):])
                 try:
@@ -344,6 +466,56 @@ class DataDistributor:
                     TraceEvent("DDShardSplit").detail(
                         "At", split_key).detail("Bytes", total).log()
 
+    async def _drain_excluded(self) -> None:
+        """Move every shard off excluded servers (reference: exclusion is
+        DD-driven data movement; the server stays a valid fetch SOURCE
+        while draining, it just stops being a destination).  Recruits a
+        replacement first when the non-excluded pool is too small, and
+        REFUSES to drop below the replication factor — an exclusion the
+        cluster lacks capacity for waits (and is retried by the registry
+        scan) rather than silently under-replicating."""
+        if self._draining:
+            return
+        self._draining = True
+        try:
+            pool = {t for t in self.healthy if t not in self.excluded}
+            while len(pool) < self.replication:
+                if await self._recruit_replacement() is None:
+                    TraceEvent("DDDrainWaitingForCapacity",
+                               Severity.Warn).detail(
+                        "Pool", sorted(pool)).detail(
+                        "Replication", self.replication).log()
+                    return          # retried by the registry scan
+                pool = {t for t in self.healthy if t not in self.excluded}
+            for begin, _e, _t in list(self.map.ranges()):
+                team = self.map.lookup(begin)
+                end = self.map.shard_end(begin)
+                if not team or not (set(team) & self.excluded):
+                    continue
+                keep = [t for t in team if t not in self.excluded]
+                candidates = sorted(self.healthy - set(team) -
+                                    self.excluded)
+                new_team = keep + candidates[:max(
+                    0, min(self.replication, len(pool)) - len(keep))]
+                if not new_team or set(new_team) == set(team) or \
+                        len(new_team) < min(self.replication, len(pool)):
+                    TraceEvent("DDDrainStuck", Severity.Warn).detail(
+                        "Begin", begin).detail(
+                        "Excluded", sorted(self.excluded)).log()
+                    continue
+                for attempt in range(5):
+                    try:
+                        await self.move_shard(begin, end, new_team)
+                        break
+                    except FdbError as e:
+                        TraceEvent("DDDrainMoveFailed",
+                                   Severity.Warn).detail(
+                            "Begin", begin).detail("Error", e.name).detail(
+                            "Attempt", attempt).log()
+                        await delay(0.5 * (1 << attempt))
+        finally:
+            self._draining = False
+
     async def _check_removed(self, db_info_var, epoch: int) -> None:
         """Halt when the announced transaction system carries a different
         DD (reference checkRemoved, Resolver.actor.cpp:357-366): a deposed
@@ -378,6 +550,7 @@ class DataDistributor:
         self.halted = False
         self._actors = []
         self._process = process
+        self._db_info_var = db_info_var
         for s in self.interface.streams():
             process.register(s)
         for tag, ssi in self.storage.items():

@@ -32,6 +32,12 @@ class DiskQueue:
         self.popped_seq = 0          # records <= this are logically gone
         self._write_offset = 0
         self._pending: List[bytes] = []
+        # seq -> (payload offset, payload length): random access for
+        # spill-by-reference readers (the TLog serves peeks of spilled
+        # tags straight from the queue file; reference TLogServer spill
+        # reads via IDiskQueue::read).  Entries drop at pop().
+        self._index: dict = {}
+        self._pending_offset = 0
 
     # -- write path ----------------------------------------------------------
     def push(self, payload: bytes) -> int:
@@ -39,15 +45,31 @@ class DiskQueue:
         seq = self.next_seq
         self.next_seq += 1
         crc = zlib.crc32(payload)
-        self._pending.append(_HDR.pack(_MAGIC, seq, self.popped_seq,
-                                       len(payload), crc) + payload)
+        frame = _HDR.pack(_MAGIC, seq, self.popped_seq,
+                          len(payload), crc) + payload
+        self._index[seq] = (self._write_offset + self._pending_offset +
+                            _HDR.size, len(payload))
+        self._pending_offset += len(frame)
+        self._pending.append(frame)
         return seq
+
+    async def read_payload(self, seq: int) -> Optional[bytes]:
+        """Read one DURABLE record's payload by seq (spilled-tag peeks).
+        None if unknown or already popped."""
+        loc = self._index.get(seq)
+        if loc is None or seq <= self.popped_seq:
+            return None
+        offset, length = loc
+        if offset + length > self._write_offset:
+            return None            # not yet committed to the file
+        return await self.file.read(offset, length)
 
     async def commit(self) -> None:
         """Write buffered records and fsync (reference group commit)."""
         if self._pending:
             blob = b"".join(self._pending)
             self._pending = []
+            self._pending_offset = 0
             await self.file.write(self._write_offset, blob)
             self._write_offset += len(blob)
         await self.file.sync()
@@ -55,7 +77,10 @@ class DiskQueue:
     def pop(self, up_to_seq: int) -> None:
         """Trim records <= seq (durably recorded with the NEXT append, as
         in the reference's lazy page-header update)."""
-        self.popped_seq = max(self.popped_seq, up_to_seq)
+        if up_to_seq > self.popped_seq:
+            self.popped_seq = up_to_seq
+            for seq in [s for s in self._index if s <= up_to_seq]:
+                del self._index[seq]
 
     # -- recovery (reference recovery scan) ----------------------------------
     async def recover(self) -> List[Tuple[int, bytes]]:
@@ -78,11 +103,14 @@ class DiskQueue:
             if zlib.crc32(payload) != crc:
                 break                      # corrupt tail
             records.append((seq, payload))
+            self._index[seq] = (offset + _HDR.size, length)
             max_popped = max(max_popped, popped)
             last_seq = seq
             offset += _HDR.size + length
         self.next_seq = last_seq + 1
         self.popped_seq = max_popped
+        for seq in [s for s in self._index if s <= max_popped]:
+            del self._index[seq]
         self._write_offset = offset
         # Anything beyond the valid prefix is garbage from a torn write:
         # discard it so future appends are consistent.

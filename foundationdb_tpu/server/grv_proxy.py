@@ -33,11 +33,13 @@ class GrvProxy:
         self.tlogs = tlogs or []        # [TLogInterface] for liveness confirm
         self.ratekeeper = ratekeeper    # RatekeeperInterface (optional)
         self._rate = float("inf")       # tps budget from the ratekeeper
+        self._batch_rate = float("inf")  # batch-priority budget (<= _rate)
         self.interface = GrvProxyInterface(proxy_id)
         # Priority queues: immediate > default > batch (reference
         # SystemTransactionQueue/DefaultQueue/BatchQueue).
         self.queues: List[List[GetReadVersionRequest]] = [[], [], []]
         self.transaction_budget = float("inf")
+        self.batch_budget = float("inf")
         self.stats = {"grvs": 0, "batches": 0}
         from ..core.histogram import CounterCollection
         self.metrics = CounterCollection("GrvProxy", proxy_id)
@@ -53,23 +55,35 @@ class GrvProxy:
                 w, self._wakeup = self._wakeup, None
                 w.send(None)
 
-    def _drain(self, budget: float):
+    def _drain(self, budget: float, batch_budget: float):
         """Release requests: IMMEDIATE always (and exempt from ratekeeper
-        accounting, as in the reference); default/batch only while budget
-        remains.  Returns (batch, charged) so the caller can carry any
-        overdraft forward as debt instead of erasing it."""
+        accounting, as in the reference); DEFAULT while the normal budget
+        remains; BATCH only while BOTH the normal and the batch budget
+        remain (reference GrvProxyServer.actor.cpp:702 — batch releases
+        draw from a separate, smaller allowance, so a batch flood can
+        never starve default traffic: the batch limit collapses first
+        under load and default is always drained ahead of batch).
+        Returns (released, charged, batch_charged) so overdrafts carry
+        forward as debt per bucket."""
         out: List[GetReadVersionRequest] = []
         charged = 0
+        batch_charged = 0
         q = self.queues[TransactionPriority.IMMEDIATE]
         while q:
             out.append(q.pop(0))
-        for pri in (TransactionPriority.DEFAULT, TransactionPriority.BATCH):
-            q = self.queues[pri]
-            while q and budget - charged > 0:
-                req = q.pop(0)
-                out.append(req)
-                charged += req.transaction_count
-        return out, charged
+        q = self.queues[TransactionPriority.DEFAULT]
+        while q and budget - charged > 0:
+            req = q.pop(0)
+            out.append(req)
+            charged += req.transaction_count
+        q = self.queues[TransactionPriority.BATCH]
+        while q and budget - charged > 0 and \
+                batch_budget - batch_charged > 0:
+            req = q.pop(0)
+            out.append(req)
+            charged += req.transaction_count
+            batch_charged += req.transaction_count
+        return out, charged, batch_charged
 
     async def _transaction_starter(self) -> None:
         from ..core.scheduler import now
@@ -90,8 +104,15 @@ class GrvProxy:
                     self._rate)
             else:
                 self.transaction_budget = float("inf")
+            if self._batch_rate != float("inf"):
+                self.batch_budget = min(
+                    self.batch_budget + self._batch_rate * (t - last),
+                    self._batch_rate)
+            else:
+                self.batch_budget = float("inf")
             last = t
-            batch, charged = self._drain(self.transaction_budget)
+            batch, charged, batch_charged = self._drain(
+                self.transaction_budget, self.batch_budget)
             if not batch:
                 continue
             if self.transaction_budget != float("inf"):
@@ -99,6 +120,8 @@ class GrvProxy:
                 # means fewer releases later, keeping the long-run rate at
                 # the ratekeeper's tps.
                 self.transaction_budget -= charged
+            if self.batch_budget != float("inf"):
+                self.batch_budget -= batch_charged
             self.stats["batches"] += 1
             self._process.spawn(self._reply_batch(batch),
                                 f"{self.id}.grvBatch")
@@ -116,6 +139,7 @@ class GrvProxy:
                     GetRateInfoRequest(proxy_id=self.id,
                                        total_released=self.stats["grvs"]))
                 self._rate = reply.tps
+                self._batch_rate = min(reply.batch_tps, reply.tps)
                 wait = reply.lease_duration / 2
             except FdbError:
                 wait = 0.5

@@ -424,6 +424,10 @@ class ServerDBInfo:
     storage_servers: Dict[Tag, Any] = field(default_factory=dict)
     ratekeeper: Any = None
     data_distributor: Any = None
+    # The recruiting CC (reference ServerDBInfo.clusterInterface): lets
+    # singletons like the DD reach the worker registry for storage
+    # recruitment without a private channel.
+    cluster_controller: Any = None
 
 
 @dataclass

@@ -4,17 +4,28 @@ Reference: fdbserver/VersionedBTree.actor.cpp (Redwood) — a paged
 copy-on-write B+tree behind IKeyValueStore: modified pages are written to
 fresh page ids, parents re-point up to a new root, and a double-slot
 header commits the new root atomically (IPager.h versioned pager).  This
-engine keeps Redwood's crash-consistency shape without its versioning,
-prefix compression, or page reuse (pages are append-only between
-compactions — a documented simplification; Redwood's free list is the
-remaining step):
+engine keeps Redwood's crash-consistency shape without its versioning or
+prefix compression, and carries the pager features that bound file growth
+and record size:
 
-  page 0/1: alternating header slots (magic, commit_seq, root id, page
-            count, crc) — recovery picks the valid slot with the higher
-            seq, so a power failure mid-commit always lands on a complete
-            tree (old or new, never torn).
-  leaves:   sorted (key, value) records.
-  internal: child ids + separator keys (child i covers keys < sep[i]).
+  page 0/1:  alternating header slots (magic, commit_seq, root id, page
+             count, crc) — recovery picks the valid slot with the higher
+             seq, so a power failure mid-commit always lands on a complete
+             tree (old or new, never torn).
+  leaves:    sorted (key, value-or-overflow-ref) records.
+  internal:  child ids + SHORTENED separator keys (child i covers keys
+             < sep[i]; separators are the shortest prefix of the right
+             sibling's first key that still separates — Redwood's prefix
+             truncation keeps internal nodes small under large keys).
+  overflow:  values larger than _OVERFLOW_BYTES live in chains of whole
+             pages referenced from the leaf record (reference Redwood
+             "big value" overflow pages); the ref carries the page list
+             so replaced/cleared records free their chains.
+  free list: pages orphaned by COW replacement are reusable from the NEXT
+             commit on (a torn commit must still find the previous tree
+             intact — the reference pager's delayed-free queue).  The
+             list is rebuilt at recovery by a reachability walk, so it
+             needs no durable format of its own.
 
 Commit protocol: write all new pages, fsync, write the next header slot,
 fsync — the reference's "commit is one header write" invariant.
@@ -24,7 +35,7 @@ from __future__ import annotations
 
 import bisect
 import zlib
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 from ..core.trace import TraceEvent
 from ..core.wire import Reader, Writer
@@ -37,6 +48,26 @@ _LEAF, _INTERNAL = 0, 1
 # Split when a serialized page exceeds this (leaving headroom for the
 # page header fields).
 _SPLIT_BYTES = PAGE_SIZE - 64
+# Values above this spill to overflow page chains.
+_OVERFLOW_BYTES = 1024
+# Usable payload per overflow page (after the 4-byte length frame).
+_OVF_PAYLOAD = PAGE_SIZE - 8
+
+
+class OverflowRef:
+    """A leaf record's value stored out-of-line in whole pages."""
+
+    __slots__ = ("length", "pages")
+
+    def __init__(self, length: int, pages: List[int]) -> None:
+        self.length = length
+        self.pages = pages
+
+    def ref_size(self) -> int:
+        return 8 + 4 * len(self.pages)
+
+
+Value = Union[bytes, OverflowRef]
 
 
 class _Node:
@@ -45,7 +76,7 @@ class _Node:
     def __init__(self, kind: int, keys=None, values=None, children=None):
         self.kind = kind
         self.keys: List[bytes] = keys or []       # leaf: record keys;
-        self.values: List[bytes] = values or []   # internal: separators
+        self.values: List[Value] = values or []   # internal: separators
         self.children: List[int] = children or []
 
     def encode(self) -> bytes:
@@ -54,7 +85,12 @@ class _Node:
             w.bytes_(k)
         if self.kind == _LEAF:
             for v in self.values:
-                w.bytes_(v)
+                if isinstance(v, OverflowRef):
+                    w.u8(1).u32(v.length).u32(len(v.pages))
+                    for p in v.pages:
+                        w.u32(p)
+                else:
+                    w.u8(0).bytes_(v)
         else:
             w.u32(len(self.children))
             for c in self.children:
@@ -68,15 +104,35 @@ class _Node:
         n = r.u32()
         keys = [r.bytes_() for _ in range(n)]
         if kind == _LEAF:
-            return cls(_LEAF, keys, [r.bytes_() for _ in range(n)])
+            values: List[Value] = []
+            for _ in range(n):
+                if r.u8():
+                    length = r.u32()
+                    pages = [r.u32() for _ in range(r.u32())]
+                    values.append(OverflowRef(length, pages))
+                else:
+                    values.append(r.bytes_())
+            return cls(_LEAF, keys, values)
         children = [r.u32() for _ in range(r.u32())]
         return cls(_INTERNAL, keys, None, children)
 
     def size(self) -> int:
         base = sum(len(k) + 8 for k in self.keys)
         if self.kind == _LEAF:
-            return base + sum(len(v) for v in self.values)
+            return base + sum(
+                v.ref_size() if isinstance(v, OverflowRef) else len(v) + 1
+                for v in self.values)
         return base + 4 * len(self.children)
+
+
+def _shorten_sep(left_last: bytes, right_first: bytes) -> bytes:
+    """Shortest prefix of right_first that still exceeds left_last
+    (Redwood-style separator truncation: internal nodes stay small no
+    matter how large leaf keys grow)."""
+    for i in range(len(right_first)):
+        if i >= len(left_last) or right_first[i] != left_last[i]:
+            return right_first[:i + 1]
+    return right_first
 
 
 class KVStoreBTree(IKeyValueStore):
@@ -87,10 +143,14 @@ class KVStoreBTree(IKeyValueStore):
         self.file = fs.open(prefix + ".btree")
         self._uncommitted: List[Tuple[int, bytes, bytes]] = []
         self._cache: Dict[int, _Node] = {}
-        self._dirty: Dict[int, _Node] = {}
+        # page id -> _Node (tree page) or bytes (raw overflow payload)
+        self._dirty: Dict[int, Union[_Node, bytes]] = {}
         self.root = 0          # 0 = empty tree
         self.page_count = 2    # slots 0,1 are headers
         self.commit_seq = 0
+        # Reusable page ids (freed by PREVIOUS commits; see module doc).
+        self.free: List[int] = []
+        self._freed_this_commit: List[int] = []
 
     # -- paging --------------------------------------------------------------
     async def _read_node(self, page_id: int) -> _Node:
@@ -102,11 +162,57 @@ class KVStoreBTree(IKeyValueStore):
             self._cache[page_id] = node
         return node
 
-    def _alloc(self, node: _Node) -> int:
+    def _alloc_id(self) -> int:
+        if self.free:
+            return self.free.pop()
         page_id = self.page_count
         self.page_count += 1
+        return page_id
+
+    def _alloc(self, node: _Node) -> int:
+        page_id = self._alloc_id()
         self._dirty[page_id] = node
         return page_id
+
+    def _free_page(self, page_id: int) -> None:
+        if page_id >= 2:
+            self._freed_this_commit.append(page_id)
+            self._cache.pop(page_id, None)
+            self._dirty.pop(page_id, None)
+
+    def _free_value(self, v: Value) -> None:
+        if isinstance(v, OverflowRef):
+            for p in v.pages:
+                self._free_page(p)
+
+    def _store_value(self, value: bytes) -> Value:
+        """Inline small values; spill large ones to an overflow chain."""
+        if len(value) <= _OVERFLOW_BYTES:
+            return value
+        pages: List[int] = []
+        for off in range(0, len(value), _OVF_PAYLOAD):
+            chunk = value[off:off + _OVF_PAYLOAD]
+            pid = self._alloc_id()
+            self._dirty[pid] = bytes(chunk)
+            pages.append(pid)
+        return OverflowRef(len(value), pages)
+
+    async def _load_value(self, v: Value) -> bytes:
+        if not isinstance(v, OverflowRef):
+            return v
+        parts: List[bytes] = []
+        remaining = v.length
+        for pid in v.pages:
+            raw = self._dirty.get(pid)
+            if isinstance(raw, bytes):
+                part = raw
+            else:
+                blob = await self.file.read(pid * PAGE_SIZE, PAGE_SIZE)
+                n = int.from_bytes(blob[:4], "little")
+                part = blob[4:4 + n]
+            parts.append(part[:remaining])
+            remaining -= len(parts[-1])
+        return b"".join(parts)
 
     def _header_blob(self) -> bytes:
         w = Writer().u32(_MAGIC).i64(self.commit_seq).u32(self.root)
@@ -125,20 +231,23 @@ class KVStoreBTree(IKeyValueStore):
         """Insert/overwrite; returns the NEW page id for this subtree
         (list of ids if the node split)."""
         if page_id == 0:
-            return self._alloc(_Node(_LEAF, [key], [value]))
+            return self._alloc(_Node(_LEAF, [key], [self._store_value(value)]))
         node = await self._read_node(page_id)
         if node.kind == _LEAF:
             i = bisect.bisect_left(node.keys, key)
             keys, values = list(node.keys), list(node.values)
+            stored = self._store_value(value)
             if i < len(keys) and keys[i] == key:
-                values[i] = value
+                self._free_value(values[i])   # replaced value's chain
+                values[i] = stored
             else:
                 keys.insert(i, key)
-                values.insert(i, value)
+                values.insert(i, stored)
+            self._free_page(page_id)
             return self._finish(_Node(_LEAF, keys, values))
         ci = bisect.bisect_right(node.keys, key)
         new_child = await self._cow_set(node.children[ci], key, value)
-        return self._replace_child(node, ci, new_child)
+        return self._replace_child(page_id, node, ci, new_child)
 
     def _finish(self, node: _Node):
         """Allocate `node`, splitting when oversized; returns page id or
@@ -149,7 +258,7 @@ class KVStoreBTree(IKeyValueStore):
         if node.kind == _LEAF:
             left = _Node(_LEAF, node.keys[:mid], node.values[:mid])
             right = _Node(_LEAF, node.keys[mid:], node.values[mid:])
-            sep = node.keys[mid]
+            sep = _shorten_sep(node.keys[mid - 1], node.keys[mid])
         else:
             # separator mid is promoted, not kept.
             left = _Node(_INTERNAL, node.keys[:mid], None,
@@ -159,7 +268,7 @@ class KVStoreBTree(IKeyValueStore):
             sep = node.keys[mid]
         return (self._alloc(left), sep, self._alloc(right))
 
-    def _replace_child(self, node: _Node, ci: int, new_child):
+    def _replace_child(self, page_id: int, node: _Node, ci: int, new_child):
         keys = list(node.keys)
         children = list(node.children)
         if isinstance(new_child, tuple):
@@ -168,6 +277,7 @@ class KVStoreBTree(IKeyValueStore):
             keys.insert(ci, sep)
         else:
             children[ci] = new_child
+        self._free_page(page_id)
         return self._finish(_Node(_INTERNAL, keys, None, children))
 
     async def _cow_clear(self, page_id: int, begin: bytes,
@@ -176,10 +286,15 @@ class KVStoreBTree(IKeyValueStore):
             return 0
         node = await self._read_node(page_id)
         if node.kind == _LEAF:
-            pairs = [(k, v) for k, v in zip(node.keys, node.values)
-                     if not begin <= k < end]
+            pairs = []
+            for k, v in zip(node.keys, node.values):
+                if begin <= k < end:
+                    self._free_value(v)       # cleared record's chain
+                else:
+                    pairs.append((k, v))
             if len(pairs) == len(node.keys):
                 return page_id     # nothing cleared: no COW churn
+            self._free_page(page_id)
             if not pairs:
                 return 0
             return self._alloc(_Node(_LEAF, [k for k, _ in pairs],
@@ -204,6 +319,7 @@ class KVStoreBTree(IKeyValueStore):
                 children.append(child)
         if not changed:
             return page_id         # subtree untouched: keep the old pages
+        self._free_page(page_id)
         if not children:
             return 0
         if len(children) == 1:
@@ -212,7 +328,8 @@ class KVStoreBTree(IKeyValueStore):
 
     async def commit(self) -> None:
         batch, self._uncommitted = self._uncommitted, []
-        self._page_count_at_commit_start = self.page_count
+        page_count0 = self.page_count
+        free0 = list(self.free)
         root = self.root
         for op, a, b in batch:
             if op == 0:
@@ -224,18 +341,22 @@ class KVStoreBTree(IKeyValueStore):
                 r = self._alloc(_Node(_INTERNAL, [sep], None, [lid, rid]))
             root = r
         # Validate page sizes BEFORE any write so an oversized record
-        # (single k/v too big for a page; overflow pages are a pending
-        # feature vs Redwood) fails cleanly with the tree untouched.
+        # (a single KEY too large for a page — values overflow, keys do
+        # not) fails cleanly with the tree untouched.
         encoded = {}
         for page_id, node in self._dirty.items():
+            if isinstance(node, bytes):
+                encoded[page_id] = node        # raw overflow payload
+                continue
             blob = node.encode()
             if 4 + len(blob) > PAGE_SIZE:
                 from ..core.error import err
                 self._dirty = {}
-                self.page_count = self._page_count_at_commit_start
+                self.page_count = page_count0
+                self.free = free0
+                self._freed_this_commit = []
                 raise err("operation_failed",
-                          "btree record exceeds page size "
-                          "(overflow pages not yet implemented)")
+                          "btree key exceeds page capacity")
             encoded[page_id] = blob
         # Write dirty pages, fsync, then the next header slot, fsync
         # (reference: commit == one durable header write).
@@ -243,13 +364,20 @@ class KVStoreBTree(IKeyValueStore):
             await self.file.write(page_id * PAGE_SIZE,
                                   len(blob).to_bytes(4, "little") + blob)
         await self.file.sync()
-        self._cache.update(self._dirty)
+        for page_id, node in self._dirty.items():
+            if isinstance(node, _Node):
+                self._cache[page_id] = node
         self._dirty = {}
         self.root = root
         self.commit_seq += 1
         slot = self.commit_seq % 2
         await self.file.write(slot * PAGE_SIZE, self._header_blob())
         await self.file.sync()
+        # Pages orphaned by THIS commit become reusable from the next one
+        # (the previous tree stays intact under this commit's writes, so a
+        # torn next-commit still recovers cleanly).
+        self.free.extend(self._freed_this_commit)
+        self._freed_this_commit = []
 
     # -- reads ---------------------------------------------------------------
     def read_value(self, key: bytes) -> Optional[bytes]:
@@ -262,7 +390,7 @@ class KVStoreBTree(IKeyValueStore):
             if node.kind == _LEAF:
                 i = bisect.bisect_left(node.keys, key)
                 if i < len(node.keys) and node.keys[i] == key:
-                    return node.values[i]
+                    return await self._load_value(node.values[i])
                 return None
             page_id = node.children[bisect.bisect_right(node.keys, key)]
         return None
@@ -281,7 +409,7 @@ class KVStoreBTree(IKeyValueStore):
         if node.kind == _LEAF:
             for k, v in zip(node.keys, node.values):
                 if begin <= k < end:
-                    out.append((k, v))
+                    out.append((k, await self._load_value(v)))
                     if len(out) >= limit:
                         return
             return
@@ -327,5 +455,30 @@ class KVStoreBTree(IKeyValueStore):
             self.root, self.page_count, self.commit_seq = 0, 2, 0
         self._cache.clear()
         self._dirty = {}
+        await self._rebuild_free_list()
         TraceEvent("BTreeRecovered").detail("Seq", self.commit_seq).detail(
-            "Root", self.root).detail("Pages", self.page_count).log()
+            "Root", self.root).detail("Pages", self.page_count).detail(
+            "Free", len(self.free)).log()
+
+    async def _rebuild_free_list(self) -> None:
+        """Reachability walk from the recovered root: every allocated page
+        not referenced by the live tree (or its overflow chains) is free.
+        The free list thus needs no durable format — the reference pager
+        persists its free-list pages instead; a scan is the simpler
+        equivalent at this engine's scale."""
+        reachable = {0, 1}
+        stack = [self.root] if self.root else []
+        while stack:
+            pid = stack.pop()
+            if pid in reachable:
+                continue
+            reachable.add(pid)
+            node = await self._read_node(pid)
+            if node.kind == _LEAF:
+                for v in node.values:
+                    if isinstance(v, OverflowRef):
+                        reachable.update(v.pages)
+            else:
+                stack.extend(node.children)
+        self.free = [p for p in range(2, self.page_count)
+                     if p not in reachable]

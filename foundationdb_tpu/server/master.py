@@ -459,20 +459,31 @@ async def master_server(master: Master, process, coordinators,
                             prev.backup_active = backup_flag
                         st = parse_server_tag_mutation(m)
                         if st is not None:
-                            # Storage rejoin committed since the cstate
-                            # snapshot: the registry interface supersedes
-                            # the snapshot's (a boot-time re-registration
-                            # this recovery observes directly still wins).
-                            replayed_rejoins[st[0]] = st[1]
+                            # Registry changes committed since the cstate
+                            # snapshot: rejoins/recruits supersede the
+                            # snapshot's interfaces; None = retired (dead,
+                            # drained) tags drop out of the system.
+                            for tag, iface in st:
+                                replayed_rejoins[tag] = iface
                         n_deltas += 1
             from .interfaces import same_incarnation
-            prev.storage_servers = {
-                t: (replayed_rejoins[t]
-                    if t in replayed_rejoins and
-                    not same_incarnation(prev.storage_servers.get(t),
-                                         replayed_rejoins[t])
-                    else i)
-                for t, i in prev.storage_servers.items()}
+            merged = {}
+            for t, i in prev.storage_servers.items():
+                if t in replayed_rejoins:
+                    r = replayed_rejoins[t]
+                    if r is None:
+                        continue       # retired since the snapshot
+                    merged[t] = (r if not same_incarnation(i, r) else i)
+                else:
+                    merged[t] = i
+            # Tags NOT in the snapshot are storage servers RECRUITED since
+            # it (DD replacement recruitment commits their serverTag) —
+            # they are part of the transaction system now: carry their
+            # tags' log data across the generation change too.
+            for t, i in replayed_rejoins.items():
+                if t not in merged and i is not None:
+                    merged[t] = i
+            prev.storage_servers = merged
             # The flag may have turned ON since the durable snapshot: the
             # old generation's un-pulled backup stream must still carry
             # over or the capture would have a hole (the pre-lock check
@@ -647,7 +658,8 @@ async def master_server(master: Master, process, coordinators,
             grv_proxies=grv_proxies, commit_proxies=commit_proxies,
             resolvers=resolvers, tlogs=tlogs,
             storage_servers=storage_servers, ratekeeper=ratekeeper,
-            data_distributor=data_distributor)
+            data_distributor=data_distributor,
+            cluster_controller=cc_interface)
         await RequestStream.at(
             cc_interface.master_registration.endpoint).get_reply(
             MasterRegistrationRequest(epoch=master.epoch, db_info=db_info))

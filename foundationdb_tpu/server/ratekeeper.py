@@ -39,6 +39,11 @@ class GetRateInfoRequest:
 class GetRateInfoReply:
     tps: float               # this proxy's transactions-per-second budget
     lease_duration: float    # budget valid this long (reference leaseDuration)
+    # Batch-priority budget (reference: distinct batchTransactions limit,
+    # Ratekeeper.actor.cpp:991/GrvProxyServer.actor.cpp:702).  Always
+    # <= tps; collapses to ~0 BEFORE default throttling begins, so batch
+    # load sheds first and can never starve default-priority traffic.
+    batch_tps: float = float("inf")
 
 
 @dataclass
@@ -88,6 +93,7 @@ class Ratekeeper:
         self.storage_interfaces = storage_interfaces
         self.poll_interval = poll_interval
         self.tps_limit: float = float("inf")
+        self.batch_tps_limit: float = float("inf")
         self.limit_reason = "workload"
         # Smoothed release rate across proxies (reference
         # smoothReleasedTransactions).
@@ -111,13 +117,24 @@ class Ratekeeper:
         target = float(knobs.STORAGE_LIMIT_BYTES)
         spring = max(target * 0.2, 1.0)
         worst = float(self.worst_queue_bytes)
+        released = max(self._release_rate(), 1.0)
+        # Batch spring zone sits BELOW the normal one (reference: the
+        # batch limit uses tighter queue targets): batch throttles through
+        # [target - 2*spring, target - spring] and hits ~0 exactly where
+        # default throttling begins — under overload batch sheds first.
+        batch_floor = target - 2 * spring
+        if worst <= batch_floor:
+            self.batch_tps_limit = float("inf")
+        else:
+            b_over = min(worst - batch_floor, spring)
+            self.batch_tps_limit = released * max(
+                0.0, 1.0 - b_over / spring) + 0.1
         if worst <= target - spring:
             self.tps_limit = float("inf")
             self.limit_reason = "workload"
             return
         # Spring zone: scale the observed rate down proportionally to how
         # deep into the spring the worst queue is; a full queue halts.
-        released = max(self._release_rate(), 1.0)
         over = min(worst - (target - spring), spring)
         factor = max(0.0, 1.0 - over / spring)
         self.tps_limit = released * factor + 1.0
@@ -150,6 +167,7 @@ class Ratekeeper:
             n_proxies = max(len(self._proxy_released), 1)
             req.reply.send(GetRateInfoReply(
                 tps=self.tps_limit / n_proxies,
+                batch_tps=self.batch_tps_limit / n_proxies,
                 lease_duration=self.poll_interval * 2))
 
     async def _serve_status(self) -> None:

@@ -34,6 +34,12 @@ SERVER_LIST_PREFIX = b"\xff/serverList/"
 # and the data distributor re-admits the tag on its registry scan.
 SERVER_TAG_PREFIX = b"\xff/serverTag/"
 SERVER_TAG_END = b"\xff/serverTag0"
+# Tag -> b"1": storage servers the operator excluded (reference
+# excludedServersPrefix under \xff/conf/; ManagementAPI excludeServers).
+# The DD drains every shard off excluded servers; they remain usable as
+# fetch SOURCES while draining.
+EXCLUDED_PREFIX = b"\xff/conf/excluded/"
+EXCLUDED_END = b"\xff/conf/excluded0"
 BACKUP_STARTED_KEY = b"\xff/backupStarted"
 
 # All user mutations additionally ride this tag while a backup is active
@@ -80,13 +86,38 @@ def decode_server_tag_value(blob: bytes):
     return serde.decode_message(blob)
 
 
+def excluded_key(tag: Tag) -> bytes:
+    return EXCLUDED_PREFIX + b"%010d" % tag
+
+
 def parse_server_tag_mutation(m: Mutation):
-    """(tag, interface) if `m` is a serverTag registry write, else None."""
-    if m.type != MutationType.SetValue or \
-            not m.param1.startswith(SERVER_TAG_PREFIX):
-        return None
-    tag = int(m.param1[len(SERVER_TAG_PREFIX):])
-    return tag, decode_server_tag_value(m.param2)
+    """(tag, interface) for a registry write, (tag, None) for each tag a
+    registry CLEAR retires (a dead, fully-drained server removed by the
+    DD — reference removeStorageServer clearing serverListKey), else
+    None."""
+    if m.type == MutationType.SetValue and \
+            m.param1.startswith(SERVER_TAG_PREFIX):
+        tag = int(m.param1[len(SERVER_TAG_PREFIX):])
+        return [(tag, decode_server_tag_value(m.param2))]
+    if m.type == MutationType.ClearRange and \
+            m.param1 < SERVER_TAG_END and m.param2 > SERVER_TAG_PREFIX:
+        # Enumerate retired tags EXACTLY by generating candidate keys and
+        # testing membership (clear bounds need not align to key format,
+        # e.g. a single-key clear ends at key+\x00).  Registry tags are
+        # small ints; the sweep is capped defensively.
+        lo = max(m.param1, SERVER_TAG_PREFIX)
+        digits = bytes(c for c in lo[len(SERVER_TAG_PREFIX):][:10]
+                       if 48 <= c <= 57)
+        lo_tag = int(digits) if len(digits) == 10 else 0
+        out = []
+        for tag in range(lo_tag, lo_tag + 10_000):
+            k = server_tag_key(tag)
+            if k >= m.param2:
+                break
+            if k >= m.param1:
+                out.append((tag, None))
+        return out or None
+    return None
 
 
 def apply_metadata_mutation(key_servers: RangeMap, m: Mutation):

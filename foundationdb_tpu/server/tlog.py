@@ -17,6 +17,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from ..core.buggify import buggify
 from ..core.futures import Promise
+from ..core.knobs import server_knobs
 from ..core.scheduler import delay, get_event_loop
 from ..core.trace import TraceEvent
 from ..core.wire import Reader, Writer
@@ -72,10 +73,22 @@ class TLog:
         self.durable_version = NotifiedVersion(recovery_version)  # fsynced
         self.known_committed_version: Version = recovery_version
         self.interface = TLogInterface(tlog_id)
-        # tag -> deque of (version, mutations), version-ascending.
+        # tag -> deque of (version, mutations), version-ascending — the
+        # RESIDENT (in-memory) suffix of each tag's data.
         self.tag_data: Dict[Tag, Deque[Tuple[Version, List[Mutation]]]] = {}
+        # tag -> deque of (version, disk-record seq): the SPILLED prefix
+        # (payload evicted from memory, served from the DiskQueue on peek;
+        # reference spill-by-reference, TLogServer.actor.cpp:293 spill
+        # fields).  Invariant: spilled versions < resident versions.
+        self.spilled: Dict[Tag, Deque[Tuple[Version, int]]] = {}
         self.poppedtags: Dict[Tag, Version] = {}
         self.bytes_input = 0
+        # In-memory payload accounting driving the spill policy.
+        self.bytes_in_memory = 0
+        self.tag_bytes: Dict[Tag, int] = {}
+        self.bytes_spilled = 0
+        # version -> disk record seq of the commit that carried it.
+        self._seq_of_version: Dict[Version, int] = {}
         self._sync_running = False
         self.stopped = False   # locked at epoch end; rejects new commits
         self._stop_promise: Promise = Promise()  # fires when locked
@@ -103,16 +116,29 @@ class TLog:
                 t.poppedtags[tag] = max(t.poppedtags.get(tag, 0), v)
             for tag, msgs in messages.items():
                 t.tag_data.setdefault(tag, deque()).append((version, msgs))
-                t.bytes_input += sum(m.expected_size() for m in msgs)
+                nbytes = sum(m.expected_size() for m in msgs)
+                t.bytes_input += nbytes
+                t.bytes_in_memory += nbytes
+                t.tag_bytes[tag] = t.tag_bytes.get(tag, 0) + nbytes
             t.known_committed_version = max(t.known_committed_version, kcv)
             t._record_seqs.append((version, seq, frozenset(messages)))
+            t._seq_of_version[version] = seq
             if version > t.version.get():
                 t.version.set(version)
         t.durable_version.set(t.version.get())
         for tag, popped_v in t.poppedtags.items():
             q = t.tag_data.get(tag)
             while q and q[0][0] <= popped_v:
-                q.popleft()
+                _v, msgs = q.popleft()
+                nbytes = sum(m.expected_size() for m in msgs)
+                t.bytes_in_memory -= nbytes
+                if tag in t.tag_bytes:
+                    t.tag_bytes[tag] -= nbytes
+        # Re-apply the memory bound: the recovery scan rebuilt every
+        # record fully resident, and on an old-generation TLog no commit
+        # will ever arrive to trigger spilling — a lagging replica's
+        # multi-x backlog must go straight back to references.
+        t._maybe_spill()
         TraceEvent("TLogRecoveredFromDisk").detail("Id", tlog_id).detail(
             "Version", t.version.get()).detail(
             "Records", len(records)).log()
@@ -142,6 +168,9 @@ class TLog:
             for v, msgs in reply.messages:
                 if v <= recovery_version:
                     q.append((v, msgs))
+                    nbytes = sum(m.expected_size() for m in msgs)
+                    self.bytes_in_memory += nbytes
+                    self.tag_bytes[tag] = self.tag_bytes.get(tag, 0) + nbytes
             if popped:
                 self.poppedtags[tag] = popped
         if self.disk_queue is not None:
@@ -156,6 +185,7 @@ class TLog:
                     dict(self.poppedtags), by_version[v]))
                 self._record_seqs.append((v, seq,
                                           frozenset(by_version[v])))
+                self._seq_of_version[v] = seq
                 prev_v = v
             await self.disk_queue.commit()
         TraceEvent("TLogRecovered").detail("Id", self.id).detail(
@@ -198,7 +228,10 @@ class TLog:
                     continue
                 q = self.tag_data.setdefault(tag, deque())
                 q.append((req.version, msgs))
-                self.bytes_input += sum(m.expected_size() for m in msgs)
+                nbytes = sum(m.expected_size() for m in msgs)
+                self.bytes_input += nbytes
+                self.bytes_in_memory += nbytes
+                self.tag_bytes[tag] = self.tag_bytes.get(tag, 0) + nbytes
             self.known_committed_version = max(self.known_committed_version,
                                                req.known_committed_version)
             if self.disk_queue is not None:
@@ -208,8 +241,10 @@ class TLog:
                     req.messages))
                 self._record_seqs.append(
                     (req.version, seq, frozenset(req.messages)))
+                self._seq_of_version[req.version] = seq
             self.version.set(req.version)
             self._start_sync()
+            self._maybe_spill()
         await self.durable_version.when_at_least(req.version)
         req.reply.send(self.version.get())
 
@@ -234,9 +269,56 @@ class TLog:
                 else:
                     await delay(_SIM_FSYNC_SECONDS)
                 self.durable_version.set(target)
+                # Entries appended before this fsync are durable now, so
+                # a pending overflow can finally evict them.
+                self._maybe_spill()
             self._sync_running = False
 
         get_event_loop().spawn(sync(), f"{self.id}.queueCommit")
+
+    # -- spill-by-reference (reference TLogData spill fields :293) -----------
+    def _maybe_spill(self) -> None:
+        """When resident payload bytes exceed the knob limit, evict the
+        oldest DURABLE entries of the heaviest tags to (version, seq)
+        references — a lagging storage server's backlog then lives on
+        disk, not in the TLog's heap, and its peeks read the queue file
+        (reference spill-by-reference; memory stays bounded no matter how
+        far a puller falls behind)."""
+        if self.disk_queue is None:
+            return
+        knobs = server_knobs()
+        limit = int(knobs.TLOG_SPILL_THRESHOLD)
+        if self.bytes_in_memory <= limit:
+            return
+        durable = self.durable_version.get()
+        spilled_bytes = 0
+        while self.bytes_in_memory > limit * 3 // 4:
+            # Heaviest tag first: that's the laggard filling the heap.
+            tag = max(self.tag_bytes, key=lambda t: self.tag_bytes.get(t, 0),
+                      default=None)
+            if tag is None or self.tag_bytes.get(tag, 0) <= 0:
+                break
+            q = self.tag_data.get(tag)
+            progressed = False
+            while q and self.bytes_in_memory > limit * 3 // 4:
+                version, msgs = q[0]
+                seq = self._seq_of_version.get(version)
+                if version > durable or seq is None:
+                    break      # only durable records are readable from disk
+                q.popleft()
+                nbytes = sum(m.expected_size() for m in msgs)
+                self.bytes_in_memory -= nbytes
+                self.tag_bytes[tag] -= nbytes
+                spilled_bytes += nbytes
+                self.spilled.setdefault(tag, deque()).append((version, seq))
+                progressed = True
+            if not progressed:
+                break          # nothing durable to evict yet; retry later
+        if spilled_bytes:
+            self.bytes_spilled += spilled_bytes
+            TraceEvent("TLogSpilled").detail("Id", self.id).detail(
+                "Bytes", spilled_bytes).detail(
+                "InMemory", self.bytes_in_memory).log()
 
     # -- peek / pop ----------------------------------------------------------
     async def _peek(self, req: TLogPeekRequest) -> None:
@@ -248,24 +330,58 @@ class TLog:
             from ..core.futures import wait_any
             await wait_any([self.version.when_at_least(req.begin),
                             self._stop_promise.get_future()])
+        # ONE synchronous cut of both tiers (no await between the two
+        # snapshots): _maybe_spill moves entries resident -> spilled
+        # concurrently with the disk reads below, and a late spilled-deque
+        # snapshot would miss entries present in neither list — a silent
+        # version gap the puller would advance past (data loss).  An entry
+        # may appear in BOTH snapshots after such a move; dedupe by
+        # version.
+        sq_snap = list(self.spilled.get(req.tag) or ())
+        resident_snap = [(v, msgs) for v, msgs in
+                         (self.tag_data.get(req.tag) or ())
+                         if v >= req.begin]
+        max_known = self.version.get()
         out: List[Tuple[Version, List[Mutation]]] = []
-        q = self.tag_data.get(req.tag)
-        if q is not None:
-            for v, msgs in q:
-                if v >= req.begin:
-                    out.append((v, msgs))
+        seen = set()
+        # Spilled prefix: read the referenced commit records back from the
+        # queue file (reference tLogPeekMessages :1584 serving spilled
+        # tags via IDiskQueue reads).
+        for v, seq in sq_snap:
+            if v < req.begin:
+                continue
+            blob = await self.disk_queue.read_payload(seq)
+            if blob is None:
+                continue     # popped concurrently with this peek
+            _v, _p, _k, _pop, messages = _unpack_commit(blob)
+            msgs = messages.get(req.tag)
+            if msgs:
+                out.append((v, msgs))
+                seen.add(v)
+        for v, msgs in resident_snap:
+            if v not in seen:
+                out.append((v, msgs))
+        out.sort(key=lambda e: e[0])
         req.reply.send(TLogPeekReply(
-            messages=out, end=self.version.get() + 1,
-            max_known_version=self.version.get()))
+            messages=out, end=max_known + 1,
+            max_known_version=max_known))
 
     def _pop(self, req: TLogPopRequest) -> None:
         prev = self.poppedtags.get(req.tag, 0)
         if req.to > prev:
             self.poppedtags[req.tag] = req.to
+            sq = self.spilled.get(req.tag)
+            if sq is not None:
+                while sq and sq[0][0] <= req.to:
+                    sq.popleft()
             q = self.tag_data.get(req.tag)
             if q is not None:
                 while q and q[0][0] <= req.to:
-                    q.popleft()
+                    _v, msgs = q.popleft()
+                    nbytes = sum(m.expected_size() for m in msgs)
+                    self.bytes_in_memory -= nbytes
+                    if req.tag in self.tag_bytes:
+                        self.tag_bytes[req.tag] -= nbytes
             self._trim_queue()
         if req.reply is not None:
             req.reply.send(None)
@@ -286,6 +402,7 @@ class TLog:
             if not all(self.poppedtags.get(t, 0) >= version for t in tags):
                 break
             self._record_seqs.popleft()
+            self._seq_of_version.pop(version, None)
             last_seq = seq
         if last_seq:
             self.disk_queue.pop(last_seq)

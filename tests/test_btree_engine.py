@@ -122,3 +122,101 @@ def test_cluster_on_btree_engine_survives_power_fail():
         set_simulator(None)
         set_event_loop(None)
 
+
+def test_churn_file_size_plateaus():
+    """VERDICT r3 item 7: with the free-list, write/clear churn reuses
+    pages — the file stops growing instead of leaking a page per COW."""
+    fresh_loop()
+    from foundationdb_tpu.server.kvstore_btree import KVStoreBTree
+    fs = SimFileSystem()
+    eng = KVStoreBTree(fs, "churn")
+
+    async def go():
+        for i in range(50):
+            eng.set(b"k%04d" % i, b"v" * 100)
+        await eng.commit()
+        sizes = []
+        for round_ in range(30):
+            for i in range(50):
+                eng.set(b"k%04d" % i, b"w%03d" % round_ + b"v" * 100)
+            await eng.commit()
+            eng.clear(b"k0010", b"k0040")
+            await eng.commit()
+            for i in range(10, 40):
+                eng.set(b"k%04d" % i, b"v" * 100)
+            await eng.commit()
+            sizes.append(eng.page_count)
+        # Page count must PLATEAU: the last 10 rounds allocate nothing new.
+        assert sizes[-1] == sizes[-10], sizes
+        assert len(eng.free) > 0
+        return True
+
+    assert drive(go())
+
+
+def test_large_values_round_trip_power_fail():
+    """VERDICT r3 item 7: 1MB values stored via overflow page chains
+    survive an unclean power failure and read back bit-identical."""
+    import hashlib
+    fresh_loop()
+    from foundationdb_tpu.server.kvstore_btree import KVStoreBTree
+    fs = SimFileSystem()
+    eng = KVStoreBTree(fs, "big")
+    big1 = bytes(range(256)) * 4096            # 1MB, patterned
+    big2 = hashlib.sha256(b"x").digest() * 40_000   # ~1.25MB
+
+    async def go():
+        eng.set(b"big1", big1)
+        eng.set(b"small", b"s")
+        await eng.commit()
+        eng.set(b"big2", big2)
+        await eng.commit()
+        # Overwrite big1: its old overflow chain must be freed (reused
+        # later), and the new value read back.
+        eng.set(b"big1", big1[::-1])
+        await eng.commit()
+        assert eng.read_value(b"big1") == big1[::-1]
+        assert eng.read_value(b"big2") == big2
+        return True
+
+    assert drive(go())
+
+    fs.power_fail_all()
+    eng2 = KVStoreBTree(fs, "big")
+
+    async def after():
+        await eng2.recover()
+        assert eng2.read_value(b"big1") == big1[::-1]
+        assert eng2.read_value(b"big2") == big2
+        assert eng2.read_value(b"small") == b"s"
+        # Clearing the big records frees their chains into the free list.
+        free0 = len(eng2.free)
+        eng2.clear(b"big1", b"big3")
+        await eng2.commit()
+        assert len(eng2.free) > free0 + 100   # hundreds of overflow pages
+        return True
+
+    assert drive(after())
+
+
+def test_overflow_chain_freed_on_overwrite_and_reused():
+    fresh_loop()
+    from foundationdb_tpu.server.kvstore_btree import KVStoreBTree
+    fs = SimFileSystem()
+    eng = KVStoreBTree(fs, "reuse")
+
+    async def go():
+        eng.set(b"k", b"A" * 50_000)
+        await eng.commit()
+        pages_after_first = eng.page_count
+        # Overwrite the same big value many times: page count must not
+        # grow linearly — freed chains are reused.
+        for i in range(10):
+            eng.set(b"k", bytes([i]) * 50_000)
+            await eng.commit()
+        assert eng.page_count <= pages_after_first + 20, (
+            eng.page_count, pages_after_first)
+        assert eng.read_value(b"k") == bytes([9]) * 50_000
+        return True
+
+    assert drive(go())

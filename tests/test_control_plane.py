@@ -76,13 +76,13 @@ def test_grv_rate_budget_enforced(teardown):
             GetReadVersionRequest(priority=TransactionPriority.IMMEDIATE)
             for _ in range(3)]
         budget = min(0.0 + gp._rate * 0.5, gp._rate)
-        batch, charged = gp._drain(budget)
+        batch, charged, _bc = gp._drain(budget, float("inf"))
         # IMMEDIATE always released and NOT charged; default charged.
         assert len(batch) == 3 + 5
         assert charged == 5
         assert len(gp.queues[TransactionPriority.DEFAULT]) == 15
         # Fractional budget releases at most one txn and carries the debt.
-        batch, charged = gp._drain(0.1)
+        batch, charged, _bc = gp._drain(0.1, float("inf"))
         assert len(batch) == 1 and charged == 1
         assert (0.1 - charged) < 0      # caller keeps the deficit
 

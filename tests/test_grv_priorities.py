@@ -1,0 +1,151 @@
+"""GRV priority queues: batch-priority load cannot starve default.
+
+Reference: fdbserver/GrvProxyServer.actor.cpp:389 (priority queues),
+:702 (transactionStarter releasing against distinct normal/batch budgets)
+and Ratekeeper.actor.cpp:991 (separate batch limit with tighter targets).
+VERDICT round-3 item 5 done-criterion: a batch-priority flood must not
+delay default-priority GRVs under overload.
+"""
+
+import pytest
+
+from foundationdb_tpu.core.scheduler import delay
+from foundationdb_tpu.rpc.endpoint import RequestStream
+from foundationdb_tpu.server.grv_proxy import GrvProxy
+from foundationdb_tpu.server.interfaces import (GetRawCommittedVersionReply,
+                                                GetRawCommittedVersionRequest,
+                                                GetReadVersionRequest,
+                                                MasterInterface,
+                                                TransactionPriority)
+
+from test_recovery import teardown  # noqa: F401
+
+
+def _world():
+    from foundationdb_tpu.core import EventLoop, set_event_loop
+    from foundationdb_tpu.rpc.network import SimNetwork, set_network
+    from foundationdb_tpu.rpc.sim import Simulator, set_simulator
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    sim = Simulator()
+    set_simulator(sim)
+    set_network(sim.network)
+    return lp, sim
+
+
+async def _serve_versions(master: MasterInterface) -> None:
+    async for req in master.get_live_committed_version.queue:
+        req.reply.send(GetRawCommittedVersionReply(version=1000))
+
+
+def test_batch_flood_cannot_starve_default_grvs(teardown):  # noqa: F811
+    lp, sim = _world()
+    p = sim.new_process(name="grvhost")
+    master = MasterInterface()
+    for s in master.streams():
+        p.register(s)
+    p.spawn(_serve_versions(master), "master.stub")
+
+    proxy = GrvProxy("grv-test", master)
+    proxy.run(p)
+    # Overload regime straight from the ratekeeper model: normal budget
+    # 100 tps, batch collapsed to 5 tps (batch throttles first).
+    proxy._rate = 100.0
+    proxy._batch_rate = 5.0
+
+    grv_ep = proxy.interface.get_consistent_read_version.endpoint
+    results = {"batch_done": 0, "default_lat": []}
+
+    async def flood_batch() -> None:
+        # 2000 batch-priority GRVs queued at once: at 5 tps this backlog
+        # takes ~400s — it must NOT block default traffic behind it.
+        for _ in range(2000):
+            f = RequestStream.at(grv_ep).get_reply(GetReadVersionRequest(
+                priority=TransactionPriority.BATCH))
+            f.on_ready(lambda _f: results.__setitem__(
+                "batch_done", results["batch_done"] + 1))
+
+    async def default_traffic() -> None:
+        from foundationdb_tpu.core.scheduler import now
+        for _ in range(40):
+            t0 = now()
+            await RequestStream.at(grv_ep).get_reply(GetReadVersionRequest(
+                priority=TransactionPriority.DEFAULT))
+            results["default_lat"].append(now() - t0)
+            await delay(0.05)
+
+    async def go():
+        lp.spawn(flood_batch())
+        await delay(0.2)         # the flood is queued first
+        await default_traffic()
+        await delay(1.0)
+        return True
+
+    assert lp.run_until(lp.spawn(go()), timeout=60)
+    # Every default GRV was served promptly despite the queued flood...
+    assert len(results["default_lat"]) == 40
+    assert max(results["default_lat"]) < 0.5, results["default_lat"]
+    # ...while the batch backlog drained at only ~5 tps (strictly limited).
+    assert results["batch_done"] < 100, results["batch_done"]
+    assert results["batch_done"] >= 1   # but not starved entirely
+
+
+def test_immediate_priority_bypasses_budgets(teardown):  # noqa: F811
+    lp, sim = _world()
+    p = sim.new_process(name="grvhost")
+    master = MasterInterface()
+    for s in master.streams():
+        p.register(s)
+    p.spawn(_serve_versions(master), "master.stub")
+    proxy = GrvProxy("grv-test", master)
+    proxy.run(p)
+    proxy._rate = 0.001          # normal traffic fully throttled
+    proxy._batch_rate = 0.001
+    proxy.transaction_budget = 0.0
+    proxy.batch_budget = 0.0
+
+    grv_ep = proxy.interface.get_consistent_read_version.endpoint
+
+    async def go():
+        from foundationdb_tpu.core.scheduler import now
+        t0 = now()
+        r = await RequestStream.at(grv_ep).get_reply(GetReadVersionRequest(
+            priority=TransactionPriority.IMMEDIATE))
+        assert r.version == 1000
+        return now() - t0
+
+    lat = lp.run_until(lp.spawn(go()), timeout=30)
+    assert lat < 0.5, lat
+
+
+def test_ratekeeper_batch_limit_collapses_first(teardown):  # noqa: F811
+    """The batch spring zone sits below the normal one: as the worst
+    storage queue grows, batch_tps hits ~0 while normal tps is still
+    unlimited or generous."""
+    from foundationdb_tpu.core import EventLoop, set_event_loop
+    from foundationdb_tpu.core.knobs import server_knobs
+    from foundationdb_tpu.server.ratekeeper import Ratekeeper
+
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    rk = Ratekeeper("rk-test", {})
+    rk._released_window = [(0.0, 0), (1.0, 1000)]   # 1000 tps observed
+    target = float(server_knobs().STORAGE_LIMIT_BYTES)
+    spring = max(target * 0.2, 1.0)
+
+    rk.worst_queue_bytes = 0
+    rk._update_rate()
+    assert rk.tps_limit == float("inf")
+    assert rk.batch_tps_limit == float("inf")
+
+    # Inside the batch spring zone only: batch throttled, normal not.
+    rk.worst_queue_bytes = int(target - 1.5 * spring)
+    rk._update_rate()
+    assert rk.tps_limit == float("inf")
+    assert rk.batch_tps_limit < 1000
+
+    # At the normal threshold: batch ~0, normal begins throttling.
+    rk.worst_queue_bytes = int(target - spring + spring * 0.5)
+    rk._update_rate()
+    assert rk.batch_tps_limit <= 1.0
+    assert rk.tps_limit < float("inf")

@@ -1,0 +1,125 @@
+"""TLog spill-by-reference + memory backpressure.
+
+Reference: fdbserver/TLogServer.actor.cpp:293 (TLogData spill fields) and
+:1584 (tLogPeekMessages serving spilled tags from the DiskQueue).  VERDICT
+round-3 item 6 done-criteria: stall a storage server's pulls, push many
+times the memory limit, TLog memory stays bounded, and the stalled tag
+catches up afterward (peeks return everything, served from disk).
+"""
+
+import pytest
+
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.core.futures import Promise
+from foundationdb_tpu.server.disk_queue import DiskQueue
+from foundationdb_tpu.server.interfaces import (TLogCommitRequest,
+                                                TLogPeekRequest,
+                                                TLogPopRequest)
+from foundationdb_tpu.server.sim_fs import SimFileSystem
+from foundationdb_tpu.server.tlog import TLog
+from foundationdb_tpu.txn.types import Mutation, MutationType
+
+from test_recovery import teardown  # noqa: F401
+
+
+def _world():
+    from foundationdb_tpu.core import EventLoop, set_event_loop
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    return lp
+
+
+async def _commit(tlog, version, prev, messages):
+    p = Promise()
+    await tlog._commit(TLogCommitRequest(
+        version=version, prev_version=prev, known_committed_version=prev,
+        messages=messages, reply=p))
+    return await p.get_future()
+
+
+def test_stalled_tag_spills_and_catches_up(teardown):  # noqa: F811
+    knobs = server_knobs()
+    old = knobs.TLOG_SPILL_THRESHOLD
+    knobs.TLOG_SPILL_THRESHOLD = 50_000
+    try:
+        lp = _world()
+        fs = SimFileSystem()
+        tlog = TLog("spill-test", disk_queue=DiskQueue(fs.open("t.wal")))
+
+        async def go():
+            payload = b"x" * 1000
+            # Tag 0 is STALLED (never pops); tag 1 pops along.  Push ~500KB
+            # = 10x the 50KB memory limit.
+            v = 0
+            for i in range(500):
+                prev, v = v, v + 1
+                await _commit(tlog, v, prev, {
+                    0: [Mutation(MutationType.SetValue,
+                                 b"k%04d" % i, payload)],
+                    1: [Mutation(MutationType.SetValue,
+                                 b"j%04d" % i, b"small")],
+                })
+                tlog._pop(TLogPopRequest(tag=1, to=v))
+            # Memory stayed bounded despite the 500KB backlog on tag 0.
+            assert tlog.bytes_in_memory <= 60_000, tlog.bytes_in_memory
+            assert tlog.bytes_spilled > 300_000, tlog.bytes_spilled
+            assert tlog.spilled.get(0), "nothing was spilled by reference"
+            # The stalled tag catches up: a peek from the beginning returns
+            # EVERY version, the spilled prefix served from the queue file.
+            p = Promise()
+            await tlog._peek(TLogPeekRequest(tag=0, begin=1, reply=p))
+            reply = await p.get_future()
+            versions = [v for v, _m in reply.messages]
+            assert versions == list(range(1, 501)), (
+                f"missing versions: got {len(versions)}")
+            payloads_ok = all(
+                m[0].param2 == payload for _v, m in reply.messages)
+            assert payloads_ok
+            # After the laggard pops, spilled refs and disk records trim.
+            tlog._pop(TLogPopRequest(tag=0, to=500))
+            assert not tlog.spilled.get(0)
+            assert tlog.bytes_in_memory <= 1000, tlog.bytes_in_memory
+            return True
+
+        assert lp.run_until(lp.spawn(go()), timeout=120)
+    finally:
+        knobs.TLOG_SPILL_THRESHOLD = old
+
+
+def test_spill_survives_reboot(teardown):  # noqa: F811
+    """Spilled data lives in the DiskQueue, so a rebooted TLog recovers it
+    like any other record (from_disk replays the whole surviving queue)."""
+    knobs = server_knobs()
+    old = knobs.TLOG_SPILL_THRESHOLD
+    knobs.TLOG_SPILL_THRESHOLD = 10_000
+    try:
+        lp = _world()
+        fs = SimFileSystem()
+        tlog = TLog("spill-reboot", disk_queue=DiskQueue(fs.open("t.wal")))
+
+        async def phase1():
+            v = 0
+            for i in range(100):
+                prev, v = v, v + 1
+                await _commit(tlog, v, prev, {
+                    0: [Mutation(MutationType.SetValue,
+                                 b"k%04d" % i, b"y" * 500)]})
+            assert tlog.bytes_spilled > 0
+            return True
+
+        assert lp.run_until(lp.spawn(phase1()), timeout=60)
+        fs.power_fail_all()
+
+        async def phase2():
+            t2 = await TLog.from_disk("spill-reboot",
+                                      DiskQueue(fs.open("t.wal")))
+            p = Promise()
+            await t2._peek(TLogPeekRequest(tag=0, begin=1, reply=p))
+            reply = await p.get_future()
+            # Every acked version survived (commit acks only after fsync).
+            assert [v for v, _m in reply.messages] == list(range(1, 101))
+            return True
+
+        assert lp.run_until(lp.spawn(phase2()), timeout=60)
+    finally:
+        knobs.TLOG_SPILL_THRESHOLD = old
