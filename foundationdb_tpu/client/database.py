@@ -236,8 +236,21 @@ class Transaction:
         # rejected unless explicitly enabled (management/DD transactions).
         if not hasattr(self, "access_system_keys"):
             self.access_system_keys = False
+        # REPORT_CONFLICTING_KEYS option + the resulting ranges of the
+        # last not_committed attempt, surfaced via
+        # \xff\xff/transaction/conflicting_keys (reference RYW +
+        # SpecialKeySpace ConflictingKeysImpl).  Both survive _reset so
+        # the retry loop can read them before on_error clears state.
+        if not hasattr(self, "report_conflicting_keys"):
+            self.report_conflicting_keys = False
+        if not hasattr(self, "_conflicting_keys"):
+            # Survives attempt resets: the RETRY reads the previous
+            # attempt's conflicts (reference: conflicting-keys special
+            # keys are populated for the attempt after the conflict).
+            self._conflicting_keys: List[Tuple[bytes, bytes]] = []
 
     def reset(self) -> None:
+        self._conflicting_keys = []
         self._reset()
         self._backoff = client_knobs().DEFAULT_BACKOFF
 
@@ -265,9 +278,37 @@ class Transaction:
             raise err("request_maybe_delivered", "GRV timed out")
         return f.get().version
 
+    # Special keyspace (reference SpecialKeySpace.actor.h ConflictingKeys
+    # module): boundary keys under this prefix with \x01 = range begin,
+    # \x00 = range end, populated after a not_committed attempt with
+    # report_conflicting_keys set.
+    CONFLICTING_KEYS_PREFIX = b"\xff\xff/transaction/conflicting_keys/"
+
+    def _conflicting_key_rows(self) -> List[Tuple[bytes, bytes]]:
+        # Coalesce first: per-resolver clipping can split one logical
+        # range at resolver boundaries, and un-merged pieces would emit
+        # the shared boundary twice with contradictory begin/end markers.
+        merged: List[List[bytes]] = []
+        for b, e in sorted(self._conflicting_keys):
+            if merged and b <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([b, e])
+        rows: List[Tuple[bytes, bytes]] = []
+        p = self.CONFLICTING_KEYS_PREFIX
+        for b, e in merged:
+            rows.append((p + b, b"\x01"))
+            rows.append((p + e, b"\x00"))
+        return rows
+
     # -- reads ---------------------------------------------------------------
     async def get(self, key: bytes, snapshot: bool = False
                   ) -> Optional[bytes]:
+        if key.startswith(self.CONFLICTING_KEYS_PREFIX):
+            for k, v in self._conflicting_key_rows():
+                if k == key:
+                    return v
+            return None
         _check_key(key, self.access_system_keys)
         if not snapshot:
             self.read_conflict_ranges.append((key, key_after(key)))
@@ -306,6 +347,13 @@ class Transaction:
         limit-truncated."""
         if begin >= end:
             return []
+        p = self.CONFLICTING_KEYS_PREFIX
+        if begin.startswith(p) or (begin <= p and end > p):
+            rows = [(k, v) for k, v in self._conflicting_key_rows()
+                    if begin <= k < end]
+            if reverse:
+                rows.reverse()
+            return rows[:limit]
         if not snapshot:
             self.read_conflict_ranges.append((begin, end))
         version = await self._ensure_read_version()
@@ -480,7 +528,8 @@ class Transaction:
             write_conflict_ranges=[KeyRange(b, e) for b, e in
                                    _coalesce(wcr)],
             mutations=self.writes.mutations,
-            read_snapshot=read_snapshot)
+            read_snapshot=read_snapshot,
+            report_conflicting_keys=self.report_conflicting_keys)
         if txn.expected_size() > client_knobs().TRANSACTION_SIZE_LIMIT:
             raise err("transaction_too_large")
         await self.db._await_ready()
@@ -498,6 +547,11 @@ class Transaction:
             if e.name in ("broken_promise", "connection_failed",
                           "request_maybe_delivered"):
                 raise err("commit_unknown_result", f"commit lost: {e.name}")
+            if e.name == "not_committed":
+                # Conflicting read ranges ride the error reply; surface
+                # them as \xff\xff/transaction/conflicting_keys to the
+                # retry (reference NativeAPI :5118-5123).
+                self._conflicting_keys = list(getattr(e, "details", []))
             raise
         if idx == 1:
             raise err("commit_unknown_result", "commit timed out")

@@ -59,3 +59,32 @@ async def excluded_servers(db) -> List[int]:
                     if v == b"1"]
         except FdbError as e:
             await t.on_error(e)
+
+
+async def change_configuration(db, **fields) -> None:
+    """Change the database configuration transactionally (reference
+    `fdbcli configure` -> ManagementAPI changeConfig writing \\xff/conf/
+    keys): role counts and engine settings become ordinary committed
+    state — they survive exactly what the database survives, and the
+    transaction system recovers into the new shape."""
+    from ..server.system_data import conf_key
+
+    async def go(t):
+        for name, value in fields.items():
+            if value is None:
+                t.clear(conf_key(name))
+            else:
+                t.set(conf_key(name), str(value).encode())
+    await _retrying(db, go)
+
+
+async def get_configuration(db) -> dict:
+    """The committed \\xff/conf/ overrides (absent fields use static
+    defaults)."""
+    from ..server.system_data import CONF_END, CONF_PREFIX, EXCLUDED_PREFIX
+
+    async def go(t):
+        rows = await t.get_range(CONF_PREFIX, CONF_END)
+        return {k[len(CONF_PREFIX):].decode(): v for k, v in rows
+                if not k.startswith(EXCLUDED_PREFIX)}
+    return await _retrying(db, go)

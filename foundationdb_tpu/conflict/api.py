@@ -47,6 +47,26 @@ class ConflictSet:
         per transaction, in input order."""
         raise NotImplementedError
 
+    def resolve_with_conflicts(self, transactions, now: Version,
+                               new_oldest_version: Optional[Version] = None):
+        """(verdicts, {txn_index: [(begin, end), ...]}) — the ranges are
+        the conflicting READ ranges of CONFLICT-verdict transactions that
+        set report_conflicting_keys (reference ConflictBatch's
+        conflictingKeyRangeMap feeding \\xff\\xff/transaction/
+        conflicting_keys).  Base implementation is CONSERVATIVE: it
+        reports every read range of a conflicted reporter (a superset of
+        the true culprits — allowed, like the reference's approximation
+        note in ReadYourWrites.actor.cpp); OracleConflictSet reports the
+        exact ranges."""
+        verdicts = self.resolve(transactions, now, new_oldest_version)
+        ranges = {}
+        for i, (v, tr) in enumerate(zip(verdicts, transactions)):
+            if v == CommitResult.CONFLICT and \
+                    getattr(tr, "report_conflicting_keys", False):
+                ranges[i] = [(r.begin, r.end)
+                             for r in tr.read_conflict_ranges]
+        return verdicts, ranges
+
     def clear(self, version: Version) -> None:
         """Reset all history (reference clearConflictSet)."""
         raise NotImplementedError

@@ -104,9 +104,26 @@ class OracleConflictSet(ConflictSet):
 
     def resolve(self, transactions: Sequence[CommitTransactionRef], now: Version,
                 new_oldest_version: Optional[Version] = None) -> List[CommitResult]:
+        verdicts, _ranges = self.resolve_with_conflicts(
+            transactions, now, new_oldest_version)
+        return verdicts
+
+    def resolve_with_conflicts(self, transactions, now: Version,
+                               new_oldest_version: Optional[Version] = None):
+        """EXACT conflicting-keys reporting (overrides the conservative
+        base): for reporters, every read range individually checked
+        against the history and the intra-batch writers — the reported
+        set is precisely the ranges whose max write version exceeded the
+        snapshot (reference ConflictBatch report path feeding
+        ReportConflictingKeys.actor.cpp's cross-check)."""
         n = len(transactions)
         too_old = [False] * n
         conflict = [False] * n
+        reported: dict = {}
+
+        def _report(t, tr, rng) -> None:
+            if getattr(tr, "report_conflicting_keys", False):
+                reported.setdefault(t, []).append((rng.begin, rng.end))
 
         # 1. too-old classification (SkipList.cpp:819-827): snapshot below the
         # window floor, and only if the txn actually read something.
@@ -118,10 +135,13 @@ class OracleConflictSet(ConflictSet):
         for t, tr in enumerate(transactions):
             if too_old[t]:
                 continue
+            report = getattr(tr, "report_conflicting_keys", False)
             for r in tr.read_conflict_ranges:
                 if self.history.query_max(r.begin, r.end) > tr.read_snapshot:
                     conflict[t] = True
-                    break
+                    _report(t, tr, r)
+                    if not report:
+                        break
 
         # 3. intra-batch, in batch order; only surviving writers block
         # (checkIntraBatchConflicts, SkipList.cpp:874-906).
@@ -130,14 +150,19 @@ class OracleConflictSet(ConflictSet):
             if conflict[t]:
                 continue
             c = too_old[t]
+            report = getattr(tr, "report_conflicting_keys", False)
             if not c:
                 for r in tr.read_conflict_ranges:
+                    hit = False
                     for wb, we in surviving_writes:
                         if r.begin < we and wb < r.end:
-                            c = True
+                            hit = True
                             break
-                    if c:
-                        break
+                    if hit:
+                        c = True
+                        _report(t, tr, r)
+                        if not report:
+                            break
             conflict[t] = c
             if not c:
                 for w in tr.write_conflict_ranges:
@@ -161,4 +186,6 @@ class OracleConflictSet(ConflictSet):
                 out.append(CommitResult.CONFLICT)
             else:
                 out.append(CommitResult.COMMITTED)
-        return out
+        reported = {t: rs for t, rs in reported.items()
+                    if out[t] == CommitResult.CONFLICT}
+        return out, reported

@@ -138,6 +138,9 @@ def encode_value(w: Writer, v: Any) -> None:
         w.u8(T_ADDRESS).str_(v.ip).u32(v.port)
     elif isinstance(v, FdbError):
         w.u8(T_ERROR).u32(v.code).str_(v.name).str_(str(v))
+        # Optional structured payload (e.g. not_committed carrying the
+        # conflicting key ranges for \xff\xff/transaction/conflicting_keys)
+        encode_value(w, getattr(v, "details", None))
     elif isinstance(v, tuple):
         w.u8(T_TUPLE).u32(len(v))
         for x in v:
@@ -225,7 +228,11 @@ def decode_value(r: Reader) -> Any:
         code = r.u32()
         name = r.str_()
         msg = r.str_()
-        return FdbError(code, name, msg)
+        e = FdbError(code, name, msg)
+        details = decode_value(r)
+        if details is not None:
+            e.details = details
+        return e
     if tag == T_TUPLE:
         return tuple(decode_value(r) for _ in range(r.u32()))
     if tag == T_LIST:

@@ -80,7 +80,8 @@ class ClusterController:
                             f"{self.id}.monitorWorker")
             self.workers[req.worker.id] = WorkerRegistration(
                 req.worker, req.process_class,
-                req.recovered_logs, req.recovered_storage)
+                req.recovered_logs, req.recovered_storage,
+                getattr(req, "storage_versions", {}) or {})
             arrived, self._worker_arrived = self._worker_arrived, []
             for p in arrived:
                 p.send(None)

@@ -294,6 +294,16 @@ class CommitProxy:
             self.master.report_live_committed_version.endpoint).get_reply(
             ReportRawCommittedVersionRequest(version=commit_version))
         self.metrics.histogram("Commit").record(now() - t_start)
+        # Union each reporter's conflicting read ranges across the
+        # resolvers that judged it (reference: conflictingKRIndices merged
+        # per transaction before the client reply).
+        conflict_ranges: Dict[int, list] = {}
+        for r_idx, reply in enumerate(resolutions):
+            for local_i, ranges in getattr(reply, "conflicting_ranges",
+                                           {}).items():
+                if local_i < len(index_maps[r_idx]):
+                    t_idx = index_maps[r_idx][local_i]
+                    conflict_ranges.setdefault(t_idx, []).extend(ranges)
         for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
             if verdict == CommitResult.COMMITTED:
                 self.metrics.counter("TxnCommitted").add(1)
@@ -307,7 +317,13 @@ class CommitProxy:
             else:
                 self.metrics.counter("TxnConflicted").add(1)
                 from ..core.error import err
-                req.reply.send_error(err("not_committed"))
+                e = err("not_committed")
+                if t_idx in conflict_ranges:
+                    # Rides the error reply to the client, surfacing as
+                    # \xff\xff/transaction/conflicting_keys (reference
+                    # SpecialKeySpace ConflictingKeysImpl).
+                    e.details = conflict_ranges[t_idx]
+                req.reply.send_error(e)
 
     def _spawn(self, coro, name: str):
         """Handlers are PROCESS-scoped: a killed process must cancel its
@@ -431,6 +447,21 @@ class CommitProxy:
         handled, backup_flag = apply_metadata_mutation(self.key_servers, m)
         if backup_flag is not None:
             self.backup_active = backup_flag
+        from .system_data import parse_conf_mutation
+        cf = parse_conf_mutation(m)
+        if cf is not None:
+            # A committed configuration change may end the epoch
+            # (reference: master dies when configuration !=
+            # lastConfiguration).  Forward the FIELDS so the master can
+            # compare values — a client retrying an identical configure
+            # (e.g. after commit_unknown_result across the resulting
+            # recovery) must not bounce every successive epoch.
+            try:
+                RequestStream.at(
+                    self.master.config_changed.endpoint).send(dict(cf))
+            except Exception:  # noqa: BLE001 — master already gone: the
+                pass           # next recovery reads the conf anyway
+            handled = True
         from .system_data import parse_server_tag_mutation
         st = parse_server_tag_mutation(m)
         if st is not None:

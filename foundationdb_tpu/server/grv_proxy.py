@@ -40,6 +40,7 @@ class GrvProxy:
         self.queues: List[List[GetReadVersionRequest]] = [[], [], []]
         self.transaction_budget = float("inf")
         self.batch_budget = float("inf")
+        self._wait_failure_actor = None
         self.stats = {"grvs": 0, "batches": 0}
         from ..core.histogram import CounterCollection
         self.metrics = CounterCollection("GrvProxy", proxy_id)
@@ -146,6 +147,7 @@ class GrvProxy:
             await delay(wait)
 
     async def _reply_batch(self, batch: List[GetReadVersionRequest]) -> None:
+        from ..core.error import FdbError
         _t0 = now()
         # Confirm log-system liveness + fetch live committed version in
         # parallel (reference getLiveCommittedVersion :527).
@@ -154,9 +156,23 @@ class GrvProxy:
         version_f = RequestStream.at(
             self.master.get_live_committed_version.endpoint).get_reply(
             GetRawCommittedVersionRequest())
-        if confirms:
-            await wait_all(confirms)
-        vreply = await version_f
+        try:
+            if confirms:
+                await wait_all(confirms)
+            vreply = await version_f
+        except FdbError as e:
+            # A failed liveness confirm means our log generation is locked
+            # or dead: this proxy must DIE VISIBLY (reference: GRV proxies
+            # die on tlog_failed, taking the master with them so the CC
+            # recruits a fresh epoch).  Observed deadlock without this: a
+            # superseded epoch keeps timing out every GRV forever while
+            # its master never ends.
+            TraceEvent("GrvProxyBatchFailed").detail(
+                "Proxy", self.id).detail("Error", e.name).log()
+            if self._wait_failure_actor is not None and \
+                    not self._wait_failure_actor.is_ready():
+                self._wait_failure_actor.cancel()
+            return
         self.stats["grvs"] += len(batch)
         self.metrics.counter("TxnStarted").add(len(batch))
         self.metrics.histogram("GRVLatency").record(now() - _t0)
@@ -174,6 +190,7 @@ class GrvProxy:
         if self.ratekeeper is not None:
             process.spawn(self._rate_updater(), f"{self.id}.rateUpdater")
         from .failure import hold_wait_failure
-        process.spawn(hold_wait_failure(self.interface.wait_failure),
-                      f"{self.id}.waitFailure")
+        self._wait_failure_actor = process.spawn(
+            hold_wait_failure(self.interface.wait_failure),
+            f"{self.id}.waitFailure")
         TraceEvent("GrvProxyStarted").detail("Id", self.id).log()

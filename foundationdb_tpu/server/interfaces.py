@@ -107,10 +107,17 @@ class MasterInterface:
             TaskPriority.ProxyGetRawCommittedVersion)
         self.wait_failure = RequestStream(
             "master.waitFailure", TaskPriority.FailureMonitor)
+        # One-way nudge from a commit proxy that applied a committed
+        # \xff/conf/ mutation: the master ends its epoch so the next
+        # recovery re-recruits at the new configuration (reference: the
+        # master dies when configuration != lastConfiguration).
+        self.config_changed = RequestStream(
+            "master.configChanged", TaskPriority.DefaultEndpoint)
 
     def streams(self) -> List[RequestStream]:
         return [self.get_commit_version, self.report_live_committed_version,
-                self.get_live_committed_version, self.wait_failure]
+                self.get_live_committed_version, self.wait_failure,
+                self.config_changed]
 
 
 @dataclass
@@ -128,6 +135,32 @@ class DatabaseConfiguration:
     conflict_backend: Optional[str] = None
     storage_engine: str = "memory"     # memory | btree (reference ssd-2)
     min_workers: int = 1
+
+    _INT_FIELDS = ("n_tlogs", "n_commit_proxies", "n_grv_proxies",
+                   "n_resolvers", "n_storage", "log_replication",
+                   "storage_replication", "min_workers")
+    _STR_FIELDS = ("conflict_backend", "storage_engine")
+
+    def with_conf(self, conf: Dict[str, Optional[bytes]]
+                  ) -> "DatabaseConfiguration":
+        """This configuration overridden by committed \\xff/conf/ values
+        (reference: DatabaseConfiguration is PARSED from system keys,
+        fdbclient/DatabaseConfiguration.h fromKeyValues).  None/absent
+        fields keep the static default; the "*" wildcard (broad clear)
+        resets everything to defaults."""
+        import dataclasses as _dc
+        out = _dc.replace(self)
+        for name, raw in conf.items():
+            if raw is None or name == "*":
+                continue
+            if name in self._INT_FIELDS:
+                try:
+                    setattr(out, name, int(raw))
+                except ValueError:
+                    pass
+            elif name in self._STR_FIELDS:
+                setattr(out, name, raw.decode() or None)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -155,6 +188,10 @@ class ResolveTransactionBatchReply:
     # verdicts and applies committed foreign entries to its shard map
     # (reference CommitProxyServer.actor.cpp:737 applyMetadataEffect).
     state_transactions: List[Any] = field(default_factory=list)
+    # {local txn index: [(begin, end), ...]} — conflicting read ranges of
+    # CONFLICT transactions that set report_conflicting_keys (reference
+    # conflictingKRIndices in ResolveTransactionBatchReply).
+    conflicting_ranges: Dict[int, List[Any]] = field(default_factory=dict)
 
 
 @dataclass
@@ -449,6 +486,12 @@ class RegisterWorkerRequest:
     # recovery resolves DBCoreState ids/tags against these.
     recovered_logs: Dict[str, Any] = field(default_factory=dict)
     recovered_storage: Dict[int, Any] = field(default_factory=dict)
+    # Per-tag applied version of each recovered storage role: when two
+    # workers both hold files for one tag (a failed recruitment attempt
+    # left an empty impostor), recovery must adopt the candidate with the
+    # MOST data — an id/tag collision resolved arbitrarily can roll the
+    # tag back to empty.
+    storage_versions: Dict[int, int] = field(default_factory=dict)
     reply: Any = None
 
 
@@ -460,6 +503,7 @@ class WorkerRegistration:
     process_class: str = "unset"
     recovered_logs: Dict[str, Any] = field(default_factory=dict)
     recovered_storage: Dict[int, Any] = field(default_factory=dict)
+    storage_versions: Dict[int, int] = field(default_factory=dict)
 
 
 @dataclass

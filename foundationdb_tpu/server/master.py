@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..core.error import FdbError, err
 from ..core.futures import Future, Promise
 from ..core.knobs import server_knobs
+from ..core.rng import deterministic_random
 from ..core.scheduler import delay, now, spawn
 from ..core.trace import Severity, TraceEvent
 from ..rpc.endpoint import RequestStream
@@ -211,6 +212,11 @@ class DBCoreState:
     # reason).
     tlog_ids: List[str] = field(default_factory=list)
     storage_ids: Dict[Tag, str] = field(default_factory=dict)
+    # Committed \xff/conf/ configuration values as of map_version (the
+    # reference's DatabaseConfiguration lives in the database; the
+    # baseline snapshot rides the cstate like key_servers_ranges, with
+    # TXS replay applying later changes on top).
+    conf: Dict[str, bytes] = field(default_factory=dict)
 
     def pack(self) -> bytes:
         from ..core.wire import Writer
@@ -232,6 +238,9 @@ class DBCoreState:
             w.bytes_(b).bytes_(e).u16(len(team))
             for t in team:
                 w.u32(t)
+        w.u16(len(self.conf))
+        for name, raw in self.conf.items():
+            w.str_(name).bytes_(raw)
         return w.done()
 
     @staticmethod
@@ -258,12 +267,18 @@ class DBCoreState:
             b, e = r.bytes_(), r.bytes_()
             team = [r.u32() for _ in range(r.u16())]
             ranges.append((b, e, team))
+        conf = {}
+        if not r.at_end():
+            for _ in range(r.u16()):
+                name = r.str_()
+                conf[name] = r.bytes_()
         return cls(epoch=epoch, recovery_version=rv,
                    tlogs=[None] * len(tlog_ids), log_replication=log_rep,
                    storage_servers={t: None for t in storage_ids},
                    key_servers_ranges=ranges, n_resolvers=n_res,
                    tlog_ids=tlog_ids, storage_ids=storage_ids,
-                   map_version=map_version, backup_active=backup_active)
+                   map_version=map_version, backup_active=backup_active,
+                   conf=conf)
 
 
 def _split_points(n: int) -> List[bytes]:
@@ -377,9 +392,20 @@ async def master_server(master: Master, process, coordinators,
             raise err("master_recovery_failed", "no workers registered")
         recovered_logs: Dict[str, Any] = {}
         recovered_storage: Dict[Tag, Any] = {}
+        best_storage_ver: Dict[Tag, int] = {}
         for reg in workers:
             recovered_logs.update(reg.recovered_logs)
-            recovered_storage.update(reg.recovered_storage)
+            vers = getattr(reg, "storage_versions", {}) or {}
+            for tag, iface in reg.recovered_storage.items():
+                # Collision tiebreak: a failed recruitment attempt may
+                # have left an EMPTY same-tag impostor on another worker;
+                # the candidate with the most applied data wins — an
+                # arbitrary pick could roll the tag back to empty.
+                v = vers.get(tag, 0)
+                if tag not in recovered_storage or \
+                        v > best_storage_ver.get(tag, -1):
+                    recovered_storage[tag] = iface
+                    best_storage_ver[tag] = v
 
         # LOCKING_CSTATE: lock the previous TLog generation (epoch end).
         old_tag_holders: Dict[Tag, Any] = {}
@@ -448,6 +474,7 @@ async def master_server(master: Master, process, coordinators,
                 old_tlogs[txs_holder].peek.endpoint).get_reply(
                 TLogPeekRequest(tag=TXS_TAG, begin=prev.map_version + 1))
             from .system_data import (apply_metadata_mutation,
+                                      parse_conf_mutation,
                                       parse_server_tag_mutation)
             n_deltas = 0
             replayed_rejoins = {}
@@ -457,6 +484,19 @@ async def master_server(master: Master, process, coordinators,
                         _h, backup_flag = apply_metadata_mutation(map_rm, m)
                         if backup_flag is not None:
                             prev.backup_active = backup_flag
+                        cf = parse_conf_mutation(m)
+                        if cf is not None:
+                            # Configuration changes committed since the
+                            # snapshot: THIS recovery adopts them
+                            # (reference: DatabaseConfiguration is read
+                            # from the database at recovery).
+                            for fname, raw in cf:
+                                if fname == "*":
+                                    prev.conf.clear()
+                                elif raw is None:
+                                    prev.conf.pop(fname, None)
+                                else:
+                                    prev.conf[fname] = raw
                         st = parse_server_tag_mutation(m)
                         if st is not None:
                             # Registry changes committed since the cstate
@@ -510,6 +550,16 @@ async def master_server(master: Master, process, coordinators,
         master.last_epoch_end = recovery_version
         master.live_committed_version = recovery_version
 
+        # Effective configuration: static defaults overridden by the
+        # committed \xff/conf/ state (snapshot + replay above) — role
+        # counts below come from the DATABASE, so a configuration change
+        # is a transaction that survives anything the database survives.
+        if prev is not None and prev.conf:
+            config = config.with_conf(prev.conf)
+            TraceEvent("MasterConfigFromDatabase").detail(
+                "Conf", {k: v.decode(errors="replace")
+                         for k, v in prev.conf.items()}).log()
+
         # RECRUITING (:1741): place roles on registered workers.
         TraceEvent("MasterRecoveryState").detail(
             "State", "recruiting").detail(
@@ -543,13 +593,21 @@ async def master_server(master: Master, process, coordinators,
         new_ls_teams = LogSystemClient(
             [None] * config.n_tlogs, config.log_replication)
         tlog_futures = []
+        # Instance-unique ids (reference: TLogs have UIDs): a FAILED
+        # recovery attempt leaves an empty same-purpose WAL on some other
+        # worker; if ids were only epoch-unique, a later whole-cluster
+        # restart could resolve the cstate's tlog id to that empty
+        # impostor and adopt end_version=0 — rolling the database back to
+        # nothing (observed).  The ".e{epoch}" suffix stays LAST so the
+        # worker's file GC can still parse the generation.
+        tuid = deterministic_random().random_unique_id()[:8]
         for i in range(config.n_tlogs):
             my_tags = {t: h for t, h in old_tag_holders.items()
                        if i in new_ls_teams.team_for_tag(t)}
             tlog_futures.append(RequestStream.at(
                 pick(i).init_tlog.endpoint).get_reply(
                 InitializeTLogRequest(
-                    tlog_id=f"log{i}.e{master.epoch}",
+                    tlog_id=f"log{i}.{tuid}.e{master.epoch}",
                     recovery_version=recovery_version,
                     recover_tags=my_tags,
                     recover_popped={t: old_popped.get(t, 0)
@@ -644,7 +702,8 @@ async def master_server(master: Master, process, coordinators,
             key_servers_ranges=key_servers_ranges,
             n_resolvers=config.n_resolvers,
             map_version=recovery_version,
-            backup_active=prev.backup_active if prev else False))
+            backup_active=prev.backup_active if prev else False,
+            conf=dict(prev.conf) if prev else {}))
 
         # ACCEPTING_COMMITS (:1943): start the allocator + announce.
         adopt(master._serve_commit_versions(), "master.serveVersions")
@@ -673,15 +732,43 @@ async def master_server(master: Master, process, coordinators,
         # commit_proxy_failed / resolver_failed).
         from ..core.futures import wait_any as _wait_any
         from .failure import wait_failure_of
+
+        async def _config_change_watch() -> None:
+            from .interfaces import DatabaseConfiguration
+            defaults = DatabaseConfiguration()
+            known = set(DatabaseConfiguration._INT_FIELDS) | \
+                set(DatabaseConfiguration._STR_FIELDS)
+            async for req in master.interface.config_changed.queue:
+                for fname, raw in (req or {}).items():
+                    if fname == "*":
+                        return      # broad reset: always re-recruit
+                    if fname not in known:
+                        # Unknown conf keys never affect recruitment
+                        # (with_conf ignores them); ending the epoch for
+                        # one would bounce EVERY epoch, since the
+                        # recruited config can never "catch up" to it.
+                        continue
+                    cur = getattr(config, fname, None)
+                    want = (getattr(defaults, fname, None) if raw is None
+                            else raw.decode())
+                    if str(cur) != str(want):
+                        return      # genuinely different: end the epoch
+                # identical to the recruited configuration: ignore
+                # (idempotent configure retry)
+
         role_failures = [
             spawn(wait_failure_of(x), "master.roleWatch")
             for x in (tlogs + resolvers + commit_proxies + grv_proxies +
                       [ratekeeper])]
+        config_watch = spawn(_config_change_watch(), "master.confWatch")
         children.extend(role_failures)
-        idx, _ = await _wait_any(role_failures)
+        children.append(config_watch)
+        idx, _ = await _wait_any(role_failures + [config_watch])
+        reason = ("configuration changed" if idx == len(role_failures)
+                  else "recruited role failed")
         TraceEvent("MasterTerminated", Severity.Warn).detail(
             "Epoch", master.epoch).detail(
-            "Reason", "recruited role failed").detail("RoleIdx", idx).log()
+            "Reason", reason).detail("RoleIdx", idx).log()
     except FdbError as e:
         TraceEvent("MasterRecoveryFailed", Severity.Warn).detail(
             "Epoch", master.epoch).detail("Error", e.name).detail(

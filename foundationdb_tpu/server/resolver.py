@@ -97,7 +97,7 @@ class Resolver:
                          req.version -
                          int(knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS))
         _t0 = now()
-        committed = self.conflict_set.resolve(
+        committed, conflicting = self.conflict_set.resolve_with_conflicts(
             req.transactions, req.version, new_oldest_version=new_oldest)
         self.metrics.histogram("Resolve").record(now() - _t0)
         self.metrics.counter("TxnResolved").add(len(req.transactions))
@@ -107,6 +107,7 @@ class Resolver:
         lrv = req.last_received_version
         reply = ResolveTransactionBatchReply(
             committed=committed,
+            conflicting_ranges=conflicting,
             state_transactions=[e for e in self.state_txns
                                 if e[0] > lrv and e[1] != req.proxy_id])
         self.resolved_batches += 1

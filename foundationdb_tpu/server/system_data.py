@@ -90,6 +90,47 @@ def excluded_key(tag: Tag) -> bytes:
     return EXCLUDED_PREFIX + b"%010d" % tag
 
 
+# Database configuration as ordinary keys (reference \xff/conf/ parsed by
+# DatabaseConfiguration, fdbclient/DatabaseConfiguration.h; changed
+# transactionally via ManagementAPI `configure`).  Field name -> printed
+# value, e.g. \xff/conf/n_resolvers = b"2".  The \xff/conf/excluded/
+# subspace nests here but is NOT a configuration field.
+CONF_PREFIX = b"\xff/conf/"
+CONF_END = b"\xff/conf0"
+
+
+def conf_key(field_name: str) -> bytes:
+    return CONF_PREFIX + field_name.encode()
+
+
+def parse_conf_mutation(m: Mutation):
+    """List of (field_name, value|None) for a \xff/conf/ configuration
+    mutation (None = field cleared back to default), or None.  Exclusion
+    keys are handled separately and skipped here."""
+    if m.type == MutationType.SetValue:
+        if not m.param1.startswith(CONF_PREFIX) or \
+                m.param1.startswith(EXCLUDED_PREFIX):
+            return None
+        return [(m.param1[len(CONF_PREFIX):].decode(), m.param2)]
+    if m.type == MutationType.ClearRange:
+        lo = max(m.param1, CONF_PREFIX)
+        hi = min(m.param2, CONF_END)
+        if hi <= lo:
+            return None
+        if lo >= EXCLUDED_PREFIX and hi <= EXCLUDED_END:
+            return None        # pure exclusion-list clear, not config
+        if hi == lo + b"\x00" and lo.startswith(CONF_PREFIX):
+            # Point clear (Transaction.clear(key) emits [key, key+\x00)):
+            # exactly ONE field reverts to its default — this must NOT
+            # read as the wildcard, or clearing one override would wipe
+            # the whole committed configuration.
+            return [(lo[len(CONF_PREFIX):].decode(), None)]
+        # A broad clear cannot be enumerated without the key list; signal
+        # "all fields reset" with a wildcard the applier understands.
+        return [("*", None)]
+    return None
+
+
 def parse_server_tag_mutation(m: Mutation):
     """(tag, interface) for a registry write, (tag, None) for each tag a
     registry CLEAR retires (a dead, fully-drained server removed by the

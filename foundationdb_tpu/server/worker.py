@@ -44,6 +44,9 @@ class Worker:
         # registry cannot resolve them).
         self.recovered_logs: Dict[str, Any] = {}
         self.recovered_storage: Dict[int, Any] = {}
+        # Per-tag applied version of hosted storage roles (see
+        # RegisterWorkerRequest.storage_versions: collision tiebreak).
+        self.storage_versions: Dict[int, int] = {}
         self._current_cc = None
         from ..core.futures import Promise
         self._scanned: Promise = Promise()
@@ -89,6 +92,7 @@ class Worker:
                     ss.run(self.process)
                     self.storage_roles.append(ss)
                     self.recovered_storage[ss.tag] = ss.interface
+                    self.storage_versions[ss.tag] = ss.version.get()
             if self.recovered_logs or self.recovered_storage:
                 TraceEvent("WorkerBootScan").detail(
                     "Worker", self.process.name).detail(
@@ -314,7 +318,8 @@ class Worker:
                 worker=self.interface,
                 process_class=self.process_class,
                 recovered_logs=dict(self.recovered_logs),
-                recovered_storage=dict(self.recovered_storage)))
+                recovered_storage=dict(self.recovered_storage),
+                storage_versions=dict(self.storage_versions)))
 
     async def _serve_wait_failure(self) -> None:
         """Hold requests forever; process death breaks their promises —

@@ -411,13 +411,14 @@ class ChangeConfigWorkload(TestWorkload):
             else 2
         self.want_proxies = 3 - cfg.n_commit_proxies \
             if cfg.n_commit_proxies in (1, 2) else 2
-        cfg.n_resolvers = self.want_resolvers
-        cfg.n_commit_proxies = self.want_proxies
-        cc = self.cluster.current_cc()
-        if cc is not None and cc.db_info.master is not None:
-            proc = self.cluster.process_of(cc.db_info.master)
-            if proc is not None:
-                self.cluster.sim.kill_process(proc)
+        # A configuration change is a DATABASE TRANSACTION (reference
+        # ChangeConfig.actor.cpp -> ManagementAPI changeConfig): commit
+        # the \xff/conf/ keys; the proxies nudge the master, the epoch
+        # ends, and the next recovery recruits at the new counts.
+        from ..client.management import change_configuration
+        await change_configuration(self.db,
+                                   n_resolvers=self.want_resolvers,
+                                   n_commit_proxies=self.want_proxies)
         self.metrics["changed"] = 1
 
     async def check(self) -> bool:

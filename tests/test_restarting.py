@@ -1,0 +1,143 @@
+"""Restarting tests: save disk state, restart EVERY process from disk in
+fresh interpreters, verify invariants (VERDICT round-3 item 9).
+
+Reference: tests/restarting/ (two-phase specs: the first half runs a
+workload then SaveAndKill.actor.cpp persists the cluster layout and kills
+every process; the second half — possibly a different binary — restarts
+from the same data directories and checks the workload's invariants).
+Here each fdbserver is a real OS process (server/fdbserver.py); phase 2
+re-execs every one of them from its datadir, so recovery runs purely from
+durable state in brand-new interpreters — the upgrade-test scaffold.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 47500
+COORDS = f"127.0.0.1:{BASE_PORT}"
+CONFIG = json.dumps({"n_storage": 2, "min_workers": 3})
+
+NAMES = {"coord0": (BASE_PORT, "stateless"),
+         "stateless1": (BASE_PORT + 1, "stateless"),
+         "storage0": (BASE_PORT + 2, "storage"),
+         "storage1": (BASE_PORT + 3, "storage")}
+
+
+def _spawn(base, name, generation):
+    port, pclass = NAMES[name]
+    cmd = [sys.executable, "-m", "foundationdb_tpu.server.fdbserver",
+           "--port", str(port), "--coordinators", COORDS,
+           "--datadir", os.path.join(base, name), "--class", pclass,
+           "--config", CONFIG, "--name", f"{name}.g{generation}"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=open(os.path.join(base, f"{name}.g{generation}.out"), "wb"),
+        stderr=subprocess.STDOUT)
+
+
+def _client():
+    from foundationdb_tpu.client.database import open_cluster
+    return open_cluster(COORDS)
+
+
+def _teardown_client():
+    from foundationdb_tpu.core.scheduler import set_event_loop
+    from foundationdb_tpu.rpc.network import get_network, set_network
+    try:
+        get_network().close()
+    except Exception:
+        pass
+    set_network(None)
+    set_event_loop(None)
+
+
+async def _commit_kv(db, k, v):
+    t = db.create_transaction()
+    while True:
+        try:
+            t.set(k, v)
+            return await t.commit()
+        except Exception as e:
+            await t.on_error(e)
+
+
+async def _read_key(db, k):
+    t = db.create_transaction()
+    while True:
+        try:
+            return await t.get(k)
+        except Exception as e:
+            await t.on_error(e)
+
+
+def test_whole_cluster_restart_from_disk(tmp_path):
+    base = str(tmp_path)
+    N = 12
+    procs = {n: _spawn(base, n, 1) for n in NAMES}
+    try:
+        time.sleep(2.5)
+        dead = {n: p.poll() for n, p in procs.items()
+                if p.poll() is not None}
+        assert not dead, f"phase-1 processes died at boot: {dead}"
+        loop, db = _client()
+
+        async def phase1():
+            # A cycle ring (the classic restarting-test invariant) plus
+            # plain data.
+            for i in range(N):
+                await _commit_kv(db, b"ring/%03d" % i,
+                                 b"ring/%03d" % ((i + 1) % N))
+            for i in range(20):
+                await _commit_kv(db, b"data/%03d" % i, b"v%03d" % i)
+            return True
+
+        assert loop.run_until(loop.spawn(phase1()), timeout=90)
+        _teardown_client()
+
+        # SaveAndKill: stop EVERY process.  SIGKILL — recovery must work
+        # from exactly what was durable, like a power failure.
+        for p in procs.values():
+            p.kill()
+        for p in procs.values():
+            p.wait()
+        time.sleep(1.0)
+
+        # Phase 2: fresh interpreters over the same data directories.
+        procs = {n: _spawn(base, n, 2) for n in NAMES}
+        time.sleep(2.5)
+        dead = {n: p.poll() for n, p in procs.items()
+                if p.poll() is not None}
+        assert not dead, f"phase-2 processes died at boot: {dead}"
+        loop, db = _client()
+
+        async def phase2():
+            # Cycle invariant holds across the restart.
+            seen = set()
+            k = b"ring/%03d" % 0
+            for _ in range(N):
+                assert k not in seen
+                seen.add(k)
+                k = await _read_key(db, k)
+                assert k is not None, "ring broken"
+            assert k == b"ring/%03d" % 0 and len(seen) == N
+            for i in range(20):
+                assert await _read_key(db, b"data/%03d" % i) == b"v%03d" % i
+            # The restarted cluster accepts new commits.
+            await _commit_kv(db, b"post-restart", b"alive")
+            assert await _read_key(db, b"post-restart") == b"alive"
+            return True
+
+        assert loop.run_until(loop.spawn(phase2()), timeout=120)
+        _teardown_client()
+    finally:
+        for p in procs.values():
+            p.kill()
+        for p in procs.values():
+            p.wait()
