@@ -139,6 +139,64 @@ class Database:
         self.cluster = cluster
         self._location_cache: RangeMap = RangeMap(default=None)
         self._rr = 0   # round-robin over proxies / replicas
+        # Per-replica EWMA latency (reference QueueModel feeding
+        # LoadBalance.actor.h): reads prefer faster replicas; a failed
+        # attempt is penalized so the replica sorts last until the
+        # penalty decays and it proves itself again.
+        self._replica_latency: dict = {}
+
+    from ..rpc.endpoint import TRANSPORT_ERRORS as _FAILOVER_ERRORS
+
+    # Replicas whose EWMA latencies fall in the same band alternate
+    # round-robin — strict fastest-first would pin ALL reads onto one
+    # replica and halve the team's read throughput.
+    _LATENCY_BAND = 0.05
+
+    @staticmethod
+    def _replica_key(ssi):
+        ep = getattr(getattr(ssi, "get_value", None), "_endpoint", None)
+        return ep or id(ssi)
+
+    def _order_replicas(self, ssis):
+        self._rr += 1
+        rr = self._rr
+        # Age penalties/estimates toward zero so a demoted replica is
+        # re-probed eventually instead of staying blacklisted forever.
+        for k in self._replica_latency:
+            self._replica_latency[k] *= 0.9
+        return sorted(
+            ssis, key=lambda s: (
+                int(self._replica_latency.get(self._replica_key(s), 0.0)
+                    / self._LATENCY_BAND),
+                (rr + ssis.index(s)) % len(ssis)))
+
+    def _note_latency(self, ssi, dt: float) -> None:
+        k = self._replica_key(ssi)
+        prev = self._replica_latency.get(k, dt)
+        self._replica_latency[k] = 0.8 * prev + 0.2 * dt
+
+    async def read_replica(self, ssis, stream_of, make_request):
+        """One storage read with REPLICA FAILOVER (reference
+        LoadBalance.actor.h): replicas are tried fastest-first; transport
+        failures move to the next replica instead of surfacing, so a dead
+        replica costs latency, not a client error.  Non-transport errors
+        (wrong_shard_server, future_version, ...) raise through."""
+        from ..core.scheduler import now as _now
+        last: Optional[BaseException] = None
+        for ssi in self._order_replicas(list(ssis)):
+            t0 = _now()
+            try:
+                reply = await RequestStream.at(
+                    stream_of(ssi).endpoint).get_reply(make_request())
+                self._note_latency(ssi, _now() - t0)
+                return reply
+            except FdbError as e:
+                if e.name in self._FAILOVER_ERRORS:
+                    self._note_latency(ssi, 1.0)   # demote; decays back
+                    last = e
+                    continue
+                raise
+        raise last or err("wrong_shard_server", "no replica answered")
 
     # -- proxies -------------------------------------------------------------
     async def _await_ready(self) -> None:
@@ -324,11 +382,10 @@ class Transaction:
         ssis = await self.db.get_key_location(key)
         if not ssis:
             raise err("wrong_shard_server", f"no team for {key!r}")
-        self.db._rr += 1
-        ssi = ssis[self.db._rr % len(ssis)]
         try:
-            reply = await RequestStream.at(ssi.get_value.endpoint).get_reply(
-                GetValueRequest(key=key, version=version))
+            reply = await self.db.read_replica(
+                ssis, lambda s: s.get_value,
+                lambda: GetValueRequest(key=key, version=version))
         except FdbError as e:
             if e.name in ("broken_promise", "wrong_shard_server"):
                 self.db.invalidate_cache(key)
@@ -385,11 +442,10 @@ class Transaction:
         shard_end = min(rng_e, end)
         if not ssis:
             raise err("wrong_shard_server")
-        self.db._rr += 1
-        ssi = ssis[self.db._rr % len(ssis)]
-        reply = await RequestStream.at(ssi.get_key_values.endpoint).get_reply(
-            GetKeyValuesRequest(begin=cursor, end=shard_end, version=version,
-                                limit=limit))
+        reply = await self.db.read_replica(
+            ssis, lambda s: s.get_key_values,
+            lambda: GetKeyValuesRequest(begin=cursor, end=shard_end,
+                                        version=version, limit=limit))
         if reply.more and reply.data:
             return reply.data, key_after(reply.data[-1][0])
         return reply.data, shard_end
@@ -403,11 +459,11 @@ class Transaction:
         shard_begin = max(rng_b, begin)
         if not ssis:
             raise err("wrong_shard_server")
-        self.db._rr += 1
-        ssi = ssis[self.db._rr % len(ssis)]
-        reply = await RequestStream.at(ssi.get_key_values.endpoint).get_reply(
-            GetKeyValuesRequest(begin=shard_begin, end=cursor,
-                                version=version, limit=limit, reverse=True))
+        reply = await self.db.read_replica(
+            ssis, lambda s: s.get_key_values,
+            lambda: GetKeyValuesRequest(begin=shard_begin, end=cursor,
+                                        version=version, limit=limit,
+                                        reverse=True))
         if reply.more and reply.data:
             return reply.data, reply.data[-1][0]   # inclusive smallest key
         return reply.data, shard_begin

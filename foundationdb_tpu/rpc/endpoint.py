@@ -22,6 +22,13 @@ from ..core.futures import Future, Promise, PromiseStream
 from ..core.scheduler import TaskPriority
 
 
+# Error names that mean "the transport failed", not "the request was
+# processed and rejected" — callers may retry/fail over on these
+# (reference: errors LoadBalance/tryGetReply treat as retriable).
+TRANSPORT_ERRORS = frozenset({"broken_promise", "connection_failed",
+                              "request_maybe_delivered"})
+
+
 class NetworkAddress(NamedTuple):
     """Process address (reference flow/network.h NetworkAddress)."""
 
@@ -151,8 +158,7 @@ class RequestStreamStub:
         try:
             return await self.get_reply(request)
         except FdbError as e:
-            if e.name in ("broken_promise", "connection_failed",
-                          "request_maybe_delivered"):
+            if e.name in TRANSPORT_ERRORS:
                 return None
             raise
 

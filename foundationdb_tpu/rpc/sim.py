@@ -111,7 +111,8 @@ class Simulator:
 
     # -- topology -----------------------------------------------------------
     def new_process(self, machineid: str = "", dcid: str = "dc0",
-                    process_class: str = "unset", name: str = "") -> SimProcess:
+                    process_class: str = "unset", name: str = "",
+                    zoneid: str = "") -> SimProcess:
         ip = f"10.0.{self._next_ip >> 8}.{self._next_ip & 0xff}"
         self._next_ip += 1
         machineid = machineid or f"m{ip}"
@@ -119,7 +120,8 @@ class Simulator:
         if mach is None:
             mach = self.machines[machineid] = Machine(machineid, dcid)
         p = SimProcess(NetworkAddress(ip, 4500),
-                       Locality(dcid=dcid, machineid=machineid),
+                       Locality(dcid=dcid, machineid=machineid,
+                                zoneid=zoneid),
                        process_class, name)
         mach.processes.append(p)
         self.processes[p.address] = p
@@ -177,6 +179,13 @@ class Simulator:
         """Whole-cluster power loss (the restarting-test scenario)."""
         for machineid in list(self.machines):
             self.power_fail_machine(machineid)
+
+    def kill_zone(self, zoneid: str) -> None:
+        """Kill every process in a failure zone (reference killZone,
+        simulator.h KillType on zoneId)."""
+        for p in list(self.processes.values()):
+            if p.locality.zoneid == zoneid:
+                self.kill_process(p)
 
     def kill_datacenter(self, dcid: str) -> None:
         for m in self.machines.values():

@@ -148,7 +148,11 @@ class SimFdbCluster:
 
     def __init__(self, config=None, n_workers: int = 4,
                  n_storage_workers: int = 2, n_coordinators: int = 3,
-                 loop: Optional[EventLoop] = None) -> None:
+                 loop: Optional[EventLoop] = None,
+                 n_zones: int = 0) -> None:
+        """n_zones > 0 places storage workers round-robin into that many
+        failure zones (reference LocalityData zoneId); 0 = every machine
+        its own zone (the default locality)."""
         from .interfaces import DatabaseConfiguration
 
         self.config = config or DatabaseConfiguration()
@@ -160,6 +164,7 @@ class SimFdbCluster:
         self.n_workers = n_workers
         self.n_storage_workers = n_storage_workers
         self.n_coordinators = n_coordinators
+        self.n_zones = n_zones
         self.loop = loop or EventLoop(sim=True)
         set_event_loop(self.loop)
         self.sim = Simulator()
@@ -193,9 +198,11 @@ class SimFdbCluster:
         self.workers = []
         for i in range(self.n_workers):
             pclass = "storage" if i < self.n_storage_workers else "stateless"
+            zone = (f"z{i % self.n_zones}"
+                    if self.n_zones and pclass == "storage" else "")
             p = self.sim.new_process(name=f"worker{i}",
                                      machineid=f"mach.worker{i}",
-                                     process_class=pclass)
+                                     process_class=pclass, zoneid=zone)
             leader_var = AsyncVar(None)
             # Only stateless workers campaign for CC (a storage worker
             # winning would put the control plane on a data node), so only

@@ -272,6 +272,27 @@ class DataDistributor:
             "Worker", idle.id).log()
         return new_tag
 
+    def _ordered_candidates(self, kept: List[Tag], team) -> List[Tag]:
+        """Replacement candidates, ZONE-DIVERSE first (reference
+        ReplicationPolicy PolicyAcross zoneid): greedy selection — each
+        pick's zone counts as occupied for the NEXT pick, so two
+        replacements cannot both land in one fresh zone (a static sort
+        would rank them equally and break the one-zone-loss invariant)."""
+        from .interfaces import zone_of
+
+        def _zone(t):
+            return zone_of(self.storage[t]) if t in self.storage else None
+
+        zones = {_zone(t) for t in kept}
+        pool = set(self.healthy) - set(team) - self.excluded
+        out: List[Tag] = []
+        while pool:
+            pick = min(pool, key=lambda t: (_zone(t) in zones, t))
+            out.append(pick)
+            pool.discard(pick)
+            zones.add(_zone(pick))
+        return out
+
     # -- re-replication (reference teamTracker unhealthy path) ---------------
     async def _handle_storage_failure(self, dead_tag: Tag) -> None:
         self.healthy.discard(dead_tag)
@@ -297,7 +318,7 @@ class DataDistributor:
                 TraceEvent("DDShardUnrecoverable", Severity.Error).detail(
                     "Begin", begin).detail("End", end).log()
                 continue
-            candidates = sorted(self.healthy - set(team) - self.excluded)
+            candidates = self._ordered_candidates(survivors, team)
             new_team = survivors + candidates[:max(
                 0, min(self.replication, len(self.healthy)) -
                 len(survivors))]
@@ -493,8 +514,7 @@ class DataDistributor:
                 if not team or not (set(team) & self.excluded):
                     continue
                 keep = [t for t in team if t not in self.excluded]
-                candidates = sorted(self.healthy - set(team) -
-                                    self.excluded)
+                candidates = self._ordered_candidates(keep, team)
                 new_team = keep + candidates[:max(
                     0, min(self.replication, len(pool)) - len(keep))]
                 if not new_team or set(new_team) == set(team) or \

@@ -29,6 +29,14 @@ Tag = int
 TXS_TAG: Tag = 0xFFFFFFFE
 
 
+def zone_of(iface) -> str:
+    """Failure-zone key of a storage interface: explicit zoneid, else the
+    machine, else the server id (every server its own zone) — reference
+    LocalityData::zoneId defaulting to machineId."""
+    loc = getattr(iface, "locality", None) or ("", "", "")
+    return loc[1] or loc[2] or getattr(iface, "id", "")
+
+
 def same_incarnation(a, b) -> bool:
     """Do two interface handles name the SAME role incarnation?  Judged by
     the wait_failure endpoint — wire deserialization makes object identity
@@ -733,6 +741,11 @@ class StorageServerInterface:
     def __init__(self, ss_id: str = "", tag: Tag = 0) -> None:
         self.id = ss_id
         self.tag = tag
+        # (dcid, zoneid, machineid) of the hosting process, stamped at
+        # recruitment/boot-scan (reference: serverList entries carry
+        # LocalityData, fdbrpc/Locality.h) — drives zone-diverse team
+        # selection in the DD and master cold-boot assignment.
+        self.locality = ("", "", "")
         self.get_value = RequestStream(
             "storage.getValue", TaskPriority.DefaultPromiseEndpoint)
         self.get_key_values = RequestStream(

@@ -648,9 +648,28 @@ async def master_server(master: Master, process, coordinators,
             storage_servers = dict(enumerate(ssis))
             bounds = [b""] + _split_points(config.n_storage) + [b"\xff\xff"]
             key_servers_ranges = []
+            from .interfaces import zone_of
             for i in range(config.n_storage):
-                team = [Tag((i + j) % config.n_storage)
-                        for j in range(config.storage_replication)]
+                # Zone-diverse team (reference ReplicationPolicy
+                # PolicyAcross zoneid): walk forward from tag i, taking
+                # tags whose failure zone is new to the team; fall back to
+                # same-zone only when zones run out.
+                team = [Tag(i)]
+                zones = {zone_of(ssis[i])}
+                for j in range(1, config.n_storage):
+                    if len(team) >= config.storage_replication:
+                        break
+                    cand = Tag((i + j) % config.n_storage)
+                    if zone_of(ssis[cand]) not in zones:
+                        team.append(cand)
+                        zones.add(zone_of(ssis[cand]))
+                j = 1
+                while len(team) < config.storage_replication and \
+                        len(team) < config.n_storage:
+                    cand = Tag((i + j) % config.n_storage)
+                    if cand not in team:
+                        team.append(cand)
+                    j += 1
                 key_servers_ranges.append((bounds[i], bounds[i + 1], team))
 
         # Second wave: ratekeeper + data distributor + proxies.

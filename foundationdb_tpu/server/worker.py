@@ -51,6 +51,14 @@ class Worker:
         from ..core.futures import Promise
         self._scanned: Promise = Promise()
 
+    def _stamp_locality(self, ss) -> None:
+        """Record the hosting process's placement on the interface
+        (reference: serverList entries carry LocalityData) so team
+        selection can diversify across zones."""
+        loc = getattr(self.process, "locality", None)
+        if loc is not None:
+            ss.interface.locality = (loc.dcid, loc.zoneid, loc.machineid)
+
     def _fs(self):
         # Real-mode processes carry their machine filesystem directly
         # (server/real_fs.py); sim processes share their machine's
@@ -90,6 +98,7 @@ class Worker:
                     if ss is None:
                         continue
                     ss.run(self.process)
+                    self._stamp_locality(ss)
                     self.storage_roles.append(ss)
                     self.recovered_storage[ss.tag] = ss.interface
                     self.storage_versions[ss.tag] = ss.version.get()
@@ -295,8 +304,10 @@ class Worker:
         engine.set(_META_KEY, ss._meta_blob(0))
         await engine.commit()
         ss.run(self.process)
+        self._stamp_locality(ss)
         self.storage_roles.append(ss)
         self.recovered_storage[req.tag] = ss.interface
+        self.storage_versions[req.tag] = 0
         self._announce_roles()
         # Keep the serverTag registry on the NEWEST incarnation: a
         # stale rejoin entry from a replaced role must not win the

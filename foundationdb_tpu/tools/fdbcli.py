@@ -1,0 +1,226 @@
+"""fdbcli: the interactive/administrative command surface.
+
+Reference: fdbcli/fdbcli.actor.cpp (+ one file per command, e.g.
+ExcludeCommand.actor.cpp) — get/set/clear/getrange data commands, status,
+configure, exclude/include, consistency check.  Connects like any client
+(client/database.open_cluster) and speaks only public surfaces: ordinary
+transactions, the management API's \xff/conf keys, and the status
+document — no private channel into the cluster.
+
+    python -m foundationdb_tpu.tools.fdbcli -C 127.0.0.1:4700 \
+        [--exec "set k v; get k; status"]
+
+Without --exec, reads commands from stdin (one per line; `help` lists
+them).  Keys/values accept backslash-x hex escapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import sys
+from typing import List, Optional
+
+
+def _unescape(s: str) -> bytes:
+    return s.encode("utf-8").decode("unicode_escape").encode("latin-1")
+
+
+def _printable(b: bytes) -> str:
+    return "".join(chr(c) if 32 <= c < 127 else "\\x%02x" % c for c in b)
+
+
+HELP = """\
+Commands (reference fdbcli command set):
+  get KEY                    read one key
+  set KEY VALUE              write one key
+  clear KEY                  clear one key
+  clearrange BEGIN END       clear a range
+  getrange BEGIN END [N]     read up to N (default 25) pairs
+  status [json]              cluster status summary (or the raw document)
+  configure FIELD=VALUE ...  change configuration transactionally
+  getconfiguration           committed \\xff/conf overrides
+  exclude TAG [TAG...]       drain + exclude storage servers by tag
+  include [TAG...]           re-admit excluded servers (no args: all)
+  excluded                   list excluded tags
+  watch KEY                  block until KEY changes once
+  help                       this text
+  exit / quit
+"""
+
+
+class Cli:
+    def __init__(self, cluster_spec: str) -> None:
+        from ..client.database import open_cluster
+        self.loop, self.db = open_cluster(cluster_spec)
+
+    def run_async(self, coro, timeout: float = 30.0):
+        return self.loop.run_until(self.loop.spawn(coro), timeout=timeout)
+
+    async def _txn(self, fn):
+        t = self.db.create_transaction()
+        from ..core.error import FdbError
+        while True:
+            try:
+                r = await fn(t)
+                await t.commit()
+                return r
+            except FdbError as e:
+                await t.on_error(e)
+
+    # -- commands ------------------------------------------------------------
+    def cmd_get(self, key: str) -> str:
+        async def go(t):
+            return await t.get(_unescape(key))
+        v = self.run_async(self._txn(go))
+        return (f"`{key}' is `{_printable(v)}'" if v is not None
+                else f"`{key}': not found")
+
+    def cmd_set(self, key: str, value: str) -> str:
+        async def go(t):
+            t.set(_unescape(key), _unescape(value))
+        self.run_async(self._txn(go))
+        return "Committed"
+
+    def cmd_clear(self, key: str) -> str:
+        async def go(t):
+            t.clear(_unescape(key))
+        self.run_async(self._txn(go))
+        return "Committed"
+
+    def cmd_clearrange(self, begin: str, end: str) -> str:
+        async def go(t):
+            t.clear(_unescape(begin), _unescape(end))
+        self.run_async(self._txn(go))
+        return "Committed"
+
+    def cmd_getrange(self, begin: str, end: str, limit: str = "25") -> str:
+        async def go(t):
+            return await t.get_range(_unescape(begin), _unescape(end),
+                                     limit=int(limit))
+        rows = self.run_async(self._txn(go))
+        out = [f"`{_printable(k)}' is `{_printable(v)}'" for k, v in rows]
+        out.append(f"({len(rows)} results)")
+        return "\n".join(out)
+
+    def cmd_status(self, mode: str = "") -> str:
+        async def go():
+            return await self.db.cluster.get_status()
+        doc = self.run_async(go())
+        if mode == "json":
+            return json.dumps(doc, indent=2, default=str)
+        cl = doc.get("cluster", {})
+        data = cl.get("data", {})
+        lines = [
+            "Configuration:",
+            f"  Redundancy mode        - {cl.get('configuration', {})}",
+            "Cluster:",
+            f"  Recovery state         - {cl.get('recovery_state', '?')}",
+            f"  Epoch                  - {cl.get('generation', '?')}",
+            f"  Workers                - {cl.get('machines', '?')}",
+            "Data:",
+            f"  State                  - "
+            f"{data.get('state', {}).get('name', '?')}",
+            f"  KV size               - "
+            f"{data.get('total_kv_size_bytes', '?')} bytes",
+            "Database:",
+            f"  Available              - "
+            f"{doc.get('client', {}).get('database_status', {})}",
+        ]
+        return "\n".join(lines)
+
+    def cmd_configure(self, *assignments: str) -> str:
+        from ..client.management import change_configuration
+        fields = {}
+        for a in assignments:
+            if "=" not in a:
+                return f"bad assignment `{a}' (want FIELD=VALUE)"
+            k, v = a.split("=", 1)
+            fields[k] = v
+        self.run_async(change_configuration(self.db, **fields), timeout=60)
+        return "Configuration changed"
+
+    def cmd_getconfiguration(self) -> str:
+        from ..client.management import get_configuration
+        conf = self.run_async(get_configuration(self.db))
+        if not conf:
+            return "(all defaults)"
+        return "\n".join(f"{k} = {v.decode(errors='replace')}"
+                         for k, v in sorted(conf.items()))
+
+    def cmd_exclude(self, *tags: str) -> str:
+        from ..client.management import exclude_servers
+        self.run_async(exclude_servers(self.db, [int(t) for t in tags]))
+        return f"Excluded tags {', '.join(tags)} (draining in background)"
+
+    def cmd_include(self, *tags: str) -> str:
+        from ..client.management import include_servers
+        self.run_async(include_servers(
+            self.db, [int(t) for t in tags] if tags else None))
+        return "Included"
+
+    def cmd_excluded(self) -> str:
+        from ..client.management import excluded_servers
+        tags = self.run_async(excluded_servers(self.db))
+        return f"Excluded tags: {tags or 'none'}"
+
+    def cmd_watch(self, key: str) -> str:
+        async def go():
+            t = self.db.create_transaction()
+            f = await t.watch(_unescape(key))
+            await t.commit()
+            await f
+            return True
+        self.run_async(go(), timeout=3600)
+        return f"`{key}' changed"
+
+    # -- dispatch ------------------------------------------------------------
+    def dispatch(self, line: str) -> Optional[str]:
+        parts = shlex.split(line)
+        if not parts:
+            return None
+        cmd, args = parts[0].lower(), parts[1:]
+        if cmd in ("exit", "quit"):
+            raise SystemExit(0)
+        if cmd == "help":
+            return HELP
+        fn = getattr(self, f"cmd_{cmd}", None)
+        if fn is None:
+            return f"ERROR: unknown command `{cmd}' (try help)"
+        try:
+            return fn(*args)
+        except TypeError as e:
+            return f"ERROR: {e}"
+        except Exception as e:  # noqa: BLE001 — surface, keep the REPL up
+            return f"ERROR: {e!r}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fdbcli")
+    ap.add_argument("-C", "--cluster", required=True,
+                    help="coordinator list host:port,host:port,...")
+    ap.add_argument("--exec", dest="exec_cmds", default=None,
+                    help="semicolon-separated commands, then exit")
+    args = ap.parse_args(argv)
+    cli = Cli(args.cluster)
+    if args.exec_cmds is not None:
+        rc = 0
+        for line in args.exec_cmds.split(";"):
+            out = cli.dispatch(line.strip())
+            if out:
+                print(out)
+            if out and out.startswith("ERROR"):
+                rc = 1
+        return rc
+    print("fdbcli — type `help' for commands")
+    for line in sys.stdin:
+        out = cli.dispatch(line.strip())
+        if out:
+            print(out)
+        sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

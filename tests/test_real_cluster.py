@@ -150,3 +150,29 @@ def test_real_cluster_storage_restart_preserves_data(real_cluster):
         return "ok"
 
     assert loop.run_until(loop.spawn(phase2()), timeout=120) == "ok"
+
+
+def test_fdbcli_against_real_cluster(real_cluster):
+    """The fdbcli ops surface (reference fdbcli/fdbcli.actor.cpp) drives a
+    real multi-process cluster end-to-end: data commands, status,
+    configuration, exclusion bookkeeping."""
+    base, procs, loop, db = real_cluster
+    from foundationdb_tpu.tools.fdbcli import Cli
+
+    cli = Cli.__new__(Cli)
+    cli.loop, cli.db = loop, db    # reuse the fixture's client world
+
+    assert cli.dispatch("set cli-key cli-value") == "Committed"
+    assert "cli-value" in cli.dispatch("get cli-key")
+    assert cli.dispatch("set cli-key2 v2") == "Committed"
+    out = cli.dispatch("getrange cli- cli0 10")
+    assert "cli-key" in out and "cli-key2" in out and "(2 results)" in out
+    assert cli.dispatch("clear cli-key") == "Committed"
+    assert "not found" in cli.dispatch("get cli-key")
+    out = cli.dispatch("status")
+    assert "Recovery state" in out
+    out = cli.dispatch("status json")
+    assert '"cluster"' in out
+    assert "ERROR" not in cli.dispatch("getconfiguration")
+    assert "Excluded tags: none" in cli.dispatch("excluded")
+    assert "unknown command" in cli.dispatch("bogus")
