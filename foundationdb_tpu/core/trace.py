@@ -100,3 +100,53 @@ class TraceEvent:
             self.log()
         except Exception:  # pragma: no cover - interpreter shutdown
             pass
+
+
+class Span:
+    """Request-scoped span (reference flow/Tracing.h:36): a named timed
+    region with a parent link, emitted as a "Span" trace event on finish
+    (the LogfileTracer sink, Tracing.actor.cpp:47).  Contexts travel
+    inside requests as strings; a role handling a request opens a child
+    span with parent=request.span_context.  Construction with an empty
+    parent on an UNSAMPLED path is free-ish: pass sampled=False and
+    nothing is emitted (reference: unsampled spans skip the tracer)."""
+
+    __slots__ = ("context", "parent", "name", "_t0", "sampled")
+
+    def __init__(self, name: str, parent: str = "",
+                 sampled: bool = True) -> None:
+        self.name = name
+        self.parent = parent
+        self.sampled = sampled
+        from .rng import deterministic_random
+        self.context = (deterministic_random().random_unique_id()[:16]
+                        if self.sampled else "")
+        from .scheduler import current_event_loop_or_none
+        lp = current_event_loop_or_none()
+        self._t0 = lp.now() if lp is not None else 0.0
+
+    def finish(self) -> None:
+        if not self.sampled:
+            return
+        from .scheduler import current_event_loop_or_none
+        lp = current_event_loop_or_none()
+        t1 = lp.now() if lp is not None else 0.0
+        TraceEvent("Span").detail("Name", self.name).detail(
+            "SpanID", self.context).detail("ParentID", self.parent).detail(
+            "Duration", round(t1 - self._t0, 6)).log()
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+
+def trace_batch_event(event_type: str, debug_id: str, location: str) -> None:
+    """Transaction debug correlation (reference g_traceBatch.addEvent:
+    "TransactionDebug"/"CommitDebug" point events at every hop, keyed by
+    the transaction's debug id; post-processed into a cross-process
+    timeline by contrib/commit_debug.py).  No-op without a debug id."""
+    if debug_id:
+        TraceEvent(event_type).detail("DebugID", debug_id).detail(
+            "Location", location).log()
