@@ -56,9 +56,19 @@ CAPACITY = 1 << 21
 DELTA_CAPACITY = 1 << 20
 
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
-PROBE_ATTEMPTS = 3
+# Long-horizon probe schedule: the axon tunnel has documented multi-minute
+# outages that can end — surrendering after ~7 minutes wasted a whole round
+# (round 4).  Keep re-probing every PROBE_INTERVAL_S until PROBE_TOTAL_S
+# elapses before falling back to XLA-CPU.
+PROBE_INTERVAL_S = int(os.environ.get("BENCH_PROBE_INTERVAL", "300"))
+PROBE_TOTAL_S = int(os.environ.get("BENCH_PROBE_TOTAL", "2700"))
 CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2700"))
 CPU_CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CPU_CHILD_TIMEOUT", "2400"))
+# Last-known-good real-TPU figure, persisted next to this file on every
+# successful TPU run and re-emitted with stale:true on fallback, so an
+# outage round still reports the project's actual measured capability.
+LKG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_LKG.json")
 
 
 def gen_batch(rng: np.random.Generator, version: int, prev: int,
@@ -343,8 +353,14 @@ _PROBE_SRC = ("import jax, numpy as np; "
 def _probe_tpu() -> bool:
     """Trivial jit on the default (axon/TPU) backend with a hard timeout.
     The tunnel HANGS rather than erroring when down, so an in-process
-    probe could wedge the whole benchmark."""
-    for attempt in range(PROBE_ATTEMPTS):
+    probe could wedge the whole benchmark.  Probes repeat on a long
+    horizon (see PROBE_TOTAL_S): tunnel outages are often transient and a
+    round's headline number is worth waiting most of an hour for."""
+    deadline = time.monotonic() + PROBE_TOTAL_S
+    attempt = 0
+    while True:
+        attempt += 1
+        started = time.monotonic()
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
@@ -353,17 +369,51 @@ def _probe_tpu() -> bool:
             if r.returncode == 0 and "probe-ok" in r.stdout:
                 return True
             tail = (r.stderr or "").strip().splitlines()[-1:] or ["?"]
-            print(f"# tpu probe attempt {attempt + 1} failed: {tail[0]}",
+            print(f"# tpu probe attempt {attempt} failed: {tail[0]}",
                   file=sys.stderr)
         except subprocess.TimeoutExpired:
-            print(f"# tpu probe attempt {attempt + 1} timed out "
+            print(f"# tpu probe attempt {attempt} timed out "
                   f"({PROBE_TIMEOUT_S}s)", file=sys.stderr)
-        time.sleep(10 * (attempt + 1))
-    return False
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        wait = min(max(PROBE_INTERVAL_S - (time.monotonic() - started), 5),
+                   remaining)
+        print(f"# re-probing in {int(wait)}s "
+              f"({int(remaining)}s left in probe window)", file=sys.stderr)
+        time.sleep(wait)
+
+
+def _save_lkg(parsed: dict) -> None:
+    try:
+        rec = dict(parsed)
+        rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
+        with open(LKG_PATH, "w") as f:
+            json.dump(rec, f)
+    except OSError:
+        pass
+
+
+def _attach_lkg(parsed: dict) -> dict:
+    """On a fallback result, carry the last-known-good REAL TPU figure
+    (stale: true) so the emitted line never reads as a 400x regression
+    when the tunnel — not the backend — was the failure."""
+    try:
+        with open(LKG_PATH) as f:
+            lkg = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return parsed
+    lkg["stale"] = True
+    parsed["last_known_good_tpu"] = lkg
+    return parsed
 
 
 def _run_child(backend: str, platform_env: str, timeout_s: int):
     """Run the measurement child; returns (parsed_json | None, note)."""
+    fake = os.environ.get("BENCH_FAKE_CHILD")
+    if fake:  # test hook: stand in for the (minutes-long) real child
+        return json.loads(fake), ""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     # Clear any inherited value first: a leftover JAX_PLATFORMS=cpu from a
@@ -398,18 +448,21 @@ def _run_child(backend: str, platform_env: str, timeout_s: int):
 def parent_main(backend: str) -> None:
     errors = []
     if backend == "tpu":
-        if _probe_tpu():
+        forced = os.environ.get("BENCH_FORCE_FALLBACK") == "1"
+        if not forced and _probe_tpu():
             for attempt in range(2):
                 parsed, note = _run_child("tpu", "", CHILD_TIMEOUT_S)
                 if parsed is not None:
+                    _save_lkg(parsed)
                     print(json.dumps(parsed))
                     return
                 errors.append(f"tpu run {attempt + 1}: {note}")
                 print(f"# {errors[-1]}", file=sys.stderr)
         else:
             errors.append(
-                f"axon/TPU backend unreachable after {PROBE_ATTEMPTS} "
-                f"probes x {PROBE_TIMEOUT_S}s")
+                "forced fallback (BENCH_FORCE_FALLBACK=1)" if forced else
+                f"axon/TPU backend unreachable after {PROBE_TOTAL_S}s of "
+                f"probing every {PROBE_INTERVAL_S}s")
         # Degraded mode: same kernels, same parity assertions, XLA CPU,
         # smaller stream (a full-size run exceeds any sane timeout there).
         print("# falling back to JAX CPU backend", file=sys.stderr)
@@ -418,13 +471,13 @@ def parent_main(backend: str) -> None:
         if parsed is not None:
             parsed["error"] = ("TPU unavailable; measured on XLA-CPU "
                                "fallback — " + "; ".join(errors))
-            print(json.dumps(parsed))
+            print(json.dumps(_attach_lkg(parsed)))
             return
         errors.append(f"cpu fallback: {note}")
-        print(json.dumps({
+        print(json.dumps(_attach_lkg({
             "metric": "conflict_range_checks_per_s", "value": 0.0,
             "unit": "ranges/s", "vs_baseline": 0.0,
-            "error": "; ".join(errors)}))
+            "error": "; ".join(errors)})))
         return
     # backend == "cpu": oracle-only mode, no TPU involved.
     parsed, note = _run_child("cpu", "cpu", CPU_CHILD_TIMEOUT_S)

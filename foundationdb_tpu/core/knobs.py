@@ -95,6 +95,10 @@ class ServerKnobs(KnobBase):
 
         # TLog
         self.TLOG_SPILL_THRESHOLD = 1500e6
+        # Byte budget per TLogPeekReply (reference DESIRED_TOTAL_BYTES in
+        # tLogPeekMessages): a lagging puller's catch-up peek pages through
+        # the spilled backlog instead of materializing all of it at once.
+        self.TLOG_PEEK_DESIRED_BYTES = 1e6
         self.UPDATE_STORAGE_BYTE_LIMIT = 1e6
         self.MAX_COMMIT_UPDATES = 2000
 

@@ -127,6 +127,24 @@ def _add_one_ulp(d: np.ndarray) -> np.ndarray:
     return d
 
 
+def planar_to_s24(planar: np.ndarray) -> np.ndarray:
+    """Host: planar uint32[6, N] -> numpy S24[N] whose ordering equals
+    digest lexicographic order (the big-endian byte concatenation).
+
+    Feeds np.sort / np.unique / np.searchsorted so batch key-grouping can
+    run on the HOST — the basis of the sort-free device point path
+    (conflict/fused.py): a multi-operand device lax.sort costs minutes of
+    XLA compile time per shape over the TPU tunnel and dominated the
+    per-batch step.  numpy's S-dtype trailing-NUL padding conflates only
+    digests differing solely in trailing zero bytes; every non-empty key's
+    digest ends with a nonzero length marker and the empty key's digest is
+    all zeros, so no two DISTINCT digests are conflated."""
+    n = planar.shape[1]
+    rows = (np.ascontiguousarray(planar.T).astype(">u4")
+            .view(np.uint8).reshape(n, DIGEST_BYTES))
+    return np.ascontiguousarray(rows).view("S%d" % DIGEST_BYTES).ravel()
+
+
 # ---------------------------------------------------------------------------
 # Device-side lexicographic comparison and binary search (planar layout)
 # ---------------------------------------------------------------------------

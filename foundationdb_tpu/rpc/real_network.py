@@ -55,7 +55,8 @@ class _Conn:
     """One peer connection (either direction) on the reactor."""
 
     def __init__(self, net: "RealNetwork", sock: socket.socket,
-                 peer_key: Optional[Tuple[str, int]], outbound: bool) -> None:
+                 peer_key: Optional[Tuple[str, int]], outbound: bool,
+                 connecting: bool = False) -> None:
         self.net = net
         self.sock = sock
         self.peer_key = peer_key       # canonical dial address (outbound)
@@ -65,6 +66,9 @@ class _Conn:
         self._out = bytearray()
         self._hs_done = False
         self._writer_on = False
+        # Non-blocking dial in progress: frames buffer into _out; the
+        # writer callback fires on connect completion (or SO_ERROR).
+        self._connecting = connecting
         sock.setblocking(False)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -72,8 +76,43 @@ class _Conn:
             pass
         if outbound:
             self._out += _HS.pack(MAGIC, PROTOCOL_VERSION)
-            self._flush()
+            if not connecting:
+                self._flush()
+        if connecting:
+            self._writer_on = True
+            self.net.loop.add_writer(self.sock, self._on_connect_complete)
+            # Blackholed SYNs (host down without RST) never become writable;
+            # give up after a bounded dial window instead of holding the
+            # conn (and its buffered requests) forever.
+            self.net.loop.call_at(self.net.loop.now() + 5.0,
+                                  self._on_connect_deadline)
         self.net.loop.add_reader(self.sock, self._on_readable)
+
+    # -- non-blocking connect completion --------------------------------------
+    def _on_connect_complete(self) -> None:
+        if self.closed or not self._connecting:
+            return
+        soerr = self.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+        self._writer_on = False
+        self.net.loop.remove_writer(self.sock)
+        self._connecting = False
+        if soerr != 0:
+            TraceEvent("ConnectFailed", Severity.Warn).detail(
+                "Peer", f"{self.peer_key}").detail(
+                "Error", errno.errorcode.get(soerr, str(soerr))).log()
+            self.net._note_dial_failure(self.peer_key)
+            self.close()
+            return
+        self.net._dial_backoff.pop(self.peer_key, None)
+        self._flush()
+
+    def _on_connect_deadline(self) -> None:
+        if self.closed or not self._connecting:
+            return
+        TraceEvent("ConnectTimedOut", Severity.Warn).detail(
+            "Peer", f"{self.peer_key}").log()
+        self.net._note_dial_failure(self.peer_key)
+        self.close()
 
     # -- sending -------------------------------------------------------------
     def send_frame(self, kind: int, body: bytes) -> None:
@@ -85,6 +124,8 @@ class _Conn:
     def _flush(self) -> None:
         if self.closed:
             return
+        if self._connecting:
+            return                     # buffer until the dial completes
         try:
             while self._out:
                 n = self.sock.send(self._out)
@@ -163,6 +204,13 @@ class _Conn:
         if self.closed:
             return
         self.closed = True
+        # A refused dial can surface through the READER callback first
+        # (RST marks the fd readable; recv raises before the writer
+        # callback ever runs) — record the failure here so the negative-TTL
+        # dial cache engages on the common ECONNREFUSED path too.
+        if self._connecting:
+            self.net._note_dial_failure(self.peer_key)
+            self._connecting = False
         self.net.loop.remove_reader(self.sock)
         if self._writer_on:
             self.net.loop.remove_writer(self.sock)
@@ -184,6 +232,8 @@ class RealNetwork:
         self._all_conns: List[_Conn] = []
         # reply_id -> (Promise, conn)
         self._pending: Dict[int, Tuple[Promise, _Conn]] = {}
+        # peer key -> monotonic time before which we won't re-dial
+        self._dial_backoff: Dict[Tuple[str, int], float] = {}
         self._next_reply_id = 1
         self.messages_sent = 0
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -240,24 +290,35 @@ class RealNetwork:
         conn = self._conns.get(key)
         if conn is not None and not conn.closed:
             return conn
-        # Lazy dial.  A short blocking connect: peers are LAN/localhost (the
-        # reference also dials synchronously from the network thread's
-        # perspective — Net2 connect is sub-millisecond in-DC; a dead peer
-        # returns ECONNREFUSED immediately rather than hanging).
+        # Negative-TTL dial cache: a just-failed peer isn't re-dialed on
+        # every send (each failed dial costs a round of reactor callbacks;
+        # without the cache a hot retry loop would churn sockets).
+        until = self._dial_backoff.get(key)
+        if until is not None and self.loop.now() < until:
+            return None
+        # Lazy NON-BLOCKING dial (connect_ex + writer-completion callback):
+        # a peer that blackholes SYNs must not stall the reactor thread —
+        # frames buffer on the conn until the handshake flushes, and every
+        # pending reply breaks if the dial fails (reference Net2 connects
+        # asynchronously on the network thread too).
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.settimeout(2.0)
-        try:
-            sock.connect(key)
-        except OSError as e:
+        sock.setblocking(False)
+        rc = sock.connect_ex(key)
+        if rc not in (0, errno.EINPROGRESS, errno.EWOULDBLOCK):
             sock.close()
             TraceEvent("ConnectFailed", Severity.Warn).detail(
-                "Peer", f"{addr}").detail("Error", errno.errorcode.get(
-                    e.errno, repr(e)) if e.errno else repr(e)).log()
+                "Peer", f"{addr}").detail(
+                "Error", errno.errorcode.get(rc, str(rc))).log()
+            self._note_dial_failure(key)
             return None
-        conn = _Conn(self, sock, key, outbound=True)
+        conn = _Conn(self, sock, key, outbound=True, connecting=(rc != 0))
         self._conns[key] = conn
         self._all_conns.append(conn)
         return conn
+
+    def _note_dial_failure(self, key) -> None:
+        if key is not None:
+            self._dial_backoff[key] = self.loop.now() + 1.0
 
     def _on_conn_closed(self, conn: _Conn) -> None:
         if conn.peer_key is not None and \

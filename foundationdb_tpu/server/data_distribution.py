@@ -251,11 +251,7 @@ class DataDistributor:
             break
         if idle is None:
             return None
-        self._max_tag_seen = max(self._max_tag_seen,
-                                 max(list(self.storage) + [-1]),
-                                 max(self.excluded, default=-1))
-        new_tag = self._max_tag_seen + 1
-        self._max_tag_seen = new_tag
+        new_tag = await self._alloc_tag()
         try:
             ssi = await RequestStream.at(
                 idle.init_storage.endpoint).get_reply(
@@ -271,6 +267,31 @@ class DataDistributor:
         TraceEvent("DDStorageRecruited").detail("Tag", new_tag).detail(
             "Worker", idle.id).log()
         return new_tag
+
+    async def _alloc_tag(self) -> Tag:
+        """Allocate a never-before-issued tag.  The floor is COMMITTED data
+        (\xff/maxServerTag, bumped in the same transaction that claims the
+        tag), so reissue is impossible across recoveries even after a tag's
+        serverTag/excluded entries are retired — the in-memory recompute
+        alone could repeat a retired number and inherit stale per-tag state
+        (e.g. a late exclusion write racing retirement)."""
+        from .system_data import MAX_TAG_KEY
+        floor = max(self._max_tag_seen,
+                    max(list(self.storage) + [-1]),
+                    max(self.excluded, default=-1))
+        t = self.db.create_transaction()
+        t.access_system_keys = True
+        while True:
+            try:
+                raw = await t.get(MAX_TAG_KEY)
+                committed = int(raw) if raw else -1
+                new_tag = max(floor, committed) + 1
+                t.set(MAX_TAG_KEY, b"%d" % new_tag)
+                await t.commit()
+                self._max_tag_seen = max(self._max_tag_seen, new_tag)
+                return new_tag
+            except FdbError as e:
+                await t.on_error(e)
 
     def _ordered_candidates(self, kept: List[Tag], team) -> List[Tag]:
         """Replacement candidates, ZONE-DIVERSE first (reference

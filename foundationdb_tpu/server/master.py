@@ -554,8 +554,14 @@ async def master_server(master: Master, process, coordinators,
         # committed \xff/conf/ state (snapshot + replay above) — role
         # counts below come from the DATABASE, so a configuration change
         # is a transaction that survives anything the database survives.
+        seeded_fields: set = set()
         if prev is not None and prev.conf:
             config = config.with_conf(prev.conf)
+            # Fields this epoch's config took from COMMITTED conf: if one
+            # is later cleared (and the clear's nudge lost), the poll below
+            # must compare it against the static default.
+            seeded_fields = {k for k, v in prev.conf.items()
+                             if v is not None and k != "*"}
             TraceEvent("MasterConfigFromDatabase").detail(
                 "Conf", {k: v.decode(errors="replace")
                          for k, v in prev.conf.items()}).log()
@@ -775,15 +781,61 @@ async def master_server(master: Master, process, coordinators,
                 # identical to the recruited configuration: ignore
                 # (idempotent configure retry)
 
+        async def _config_poll() -> None:
+            """Self-heal for a LOST config_changed nudge: the proxy's
+            epoch-end trigger is a one-way send (commit_proxy.py), so a
+            dropped connection — or a master re-recruited after the \xff/conf
+            mutation committed — would otherwise leave a committed
+            configuration change dormant until the next unrelated recovery.
+            Poll the committed conf and end the epoch on a genuine
+            difference.  Keys PRESENT in the committed conf compare against
+            the recruited value; keys this epoch recruited FROM committed
+            conf (seeded_fields) that have since been cleared compare
+            against the static default.  Other absent keys are ignored: a
+            programmatic (never-committed) non-default value must not
+            bounce the epoch forever."""
+            from ..client.database import ClusterConnection, Database
+            from ..client.management import get_configuration
+            from .interfaces import DatabaseConfiguration
+            defaults = DatabaseConfiguration()
+            known = set(DatabaseConfiguration._INT_FIELDS) | \
+                set(DatabaseConfiguration._STR_FIELDS)
+            db = Database(ClusterConnection(coordinators))
+            try:
+                while True:
+                    await _delay(5.0)
+                    try:
+                        committed = await get_configuration(db)
+                    except Exception:  # noqa: BLE001 — pipeline mid-recovery
+                        continue
+                    for fname in known & (set(committed) | seeded_fields):
+                        raw = committed.get(fname)
+                        cur = getattr(config, fname, None)
+                        want = (getattr(defaults, fname, None) if raw is None
+                                else raw.decode())
+                        if str(cur) != str(want):
+                            TraceEvent("MasterConfigPollDiff").detail(
+                                "Field", fname).detail(
+                                "Recruited", str(cur)).detail(
+                                "Committed", str(want)).log()
+                            return
+            finally:
+                close = getattr(db.cluster, "close", None)
+                if close is not None:
+                    close()
+
+        from ..core.scheduler import delay as _delay
         role_failures = [
             spawn(wait_failure_of(x), "master.roleWatch")
             for x in (tlogs + resolvers + commit_proxies + grv_proxies +
                       [ratekeeper])]
         config_watch = spawn(_config_change_watch(), "master.confWatch")
+        config_poll = spawn(_config_poll(), "master.confPoll")
         children.extend(role_failures)
         children.append(config_watch)
-        idx, _ = await _wait_any(role_failures + [config_watch])
-        reason = ("configuration changed" if idx == len(role_failures)
+        children.append(config_poll)
+        idx, _ = await _wait_any(role_failures + [config_watch, config_poll])
+        reason = ("configuration changed" if idx >= len(role_failures)
                   else "recruited role failed")
         TraceEvent("MasterTerminated", Severity.Warn).detail(
             "Epoch", master.epoch).detail(

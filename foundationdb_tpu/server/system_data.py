@@ -41,6 +41,12 @@ SERVER_TAG_END = b"\xff/serverTag0"
 EXCLUDED_PREFIX = b"\xff/conf/excluded/"
 EXCLUDED_END = b"\xff/conf/excluded0"
 BACKUP_STARTED_KEY = b"\xff/backupStarted"
+# Monotonic allocator floor for storage tags: committed data, so a tag can
+# never be reissued across recoveries even after its serverTag/excluded
+# entries are retired (the reference's serverTag allocation scans committed
+# state for the same reason; in-memory recomputation alone could repeat a
+# retired number and inherit stale per-tag state).
+MAX_TAG_KEY = b"\xff/maxServerTag"
 
 # All user mutations additionally ride this tag while a backup is active
 # (reference: backup workers pull dedicated backup tags from the log

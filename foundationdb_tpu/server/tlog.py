@@ -344,12 +344,28 @@ class TLog:
         max_known = self.version.get()
         out: List[Tuple[Version, List[Mutation]]] = []
         seen = set()
+        # Byte budget per reply (reference DESIRED_TOTAL_BYTES paging in
+        # tLogPeekMessages): without it a catch-up peek of a multi-GB
+        # spilled backlog would pull everything back into the heap and one
+        # RPC reply, defeating spill-by-reference.  At least one entry is
+        # always sent so the puller makes progress; `cut` is the first
+        # version NOT included, and a truncated reply lowers end AND
+        # max_known_version to it so the puller re-peeks for the rest
+        # instead of skipping ahead (storage _pull_loop advances to
+        # max_known_version).
+        budget = int(server_knobs().TLOG_PEEK_DESIRED_BYTES)
+        sent_bytes = 0
+        cut: Optional[Version] = None
         # Spilled prefix: read the referenced commit records back from the
         # queue file (reference tLogPeekMessages :1584 serving spilled
-        # tags via IDiskQueue reads).
+        # tags via IDiskQueue reads).  Spilled versions precede resident
+        # ones, so a budget cut here is a version-prefix cut.
         for v, seq in sq_snap:
             if v < req.begin:
                 continue
+            if sent_bytes >= budget:
+                cut = v
+                break
             blob = await self.disk_queue.read_payload(seq)
             if blob is None:
                 continue     # popped concurrently with this peek
@@ -358,13 +374,25 @@ class TLog:
             if msgs:
                 out.append((v, msgs))
                 seen.add(v)
-        for v, msgs in resident_snap:
-            if v not in seen:
+                sent_bytes += sum(m.expected_size() for m in msgs)
+        if cut is None:
+            for v, msgs in resident_snap:
+                if v in seen:
+                    continue
+                if sent_bytes >= budget:
+                    cut = v
+                    break
                 out.append((v, msgs))
+                sent_bytes += sum(m.expected_size() for m in msgs)
         out.sort(key=lambda e: e[0])
-        req.reply.send(TLogPeekReply(
-            messages=out, end=max_known + 1,
-            max_known_version=max_known))
+        if cut is not None:
+            req.reply.send(TLogPeekReply(
+                messages=[e for e in out if e[0] < cut],
+                end=cut, max_known_version=cut - 1))
+        else:
+            req.reply.send(TLogPeekReply(
+                messages=out, end=max_known + 1,
+                max_known_version=max_known))
 
     def _pop(self, req: TLogPopRequest) -> None:
         prev = self.poppedtags.get(req.tag, 0)

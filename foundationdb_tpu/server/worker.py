@@ -281,10 +281,19 @@ class Worker:
             self.storage_roles.remove(old)
         # A still-retrying rejoin commit carries the REPLACED
         # interface; cancel it so it cannot land after (and clobber)
-        # this recruitment's registry write.
+        # this recruitment's registry write — but the rejoin covers ALL
+        # of this worker's disk-recovered tags, so respawn it for the
+        # remaining ones (their roles are still live) or they'd never
+        # re-enter the serverTag registry.
         rejoin_f = getattr(self, "_rejoin_f", None)
         if rejoin_f is not None and not rejoin_f.is_ready():
             rejoin_f.cancel()
+            remaining = {t: i for t, i in self.recovered_storage.items()
+                         if t != req.tag}
+            if remaining:
+                self._rejoin_f = self.process.spawn(
+                    self._commit_server_tags(remaining),
+                    f"{self.process.name}.ssRejoin")
         info = self.db_info.get()
         ls = LogSystemClient(info.tlogs,
                              replication=self._log_replication()) \

@@ -1,0 +1,79 @@
+"""bench.py survivability: a tunnel-outage round must still emit the
+last-known-good real-TPU figure (stale-marked) alongside the fallback
+number (VERDICT round-4 item 3).
+
+The full fallback path (XLA-CPU child) takes minutes, so the integration
+test exercises parent_main with BENCH_FORCE_FALLBACK=1 and a stubbed child
+via BENCH_FAKE_CHILD; the LKG persistence helpers are unit-tested directly.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+_BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench_under_test", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_attach_lkg_roundtrip(tmp_path):
+    bench = _load_bench()
+    bench.LKG_PATH = str(tmp_path / "lkg.json")
+    measured = {"metric": "conflict_range_checks_per_s", "value": 123456.0,
+                "unit": "ranges/s", "vs_baseline": 0.123}
+    bench._save_lkg(measured)
+    fallback = {"metric": "conflict_range_checks_per_s", "value": 500.0,
+                "unit": "ranges/s", "vs_baseline": 0.0005,
+                "error": "TPU unavailable"}
+    out = bench._attach_lkg(fallback)
+    lkg = out["last_known_good_tpu"]
+    assert lkg["value"] == 123456.0
+    assert lkg["stale"] is True
+    assert "measured_at" in lkg
+    # The fallback figure itself is untouched.
+    assert out["value"] == 500.0
+
+
+def test_attach_lkg_missing_file(tmp_path):
+    bench = _load_bench()
+    bench.LKG_PATH = str(tmp_path / "absent.json")
+    fallback = {"value": 1.0}
+    assert "last_known_good_tpu" not in bench._attach_lkg(fallback)
+
+
+def test_repo_lkg_checked_in():
+    """The repo carries the best real-TPU figure so a fresh checkout's
+    outage round still reports measured capability."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "BENCH_LKG.json")
+    with open(path) as f:
+        lkg = json.load(f)
+    assert lkg["value"] > 0
+    assert lkg["unit"] == "ranges/s"
+
+
+def test_forced_fallback_emits_both_numbers():
+    """End-to-end parent path with a faked child: the emitted JSON line
+    carries the degraded value, the error marker, AND the stale LKG."""
+    env = dict(os.environ)
+    env["BENCH_FORCE_FALLBACK"] = "1"
+    env["BENCH_FAKE_CHILD"] = json.dumps(
+        {"metric": "conflict_range_checks_per_s", "value": 525.0,
+         "unit": "ranges/s", "vs_baseline": 0.0005})
+    r = subprocess.run([sys.executable, _BENCH], capture_output=True,
+                       text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    line = [ln for ln in r.stdout.strip().splitlines()
+            if ln.startswith("{")][-1]
+    out = json.loads(line)
+    assert out["value"] == 525.0
+    assert "error" in out
+    assert out["last_known_good_tpu"]["stale"] is True
+    assert out["last_known_good_tpu"]["value"] > 0

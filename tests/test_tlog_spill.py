@@ -86,6 +86,60 @@ def test_stalled_tag_spills_and_catches_up(teardown):  # noqa: F811
         knobs.TLOG_SPILL_THRESHOLD = old
 
 
+def test_peek_paginates_by_bytes(teardown):  # noqa: F811
+    """A catch-up peek of a large spilled backlog is paged by
+    TLOG_PEEK_DESIRED_BYTES (reference tLogPeekMessages DESIRED_TOTAL_BYTES):
+    each reply stays under the budget (plus one entry), end/max_known_version
+    point at the cut so the puller re-peeks for the rest, and following
+    reply.end reconstructs the full stream with no gaps or duplicates."""
+    knobs = server_knobs()
+    old_spill = knobs.TLOG_SPILL_THRESHOLD
+    old_peek = knobs.TLOG_PEEK_DESIRED_BYTES
+    knobs.TLOG_SPILL_THRESHOLD = 50_000
+    knobs.TLOG_PEEK_DESIRED_BYTES = 20_000
+    try:
+        lp = _world()
+        fs = SimFileSystem()
+        tlog = TLog("page-test", disk_queue=DiskQueue(fs.open("t.wal")))
+
+        async def go():
+            payload = b"x" * 1000
+            v = 0
+            for i in range(300):
+                prev, v = v, v + 1
+                await _commit(tlog, v, prev, {
+                    0: [Mutation(MutationType.SetValue,
+                                 b"k%04d" % i, payload)]})
+            assert tlog.bytes_spilled > 0
+            got = []
+            begin = 1
+            rounds = 0
+            while True:
+                p = Promise()
+                await tlog._peek(TLogPeekRequest(tag=0, begin=begin, reply=p))
+                reply = await p.get_future()
+                nbytes = sum(m.expected_size()
+                             for _v, msgs in reply.messages for m in msgs)
+                # Budget + at most one overshooting entry.
+                assert nbytes <= 20_000 + 2000, nbytes
+                got.extend(v for v, _m in reply.messages)
+                if reply.end > 300:
+                    break
+                # Truncated replies must not let the puller skip ahead.
+                assert reply.max_known_version == reply.end - 1
+                begin = reply.end
+                rounds += 1
+                assert rounds < 100
+            assert got == list(range(1, 301)), (len(got), got[:5], got[-5:])
+            assert rounds >= 5, f"never paginated (rounds={rounds})"
+            return True
+
+        assert lp.run_until(lp.spawn(go()), timeout=120)
+    finally:
+        knobs.TLOG_SPILL_THRESHOLD = old_spill
+        knobs.TLOG_PEEK_DESIRED_BYTES = old_peek
+
+
 def test_spill_survives_reboot(teardown):  # noqa: F811
     """Spilled data lives in the DiskQueue, so a rebooted TLog recovers it
     like any other record (from_disk replays the whole surviving queue)."""
