@@ -54,9 +54,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.digest import (KEY_LANES, MAX_DIGEST, ROW_PAD, gather_cols,
-                          lex_eq, planar_to_rows, rank_count,
+                          lex_eq, lex_less, planar_to_rows, rank_count,
                           rows_to_planar, searchsorted_left,
                           searchsorted_right)
+from ..ops.digest import lex_max_cols as _lex_max_cols
+from ..ops.digest import lex_min_cols as _lex_min_cols
 from ..ops.rangemax import NEG_INF, build_sparse_table, range_max
 from ..ops.segtree import (build_min_table, interval_min_cover, range_min)
 from ..txn.types import CommitResult
@@ -84,7 +86,12 @@ def _next_pow2(n: int) -> int:
     return 1 << max(int(n - 1).bit_length(), 1)
 
 
-def meta_size(t_cap: int, r_cap: int, w_cap: int) -> int:
+def meta_size(t_cap: int, r_cap: int, w_cap: int,
+              all_point: bool = False) -> int:
+    # Point layout carries two extra host-computed index columns (r_wid,
+    # w_uidx) in exchange for eliminating every device sort.
+    if all_point:
+        return 3 * r_cap + 3 * w_cap + 3 * t_cap + N_SCALARS
     return 2 * r_cap + 2 * w_cap + 3 * t_cap + N_SCALARS
 
 
@@ -93,16 +100,129 @@ def make_delta_state(d_cap: int) -> WindowState:
     return make_window_state(d_cap, int(NEG_INF))
 
 
+def _point_insert(dk, dv, dsize, u_k, u_e, w_uidx, w_ins, now_rel,
+                  d_cap: int, w_cap: int, u_own=None):
+    """Sort-free window_insert for point batches (traced inline).
+
+    Semantics identical to window.window_insert on the same surviving
+    write set, exploiting what the host guarantees for the point path
+    (tpu_backend._group_points): u_k/u_e are UNIQUE write keys already
+    sorted ascending with MAX padding, disjoint as ranges, and no end
+    reaches the next begin.  So the union sweep (an 8-operand lax.sort)
+    reduces to a scatter-max of the survivor mask over unique-key slots,
+    and the sorted new-boundary sequence (a 7-operand lax.sort) is just
+    the host-interleaved [b0, e0, b1, e1, ...] compacted by a rank
+    scatter — the device runs only binary searches, cumsums and row
+    scatters, all linear-time and cheap to compile."""
+    max_col = jnp.asarray(MAX_DIGEST)[:, None]
+    m_valid = jnp.zeros((w_cap,), bool).at[
+        jnp.clip(w_uidx, 0, w_cap - 1)].max(w_ins)
+    if u_own is not None:
+        # Sharded mode: this shard inserts only the unique keys it owns.
+        m_valid = m_valid & u_own
+    mb = jnp.where(m_valid[None, :], u_k, max_col)
+    me = jnp.where(m_valid[None, :], u_e, max_col)
+
+    # Old-boundary bookkeeping on the current delta (mirrors
+    # window_insert): version continuing after each end, ends already
+    # present, and boundaries covered by an inserted range (for points:
+    # exactly a boundary equal to the begin key).
+    idx_cap = jnp.arange(d_cap, dtype=jnp.int32)
+    live = idx_cap < dsize
+    slot = searchsorted_right(dk, me) - 1
+    cont_v = dv[jnp.clip(slot, 0, d_cap - 1)]
+    p = searchsorted_left(dk, me)
+    present_end = lex_eq(gather_cols(dk, jnp.minimum(p, d_cap - 1)), me) & (
+        p < dsize)
+    cnt_b = rank_count(searchsorted_left(dk, mb), d_cap)
+    cnt_e = rank_count(p, d_cap)
+    inside = cnt_b > cnt_e
+    keep = live & ~inside
+    kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    kept_count = jnp.sum(keep.astype(jnp.int32))
+    scatter_idx = jnp.where(keep, kept_rank, d_cap)
+    max_rows_cap = jnp.full((d_cap, ROW_PAD), 0xFFFFFFFF, dtype=jnp.uint32)
+    old_rows = max_rows_cap.at[scatter_idx].set(planar_to_rows(dk),
+                                                mode="drop")
+    old_k = rows_to_planar(old_rows)
+    old_v = jnp.full((d_cap,), NEG_INF, dtype=jnp.int32).at[
+        scatter_idx].set(dv, mode="drop")
+
+    # New boundaries: begins at now, ends at cont_v (suppressed when
+    # already present).  The interleave [b0, e0, b1, e1, ...] is sorted
+    # ascending over its VALID entries by the host guarantees above, so
+    # compaction (order-preserving rank scatter) yields the sorted
+    # new-entry sequence the merge positions require.
+    end_valid = m_valid & ~present_end
+    il_rows = jnp.stack([planar_to_rows(u_k), planar_to_rows(u_e)],
+                        axis=1).reshape(2 * w_cap, ROW_PAD)
+    il_valid = jnp.stack([m_valid, end_valid], axis=1).reshape(2 * w_cap)
+    il_v = jnp.stack(
+        [jnp.where(m_valid, now_rel, NEG_INF).astype(jnp.int32),
+         jnp.where(end_valid, cont_v, NEG_INF).astype(jnp.int32)],
+        axis=1).reshape(2 * w_cap)
+    nrank = jnp.cumsum(il_valid.astype(jnp.int32)) - 1
+    ndst = jnp.where(il_valid, nrank, 2 * w_cap)
+    cnew_rows = jnp.full((2 * w_cap, ROW_PAD), 0xFFFFFFFF,
+                         dtype=jnp.uint32).at[ndst].set(il_rows, mode="drop")
+    cnew_v = jnp.full((2 * w_cap,), NEG_INF, dtype=jnp.int32).at[
+        ndst].set(il_v, mode="drop")
+    new_digest = rows_to_planar(cnew_rows)
+    new_count = jnp.sum(il_valid.astype(jnp.int32))
+    new_valid = jnp.arange(2 * w_cap, dtype=jnp.int32) < new_count
+
+    # Interleave positions (identical to window_insert's tail).
+    pos_new = searchsorted_left(old_k, new_digest) + jnp.arange(
+        2 * w_cap, dtype=jnp.int32)
+    pos_old = idx_cap + rank_count(
+        searchsorted_right(old_k, new_digest), d_cap)
+    new_size = kept_count + new_count
+    overflow = new_size > d_cap
+    old_dst = jnp.where((idx_cap < kept_count) & ~overflow, pos_old, d_cap)
+    new_dst = jnp.where(new_valid & ~overflow, pos_new, d_cap)
+    out_rows = max_rows_cap.at[old_dst].set(old_rows, mode="drop")
+    out_rows = out_rows.at[new_dst].set(cnew_rows, mode="drop")
+    out_k = rows_to_planar(out_rows)
+    out_v = jnp.full((d_cap,), NEG_INF, dtype=jnp.int32)
+    out_v = out_v.at[old_dst].set(old_v, mode="drop")
+    out_v = out_v.at[new_dst].set(cnew_v, mode="drop")
+    out_k = jnp.where(overflow, dk, out_k)
+    out_v = jnp.where(overflow, dv, out_v)
+    out_size = jnp.where(overflow, dsize, new_size).astype(jnp.int32)
+    return WindowState(out_k, out_v, out_size), overflow
+
+
 @lru_cache(maxsize=64)
 def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
-                      w_cap: int, all_point: bool = False):
+                      w_cap: int, all_point: bool = False,
+                      axis_name: str = None):
     """Build the jitted per-batch step for one bucket shape.
 
-    all_point=True compiles the point-key fast path for batches whose every
-    conflict range is [k, k+\\x00) with len(k) <= 23: intra-batch overlap is
-    then exact digest equality, so the per-round interval tree collapses to
-    one scatter-min over key ids + one gather (~10x cheaper per Jacobi
-    round on TPU).  Verdicts are identical to the general path.
+    axis_name=None (default) builds the single-device program.  With an
+    axis name, the SAME program becomes the per-shard body of a
+    key-range-sharded resolve (parallel/sharded_resolver.py): the function
+    gains a trailing `bounds` argument (uint32[6, 2]: this shard's [lo,
+    hi) digest range), history reads are CLIPPED to the shard and the
+    per-txn history-conflict bits are max-combined over `axis_name` (the
+    device-side analog of the proxy's min-combine across resolvers,
+    CommitProxyServer.actor.cpp:800-806), inserts keep only the shard's
+    portion of each write, and the reply extras combine across shards
+    (flag/delta-occupancy by max, base size by sum).  The intra-batch
+    fixpoint needs no collectives: it is batch-local and runs replicated.
+    The function is returned UNJITTED for the caller to wrap in
+    shard_map + jit.
+
+    all_point=True compiles the SORT-FREE point-key path for batches whose
+    every conflict range is [k, k+\\x00) with len(k) <= 23: the host
+    pre-groups keys (np.unique over S24 digest views, tpu_backend
+    _group_points) and ships unique sorted write keys + per-range slot
+    indices, so the device runs no lax.sort at all — multi-operand sorts
+    were both the per-batch runtime hot spot and a minutes-per-shape XLA
+    compile cost over the TPU tunnel.  Intra-batch overlap is exact
+    begin-digest equality, so each Jacobi round is one scatter-min over
+    unique-key slots + one gather; the delta insert compacts host-sorted
+    interleaved boundaries with rank scatters (_point_insert) instead of
+    sorting.  Verdicts are identical to the general path.
 
     fn(bk, bv, table, size, dk, dv, dsize, flag, digests, meta)
       -> (dk', dv', dsize', flag', out)
@@ -111,10 +231,12 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
     Base arrays pass through untouched (read-only)."""
     u_cap = _next_pow2(2 * (r_cap + w_cap))
     log_u = u_cap.bit_length() - 1
-    b_cap = _next_pow2(r_cap + w_cap)
 
-    def step(bk, bv, table, size, dk, dv, dsize, flag, digests, meta):
+    def step(bk, bv, table, size, dk, dv, dsize, flag, digests, meta,
+             bounds=None):
         # ---- unpack the two packed input blocks ---------------------------
+        # Point layout: the w sections carry the host-grouped UNIQUE sorted
+        # write keys/ends (u <= nw live columns, MAX padding above).
         r_b = digests[:, 0:r_cap]
         r_e = digests[:, r_cap:2 * r_cap]
         w_b = digests[:, 2 * r_cap:2 * r_cap + w_cap]
@@ -122,8 +244,12 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
         o = 0
         r_txn = meta[o:o + r_cap]; o += r_cap
         r_valid = meta[o:o + r_cap] != 0; o += r_cap
+        if all_point:
+            r_wid = meta[o:o + r_cap]; o += r_cap
         w_txn = meta[o:o + w_cap]; o += w_cap
         w_valid = meta[o:o + w_cap] != 0; o += w_cap
+        if all_point:
+            w_uidx = meta[o:o + w_cap]; o += w_cap
         t_snap = meta[o:o + t_cap]; o += t_cap
         t_has_reads = meta[o:o + t_cap] != 0; o += t_cap
         t_valid = meta[o:o + t_cap] != 0; o += t_cap
@@ -137,17 +263,29 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
         r_txn_c = jnp.clip(r_txn, 0, t_cap - 1)
         r_live = r_valid & ~too_old[r_txn_c]
         snap_r = t_snap[r_txn_c]
-        lo_b = searchsorted_right(bk, r_b) - 1   # segment containing begin
-        hi_b = searchsorted_left(bk, r_e)        # first boundary >= end
+        if axis_name is not None:
+            # Clip each read to this shard's key range; the per-txn bits
+            # are max-combined across shards below, so the union over
+            # shards covers the whole range exactly.
+            cr_b = _lex_max_cols(r_b, bounds[:, 0])
+            cr_e = _lex_min_cols(r_e, bounds[:, 1])
+            r_hist_live = r_live & lex_less(cr_b, cr_e)
+        else:
+            cr_b, cr_e, r_hist_live = r_b, r_e, r_live
+        lo_b = searchsorted_right(bk, cr_b) - 1  # segment containing begin
+        hi_b = searchsorted_left(bk, cr_e)       # first boundary >= end
         max_base = range_max(table, lo_b, hi_b)
         dtable = build_sparse_table(dv)          # DCAP log DCAP: cheap
-        lo_d = searchsorted_right(dk, r_b) - 1
-        hi_d = searchsorted_left(dk, r_e)
+        lo_d = searchsorted_right(dk, cr_b) - 1
+        hi_d = searchsorted_left(dk, cr_e)
         max_delta = range_max(dtable, lo_d, hi_d)
-        hist_bits = r_live & (jnp.maximum(max_base, max_delta) > snap_r)
+        hist_bits = r_hist_live & (jnp.maximum(max_base, max_delta) > snap_r)
         r_scatter = jnp.where(r_live, r_txn, t_cap)
         hist_conflicted = jnp.zeros((t_cap,), bool).at[r_scatter].max(
             hist_bits, mode="drop")
+        if axis_name is not None:
+            hist_conflicted = jax.lax.pmax(
+                hist_conflicted.astype(jnp.int32), axis_name) > 0
 
         w_txn_c = jnp.clip(w_txn, 0, t_cap - 1)
         w_base_ok = w_valid & ~too_old[w_txn_c]
@@ -159,27 +297,22 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
         # wrongly abort t3.  Prefix-correctness of Jacobi on the triangular
         # dependency system guarantees convergence in <= chain-depth rounds.
         if all_point:
-            # Point fast path: overlap == begin-digest equality.  Key id =
-            # rank of first equal begin among all begins; per round, one
-            # scatter-min of active writer txn ids + one gather.
+            # Point fast path: overlap == begin-digest equality, and the
+            # HOST already grouped keys — r_wid[i] is the unique-write-key
+            # slot matching read i (w_cap when none), w_uidx[j] is write
+            # j's slot.  Per round: one scatter-min + one gather; no sort,
+            # no searchsorted.
             from ..ops.segtree import INF_I32
-            pad_b = jnp.broadcast_to(
-                jnp.asarray(MAX_DIGEST)[:, None],
-                (KEY_LANES, b_cap - r_cap - w_cap))
-            begins = jnp.concatenate([r_b, w_b, pad_b], axis=1)
-            sorted_b = jnp.stack(jax.lax.sort(
-                [begins[l] for l in range(KEY_LANES)],
-                num_keys=KEY_LANES), axis=0)
-            r_id = jnp.minimum(searchsorted_left(sorted_b, r_b), b_cap - 1)
-            w_id = searchsorted_left(sorted_b, w_b)
+            r_wid_c = jnp.clip(r_wid, 0, w_cap)
+            w_slot = jnp.clip(w_uidx, 0, w_cap - 1)
 
             def body(carry):
                 conf, _ = carry
                 w_active = w_base_ok & ~conf[w_txn_c]
-                cover = jnp.full((b_cap,), INF_I32, jnp.int32).at[
-                    jnp.where(w_active, w_id, b_cap)].min(
-                    jnp.where(w_active, w_txn, INF_I32), mode="drop")
-                intra_hit = r_live & (cover[r_id] < r_txn)
+                cover = jnp.full((w_cap + 1,), INF_I32, jnp.int32).at[
+                    jnp.where(w_active, w_slot, w_cap)].min(
+                    jnp.where(w_active, w_txn, INF_I32))
+                intra_hit = r_live & (cover[r_wid_c] < r_txn)
                 new_conf = hist_conflicted.at[r_scatter].max(intra_hit,
                                                              mode="drop")
                 return new_conf, jnp.any(new_conf != conf)
@@ -217,8 +350,28 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
         # ---- insert surviving writes into the DELTA at `now` --------------
         survivor = t_valid & ~too_old & ~conflicted
         w_ins = w_valid & survivor[w_txn_c]
-        (dk2, dv2, dsize2), overflow = window_insert(
-            WindowState(dk, dv, dsize), w_b, w_e, w_ins, now_rel)
+        if all_point:
+            if axis_name is not None:
+                # A point range never straddles a split (its begin and end
+                # digests differ only in the final marker byte), so the
+                # begin's owner inserts the whole range.
+                lo_bc = jnp.broadcast_to(bounds[:, 0][:, None], w_b.shape)
+                hi_bc = jnp.broadcast_to(bounds[:, 1][:, None], w_b.shape)
+                u_own = ~lex_less(w_b, lo_bc) & lex_less(w_b, hi_bc)
+            else:
+                u_own = None
+            (dk2, dv2, dsize2), overflow = _point_insert(
+                dk, dv, dsize, w_b, w_e, w_uidx, w_ins, now_rel,
+                d_cap, w_cap, u_own=u_own)
+        else:
+            if axis_name is not None:
+                iw_b = _lex_max_cols(w_b, bounds[:, 0])
+                iw_e = _lex_min_cols(w_e, bounds[:, 1])
+                w_ins = w_ins & lex_less(iw_b, iw_e)
+            else:
+                iw_b, iw_e = w_b, w_e
+            (dk2, dv2, dsize2), overflow = window_insert(
+                WindowState(dk, dv, dsize), iw_b, iw_e, w_ins, now_rel)
         flag2 = flag | overflow.astype(jnp.int32)
 
         codes = jnp.where(
@@ -226,11 +379,24 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
             jnp.where(too_old, RES_TOO_OLD,
                       jnp.where(conflicted, RES_CONFLICT, RES_COMMITTED))
         ).astype(jnp.int8)
-        extras = jnp.stack([flag2, dsize2.astype(jnp.int32),
-                            size.astype(jnp.int32)])
+        if axis_name is not None:
+            # Replicated reply: sticky flag and worst-shard delta occupancy
+            # by max (both drive host merge scheduling), base size by sum
+            # (a global live-boundary census).
+            ex_flag = jax.lax.pmax(flag2, axis_name)
+            ex_dsize = jax.lax.pmax(dsize2.astype(jnp.int32), axis_name)
+            ex_size = jax.lax.psum(size.astype(jnp.int32), axis_name)
+        else:
+            ex_flag = flag2
+            ex_dsize = dsize2.astype(jnp.int32)
+            ex_size = size.astype(jnp.int32)
+        extras = jnp.stack([ex_flag, ex_dsize, ex_size])
         extras8 = jax.lax.bitcast_convert_type(extras, jnp.int8).reshape(-1)
         out = jnp.concatenate([codes, extras8])
         return dk2, dv2, dsize2, flag2, out
+
+    if axis_name is not None:
+        return step
 
     # digests/meta (argnums 8, 9) are never donatable into the outputs;
     # donating them only produces per-shape "unusable donation" warnings.
@@ -238,15 +404,21 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
 
 
 @lru_cache(maxsize=16)
-def make_merge_step(cap: int, d_cap: int):
+def make_merge_step(cap: int, d_cap: int, sharded: bool = False):
     """Build the jitted merge: overlay delta onto base + GC + rebase + table.
 
     fn(bk, bv, size, dk, dv, dsize, flag, scalars)
       -> (bk', bv', table', size', dk0, dv0, dsize0, flag')
-    scalars = int32[2] = [new_oldest_rel, rebase_delta]."""
+    scalars = int32[2] = [new_oldest_rel, rebase_delta].
+
+    sharded=True returns the function UNJITTED as the per-shard merge body
+    (no collectives are needed: every input is either shard-local state or
+    a replicated scalar).  The reset delta's covering boundary then starts
+    at the shard's own lower bound, passed as a trailing `dk0_first`
+    argument (uint32[6]) instead of the all-keys zero digest."""
     s_cap = cap + d_cap  # scratch rows for the pre-GC merged sequence
 
-    def merge(bk, bv, size, dk, dv, dsize, flag, scalars):
+    def merge(bk, bv, size, dk, dv, dsize, flag, scalars, dk0_first=None):
         new_oldest_rel = scalars[0]
         rebase_delta = scalars[1]
         idx_b = jnp.arange(cap, dtype=jnp.int32)
@@ -318,11 +490,15 @@ def make_merge_step(cap: int, d_cap: int):
         table = build_sparse_table(out_v)
         new_size = jnp.minimum(final_size, cap).astype(jnp.int32)
 
+        first = (jnp.zeros((KEY_LANES,), jnp.uint32) if dk0_first is None
+                 else dk0_first)
         ndk = jnp.asarray(np.broadcast_to(MAX_DIGEST[:, None],
                                           (KEY_LANES, d_cap))
-                          ).at[:, 0].set(jnp.zeros((KEY_LANES,), jnp.uint32))
+                          ).at[:, 0].set(first)
         ndv = jnp.full((d_cap,), NEG_INF, dtype=jnp.int32)
         ndsize = jnp.int32(1)
         return out_k, out_v, table, new_size, ndk, ndv, ndsize, flag2
 
+    if sharded:
+        return merge
     return jax.jit(merge, donate_argnums=(0, 1, 2, 3, 4, 5, 6))

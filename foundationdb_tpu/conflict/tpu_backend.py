@@ -200,6 +200,44 @@ class TpuConflictSet(ConflictSet):
         self.dk, self.dv, self.dsize = dst.bk, dst.bv, dst.size
 
     # -- batch packing ------------------------------------------------------
+    @staticmethod
+    def _group_points(enc: EncodedBatch, w_cap: int):
+        """Host-side key grouping for the sort-free device point path:
+        (u_keys, u_ends, w_uidx, r_wid) — unique sorted write keys, each
+        write's slot among them, and each read's matching slot (w_cap
+        sentinel when its key was not written).  np.unique/searchsorted run
+        over S24 byte views of the digests (ops/digest.py planar_to_s24).
+
+        Returns None when two unique keys are digest-ADJACENT (one range's
+        end >= the next range's begin, e.g. keys k and k+b"\\x00"): the
+        interleaved-boundary device insert requires strictly separated
+        ranges, so such batches take the general sorted path instead."""
+        from ..ops.digest import planar_to_s24
+        nw = enc.w_txn.shape[0]
+        nr = enc.r_txn.shape[0]
+        if nw == 0:
+            empty = np.empty((enc.r_begin.shape[0], 0), dtype=np.uint32)
+            return (empty, empty, np.zeros((0,), np.int32),
+                    np.full((nr,), w_cap, dtype=np.int32))
+        wb_s = planar_to_s24(enc.w_begin)
+        u_s, first_idx, w_uidx = np.unique(
+            wb_s, return_index=True, return_inverse=True)
+        u_keys = np.ascontiguousarray(enc.w_begin[:, first_idx])
+        u_ends = np.ascontiguousarray(enc.w_end[:, first_idx])
+        if len(u_s) > 1:
+            ue_s = planar_to_s24(u_ends)
+            if bool((ue_s[:-1] >= u_s[1:]).any()):
+                return None
+        if nr:
+            rb_s = planar_to_s24(enc.r_begin)
+            pos = np.searchsorted(u_s, rb_s)
+            safe = np.minimum(pos, len(u_s) - 1)
+            hit = (pos < len(u_s)) & (u_s[safe] == rb_s)
+            r_wid = np.where(hit, pos, w_cap).astype(np.int32)
+        else:
+            r_wid = np.zeros((0,), np.int32)
+        return u_keys, u_ends, w_uidx.astype(np.int32), r_wid
+
     def _pack(self, enc: EncodedBatch):
         """Bucket-pad the columnar batch into the two device input blocks."""
         from ..ops.digest import max_digest_block
@@ -210,23 +248,42 @@ class TpuConflictSet(ConflictSet):
         r_cap = _bucket(nr)
         w_cap = _bucket(nw)
 
+        all_point = bool(enc.all_point)
+        point = None
+        if all_point:
+            point = self._group_points(enc, w_cap)
+            if point is None:
+                all_point = False
+
         # Packed digest block: r_b | r_e | w_b | w_e (one h2d transfer);
-        # planar uint32[6, 2R+2W].
+        # planar uint32[6, 2R+2W].  Point path: the w sections carry the
+        # unique grouped keys instead (fused.py step docstring).
         digests = max_digest_block(2 * r_cap + 2 * w_cap)
         digests[:, :nr] = enc.r_begin
         digests[:, r_cap:r_cap + nr] = enc.r_end
-        digests[:, 2 * r_cap:2 * r_cap + nw] = enc.w_begin
-        digests[:, 2 * r_cap + w_cap:2 * r_cap + w_cap + nw] = enc.w_end
+        if all_point:
+            u_keys, u_ends, w_uidx, r_wid = point
+            u = u_keys.shape[1]
+            digests[:, 2 * r_cap:2 * r_cap + u] = u_keys
+            digests[:, 2 * r_cap + w_cap:2 * r_cap + w_cap + u] = u_ends
+        else:
+            digests[:, 2 * r_cap:2 * r_cap + nw] = enc.w_begin
+            digests[:, 2 * r_cap + w_cap:2 * r_cap + w_cap + nw] = enc.w_end
 
         # Packed int32 metadata block (second h2d transfer); scalar slots at
         # the end are filled by _dispatch.
-        meta = np.zeros((self._fused.meta_size(t_cap, r_cap, w_cap),),
+        meta = np.zeros((self._fused.meta_size(t_cap, r_cap, w_cap,
+                                               all_point),),
                         dtype=np.int32)
         o = 0
         meta[o:o + nr] = enc.r_txn; o += r_cap
         meta[o:o + nr] = 1; o += r_cap
+        if all_point:
+            meta[o:o + nr] = r_wid; o += r_cap
         meta[o:o + nw] = enc.w_txn; o += w_cap
         meta[o:o + nw] = 1; o += w_cap
+        if all_point:
+            meta[o:o + nw] = w_uidx; o += w_cap
         snap_off = o; o += t_cap
         meta[o:o + n] = enc.t_has_reads; o += t_cap
         meta[o:o + n] = 1; o += t_cap
@@ -234,7 +291,7 @@ class TpuConflictSet(ConflictSet):
         return {"digests": digests, "meta": meta, "snap_off": snap_off,
                 "scalar_off": o, "t_snap_abs": enc.t_snap, "nw": nw,
                 "caps": (t_cap, r_cap, w_cap),
-                "all_point": bool(enc.all_point)}
+                "all_point": all_point}
 
     def _dispatch(self, enc, now: Version, oldest_floor: Version,
                   n_txns: int) -> ResolveHandle:

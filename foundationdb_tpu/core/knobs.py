@@ -99,6 +99,10 @@ class ServerKnobs(KnobBase):
         # tLogPeekMessages): a lagging puller's catch-up peek pages through
         # the spilled backlog instead of materializing all of it at once.
         self.TLOG_PEEK_DESIRED_BYTES = 1e6
+        # Region replication (log_router.py): bound on a LogRouter's
+        # buffered bytes — past it, pulling pauses and the primary TLogs
+        # absorb the remote lag via spill-by-reference.
+        self.LOG_ROUTER_BUFFER_BYTES = 100e6
         self.UPDATE_STORAGE_BYTE_LIMIT = 1e6
         self.MAX_COMMIT_UPDATES = 2000
 

@@ -165,6 +165,18 @@ def lex_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(a == b, axis=0)
 
 
+def lex_max_cols(a: jnp.ndarray, b_col: jnp.ndarray) -> jnp.ndarray:
+    """Columnwise lexicographic max(a[:, i], b_col); a: [6, N], b_col: [6].
+    Used to clip digest ranges to a key-range shard's bounds."""
+    b = jnp.broadcast_to(b_col[:, None], a.shape)
+    return jnp.where(lex_less(a, b)[None, :], b, a)
+
+
+def lex_min_cols(a: jnp.ndarray, b_col: jnp.ndarray) -> jnp.ndarray:
+    b = jnp.broadcast_to(b_col[:, None], a.shape)
+    return jnp.where(lex_less(b, a)[None, :], b, a)
+
+
 ROW_PAD = 8  # gather row width: 6 key lanes padded to a power of two
 
 
@@ -222,16 +234,22 @@ def _searchsorted(sorted_keys: jnp.ndarray, queries: jnp.ndarray,
     must be a power of two (capacity arrays are padded with MAX_DIGEST above
     the live size).
 
-    The probe loop gathers interleaved ROWS (uint32[CAP, 8]: 6 lanes + pad)
-    — ONE row gather per probe instead of 6 planar 1-D gathers.  Measured on
-    TPU v5e: ~40x faster (per-lane gathers ran at ~74M elem/s; row gathers
-    move the same data in one pass).  The planar->rows transpose here is
-    CSE'd by XLA when several searches against the same array live in one
-    jit, so callers keep the planar layout everywhere."""
+    The probe-gather layout is BACKEND-ADAPTIVE (chosen at trace time):
+
+    - TPU: interleaved ROWS (uint32[CAP, 8]: 6 lanes + pad) — ONE row
+      gather per probe.  Measured on v5e: ~40x faster than per-lane
+      gathers (which ran at ~74M elem/s).  The planar->rows transpose is
+      CSE'd by XLA when several searches share one jit.
+    - CPU: per-lane planar 1-D gathers — row gathers measured ~1000x
+      SLOWER there (XLA:CPU scalarizes the 8-wide row loads), and the
+      XLA-CPU path serves the bench fallback and the whole test suite."""
+    import jax as _jax
     cap = sorted_keys.shape[1]
     nbits = int(cap).bit_length() - 1
     assert cap == 1 << nbits, f"capacity {cap} not a power of two"
-    rows = planar_to_rows(sorted_keys)
+    use_rows = _jax.default_backend() != "cpu"
+    if use_rows:
+        rows = planar_to_rows(sorted_keys)
     nq = queries.shape[1]
     lo = jnp.zeros((nq,), dtype=jnp.int32)
     # Binary search maintaining: result in (lo, hi]; start hi = cap.
@@ -241,16 +259,20 @@ def _searchsorted(sorted_keys: jnp.ndarray, queries: jnp.ndarray,
         active = lo < hi
         mid = (lo + hi) >> 1
         midc = jnp.minimum(mid, cap - 1)
-        mk = rows[midc]                     # [nq, 8] single row gather
+        if use_rows:
+            mk = rows[midc]                 # [nq, 8] single row gather
+            mk_lanes = [mk[:, lane] for lane in range(KEY_LANES)]
+        else:
+            mk_lanes = [sorted_keys[lane][midc] for lane in range(KEY_LANES)]
         # lexicographic keys[midc] < q (or <=) via per-lane where-chain
         last = KEY_LANES - 1
         if side_left:
-            cmp = mk[:, last] < q_lanes[last]    # keys[mid] < q
+            cmp = mk_lanes[last] < q_lanes[last]    # keys[mid] < q
         else:
-            cmp = mk[:, last] <= q_lanes[last]   # keys[mid] <= q
+            cmp = mk_lanes[last] <= q_lanes[last]   # keys[mid] <= q
         for lane in range(KEY_LANES - 2, -1, -1):
-            cmp = jnp.where(mk[:, lane] == q_lanes[lane], cmp,
-                            mk[:, lane] < q_lanes[lane])
+            cmp = jnp.where(mk_lanes[lane] == q_lanes[lane], cmp,
+                            mk_lanes[lane] < q_lanes[lane])
         lo = jnp.where(active & cmp, mid + 1, lo)
         hi = jnp.where(active & ~cmp, mid, hi)
     return hi

@@ -67,16 +67,8 @@ def digest_splits(n_shards: int) -> np.ndarray:
     return splits
 
 
-def _lex_max_cols(a: jnp.ndarray, b_col: jnp.ndarray) -> jnp.ndarray:
-    """Columnwise max(a[:, i], b_col) lexicographically; a: [6, N] planar,
-    b_col: [6]."""
-    b = jnp.broadcast_to(b_col[:, None], a.shape)
-    return jnp.where(lex_less(a, b)[None, :], b, a)
-
-
-def _lex_min_cols(a: jnp.ndarray, b_col: jnp.ndarray) -> jnp.ndarray:
-    b = jnp.broadcast_to(b_col[:, None], a.shape)
-    return jnp.where(lex_less(b, a)[None, :], b, a)
+from ..ops.digest import lex_max_cols as _lex_max_cols  # noqa: E402
+from ..ops.digest import lex_min_cols as _lex_min_cols  # noqa: E402
 
 
 class ShardedWindow:

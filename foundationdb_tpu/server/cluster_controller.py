@@ -85,7 +85,7 @@ class ClusterController:
             arrived, self._worker_arrived = self._worker_arrived, []
             for p in arrived:
                 p.send(None)
-            if req.reply is not None:
+            if getattr(req.reply, "send", None):  # one-way sends: reply=False
                 req.reply.send(None)
 
     async def _monitor_worker(self, wid: str, iface: WorkerInterface) -> None:

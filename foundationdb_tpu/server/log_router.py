@@ -1,0 +1,267 @@
+"""LogRouter + remote-TLog feeder: the cross-region replication plane.
+
+Reference: fdbserver/LogRouter.actor.cpp:308 (pullAsyncData — the router
+pulls its tags from the primary log system into a bounded buffer and
+re-serves peeks to the remote region) and
+TagPartitionedLogSystem.actor.cpp (remote tlog sets pulling through log
+routers instead of every remote consumer crossing the DCN per primary
+TLog).
+
+Topology here (see master.py region recruiting):
+
+    commit proxies --push--> primary TLogs     (sync, commit-acked)
+         primary TLogs <--peek-- LogRouter     (async, this module)
+         LogRouter <--peek-- remote TLogs      (remote_tlog_feeder)
+         remote TLogs <--peek-- remote storage (ordinary pull loops)
+
+Remote data rides REMOTE TWIN TAGS: twin(t) = t + REMOTE_TAG_OFFSET for a
+primary tag t (an involution — after a region failover the old primary
+tags become the new remote twins).  Proxies push twin-tagged copies of
+every mutation to the primary TLogs (commit_proxy tag routing); the
+router pulls only twin tags, so primary-region pops are never blocked by
+it, and the primary TLogs' spill-by-reference + peek pagination bound
+memory when the remote lags.
+
+The remote TLog is an ordinary TLog (same DiskQueue durability, lock
+semantics, peeks): remote_tlog_feeder stages per-tag router pulls and
+commits whole versions in order once EVERY fed tag's frontier has passed
+them, so the remote TLog's version chain is contiguous and a region
+failover can lock + recover from it exactly like an old generation.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+from ..core.futures import Promise
+from ..core.knobs import server_knobs
+from ..core.scheduler import delay
+from ..core.trace import Severity, TraceEvent
+from ..txn.types import Mutation, Version
+from .interfaces import (Tag, TLogCommitRequest, TLogInterface,
+                         TLogPeekReply, TLogPeekRequest, TLogPopRequest)
+from .notified import NotifiedVersion
+
+# Twin-tag namespace: remote replica of primary tag t is t + OFFSET, and
+# twin(twin(t)) == t (involution) so fail-back reuses the old primary's
+# surviving storage as the new remote side.
+REMOTE_TAG_OFFSET = 1_000_000
+
+
+def twin_tag(tag: Tag) -> Tag:
+    return tag - REMOTE_TAG_OFFSET if tag >= REMOTE_TAG_OFFSET \
+        else tag + REMOTE_TAG_OFFSET
+
+
+def is_remote_tag(tag: Tag) -> bool:
+    return tag >= REMOTE_TAG_OFFSET
+
+
+class LogRouter:
+    """Buffered per-tag relay from the primary log system.
+
+    Serves the TLogInterface peek/pop surface (so LogSystemClient works
+    against a router set unchanged); a pull loop per requested tag keeps
+    [popped, frontier] buffered, bounded by LOG_ROUTER_BUFFER_BYTES —
+    when full, pulling pauses until the remote pops (the primary TLogs
+    absorb the backlog via spill-by-reference)."""
+
+    def __init__(self, router_id: str, primary_log_system: Any,
+                 start_version: Version = 0) -> None:
+        self.id = router_id
+        self.interface = TLogInterface(router_id)
+        self.interface.role = self
+        self.primary = primary_log_system      # LogSystemClient
+        self.start_version = start_version
+        self.tag_data: Dict[Tag, Deque[Tuple[Version, List[Mutation]]]] = {}
+        self.frontier: Dict[Tag, NotifiedVersion] = {}
+        self.popped: Dict[Tag, Version] = {}
+        self.buffered_bytes = 0
+        self._pullers: Set[Tag] = set()
+        self._process = None
+        self.stopped = False
+        self._stop_promise: Promise = Promise()
+
+    # -- pull loop (reference pullAsyncData, LogRouter.actor.cpp:308) --------
+    def _ensure_puller(self, tag: Tag) -> None:
+        if tag in self._pullers or self._process is None:
+            return
+        self._pullers.add(tag)
+        self.tag_data.setdefault(tag, deque())
+        self.frontier.setdefault(
+            tag, NotifiedVersion(self.start_version))
+        self._process.spawn(self._pull(tag), f"{self.id}.pull{tag}")
+
+    async def _pull(self, tag: Tag) -> None:
+        from ..core.error import FdbError
+        knobs = server_knobs()
+        limit = int(knobs.LOG_ROUTER_BUFFER_BYTES)
+        cursor = self.frontier[tag].get() + 1
+        while not self.stopped:
+            if self.buffered_bytes > limit:
+                await delay(0.05)          # backpressure: wait for pops
+                continue
+            try:
+                reply = await self.primary.peek_tag(tag, cursor)
+            except FdbError:
+                await delay(0.5)           # primary epoch mid-recovery
+                continue
+            q = self.tag_data[tag]
+            popped_to = self.popped.get(tag, 0)
+            for v, msgs in reply.messages:
+                if v < cursor or v <= popped_to:
+                    continue
+                q.append((v, msgs))
+                self.buffered_bytes += sum(m.expected_size() for m in msgs)
+            cursor = max(reply.end, cursor)
+            new_frontier = max(reply.max_known_version,
+                               self.frontier[tag].get())
+            if new_frontier > self.frontier[tag].get():
+                self.frontier[tag].set(new_frontier)
+            else:
+                await delay(0.05)          # no progress: poll
+
+
+    # -- serving -------------------------------------------------------------
+    async def _peek(self, req: TLogPeekRequest) -> None:
+        self._ensure_puller(req.tag)
+        fr = self.frontier[req.tag]
+        if fr.get() < req.begin and not self.stopped:
+            # Race the halt signal: a peek parked on a frontier that will
+            # never advance (router halted mid-wait) must still reply.
+            from ..core.futures import wait_any
+            await wait_any([fr.when_at_least(req.begin),
+                            self._stop_promise.get_future()])
+        # Page by the same byte budget as TLog peeks: a catch-up peek of
+        # the full buffer (up to LOG_ROUTER_BUFFER_BYTES) would exceed the
+        # transport frame cap and defeat the memory bound.  The cut
+        # lowers end AND max_known_version so the puller re-peeks.
+        budget = int(server_knobs().TLOG_PEEK_DESIRED_BYTES)
+        sent = 0
+        cut: Optional[Version] = None
+        out: List[Tuple[Version, List[Mutation]]] = []
+        for v, msgs in self.tag_data.get(req.tag, ()):
+            if v < req.begin:
+                continue
+            if sent >= budget:
+                cut = v
+                break
+            out.append((v, msgs))
+            sent += sum(m.expected_size() for m in msgs)
+        if cut is not None:
+            req.reply.send(TLogPeekReply(messages=out, end=cut,
+                                         max_known_version=cut - 1))
+        else:
+            max_known = fr.get()
+            req.reply.send(TLogPeekReply(messages=out, end=max_known + 1,
+                                         max_known_version=max_known))
+
+    def _pop(self, req: TLogPopRequest) -> None:
+        prev = self.popped.get(req.tag, 0)
+        if req.to > prev:
+            self.popped[req.tag] = req.to
+            q = self.tag_data.get(req.tag)
+            if q is not None:
+                while q and q[0][0] <= req.to:
+                    _v, msgs = q.popleft()
+                    self.buffered_bytes -= sum(
+                        m.expected_size() for m in msgs)
+            # Forward: the primary may now trim/spill-trim this twin tag.
+            self.primary.pop(req.tag, req.to)
+        if getattr(req.reply, "send", None):   # one-way pops carry reply=False
+            req.reply.send(None)
+
+    async def _serve_peek(self) -> None:
+        async for req in self.interface.peek.queue:
+            self._process.spawn(self._peek(req), f"{self.id}.peek")
+
+    async def _serve_pop(self) -> None:
+        async for req in self.interface.pop.queue:
+            self._pop(req)
+
+    def run(self, process) -> None:
+        self._process = process
+        for s in (self.interface.peek, self.interface.pop):
+            process.register(s)
+        process.spawn(self._serve_peek(), f"{self.id}.servePeek")
+        process.spawn(self._serve_pop(), f"{self.id}.servePop")
+
+    def halt(self) -> None:
+        self.stopped = True
+        if not self._stop_promise.is_set():
+            self._stop_promise.send(None)
+
+
+async def remote_tlog_feeder(tlog, router_log_system: Any,
+                             tags: List[Tag],
+                             start_version: Version = 0) -> None:
+    """Feed a remote TLog from the log routers.
+
+    Pulls every twin tag's stream, stages entries by version, and commits
+    a version into the TLog once ALL tags' pulled frontiers pass it — the
+    remote TLog's version chain is then contiguous (empty versions
+    included via a frontier-advancing empty commit), so locks/peeks
+    behave exactly like a primary TLog's and failover recovery can treat
+    it as an old generation (master.py region failover).
+
+    Reference: the remote tLog's pull from log routers,
+    TLogServer.actor.cpp pullAsyncData on remote sets."""
+    from ..core.error import FdbError
+    tags = list(tags)
+    if not tags:
+        return
+    cursors = {t: max(start_version, tlog.version.get()) + 1 for t in tags}
+    frontiers = {t: max(start_version, tlog.version.get()) for t in tags}
+    staged: Dict[Version, Dict[Tag, List[Mutation]]] = {}
+
+    async def _commit(version: Version,
+                      messages: Dict[Tag, List[Mutation]]) -> None:
+        p = Promise()
+        await tlog._commit(TLogCommitRequest(
+            version=version, prev_version=tlog.version.get(),
+            known_committed_version=tlog.known_committed_version,
+            messages=messages, reply=p))
+        await p.get_future()
+
+    while not tlog.stopped:
+        progressed = False
+        for t in tags:
+            try:
+                reply = await router_log_system.peek_tag(t, cursors[t])
+            except FdbError:
+                await delay(0.5)
+                continue
+            for v, msgs in reply.messages:
+                if v >= cursors[t]:
+                    staged.setdefault(v, {})[t] = msgs
+            if reply.end > cursors[t]:
+                progressed = True
+            cursors[t] = max(reply.end, cursors[t])
+            frontiers[t] = max(frontiers[t], reply.max_known_version)
+        lim = min(frontiers.values())
+        committed_any = False
+        for v in sorted(vv for vv in staged if vv <= lim):
+            if tlog.stopped:
+                return
+            if v > tlog.version.get():
+                await _commit(v, staged[v])
+                committed_any = True
+            del staged[v]
+        if lim > tlog.version.get() and not tlog.stopped:
+            # Advance through trailing EMPTY versions so peeks/locks see
+            # the full contiguous frontier.
+            await _commit(lim, {})
+            committed_any = True
+        if committed_any:
+            # Only durable data may be popped off the routers (and
+            # transitively off the primary): wait for the fsync frontier.
+            durable = tlog.durable_version.get()
+            target = min(tlog.version.get(), lim)
+            if durable < target:
+                await tlog.durable_version.when_at_least(target)
+            for t in tags:
+                router_log_system.pop(t, min(cursors[t] - 1, target))
+        if not progressed:
+            await delay(0.05)
+    TraceEvent("RemoteTLogFeederStopped").detail("Id", tlog.id).log()

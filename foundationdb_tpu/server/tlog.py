@@ -411,7 +411,10 @@ class TLog:
                     if req.tag in self.tag_bytes:
                         self.tag_bytes[req.tag] -= nbytes
             self._trim_queue()
-        if req.reply is not None:
+        # Duck-typed: network one-way pops carry reply=False (no promise
+        # attached); `is not None` alone would call False.send and kill
+        # the serve loop — silently disabling trimming forever.
+        if getattr(req.reply, "send", None):
             req.reply.send(None)
 
     def _trim_queue(self) -> None:

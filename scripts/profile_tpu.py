@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Per-piece cost profile of the resolve step on the live backend.
+
+Times the bench-configuration step (BASELINE config 2 shapes: 100K txns,
+200K reads + 100K writes, CAP 2^21, DCAP 2^20) and its constituent device
+programs SEPARATELY, each materialized with np.asarray (the axon tunnel's
+block_until_ready does not actually block).  Prints one line per piece so
+the top cost is obvious; run on TPU (default) or
+JAX_PLATFORMS=cpu for the XLA-CPU comparison.
+
+Usage: python scripts/profile_tpu.py [reps] [--small]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+REPS = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 3
+SMALL = "--small" in sys.argv
+
+if SMALL:
+    T, CAP, DCAP = 2_000, 1 << 16, 1 << 15
+else:
+    T, CAP, DCAP = 100_000, 1 << 21, 1 << 20
+R, W = 2 * T, T
+
+
+def bucket(n):
+    b = 256
+    while b < n:
+        b <<= 1
+    return b
+
+
+def timed(label, fn, *args, reps=REPS, **kw):
+    # warmup/compile
+    out = fn(*args, **kw)
+    np.asarray(jax.tree_util.tree_leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args, **kw)
+    for leaf in jax.tree_util.tree_leaves(out):
+        np.asarray(leaf)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{label:35s} {dt * 1e3:9.2f} ms")
+    return out
+
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+print(f"# backend={jax.default_backend()} T={T} CAP={CAP} DCAP={DCAP}")
+
+from bench import gen_batch  # noqa: E402
+import bench as _bench  # noqa: E402
+
+_bench.TXNS_PER_BATCH = T
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet  # noqa: E402
+from foundationdb_tpu.ops.digest import searchsorted_left  # noqa: E402
+from foundationdb_tpu.ops.rangemax import build_sparse_table  # noqa: E402
+
+rng = np.random.default_rng(2026)
+cs = TpuConflictSet(0, capacity=CAP, delta_capacity=DCAP)
+
+batches = []
+version = 1_000
+for _ in range(4):
+    prev, version = version, version + 1_000
+    batches.append((version, *gen_batch(rng, version, prev)))
+
+# ---- host-side pack cost (numpy unique/searchsorted grouping) -------------
+v0, enc0, _k, _s = batches[0]
+t0 = time.perf_counter()
+for _ in range(REPS):
+    packed = cs._pack(enc0)
+print(f"{'host _pack (incl. grouping)':35s} "
+      f"{(time.perf_counter() - t0) / REPS * 1e3:9.2f} ms")
+
+# ---- h2d transfer of the packed blocks ------------------------------------
+dig = packed["digests"]
+meta = packed["meta"]
+
+
+def h2d(a, b):
+    return jax.device_put(a), jax.device_put(b)
+
+
+da, db = timed("h2d digests+meta "
+               f"({(dig.nbytes + meta.nbytes) / 1e6:.0f} MB)", h2d, dig, meta)
+
+# ---- full step + merge ----------------------------------------------------
+for v, enc, _k, _s in batches[:2]:
+    cs.resolve_encoded(enc, v, 0)     # compile both programs
+
+v, enc, _k, _s = batches[2]
+
+
+def full_step():
+    h = cs.resolve_encoded_async(enc, v + 50_000, 0)
+    return h.wait_codes()
+
+
+timed("full resolve step (steady delta)", full_step, reps=1)
+t0 = time.perf_counter()
+cs.merge()
+np.asarray(cs.bv)
+print(f"{'merge (overlay+GC+rebase+table)':35s} "
+      f"{(time.perf_counter() - t0) * 1e3:9.2f} ms")
+
+# ---- isolated pieces at the same shapes -----------------------------------
+r_cap = bucket(R)
+qb = jnp.asarray(dig[:, :r_cap])
+timed("searchsorted R queries into CAP",
+      jax.jit(lambda bk, q: searchsorted_left(bk, q)), cs.bk, qb)
+timed("searchsorted R queries into DCAP",
+      jax.jit(lambda dk, q: searchsorted_left(dk, q)), cs.dk, qb)
+timed("build_sparse_table(DCAP)",
+      jax.jit(build_sparse_table), cs.dv)
+
+cover = jnp.zeros((bucket(W) + 1,), jnp.int32)
+widx = jnp.asarray(np.arange(bucket(W)) % bucket(W), dtype=np.int32)
+wtxn = jnp.asarray(np.arange(bucket(W), dtype=np.int32))
+
+
+def fixpoint_round(c, wi, wt):
+    cv = c.at[wi].min(wt)
+    return cv[jnp.clip(wi, 0, bucket(W))]
+
+
+timed("one point fixpoint round", jax.jit(fixpoint_round), cover, widx, wtxn)
+
+out8 = jnp.zeros((bucket(T) + 12,), jnp.int8)
+t0 = time.perf_counter()
+for _ in range(REPS):
+    np.asarray(out8)
+print(f"{'d2h out int8[t_cap+12]':35s} "
+      f"{(time.perf_counter() - t0) / REPS * 1e3:9.2f} ms")

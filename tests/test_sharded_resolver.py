@@ -1,0 +1,148 @@
+"""The REAL resolve step sharded over an 8-virtual-device CPU mesh.
+
+VERDICT round-4 item 2 done-criteria: the full TpuConflictSet per-batch
+program (too-old, base+delta history query, intra-batch fixpoint, clipped
+insert, verdict codes) runs under shard_map with the history bits
+max-combined over mesh axis "kr", and its verdicts are bit-identical to
+the CPU oracle on randomized batches — point AND general ranges, across
+merges, floor advances, rebases, and overflow surfacing.
+
+Reference semantics: the proxy min-combining per-key-range resolver
+verdicts, CommitProxyServer.actor.cpp:800-806.
+"""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+from foundationdb_tpu.core import DeterministicRandom
+from foundationdb_tpu.parallel.sharded_resolver import ShardedTpuConflictSet
+from foundationdb_tpu.parallel.sharded_window import make_conflict_mesh
+from foundationdb_tpu.txn import CommitResult, CommitTransactionRef, KeyRange
+
+from test_conflict_oracle import make_domain, random_txn
+from test_conflict_tpu import random_point_txn
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_conflict_mesh(n_devices=8)   # kr=4, q=2
+
+
+def test_mesh_shape(mesh):
+    assert mesh.shape["kr"] >= 2, "need real key-range sharding to test"
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_sharded_matches_oracle_general(mesh, seed):
+    """Random GENERAL ranges (spanning shard splits) through the sharded
+    step vs the oracle; merges every 3 batches; floor advances+rebases."""
+    rng = DeterministicRandom(seed)
+    domain = make_domain()
+    oracle = OracleConflictSet(0)
+    cs = ShardedTpuConflictSet(mesh, 0, capacity=1 << 10,
+                               delta_capacity=1 << 9,
+                               gc_interval_batches=3)
+    now = 0
+    for _ in range(16):
+        now += rng.random_int(1, 2_000_000)
+        batch = [random_txn(rng, domain, now, 4_000_000)
+                 for _ in range(rng.random_int(1, 10))]
+        new_oldest = now - 5_000_000 if rng.coinflip() else None
+        got = cs.resolve(batch, now, new_oldest)
+        want = oracle.resolve(batch, now, new_oldest)
+        assert got == want, f"divergence at now={now}"
+    assert cs.version_base > 0          # a rebase actually happened
+    assert sum(cs.shard_sizes()) >= mesh.shape["kr"]
+
+
+@pytest.mark.parametrize("seed", [41, 42])
+def test_sharded_matches_oracle_points(mesh, seed):
+    """Hot point-key batches (deep intra-batch chains) through the sharded
+    sort-free path; every key owned by exactly one shard."""
+    rng = DeterministicRandom(seed)
+    oracle = OracleConflictSet(0)
+    cs = ShardedTpuConflictSet(mesh, 0, capacity=1 << 10,
+                               delta_capacity=1 << 9,
+                               gc_interval_batches=4)
+    now = 0
+    for _ in range(12):
+        now += rng.random_int(1, 2_000_000)
+        batch = [random_point_txn(rng, 12, now, 4_000_000)
+                 for _ in range(rng.random_int(1, 24))]
+        new_oldest = now - 5_000_000 if rng.coinflip() else None
+        got = cs.resolve(batch, now, new_oldest)
+        want = oracle.resolve(batch, now, new_oldest)
+        assert got == want, f"point divergence at now={now}"
+
+
+def test_sharded_matches_single_device(mesh):
+    """Shard count must be invisible: the sharded backend and the
+    single-device backend agree verdict-for-verdict on the same stream
+    (keys spread across the whole digest space so every shard owns some)."""
+    rng = DeterministicRandom(5)
+    single = TpuConflictSet(0, capacity=1 << 12)
+    sharded = ShardedTpuConflictSet(mesh, 0, capacity=1 << 10,
+                                    delta_capacity=1 << 9,
+                                    gc_interval_batches=3)
+    now = 0
+    for i in range(10):
+        now += 1_000_000
+        batch = []
+        for _ in range(8):
+            # Keys with random leading byte -> uniform across shards.
+            k = bytes([rng.random_int(0, 255)]) + b"k%04d" % rng.random_int(
+                0, 50)
+            tr = CommitTransactionRef(
+                read_snapshot=max(now - rng.random_int(0, 3_000_000), 0))
+            if rng.coinflip():
+                tr.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            tr.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            batch.append(tr)
+        new_oldest = now - 5_000_000
+        got = sharded.resolve(batch, now, new_oldest)
+        want = single.resolve(batch, now, new_oldest)
+        assert got == want, f"sharded != single at batch {i}"
+
+
+def test_sharded_ranges_straddle_splits(mesh):
+    """A write range spanning MULTIPLE shards' key ranges conflicts with
+    reads landing in each of them — the clipped insert must cover every
+    shard's portion, and the history combine must surface hits found on
+    any shard."""
+    cs = ShardedTpuConflictSet(mesh, 0, capacity=1 << 10,
+                               delta_capacity=1 << 9)
+    # [\x10, \xf0) spans all 4 shard splits (which are at lane-0 values
+    # 0x40/0x80/0xc0...).
+    w = CommitTransactionRef(
+        write_conflict_ranges=[KeyRange(b"\x10", b"\xf0")])
+    assert cs.resolve([w], 100) == [CommitResult.COMMITTED]
+    readers = []
+    for lead in (0x11, 0x55, 0x99, 0xdd):
+        readers.append(CommitTransactionRef(
+            read_snapshot=50,
+            read_conflict_ranges=[KeyRange(bytes([lead]),
+                                           bytes([lead]) + b"\x00")]))
+    # One reader entirely outside the written range commits.
+    readers.append(CommitTransactionRef(
+        read_snapshot=50,
+        read_conflict_ranges=[KeyRange(b"\xf5", b"\xf6")]))
+    got = cs.resolve(readers, 200)
+    assert got == [CommitResult.CONFLICT] * 4 + [CommitResult.COMMITTED]
+
+
+def test_sharded_overflow_flag_raises(mesh):
+    """Pinned floor + tiny per-shard capacity: the sticky overflow flag of
+    ANY shard must surface at wait() (flags pmax-combined)."""
+    cs = ShardedTpuConflictSet(mesh, 0, capacity=256, delta_capacity=256)
+    now = 0
+    with pytest.raises(Exception, match="capacity exceeded"):
+        for i in range(60):
+            now += 1_000
+            # All keys share a leading byte -> ONE shard takes every insert.
+            txns = [CommitTransactionRef(write_conflict_ranges=[
+                KeyRange(b"\x01%05d" % (i * 10 + j),
+                         b"\x01%05d\x00" % (i * 10 + j))])
+                for j in range(10)]
+            cs.resolve(txns, now)      # floor never advances
