@@ -174,6 +174,13 @@ def _force_cpu_backend() -> None:
         pass
 
 
+def _phase(msg: str) -> None:
+    """Timestamped stderr progress so a hung/slow child is diagnosable
+    from the parent's relayed tail (and from a streamed log)."""
+    print(f"# [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
 def child_main(backend: str) -> None:
     """The actual measurement (runs in a subprocess; see module doc)."""
     global TXNS_PER_BATCH, N_BATCHES, N_LATENCY, CAPACITY, DELTA_CAPACITY
@@ -202,12 +209,14 @@ def child_main(backend: str) -> None:
     rng = np.random.default_rng(2026)
     total = (N_WARMUP + N_PARITY if backend == "cpu"
              else N_WARMUP + N_BATCHES + N_LATENCY)
+    _phase(f"generating {total} batches of {TXNS_PER_BATCH} txns")
     batches = []
     version = 1_000
     for _ in range(total):
         prev = version
         version += VERSIONS_PER_BATCH
         batches.append((version, *gen_batch(rng, version, prev)))
+    _phase("batches generated")
 
     def floor(v):
         return max(v - window, 0)
@@ -240,9 +249,12 @@ def child_main(backend: str) -> None:
 
     # Warmup: compile the fused step + merge for this bucket shape (the
     # merge is forced here so its one-time compile can't land mid-measure).
-    for v, enc, kids, snaps in batches[:N_WARMUP]:
+    for i, (v, enc, kids, snaps) in enumerate(batches[:N_WARMUP]):
+        _phase(f"warmup batch {i} (first = step compile)")
         cs.resolve_encoded(enc, v, floor(v))
+    _phase("warmup merge (compile)")
     cs.merge()
+    _phase("measuring")
 
     # ---- main throughput phase (pipelined) --------------------------------
     from collections import deque
@@ -271,6 +283,7 @@ def child_main(backend: str) -> None:
         committed += int(np.sum(codes == committed_code))
     dt = time.perf_counter() - t0
     value = n_ranges / dt
+    _phase(f"throughput phase done: {value:.0f} ranges/s")
 
     # ---- p50 resolve latency (depth-1 dispatch -> wait) -------------------
     lats = []
@@ -279,6 +292,7 @@ def child_main(backend: str) -> None:
         cs.resolve_encoded_async(enc, v, floor(v)).wait_codes()
         lats.append(time.perf_counter() - t1)
     p50_ms = float(np.percentile(lats, 50) * 1e3)
+    _phase(f"latency phase done: p50={p50_ms:.1f}ms; oracle parity next")
 
     # ---- oracle on the same stream prefix: parity + relative throughput ---
     oracle = OracleConflictSet(0)
@@ -310,6 +324,7 @@ def child_main(backend: str) -> None:
     # ---- second regime: low contention, every batch parity-checked --------
     # (round-3 review: one heavily-contended regime is not enough; the
     # commit-heavy path exercises different insert/merge behavior.)
+    _phase("high-contention parity ok; low-contention regime next")
     lowc = []
     version = 1_000
     for _ in range(N_LOWC):

@@ -306,6 +306,11 @@ class Transaction:
             # attempt's conflicts (reference: conflicting-keys special
             # keys are populated for the attempt after the conflict).
             self._conflicting_keys: List[Tuple[bytes, bytes]] = []
+        # Throttling tag (reference TransactionOptions::tags /
+        # fdbclient/TagThrottle): carried on GRVs (proxy-side throttle
+        # enforcement) and on reads (storage busy-tag sampling).
+        if not hasattr(self, "tag"):
+            self.tag: str = ""
 
     def reset(self) -> None:
         self._conflicting_keys = []
@@ -318,7 +323,8 @@ class Transaction:
             proxy = self.db._grv_proxy()
             self._read_version = RequestStream.at(
                 proxy.get_consistent_read_version.endpoint).get_reply(
-                GetReadVersionRequest(priority=self.priority))
+                GetReadVersionRequest(priority=self.priority,
+                                      tags=(self.tag,) if self.tag else ()))
         return self._read_version
 
     GRV_TIMEOUT = 5.0
@@ -385,7 +391,8 @@ class Transaction:
         try:
             reply = await self.db.read_replica(
                 ssis, lambda s: s.get_value,
-                lambda: GetValueRequest(key=key, version=version))
+                lambda: GetValueRequest(key=key, version=version,
+                                        tag=self.tag))
         except FdbError as e:
             if e.name in ("broken_promise", "wrong_shard_server"):
                 self.db.invalidate_cache(key)
@@ -445,7 +452,8 @@ class Transaction:
         reply = await self.db.read_replica(
             ssis, lambda s: s.get_key_values,
             lambda: GetKeyValuesRequest(begin=cursor, end=shard_end,
-                                        version=version, limit=limit))
+                                        version=version, limit=limit,
+                                        tag=self.tag))
         if reply.more and reply.data:
             return reply.data, key_after(reply.data[-1][0])
         return reply.data, shard_end
@@ -463,7 +471,7 @@ class Transaction:
             ssis, lambda s: s.get_key_values,
             lambda: GetKeyValuesRequest(begin=shard_begin, end=cursor,
                                         version=version, limit=limit,
-                                        reverse=True))
+                                        reverse=True, tag=self.tag))
         if reply.more and reply.data:
             return reply.data, reply.data[-1][0]   # inclusive smallest key
         return reply.data, shard_begin

@@ -145,7 +145,12 @@ class TpuConflictSet(ConflictSet):
         dst = self._fused.make_delta_state(self.d_cap)
         self.dk, self.dv, self.dsize = dst.bk, dst.bv, dst.size
         self.flag = self._jnp.int32(0)
-        self._live_boundaries = 1
+        self._reset_bookkeeping(live_boundaries=1)
+
+    def _reset_bookkeeping(self, live_boundaries: int) -> None:
+        """Merge-scheduling/accounting reset shared with the sharded
+        backend's _reset_state."""
+        self._live_boundaries = live_boundaries
         self._batches_since_merge = 0
         # Sound upper bound on delta occupancy (insert adds <= 2W+0 net new
         # boundaries per batch); drives proactive merge scheduling so the
@@ -295,7 +300,6 @@ class TpuConflictSet(ConflictSet):
 
     def _dispatch(self, enc, now: Version, oldest_floor: Version,
                   n_txns: int) -> ResolveHandle:
-        jnp = self._jnp
         t_cap, r_cap, w_cap = enc["caps"]
         need = 2 * enc["nw"] + 2
         if (self._delta_bound + need > self.d_cap
@@ -324,6 +328,18 @@ class TpuConflictSet(ConflictSet):
         sc = enc["scalar_off"]
         meta[sc:sc + 2] = (self._rel(now), self._rel(oldest_floor))
 
+        out = self._invoke_step(enc, meta)
+        handle = ResolveHandle(self, out, n_txns, t_cap)
+        self._inflight.append(handle)
+        return handle
+
+    def _invoke_step(self, enc, meta):
+        """Build + run the device program for this batch shape; the ONLY
+        part of _dispatch that differs per backend (ShardedTpuConflictSet
+        overrides it with the shard_map'd step) — the delta budgeting,
+        version-offset guard, and merge scheduling above stay shared."""
+        jnp = self._jnp
+        t_cap, r_cap, w_cap = enc["caps"]
         step = self._fused.make_resolve_step(
             self.capacity, self.d_cap, t_cap, r_cap, w_cap,
             enc["all_point"])
@@ -331,9 +347,7 @@ class TpuConflictSet(ConflictSet):
             self.bk, self.bv, self.table, self.size,
             self.dk, self.dv, self.dsize, self.flag,
             jnp.asarray(enc["digests"]), jnp.asarray(meta))
-        handle = ResolveHandle(self, out, n_txns, t_cap)
-        self._inflight.append(handle)
-        return handle
+        return out
 
     # -- public API ---------------------------------------------------------
     def resolve_encoded_async(self, batch: EncodedBatch, now: Version,

@@ -82,11 +82,27 @@ class ServerKnobs(KnobBase):
         # Data distribution (reference DD_SHARD_SIZE_GRANULARITY etc.)
         self.DD_SHARD_SPLIT_BYTES = 1 << 20   # split a shard above this
         self.DD_METRICS_INTERVAL = 0.5        # shard-size poll cadence
+        # Merge adjacent same-team shards whose COMBINED size is below
+        # this (reference DataDistributionTracker shardMerger; kept well
+        # under the split threshold to avoid split/merge ping-pong).
+        self.DD_SHARD_MERGE_BYTES = (1 << 20) // 4
 
         # GRV / ratekeeper
         self.START_TRANSACTION_BATCH_INTERVAL_MIN = 1e-6
         self.START_TRANSACTION_BATCH_INTERVAL_MAX = 0.010
         self.START_TRANSACTION_MAX_BUDGET_SIZE = 20
+        # Ratekeeper smoothing half-life (reference SMOOTHING_AMOUNT /
+        # smoothReleasedTransactions in Ratekeeper.actor.cpp).
+        self.RK_SMOOTHING_HALF_LIFE = 1.0
+        # Per-tag auto-throttle (reference TagThrottle / busy-read
+        # detection, Ratekeeper.actor.cpp updateRate + StorageServer
+        # busiest-tag sampling): a storage server whose read rate exceeds
+        # BUSY fraction of its saturation with one tag responsible for
+        # >= MIN_TAG_FRACTION of reads gets that tag throttled.
+        self.SS_READ_SATURATION_OPS = 20000.0
+        self.AUTO_THROTTLE_BUSY_FRACTION = 0.8
+        self.AUTO_THROTTLE_MIN_TAG_FRACTION = 0.5
+        self.AUTO_TAG_THROTTLE_DURATION = 5.0
 
         # Storage
         self.STORAGE_DURABILITY_LAG_SOFT_MAX = 250e6
@@ -95,6 +111,13 @@ class ServerKnobs(KnobBase):
 
         # TLog
         self.TLOG_SPILL_THRESHOLD = 1500e6
+        # Resident TLog bytes target for the ratekeeper spring (reference
+        # TARGET_BYTES_PER_TLOG = 2.4GB vs TLOG_SPILL_THRESHOLD = 1.5GB):
+        # sits ABOVE the spill threshold — spilling is the first relief
+        # valve (a lagging peeker never throttles the cluster); the rate
+        # springs down only when memory grows past what spilling can
+        # evict (nothing durable yet => fsync-bound overload).
+        self.TLOG_LIMIT_BYTES = 2400e6
         # Byte budget per TLogPeekReply (reference DESIRED_TOTAL_BYTES in
         # tLogPeekMessages): a lagging puller's catch-up peek pages through
         # the spilled backlog instead of materializing all of it at once.

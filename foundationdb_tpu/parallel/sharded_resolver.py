@@ -105,13 +105,7 @@ class ShardedTpuConflictSet(TpuConflictSet):
         bounds[:, :, 1] = splits[1:]
         self.bounds = self._put(bounds)
         self._firsts = self._put(splits[:d].copy())   # [D, 6]
-        self._live_boundaries = d
-        self._batches_since_merge = 0
-        self._delta_bound = 1
-        self._delta_epoch = getattr(self, "_delta_epoch", 0) + 1
-        self._seq = getattr(self, "_seq", 0)
-        self._corrected_seq = getattr(self, "_corrected_seq", 0)
-        self._needs = {}
+        self._reset_bookkeeping(live_boundaries=d)
         self._jnp = jnp
 
     def _grow_delta(self, needed: int) -> None:
@@ -199,46 +193,20 @@ class ShardedTpuConflictSet(TpuConflictSet):
         self._delta_epoch += 1
         self._needs.clear()
 
-    def _dispatch(self, enc, now, oldest_floor, n_txns):
+    def _invoke_step(self, enc, meta):
+        """Shard-map'd step over the mesh; the shared _dispatch keeps the
+        delta budgeting (worst case every write lands on ONE shard, so
+        the per-shard budget uses the same global bound — merges at least
+        as often as the single-device backend), the _REL_LIMIT guard, and
+        merge scheduling."""
         jnp = self._jnp
         t_cap, r_cap, w_cap = enc["caps"]
-        # Worst case every write lands on ONE shard, so the per-shard
-        # delta budget uses the same global bound as the single-device
-        # backend (conservative: merges at least as often).
-        need = 2 * enc["nw"] + 2
-        if (self._delta_bound + need > self.d_cap
-                or self._batches_since_merge >= self._gc_interval
-                or now - self.version_base >= (1 << 30)):
-            self.merge()
-        if need > self.d_cap:
-            self._grow_delta(need)
-        self._delta_bound += need
-        self._seq += 1
-        self._needs[self._seq] = need
-        self._batches_since_merge += 1
-
-        meta = enc["meta"]
-        so = enc["snap_off"]
-        off = np.clip(enc["t_snap_abs"] - self.version_base,
-                      -(1 << 31) + 2, None)
-        if off.size and off.max() >= self._REL_LIMIT:
-            from ..core.error import err
-            raise err("internal_error",
-                      "version offset exceeds int32 window; "
-                      "advance new_oldest_version to allow rebasing")
-        meta[so:so + n_txns] = off.astype(np.int32)
-        sc = enc["scalar_off"]
-        meta[sc:sc + 2] = (self._rel(now), self._rel(oldest_floor))
-
         step = self._sharded_step(t_cap, r_cap, w_cap, enc["all_point"])
         self.dk, self.dv, self.dsize, self.flag, out = step(
             self.bk, self.bv, self.table, self.size,
             self.dk, self.dv, self.dsize, self.flag,
             jnp.asarray(enc["digests"]), jnp.asarray(meta), self.bounds)
-        from ..conflict.tpu_backend import ResolveHandle
-        handle = ResolveHandle(self, out, n_txns, t_cap)
-        self._inflight.append(handle)
-        return handle
+        return out
 
     # -- introspection ------------------------------------------------------
     def shard_sizes(self) -> List[int]:

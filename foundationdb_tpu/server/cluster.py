@@ -226,6 +226,27 @@ class SimFdbCluster:
             worker.run(leader_var)
             self.workers.append((p, worker, cc, leader_var))
 
+    def add_worker(self, pclass: str = "stateless",
+                   name: Optional[str] = None):
+        """Register one more worker process mid-run (used by placement
+        tests: a better-class worker joining should trigger
+        betterMasterExists re-recruitment)."""
+        from ..core.futures import AsyncVar
+        from .coordination import monitor_leader
+        from .worker import Worker
+        i = len(self.workers)
+        name = name or f"worker{i}"
+        p = self.sim.new_process(name=name, machineid=f"mach.{name}",
+                                 process_class=pclass)
+        leader_var = AsyncVar(None)
+        p.spawn(monitor_leader(self.coordinator_clients, leader_var),
+                f"{name}.monitorLeader")
+        worker = Worker(p, self.coordinator_clients,
+                        process_class=pclass, config=self.config)
+        worker.run(leader_var)
+        self.workers.append((p, worker, None, leader_var))
+        return p, worker
+
     def power_fail_reboot(self) -> None:
         """Whole-cluster unclean power loss + restart (reference
         tests/restarting/ SaveAndKill + second-binary restart): un-synced

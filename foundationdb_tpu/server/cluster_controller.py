@@ -189,12 +189,66 @@ class ClusterController:
             await wait_any([p.get_future(), delay(0.25)])
 
     def _pick_master_worker(self) -> WorkerInterface:
-        # Prefer stateless-class workers; deterministic order by id.
-        items = sorted(self.workers.items())
-        for wid, reg in items:
-            if reg.process_class in ("stateless", "master"):
-                return reg.worker
-        return items[0][1].worker
+        """Best-fitness worker for the master role (reference
+        clusterRecruitFromConfiguration placement fitness); deterministic
+        tiebreak by id."""
+        from .interfaces import FITNESS_NEVER, role_fitness
+        ranked = sorted(
+            (reg for reg in self.workers.values()
+             if role_fitness(reg.process_class, "master") < FITNESS_NEVER),
+            key=lambda reg: (role_fitness(reg.process_class, "master"),
+                             reg.worker.id))
+        if not ranked:
+            return sorted(self.workers.items())[0][1].worker
+        return ranked[0].worker
+
+    async def _better_master_exists(self, master_wid: str) -> None:
+        """Returns when a STRICTLY better master placement has appeared
+        and stayed for a settle period (reference betterMasterExists
+        ClusterController.actor.cpp:2214) — the caller then starts a new
+        recovery on the better worker.  Only fires once the cluster is
+        serving commits: re-election mid-recovery would thrash."""
+        from .interfaces import FITNESS_WORST, role_fitness
+
+        def improvement() -> bool:
+            if self.db_info.recovery_state not in ("accepting_commits",
+                                                   "fully_recovered"):
+                return False
+            cur = self.workers.get(master_wid)
+            # A master on a dead/deregistered worker is caught by its
+            # failure monitor, not here.
+            if cur is None:
+                return False
+            cur_fit = role_fitness(cur.process_class, "master")
+            best = min((role_fitness(reg.process_class, "master")
+                        for reg in self.workers.values()),
+                       default=cur_fit)
+            return best < cur_fit
+
+        from ..core.futures import wait_any
+        while True:
+            # Wake on registrations AND on a coarse poll: the candidate
+            # may have registered mid-recovery, before improvement() could
+            # be true.
+            p: Promise = Promise()
+            self._worker_arrived.append(p)
+            await wait_any([p.get_future(), delay(2.0)])
+            if not p.is_set():
+                # Poll-branch wake: retire our waiter or the list grows
+                # one entry per poll for the life of the epoch.
+                try:
+                    self._worker_arrived.remove(p)
+                except ValueError:
+                    pass
+            if not improvement():
+                continue
+            # Debounce: the better worker must still be better after a
+            # settle window (a flapping process must not thrash epochs).
+            await delay(1.0)
+            if improvement():
+                TraceEvent("CCBetterMasterExists").detail(
+                    "CurrentWorker", master_wid).log()
+                return
 
     async def _cluster_watch_database(self) -> None:
         from .coordination import CoordinatedState
@@ -218,10 +272,26 @@ class ClusterController:
                     InitializeMasterRequest(epoch=epoch,
                                             cc=self.interface))
                 # Wait for the master to die (recovery failure or process
-                # death) — then recruit a replacement.
-                await RequestStream.at(
+                # death) OR for a strictly better placement to appear
+                # (betterMasterExists) — then recruit a replacement; the
+                # new epoch's cstate lock fences the old master.
+                from ..core.futures import wait_any
+                failure_f = RequestStream.at(
                     miface.wait_failure.endpoint).get_reply(
                     WaitFailureRequest())
+                better_f = self._spawn(
+                    self._better_master_exists(worker.id),
+                    f"{self.id}.betterMaster")
+                try:
+                    idx, _ = await wait_any([failure_f, better_f])
+                finally:
+                    for f in (failure_f, better_f):
+                        if not f.is_ready():
+                            f.cancel()
+                if idx == 1:
+                    TraceEvent("CCReRecruitMaster").detail(
+                        "Epoch", epoch).log()
+                    continue
             except FdbError as e:
                 TraceEvent("CCMasterDied", Severity.Warn).detail(
                     "Error", e.name).detail("Message", str(e)).log()

@@ -66,6 +66,14 @@ class BoundaryMap:
             self._bounds.insert(i, key)
             self._teams.insert(i, team)
 
+    def remove_boundary(self, key: bytes) -> None:
+        """Merge the shard starting at `key` into its predecessor (the
+        DD-side effect of clearing the keyServers boundary)."""
+        i = self._bisect.bisect_left(self._bounds, key)
+        if 0 < i < len(self._bounds) and self._bounds[i] == key:
+            del self._bounds[i]
+            del self._teams[i]
+
     def lookup(self, key: bytes):
         return self._teams[self._bisect.bisect_right(self._bounds, key) - 1]
 
@@ -139,20 +147,32 @@ class DataDistributor:
         # reference's byte sampling makes metrics O(changes); this bounds
         # our exact-scan fallback).
         self._poll_backoff: Dict[bytes, List[int]] = {}
+        # begin -> last measured shard bytes (split-sweep cache): merge
+        # candidates are pre-filtered on these so the merge pass doesn't
+        # re-poll every cold pair each sweep (that would undo the poll
+        # backoff's load reduction).
+        self._shard_sizes: Dict[bytes, int] = {}
         self.stats = {"splits": 0, "moves": 0, "rereplications": 0}
 
     # -- metadata transactions ----------------------------------------------
     async def _commit_boundaries(self, sets) -> int:
         """One serializable txn writing keyServers boundaries; retried.
-        Returns the commit version (the MoveKeys phase-1 version: sources
-        must serve fetch snapshots at or above it, or writes routed only
-        to the old team in (snapshot, phase1] would be lost)."""
+        A team of None CLEARS the boundary (shard merge: the span is
+        absorbed by the preceding shard — apply_key_servers_mutation's
+        ClearRange path).  Returns the commit version (the MoveKeys
+        phase-1 version: sources must serve fetch snapshots at or above
+        it, or writes routed only to the old team in (snapshot, phase1]
+        would be lost)."""
         t = self.db.create_transaction()
         t.access_system_keys = True
         while True:
             try:
                 for boundary, team in sets:
-                    t.set(key_servers_key(boundary), key_servers_value(team))
+                    if team is None:
+                        t.clear(key_servers_key(boundary))
+                    else:
+                        t.set(key_servers_key(boundary),
+                              key_servers_value(team))
                 return await t.commit()
             except FdbError as e:
                 await t.on_error(e)
@@ -484,6 +504,7 @@ class DataDistributor:
                         split_threshold=int(knobs.DD_SHARD_SPLIT_BYTES)))
                 except FdbError:
                     continue
+                self._shard_sizes[begin] = total
                 if total < int(knobs.DD_SHARD_SPLIT_BYTES) // 2:
                     # Cold shard: double its poll backoff (cap 32 sweeps).
                     b = self._poll_backoff.setdefault(begin, [1, 0])
@@ -507,6 +528,80 @@ class DataDistributor:
                     self.stats["splits"] += 1
                     TraceEvent("DDShardSplit").detail(
                         "At", split_key).detail("Bytes", total).log()
+            await self._merge_pass()
+
+    async def _shard_bytes(self, begin: bytes, end: bytes,
+                           team) -> Optional[int]:
+        holder = next((t for t in team or () if t in self.healthy), None)
+        if holder is None:
+            return None
+        try:
+            total, _sk = await RequestStream.at(
+                self.storage[holder].shard_metrics.endpoint).get_reply(
+                GetShardMetricsRequest(
+                    begin=begin, end=end,
+                    split_threshold=1 << 62))   # no split key needed
+            return total
+        except FdbError:
+            return None
+
+    async def _merge_pass(self) -> None:
+        """Merge adjacent same-team shards whose combined size dropped
+        below DD_SHARD_MERGE_BYTES (reference
+        DataDistributionTracker.actor.cpp shardMerger): without this,
+        clear-heavy workloads grow the boundary map forever.  A merge is
+        a pure metadata CLEAR of the right shard's boundary key — the
+        span is absorbed by the left shard (apply_key_servers_mutation).
+        Sizes are re-measured under the relocation lock so a racing
+        move/split can't be merged over."""
+        knobs = server_knobs()
+        merge_limit = int(knobs.DD_SHARD_MERGE_BYTES)
+        if self.moves_in_flight or self.halted:
+            return
+        ranges = list(self.map.ranges())
+        i = 0
+        while i < len(ranges) - 1:
+            b1, e1, t1 = ranges[i]
+            b2, e2, t2 = ranges[i + 1]
+            if e1 != b2 or not t1 or not t2 or list(t1) != list(t2):
+                i += 1
+                continue
+            # Pre-filter on the split sweep's cached sizes: only pairs the
+            # last measurements say are small get the under-lock
+            # re-measure; unknown sizes wait for their next sweep poll.
+            c1 = self._shard_sizes.get(b1)
+            c2 = self._shard_sizes.get(b2)
+            if c1 is None or c2 is None or c1 + c2 >= merge_limit:
+                i += 1
+                continue
+            merged = False
+            async with self._relocation_lock:
+                if (self.halted or self.map.lookup(b1) != t1 or
+                        self.map.shard_end(b1) != b2 or
+                        self.map.lookup(b2) != t2 or
+                        self.map.shard_end(b2) != e2):
+                    i += 1
+                    continue
+                left = await self._shard_bytes(b1, b2, t1)
+                right = await self._shard_bytes(b2, e2, t2)
+                if left is not None and right is not None and \
+                        left + right < merge_limit:
+                    await self._commit_boundaries([(b2, None)])
+                    self.map.remove_boundary(b2)
+                    self._poll_backoff.pop(b2, None)
+                    self._shard_sizes.pop(b2, None)
+                    self._shard_sizes[b1] = left + right
+                    self.stats["merges"] = self.stats.get("merges", 0) + 1
+                    TraceEvent("DDShardMerge").detail("At", b2).detail(
+                        "Bytes", left + right).log()
+                    merged = True
+            if merged:
+                # The left shard grew to [b1, e2): keep absorbing
+                # neighbours from the same position.
+                ranges[i] = (b1, e2, t1)
+                del ranges[i + 1]
+            else:
+                i += 1
 
     async def _drain_excluded(self) -> None:
         """Move every shard off excluded servers (reference: exclusion is

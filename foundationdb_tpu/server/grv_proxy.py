@@ -34,6 +34,13 @@ class GrvProxy:
         self.ratekeeper = ratekeeper    # RatekeeperInterface (optional)
         self._rate = float("inf")       # tps budget from the ratekeeper
         self._batch_rate = float("inf")  # batch-priority budget (<= _rate)
+        # Per-tag throttles from the ratekeeper (reference proxy-side
+        # tag throttle enforcement): tag -> tps ceiling, token budget,
+        # and a held-request queue per throttled tag.
+        self._tag_rates: dict = {}
+        self._tag_budgets: dict = {}
+        self._tag_deferred: dict = {}
+        self.tag_released: dict = {}    # tag -> total released (to RK)
         self.interface = GrvProxyInterface(proxy_id)
         # Priority queues: immediate > default > batch (reference
         # SystemTransactionQueue/DefaultQueue/BatchQueue).
@@ -72,15 +79,46 @@ class GrvProxy:
         q = self.queues[TransactionPriority.IMMEDIATE]
         while q:
             out.append(q.pop(0))
+
+        def tag_blocked(req) -> bool:
+            """A throttled tag with an exhausted token bucket holds the
+            request in a per-tag side queue (reference: tagged GRVs wait
+            out their throttle at the proxy, not in the main queue, so
+            untagged traffic flows past them)."""
+            for tag in getattr(req, "tags", ()) or ():
+                if tag in self._tag_rates and \
+                        self._tag_budgets.get(tag, 0.0) <= 0.0:
+                    self._tag_deferred.setdefault(tag, []).append(req)
+                    return True
+            return False
+
+        def charge_tags(req) -> None:
+            # Only THROTTLED tags are tracked/reported: tags are arbitrary
+            # client strings, so unconditional accounting would grow
+            # per-tag state (and every rate-info payload) without bound.
+            for tag in getattr(req, "tags", ()) or ():
+                if tag not in self._tag_rates:
+                    continue
+                self.tag_released[tag] = self.tag_released.get(tag, 0) + \
+                    req.transaction_count
+                if tag in self._tag_budgets:
+                    self._tag_budgets[tag] -= req.transaction_count
+
         q = self.queues[TransactionPriority.DEFAULT]
         while q and budget - charged > 0:
             req = q.pop(0)
+            if tag_blocked(req):
+                continue
+            charge_tags(req)
             out.append(req)
             charged += req.transaction_count
         q = self.queues[TransactionPriority.BATCH]
         while q and budget - charged > 0 and \
                 batch_budget - batch_charged > 0:
             req = q.pop(0)
+            if tag_blocked(req):
+                continue
+            charge_tags(req)
             out.append(req)
             charged += req.transaction_count
             batch_charged += req.transaction_count
@@ -91,11 +129,15 @@ class GrvProxy:
         knobs = server_knobs()
         last = now()
         while True:
-            if not any(self.queues):
+            have_deferred = any(self._tag_deferred.values())
+            if not any(self.queues) and not have_deferred:
                 # Sleep until a request arrives (no virtual-time polling).
                 self._wakeup = Promise()
                 await self._wakeup.get_future()
-            await delay(knobs.START_TRANSACTION_BATCH_INTERVAL_MIN)
+            # Tag-deferred requests wait on token accrual, not on new
+            # arrivals: poll at a coarse interval instead of parking.
+            await delay(0.05 if have_deferred and not any(self.queues)
+                        else knobs.START_TRANSACTION_BATCH_INTERVAL_MIN)
             # Token bucket: accrue budget at the ratekeeper's tps, capped
             # at one lease's worth (reference transactionStarter :702).
             t = now()
@@ -111,6 +153,22 @@ class GrvProxy:
                     self._batch_rate)
             else:
                 self.batch_budget = float("inf")
+            # Per-tag token buckets accrue at the throttle tps, capped at
+            # one second's worth; deferred holders re-enter their priority
+            # queue once their tag has budget again.
+            for tag, rate in self._tag_rates.items():
+                self._tag_budgets[tag] = min(
+                    self._tag_budgets.get(tag, 0.0) + rate * (t - last),
+                    max(rate, 1.0))
+            for tag, held in list(self._tag_deferred.items()):
+                if held and (tag not in self._tag_rates or
+                             self._tag_budgets.get(tag, 0.0) > 0.0):
+                    for req in reversed(held):
+                        pri = min(max(req.priority,
+                                      TransactionPriority.BATCH),
+                                  TransactionPriority.IMMEDIATE)
+                        self.queues[pri].insert(0, req)
+                    held.clear()
             last = t
             batch, charged, batch_charged = self._drain(
                 self.transaction_budget, self.batch_budget)
@@ -138,9 +196,29 @@ class GrvProxy:
                 reply = await RequestStream.at(
                     self.ratekeeper.get_rate_info.endpoint).get_reply(
                     GetRateInfoRequest(proxy_id=self.id,
-                                       total_released=self.stats["grvs"]))
+                                       total_released=self.stats["grvs"],
+                                       tag_released=dict(self.tag_released)))
                 self._rate = reply.tps
                 self._batch_rate = min(reply.batch_tps, reply.tps)
+                new_tags = reply.tag_throttles or {}
+                for tag in new_tags:
+                    if tag not in self._tag_rates:
+                        # Fresh throttle starts with an empty bucket.
+                        self._tag_budgets.setdefault(tag, 0.0)
+                for tag in list(self._tag_budgets):
+                    if tag not in new_tags:
+                        del self._tag_budgets[tag]
+                # Expired throttles drop ALL their per-tag state (tags are
+                # unbounded client strings; kept entries would accrete for
+                # the proxy's lifetime).  Deferred holders re-enter the
+                # main queues via the starter's re-injection pass.
+                for tag in list(self.tag_released):
+                    if tag not in new_tags:
+                        del self.tag_released[tag]
+                for tag in list(self._tag_deferred):
+                    if tag not in new_tags and not self._tag_deferred[tag]:
+                        del self._tag_deferred[tag]
+                self._tag_rates = new_tags
                 wait = reply.lease_duration / 2
             except FdbError:
                 wait = 0.5
@@ -176,9 +254,11 @@ class GrvProxy:
         self.stats["grvs"] += len(batch)
         self.metrics.counter("TxnStarted").add(len(batch))
         self.metrics.histogram("GRVLatency").record(now() - _t0)
+        throttles = dict(self._tag_rates) if self._tag_rates else None
         for req in batch:
             req.reply.send(GetReadVersionReply(version=vreply.version,
-                                               locked=vreply.locked))
+                                               locked=vreply.locked,
+                                               tag_throttles=throttles))
 
     def run(self, process) -> None:
         self._process = process

@@ -299,6 +299,10 @@ class GetReadVersionRequest:
     transaction_count: int = 1
     flags: int = 0
     debug_id: str = ""
+    # Transaction throttling tags (reference GetReadVersionRequest.tags /
+    # fdbclient/TagThrottle.actor.cpp): auto-throttled hot tags are held
+    # at the GRV proxy under a per-tag budget.
+    tags: tuple = ()
     reply: Any = None
 
     FLAG_CAUSAL_READ_RISKY = 1
@@ -309,6 +313,9 @@ class GetReadVersionRequest:
 class GetReadVersionReply:
     version: Version
     locked: bool = False
+    # tag -> tps ceiling currently enforced (reference
+    # GetReadVersionReply.tagThrottleInfo, so clients can back off).
+    tag_throttles: Any = None
 
 
 class GrvProxyInterface:
@@ -390,12 +397,14 @@ class TLogInterface:
         self.confirm_running = RequestStream(
             "tlog.confirmRunning", TaskPriority.TLogConfirmRunning)
         self.lock = RequestStream("tlog.lock", TaskPriority.TLogCommit)
+        self.queuing_metrics = RequestStream(
+            "tlog.queuingMetrics", TaskPriority.DefaultEndpoint)
         self.wait_failure = RequestStream("tlog.waitFailure",
                                           TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
         return [self.commit, self.peek, self.pop, self.confirm_running,
-                self.lock, self.wait_failure]
+                self.lock, self.queuing_metrics, self.wait_failure]
 
 
 # ---------------------------------------------------------------------------
@@ -407,6 +416,9 @@ class GetValueRequest:
     key: bytes
     version: Version
     debug_id: str = ""
+    # Throttling tag of the issuing transaction (reference
+    # StorageServerInterface tenant/tag info for busy-read sampling).
+    tag: str = ""
     reply: Any = None
 
 
@@ -424,6 +436,7 @@ class GetKeyValuesRequest:
     limit: int = 1000
     limit_bytes: int = 1 << 20
     reverse: bool = False
+    tag: str = ""
     reply: Any = None
 
 
@@ -514,6 +527,43 @@ class WorkerRegistration:
     storage_versions: Dict[int, int] = field(default_factory=dict)
 
 
+# -- placement fitness (reference flow/ProcessClass machineClassFitness +
+# ClusterController.actor.cpp:3576 clusterRecruitFromConfiguration) ----------
+# Lower is better.  BEST=0 (dedicated class), GOOD=1, UNSET=2, OKAY=3,
+# WORST=4 (usable only when nothing better registered), NEVER=9 (never
+# place this role here).
+FITNESS_BEST, FITNESS_GOOD, FITNESS_UNSET = 0, 1, 2
+FITNESS_OKAY, FITNESS_WORST, FITNESS_NEVER = 3, 4, 9
+
+_ROLE_FITNESS: Dict[str, Dict[str, int]] = {
+    # Transaction-system lead.
+    "master": {"master": FITNESS_BEST, "stateless": FITNESS_GOOD,
+               "unset": FITNESS_UNSET, "log": FITNESS_OKAY,
+               "transaction": FITNESS_OKAY, "storage": FITNESS_WORST,
+               "coordinator": FITNESS_NEVER, "tester": FITNESS_NEVER},
+    # Proxies / GRV proxies / resolvers / ratekeeper / DD.
+    "stateless": {"stateless": FITNESS_BEST, "master": FITNESS_GOOD,
+                  "unset": FITNESS_UNSET, "log": FITNESS_OKAY,
+                  "transaction": FITNESS_OKAY, "storage": FITNESS_WORST,
+                  "coordinator": FITNESS_NEVER, "tester": FITNESS_NEVER},
+    # TLogs (reference TLogFit: transaction class is the dedicated one).
+    "log": {"log": FITNESS_BEST, "transaction": FITNESS_BEST,
+            "stateless": FITNESS_GOOD, "unset": FITNESS_UNSET,
+            "master": FITNESS_OKAY, "storage": FITNESS_WORST,
+            "coordinator": FITNESS_NEVER, "tester": FITNESS_NEVER},
+    "storage": {"storage": FITNESS_BEST, "unset": FITNESS_UNSET,
+                "log": FITNESS_OKAY, "transaction": FITNESS_OKAY,
+                "stateless": FITNESS_WORST, "master": FITNESS_WORST,
+                "coordinator": FITNESS_NEVER, "tester": FITNESS_NEVER},
+}
+
+
+def role_fitness(process_class: str, role: str) -> int:
+    """Placement rank of a worker class for a role; lower is better."""
+    table = _ROLE_FITNESS.get(role) or _ROLE_FITNESS["stateless"]
+    return table.get(process_class, FITNESS_UNSET)
+
+
 @dataclass
 class GetWorkersRequest:
     reply: Any = None
@@ -575,6 +625,7 @@ class InitializeGrvProxyRequest:
 class InitializeRatekeeperRequest:
     rk_id: str
     storage_interfaces: Dict[Tag, Any] = field(default_factory=dict)
+    tlog_interfaces: List[Any] = field(default_factory=list)
     reply: Any = None     # -> RatekeeperInterface
 
 

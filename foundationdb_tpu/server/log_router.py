@@ -224,44 +224,70 @@ async def remote_tlog_feeder(tlog, router_log_system: Any,
             messages=messages, reply=p))
         await p.get_future()
 
-    while not tlog.stopped:
-        progressed = False
-        for t in tags:
-            try:
-                reply = await router_log_system.peek_tag(t, cursors[t])
-            except FdbError:
-                await delay(0.5)
-                continue
-            for v, msgs in reply.messages:
-                if v >= cursors[t]:
-                    staged.setdefault(v, {})[t] = msgs
-            if reply.end > cursors[t]:
-                progressed = True
-            cursors[t] = max(reply.end, cursors[t])
-            frontiers[t] = max(frontiers[t], reply.max_known_version)
-        lim = min(frontiers.values())
-        committed_any = False
-        for v in sorted(vv for vv in staged if vv <= lim):
+    # One OUTSTANDING peek per tag, processed as each completes: a router
+    # peek PARKS until that tag's frontier reaches the cursor, so awaiting
+    # tags sequentially would let one idle tag starve the others forever
+    # (the staged versions behind it would never commit).
+    from ..core.futures import wait_any
+    from ..core.scheduler import spawn as _spawn
+
+    async def _peek_wrapped(t: Tag, begin: Version):
+        try:
+            return await router_log_system.peek_tag(t, begin)
+        except FdbError:
+            await delay(0.5)           # router epoch mid-recovery
+            return None
+
+    pending: Dict[Tag, Any] = {}
+    try:
+        while not tlog.stopped:
+            for t in tags:
+                if t not in pending:
+                    pending[t] = _spawn(_peek_wrapped(t, cursors[t]),
+                                        f"{tlog.id}.feedPeek{t}")
+            await wait_any(list(pending.values()) +
+                           [tlog._stop_promise.get_future()])
             if tlog.stopped:
                 return
-            if v > tlog.version.get():
-                await _commit(v, staged[v])
+            for t in list(pending):
+                f = pending[t]
+                if not f.is_ready():
+                    continue
+                del pending[t]
+                reply = f.get()
+                if reply is None:
+                    continue               # errored peek; reissued above
+                for v, msgs in reply.messages:
+                    if v >= cursors[t]:
+                        staged.setdefault(v, {})[t] = msgs
+                cursors[t] = max(reply.end, cursors[t])
+                frontiers[t] = max(frontiers[t], reply.max_known_version)
+            lim = min(frontiers.values())
+            committed_any = False
+            for v in sorted(vv for vv in staged if vv <= lim):
+                if tlog.stopped:
+                    return
+                if v > tlog.version.get():
+                    await _commit(v, staged[v])
+                    committed_any = True
+                del staged[v]
+            if lim > tlog.version.get() and not tlog.stopped:
+                # Advance through trailing EMPTY versions so peeks/locks
+                # see the full contiguous frontier.
+                await _commit(lim, {})
                 committed_any = True
-            del staged[v]
-        if lim > tlog.version.get() and not tlog.stopped:
-            # Advance through trailing EMPTY versions so peeks/locks see
-            # the full contiguous frontier.
-            await _commit(lim, {})
-            committed_any = True
-        if committed_any:
-            # Only durable data may be popped off the routers (and
-            # transitively off the primary): wait for the fsync frontier.
-            durable = tlog.durable_version.get()
-            target = min(tlog.version.get(), lim)
-            if durable < target:
-                await tlog.durable_version.when_at_least(target)
-            for t in tags:
-                router_log_system.pop(t, min(cursors[t] - 1, target))
-        if not progressed:
-            await delay(0.05)
+            if committed_any:
+                # Only durable data may be popped off the routers (and
+                # transitively off the primary): wait for the fsync
+                # frontier.
+                durable = tlog.durable_version.get()
+                target = min(tlog.version.get(), lim)
+                if durable < target:
+                    await tlog.durable_version.when_at_least(target)
+                for t in tags:
+                    router_log_system.pop(t, min(cursors[t] - 1, target))
+    finally:
+        for f in pending.values():
+            if not f.is_ready():
+                f.cancel()
     TraceEvent("RemoteTLogFeederStopped").detail("Id", tlog.id).log()

@@ -570,25 +570,47 @@ async def master_server(master: Master, process, coordinators,
         TraceEvent("MasterRecoveryState").detail(
             "State", "recruiting").detail(
             "RecoveryVersion", recovery_version).log()
-        # Placement pools by process class (reference fitness-based
-        # placement, ClusterController getWorkerForRoleInDatacenter):
-        # transaction-system roles avoid storage-class workers so chaos on
-        # the txn system never destroys storage state.
-        stateless = sorted((reg.worker for reg in workers
-                            if reg.process_class in ("stateless", "unset")),
-                           key=lambda x: x.id)
-        storage_pool = sorted((reg.worker for reg in workers
-                               if reg.process_class in ("storage", "unset")),
-                              key=lambda x: x.id)
-        w = sorted((reg.worker for reg in workers), key=lambda x: x.id)
-        stateless = stateless or w
-        storage_pool = storage_pool or w
+        # Placement pools ranked by role fitness (reference
+        # ClusterController.actor.cpp:3576 clusterRecruitFromConfiguration
+        # + ProcessClass machineClassFitness): dedicated classes first,
+        # storage-class workers are WORST for transaction-system roles and
+        # used only when nothing better registered — chaos on the txn
+        # system then never destroys storage state.
+        from .interfaces import (FITNESS_NEVER, FITNESS_OKAY, FITNESS_UNSET,
+                                 FITNESS_WORST, role_fitness)
+
+        def pool(role: str):
+            """Workers from the best OCCUPIED fitness band only: the
+            dedicated/good/unset band when anyone is in it, spilling to
+            OKAY then WORST classes only when the better bands are empty
+            — round-robining across mixed tiers would place roles on
+            worse-class workers while better ones still had capacity."""
+            ranked = sorted(
+                (reg for reg in workers
+                 if role_fitness(reg.process_class, role) < FITNESS_NEVER),
+                key=lambda reg: (role_fitness(reg.process_class, role),
+                                 reg.worker.id))
+            for cut in (FITNESS_UNSET, FITNESS_OKAY, FITNESS_WORST):
+                band = [reg.worker for reg in ranked
+                        if role_fitness(reg.process_class, role) <= cut]
+                if band:
+                    return band
+            return [reg.worker for reg in ranked] or \
+                sorted((reg.worker for reg in workers), key=lambda x: x.id)
+
+        stateless = pool("stateless")
+        log_pool = pool("log")
+        storage_pool = pool("storage")
         # Spread recruited roles AWAY from the master's own worker: killing
         # the master must never also take out the only TLog copy.
         others = [x for x in stateless if x.id != process.name] or stateless
+        log_others = [x for x in log_pool if x.id != process.name] or log_pool
 
         def pick(i: int):
             return others[i % len(others)]
+
+        def pick_log(i: int):
+            return log_others[i % len(log_others)]
 
         def pick_storage(i: int):
             return storage_pool[i % len(storage_pool)]
@@ -611,7 +633,7 @@ async def master_server(master: Master, process, coordinators,
             my_tags = {t: h for t, h in old_tag_holders.items()
                        if i in new_ls_teams.team_for_tag(t)}
             tlog_futures.append(RequestStream.at(
-                pick(i).init_tlog.endpoint).get_reply(
+                pick_log(i).init_tlog.endpoint).get_reply(
                 InitializeTLogRequest(
                     tlog_id=f"log{i}.{tuid}.e{master.epoch}",
                     recovery_version=recovery_version,
@@ -685,7 +707,8 @@ async def master_server(master: Master, process, coordinators,
             pick(0).init_ratekeeper.endpoint).get_reply(
             InitializeRatekeeperRequest(
                 rk_id=f"rk.e{master.epoch}",
-                storage_interfaces=storage_servers))
+                storage_interfaces=storage_servers,
+                tlog_interfaces=list(tlogs)))
         data_distributor = await RequestStream.at(
             pick(2).init_data_distributor.endpoint).get_reply(
             InitializeDataDistributorRequest(

@@ -2,28 +2,64 @@
 
 Reference: fdbserver/Ratekeeper.actor.cpp — the singleton tracks every
 storage server's queue depth and durability lag
-(trackStorageServerQueueInfo :610) and every TLog's queue, computes a
-cluster transactions-per-second budget (updateRate :991) with a
-spring-damped limit as queues approach their targets, and hands rates to
-the GRV proxies, which release queued transactions against the budget
-(GrvProxyServer getRate loop :288).
+(trackStorageServerQueueInfo :610), every TLog's un-popped queue (:663),
+computes a cluster transactions-per-second budget (updateRate :991) with
+spring-damped limits as queues approach their targets, auto-throttles
+hot transaction tags (busy-read detection + fdbclient/TagThrottle.actor.cpp),
+and hands rates to the GRV proxies, which release queued transactions
+against the budget (GrvProxyServer getRate loop :288).
 
-Simplified spring model kept from the reference: the limit scales the
-current release rate by target_queue/current_queue as the worst storage
-queue (bytes of non-durable data) crosses (target - spring); below that
-the rate is unlimited (workload-bound).
+Rate sources combined (worst wins, reference limitReason):
+  - storage_server_write_queue_size: worst SS non-durable bytes vs
+    STORAGE_LIMIT_BYTES spring zone.
+  - storage_server_durability_lag: worst SS version lag vs
+    STORAGE_DURABILITY_LAG_SOFT_MAX.
+  - log_server_write_queue: worst TLog un-popped bytes vs
+    TLOG_LIMIT_BYTES (below the spill threshold, so the cluster slows
+    before spill-by-reference starts).
+Observed release rates are exponentially smoothed (reference
+smoothReleasedTransactions, flow/Smoother.h) rather than raw-windowed.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import math
+from dataclasses import dataclass, field
 from typing import Any, Dict, List
 
 from ..core.knobs import server_knobs
-from ..core.scheduler import delay, spawn
+from ..core.scheduler import delay, now, spawn
 from ..core.trace import TraceEvent
 from ..rpc.endpoint import RequestStream
 from ..core.scheduler import TaskPriority
+
+
+class Smoother:
+    """Exponential smoother (reference flow/Smoother.h): estimates the
+    rate of a monotonically growing total with half-life decay, so one
+    noisy sample can't swing the cluster budget."""
+
+    def __init__(self, half_life: float) -> None:
+        self.half_life = max(half_life, 1e-6)
+        self._time = None
+        self._total = 0.0
+        self._estimate = 0.0     # smoothed rate
+
+    def set_total(self, t: float, total: float) -> None:
+        if self._time is None:
+            self._time, self._total = t, total
+            return
+        dt = t - self._time
+        if dt <= 0:
+            self._total = max(self._total, total)
+            return
+        rate = max(0.0, (total - self._total) / dt)
+        alpha = 1.0 - math.exp(-dt * math.log(2.0) / self.half_life)
+        self._estimate += alpha * (rate - self._estimate)
+        self._time, self._total = t, total
+
+    def rate(self) -> float:
+        return self._estimate
 
 
 @dataclass
@@ -32,6 +68,9 @@ class GetRateInfoRequest:
 
     proxy_id: str
     total_released: int      # transactions this proxy released so far
+    # Per-tag released counts from this proxy (reference throttledTagCounts
+    # piggybacked on GetRateInfoRequest).
+    tag_released: Dict[str, int] = field(default_factory=dict)
     reply: Any = None
 
 
@@ -44,6 +83,9 @@ class GetRateInfoReply:
     # <= tps; collapses to ~0 BEFORE default throttling begins, so batch
     # load sheds first and can never starve default-priority traffic.
     batch_tps: float = float("inf")
+    # tag -> per-proxy tps ceiling for auto-throttled hot tags (reference
+    # GetRateInfoReply.throttledTags).
+    tag_throttles: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -56,6 +98,23 @@ class StorageQueuingMetricsReply:
     queue_bytes: int         # non-durable bytes (version lag proxy)
     durability_lag: int      # version - durable_version
     stored_bytes: int = 0
+    # Busiest-transaction-tag sampling for auto tag throttling (reference
+    # StorageQueuingMetricsReply.busiestTag).
+    busiest_read_tag: str = ""
+    busiest_read_rate: float = 0.0   # ops/s attributed to that tag
+    total_read_rate: float = 0.0     # ops/s total on this server
+
+
+@dataclass
+class TLogQueuingMetricsRequest:
+    reply: Any = None
+
+
+@dataclass
+class TLogQueuingMetricsReply:
+    queue_bytes: int         # un-popped bytes (input - popped)
+    durable_lag: int         # appended version - fsynced version
+    bytes_input: int = 0
 
 
 @dataclass
@@ -69,6 +128,8 @@ class RatekeeperStatusReply:
     limit_reason: str
     released_tps: float
     worst_queue_bytes: int
+    worst_tlog_queue_bytes: int = 0
+    throttled_tags: Dict[str, float] = field(default_factory=dict)
 
 
 class RatekeeperInterface:
@@ -87,58 +148,126 @@ class RatekeeperInterface:
 
 class Ratekeeper:
     def __init__(self, rk_id: str, storage_interfaces: Dict[int, Any],
+                 tlog_interfaces: List[Any] = (),
                  poll_interval: float = 0.5) -> None:
         self.id = rk_id
         self.interface = RatekeeperInterface(rk_id)
+        self.interface.role = self   # sim-side backref for status/tests
         self.storage_interfaces = storage_interfaces
+        self.tlog_interfaces = list(tlog_interfaces)
         self.poll_interval = poll_interval
         self.tps_limit: float = float("inf")
         self.batch_tps_limit: float = float("inf")
         self.limit_reason = "workload"
-        # Smoothed release rate across proxies (reference
-        # smoothReleasedTransactions).
+        knobs = server_knobs()
         self._proxy_released: Dict[str, int] = {}
-        self._released_window: List = []   # (time, total)
+        self._released = Smoother(knobs.RK_SMOOTHING_HALF_LIFE)
         self.worst_queue_bytes = 0
+        self.worst_durability_lag = 0
+        self.worst_tlog_queue_bytes = 0
+        # tag -> (tps_ceiling, expires_at) for auto-throttled hot tags.
+        self.tag_throttles: Dict[str, tuple] = {}
+        # tag -> Smoother over proxy-reported per-tag release totals.
+        self._tag_released: Dict[str, Smoother] = {}
+        self._proxy_tag_released: Dict[str, Dict[str, int]] = {}
 
     # -- rate computation (reference updateRate :991) ------------------------
     def _release_rate(self) -> float:
-        """Observed cluster release rate over the sampling window."""
-        if len(self._released_window) < 2:
-            return 0.0
-        (t0, n0), (t1, n1) = self._released_window[0], \
-            self._released_window[-1]
-        if t1 <= t0:
-            return 0.0
-        return max(0.0, (n1 - n0) / (t1 - t0))
+        """Smoothed cluster release rate (smoothReleasedTransactions)."""
+        return self._released.rate()
+
+    def _spring_factor(self, worst: float, target: float,
+                       spring: float) -> float:
+        """1.0 below (target - spring); linear to 0.0 at target."""
+        if worst <= target - spring:
+            return 1.0
+        over = min(worst - (target - spring), spring)
+        return max(0.0, 1.0 - over / spring)
 
     def _update_rate(self) -> None:
         knobs = server_knobs()
+        released = max(self._release_rate(), 1.0)
+        limits = []   # (factor, reason)
+
+        # Storage write-queue spring.
         target = float(knobs.STORAGE_LIMIT_BYTES)
         spring = max(target * 0.2, 1.0)
-        worst = float(self.worst_queue_bytes)
-        released = max(self._release_rate(), 1.0)
-        # Batch spring zone sits BELOW the normal one (reference: the
-        # batch limit uses tighter queue targets): batch throttles through
-        # [target - 2*spring, target - spring] and hits ~0 exactly where
-        # default throttling begins — under overload batch sheds first.
-        batch_floor = target - 2 * spring
-        if worst <= batch_floor:
-            self.batch_tps_limit = float("inf")
-        else:
-            b_over = min(worst - batch_floor, spring)
-            self.batch_tps_limit = released * max(
-                0.0, 1.0 - b_over / spring) + 0.1
-        if worst <= target - spring:
+        limits.append((self._spring_factor(
+            float(self.worst_queue_bytes), target, spring),
+            "storage_server_write_queue_size"))
+
+        # Storage durability lag (reference durabilityLagLimit): versions
+        # behind the durable frontier; soft max gives a spring zone too.
+        lag_target = float(knobs.STORAGE_DURABILITY_LAG_SOFT_MAX)
+        lag_spring = max(lag_target * 0.2, 1.0)
+        limits.append((self._spring_factor(
+            float(self.worst_durability_lag), lag_target, lag_spring),
+            "storage_server_durability_lag"))
+
+        # TLog un-popped queue spring (reference :663): fires BELOW the
+        # spill threshold so spill is the backstop, not the steady state.
+        t_target = float(knobs.TLOG_LIMIT_BYTES)
+        t_spring = max(t_target * 0.2, 1.0)
+        limits.append((self._spring_factor(
+            float(self.worst_tlog_queue_bytes), t_target, t_spring),
+            "log_server_write_queue"))
+
+        factor, reason = min(limits, key=lambda fr: fr[0])
+
+        # Batch zone sits one spring width BELOW each normal zone
+        # (reference tighter batch targets): batch hits ~0 exactly where
+        # default throttling begins, so batch sheds first.
+        batch_factors = [
+            self._spring_factor(float(self.worst_queue_bytes),
+                                target - spring, spring),
+            self._spring_factor(float(self.worst_durability_lag),
+                                lag_target - lag_spring, lag_spring),
+            self._spring_factor(float(self.worst_tlog_queue_bytes),
+                                t_target - t_spring, t_spring),
+        ]
+        b_factor = min(batch_factors)
+        self.batch_tps_limit = float("inf") if b_factor >= 1.0 else \
+            released * b_factor + 0.1
+
+        if factor >= 1.0:
             self.tps_limit = float("inf")
             self.limit_reason = "workload"
-            return
-        # Spring zone: scale the observed rate down proportionally to how
-        # deep into the spring the worst queue is; a full queue halts.
-        over = min(worst - (target - spring), spring)
-        factor = max(0.0, 1.0 - over / spring)
-        self.tps_limit = released * factor + 1.0
-        self.limit_reason = "storage_server_write_queue_size"
+        else:
+            self.tps_limit = released * factor + 1.0
+            self.limit_reason = reason
+
+    # -- per-tag auto throttling (reference Ratekeeper tag throttling) -------
+    def _update_tag_throttles(self, ss_replies: List[Any]) -> None:
+        knobs = server_knobs()
+        t = now()
+        saturation = float(knobs.SS_READ_SATURATION_OPS)
+        busy_at = saturation * float(knobs.AUTO_THROTTLE_BUSY_FRACTION)
+        for r in ss_replies:
+            if not r.busiest_read_tag or r.total_read_rate <= busy_at:
+                continue
+            if r.busiest_read_rate < r.total_read_rate * float(
+                    knobs.AUTO_THROTTLE_MIN_TAG_FRACTION):
+                continue
+            # Scale the hot tag down so the server returns under the busy
+            # threshold; tag tps is measured in GRV releases, approximated
+            # by its measured release rate scaled like its read rate.
+            scale = busy_at / r.total_read_rate
+            rel = self._tag_released.get(r.busiest_read_tag)
+            rel_rate = rel.rate() if rel else r.busiest_read_rate
+            tps = max(1.0, rel_rate * scale)
+            cur = self.tag_throttles.get(r.busiest_read_tag)
+            if cur is not None:
+                tps = min(tps, cur[0])    # tighten, never loosen mid-storm
+            self.tag_throttles[r.busiest_read_tag] = (
+                tps, t + float(knobs.AUTO_TAG_THROTTLE_DURATION))
+            TraceEvent("RkTagThrottled").detail(
+                "Tag", r.busiest_read_tag).detail("Tps", tps).detail(
+                "SSReadRate", r.total_read_rate).log()
+        # Expire throttles whose storm has passed.
+        for tag in list(self.tag_throttles):
+            if self.tag_throttles[tag][1] <= t:
+                del self.tag_throttles[tag]
+                TraceEvent("RkTagUnthrottled").detail("Tag", tag).log()
 
     async def _poll_storage(self) -> None:
         from ..core.futures import swallow, wait_all
@@ -149,25 +278,68 @@ class Ratekeeper:
                 ssi.queuing_metrics.endpoint).get_reply(
                 StorageQueuingMetricsRequest())
                 for ssi in self.storage_interfaces.values()]
-            await wait_all([swallow(f) for f in futures])
-            worst = max((f.get().queue_bytes for f in futures
-                         if not f.is_error()), default=0)
-            self.worst_queue_bytes = worst
+            t_futures = [RequestStream.at(
+                tli.queuing_metrics.endpoint).get_reply(
+                TLogQueuingMetricsRequest())
+                for tli in self.tlog_interfaces
+                if tli is not None]
+            await wait_all([swallow(f) for f in futures + t_futures])
+            replies = [f.get() for f in futures if not f.is_error()]
+            self.worst_queue_bytes = max(
+                (r.queue_bytes for r in replies), default=0)
+            self.worst_durability_lag = max(
+                (r.durability_lag for r in replies), default=0)
+            self.worst_tlog_queue_bytes = max(
+                (f.get().queue_bytes for f in t_futures
+                 if not f.is_error()), default=0)
+            self._update_tag_throttles(replies)
             self._update_rate()
             await delay(self.poll_interval)
 
     async def _serve_rate_info(self) -> None:
-        from ..core.scheduler import now
         async for req in self.interface.get_rate_info.queue:
+            t = now()
             self._proxy_released[req.proxy_id] = req.total_released
-            total = sum(self._proxy_released.values())
-            self._released_window.append((now(), total))
-            if len(self._released_window) > 20:
-                self._released_window.pop(0)
+            self._released.set_total(
+                t, float(sum(self._proxy_released.values())))
+            # Record EVERY report (including empty ones): a proxy whose
+            # throttles expired reports {} forever after, and skipping
+            # that would pin its last non-empty dict — and every tag in
+            # it — in memory for the ratekeeper's lifetime.
+            if req.tag_released:
+                self._proxy_tag_released[req.proxy_id] = dict(
+                    req.tag_released)
+            else:
+                self._proxy_tag_released.pop(req.proxy_id, None)
+            if req.tag_released:
+                knobs = server_knobs()
+                totals: Dict[str, int] = {}
+                for per in self._proxy_tag_released.values():
+                    for tag, n in per.items():
+                        totals[tag] = totals.get(tag, 0) + n
+                for tag, total in totals.items():
+                    sm = self._tag_released.get(tag)
+                    if sm is None:
+                        sm = self._tag_released[tag] = Smoother(
+                            knobs.RK_SMOOTHING_HALF_LIFE)
+                    sm.set_total(t, float(total))
+            # Bound per-tag state: proxies only report actively-throttled
+            # tags, so anything tracked here that is no longer throttled
+            # and no longer reported is dead — drop it (tags are arbitrary
+            # client strings; without pruning this grows forever).
+            live = set(self.tag_throttles)
+            for per in self._proxy_tag_released.values():
+                live.update(per)
+            for tag in list(self._tag_released):
+                if tag not in live:
+                    del self._tag_released[tag]
             n_proxies = max(len(self._proxy_released), 1)
             req.reply.send(GetRateInfoReply(
                 tps=self.tps_limit / n_proxies,
                 batch_tps=self.batch_tps_limit / n_proxies,
+                tag_throttles={tag: tps / n_proxies
+                               for tag, (tps, _exp)
+                               in self.tag_throttles.items()},
                 lease_duration=self.poll_interval * 2))
 
     async def _serve_status(self) -> None:
@@ -176,7 +348,10 @@ class Ratekeeper:
                 tps_limit=self.tps_limit,
                 limit_reason=self.limit_reason,
                 released_tps=self._release_rate(),
-                worst_queue_bytes=self.worst_queue_bytes))
+                worst_queue_bytes=self.worst_queue_bytes,
+                worst_tlog_queue_bytes=self.worst_tlog_queue_bytes,
+                throttled_tags={tag: tps for tag, (tps, _exp)
+                                in self.tag_throttles.items()}))
 
     def run(self, process) -> None:
         for s in self.interface.streams():

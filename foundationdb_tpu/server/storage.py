@@ -196,6 +196,10 @@ class StorageServer:
         self._watches: Dict[bytes, list] = {}
         self.stats = {"reads": 0, "range_reads": 0, "mutations": 0,
                       "watches": 0}
+        # Busy-read tag sampling window (reset each ratekeeper poll).
+        self._tag_read_ops: Dict[str, int] = {}
+        self._read_ops_window = 0
+        self._read_window_start = now()
         self._process = None
         self._pull_actor = None
         from ..core.histogram import CounterCollection
@@ -427,6 +431,7 @@ class StorageServer:
             await self._wait_for_version(req.version)
             self._check_owned(req.key, req.key + b"\x00", req.version)
             self.stats["reads"] += 1
+            self._sample_read_tag(req.tag)
             self.metrics.histogram("ReadLatency").record(now() - _t0)
             req.reply.send(GetValueReply(
                 value=self.data.get(req.key, req.version),
@@ -439,6 +444,7 @@ class StorageServer:
             await self._wait_for_version(req.version)
             self._check_owned(req.begin, req.end, req.version)
             self.stats["range_reads"] += 1
+            self._sample_read_tag(req.tag)
             data, more = self.data.range_read(
                 req.begin, req.end, req.version, req.limit, req.limit_bytes,
                 req.reverse)
@@ -546,13 +552,36 @@ class StorageServer:
                 (self.version.get(), 1, req.begin, req.end))
         req.reply.send(None)
 
+    def _sample_read_tag(self, tag: str) -> None:
+        """Busy-read sampling for ratekeeper tag auto-throttling
+        (reference storage server busiest-tag tracking feeding
+        StorageQueuingMetricsReply.busiestTag)."""
+        self._read_ops_window += 1
+        if tag:
+            self._tag_read_ops[tag] = self._tag_read_ops.get(tag, 0) + 1
+
     async def _queuing_metrics(self, req) -> None:
         from .ratekeeper import StorageQueuingMetricsReply
         lag = self.version.get() - self.durable_version.get()
+        t = now()
+        dt = max(t - self._read_window_start, 1e-6)
+        busiest_tag, busiest_ops = "", 0
+        for tag, n in self._tag_read_ops.items():
+            if n > busiest_ops:
+                busiest_tag, busiest_ops = tag, n
+        total_rate = self._read_ops_window / dt
+        # Reset the sampling window each poll so rates track the current
+        # storm, not all of history.
+        self._read_ops_window = 0
+        self._tag_read_ops = {}
+        self._read_window_start = t
         req.reply.send(StorageQueuingMetricsReply(
             queue_bytes=lag * 64,            # approx bytes per version
             durability_lag=lag,
-            stored_bytes=len(self.data)))
+            stored_bytes=len(self.data),
+            busiest_read_tag=busiest_tag,
+            busiest_read_rate=busiest_ops / dt,
+            total_read_rate=total_rate))
 
     # -- watches (reference watchValueQ, trigger :2622) ----------------------
     def _trigger_watch(self, key: bytes) -> None:

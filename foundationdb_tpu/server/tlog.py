@@ -76,17 +76,23 @@ class TLog:
         # tag -> deque of (version, mutations), version-ascending — the
         # RESIDENT (in-memory) suffix of each tag's data.
         self.tag_data: Dict[Tag, Deque[Tuple[Version, List[Mutation]]]] = {}
-        # tag -> deque of (version, disk-record seq): the SPILLED prefix
-        # (payload evicted from memory, served from the DiskQueue on peek;
-        # reference spill-by-reference, TLogServer.actor.cpp:293 spill
-        # fields).  Invariant: spilled versions < resident versions.
-        self.spilled: Dict[Tag, Deque[Tuple[Version, int]]] = {}
+        # tag -> deque of (version, disk-record seq, payload bytes): the
+        # SPILLED prefix (payload evicted from memory, served from the
+        # DiskQueue on peek; reference spill-by-reference,
+        # TLogServer.actor.cpp:293 spill fields).  Invariant: spilled
+        # versions < resident versions.
+        self.spilled: Dict[Tag, Deque[Tuple[Version, int, int]]] = {}
         self.poppedtags: Dict[Tag, Version] = {}
         self.bytes_input = 0
         # In-memory payload accounting driving the spill policy.
         self.bytes_in_memory = 0
         self.tag_bytes: Dict[Tag, int] = {}
         self.bytes_spilled = 0
+        # Cumulative bytes trimmed by pops (both tiers): the un-popped
+        # queue the ratekeeper springs against is input - popped
+        # (reference TLogData bytesInput/bytesDurable driving
+        # TARGET_BYTES_PER_TLOG in Ratekeeper.actor.cpp:663).
+        self.bytes_popped = 0
         # version -> disk record seq of the commit that carried it.
         self._seq_of_version: Dict[Version, int] = {}
         self._sync_running = False
@@ -132,6 +138,7 @@ class TLog:
                 _v, msgs = q.popleft()
                 nbytes = sum(m.expected_size() for m in msgs)
                 t.bytes_in_memory -= nbytes
+                t.bytes_popped += nbytes
                 if tag in t.tag_bytes:
                     t.tag_bytes[tag] -= nbytes
         # Re-apply the memory bound: the recovery scan rebuilt every
@@ -310,7 +317,8 @@ class TLog:
                 self.bytes_in_memory -= nbytes
                 self.tag_bytes[tag] -= nbytes
                 spilled_bytes += nbytes
-                self.spilled.setdefault(tag, deque()).append((version, seq))
+                self.spilled.setdefault(tag, deque()).append(
+                    (version, seq, nbytes))
                 progressed = True
             if not progressed:
                 break          # nothing durable to evict yet; retry later
@@ -360,7 +368,7 @@ class TLog:
         # queue file (reference tLogPeekMessages :1584 serving spilled
         # tags via IDiskQueue reads).  Spilled versions precede resident
         # ones, so a budget cut here is a version-prefix cut.
-        for v, seq in sq_snap:
+        for v, seq, _nb in sq_snap:
             if v < req.begin:
                 continue
             if sent_bytes >= budget:
@@ -401,13 +409,15 @@ class TLog:
             sq = self.spilled.get(req.tag)
             if sq is not None:
                 while sq and sq[0][0] <= req.to:
-                    sq.popleft()
+                    _v, _seq, nb = sq.popleft()
+                    self.bytes_popped += nb
             q = self.tag_data.get(req.tag)
             if q is not None:
                 while q and q[0][0] <= req.to:
                     _v, msgs = q.popleft()
                     nbytes = sum(m.expected_size() for m in msgs)
                     self.bytes_in_memory -= nbytes
+                    self.bytes_popped += nbytes
                     if req.tag in self.tag_bytes:
                         self.tag_bytes[req.tag] -= nbytes
             self._trim_queue()
@@ -461,6 +471,22 @@ class TLog:
         async for req in self.interface.lock.queue:
             self._process.spawn(self._lock(req), f"{self.id}.lock")
 
+    async def _serve_queuing_metrics(self) -> None:
+        """Ratekeeper poll (reference TLogQueuingMetricsRequest served by
+        tLogCore): reports RESIDENT (in-memory, not-yet-popped) payload —
+        the reference's bytesInput - bytesDurable.  Spill-by-reference is
+        the relief valve BELOW the throttle point (spill threshold <
+        TLOG_LIMIT_BYTES): a lagging peeker's backlog moves to disk and
+        does NOT throttle the cluster; the rate only springs down when
+        memory still grows — i.e. spilling can't keep up (nothing durable
+        to evict yet), the case durability can't absorb."""
+        from .ratekeeper import TLogQueuingMetricsReply
+        async for req in self.interface.queuing_metrics.queue:
+            req.reply.send(TLogQueuingMetricsReply(
+                queue_bytes=self.bytes_in_memory,
+                durable_lag=self.version.get() - self.durable_version.get(),
+                bytes_input=self.bytes_input))
+
     def run(self, process) -> None:
         self._process = process
         for s in self.interface.streams():
@@ -470,6 +496,8 @@ class TLog:
         process.spawn(self._serve_pop(), f"{self.id}.servePop")
         process.spawn(self._serve_confirm(), f"{self.id}.serveConfirm")
         process.spawn(self._serve_lock(), f"{self.id}.serveLock")
+        process.spawn(self._serve_queuing_metrics(),
+                      f"{self.id}.serveQueuingMetrics")
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),
                       f"{self.id}.waitFailure")

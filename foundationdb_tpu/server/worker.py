@@ -247,7 +247,8 @@ class Worker:
 
     async def _init_ratekeeper(self, req) -> None:
         from .ratekeeper import Ratekeeper
-        rk = Ratekeeper(req.rk_id, req.storage_interfaces)
+        rk = Ratekeeper(req.rk_id, req.storage_interfaces,
+                        getattr(req, "tlog_interfaces", ()) or ())
         rk.run(self.process)
         req.reply.send(rk.interface)
 

@@ -129,7 +129,7 @@ def test_ratekeeper_batch_limit_collapses_first(teardown):  # noqa: F811
     lp = EventLoop(sim=True)
     set_event_loop(lp)
     rk = Ratekeeper("rk-test", {})
-    rk._released_window = [(0.0, 0), (1.0, 1000)]   # 1000 tps observed
+    rk._released._estimate = 1000.0   # smoothed 1000 tps observed
     target = float(server_knobs().STORAGE_LIMIT_BYTES)
     spring = max(target * 0.2, 1.0)
 

@@ -1,0 +1,239 @@
+"""Ratekeeper parity: TLog queue tracking, durability-lag limiting,
+per-tag auto-throttling (VERDICT r4 item 5).
+
+Reference: fdbserver/Ratekeeper.actor.cpp:663 (TLog queue tracking),
+:991 (updateRate limit reasons), fdbclient/TagThrottle.actor.cpp
+(per-tag throttles surfaced through GRV replies).
+"""
+
+import pytest
+
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.core.scheduler import delay
+from foundationdb_tpu.rpc.endpoint import RequestStream
+from foundationdb_tpu.server.grv_proxy import GrvProxy
+from foundationdb_tpu.server.interfaces import (GetRawCommittedVersionReply,
+                                                GetReadVersionRequest,
+                                                MasterInterface,
+                                                TLogInterface,
+                                                TransactionPriority)
+from foundationdb_tpu.server.ratekeeper import (GetRateInfoRequest,
+                                                Ratekeeper, Smoother,
+                                                StorageQueuingMetricsReply,
+                                                TLogQueuingMetricsReply)
+
+from test_recovery import teardown  # noqa: F401
+
+
+def _world():
+    from foundationdb_tpu.core import EventLoop, set_event_loop
+    from foundationdb_tpu.rpc.network import SimNetwork, set_network
+    from foundationdb_tpu.rpc.sim import Simulator, set_simulator
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    sim = Simulator()
+    set_simulator(sim)
+    set_network(sim.network)
+    return lp, sim
+
+
+def test_smoother_converges_and_damps():
+    s = Smoother(half_life=1.0)
+    # 100/s fed for 10 half-lives converges to ~100.
+    for i in range(101):
+        s.set_total(i * 0.1, i * 10.0)
+    assert 85.0 < s.rate() < 101.0, s.rate()
+    # One wild sample (a 10000/s instantaneous burst over 10ms) moves the
+    # estimate only in proportion to its duration, not its magnitude.
+    s.set_total(10.11, 1010.0 + 100.0)
+    assert s.rate() < 1000.0, s.rate()
+
+
+def test_tlog_queue_limits_rate(teardown):  # noqa: F811
+    """A TLog whose RESIDENT bytes cross TLOG_LIMIT_BYTES lowers the
+    cluster rate.  The spill threshold sits BELOW the limit (reference
+    TARGET_BYTES_PER_TLOG 2.4GB vs spill 1.5GB): a lagging peeker's
+    backlog spills to disk without throttling; only memory growth that
+    spilling can't evict (fsync-bound overload) springs the rate."""
+    from foundationdb_tpu.core import EventLoop, set_event_loop
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    knobs = server_knobs()
+    assert knobs.TLOG_SPILL_THRESHOLD < knobs.TLOG_LIMIT_BYTES
+
+    rk = Ratekeeper("rk-test", {})
+    rk._released._estimate = 1000.0
+    # A spilled steady state (resident capped at the spill threshold)
+    # does NOT throttle: spill is the relief valve, not a rate signal.
+    rk.worst_tlog_queue_bytes = int(knobs.TLOG_SPILL_THRESHOLD)
+    rk._update_rate()
+    assert rk.tps_limit == float("inf")
+    # Memory past the limit (spill can't evict) throttles.
+    rk.worst_tlog_queue_bytes = int(knobs.TLOG_LIMIT_BYTES)
+    rk._update_rate()
+    assert rk.tps_limit < 100.0
+    assert rk.limit_reason == "log_server_write_queue"
+
+
+def test_durability_lag_limits_rate(teardown):  # noqa: F811
+    from foundationdb_tpu.core import EventLoop, set_event_loop
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    knobs = server_knobs()
+    rk = Ratekeeper("rk-test", {})
+    rk._released._estimate = 1000.0
+    rk.worst_durability_lag = int(knobs.STORAGE_DURABILITY_LAG_SOFT_MAX)
+    rk._update_rate()
+    assert rk.tps_limit < 100.0
+    assert rk.limit_reason == "storage_server_durability_lag"
+
+
+class _StubSS:
+    """Storage interface stub reporting a configurable busy tag."""
+
+    def __init__(self, p, reply: StorageQueuingMetricsReply) -> None:
+        self.queuing_metrics = RequestStream("stub.ss.queuingMetrics")
+        p.register(self.queuing_metrics)
+        self._reply = reply
+
+        async def serve() -> None:
+            async for req in self.queuing_metrics.queue:
+                req.reply.send(self._reply)
+        p.spawn(serve(), "stub.ss")
+
+
+class _StubTLog:
+    def __init__(self, p, reply: TLogQueuingMetricsReply) -> None:
+        self.queuing_metrics = RequestStream("stub.tlog.queuingMetrics")
+        p.register(self.queuing_metrics)
+        self._reply = reply
+
+        async def serve() -> None:
+            async for req in self.queuing_metrics.queue:
+                req.reply.send(self._reply)
+        p.spawn(serve(), "stub.tlog")
+
+
+def test_rk_polls_tlogs_and_storage(teardown):  # noqa: F811
+    lp, sim = _world()
+    p = sim.new_process(name="rkhost")
+    knobs = server_knobs()
+    ss = _StubSS(p, StorageQueuingMetricsReply(
+        queue_bytes=0, durability_lag=0))
+    tl = _StubTLog(p, TLogQueuingMetricsReply(
+        queue_bytes=int(knobs.TLOG_LIMIT_BYTES), durable_lag=0))
+    rk = Ratekeeper("rk-test", {0: ss}, [tl], poll_interval=0.1)
+    rk._released._estimate = 1000.0
+    rk.run(p)
+
+    async def go():
+        await delay(0.5)
+        return True
+
+    assert lp.run_until(lp.spawn(go()), timeout=30)
+    assert rk.worst_tlog_queue_bytes == int(knobs.TLOG_LIMIT_BYTES)
+    assert rk.limit_reason == "log_server_write_queue"
+    assert rk.tps_limit < float("inf")
+
+
+def test_hot_tag_throttled_others_proceed(teardown):  # noqa: F811
+    """A saturated storage server whose reads are dominated by one tag
+    gets that tag throttled at the GRV proxy while untagged traffic
+    proceeds at full speed (reference busy-read auto-throttling)."""
+    lp, sim = _world()
+    p = sim.new_process(name="host")
+    knobs = server_knobs()
+    sat = float(knobs.SS_READ_SATURATION_OPS)
+    ss = _StubSS(p, StorageQueuingMetricsReply(
+        queue_bytes=0, durability_lag=0,
+        busiest_read_tag="hot", busiest_read_rate=sat * 0.9,
+        total_read_rate=sat * 1.0))
+    rk = Ratekeeper("rk-test", {0: ss}, poll_interval=0.05)
+    rk.run(p)
+
+    master = MasterInterface()
+    for s in master.streams():
+        p.register(s)
+
+    async def serve_versions() -> None:
+        async for req in master.get_live_committed_version.queue:
+            req.reply.send(GetRawCommittedVersionReply(version=1000))
+    p.spawn(serve_versions(), "master.stub")
+
+    proxy = GrvProxy("grv-test", master, ratekeeper=rk.interface)
+    proxy.run(p)
+    grv_ep = proxy.interface.get_consistent_read_version.endpoint
+    results = {"hot_done": 0, "plain_lat": []}
+
+    async def hot_flood() -> None:
+        # Tagged backlog: must drain only at the throttled tag tps.
+        for _ in range(500):
+            f = RequestStream.at(grv_ep).get_reply(GetReadVersionRequest(
+                priority=TransactionPriority.DEFAULT, tags=("hot",)))
+            f.on_ready(lambda _f: results.__setitem__(
+                "hot_done", results["hot_done"] + 1))
+
+    async def plain_traffic() -> None:
+        from foundationdb_tpu.core.scheduler import now
+        for _ in range(30):
+            t0 = now()
+            await RequestStream.at(grv_ep).get_reply(GetReadVersionRequest(
+                priority=TransactionPriority.DEFAULT))
+            results["plain_lat"].append(now() - t0)
+            await delay(0.05)
+
+    async def go():
+        # Feed the RK a per-tag release rate so the throttle has a
+        # baseline, and let a poll land the throttle on the proxy.
+        await RequestStream.at(rk.interface.get_rate_info.endpoint) \
+            .get_reply(GetRateInfoRequest(
+                proxy_id="seed", total_released=0,
+                tag_released={"hot": 0}))
+        await delay(0.1)
+        await RequestStream.at(rk.interface.get_rate_info.endpoint) \
+            .get_reply(GetRateInfoRequest(
+                proxy_id="seed", total_released=2000,
+                tag_released={"hot": 2000}))
+        await delay(0.3)        # several RK polls -> throttle exists
+        assert "hot" in rk.tag_throttles, rk.tag_throttles
+        lp.spawn(hot_flood())
+        await delay(0.1)
+        await plain_traffic()
+        await delay(0.5)
+        return True
+
+    assert lp.run_until(lp.spawn(go()), timeout=60)
+    # Untagged default traffic unaffected...
+    assert len(results["plain_lat"]) == 30
+    assert max(results["plain_lat"]) < 0.5, results["plain_lat"]
+    # ...while the tagged backlog drained at only the throttled tps.
+    assert results["hot_done"] < 500, "hot tag was never throttled"
+    assert results["hot_done"] >= 1   # but not starved entirely
+
+
+def test_tag_throttle_expires(teardown):  # noqa: F811
+    """Once the storm passes, the throttle lapses after
+    AUTO_TAG_THROTTLE_DURATION and the tag flows freely again."""
+    lp, sim = _world()
+    p = sim.new_process(name="host")
+    knobs = server_knobs()
+    sat = float(knobs.SS_READ_SATURATION_OPS)
+    hot_reply = StorageQueuingMetricsReply(
+        queue_bytes=0, durability_lag=0,
+        busiest_read_tag="hot", busiest_read_rate=sat * 0.9,
+        total_read_rate=sat)
+    ss = _StubSS(p, hot_reply)
+    rk = Ratekeeper("rk-test", {0: ss}, poll_interval=0.05)
+    rk.run(p)
+
+    async def go():
+        await delay(0.3)
+        assert "hot" in rk.tag_throttles
+        # Storm over: the stub now reports an idle server.
+        ss._reply = StorageQueuingMetricsReply(
+            queue_bytes=0, durability_lag=0)
+        await delay(float(knobs.AUTO_TAG_THROTTLE_DURATION) + 1.0)
+        assert "hot" not in rk.tag_throttles
+        return True
+
+    assert lp.run_until(lp.spawn(go()), timeout=60)
