@@ -1,0 +1,26 @@
+"""External binding surface (reference bindings/).
+
+The reference's point of being a database is a STABLE external API:
+bindings/c/fdb_c.cpp wraps the native client in a frozen C ABI and every
+language binding (python/java/go/...) is a veneer over it, validated by
+the cross-implementation stack-machine bindingtester
+(bindings/bindingtester/spec/bindingApiTester.md).
+
+This package is the analog for the TPU-native stack:
+
+  fdb_api       the frozen `fdb`-style Python API (open/Database/
+                Transaction surface mirroring the reference python
+                binding's shapes, decoupled from internal client churn)
+  tuple         the FDB tuple layer: order-preserving packing of typed
+                tuples into keys (reference design/tuple.md encoding)
+  stack_tester  the stack-machine tester: replays an op stream through
+                the frozen API and diffs results against a direct
+                in-process client run (tests/test_bindings.py)
+
+The native C ABI half lives in conflict/native_src/conflict.cpp (cs_new/
+cs_resolve/...): the hot engine is callable from any C FFI today; a full
+client C ABI would wrap a network protocol and is tracked as a gap.
+"""
+
+from . import fdb_api as fdb  # noqa: F401
+from . import tuple as fdb_tuple  # noqa: F401

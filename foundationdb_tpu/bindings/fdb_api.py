@@ -1,0 +1,339 @@
+"""The frozen `fdb`-style Python API (reference bindings/python/fdb).
+
+A STABLE veneer over the internal client, shaped like the reference's
+python binding (which wraps fdb_c: bindings/c/fdb_c.cpp
+fdb_transaction_get :210 / fdb_transaction_commit :272): `open()` a
+database, `db[k]` sugar, `@transactional` retry decorator, transaction
+objects with get/set/clear/get_range/atomic ops/watch/on_error.  Internal
+client refactors must not change THIS surface — tests/test_bindings.py
+replays a stack-machine op stream through it and diffs against direct
+client calls (the reference's bindingtester role).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Iterable, List, Optional, Tuple
+
+_API_VERSION: Optional[int] = None
+MAX_API_VERSION = 710
+
+
+class FDBError(Exception):
+    """Stable error surface: .code matches the reference error codes
+    (core/error.py mirrors flow/error_definitions.h)."""
+
+    def __init__(self, code: int, description: str = "") -> None:
+        self.code = code
+        self.description = description
+        super().__init__(f"{description or 'fdb error'} ({code})")
+
+
+def api_version(version: int) -> None:
+    """Select the API version (reference fdb.api_version): must be called
+    before open(), at most once, with a supported version."""
+    global _API_VERSION
+    if _API_VERSION is not None and _API_VERSION != version:
+        raise RuntimeError(f"API version already set to {_API_VERSION}")
+    if not 14 <= version <= MAX_API_VERSION:
+        raise RuntimeError(f"API version {version} not supported")
+    _API_VERSION = version
+
+
+def _require_api_version() -> None:
+    if _API_VERSION is None:
+        raise RuntimeError("Call fdb.api_version() before using the API")
+
+
+def _wrap_error(e: BaseException) -> BaseException:
+    from ..core.error import FdbError as _Internal
+    if isinstance(e, _Internal):
+        return FDBError(e.code, e.name)
+    return e
+
+
+def open(cluster_spec: Any = None, event_loop: Any = None) -> "FDBDatabase":
+    """Open a database handle.
+
+    `cluster_spec` is a "host:port,..." coordinator string (the content
+    of an fdb.cluster file) for real clusters, or an internal Database
+    object (sim harnesses pass SimFdbCluster.database())."""
+    _require_api_version()
+    from ..client.database import Database
+    if isinstance(cluster_spec, Database):
+        return FDBDatabase(cluster_spec)
+    from ..client.database import open_cluster
+    loop, db = open_cluster(cluster_spec)
+    return FDBDatabase(db, loop=loop)
+
+
+def transactional(func):
+    """@fdb.transactional: the wrapped function's first argument may be a
+    Database (a transaction is created and retried until commit) or an
+    existing Transaction (caller owns commit) — reference semantics."""
+    @functools.wraps(func)
+    async def wrapper(db_or_tr, *args, **kwargs):
+        if isinstance(db_or_tr, FDBTransaction):
+            return await func(db_or_tr, *args, **kwargs)
+        tr = db_or_tr.create_transaction()
+        while True:
+            try:
+                result = await func(tr, *args, **kwargs)
+                await tr.commit()
+                return result
+            except FDBError as e:
+                await tr.on_error(e)
+    return wrapper
+
+
+class FDBDatabase:
+    def __init__(self, db: Any, loop: Any = None) -> None:
+        self._db = db
+        self._loop = loop
+
+    def create_transaction(self) -> "FDBTransaction":
+        return FDBTransaction(self._db.create_transaction())
+
+    # -- db-level conveniences (each one transaction, reference Database
+    # auto-retry wrappers) ---------------------------------------------------
+    async def get(self, key: bytes) -> Optional[bytes]:
+        @transactional
+        async def go(tr):
+            return await tr.get(key)
+        return await go(self)
+
+    async def set(self, key: bytes, value: bytes) -> None:
+        @transactional
+        async def go(tr):
+            tr.set(key, value)
+        await go(self)
+
+    async def clear(self, key: bytes) -> None:
+        @transactional
+        async def go(tr):
+            tr.clear(key)
+        await go(self)
+
+    async def get_range(self, begin: bytes, end: bytes, limit: int = 0,
+                        reverse: bool = False
+                        ) -> List[Tuple[bytes, bytes]]:
+        @transactional
+        async def go(tr):
+            return await tr.get_range(begin, end, limit=limit,
+                                      reverse=reverse)
+        return await go(self)
+
+
+class FDBTransaction:
+    """One transaction (reference fdb.Transaction over fdb_c handles)."""
+
+    def __init__(self, tr: Any) -> None:
+        self._tr = tr
+        self._cancelled = False
+        self.options = _TransactionOptions(tr)
+
+    def _check_cancelled(self) -> None:
+        if self._cancelled:
+            raise FDBError(1025, "transaction_cancelled")
+
+    # -- reads ---------------------------------------------------------------
+    async def get(self, key: bytes) -> Optional[bytes]:
+        self._check_cancelled()
+        try:
+            return await self._tr.get(bytes(key))
+        except Exception as e:  # noqa: BLE001
+            raise _wrap_error(e) from None
+
+    async def get_key(self, sel: "KeySelector") -> bytes:
+        """Resolve a key selector via range reads (the internal client
+        has no native selector op; offsets beyond +-1 are unsupported)."""
+        try:
+            if sel.offset == 1:
+                begin = (sel.key + b"\x00") if sel.or_equal else sel.key
+                rows = await self._tr.get_range(begin, b"\xff", limit=1)
+                return rows[0][0] if rows else b"\xff"
+            if sel.offset == 0:
+                end = (sel.key + b"\x00") if sel.or_equal else sel.key
+                rows = await self._tr.get_range(b"", end, limit=1,
+                                                reverse=True)
+                return rows[0][0] if rows else b""
+            raise FDBError(2000, "key selector offset unsupported")
+        except FDBError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise _wrap_error(e) from None
+
+    async def get_range(self, begin: bytes, end: bytes, limit: int = 0,
+                        reverse: bool = False
+                        ) -> List[Tuple[bytes, bytes]]:
+        try:
+            return await self._tr.get_range(bytes(begin), bytes(end),
+                                            limit=limit or 1_000_000,
+                                            reverse=reverse)
+        except Exception as e:  # noqa: BLE001
+            raise _wrap_error(e) from None
+
+    async def get_read_version(self) -> int:
+        try:
+            return await self._tr.get_read_version()
+        except Exception as e:  # noqa: BLE001
+            raise _wrap_error(e) from None
+
+    async def watch(self, key: bytes):
+        try:
+            return await self._tr.watch(bytes(key))
+        except Exception as e:  # noqa: BLE001
+            raise _wrap_error(e) from None
+
+    # -- writes --------------------------------------------------------------
+    def set(self, key: bytes, value: bytes) -> None:
+        self._tr.set(bytes(key), bytes(value))
+
+    def clear(self, key: bytes) -> None:
+        self._tr.clear(bytes(key))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._tr.clear(bytes(begin), bytes(end))
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._tr.add_read_conflict_range(bytes(begin), bytes(end))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._tr.add_write_conflict_range(bytes(begin), bytes(end))
+
+    # Atomic ops (reference fdb_transaction_atomic_op mutation types).
+    def _atomic(self, op, key: bytes, param: bytes) -> None:
+        self._tr.atomic_op(op, bytes(key), bytes(param))
+
+    def add(self, key: bytes, param: bytes) -> None:
+        from ..txn.types import MutationType
+        self._atomic(MutationType.AddValue, key, param)
+
+    def bit_and(self, key: bytes, param: bytes) -> None:
+        from ..txn.types import MutationType
+        self._atomic(MutationType.And, key, param)
+
+    def bit_or(self, key: bytes, param: bytes) -> None:
+        from ..txn.types import MutationType
+        self._atomic(MutationType.Or, key, param)
+
+    def bit_xor(self, key: bytes, param: bytes) -> None:
+        from ..txn.types import MutationType
+        self._atomic(MutationType.Xor, key, param)
+
+    def max(self, key: bytes, param: bytes) -> None:
+        from ..txn.types import MutationType
+        self._atomic(MutationType.Max, key, param)
+
+    def min(self, key: bytes, param: bytes) -> None:
+        from ..txn.types import MutationType
+        self._atomic(MutationType.Min, key, param)
+
+    def byte_max(self, key: bytes, param: bytes) -> None:
+        from ..txn.types import MutationType
+        self._atomic(MutationType.ByteMax, key, param)
+
+    def byte_min(self, key: bytes, param: bytes) -> None:
+        from ..txn.types import MutationType
+        self._atomic(MutationType.ByteMin, key, param)
+
+    @staticmethod
+    def _split_stamp_template(template: bytes) -> Tuple[bytes, int]:
+        """Reference >=API 520 convention: the template's trailing 4
+        little-endian bytes give the versionstamp offset."""
+        if len(template) < 4:
+            raise FDBError(2006, "versionstamp template too short")
+        off = int.from_bytes(template[-4:], "little")
+        body = template[:-4]
+        if off + 10 > len(body):
+            raise FDBError(2006, "versionstamp offset out of range")
+        return body, off
+
+    def set_versionstamped_key(self, key_template: bytes,
+                               value: bytes) -> None:
+        body, off = self._split_stamp_template(bytes(key_template))
+        self._tr.set_versionstamped_key(body, off, bytes(value))
+
+    def set_versionstamped_value(self, key: bytes,
+                                 value_template: bytes) -> None:
+        body, off = self._split_stamp_template(bytes(value_template))
+        self._tr.set_versionstamped_value(bytes(key), body, off)
+
+    # -- lifecycle -----------------------------------------------------------
+    async def commit(self) -> None:
+        self._check_cancelled()
+        try:
+            await self._tr.commit()
+        except Exception as e:  # noqa: BLE001
+            raise _wrap_error(e) from None
+
+    def get_committed_version(self) -> int:
+        return self._tr.committed_version
+
+    async def get_versionstamp(self) -> bytes:
+        try:
+            return await self._tr.get_versionstamp()
+        except Exception as e:  # noqa: BLE001
+            raise _wrap_error(e) from None
+
+    async def on_error(self, e: BaseException) -> None:
+        from ..core.error import FdbError as _Internal
+        if isinstance(e, FDBError):
+            e = _Internal(e.code, e.description)
+        try:
+            await self._tr.on_error(e)
+        except Exception as e2:  # noqa: BLE001
+            raise _wrap_error(e2) from None
+
+    def reset(self) -> None:
+        self._tr.reset()
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        """Reference fdb_transaction_cancel: the transaction may never
+        commit after this; reads/commit raise transaction_cancelled
+        until reset()."""
+        self._cancelled = True
+        self._tr.reset()
+
+
+class _TransactionOptions:
+    """Option surface (reference fdb_transaction_set_option): only the
+    options the internal client models; unknown setters raise."""
+
+    def __init__(self, tr: Any) -> None:
+        self._tr = tr
+
+    def set_access_system_keys(self) -> None:
+        self._tr.access_system_keys = True
+
+    def set_report_conflicting_keys(self) -> None:
+        self._tr.report_conflicting_keys = True
+
+    def set_timeout(self, ms: int) -> None:
+        self._tr.timeout = ms / 1000.0
+
+
+class KeySelector:
+    """first_greater_or_equal & friends (reference KeySelectorRef)."""
+
+    def __init__(self, key: bytes, or_equal: bool, offset: int) -> None:
+        self.key = bytes(key)
+        self.or_equal = or_equal
+        self.offset = offset
+
+    @classmethod
+    def last_less_than(cls, key):
+        return cls(key, False, 0)
+
+    @classmethod
+    def last_less_or_equal(cls, key):
+        return cls(key, True, 0)
+
+    @classmethod
+    def first_greater_than(cls, key):
+        return cls(key, True, 1)
+
+    @classmethod
+    def first_greater_or_equal(cls, key):
+        return cls(key, False, 1)

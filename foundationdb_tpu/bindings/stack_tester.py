@@ -1,0 +1,301 @@
+"""Stack-machine binding tester (reference bindings/bindingtester/spec/
+bindingApiTester.md + tests/api/ApiTester).
+
+The reference validates every language binding by driving it with a
+stream of stack-machine ops and diffing the resulting stack + database
+against another binding's run of the same stream.  Here the two
+"implementations" are (a) the frozen fdb_api surface and (b) direct
+internal-client calls — the tester proves the veneer is semantically
+transparent, so internal refactors that change behavior under the frozen
+API fail tests/test_bindings.py instead of shipping.
+
+Supported ops (a representative subset of the spec):
+  PUSH v | DUP | SWAP | POP | SUB | CONCAT | EMPTY_STACK
+  SET | GET | CLEAR | CLEAR_RANGE | GET_RANGE | ATOMIC_ADD | ATOMIC_MAX
+  COMMIT | RESET | NEW_TRANSACTION | GET_READ_VERSION
+  TUPLE_PACK n | TUPLE_UNPACK | TUPLE_RANGE n
+Operands come from the stack (last pushed = first popped), mirroring the
+spec's conventions; errors are pushed as (b"ERROR", code) so both
+executors must fail identically too.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from . import tuple as fdb_tuple
+
+
+class StackMachine:
+    """Executes one op stream against an executor (below)."""
+
+    def __init__(self, executor) -> None:
+        self.ex = executor
+        self.stack: List[Any] = []
+
+    def _pop(self, n: int = 1):
+        out = [self.stack.pop() for _ in range(n)]
+        return out[0] if n == 1 else out
+
+    async def run(self, ops: List[Tuple]) -> List[Any]:
+        for op in ops:
+            name, args = op[0], op[1:]
+            await self._step(name, args)
+        return self.stack
+
+    async def _step(self, name: str, args) -> None:
+        s = self.stack
+        if name == "PUSH":
+            s.append(args[0])
+        elif name == "DUP":
+            s.append(s[-1])
+        elif name == "SWAP":
+            i = args[0]
+            s[-1], s[-1 - i] = s[-1 - i], s[-1]
+        elif name == "POP":
+            self._pop()
+        elif name == "SUB":
+            a, b = self._pop(2)
+            s.append(a - b)
+        elif name == "CONCAT":
+            a, b = self._pop(2)
+            s.append(a + b)
+        elif name == "EMPTY_STACK":
+            s.clear()
+        elif name == "TUPLE_PACK":
+            n = args[0]
+            items = tuple(reversed(self._pop(n) if n > 1
+                                   else [self._pop()]))
+            s.append(fdb_tuple.pack(items))
+        elif name == "TUPLE_UNPACK":
+            packed = self._pop()
+            for item in fdb_tuple.unpack(packed):
+                s.append(fdb_tuple.pack((item,)))
+        elif name == "TUPLE_RANGE":
+            n = args[0]
+            items = tuple(reversed(self._pop(n) if n > 1
+                                   else [self._pop()]))
+            b, e = fdb_tuple.range_of(items)
+            s.append(b)
+            s.append(e)
+        else:
+            await self._db_step(name)
+
+    async def _db_step(self, name: str) -> None:
+        s = self.stack
+        try:
+            if name == "NEW_TRANSACTION":
+                self.ex.new_transaction()
+            elif name == "SET":
+                v, k = self._pop(2)
+                self.ex.set(k, v)
+            elif name == "GET":
+                k = self._pop()
+                r = await self.ex.get(k)
+                s.append(b"RESULT_NOT_PRESENT" if r is None else r)
+            elif name == "CLEAR":
+                self.ex.clear(self._pop())
+            elif name == "CLEAR_RANGE":
+                e, b = self._pop(2)
+                self.ex.clear_range(b, e)
+            elif name == "GET_RANGE":
+                limit, e, b = self._pop(3)
+                rows = await self.ex.get_range(b, e, limit)
+                out = []
+                for k, v in rows:
+                    out.append(k)
+                    out.append(v)
+                s.append(fdb_tuple.pack(tuple(out)))
+            elif name == "ATOMIC_ADD":
+                v, k = self._pop(2)
+                self.ex.atomic_add(k, v)
+            elif name == "ATOMIC_MAX":
+                v, k = self._pop(2)
+                self.ex.atomic_max(k, v)
+            elif name == "GET_READ_VERSION":
+                await self.ex.get_read_version()
+                s.append(b"GOT_READ_VERSION")
+            elif name == "COMMIT":
+                await self.ex.commit()
+                s.append(b"COMMITTED")
+                self.ex.new_transaction()
+            elif name == "RESET":
+                self.ex.reset()
+            else:
+                raise ValueError(f"unknown op {name}")
+        except Exception as e:  # noqa: BLE001 — errors are data here
+            code = getattr(e, "code", None)
+            if code is None:
+                raise
+            retried = await self.ex.on_error(e)
+            s.append((b"ERROR", int(code), retried))
+
+
+class FrozenApiExecutor:
+    """Runs db ops through the frozen fdb_api surface."""
+
+    def __init__(self, fdb_db) -> None:
+        self.db = fdb_db
+        self.tr = None
+        self.new_transaction()
+
+    def new_transaction(self) -> None:
+        self.tr = self.db.create_transaction()
+
+    def set(self, k, v):
+        self.tr.set(k, v)
+
+    def clear(self, k):
+        self.tr.clear(k)
+
+    def clear_range(self, b, e):
+        self.tr.clear_range(b, e)
+
+    async def get(self, k):
+        return await self.tr.get(k)
+
+    async def get_range(self, b, e, limit):
+        return await self.tr.get_range(b, e, limit=limit)
+
+    def atomic_add(self, k, v):
+        self.tr.add(k, v)
+
+    def atomic_max(self, k, v):
+        self.tr.max(k, v)
+
+    async def get_read_version(self):
+        return await self.tr.get_read_version()
+
+    async def commit(self):
+        await self.tr.commit()
+
+    def reset(self):
+        self.tr.reset()
+
+    async def on_error(self, e) -> bool:
+        """Returns True if the error was retryable (transaction reset for
+        retry) — part of the compared surface."""
+        try:
+            await self.tr.on_error(e)
+            return True
+        except Exception:  # noqa: BLE001
+            self.new_transaction()
+            return False
+
+
+class DirectClientExecutor:
+    """The same ops as raw internal-client calls (the comparison side)."""
+
+    def __init__(self, db) -> None:
+        self.db = db
+        self.tr = None
+        self.new_transaction()
+
+    def new_transaction(self) -> None:
+        self.tr = self.db.create_transaction()
+
+    def set(self, k, v):
+        self.tr.set(bytes(k), bytes(v))
+
+    def clear(self, k):
+        self.tr.clear(bytes(k))
+
+    def clear_range(self, b, e):
+        self.tr.clear(bytes(b), bytes(e))
+
+    async def get(self, k):
+        return await self.tr.get(bytes(k))
+
+    async def get_range(self, b, e, limit):
+        return await self.tr.get_range(bytes(b), bytes(e),
+                                       limit=limit or 1_000_000)
+
+    def atomic_add(self, k, v):
+        from ..txn.types import MutationType
+        self.tr.atomic_op(MutationType.AddValue, bytes(k), bytes(v))
+
+    def atomic_max(self, k, v):
+        from ..txn.types import MutationType
+        self.tr.atomic_op(MutationType.Max, bytes(k), bytes(v))
+
+    async def get_read_version(self):
+        return await self.tr.get_read_version()
+
+    async def commit(self):
+        await self.tr.commit()
+
+    def reset(self):
+        self.tr.reset()
+
+    async def on_error(self, e) -> bool:
+        try:
+            await self.tr.on_error(e)
+            return True
+        except Exception:  # noqa: BLE001
+            self.new_transaction()
+            return False
+
+
+def generate_ops(rng, n_ops: int, keyspace: int = 40) -> List[Tuple]:
+    """A random-but-valid op stream (the generator keeps a model stack
+    depth so pops never underflow)."""
+    ops: List[Tuple] = [("NEW_TRANSACTION",)]
+    depth = 0
+    for _ in range(n_ops):
+        choices = ["PUSH", "SET", "GET", "CLEAR", "ATOMIC", "COMMIT",
+                   "GET_RANGE", "CLEAR_RANGE", "READ_VERSION"]
+        if depth >= 1:
+            choices += ["DUP", "POP"]
+        if depth >= 2:
+            choices += ["CONCAT_B"]
+        c = choices[int(rng.integers(0, len(choices)))]
+        k = b"bt/%03d" % int(rng.integers(0, keyspace))
+        v = b"v%05d" % int(rng.integers(0, 100000))
+        if c == "PUSH":
+            ops.append(("PUSH", v))
+            depth += 1
+        elif c == "DUP":
+            ops.append(("DUP",))
+            depth += 1
+        elif c == "POP":
+            ops.append(("POP",))
+            depth -= 1
+        elif c == "CONCAT_B":
+            ops.append(("CONCAT",))
+            depth -= 1
+        elif c == "SET":
+            ops.append(("PUSH", k))
+            ops.append(("PUSH", v))
+            ops.append(("SET",))
+        elif c == "GET":
+            ops.append(("PUSH", k))
+            ops.append(("GET",))
+            depth += 1
+        elif c == "CLEAR":
+            ops.append(("PUSH", k))
+            ops.append(("CLEAR",))
+        elif c == "CLEAR_RANGE":
+            k2 = b"bt/%03d" % int(rng.integers(0, keyspace))
+            b, e = sorted([k, k2])
+            ops.append(("PUSH", b))
+            ops.append(("PUSH", e + b"\x00"))
+            ops.append(("CLEAR_RANGE",))
+        elif c == "GET_RANGE":
+            ops.append(("PUSH", b"bt/"))
+            ops.append(("PUSH", b"bt0"))
+            ops.append(("PUSH", 10))
+            ops.append(("GET_RANGE",))
+            depth += 1
+        elif c == "ATOMIC":
+            ops.append(("PUSH", k))
+            ops.append(("PUSH", (int(rng.integers(0, 1000))
+                                 ).to_bytes(8, "little")))
+            ops.append(("ATOMIC_ADD" if rng.integers(0, 2) == 0
+                        else "ATOMIC_MAX",))
+        elif c == "READ_VERSION":
+            ops.append(("GET_READ_VERSION",))
+            depth += 1
+        elif c == "COMMIT":
+            ops.append(("COMMIT",))
+            depth += 1
+    return ops

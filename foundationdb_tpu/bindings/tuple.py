@@ -1,0 +1,166 @@
+"""The FDB tuple layer: order-preserving typed-tuple key encoding.
+
+Reference: design/tuple.md + bindings/python/fdb/tuple.py semantics —
+pack() maps tuples of (None | bytes | str | int | float | bool | nested
+tuple) to byte strings whose lexicographic order equals the natural
+order of the tuples (None < bytes < str < int < float < bool < tuple),
+and unpack() inverts it exactly.  This is the public wire format every
+reference binding shares, so layers built on one binding interoperate
+with all others; the encoding below follows the published spec:
+
+  \\x00                      null (escaped as \\x00\\xff inside nests)
+  \\x01 <bytes>  \\x00        byte string, \\x00 escaped as \\x00\\xff
+  \\x02 <utf8>   \\x00        unicode string, same escape
+  \\x05 ... \\x00             nested tuple
+  \\x0c..\\x13                int, negative, 8..1 bytes (offset-complement)
+  \\x14                      int zero
+  \\x15..\\x1c                int, positive, 1..8 bytes
+  \\x20 <8B IEEE>            double, sign-flipped for ordering
+  \\x26 / \\x27               false / true
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, List, Tuple
+
+NULL = 0x00
+BYTES = 0x01
+STRING = 0x02
+NESTED = 0x05
+INT_ZERO = 0x14
+DOUBLE = 0x20
+FALSE = 0x26
+TRUE = 0x27
+
+_ESCAPE = b"\x00\xff"
+
+
+def _encode_bytes(code: int, data: bytes) -> bytes:
+    return bytes([code]) + data.replace(b"\x00", _ESCAPE) + b"\x00"
+
+
+def _encode_int(v: int) -> bytes:
+    if v == 0:
+        return bytes([INT_ZERO])
+    if v > 0:
+        n = (v.bit_length() + 7) // 8
+        if n > 8:
+            raise ValueError(f"int too large for tuple encoding: {v}")
+        return bytes([INT_ZERO + n]) + v.to_bytes(n, "big")
+    n = ((-v).bit_length() + 7) // 8
+    if n > 8:
+        raise ValueError(f"int too small for tuple encoding: {v}")
+    # Offset complement: stored bytes are (2^(8n) - 1) + v, which orders
+    # more-negative values first.
+    return bytes([INT_ZERO - n]) + ((1 << (8 * n)) - 1 + v).to_bytes(n, "big")
+
+
+def _encode_double(v: float) -> bytes:
+    raw = bytearray(struct.pack(">d", v))
+    # IEEE sign-flip transform: positive numbers get the sign bit set,
+    # negatives are fully complemented — total order matches float order.
+    if raw[0] & 0x80:
+        raw = bytearray(b ^ 0xFF for b in raw)
+    else:
+        raw[0] ^= 0x80
+    return bytes([DOUBLE]) + bytes(raw)
+
+
+def _encode(value: Any, nested: bool) -> bytes:
+    if value is None:
+        return b"\x00\xff" if nested else b"\x00"
+    if isinstance(value, bool):           # before int (bool is int)
+        return bytes([TRUE if value else FALSE])
+    if isinstance(value, (bytes, bytearray)):
+        return _encode_bytes(BYTES, bytes(value))
+    if isinstance(value, str):
+        return _encode_bytes(STRING, value.encode("utf-8"))
+    if isinstance(value, int):
+        return _encode_int(value)
+    if isinstance(value, float):
+        return _encode_double(value)
+    if isinstance(value, (tuple, list)):
+        out = bytes([NESTED])
+        for item in value:
+            out += _encode(item, nested=True)
+        return out + b"\x00"
+    raise TypeError(f"unpackable tuple element {type(value).__name__}")
+
+
+def pack(t: Tuple[Any, ...]) -> bytes:
+    """Encode a tuple to an order-preserving byte string."""
+    return b"".join(_encode(v, nested=False) for v in t)
+
+
+def _decode_escaped(data: bytes, pos: int) -> Tuple[bytes, int]:
+    out = bytearray()
+    while True:
+        i = data.index(b"\x00", pos)
+        if i + 1 < len(data) and data[i + 1] == 0xFF:
+            out += data[pos:i] + b"\x00"
+            pos = i + 2
+        else:
+            out += data[pos:i]
+            return bytes(out), i + 1
+
+
+def _decode(data: bytes, pos: int, nested: bool) -> Tuple[Any, int]:
+    code = data[pos]
+    if code == NULL:
+        if nested and pos + 1 < len(data) and data[pos + 1] == 0xFF:
+            return None, pos + 2
+        return None, pos + 1
+    if code == BYTES:
+        return _decode_escaped(data, pos + 1)
+    if code == STRING:
+        raw, p = _decode_escaped(data, pos + 1)
+        return raw.decode("utf-8"), p
+    if code == NESTED:
+        items: List[Any] = []
+        p = pos + 1
+        while True:
+            if data[p] == NULL and not (p + 1 < len(data)
+                                        and data[p + 1] == 0xFF):
+                return tuple(items), p + 1
+            v, p = _decode(data, p, nested=True)
+            items.append(v)
+    if INT_ZERO - 8 <= code <= INT_ZERO + 8:
+        n = code - INT_ZERO
+        if n == 0:
+            return 0, pos + 1
+        if n > 0:
+            return int.from_bytes(data[pos + 1:pos + 1 + n], "big"), \
+                pos + 1 + n
+        n = -n
+        return int.from_bytes(data[pos + 1:pos + 1 + n], "big") - \
+            ((1 << (8 * n)) - 1), pos + 1 + n
+    if code == DOUBLE:
+        raw = bytearray(data[pos + 1:pos + 9])
+        if raw[0] & 0x80:
+            raw[0] ^= 0x80
+        else:
+            raw = bytearray(b ^ 0xFF for b in raw)
+        return struct.unpack(">d", bytes(raw))[0], pos + 9
+    if code == FALSE:
+        return False, pos + 1
+    if code == TRUE:
+        return True, pos + 1
+    raise ValueError(f"unknown tuple type code 0x{code:02x} at {pos}")
+
+
+def unpack(data: bytes) -> Tuple[Any, ...]:
+    """Decode pack()'s output back to the original tuple."""
+    items: List[Any] = []
+    pos = 0
+    while pos < len(data):
+        v, pos = _decode(data, pos, nested=False)
+        items.append(v)
+    return tuple(items)
+
+
+def range_of(t: Tuple[Any, ...]) -> Tuple[bytes, bytes]:
+    """(begin, end) spanning every tuple that extends `t` (reference
+    fdb.tuple.range): pack(t)+\\x00 <= x < pack(t)+\\xff."""
+    p = pack(t)
+    return p + b"\x00", p + b"\xff"

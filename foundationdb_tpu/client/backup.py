@@ -31,11 +31,38 @@ from ..core.scheduler import delay
 from ..core.trace import TraceEvent
 from ..core.wire import Reader, Writer
 from ..txn.types import Mutation, MutationType, Version
-from ..server.system_data import BACKUP_STARTED_KEY, BACKUP_TAG
+from ..server.system_data import (BACKUP_CONTAINER_KEY, BACKUP_STARTED_KEY,
+                                  BACKUP_TAG)
+
+
+# -- container URLs (reference BackupContainerFileSystem::openContainer:
+# file:// and blobstore:// URLs resolve to IBackupContainer impls) ----------
+# "sim://name" resolves against a process-global blob store (the sim's
+# stand-in for remote object storage — one shared filesystem every role
+# and agent can reach); "file:///path" resolves to a real directory.
+
+_sim_blob_store = None
+
+
+def set_sim_blob_store(fs) -> None:
+    global _sim_blob_store
+    _sim_blob_store = fs
+
+
+def open_container(url: str) -> "BackupContainer":
+    if url.startswith("sim://"):
+        if _sim_blob_store is None:
+            raise err("operation_failed", "no sim blob store registered")
+        return BackupContainer(_sim_blob_store, url[len("sim://"):])
+    if url.startswith("file://"):
+        from ..server.real_fs import RealFileSystem
+        path, _, name = url[len("file://"):].rpartition("/")
+        return BackupContainer(RealFileSystem(path or "."), name)
+    raise err("operation_failed", f"unknown container url {url!r}")
 
 
 class BackupContainer:
-    """One named backup in a simulated filesystem directory."""
+    """One named backup in a (simulated or real) filesystem directory."""
 
     def __init__(self, fs, name: str) -> None:
         self.fs = fs
@@ -65,7 +92,48 @@ class BackupContainer:
         await f.write(0, w.done())
         await f.sync()
 
+    # Partitioned snapshot (reference RangeFile kvranges/): one part per
+    # TaskBucket chunk task + a completion marker naming the part count.
+    async def write_snapshot_part(self, part: int, version: Version,
+                                  kvs: List[Tuple[bytes, bytes]]) -> None:
+        w = Writer().i64(version).u32(len(kvs))
+        for k, v in kvs:
+            w.bytes_(k).bytes_(v)
+        f = self.fs.open(f"{self.name}.snap.part{part}")
+        await f.truncate(0)
+        await f.write(0, w.done())
+        await f.sync()
+
+    async def write_snapshot_complete(self, n_parts: int,
+                                      version: Version) -> None:
+        f = self.fs.open(f"{self.name}.snap.done")
+        await f.write(0, Writer().u32(n_parts).i64(version).done())
+        await f.sync()
+
+    async def snapshot_complete(self) -> bool:
+        try:
+            f = self.fs.open(f"{self.name}.snap.done", create=False)
+            return f.size() >= 12
+        except FdbError:
+            return False
+
     async def read_snapshot(self) -> Tuple[Version, List]:
+        try:
+            f = self.fs.open(f"{self.name}.snap.done", create=False)
+            r = Reader(await f.read(0, f.size()))
+            n_parts, version = r.u32(), r.i64()
+            kvs: List[Tuple[bytes, bytes]] = []
+            for part in range(n_parts):
+                pf = self.fs.open(f"{self.name}.snap.part{part}",
+                                  create=False)
+                pr = Reader(await pf.read(0, pf.size()))
+                pr.i64()
+                kvs.extend((pr.bytes_(), pr.bytes_())
+                           for _ in range(pr.u32()))
+            return version, kvs
+        except FdbError:
+            pass
+        # Legacy single-file snapshot layout.
         f = self.fs.open(f"{self.name}.snapshot", create=False)
         r = Reader(await f.read(0, f.size()))
         version = r.i64()
@@ -83,8 +151,47 @@ class BackupContainer:
         self._log_offset += 4 + len(blob)
         await f.sync()
 
+    async def log_tail(self) -> Tuple[int, Version]:
+        """(byte_offset, last_version) of the intact log prefix — where a
+        backup worker recruited after a recovery resumes appending.  One
+        frame scan, no file creation for a fresh container."""
+        try:
+            f = self.fs.open(f"{self.name}.log", create=False)
+        except FdbError:
+            self._log_offset = 0
+            return 0, 0
+        data = await f.read(0, f.size())
+        off = 0
+        last_v: Version = 0
+        while off + 4 <= len(data):
+            n = int.from_bytes(data[off:off + 4], "little")
+            if off + 4 + n > len(data):
+                break          # torn tail (unclean stop): overwritten next
+            last_v = Reader(data[off + 4:off + 12]).i64()
+            off += 4 + n
+        self._log_offset = off
+        return off, last_v
+
+    async def write_frontier(self, version: Version) -> None:
+        """Durable capture frontier: versions <= this are fully captured
+        (even when they carried no user mutations) — what stop-drain and
+        restorability checks poll."""
+        f = self.fs.open(f"{self.name}.frontier")
+        await f.write(0, Writer().i64(version).done())
+        await f.sync()
+
+    async def read_frontier(self) -> Version:
+        try:
+            f = self.fs.open(f"{self.name}.frontier", create=False)
+            return Reader(await f.read(0, 8)).i64()
+        except FdbError:
+            return 0
+
     async def read_log(self) -> List[Tuple[Version, List[Mutation]]]:
-        f = self.fs.open(f"{self.name}.log", create=False)
+        try:
+            f = self.fs.open(f"{self.name}.log", create=False)
+        except FdbError:
+            return []   # no user mutation was ever captured
         data = await f.read(0, f.size())
         out = []
         off = 0
@@ -101,108 +208,164 @@ class BackupContainer:
         return out
 
 
+SNAPSHOT_CHUNK = 500
+
+
+async def _snapshot_chunk_task(db, bucket, task) -> None:
+    """One TaskBucket snapshot task (reference FileBackupAgent's
+    RangeFile tasks): read a chunk at the FIXED snapshot version, write
+    it as a snapshot part, then — in the SAME transaction that finishes
+    this task — either chain the next chunk's task or mark the snapshot
+    complete.  Any agent can execute/resume any chunk."""
+    url = task.params[b"url"].decode()
+    cursor = task.params[b"cursor"]
+    snap_v = int(task.params[b"snap_v"])
+    part = int(task.params[b"part"])
+    container = open_container(url)
+    # The data read is a THROWAWAY snapshot transaction at the fixed
+    # version — never committed, so its full-range read takes no conflict
+    # ranges (a committed read at an old version would abort against
+    # every concurrent write, forever).  The part file is idempotent
+    # (same version -> same content), so re-execution after a reclaim is
+    # safe; only the chain/finish transaction below commits.
+    tr = db.create_transaction()
+    while True:
+        try:
+            tr.set_read_version(snap_v)
+            chunk = await tr.get_range(cursor, b"\xff",
+                                       limit=SNAPSHOT_CHUNK)
+            break
+        except FdbError as e:
+            await tr.on_error(e)
+            tr = db.create_transaction()
+    await container.write_snapshot_part(part, snap_v, chunk)
+    done = len(chunk) < SNAPSHOT_CHUNK
+    if done:
+        await container.write_snapshot_complete(part + 1, snap_v)
+    t = db.create_transaction()
+    while True:
+        try:
+            if not done:
+                bucket.add(t, "backup_snapshot_chunk", {
+                    b"url": url.encode(),
+                    b"cursor": chunk[-1][0] + b"\x00",
+                    b"snap_v": b"%d" % snap_v,
+                    b"part": b"%d" % (part + 1)})
+            await bucket.finish(t, task)
+            await t.commit()
+            if done:
+                TraceEvent("BackupSnapshotDone").detail(
+                    "Parts", part + 1).detail("Version", snap_v).log()
+            return
+        except FdbError as e:
+            await t.on_error(e)
+
+
+BACKUP_TASK_HANDLERS = {"backup_snapshot_chunk": _snapshot_chunk_task}
+
+
 class FileBackupAgent:
-    """Drives one backup of a simulated cluster (reference BackupAgent)."""
+    """Drives one backup (reference FileBackupAgent + backup_agent):
+    activation commits the container URL + capture flag (the recruited
+    backup worker ROLE appends the log stream, server/backup_worker.py);
+    the snapshot is a TaskBucket task chain any agent can resume."""
 
     def __init__(self, cluster, db, fs, name: str = "backup") -> None:
+        from .taskbucket import TaskBucket
         self.cluster = cluster
         self.db = db
+        # The fs acts as this test universe's shared blob store.
+        set_sim_blob_store(fs)
+        self.url = f"sim://{name}"
         self.container = BackupContainer(fs, name)
+        self.bucket = TaskBucket(prefix=b"\xff/taskBucket/backup/")
         self.start_version: Version = 0
         self.snapshot_version: Version = 0
         self.end_version: Version = 0
-        self._worker_f = None
-        self._worker_stop = False
-        self._frontier: Version = 0   # highest log-system version seen
+        self._agent_f = None
 
     async def _set_backup_flag(self, on: bool) -> Version:
         t = self.db.create_transaction()
         t.access_system_keys = True
         while True:
             try:
+                if on:
+                    # Container URL FIRST: proxies apply mutations in
+                    # order, and the flag's master nudge carries the url.
+                    t.set(BACKUP_CONTAINER_KEY, self.url.encode())
                 t.set(BACKUP_STARTED_KEY, b"1" if on else b"0")
                 return await t.commit()
             except FdbError as e:
                 await t.on_error(e)
 
-    async def _backup_worker(self) -> None:
-        """Pull BACKUP_TAG and append log records (reference
-        BackupWorker.actor.cpp:1033 pull loop)."""
-        fetch_from = self.start_version + 1
-        while True:
-            cc = self.cluster.current_cc()
-            info = cc.db_info if cc is not None else None
-            if info is None or not info.tlogs:
-                await delay(0.2)
-                continue
-            from ..server.commit_proxy import LogSystemClient
-            ls = LogSystemClient(info.tlogs, getattr(
-                self.cluster.config, "log_replication", 1))
-            try:
-                reply = await ls.peek_tag(BACKUP_TAG, fetch_from)
-            except FdbError:
-                await delay(0.2)
-                continue
-            for version, msgs in reply.messages:
-                if version >= fetch_from:
-                    await self.container.append_log(version, msgs)
-                    self.end_version = max(self.end_version, version)
-            self._frontier = max(self._frontier, reply.max_known_version)
-            if reply.messages:
-                last = reply.messages[-1][0]
-                fetch_from = max(fetch_from, last + 1)
-                ls.pop(BACKUP_TAG, last)
-            elif self._worker_stop:
-                return
-            else:
-                await delay(0.05)
+    def run_agent(self, agent_id: str = "agent0"):
+        """Start a task-executing agent loop (any number may run; each
+        claims snapshot chunks from the shared bucket)."""
+        from .taskbucket import run_tasks
+        return self.cluster.loop.spawn(
+            run_tasks(self.db, self.bucket, BACKUP_TASK_HANDLERS,
+                      agent_id=agent_id),
+            f"backupAgent.{agent_id}")
 
     async def submit(self) -> None:
-        """Activate mutation capture, then write a consistent snapshot
-        (ongoing writes land in the log stream meanwhile)."""
+        """Activate capture (worker role recruited via the proxies' master
+        nudge) and enqueue the snapshot task chain."""
         self.start_version = await self._set_backup_flag(True)
         self.end_version = self.start_version
-        self._worker_f = self.cluster.loop.spawn(
-            self._backup_worker(), "backupWorker")
-        # Chunked full-range snapshot at one read version.
         t = self.db.create_transaction()
         while True:
             try:
-                kvs = []
-                cursor = b""
-                while True:
-                    chunk = await t.get_range(cursor, b"\xff", limit=1000)
-                    kvs.extend(chunk)
-                    if len(chunk) < 1000:
-                        break
-                    cursor = chunk[-1][0] + b"\x00"
                 self.snapshot_version = (await t.get_read_version()).version
                 break
             except FdbError as e:
                 await t.on_error(e)
-        await self.container.write_snapshot(self.snapshot_version, kvs)
-        TraceEvent("BackupSnapshotDone").detail(
-            "Keys", len(kvs)).detail("Version", self.snapshot_version).log()
+        await self.bucket.add_task(self.db, "backup_snapshot_chunk", {
+            b"url": self.url.encode(), b"cursor": b"",
+            b"snap_v": b"%d" % self.snapshot_version, b"part": b"0"})
+        if self._agent_f is None:
+            self._agent_f = self.run_agent()
+        # Wait for the chunk chain to finish (the bucket drains).
+        while not await self.container.snapshot_complete():
+            await delay(0.1)
 
     async def stop(self) -> Version:
-        """Deactivate capture and drain the worker; the backup restores to
-        any state up to the returned end version."""
+        """Deactivate capture and wait for the worker role's durable
+        frontier to pass the stop commit; the backup restores to any
+        state up to the returned end version."""
         stop_version = await self._set_backup_flag(False)
-        # Drain: the worker's view of the log stream must pass the stop
-        # commit (end_version only advances on captured mutations; the
-        # frontier advances on every peek).
-        while self._frontier < stop_version:
-            await delay(0.05)
+        stalls = 0
+        while await self.container.read_frontier() < stop_version:
+            await delay(0.1)
+            stalls += 1
+            if stalls % 50 == 0:
+                # Self-heal a LOST recruitment (the proxy nudge is one-way
+                # and master-side recruitment best-effort): re-touch the
+                # container key so the metadata applier re-nudges and a
+                # missing worker gets recruited instead of this drain
+                # waiting forever.
+                t = self.db.create_transaction()
+                t.access_system_keys = True
+                try:
+                    t.set(BACKUP_CONTAINER_KEY, self.url.encode())
+                    t.set(BACKUP_STARTED_KEY, b"0")
+                    await t.commit()
+                except FdbError:
+                    pass
+                TraceEvent("BackupStopDrainStalled").detail(
+                    "Frontier", await self.container.read_frontier()).detail(
+                    "StopVersion", stop_version).log()
+        records = await self.container.read_log()
+        last_logged = records[-1][0] if records else self.snapshot_version
         # A user transaction batched AFTER the flag-off mutation shares
         # commit version stop_version but is not captured; the backup only
         # claims coverage through stop_version - 1.
-        self.end_version = max(min(self.end_version, stop_version - 1),
+        self.end_version = max(min(last_logged, stop_version - 1),
                                self.snapshot_version)
-        self._worker_stop = True
-        await self._worker_f
         await self.container.write_meta(self.start_version,
                                         self.snapshot_version,
                                         self.end_version)
+        if self._agent_f is not None and not self._agent_f.is_ready():
+            self._agent_f.cancel()
         TraceEvent("BackupComplete").detail(
             "Start", self.start_version).detail(
             "Snapshot", self.snapshot_version).detail(

@@ -330,6 +330,16 @@ class Transaction:
     GRV_TIMEOUT = 5.0
     COMMIT_TIMEOUT = 10.0
 
+    def set_read_version(self, version: Version) -> None:
+        """Read at a caller-chosen version (reference
+        fdb_transaction_set_read_version): chunked backup snapshots read
+        every chunk at ONE version for a consistent image."""
+        from types import SimpleNamespace
+        from ..core.futures import Promise
+        p: Promise = Promise()
+        p.send(SimpleNamespace(version=version))
+        self._read_version = p.get_future()
+
     async def _ensure_read_version(self) -> Version:
         from ..core.futures import wait_any
         if self._read_version is None:
@@ -365,6 +375,32 @@ class Transaction:
             rows.append((p + e, b"\x00"))
         return rows
 
+    # SpecialKeySpace modules beyond conflicting_keys (reference
+    # SpecialKeySpace.actor.cpp module registry): status json and the
+    # management mirror — read-your-cluster through plain key reads.
+    STATUS_JSON_KEY = b"\xff\xff/status/json"
+    MANAGEMENT_EXCLUDED_PREFIX = b"\xff\xff/management/excluded/"
+
+    async def _special_key_get(self, key: bytes) -> Optional[bytes]:
+        if key == self.STATUS_JSON_KEY:
+            import json as _json
+            get_status = getattr(self.db.cluster, "get_status", None)
+            if get_status is None:
+                return None
+            doc = await get_status()
+            return _json.dumps(doc, default=str).encode()
+        if key.startswith(self.MANAGEMENT_EXCLUDED_PREFIX):
+            from ..server.system_data import excluded_key
+            tag = key[len(self.MANAGEMENT_EXCLUDED_PREFIX):]
+            sub = self.db.create_transaction()
+            sub.access_system_keys = True
+            try:
+                raw = await sub.get(excluded_key(int(tag)))
+            except ValueError:
+                return None
+            return raw
+        return None
+
     # -- reads ---------------------------------------------------------------
     async def get(self, key: bytes, snapshot: bool = False
                   ) -> Optional[bytes]:
@@ -373,6 +409,9 @@ class Transaction:
                 if k == key:
                     return v
             return None
+        if key.startswith(b"\xff\xff/status/") or \
+                key.startswith(b"\xff\xff/management/"):
+            return await self._special_key_get(key)
         _check_key(key, self.access_system_keys)
         if not snapshot:
             self.read_conflict_ranges.append((key, key_after(key)))

@@ -11,8 +11,9 @@ from __future__ import annotations
 
 from typing import Iterable, List
 
-from ..core.error import FdbError
-from ..server.system_data import EXCLUDED_END, EXCLUDED_PREFIX, excluded_key
+from ..core.error import FdbError, err
+from ..server.system_data import (COORDINATORS_KEY, EXCLUDED_END,
+                                  EXCLUDED_PREFIX, excluded_key)
 
 
 async def _retrying(db, fn):
@@ -75,6 +76,106 @@ async def change_configuration(db, **fields) -> None:
                 t.clear(conf_key(name))
             else:
                 t.set(conf_key(name), str(value).encode())
+    await _retrying(db, go)
+
+
+async def change_coordinators(db, new_spec: str) -> None:
+    """changeQuorum (reference fdbclient/ManagementAPI.actor.cpp
+    changeQuorumChecker): verify the target quorum answers a coordinated
+    read, then commit the new connection spec to \\xff/coordinators.  The
+    master notices the divergence, seeds the new quorum with the current
+    DBCoreState, forwards the old one, and ends its epoch; workers and
+    clients follow the forward replies onto the new quorum
+    (server/coordination.py move_coordinated_state)."""
+    from ..server.coordination import (CoordinatedState, normalize_spec,
+                                       parse_spec)
+    new_spec = normalize_spec(new_spec)   # committed form is canonical
+    coords = parse_spec(new_spec)
+    if not coords:
+        raise err("client_invalid_operation", "empty coordinator spec")
+    cur_coords = getattr(db.cluster, "coordinators", None) or []
+    cur_addrs = {(c.reg_read.address.ip, c.reg_read.address.port)
+                 for c in cur_coords
+                 if getattr(c.reg_read, "address", None) is not None}
+    new_addrs = {(c.reg_read.address.ip, c.reg_read.address.port)
+                 for c in coords}
+    if cur_addrs & new_addrs:
+        raise err("client_invalid_operation",
+                  "new quorum must not share members with the current one "
+                  "(single-register forward limitation; change in two "
+                  "disjoint steps)")
+    probe = CoordinatedState(coords)
+    try:
+        await probe.read()
+    except FdbError as e:
+        if e.name == "coordinators_changed":
+            raise err("client_invalid_operation",
+                      f"target quorum {new_spec} is itself forwarded")
+        raise
+
+    async def go(t):
+        t.set(COORDINATORS_KEY, new_spec.encode())
+    await _retrying(db, go)
+
+
+async def get_coordinators(db) -> str:
+    """The committed coordinator spec ("" before any changeQuorum)."""
+    async def go(t):
+        raw = await t.get(COORDINATORS_KEY)
+        return raw.decode() if raw else ""
+    return await _retrying(db, go)
+
+
+async def set_knob(db, name: str, value, scope: str = "server") -> None:
+    """Dynamic knob change (reference `fdbcli setknob` through the config
+    DB): commits \\xff/knobs/<scope>/<name> and bumps the change marker;
+    every worker's LocalConfiguration watch applies it live."""
+    from ..server.system_data import KNOBS_CHANGED_KEY, knob_key
+    if scope not in ("server", "client", "flow"):
+        raise err("client_invalid_operation", f"unknown knob scope {scope}")
+
+    async def go(t):
+        if value is None:
+            t.clear(knob_key(scope, name))
+        else:
+            t.set(knob_key(scope, name), str(value).encode())
+        t.set(KNOBS_CHANGED_KEY, b"1")
+    await _retrying(db, go)
+
+
+async def get_knob_overrides(db) -> dict:
+    """Committed dynamic-knob overrides: {'scope/NAME': raw}."""
+    from ..server.system_data import KNOBS_END, KNOBS_PREFIX
+
+    async def go(t):
+        rows = await t.get_range(KNOBS_PREFIX, KNOBS_END)
+        return {k[len(KNOBS_PREFIX):].decode(): v.decode()
+                for k, v in rows}
+    return await _retrying(db, go)
+
+
+async def cache_range(db, begin: bytes, end: bytes) -> None:
+    """Mark [begin, end) as cached (reference `fdbcli cache_range set`):
+    commit proxies mirror its mutations onto CACHE_TAG and the
+    StorageCache roles fetch + serve it (worker.py _storage_cache_watch)."""
+    from ..server.system_data import (CACHE_RANGES_CHANGED_KEY,
+                                      cache_range_key)
+    if not begin < end:
+        raise err("inverted_range", "cache_range begin >= end")
+
+    async def go(t):
+        t.set(cache_range_key(begin), end)
+        t.set(CACHE_RANGES_CHANGED_KEY, b"1")
+    await _retrying(db, go)
+
+
+async def uncache_range(db, begin: bytes) -> None:
+    from ..server.system_data import (CACHE_RANGES_CHANGED_KEY,
+                                      cache_range_key)
+
+    async def go(t):
+        t.clear(cache_range_key(begin))
+        t.set(CACHE_RANGES_CHANGED_KEY, b"1")
     await _retrying(db, go)
 
 

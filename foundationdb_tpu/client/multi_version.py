@@ -1,0 +1,159 @@
+"""Multi-version client (reference fdbclient/MultiVersionTransaction.actor.cpp
+:596 MultiVersionDatabase + MultiVersionApi).
+
+The reference ships every past client library inside the current one and
+connects with whichever speaks the cluster's protocol version, so a
+cluster upgrade never requires a lockstep client upgrade: the client
+watches the protocol version through the coordinators, swaps the
+underlying implementation when it changes, and in-flight transactions
+fail with cluster_version_changed (retryable) so retry loops land on the
+new implementation transparently.
+
+Here each "client library" is a factory registered against a protocol
+version; MultiVersionDatabase monitors ClientDBInfo.protocol_version and
+delegates through the matching implementation.  With only one version in
+the registry this degrades to a plain client — the machinery (version
+watch, implementation swap, transparent transaction failover) is what an
+upgrade needs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ..core.error import err
+from ..core.futures import AsyncVar
+from ..core.scheduler import spawn
+from ..core.trace import Severity, TraceEvent
+
+
+class MultiVersionDatabase:
+    """Database facade selecting the implementation by cluster protocol.
+
+    `impls` maps protocol_version -> factory(cluster) returning an
+    internal Database-compatible object; `cluster` is a ClusterConnection
+    (its ClientDBInfo carries protocol_version)."""
+
+    def __init__(self, cluster: Any,
+                 impls: Dict[int, Callable[[Any], Any]]) -> None:
+        if not impls:
+            raise err("client_invalid_operation", "no client impls")
+        self.cluster = cluster
+        self.impls = impls
+        self.active_protocol: Optional[int] = None
+        self.active_db: Optional[Any] = None
+        # Bumped on every swap; transactions created against an older
+        # generation raise cluster_version_changed on use.
+        self.generation = 0
+        self.on_switch = AsyncVar(0)
+        self._monitor = spawn(self._protocol_monitor(), "mv.protocolWatch")
+
+    def _select(self, protocol: int) -> None:
+        factory = self.impls.get(protocol)
+        if factory is None:
+            # Reference behavior: an unknown protocol leaves the database
+            # unavailable (operations wait) until a matching library is
+            # provided — surfaced loudly rather than misdecoding.
+            TraceEvent("MultiVersionNoMatchingClient",
+                       Severity.Warn).detail("Protocol", protocol).log()
+            self.active_db = None
+            self.active_protocol = protocol
+            return
+        self.active_db = factory(self.cluster)
+        self.active_protocol = protocol
+        self.generation += 1
+        self.on_switch.set(self.generation)
+        TraceEvent("MultiVersionClientSelected").detail(
+            "Protocol", protocol).detail(
+            "Generation", self.generation).log()
+
+    async def _protocol_monitor(self) -> None:
+        info_var = getattr(self.cluster, "client_info", None)
+        while True:
+            info = info_var.get() if info_var is not None else None
+            protocol = getattr(info, "protocol_version", 0) if info else 0
+            if protocol and protocol != self.active_protocol:
+                self._select(protocol)
+            if info_var is None:
+                return
+            await info_var.on_change()
+
+    async def wait_ready(self) -> None:
+        while self.active_db is None:
+            await self.on_switch.on_change()
+
+    def create_transaction(self) -> "MultiVersionTransaction":
+        return MultiVersionTransaction(self)
+
+    def close(self) -> None:
+        if not self._monitor.is_ready():
+            self._monitor.cancel()
+        close = getattr(self.cluster, "close", None)
+        if close is not None:
+            close()
+
+
+class MultiVersionTransaction:
+    """Delegates to a transaction of the active implementation; an
+    implementation swap mid-transaction surfaces as the retryable
+    cluster_version_changed at the next operation (reference
+    MultiVersionTransaction::updateTransaction)."""
+
+    def __init__(self, mvdb: MultiVersionDatabase) -> None:
+        self.mvdb = mvdb
+        self._bind()
+
+    def _bind(self) -> None:
+        self._generation = self.mvdb.generation
+        self._tr = (self.mvdb.active_db.create_transaction()
+                    if self.mvdb.active_db is not None else None)
+
+    def _check(self):
+        if self._tr is None or self._generation != self.mvdb.generation:
+            raise err("cluster_version_changed",
+                      "client implementation switched")
+        return self._tr
+
+    # -- delegated surface ---------------------------------------------------
+    async def get(self, key, **kw):
+        return await self._check().get(key, **kw)
+
+    async def get_range(self, begin, end, **kw):
+        return await self._check().get_range(begin, end, **kw)
+
+    def set(self, key, value):
+        self._check().set(key, value)
+
+    def clear(self, key, end=None):
+        self._check().clear(key, end)
+
+    def atomic_op(self, op, key, operand):
+        self._check().atomic_op(op, key, operand)
+
+    async def watch(self, key):
+        return await self._check().watch(key)
+
+    def get_read_version(self):
+        return self._check().get_read_version()
+
+    async def commit(self):
+        return await self._check().commit()
+
+    @property
+    def committed_version(self):
+        return self._tr.committed_version if self._tr else -1
+
+    async def on_error(self, e) -> None:
+        name = getattr(e, "name", "")
+        if name == "cluster_version_changed" or self._tr is None or \
+                self._generation != self.mvdb.generation:
+            # Rebind onto the (possibly new) implementation and retry.
+            await self.mvdb.wait_ready()
+            self._bind()
+            return
+        await self._tr.on_error(e)
+
+    def reset(self) -> None:
+        self._bind()
+        if self._tr is not None:
+            self._tr.reset()

@@ -1,0 +1,208 @@
+"""TaskBucket: the database-resident resumable task queue.
+
+Reference: fdbclient/TaskBucket.actor.cpp (:1361 and the available/
+timeouts/ keyspaces): long operations (backup snapshots, restores) are
+decomposed into small tasks stored IN the database; any number of
+stateless agents claim tasks transactionally, heartbeat ownership, and
+either finish them or die — a timed-out task simply becomes claimable
+again, so progress survives any individual agent.  Exactly-once effects
+come from doing a task's final effects and its removal in ONE
+transaction.
+
+Keyspace (under `prefix`):
+  avail/<uid>              packed task (claimable)
+  run/<deadline>/<uid>     packed task (claimed; deadline = version time)
+Claim moves avail -> run with a deadline; extend() pushes the deadline;
+finish() removes; claim() also reclaims any run/ entry whose deadline
+passed (the crashed-agent path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core.error import FdbError, err
+from ..core.scheduler import delay
+from ..core.trace import Severity, TraceEvent
+from ..core.wire import Reader, Writer
+
+
+class Task:
+    def __init__(self, uid: bytes, task_type: str,
+                 params: Dict[bytes, bytes], deadline: int = 0) -> None:
+        self.uid = uid
+        self.type = task_type
+        self.params = params
+        self.deadline = deadline
+
+    def pack(self) -> bytes:
+        w = Writer().str_(self.type).u16(len(self.params))
+        for k, v in self.params.items():
+            w.bytes_(k).bytes_(v)
+        return w.done()
+
+    @classmethod
+    def unpack(cls, uid: bytes, blob: bytes, deadline: int = 0) -> "Task":
+        r = Reader(blob)
+        t = r.str_()
+        params = {r.bytes_(): r.bytes_() for _ in range(r.u16())}
+        return cls(uid, t, params, deadline)
+
+
+class TaskBucket:
+    """One task queue rooted at `prefix` (reference TaskBucket)."""
+
+    def __init__(self, prefix: bytes = b"\xff/taskBucket/",
+                 timeout_versions: int = 5_000_000) -> None:
+        self.prefix = prefix
+        self.timeout = timeout_versions   # ~5s of version time
+
+    def _avail(self, uid: bytes = b"") -> bytes:
+        return self.prefix + b"avail/" + uid
+
+    def _run(self, deadline: int = 0, uid: bytes = b"") -> bytes:
+        return self.prefix + b"run/" + b"%020d/" % deadline + uid
+
+    # -- producer ------------------------------------------------------------
+    def add(self, tr, task_type: str, params: Dict[bytes, bytes],
+            uid: Optional[bytes] = None) -> bytes:
+        """Add a task inside the caller's transaction (so task creation
+        is atomic with whatever scheduled it)."""
+        if uid is None:
+            from ..core.rng import deterministic_random
+            uid = deterministic_random().random_unique_id().encode()
+        tr.access_system_keys = True
+        tr.set(self._avail(uid), Task(uid, task_type, params).pack())
+        return uid
+
+    async def add_task(self, db, task_type: str,
+                       params: Dict[bytes, bytes]) -> bytes:
+        t = db.create_transaction()
+        while True:
+            try:
+                uid = self.add(t, task_type, params)
+                await t.commit()
+                return uid
+            except FdbError as e:
+                await t.on_error(e)
+
+    # -- consumer ------------------------------------------------------------
+    async def claim_one(self, db) -> Optional[Task]:
+        """Claim an available task, or reclaim a timed-out running one.
+        Returns None when nothing is claimable."""
+        t = db.create_transaction()
+        t.access_system_keys = True
+        while True:
+            try:
+                now_v = (await t.get_read_version()).version
+                # Timed-out running tasks first (deadline ordering makes
+                # them the FIRST run/ entries).
+                run_rows = await t.get_range(self._run(),
+                                             self.prefix + b"run0", limit=1)
+                if run_rows:
+                    k, blob = run_rows[0]
+                    tail = k[len(self.prefix) + 4:]
+                    deadline = int(tail[:20])
+                    uid = tail[21:]
+                    if deadline < now_v:
+                        t.clear(k)
+                        nd = now_v + self.timeout
+                        t.set(self._run(nd, uid), blob)
+                        await t.commit()
+                        from ..core.coverage import test_coverage
+                        test_coverage("TaskBucketReclaim")
+                        TraceEvent("TaskBucketReclaimed").detail(
+                            "Uid", uid).log()
+                        return Task.unpack(uid, blob, nd)
+                rows = await t.get_range(self._avail(),
+                                         self.prefix + b"avail0", limit=1)
+                if not rows:
+                    return None
+                k, blob = rows[0]
+                uid = k[len(self._avail()):]
+                t.clear(k)
+                nd = now_v + self.timeout
+                t.set(self._run(nd, uid), blob)
+                await t.commit()
+                return Task.unpack(uid, blob, nd)
+            except FdbError as e:
+                await t.on_error(e)
+
+    async def finish(self, tr, task: Task) -> None:
+        """Remove a claimed task INSIDE the caller's transaction: commit
+        the task's final effects and its completion atomically (the
+        exactly-once contract).  Verifies ownership by READING the run
+        entry — if the task timed out and was reclaimed, this raises and
+        the whole final transaction (effects included) aborts, leaving
+        the reclaimer's execution as the only one whose effects land."""
+        tr.access_system_keys = True
+        key = self._run(task.deadline, task.uid)
+        cur = await tr.get(key)
+        if cur is None:
+            # NON-retryable (operation_failed): retrying through
+            # on_error would loop forever — the run entry is gone for
+            # good.  run_tasks catches this and moves to the next task;
+            # the reclaimer owns the re-execution.
+            raise err("operation_failed",
+                      "task reclaimed by another agent")
+        tr.clear(key)
+
+    async def extend(self, db, task: Task) -> bool:
+        """Heartbeat: push the deadline.  False if the task was reclaimed
+        or finished elsewhere (the agent must abandon it)."""
+        t = db.create_transaction()
+        t.access_system_keys = True
+        while True:
+            try:
+                cur = await t.get(self._run(task.deadline, task.uid))
+                if cur is None:
+                    return False
+                now_v = (await t.get_read_version()).version
+                t.clear(self._run(task.deadline, task.uid))
+                nd = now_v + self.timeout
+                t.set(self._run(nd, task.uid), cur)
+                await t.commit()
+                task.deadline = nd
+                return True
+            except FdbError as e:
+                await t.on_error(e)
+
+    async def is_empty(self, db) -> bool:
+        t = db.create_transaction()
+        t.access_system_keys = True
+        while True:
+            try:
+                rows = await t.get_range(self.prefix, self.prefix + b"\xff",
+                                         limit=1)
+                return not rows
+            except FdbError as e:
+                await t.on_error(e)
+
+
+async def run_tasks(db, bucket: TaskBucket,
+                    handlers: Dict[str, Callable], agent_id: str = "agent",
+                    idle_delay: float = 0.2,
+                    stop: Optional[Callable[[], bool]] = None) -> None:
+    """An agent loop (reference TaskBucket's doOne/run): claim, dispatch
+    to the handler registry, repeat.  Handlers receive (db, bucket, task)
+    and MUST call bucket.finish(tr, task) inside their final transaction;
+    a handler that dies leaves the task to time out and be reclaimed."""
+    while not (stop and stop()):
+        task = await bucket.claim_one(db)
+        if task is None:
+            await delay(idle_delay)
+            continue
+        handler = handlers.get(task.type)
+        if handler is None:
+            TraceEvent("TaskBucketUnknownType", Severity.Warn).detail(
+                "Type", task.type).log()
+            await delay(idle_delay)
+            continue
+        try:
+            await handler(db, bucket, task)
+            TraceEvent("TaskBucketDone").detail("Agent", agent_id).detail(
+                "Type", task.type).detail("Uid", task.uid).log()
+        except FdbError as e:
+            TraceEvent("TaskBucketTaskError", Severity.Warn).detail(
+                "Type", task.type).detail("Error", e.name).log()
+            await delay(idle_delay)

@@ -88,6 +88,16 @@ def new_conflict_set(backend: Optional[str] = None,
                 backend = "tpu"
         except Exception:
             pass
+        if backend == "cpu":
+            # No accelerator: the native C++ engine (~20x the Python
+            # oracle) is the right default when its library builds; the
+            # oracle stays the fallback on toolchain-less hosts.
+            try:
+                from .native import NativeConflictSet
+                NativeConflictSet(oldest_version)  # probe the build
+                backend = "native"
+            except Exception:
+                pass
     if backend == "cpu":
         from .oracle import OracleConflictSet
         return OracleConflictSet(oldest_version)

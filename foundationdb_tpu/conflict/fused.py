@@ -53,9 +53,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.digest import (KEY_LANES, MAX_DIGEST, ROW_PAD, gather_cols,
-                          lex_eq, lex_less, planar_to_rows, rank_count,
-                          rows_to_planar, searchsorted_left,
+from ..ops.digest import (KEY_LANES, MAX_DIGEST, PREFIX_BYTES, ROW_PAD,
+                          gather_cols, lex_eq, lex_less, planar_to_rows,
+                          rank_count, rows_to_planar, searchsorted_left,
                           searchsorted_right)
 from ..ops.digest import lex_max_cols as _lex_max_cols
 from ..ops.digest import lex_min_cols as _lex_min_cols
@@ -86,13 +86,54 @@ def _next_pow2(n: int) -> int:
     return 1 << max(int(n - 1).bit_length(), 1)
 
 
-def meta_size(t_cap: int, r_cap: int, w_cap: int,
-              all_point: bool = False) -> int:
-    # Point layout carries two extra host-computed index columns (r_wid,
-    # w_uidx) in exchange for eliminating every device sort.
-    if all_point:
-        return 3 * r_cap + 3 * w_cap + 3 * t_cap + N_SCALARS
+def meta_size(t_cap: int, r_cap: int, w_cap: int) -> int:
     return 2 * r_cap + 2 * w_cap + 3 * t_cap + N_SCALARS
+
+
+# Compact point-batch wire format (make_resolve_step_compact): ONE uint8
+# buffer per batch instead of the 25-ish-MB digest+meta pair.  The axon
+# TPU tunnel moves ~5-10 MB/s with ~1 s per-transfer latency, so h2d bytes
+# — not FLOPs — bound the north-star throughput; this layout ships the
+# batch's UNIQUE begin keys once as raw bytes (reads and writes both index
+# into the table) and derives everything else on device:
+#
+#   ubytes  uint8[u_pad, L+1]   unique sorted begin-key digests, compacted
+#                               to L prefix bytes + the length-marker byte
+#                               (L = longest key in the batch; bytes L..22
+#                               of every digest are zero by construction)
+#   r_uid   int32[r_pad]        each read's slot in the unique table
+#   w_uid   int32[w_pad]        each write's slot
+#   r_start int32[t_cap]        first read index per txn (reads grouped by
+#                               txn; r_txn is re-derived via rank_count)
+#   w_start int32[t_cap]
+#   t_snap  int32[t_cap]        rebased snapshot versions
+#   t_flags uint8[t_cap]        bit0 = has_reads
+#   scalars int32[6]            u_n, n_r, n_w, n_t, now_rel, oldest_rel
+#
+# End digests are NOT shipped: a point range [k, k+"\x00") has
+# digest(end) == digest(begin) with the marker byte (lane 5's low byte)
+# incremented — exact for every all_point batch (encoded.py guarantees
+# len(k) <= 23).  History search runs ONCE over the unique table and is
+# gathered per range, which also cuts the binary-search probe count ~3x.
+COMPACT_SCALARS = 6
+
+
+def compact_layout(t_cap: int, r_pad: int, w_pad: int, u_pad: int,
+                   lw: int) -> dict:
+    """Byte offsets of each section of the compact buffer.  Every section
+    starts 4-byte aligned so the host can write the int32 sections (snap
+    rebasing + scalars happen at dispatch time) through one int32 view."""
+    o = 0
+    lay = {}
+    for name, nbytes in (
+            ("ubytes", u_pad * lw), ("r_uid", 4 * r_pad),
+            ("w_uid", 4 * w_pad), ("r_start", 4 * t_cap),
+            ("w_start", 4 * t_cap), ("t_snap", 4 * t_cap),
+            ("t_flags", t_cap), ("scalars", 4 * COMPACT_SCALARS)):
+        lay[name] = o
+        o += (nbytes + 3) & ~3
+    lay["total"] = o
+    return lay
 
 
 def make_delta_state(d_cap: int) -> WindowState:
@@ -105,10 +146,11 @@ def _point_insert(dk, dv, dsize, u_k, u_e, w_uidx, w_ins, now_rel,
     """Sort-free window_insert for point batches (traced inline).
 
     Semantics identical to window.window_insert on the same surviving
-    write set, exploiting what the host guarantees for the point path
-    (tpu_backend._group_points): u_k/u_e are UNIQUE write keys already
-    sorted ascending with MAX padding, disjoint as ranges, and no end
-    reaches the next begin.  So the union sweep (an 8-operand lax.sort)
+    write set, exploiting what the host guarantees for the compact point
+    path (tpu_backend._pack_compact): u_k/u_e are the batch's UNIQUE
+    begin keys already sorted ascending with MAX padding (written subset
+    selected by the w_uidx/w_ins scatter), disjoint as ranges, and no
+    written end reaches the next written begin.  So the union sweep (an 8-operand lax.sort)
     reduces to a scatter-max of the survivor mask over unique-key slots,
     and the sorted new-boundary sequence (a 7-operand lax.sort) is just
     the host-interleaved [b0, e0, b1, e1, ...] compacted by a rank
@@ -193,9 +235,176 @@ def _point_insert(dk, dv, dsize, u_k, u_e, w_uidx, w_ins, now_rel,
 
 
 @lru_cache(maxsize=64)
+def make_resolve_step_compact(cap: int, d_cap: int, t_cap: int, r_pad: int,
+                              w_pad: int, u_pad: int, lw: int,
+                              axis_name: str = None):
+    """Per-batch step over the compact single-buffer point layout (see
+    compact_layout above) — the production path for all_point batches.
+
+    Device program: expand the unique-key byte rows to planar digests,
+    derive end digests (marker byte + 1), run the two-tier history search
+    ONCE over the unique table, gather per read, then the same Jacobi
+    intra-batch fixpoint / delta insert / verdict coding as
+    make_resolve_step — semantics bit-identical to the general path and
+    the oracle (tests/test_conflict_tpu.py).
+
+    axis_name: as in make_resolve_step — per-shard body with history bits
+    max-combined over the mesh axis; gains a trailing `bounds` argument.
+
+    fn(bk, bv, table, size, dk, dv, dsize, flag, buf[, bounds])
+      -> (dk', dv', dsize', flag', out)
+    """
+    from ..ops.segtree import INF_I32
+    L = lw - 1
+    lay = compact_layout(t_cap, r_pad, w_pad, u_pad, lw)
+
+    def step(bk, bv, table, size, dk, dv, dsize, flag, buf, bounds=None):
+        # ---- unpack the single byte buffer --------------------------------
+        def i32(name, n):
+            o = lay[name]
+            return jax.lax.bitcast_convert_type(
+                buf[o:o + 4 * n].reshape(n, 4), jnp.int32)
+
+        ub = buf[lay["ubytes"]:lay["ubytes"] + u_pad * lw].reshape(u_pad, lw)
+        r_uid = i32("r_uid", r_pad)
+        w_uid = i32("w_uid", w_pad)
+        r_start = i32("r_start", t_cap)
+        w_start = i32("w_start", t_cap)
+        t_snap = i32("t_snap", t_cap)
+        t_flags = buf[lay["t_flags"]:lay["t_flags"] + t_cap]
+        scal = i32("scalars", COMPACT_SCALARS)
+        u_n, n_r, n_w, n_t = scal[0], scal[1], scal[2], scal[3]
+        now_rel, oldest_rel = scal[4], scal[5]
+
+        # ---- expand unique begin keys to planar digests -------------------
+        ub32 = ub.astype(jnp.uint32)
+        lanes = []
+        for lane in range(KEY_LANES):
+            acc = jnp.zeros((u_pad,), jnp.uint32)
+            for bi in range(4):
+                pos = 4 * lane + bi
+                acc = acc * 256
+                if pos < L:
+                    acc = acc + ub32[:, pos]
+                elif pos == PREFIX_BYTES:
+                    acc = acc + ub32[:, L]       # length-marker byte
+            lanes.append(acc)
+        iota_u = jnp.arange(u_pad, dtype=jnp.int32)
+        pad_u = iota_u >= u_n
+        u_b = jnp.where(pad_u[None, :],
+                        jnp.asarray(MAX_DIGEST)[:, None],
+                        jnp.stack(lanes))
+        # end = begin with marker+1 (exact for every all_point batch); pad
+        # columns stay MAX (bump 0) so the sorted-with-MAX-padding
+        # invariants of searchsorted/_point_insert hold.
+        u_e = u_b.at[KEY_LANES - 1].add(
+            jnp.where(pad_u, 0, 1).astype(jnp.uint32))
+
+        # ---- derived per-txn / per-range columns --------------------------
+        iota_t = jnp.arange(t_cap, dtype=jnp.int32)
+        t_valid = iota_t < n_t
+        t_has_reads = (t_flags & 1) != 0
+        too_old = t_valid & t_has_reads & (t_snap < oldest_rel)
+
+        r_txn = rank_count(jnp.where(t_valid, r_start, r_pad), r_pad) - 1
+        w_txn = rank_count(jnp.where(t_valid, w_start, w_pad), w_pad) - 1
+
+        iota_r = jnp.arange(r_pad, dtype=jnp.int32)
+        r_valid = iota_r < n_r
+        r_txn_c = jnp.clip(r_txn, 0, t_cap - 1)
+        r_live = r_valid & ~too_old[r_txn_c]
+        snap_r = t_snap[r_txn_c]
+        r_uid_c = jnp.clip(r_uid, 0, u_pad - 1)
+
+        # ---- history: search the UNIQUE table once, gather per read -------
+        if axis_name is not None:
+            # Clip each unique key's range to this shard; a point range
+            # never straddles a split, so clipping is all-or-nothing and
+            # the pmax over shards reconstructs the global answer exactly.
+            cu_b = _lex_max_cols(u_b, bounds[:, 0])
+            cu_e = _lex_min_cols(u_e, bounds[:, 1])
+            u_owned = lex_less(cu_b, cu_e)
+        else:
+            cu_b, cu_e, u_owned = u_b, u_e, None
+        lo_b = searchsorted_right(bk, cu_b) - 1
+        hi_b = searchsorted_left(bk, cu_e)
+        max_base = range_max(table, lo_b, hi_b)
+        dtable = build_sparse_table(dv)
+        lo_d = searchsorted_right(dk, cu_b) - 1
+        hi_d = searchsorted_left(dk, cu_e)
+        max_delta = range_max(dtable, lo_d, hi_d)
+        vmax_u = jnp.maximum(max_base, max_delta)
+        if u_owned is not None:
+            vmax_u = jnp.where(u_owned, vmax_u, NEG_INF)
+        hist_bits = r_live & (vmax_u[r_uid_c] > snap_r)
+        r_scatter = jnp.where(r_live, r_txn, t_cap)
+        hist_conflicted = jnp.zeros((t_cap,), bool).at[r_scatter].max(
+            hist_bits, mode="drop")
+        if axis_name is not None:
+            hist_conflicted = jax.lax.pmax(
+                hist_conflicted.astype(jnp.int32), axis_name) > 0
+
+        # ---- intra-batch fixpoint over unique-key slots -------------------
+        iota_w = jnp.arange(w_pad, dtype=jnp.int32)
+        w_valid = iota_w < n_w
+        w_txn_c = jnp.clip(w_txn, 0, t_cap - 1)
+        w_base_ok = w_valid & ~too_old[w_txn_c]
+        w_slot = jnp.clip(w_uid, 0, u_pad - 1)
+
+        def body(carry):
+            conf, _ = carry
+            w_active = w_base_ok & ~conf[w_txn_c]
+            cover = jnp.full((u_pad + 1,), INF_I32, jnp.int32).at[
+                jnp.where(w_active, w_slot, u_pad)].min(
+                jnp.where(w_active, w_txn, INF_I32))
+            intra_hit = r_live & (cover[r_uid_c] < r_txn)
+            new_conf = hist_conflicted.at[r_scatter].max(intra_hit,
+                                                         mode="drop")
+            return new_conf, jnp.any(new_conf != conf)
+
+        conflicted, _ = jax.lax.while_loop(
+            lambda c: c[1], body, (hist_conflicted, True))
+
+        # ---- insert surviving writes into the delta -----------------------
+        survivor = t_valid & ~too_old & ~conflicted
+        w_ins = w_valid & survivor[w_txn_c]
+        if axis_name is not None:
+            lo_bc = jnp.broadcast_to(bounds[:, 0][:, None], u_b.shape)
+            hi_bc = jnp.broadcast_to(bounds[:, 1][:, None], u_b.shape)
+            u_own = ~lex_less(u_b, lo_bc) & lex_less(u_b, hi_bc)
+        else:
+            u_own = None
+        (dk2, dv2, dsize2), overflow = _point_insert(
+            dk, dv, dsize, u_b, u_e, w_uid, w_ins, now_rel,
+            d_cap, u_pad, u_own=u_own)
+        flag2 = flag | overflow.astype(jnp.int32)
+
+        codes = jnp.where(
+            ~t_valid, RES_INVALID,
+            jnp.where(too_old, RES_TOO_OLD,
+                      jnp.where(conflicted, RES_CONFLICT, RES_COMMITTED))
+        ).astype(jnp.int8)
+        if axis_name is not None:
+            ex_flag = jax.lax.pmax(flag2, axis_name)
+            ex_dsize = jax.lax.pmax(dsize2.astype(jnp.int32), axis_name)
+            ex_size = jax.lax.psum(size.astype(jnp.int32), axis_name)
+        else:
+            ex_flag = flag2
+            ex_dsize = dsize2.astype(jnp.int32)
+            ex_size = size.astype(jnp.int32)
+        extras = jnp.stack([ex_flag, ex_dsize, ex_size])
+        extras8 = jax.lax.bitcast_convert_type(extras, jnp.int8).reshape(-1)
+        out = jnp.concatenate([codes, extras8])
+        return dk2, dv2, dsize2, flag2, out
+
+    if axis_name is not None:
+        return step
+    return jax.jit(step, donate_argnums=(4, 5, 6, 7))
+
+
+@lru_cache(maxsize=64)
 def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
-                      w_cap: int, all_point: bool = False,
-                      axis_name: str = None):
+                      w_cap: int, axis_name: str = None):
     """Build the jitted per-batch step for one bucket shape.
 
     axis_name=None (default) builds the single-device program.  With an
@@ -212,17 +421,10 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
     The function is returned UNJITTED for the caller to wrap in
     shard_map + jit.
 
-    all_point=True compiles the SORT-FREE point-key path for batches whose
-    every conflict range is [k, k+\\x00) with len(k) <= 23: the host
-    pre-groups keys (np.unique over S24 digest views, tpu_backend
-    _group_points) and ships unique sorted write keys + per-range slot
-    indices, so the device runs no lax.sort at all — multi-operand sorts
-    were both the per-batch runtime hot spot and a minutes-per-shape XLA
-    compile cost over the TPU tunnel.  Intra-batch overlap is exact
-    begin-digest equality, so each Jacobi round is one scatter-min over
-    unique-key slots + one gather; the delta insert compacts host-sorted
-    interleaved boundaries with rank scatters (_point_insert) instead of
-    sorting.  Verdicts are identical to the general path.
+    Point batches do not come here: all_point batches take the compact
+    single-buffer path (make_resolve_step_compact) unless their host-side
+    verification fails (tpu_backend._pack_compact returning None), which
+    routes them through this general interval program.
 
     fn(bk, bv, table, size, dk, dv, dsize, flag, digests, meta)
       -> (dk', dv', dsize', flag', out)
@@ -235,8 +437,6 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
     def step(bk, bv, table, size, dk, dv, dsize, flag, digests, meta,
              bounds=None):
         # ---- unpack the two packed input blocks ---------------------------
-        # Point layout: the w sections carry the host-grouped UNIQUE sorted
-        # write keys/ends (u <= nw live columns, MAX padding above).
         r_b = digests[:, 0:r_cap]
         r_e = digests[:, r_cap:2 * r_cap]
         w_b = digests[:, 2 * r_cap:2 * r_cap + w_cap]
@@ -244,12 +444,8 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
         o = 0
         r_txn = meta[o:o + r_cap]; o += r_cap
         r_valid = meta[o:o + r_cap] != 0; o += r_cap
-        if all_point:
-            r_wid = meta[o:o + r_cap]; o += r_cap
         w_txn = meta[o:o + w_cap]; o += w_cap
         w_valid = meta[o:o + w_cap] != 0; o += w_cap
-        if all_point:
-            w_uidx = meta[o:o + w_cap]; o += w_cap
         t_snap = meta[o:o + t_cap]; o += t_cap
         t_has_reads = meta[o:o + t_cap] != 0; o += t_cap
         t_valid = meta[o:o + t_cap] != 0; o += t_cap
@@ -296,50 +492,29 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
         # must be retractable, or chains (t1 w A; t2 r A w B; t3 r B) would
         # wrongly abort t3.  Prefix-correctness of Jacobi on the triangular
         # dependency system guarantees convergence in <= chain-depth rounds.
-        if all_point:
-            # Point fast path: overlap == begin-digest equality, and the
-            # HOST already grouped keys — r_wid[i] is the unique-write-key
-            # slot matching read i (w_cap when none), w_uidx[j] is write
-            # j's slot.  Per round: one scatter-min + one gather; no sort,
-            # no searchsorted.
-            from ..ops.segtree import INF_I32
-            r_wid_c = jnp.clip(r_wid, 0, w_cap)
-            w_slot = jnp.clip(w_uidx, 0, w_cap - 1)
+        # ---- endpoint gap universe for interval overlap tests -------------
+        pad = jnp.broadcast_to(jnp.asarray(MAX_DIGEST)[:, None],
+                               (KEY_LANES, u_cap - digests.shape[1]))
+        all_d = jnp.concatenate([digests, pad], axis=1)
+        ops = [all_d[l] for l in range(KEY_LANES)]
+        sorted_ops = jax.lax.sort(ops, num_keys=KEY_LANES)
+        universe = jnp.stack(sorted_ops, axis=0)        # [6, U] sorted
+        r_pb = searchsorted_left(universe, r_b)
+        r_pe = searchsorted_left(universe, r_e)
+        w_pb = searchsorted_left(universe, w_b)
+        w_pe = searchsorted_left(universe, w_e)
 
-            def body(carry):
-                conf, _ = carry
-                w_active = w_base_ok & ~conf[w_txn_c]
-                cover = jnp.full((w_cap + 1,), INF_I32, jnp.int32).at[
-                    jnp.where(w_active, w_slot, w_cap)].min(
-                    jnp.where(w_active, w_txn, INF_I32))
-                intra_hit = r_live & (cover[r_wid_c] < r_txn)
-                new_conf = hist_conflicted.at[r_scatter].max(intra_hit,
-                                                             mode="drop")
-                return new_conf, jnp.any(new_conf != conf)
-        else:
-            # ---- endpoint gap universe for interval overlap tests ---------
-            pad = jnp.broadcast_to(jnp.asarray(MAX_DIGEST)[:, None],
-                                   (KEY_LANES, u_cap - digests.shape[1]))
-            all_d = jnp.concatenate([digests, pad], axis=1)
-            ops = [all_d[l] for l in range(KEY_LANES)]
-            sorted_ops = jax.lax.sort(ops, num_keys=KEY_LANES)
-            universe = jnp.stack(sorted_ops, axis=0)        # [6, U] sorted
-            r_pb = searchsorted_left(universe, r_b)
-            r_pe = searchsorted_left(universe, r_e)
-            w_pb = searchsorted_left(universe, w_b)
-            w_pe = searchsorted_left(universe, w_e)
-
-            def body(carry):
-                conf, _ = carry
-                w_active = w_base_ok & ~conf[w_txn_c]
-                cover = interval_min_cover(w_pb, w_pe, w_txn, w_active,
-                                           log_u)
-                mtable = build_min_table(cover)
-                m = range_min(mtable, r_pb, r_pe)
-                intra_hit = r_live & (m < r_txn)
-                new_conf = hist_conflicted.at[r_scatter].max(intra_hit,
-                                                             mode="drop")
-                return new_conf, jnp.any(new_conf != conf)
+        def body(carry):
+            conf, _ = carry
+            w_active = w_base_ok & ~conf[w_txn_c]
+            cover = interval_min_cover(w_pb, w_pe, w_txn, w_active,
+                                       log_u)
+            mtable = build_min_table(cover)
+            m = range_min(mtable, r_pb, r_pe)
+            intra_hit = r_live & (m < r_txn)
+            new_conf = hist_conflicted.at[r_scatter].max(intra_hit,
+                                                         mode="drop")
+            return new_conf, jnp.any(new_conf != conf)
 
         def cond(carry):
             return carry[1]
@@ -350,28 +525,14 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
         # ---- insert surviving writes into the DELTA at `now` --------------
         survivor = t_valid & ~too_old & ~conflicted
         w_ins = w_valid & survivor[w_txn_c]
-        if all_point:
-            if axis_name is not None:
-                # A point range never straddles a split (its begin and end
-                # digests differ only in the final marker byte), so the
-                # begin's owner inserts the whole range.
-                lo_bc = jnp.broadcast_to(bounds[:, 0][:, None], w_b.shape)
-                hi_bc = jnp.broadcast_to(bounds[:, 1][:, None], w_b.shape)
-                u_own = ~lex_less(w_b, lo_bc) & lex_less(w_b, hi_bc)
-            else:
-                u_own = None
-            (dk2, dv2, dsize2), overflow = _point_insert(
-                dk, dv, dsize, w_b, w_e, w_uidx, w_ins, now_rel,
-                d_cap, w_cap, u_own=u_own)
+        if axis_name is not None:
+            iw_b = _lex_max_cols(w_b, bounds[:, 0])
+            iw_e = _lex_min_cols(w_e, bounds[:, 1])
+            w_ins = w_ins & lex_less(iw_b, iw_e)
         else:
-            if axis_name is not None:
-                iw_b = _lex_max_cols(w_b, bounds[:, 0])
-                iw_e = _lex_min_cols(w_e, bounds[:, 1])
-                w_ins = w_ins & lex_less(iw_b, iw_e)
-            else:
-                iw_b, iw_e = w_b, w_e
-            (dk2, dv2, dsize2), overflow = window_insert(
-                WindowState(dk, dv, dsize), iw_b, iw_e, w_ins, now_rel)
+            iw_b, iw_e = w_b, w_e
+        (dk2, dv2, dsize2), overflow = window_insert(
+            WindowState(dk, dv, dsize), iw_b, iw_e, w_ins, now_rel)
         flag2 = flag | overflow.astype(jnp.int32)
 
         codes = jnp.where(

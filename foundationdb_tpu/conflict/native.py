@@ -53,6 +53,10 @@ def _load():
 
 
 class NativeConflictSet(ConflictSet):
+    # The ctypes call blocks the calling thread for the whole batch; the
+    # resolver routes it through core/threadpool.run_blocking.
+    offload_blocking = True
+
     def __init__(self, oldest_version: Version = 0) -> None:
         super().__init__(oldest_version)
         self._lib = _load()

@@ -47,6 +47,20 @@ def _bucket(n: int) -> int:
     return b
 
 
+_FINE_GRAN = 1 << 14
+
+
+def _fine_bucket(n: int) -> int:
+    """Pad count for the compact layout's id arrays and unique-key table:
+    power-of-two below 16K, then 16K-granular.  Finer buckets waste far
+    fewer h2d bytes than doubling (the tunnel makes bytes the bottleneck)
+    while still quantizing shapes so the per-shape XLA compile cache
+    stays small."""
+    if n <= _FINE_GRAN:
+        return _bucket(n)
+    return (n + _FINE_GRAN - 1) // _FINE_GRAN * _FINE_GRAN
+
+
 class ResolveHandle:
     """In-flight resolution of one batch; wait() returns the verdicts."""
 
@@ -206,45 +220,109 @@ class TpuConflictSet(ConflictSet):
 
     # -- batch packing ------------------------------------------------------
     @staticmethod
-    def _group_points(enc: EncodedBatch, w_cap: int):
-        """Host-side key grouping for the sort-free device point path:
-        (u_keys, u_ends, w_uidx, r_wid) — unique sorted write keys, each
-        write's slot among them, and each read's matching slot (w_cap
-        sentinel when its key was not written).  np.unique/searchsorted run
-        over S24 byte views of the digests (ops/digest.py planar_to_s24).
+    def _pack_compact(enc: EncodedBatch):
+        """Host half of the compact point wire format (fused.compact_layout):
+        dedupe the batch's begin keys ONCE (reads and writes both index the
+        unique table), compact the unique digests to raw prefix+marker
+        bytes, and assemble everything into a single uint8 buffer — the
+        whole batch ships in ONE h2d transfer of ~1/8 the bytes of the
+        general layout, which is what the ~5-10 MB/s axon tunnel makes the
+        north-star bottleneck (PERF.md).
 
-        Returns None when two unique keys are digest-ADJACENT (one range's
-        end >= the next range's begin, e.g. keys k and k+b"\\x00"): the
-        interleaved-boundary device insert requires strictly separated
-        ranges, so such batches take the general sorted path instead."""
-        from ..ops.digest import planar_to_s24
-        nw = enc.w_txn.shape[0]
+        Returns None when the batch violates a compact-path precondition —
+        ends not derivable as begin-marker+1, reads/writes not grouped by
+        txn, or two unique WRITE keys digest-adjacent (the interleaved
+        insert needs strictly separated ranges) — and the caller falls
+        back to the general interval path."""
+        from ..ops.digest import DIGEST_BYTES, PREFIX_BYTES, planar_to_s24
+        n = enc.n_txns
         nr = enc.r_txn.shape[0]
-        if nw == 0:
-            empty = np.empty((enc.r_begin.shape[0], 0), dtype=np.uint32)
-            return (empty, empty, np.zeros((0,), np.int32),
-                    np.full((nr,), w_cap, dtype=np.int32))
-        wb_s = planar_to_s24(enc.w_begin)
-        u_s, first_idx, w_uidx = np.unique(
-            wb_s, return_index=True, return_inverse=True)
-        u_keys = np.ascontiguousarray(enc.w_begin[:, first_idx])
-        u_ends = np.ascontiguousarray(enc.w_end[:, first_idx])
-        if len(u_s) > 1:
-            ue_s = planar_to_s24(u_ends)
-            if bool((ue_s[:-1] >= u_s[1:]).any()):
+        nw = enc.w_txn.shape[0]
+        # End digests must be begin-with-marker+1 (what the device derives).
+        for b_, e_ in ((enc.r_begin, enc.r_end), (enc.w_begin, enc.w_end)):
+            if b_.shape[1] and not (
+                    np.array_equal(b_[:5], e_[:5])
+                    and np.array_equal(b_[5] + 1, e_[5])):
                 return None
-        if nr:
-            rb_s = planar_to_s24(enc.r_begin)
-            pos = np.searchsorted(u_s, rb_s)
-            safe = np.minimum(pos, len(u_s) - 1)
-            hit = (pos < len(u_s)) & (u_s[safe] == rb_s)
-            r_wid = np.where(hit, pos, w_cap).astype(np.int32)
-        else:
-            r_wid = np.zeros((0,), np.int32)
-        return u_keys, u_ends, w_uidx.astype(np.int32), r_wid
+        # Ranges must be grouped by txn so r_txn/w_txn reduce to per-txn
+        # start offsets (re-derived on device via rank_count).
+        if (nr and (np.diff(enc.r_txn) < 0).any()) or \
+                (nw and (np.diff(enc.w_txn) < 0).any()):
+            return None
+        rb_s = planar_to_s24(enc.r_begin)
+        wb_s = planar_to_s24(enc.w_begin)
+        uw_s = np.unique(wb_s)
+        if uw_s.size > 1:
+            uwb = uw_s.view(np.uint8).reshape(-1, DIGEST_BYTES).copy()
+            uwb[:, DIGEST_BYTES - 1] += 1      # marker+1 never carries
+            uw_end = np.ascontiguousarray(uwb).view(
+                "S%d" % DIGEST_BYTES).ravel()
+            if bool((uw_end[:-1] >= uw_s[1:]).any()):
+                return None
+        u_s = np.unique(np.concatenate([rb_s, wb_s]))
+        u = int(u_s.size)
+        u8 = u_s.view(np.uint8).reshape(-1, DIGEST_BYTES)
+        markers = u8[:, DIGEST_BYTES - 1]
+        if markers.size and int(markers.max()) > PREFIX_BYTES:
+            return None                        # truncated key slipped in
+        lkey = int(markers.max()) if markers.size else 1
+        # Quantize the shipped prefix width to multiples of 4 so the
+        # compile cache sees at most 6 distinct widths (<= 3 wasted
+        # bytes/key; a per-batch-max width would compile per batch).
+        lw = min((lkey + 1 + 3) & ~3, PREFIX_BYTES + 1)
+
+        t_cap = _bucket(n)
+        r_pad = _fine_bucket(nr)
+        w_pad = _fine_bucket(nw)
+        u_pad = _fine_bucket(u)
+        from .fused import compact_layout
+        lay = compact_layout(t_cap, r_pad, w_pad, u_pad, lw)
+        buf = np.zeros((lay["total"],), dtype=np.uint8)
+
+        ubc = np.zeros((u, lw), dtype=np.uint8)
+        ubc[:, :lkey] = u8[:, :lkey]
+        ubc[:, lw - 1] = markers
+        buf[lay["ubytes"]:lay["ubytes"] + u * lw] = ubc.reshape(-1)
+
+        def put_i32(name, count, values, fill=0):
+            sec = np.full((count,), fill, dtype=np.int32)
+            sec[:len(values)] = values
+            o = lay[name]
+            buf[o:o + 4 * count] = sec.view(np.uint8)
+
+        put_i32("r_uid", r_pad, np.searchsorted(u_s, rb_s))
+        put_i32("w_uid", w_pad, np.searchsorted(u_s, wb_s))
+        # Start offsets; txns beyond n get sentinel r_pad/w_pad (dropped by
+        # the device's rank_count, though t_valid already gates them).
+        put_i32("r_start", t_cap,
+                np.searchsorted(enc.r_txn, np.arange(n)), fill=r_pad)
+        put_i32("w_start", t_cap,
+                np.searchsorted(enc.w_txn, np.arange(n)), fill=w_pad)
+        flags = np.zeros((t_cap,), dtype=np.uint8)
+        flags[:n] = enc.t_has_reads
+        buf[lay["t_flags"]:lay["t_flags"] + t_cap] = flags
+        scal = np.asarray([u, nr, nw, n, 0, 0], dtype=np.int32)
+        buf[lay["scalars"]:lay["scalars"] + 4 * len(scal)] = \
+            scal.view(np.uint8)
+
+        # t_snap and the now/oldest scalars are version-rebased at dispatch
+        # time through an int32 view of the (4-byte-aligned) buffer.
+        return {"compact": True, "buf": buf,
+                "meta": buf.view(np.int32),
+                "snap_off": lay["t_snap"] // 4,
+                "scalar_off": lay["scalars"] // 4 + 4,
+                "t_snap_abs": enc.t_snap, "nw": nw,
+                "caps": (t_cap, r_pad, w_pad),
+                "shapes": (t_cap, r_pad, w_pad, u_pad, lw)}
 
     def _pack(self, enc: EncodedBatch):
-        """Bucket-pad the columnar batch into the two device input blocks."""
+        """Bucket-pad the columnar batch into device input blocks: the
+        compact single-buffer layout for point batches, else the general
+        digests+meta pair."""
+        if enc.all_point:
+            packed = self._pack_compact(enc)
+            if packed is not None:
+                return packed
         from ..ops.digest import max_digest_block
         n = enc.n_txns
         nr = enc.r_txn.shape[0]
@@ -253,50 +331,31 @@ class TpuConflictSet(ConflictSet):
         r_cap = _bucket(nr)
         w_cap = _bucket(nw)
 
-        all_point = bool(enc.all_point)
-        point = None
-        if all_point:
-            point = self._group_points(enc, w_cap)
-            if point is None:
-                all_point = False
-
         # Packed digest block: r_b | r_e | w_b | w_e (one h2d transfer);
-        # planar uint32[6, 2R+2W].  Point path: the w sections carry the
-        # unique grouped keys instead (fused.py step docstring).
+        # planar uint32[6, 2R+2W].
         digests = max_digest_block(2 * r_cap + 2 * w_cap)
         digests[:, :nr] = enc.r_begin
         digests[:, r_cap:r_cap + nr] = enc.r_end
-        if all_point:
-            u_keys, u_ends, w_uidx, r_wid = point
-            u = u_keys.shape[1]
-            digests[:, 2 * r_cap:2 * r_cap + u] = u_keys
-            digests[:, 2 * r_cap + w_cap:2 * r_cap + w_cap + u] = u_ends
-        else:
-            digests[:, 2 * r_cap:2 * r_cap + nw] = enc.w_begin
-            digests[:, 2 * r_cap + w_cap:2 * r_cap + w_cap + nw] = enc.w_end
+        digests[:, 2 * r_cap:2 * r_cap + nw] = enc.w_begin
+        digests[:, 2 * r_cap + w_cap:2 * r_cap + w_cap + nw] = enc.w_end
 
         # Packed int32 metadata block (second h2d transfer); scalar slots at
         # the end are filled by _dispatch.
-        meta = np.zeros((self._fused.meta_size(t_cap, r_cap, w_cap,
-                                               all_point),),
+        meta = np.zeros((self._fused.meta_size(t_cap, r_cap, w_cap),),
                         dtype=np.int32)
         o = 0
         meta[o:o + nr] = enc.r_txn; o += r_cap
         meta[o:o + nr] = 1; o += r_cap
-        if all_point:
-            meta[o:o + nr] = r_wid; o += r_cap
         meta[o:o + nw] = enc.w_txn; o += w_cap
         meta[o:o + nw] = 1; o += w_cap
-        if all_point:
-            meta[o:o + nw] = w_uidx; o += w_cap
         snap_off = o; o += t_cap
         meta[o:o + n] = enc.t_has_reads; o += t_cap
         meta[o:o + n] = 1; o += t_cap
 
-        return {"digests": digests, "meta": meta, "snap_off": snap_off,
-                "scalar_off": o, "t_snap_abs": enc.t_snap, "nw": nw,
-                "caps": (t_cap, r_cap, w_cap),
-                "all_point": all_point}
+        return {"compact": False, "digests": digests, "meta": meta,
+                "snap_off": snap_off, "scalar_off": o,
+                "t_snap_abs": enc.t_snap, "nw": nw,
+                "caps": (t_cap, r_cap, w_cap)}
 
     def _dispatch(self, enc, now: Version, oldest_floor: Version,
                   n_txns: int) -> ResolveHandle:
@@ -339,10 +398,17 @@ class TpuConflictSet(ConflictSet):
         overrides it with the shard_map'd step) — the delta budgeting,
         version-offset guard, and merge scheduling above stay shared."""
         jnp = self._jnp
+        if enc["compact"]:
+            step = self._fused.make_resolve_step_compact(
+                self.capacity, self.d_cap, *enc["shapes"])
+            self.dk, self.dv, self.dsize, self.flag, out = step(
+                self.bk, self.bv, self.table, self.size,
+                self.dk, self.dv, self.dsize, self.flag,
+                jnp.asarray(enc["buf"]))
+            return out
         t_cap, r_cap, w_cap = enc["caps"]
         step = self._fused.make_resolve_step(
-            self.capacity, self.d_cap, t_cap, r_cap, w_cap,
-            enc["all_point"])
+            self.capacity, self.d_cap, t_cap, r_cap, w_cap)
         self.dk, self.dv, self.dsize, self.flag, out = step(
             self.bk, self.bv, self.table, self.size,
             self.dk, self.dv, self.dsize, self.flag,

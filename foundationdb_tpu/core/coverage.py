@@ -1,0 +1,65 @@
+"""Code-coverage markers (reference flow's TEST() macro + the TestHarness
+coverage ledger).
+
+The reference sprinkles `TEST("description")` at interesting code paths
+(rare races, recovery branches, spill activations); the test harness
+collects which markers fired across an ensemble and FAILS runs whose
+expected markers never fired — simulation that stops exercising a path
+is a silent coverage regression.  `test_coverage("...")` is the analog:
+call it at the path, assert with `covered()` / report with `report()`;
+scripts/run_ensemble.py aggregates across seeds and prints never-hit
+markers.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Set
+
+_hits: Counter = Counter()
+_registered: Set[str] = set()
+
+
+def test_coverage(name: str) -> None:
+    """Mark this code path as exercised (reference TEST(name))."""
+    _registered.add(name)
+    _hits[name] += 1
+
+
+def register(name: str) -> None:
+    """Pre-register a marker so report() can list it as NEVER hit even
+    when the marking line itself never executed."""
+    _registered.add(name)
+
+
+def covered(name: str) -> bool:
+    return _hits[name] > 0
+
+
+def hits(name: str) -> int:
+    return _hits[name]
+
+
+def report() -> Dict[str, int]:
+    return {name: _hits[name] for name in sorted(_registered)}
+
+
+def missing() -> List[str]:
+    return [name for name in sorted(_registered) if _hits[name] == 0]
+
+
+def reset() -> None:
+    _hits.clear()
+
+
+# Markers that exist in the codebase (kept in sync with the
+# test_coverage() call sites); ensembles report any that never fire.
+for _name in (
+    "RecoveryMasterLockedOldGeneration",
+    "RecoveryRegionFailover",
+    "TLogSpillActivated",
+    "TaskBucketReclaim",
+    "DDShardMerge",
+    "RatekeeperThrottling",
+):
+    register(_name)

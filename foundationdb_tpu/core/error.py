@@ -45,9 +45,11 @@ ERROR_CODES = {
     "connection_failed": 1026,
     "request_maybe_delivered": 1034,
     "proxy_memory_limit_exceeded": 1042,
+    "cluster_version_changed": 1039,
     "master_recovery_failed": 1201,
     "tlog_stopped": 1206,
     "worker_removed": 1202,
+    "coordinators_changed": 1203,
     "please_reboot": 1207,
     "transaction_too_large": 2101,
     "key_too_large": 2102,
@@ -71,6 +73,7 @@ _RETRYABLE = {
     ERROR_CODES["commit_unknown_result"],
     ERROR_CODES["process_behind"],
     ERROR_CODES["request_maybe_delivered"],
+    ERROR_CODES["cluster_version_changed"],
 }
 
 

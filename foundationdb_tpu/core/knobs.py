@@ -34,6 +34,39 @@ class KnobBase:
                 raise KeyError(f"unknown knob {k}")
             setattr(self, k, v)
 
+    def apply_dynamic(self, name: str, raw: bytes) -> bool:
+        """Apply a committed dynamic-knob override (the config-DB path,
+        server/system_data.py KNOBS_PREFIX): the printed value is coerced
+        to the current attribute's type.  Unknown names are ignored with
+        a warning — a knob removed in this build must not wedge the
+        watch.  Returns True when a value actually changed."""
+        from .trace import Severity, TraceEvent
+        if name.startswith("_") or not hasattr(self, name):
+            TraceEvent("DynamicKnobUnknown", Severity.Warn).detail(
+                "Name", name).log()
+            return False
+        cur = getattr(self, name)
+        text = raw.decode()
+        try:
+            if isinstance(cur, bool):
+                value: Any = text.lower() in ("1", "true", "on")
+            elif isinstance(cur, int):
+                value = int(float(text))
+            elif isinstance(cur, float):
+                value = float(text)
+            else:
+                value = text
+        except ValueError:
+            TraceEvent("DynamicKnobBadValue", Severity.Warn).detail(
+                "Name", name).detail("Raw", text).log()
+            return False
+        if value == cur:
+            return False
+        setattr(self, name, value)
+        TraceEvent("DynamicKnobApplied").detail("Name", name).detail(
+            "Value", value).log()
+        return True
+
 
 class FlowKnobs(KnobBase):
     def __init__(self) -> None:

@@ -1,0 +1,116 @@
+"""Sampling profiler + slow-task detection (reference flow/Profiler.actor.cpp
+:100 SIGPROF sampler and Net2's slow-task TraceEvents).
+
+Two production observability tools for real deployments:
+
+- SlowTask detection: the reactor times every callback it dispatches
+  (install_slow_task_detection hooks EventLoop._dispatch below); one that
+  holds the loop beyond the threshold emits a SlowTask TraceEvent with
+  the callback's name — the single-threaded reactor means every such
+  stall delays every connection of the process (the reason the blocking
+  work offload in core/threadpool.py exists; this is the tool that FINDS
+  offenders).
+
+- SamplingProfiler: a daemon thread sampling the reactor thread's stack
+  at a fixed interval (sys._current_frames, the in-process analog of the
+  reference's SIGPROF handler writing profile.bin).  report() aggregates
+  samples into (stack, count) hot spots; fdbserver enables it with
+  --profile / FDB_PROFILE=1 and dumps the top stacks to the trace log on
+  shutdown or on demand.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from collections import Counter
+from typing import List, Optional, Tuple
+
+from .trace import Severity, TraceEvent
+
+SLOW_TASK_THRESHOLD_S = 0.25
+
+
+def install_slow_task_detection(loop,
+                                threshold_s: float = SLOW_TASK_THRESHOLD_S
+                                ) -> None:
+    """Time each dispatched CALLBACK (via EventLoop.callback_hook — idle
+    sleeps and selector waits are not counted) and emit a SlowTask
+    TraceEvent when one holds the reactor past the threshold."""
+    if getattr(loop, "_slow_task_installed", False):
+        return
+    loop._slow_task_installed = True
+
+    def timing_hook(fn):
+        t0 = time.monotonic()
+        fn()
+        dt = time.monotonic() - t0
+        if dt > threshold_s:
+            TraceEvent("SlowTask", Severity.Warn).detail(
+                "DurationMs", round(dt * 1e3, 1)).detail(
+                "ThresholdMs", round(threshold_s * 1e3, 1)).detail(
+                "Callback", getattr(fn, "__qualname__", repr(fn))[:80]
+            ).log()
+
+    loop.callback_hook = timing_hook
+
+
+class SamplingProfiler:
+    def __init__(self, interval_s: float = 0.01,
+                 target_thread: Optional[int] = None) -> None:
+        self.interval_s = interval_s
+        self.target = target_thread or threading.main_thread().ident
+        self.samples: Counter = Counter()
+        self.total = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Guards samples: report() on the reactor thread vs inserts on
+        # the sampler thread ("dict changed size during iteration").
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fdb-profiler")
+        self._thread.start()
+
+    def _run(self) -> None:
+        import sys
+        while not self._stop.wait(self.interval_s):
+            frame = sys._current_frames().get(self.target)
+            if frame is None:
+                continue
+            stack = tuple(
+                f"{fr.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                f"{fr.f_code.co_name}:{lineno}"
+                for fr, lineno in traceback.walk_stack(frame))
+            # Innermost first, capped: deep actor stacks all share the
+            # scheduler root frames.
+            with self._lock:
+                self.samples[stack[:12]] += 1
+                self.total += 1
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self._thread = None
+
+    def report(self, top: int = 10) -> List[Tuple[float, str]]:
+        """[(fraction_of_samples, 'inner<-outer stack'), ...]"""
+        with self._lock:
+            snapshot = Counter(self.samples)
+            total = self.total
+        out = []
+        for stack, n in snapshot.most_common(top):
+            out.append((n / max(total, 1), " <- ".join(stack[:5])))
+        return out
+
+    def log_report(self, top: int = 10) -> None:
+        for frac, stack in self.report(top):
+            TraceEvent("ProfilerHotStack").detail(
+                "Fraction", round(frac, 4)).detail("Stack", stack).log()

@@ -88,6 +88,17 @@ class EventLoop:
         # in real mode; sim mode has no file descriptors by construction.
         self._selector = None
         self._io_cbs: dict = {}   # fd -> [reader_cb, writer_cb]
+        # Optional instrumentation wrapper around each dispatched callback
+        # (core/profiler.py slow-task detection): receives the callable,
+        # must invoke it.  Only callback EXECUTION goes through it — idle
+        # sleeps and selector waits do not.
+        self.callback_hook = None
+
+    def _dispatch(self, fn) -> None:
+        if self.callback_hook is None:
+            fn()
+        else:
+            self.callback_hook(fn)
 
     # -- real-IO reactor (real mode only) ------------------------------------
     def _sel(self):
@@ -145,10 +156,10 @@ class EventLoop:
             if cbs is None:
                 continue
             if (mask & selectors.EVENT_READ) and cbs[0] is not None:
-                cbs[0]()
+                self._dispatch(cbs[0])
                 ran = True
             if (mask & selectors.EVENT_WRITE) and cbs[1] is not None:
-                cbs[1]()
+                self._dispatch(cbs[1])
                 ran = True
         return ran
 
@@ -226,7 +237,7 @@ class EventLoop:
             heapq.heappop(self._heap)
             if when > self._time:
                 self._time = when
-            fn()
+            self._dispatch(fn)
             return True
         # Real mode: fuse the timer heap with the IO reactor.  Wait for
         # whichever comes first — the next timer, the deadline, or IO
@@ -256,7 +267,7 @@ class EventLoop:
             if when is None:
                 continue                    # pure-IO loop: keep waiting
         when, negprio, seq, fn = heapq.heappop(self._heap)
-        fn()
+        self._dispatch(fn)
         return True
 
     def stop(self) -> None:

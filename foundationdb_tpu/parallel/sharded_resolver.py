@@ -117,15 +117,14 @@ class ShardedTpuConflictSet(TpuConflictSet):
         self.dsize = self._put(np.ones((self.n_shards,), dtype=np.int32))
 
     # -- sharded programs ---------------------------------------------------
-    def _sharded_step(self, t_cap: int, r_cap: int, w_cap: int,
-                      all_point: bool):
-        key = (self.capacity, self.d_cap, t_cap, r_cap, w_cap, all_point)
+    def _sharded_step(self, t_cap: int, r_cap: int, w_cap: int):
+        key = (self.capacity, self.d_cap, t_cap, r_cap, w_cap)
         fn = self._step_cache.get(key)
         if fn is not None:
             return fn
         import jax
         raw = self._fused.make_resolve_step(
-            self.capacity, self.d_cap, t_cap, r_cap, w_cap, all_point,
+            self.capacity, self.d_cap, t_cap, r_cap, w_cap,
             axis_name="kr")
 
         def shard_fn(bk, bv, table, size, dk, dv, dsize, flag,
@@ -144,6 +143,33 @@ class ShardedTpuConflictSet(TpuConflictSet):
                       spec_state3, spec_state2, spec_1, spec_1,
                       P(None, None), P(None), spec_state3),
             out_specs=(spec_state3, spec_state2, spec_1, spec_1, P(None)),
+            check_vma=False)
+        fn = jax.jit(mapped, donate_argnums=(4, 5, 6, 7))
+        self._step_cache[key] = fn
+        return fn
+
+    def _sharded_step_compact(self, shapes):
+        key = (self.capacity, self.d_cap, "compact") + tuple(shapes)
+        fn = self._step_cache.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        raw = self._fused.make_resolve_step_compact(
+            self.capacity, self.d_cap, *shapes, axis_name="kr")
+
+        def shard_fn(bk, bv, table, size, dk, dv, dsize, flag, buf, bounds):
+            dk2, dv2, ds2, fl2, out = raw(
+                bk[0], bv[0], table[0], size[0], dk[0], dv[0], dsize[0],
+                flag[0], buf, bounds[0])
+            return dk2[None], dv2[None], ds2[None], fl2[None], out
+
+        s3 = P("kr", None, None)
+        s2 = P("kr", None)
+        s1 = P("kr")
+        mapped = jax.shard_map(
+            shard_fn, mesh=self.mesh,
+            in_specs=(s3, s2, s3, s1, s3, s2, s1, s1, P(None), s3),
+            out_specs=(s3, s2, s1, s1, P(None)),
             check_vma=False)
         fn = jax.jit(mapped, donate_argnums=(4, 5, 6, 7))
         self._step_cache[key] = fn
@@ -200,8 +226,15 @@ class ShardedTpuConflictSet(TpuConflictSet):
         as often as the single-device backend), the _REL_LIMIT guard, and
         merge scheduling."""
         jnp = self._jnp
+        if enc["compact"]:
+            step = self._sharded_step_compact(enc["shapes"])
+            self.dk, self.dv, self.dsize, self.flag, out = step(
+                self.bk, self.bv, self.table, self.size,
+                self.dk, self.dv, self.dsize, self.flag,
+                jnp.asarray(enc["buf"]), self.bounds)
+            return out
         t_cap, r_cap, w_cap = enc["caps"]
-        step = self._sharded_step(t_cap, r_cap, w_cap, enc["all_point"])
+        step = self._sharded_step(t_cap, r_cap, w_cap)
         self.dk, self.dv, self.dsize, self.flag, out = step(
             self.bk, self.bv, self.table, self.size,
             self.dk, self.dv, self.dsize, self.flag,

@@ -1,0 +1,112 @@
+"""The backup worker role (reference fdbserver/BackupWorker.actor.cpp:1033).
+
+A SERVER role — recruited by the master per epoch while a backup is
+active — that pulls BACKUP_TAG from the epoch's log system and appends
+(version, mutations) records to the backup container, advancing the
+durable capture frontier and popping the TLogs behind itself.  Because
+capture is a recruited role writing to a shared container URL, it
+survives the death of whichever client submitted the backup (the agent
+in client/backup.py only activates/deactivates and snapshots).
+
+The role resumes from the container's own log tail, so epoch changes
+splice exactly: the new epoch's worker re-opens the container, finds the
+last appended version, and continues from there against the new log
+system (whose generation carried the un-popped BACKUP_TAG records)."""
+
+from __future__ import annotations
+
+from ..core.error import FdbError
+from ..core.scheduler import delay
+from ..core.trace import Severity, TraceEvent
+from ..txn.types import Version
+from .interfaces import TLogInterface
+
+
+class BackupWorker:
+    def __init__(self, bw_id: str, epoch: int, log_system,
+                 container_url: str, db=None) -> None:
+        self.id = bw_id
+        self.epoch = epoch
+        self.log_system = log_system
+        self.container_url = container_url
+        self.db = db
+        self.stopped = False
+        # A minimal interface object so wait_failure_of can watch it.
+        self.interface = TLogInterface(bw_id)
+        self.interface.role = self
+
+    def halt(self) -> None:
+        self.stopped = True
+
+    async def _url_watch(self) -> None:
+        """Self-retire when the committed container URL moves to a new
+        backup: exactly one worker may consume (and pop) BACKUP_TAG, and
+        the master's nudge handler recruits the successor — the OLD
+        worker must notice and stop rather than split the stream between
+        two containers."""
+        from ..server.system_data import BACKUP_CONTAINER_KEY
+        if self.db is None:
+            return
+        while not self.stopped:
+            await delay(3.0)
+            try:
+                t = self.db.create_transaction()
+                t.access_system_keys = True
+                raw = await t.get(BACKUP_CONTAINER_KEY)
+            except FdbError:
+                continue
+            if raw is not None and raw.decode() != self.container_url:
+                TraceEvent("BackupWorkerRetired").detail(
+                    "Id", self.id).detail(
+                    "NewUrl", raw.decode()).log()
+                self.halt()
+                return
+
+    async def run(self) -> None:
+        from ..client.backup import open_container
+        from ..server.system_data import BACKUP_TAG
+        try:
+            container = open_container(self.container_url)
+        except FdbError as e:
+            TraceEvent("BackupWorkerNoContainer", Severity.Error).detail(
+                "Url", self.container_url).detail("Error", e.name).log()
+            return
+        _off, last_v = await container.log_tail()
+        fetch_from: Version = last_v + 1
+        frontier: Version = await container.read_frontier()
+        TraceEvent("BackupWorkerStarted").detail("Id", self.id).detail(
+            "Epoch", self.epoch).detail("From", fetch_from).log()
+        if self.db is not None:
+            from ..core.scheduler import spawn
+            spawn(self._url_watch(), f"{self.id}.urlWatch")
+        errors = 0
+        while not self.stopped:
+            try:
+                reply = await self.log_system.peek_tag(BACKUP_TAG,
+                                                       fetch_from)
+                errors = 0
+            except FdbError:
+                errors += 1
+                if errors > 20:
+                    # The epoch's log system is gone (recovery); the next
+                    # epoch's worker resumes from the container tail.
+                    TraceEvent("BackupWorkerLogSystemGone").detail(
+                        "Id", self.id).log()
+                    return
+                await delay(0.25)
+                continue
+            for version, msgs in reply.messages:
+                if version >= fetch_from:
+                    await container.append_log(version, msgs)
+            if reply.messages:
+                self.log_system.pop(BACKUP_TAG, reply.messages[-1][0])
+            fetch_from = max(fetch_from, reply.end)
+            # Complete through reply.end - 1 (NOT max_known_version: a
+            # paginated peek has messages beyond `end` still unpulled).
+            new_frontier = max(frontier, reply.end - 1)
+            if new_frontier > frontier:
+                frontier = new_frontier
+                await container.write_frontier(frontier)
+            if not reply.messages:
+                await delay(0.05)
+        TraceEvent("BackupWorkerStopped").detail("Id", self.id).log()

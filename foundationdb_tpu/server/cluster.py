@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from ..core.scheduler import EventLoop, set_event_loop
+from ..core.scheduler import EventLoop, get_event_loop, set_event_loop
 from ..rpc.sim import Simulator, set_simulator
 from ..txn.types import Version
 from .commit_proxy import CommitProxy, LogSystemClient
@@ -149,10 +149,16 @@ class SimFdbCluster:
     def __init__(self, config=None, n_workers: int = 4,
                  n_storage_workers: int = 2, n_coordinators: int = 3,
                  loop: Optional[EventLoop] = None,
-                 n_zones: int = 0) -> None:
+                 n_zones: int = 0, sim: Optional[Simulator] = None,
+                 name_prefix: str = "") -> None:
         """n_zones > 0 places storage workers round-robin into that many
         failure zones (reference LocalityData zoneId); 0 = every machine
-        its own zone (the default locality)."""
+        its own zone (the default locality).
+
+        Pass an existing `sim` (+ its loop) and a distinct `name_prefix`
+        to host a SECOND independent cluster in the same simulation — the
+        DR topology (two clusters, one universe; reference
+        SimulatedCluster can host the dr side the same way)."""
         from .interfaces import DatabaseConfiguration
 
         self.config = config or DatabaseConfiguration()
@@ -165,10 +171,15 @@ class SimFdbCluster:
         self.n_storage_workers = n_storage_workers
         self.n_coordinators = n_coordinators
         self.n_zones = n_zones
-        self.loop = loop or EventLoop(sim=True)
-        set_event_loop(self.loop)
-        self.sim = Simulator()
-        set_simulator(self.sim)
+        self.name_prefix = name_prefix
+        if sim is not None:
+            self.loop = loop or get_event_loop()
+            self.sim = sim
+        else:
+            self.loop = loop or EventLoop(sim=True)
+            set_event_loop(self.loop)
+            self.sim = Simulator()
+            set_simulator(self.sim)
         self._boot()
 
     def _boot(self) -> None:
@@ -186,10 +197,11 @@ class SimFdbCluster:
         self.coordinators = []
         self.coordinator_clients = []
         for i in range(self.n_coordinators):
-            p = self.sim.new_process(name=f"coord{i}",
-                                     machineid=f"mach.coord{i}",
+            p = self.sim.new_process(name=f"{self.name_prefix}coord{i}",
+                                     machineid=f"mach.{self.name_prefix}coord{i}",
                                      process_class="coordinator")
-            server = CoordinationServer(f"coord{i}", fs=self.sim.fs_for(p))
+            server = CoordinationServer(f"{self.name_prefix}coord{i}",
+                                        fs=self.sim.fs_for(p))
             server.run(p)
             self.coordinators.append((p, server))
             self.coordinator_clients.append(
@@ -200,8 +212,8 @@ class SimFdbCluster:
             pclass = "storage" if i < self.n_storage_workers else "stateless"
             zone = (f"z{i % self.n_zones}"
                     if self.n_zones and pclass == "storage" else "")
-            p = self.sim.new_process(name=f"worker{i}",
-                                     machineid=f"mach.worker{i}",
+            p = self.sim.new_process(name=f"{self.name_prefix}worker{i}",
+                                     machineid=f"mach.{self.name_prefix}worker{i}",
                                      process_class=pclass, zoneid=zone)
             leader_var = AsyncVar(None)
             # Only stateless workers campaign for CC (a storage worker
@@ -226,25 +238,62 @@ class SimFdbCluster:
             worker.run(leader_var)
             self.workers.append((p, worker, cc, leader_var))
 
+    def add_coordinator(self, name: Optional[str] = None):
+        """Start one more coordination server mid-run (a changeQuorum
+        target must serve generation registers before the management
+        probe arrives)."""
+        from .coordination import CoordinationServer
+        i = len(self.coordinators)
+        name = name or f"{self.name_prefix}coord{i}"
+        p = self.sim.new_process(name=name, machineid=f"mach.{name}",
+                                 process_class="coordinator")
+        server = CoordinationServer(name, fs=self.sim.fs_for(p))
+        server.run(p)
+        self.coordinators.append((p, server))
+        return p, server
+
+    @staticmethod
+    def spec_of(pairs) -> str:
+        """Connection spec "ip:port,..." for (process, server) pairs."""
+        return ",".join(f"{p.address.ip}:{p.address.port}"
+                        for p, _ in pairs)
+
     def add_worker(self, pclass: str = "stateless",
-                   name: Optional[str] = None):
+                   name: Optional[str] = None, dcid: str = "dc0",
+                   campaign: bool = False, zoneid: str = ""):
         """Register one more worker process mid-run (used by placement
         tests: a better-class worker joining should trigger
-        betterMasterExists re-recruitment)."""
+        betterMasterExists re-recruitment; region tests place workers in
+        a second dc, with `campaign` giving the remote dc a CC candidate
+        so it can elect a controller after the primary dc dies)."""
         from ..core.futures import AsyncVar
-        from .coordination import monitor_leader
+        from .cluster_controller import ClusterController
+        from .coordination import monitor_leader, try_become_leader
         from .worker import Worker
         i = len(self.workers)
         name = name or f"worker{i}"
         p = self.sim.new_process(name=name, machineid=f"mach.{name}",
-                                 process_class=pclass)
+                                 process_class=pclass, dcid=dcid,
+                                 zoneid=zoneid)
         leader_var = AsyncVar(None)
-        p.spawn(monitor_leader(self.coordinator_clients, leader_var),
-                f"{name}.monitorLeader")
+        cc = None
+        if campaign and pclass == "stateless":
+            cc = ClusterController(f"cc.{name}",
+                                   self.coordinator_clients, self.config)
+            cc.register_streams(p)
+            p.spawn(try_become_leader(self.coordinator_clients,
+                                      cc.interface, leader_var,
+                                      change_id=100 + i),
+                    f"{name}.campaign")
+            p.spawn(self._cc_runner(p, cc, leader_var, 100 + i),
+                    f"{name}.ccRunner")
+        else:
+            p.spawn(monitor_leader(self.coordinator_clients, leader_var),
+                    f"{name}.monitorLeader")
         worker = Worker(p, self.coordinator_clients,
                         process_class=pclass, config=self.config)
         worker.run(leader_var)
-        self.workers.append((p, worker, None, leader_var))
+        self.workers.append((p, worker, cc, leader_var))
         return p, worker
 
     def power_fail_reboot(self) -> None:

@@ -62,9 +62,11 @@ class ClusterController:
             p.send(None)
 
     def client_db_info(self) -> ClientDBInfo:
+        from ..rpc.real_network import PROTOCOL_VERSION
         return ClientDBInfo(epoch=self.db_info.epoch,
                             grv_proxies=list(self.db_info.grv_proxies),
-                            commit_proxies=list(self.db_info.commit_proxies))
+                            commit_proxies=list(self.db_info.commit_proxies),
+                            protocol_version=PROTOCOL_VERSION)
 
     # -- serving -------------------------------------------------------------
     async def _serve_register_worker(self) -> None:
@@ -81,7 +83,8 @@ class ClusterController:
             self.workers[req.worker.id] = WorkerRegistration(
                 req.worker, req.process_class,
                 req.recovered_logs, req.recovered_storage,
-                getattr(req, "storage_versions", {}) or {})
+                getattr(req, "storage_versions", {}) or {},
+                getattr(req, "locality", ("", "", "")) or ("", "", ""))
             arrived, self._worker_arrived = self._worker_arrived, []
             for p in arrived:
                 p.send(None)

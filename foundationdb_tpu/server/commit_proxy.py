@@ -26,7 +26,7 @@ from ..core.trace import Severity, TraceEvent
 from ..txn.types import (CommitResult, CommitTransactionRef, KeyRange,
                          Mutation, MutationType, Version)
 from ..rpc.endpoint import RequestStream
-from .interfaces import (CommitID, CommitProxyInterface,
+from .interfaces import (CACHE_TAG, CommitID, CommitProxyInterface,
                          CommitTransactionRequest, GetCommitVersionRequest,
                          GetKeyServerLocationsReply, GetReadVersionRequest,
                          ReportRawCommittedVersionRequest,
@@ -133,6 +133,11 @@ class CommitProxy:
         # key -> [Tag] storage team (reference keyInfo/tagsForKey :926).
         self.key_servers = key_servers
         self.storage_interfaces = storage_interfaces or {}
+        # Cached hot ranges (reference cacheKeysPrefix): mutations inside
+        # additionally ride CACHE_TAG; locations append the cache roles.
+        self._cache_entries: Dict[bytes, bytes] = {}
+        self.cached_ranges: RangeMap = RangeMap(default=False)
+        self.storage_caches: List[Any] = []
         self.interface = CommitProxyInterface(proxy_id)
         self.committed_version = NotifiedVersion(recovery_version)
         self.last_resolved_version: Version = recovery_version
@@ -445,8 +450,20 @@ class CommitProxy:
         backup-active flag, and storage-server registry (serverTag) rejoin
         updates.  True if the mutation was metadata."""
         handled, backup_flag = apply_metadata_mutation(self.key_servers, m)
+        from .system_data import BACKUP_CONTAINER_KEY
+        if m.type == MutationType.SetValue and \
+                m.param1 == BACKUP_CONTAINER_KEY:
+            self.backup_container = m.param2.decode()
+            handled = True
         if backup_flag is not None:
             self.backup_active = backup_flag
+            # Mid-epoch activation: nudge the master to recruit (or halt)
+            # the backup worker role NOW rather than at the next recovery.
+            try:
+                RequestStream.at(self.master.backup_changed.endpoint).send(
+                    (backup_flag, getattr(self, "backup_container", "")))
+            except Exception:  # noqa: BLE001 — next recovery recruits
+                pass
         from .system_data import parse_conf_mutation
         cf = parse_conf_mutation(m)
         if cf is not None:
@@ -477,6 +494,34 @@ class CommitProxy:
                 cur = self.storage_interfaces.get(tag)
                 if not same_incarnation(cur, iface):
                     self.storage_interfaces[tag] = iface
+            handled = True
+        # Cached-range registry (reference cacheKeysPrefix handling in
+        # ApplyMetadataMutation): \xff/cacheRanges/<begin> = <end>.
+        # Entries are kept as a dict and the routing RangeMap rebuilt per
+        # change (tiny registry; a clear mutation does not carry the old
+        # end, so in-place range edits cannot express removal).
+        from .system_data import CACHE_RANGES_PREFIX
+        changed = False
+        if m.param1.startswith(CACHE_RANGES_PREFIX):
+            begin = m.param1[len(CACHE_RANGES_PREFIX):]
+            if m.type == MutationType.SetValue:
+                self._cache_entries[begin] = m.param2
+            else:
+                self._cache_entries.pop(begin, None)
+            changed = True
+        elif m.type == MutationType.ClearRange and \
+                m.param2 > CACHE_RANGES_PREFIX and \
+                m.param1 < CACHE_RANGES_PREFIX + b"\xff":
+            for b in list(self._cache_entries):
+                k = CACHE_RANGES_PREFIX + b
+                if m.param1 <= k < m.param2:
+                    del self._cache_entries[b]
+            changed = True
+        if changed:
+            cr: RangeMap = RangeMap(default=False)
+            for b, e in self._cache_entries.items():
+                cr.set_range(b, e, True)
+            self.cached_ranges = cr
             handled = True
         return handled
 
@@ -583,12 +628,42 @@ class CommitProxy:
                         clipped = Mutation(MutationType.ClearRange, b, e)
                         for tag in tags:
                             messages.setdefault(tag, []).append(clipped)
+                    if self.storage_caches:
+                        for b, e, cached in self.cached_ranges.intersecting(
+                                m.param1, m.param2):
+                            if cached:
+                                messages.setdefault(CACHE_TAG, []).append(
+                                    Mutation(MutationType.ClearRange, b, e))
                 else:
                     for tag in self.tags_for_key(m.param1):
                         messages.setdefault(tag, []).append(m)
+                    if self.storage_caches and \
+                            self.cached_ranges.lookup(m.param1):
+                        # Cached range: the mutation also rides CACHE_TAG
+                        # (reference CommitProxyServer.actor.cpp:959).
+                        messages.setdefault(CACHE_TAG, []).append(m)
+        if getattr(self, "region_replication", False):
+            # Mirror onto twin tags (region replication): the log routers
+            # pull twins from the primary TLogs and feed the remote plane
+            # (server/log_router.py).  TXS rides REMOTE_TXS so a failover
+            # can replay the epoch's metadata from the remote TLog.
+            from .interfaces import REMOTE_TXS_TAG
+            from .log_router import REMOTE_TAG_OFFSET, twin_tag
+            twins = {}
+            for tag, msgs in messages.items():
+                if tag == TXS_TAG:
+                    twins[REMOTE_TXS_TAG] = msgs
+                elif 0 <= tag < REMOTE_TAG_OFFSET:
+                    twins[twin_tag(tag)] = msgs
+            messages.update(twins)
         return messages
 
     # -- key server locations (reference :1488 doKeyServerLocationRequest) ---
+    def _range_fully_cached(self, b: bytes, e: bytes) -> bool:
+        return bool(self.cached_ranges.lookup(b)) and all(
+            cached for _b, _e, cached in self.cached_ranges.intersecting(
+                b, e))
+
     async def _serve_locations(self) -> None:
         async for req in self.interface.get_key_servers_locations.queue:
             results = []
@@ -602,6 +677,12 @@ class CommitProxy:
                     continue
                 ssis = [self.storage_interfaces[t] for t in (tags or [])
                         if t in self.storage_interfaces]
+                if self.storage_caches and \
+                        self._range_fully_cached(b, e):
+                    # Hot-shard read scaling (reference StorageCache):
+                    # the cache joins the replica set; the client's
+                    # latency-ordered selection spreads reads onto it.
+                    ssis = ssis + list(self.storage_caches)
                 results.append((KeyRange(b, e), ssis))
                 if len(results) >= req.limit:
                     break

@@ -22,6 +22,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..core.error import FdbError, err
 from ..core.futures import Future, Promise, wait_all, wait_any
 from ..core.buggify import buggify
 from ..core.rng import deterministic_random
@@ -206,10 +207,26 @@ class CoordinationServer:
                                       max(rgen, req.gen))
                 await self._persist(req.key)
                 req.reply.send(GenRegWriteReply(gen=req.gen))
+                # A quorum-change forward write deposes any elected leader
+                # and redirects every waiting candidate/observer at once.
+                spec = (unpack_forward(req.value)
+                        if req.key == CSTATE_KEY else None)
+                if spec is not None:
+                    TraceEvent("CoordinatorForwarded").detail(
+                        "Id", self.id).detail("NewSpec", spec).log()
+                    self._set_nominee(LEADER_KEY, LeaderInfo(
+                        change_id=-2, serialized_info=spec, forward=True))
             else:
                 # Reject: reply with the winning generation so the caller
                 # knows it lost (reference replies wgen on both paths).
                 req.reply.send(GenRegWriteReply(gen=max(vgen, rgen)))
+
+    def _forward_spec(self) -> Optional[str]:
+        """The new connection spec if this coordinator's cstate register
+        has been forwarded by a quorum change, else None."""
+        value, _, _ = self._reg.get(CSTATE_KEY,
+                                    (None, Generation(), Generation()))
+        return unpack_forward(value)
 
     # -- leader election -----------------------------------------------------
     def _best_candidate(self, key: bytes) -> Optional[LeaderInfo]:
@@ -239,6 +256,11 @@ class CoordinationServer:
                                 f"{self.id}.candidacy")
 
     async def _handle_candidacy(self, req: CandidacyRequest) -> None:
+        spec = self._forward_spec()
+        if spec is not None:
+            req.reply.send(LeaderInfo(change_id=-2, serialized_info=spec,
+                                      forward=True))
+            return
         self._candidates.setdefault(req.key, {})[
             req.my_info.change_id] = req.my_info
         self._maybe_renominate(req.key)
@@ -253,6 +275,8 @@ class CoordinationServer:
 
     def _maybe_renominate(self, key: bytes) -> None:
         from ..core.scheduler import now
+        if self._forward_spec() is not None:
+            return                      # forwarded: never elect again
         cur = self._nominee.get(key)
         best = self._best_candidate(key)
         stale = (cur is not None and
@@ -267,6 +291,11 @@ class CoordinationServer:
                                 f"{self.id}.leaderGet")
 
     async def _handle_leader_get(self, req: LeaderGetRequest) -> None:
+        spec = self._forward_spec()
+        if spec is not None:
+            req.reply.send(LeaderInfo(change_id=-2, serialized_info=spec,
+                                      forward=True))
+            return
         nominee = self._nominee.get(req.key)
         if nominee is not None and \
                 nominee.change_id != req.known_leader_change_id:
@@ -279,6 +308,9 @@ class CoordinationServer:
     async def _serve_heartbeat(self) -> None:
         from ..core.scheduler import now
         async for req in self.heartbeat.queue:
+            if self._forward_spec() is not None:
+                req.reply.send(False)     # forwarded: depose the leader
+                continue
             cur = self._nominee.get(req.key)
             if cur is not None and cur.change_id == req.my_info.change_id:
                 self._last_heartbeat[req.key] = now()
@@ -292,6 +324,8 @@ class CoordinationServer:
         from ..core.scheduler import now
         while True:
             await delay(1.0)
+            if self._forward_spec() is not None:
+                continue
             for key in list(self._nominee):
                 cur = self._nominee.get(key)
                 if cur is None:
@@ -357,6 +391,48 @@ class CoordinationClientInterface:
 
 CSTATE_KEY = b"dbCoreState"
 
+# Forwarding marker written into the cstate register by a quorum change
+# (reference MovableValue::MovedFrom in fdbserver/CoordinatedState.actor.cpp
+# and LeaderInfo.forward in fdbclient/CoordinationInterface.h): a forwarded
+# coordinator answers every cstate read and every election request with the
+# NEW connection spec instead of data, so stale workers/clients chase the
+# quorum wherever it moved.
+FORWARD_MAGIC = b"\xffMOVEDTO\xff"
+
+
+def pack_forward(spec: str) -> bytes:
+    return FORWARD_MAGIC + spec.encode()
+
+
+def unpack_forward(value) -> Optional[str]:
+    if isinstance(value, (bytes, bytearray)) and \
+            bytes(value[:len(FORWARD_MAGIC)]) == FORWARD_MAGIC:
+        return bytes(value[len(FORWARD_MAGIC):]).decode()
+    return None
+
+
+def parse_spec(spec: str) -> List["CoordinationClientInterface"]:
+    """Coordinator client handles from a connection spec "ip:port,...".
+    Works over both the real transport and the simulator: each resolves
+    endpoints purely from (address, well-known token)."""
+    from ..rpc.endpoint import NetworkAddress
+    out = []
+    for part in spec.split(","):
+        host, port = part.strip().rsplit(":", 1)
+        out.append(CoordinationClientInterface.at_address(
+            NetworkAddress(host, int(port))))
+    return out
+
+
+def normalize_spec(spec: str) -> str:
+    """Canonical "ip:port,ip:port" form: what gets COMMITTED to
+    \\xff/coordinators and what the master compares its running quorum
+    against — a raw string with whitespace or zero-padded ports must not
+    read as a perpetual difference (it would bounce every epoch)."""
+    return ",".join(
+        f"{c.reg_read.address.ip}:{c.reg_read.address.port}"
+        for c in parse_spec(spec))
+
 
 class CoordinatedState:
     """Two-phase quorum state machine over the coordinators."""
@@ -415,12 +491,18 @@ class CoordinatedState:
             if lost:
                 continue
             self._gen = gen
-            return best.value if best else None
+            value = best.value if best else None
+            spec = unpack_forward(value)
+            if spec is not None:
+                e = err("coordinators_changed",
+                        f"coordinated state moved to {spec}")
+                e.new_spec = spec
+                raise e
+            return value
 
     async def write(self, value: bytes) -> None:
         """Phase 2: quorum write at the read generation.  Raises
         coordinated_state_conflict if another writer won the race."""
-        from ..core.error import err
         assert self._gen is not None, "read() before write()"
         gen = self._gen
         futures = [RequestStream.at(c.reg_write).get_reply(
@@ -435,6 +517,52 @@ class CoordinatedState:
 from ..core.futures import swallow as _swallow  # noqa: E402
 
 
+async def move_coordinated_state(cstate: CoordinatedState,
+                                 new_spec: str) -> None:
+    """Quorum change (reference fdbclient/ManagementAPI.actor.cpp
+    changeQuorum + MovableCoordinatedState): seed the NEW quorum with the
+    current DBCoreState, then forward the OLD quorum.  Run by the master
+    (the cstate's single writer) when the committed \\xff/coordinators key
+    diverges from the quorum it recovered on; the epoch ends right after,
+    and every worker/client chasing the old coordinators is redirected by
+    their forward replies.
+
+    Crash-safe at every point: before the forward write the old quorum
+    stays authoritative (the seeded copy on the new quorum is inert); the
+    forward write itself is a quorum write, and once it lands all readers
+    — including a re-recovering master — find the new spec."""
+    new_coords = parse_spec(new_spec)
+    old_addrs = {(c.reg_read.address.ip, c.reg_read.address.port)
+                 for c in cstate.coordinators
+                 if getattr(c.reg_read, "address", None) is not None}
+    new_addrs = {(c.reg_read.address.ip, c.reg_read.address.port)
+                 for c in new_coords}
+    if old_addrs & new_addrs:
+        # A coordinator in BOTH quorums would hold ONE cstate register
+        # serving two roles — the seeded new state and the old quorum's
+        # forward marker collide on it (the reference disambiguates by
+        # changing the cluster key, i.e. the register name, on every
+        # quorum change; tracked as a gap).  Refuse rather than wedge.
+        raise err("client_invalid_operation",
+                  "new quorum must not share members with the old one "
+                  f"(shared: {sorted(old_addrs & new_addrs)})")
+    cur = await cstate.read()     # fresh generation; raises if already moved
+    packed = (bytes(cur) if isinstance(cur, (bytes, bytearray))
+              else cur.pack() if cur is not None else None)
+    cs_new = CoordinatedState(new_coords)
+    try:
+        await cs_new.read()
+    except FdbError as e:
+        if e.name != "coordinators_changed":
+            raise
+        raise err("coordinated_state_conflict",
+                  f"target quorum {new_spec} is itself forwarded")
+    if packed is not None:
+        await cs_new.write(packed)
+    await cstate.write(pack_forward(new_spec))
+    TraceEvent("CoordinatorsMoved").detail("NewSpec", new_spec).log()
+
+
 # ---------------------------------------------------------------------------
 # Leader election client (reference fdbserver/LeaderElection.h:40)
 # ---------------------------------------------------------------------------
@@ -442,14 +570,45 @@ from ..core.futures import swallow as _swallow  # noqa: E402
 LEADER_KEY = b"clusterLeader"
 
 
+# Process-wide forward hook (the fdbserver cluster-file rewriter): ANY
+# follower discovering the move fires it — whichever of the process's
+# monitor/campaign/client loops sees the forward reply first swaps the
+# SHARED coordinator list, after which the others never receive a forward
+# at all (they are already talking to the new quorum), so a per-loop
+# callback alone would miss the rewrite.
+_process_forward_hook = None
+
+
+def set_forward_hook(fn) -> None:
+    global _process_forward_hook
+    _process_forward_hook = fn
+
+
+def _follow_forward(coordinators: List[CoordinationClientInterface],
+                    spec: str, on_forward) -> None:
+    """Redirect to a moved quorum: swap the SHARED coordinator list
+    in place (worker, master, clients all hold references to the same
+    list object) and notify the process hook (cluster-file rewrite)."""
+    TraceEvent("CoordinatorsForwardFollowed", Severity.Warn).detail(
+        "NewSpec", spec).log()
+    coordinators[:] = parse_spec(spec)
+    if on_forward is not None:
+        on_forward(spec)
+    if _process_forward_hook is not None:
+        _process_forward_hook(spec)
+
+
 async def try_become_leader(coordinators: List[CoordinationClientInterface],
                             my_info_payload: Any,
                             out_current_leader,  # AsyncVar[LeaderInfo|None]
-                            change_id: Optional[int] = None) -> None:
+                            change_id: Optional[int] = None,
+                            on_forward=None) -> None:
     """Campaign forever: register candidacy with every coordinator; whoever
     a majority nominates is leader.  If WE are leader, heartbeat until
     deposed; `out_current_leader` tracks the majority leader for observers.
-    Runs until cancelled."""
+    A forward reply (quorum moved by changeQuorum) swaps the coordinator
+    list in place and re-campaigns on the new quorum.  Runs until
+    cancelled."""
     my_info = LeaderInfo(
         change_id=(change_id if change_id is not None
                    else deterministic_random().random_int(0, 1 << 30)),
@@ -467,7 +626,8 @@ async def try_become_leader(coordinators: List[CoordinationClientInterface],
         quorum = len(coordinators) // 2 + 1
         pending = list(futures)
         elected: Optional[LeaderInfo] = None
-        while pending and elected is None:
+        forwarded: Optional[str] = None
+        while pending and elected is None and forwarded is None:
             idx, _ = await wait_any([_swallow(f) for f in pending])
             f = pending.pop(idx)
             if f.is_error():
@@ -475,10 +635,17 @@ async def try_become_leader(coordinators: List[CoordinationClientInterface],
             nominee = f.get()
             if nominee is None:
                 continue
+            if nominee.forward:
+                forwarded = nominee.serialized_info
+                continue
             votes[nominee.change_id] = votes.get(nominee.change_id, 0) + 1
             infos[nominee.change_id] = nominee
             if votes[nominee.change_id] >= quorum:
                 elected = nominee
+        if forwarded is not None:
+            _follow_forward(coordinators, forwarded, on_forward)
+            known_change_id = -1
+            continue
         if elected is None:
             await delay(0.5)
             continue
@@ -495,13 +662,15 @@ async def try_become_leader(coordinators: List[CoordinationClientInterface],
 
 
 async def monitor_leader(coordinators: List[CoordinationClientInterface],
-                         out_leader) -> None:
+                         out_leader, on_forward=None) -> None:
     """Track the elected leader without campaigning (reference
-    MonitorLeader): `out_leader` (AsyncVar) follows majority nominations.
-    Runs until cancelled."""
+    MonitorLeader): `out_leader` (AsyncVar) follows majority nominations;
+    forward replies swap the coordinator list in place (reference
+    MonitorLeader's cluster-file rewrite on leader.forward).  Runs until
+    cancelled."""
     known_change_id = -1
-    quorum = len(coordinators) // 2 + 1
     while True:
+        quorum = len(coordinators) // 2 + 1
         futures = [RequestStream.at(c.leader_get).get_reply(
             LeaderGetRequest(key=LEADER_KEY,
                              known_leader_change_id=known_change_id))
@@ -510,8 +679,9 @@ async def monitor_leader(coordinators: List[CoordinationClientInterface],
         infos: Dict[int, LeaderInfo] = {}
         pending = list(futures)
         elected: Optional[LeaderInfo] = None
+        forwarded: Optional[str] = None
         failed = 0
-        while pending and elected is None:
+        while pending and elected is None and forwarded is None:
             if failed > len(coordinators) - quorum:
                 break
             idx, _ = await wait_any([_swallow(f) for f in pending])
@@ -522,10 +692,17 @@ async def monitor_leader(coordinators: List[CoordinationClientInterface],
             nominee = f.get()
             if nominee is None:
                 continue
+            if nominee.forward:
+                forwarded = nominee.serialized_info
+                continue
             votes[nominee.change_id] = votes.get(nominee.change_id, 0) + 1
             infos[nominee.change_id] = nominee
             if votes[nominee.change_id] >= quorum:
                 elected = nominee
+        if forwarded is not None:
+            _follow_forward(coordinators, forwarded, on_forward)
+            known_change_id = -1
+            continue
         if elected is not None and elected.change_id != known_change_id:
             known_change_id = elected.change_id
             out_leader.set(elected)

@@ -592,6 +592,8 @@ class DataDistributor:
                     self._shard_sizes.pop(b2, None)
                     self._shard_sizes[b1] = left + right
                     self.stats["merges"] = self.stats.get("merges", 0) + 1
+                    from ..core.coverage import test_coverage
+                    test_coverage("DDShardMerge")
                     TraceEvent("DDShardMerge").detail("At", b2).detail(
                         "Bytes", left + right).log()
                     merged = True

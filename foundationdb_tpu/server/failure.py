@@ -19,8 +19,21 @@ class WaitFailureRequest:
 
 async def hold_wait_failure(stream) -> None:
     held = []
-    async for req in stream.queue:
-        held.append(req)
+    try:
+        async for req in stream.queue:
+            held.append(req)
+    finally:
+        # Break held promises EXPLICITLY at cancellation (role/epoch end):
+        # the GC-driven ReplyPromise.__del__ fallback is not prompt on an
+        # idle real process (reference cycles park cancelled actor frames
+        # until a gen-2 collection), and a remote watcher parked on this
+        # role's failure signal is exactly what re-recruitment liveness
+        # hangs on — observed: a fenced epoch whose master died was never
+        # replaced because the CC's waitFailure future never broke.
+        from ..core.error import err
+        for req in held:
+            if req.reply is not None and not req.reply.is_set():
+                req.reply.send_error(err("broken_promise"))
 
 
 async def wait_failure_of(interface) -> None:

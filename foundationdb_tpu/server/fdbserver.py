@@ -74,7 +74,8 @@ async def _cc_runner(process, cc, leader_var, my_change_id) -> None:
 
 def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
           process_class: str = "stateless", config=None,
-          ip: str = "127.0.0.1", name: str = "", seed: int = 0) -> None:
+          ip: str = "127.0.0.1", name: str = "", seed: int = 0,
+          force_coordination: bool = False) -> None:
     """Boot this process and serve forever."""
     from .cluster_controller import ClusterController
     from .worker import Worker
@@ -83,6 +84,32 @@ def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
     from ..core.trace import Tracer, set_tracer
     os.makedirs(datadir, exist_ok=True)
     set_tracer(Tracer(path=os.path.join(datadir, "trace.jsonl")))
+
+    # Cluster file (reference fdb.cluster): the durable connection spec.
+    # An existing file WINS over --coordinators (the file tracks quorum
+    # changes; the flag is only the first-boot seed), and coordinator
+    # forwards rewrite it so a restart finds the moved quorum directly.
+    cluster_file = os.path.join(datadir, "fdb.cluster")
+    if os.path.exists(cluster_file):
+        with open(cluster_file) as f:
+            spec = f.read().strip()
+        if spec:
+            coordinators = parse_coordinators(spec)
+    else:
+        spec = ",".join(f"{c.ip}:{c.port}" for c in coordinators)
+        with open(cluster_file, "w") as f:
+            f.write(spec + "\n")
+
+    def _on_forward(new_spec: str) -> None:
+        tmp = cluster_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(new_spec + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, cluster_file)
+
+    from .coordination import set_forward_hook
+    set_forward_hook(_on_forward)
     loop = EventLoop(sim=False)
     set_event_loop(loop)
     # Seed uniquely PER INCARNATION: a rebooted process must not regenerate
@@ -100,8 +127,11 @@ def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
     proc = RealProcess(loop, net, name=name or f"fdbserver:{port}",
                        process_class=process_class, fs=fs)
 
-    is_coordinator = any(c.ip == ip and c.port == port
-                         for c in coordinators)
+    # --coordination forces the role even when this address is not (yet)
+    # in the spec: a changeQuorum target must already serve generation
+    # registers when the management probe arrives.
+    is_coordinator = force_coordination or any(
+        c.ip == ip and c.port == port for c in coordinators)
     if is_coordinator:
         coord = CoordinationServer(f"coord.{port}", fs=fs)
         coord.run(proc)
@@ -118,17 +148,36 @@ def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
         # Random change_id: unique per incarnation (see seed note above).
         change_id = deterministic_random().random_int(0, 1 << 30)
         proc.spawn(try_become_leader(coord_clients, cc.interface,
-                                     leader_var, change_id=change_id),
+                                     leader_var, change_id=change_id,
+                                     on_forward=_on_forward),
                    f"{proc.name}.campaign")
         proc.spawn(_cc_runner(proc, cc, leader_var, change_id),
                    f"{proc.name}.ccRunner")
     else:
-        proc.spawn(monitor_leader(coord_clients, leader_var),
+        proc.spawn(monitor_leader(coord_clients, leader_var,
+                                  on_forward=_on_forward),
                    f"{proc.name}.monitorLeader")
 
     worker = Worker(proc, coord_clients, process_class=process_class,
                     config=config)
     worker.run(leader_var)
+
+    # Production observability (reference Net2 slow-task warnings +
+    # flow/Profiler): every dispatched callback is timed; FDB_PROFILE=1
+    # also samples the reactor thread's stack into periodic trace dumps.
+    from ..core.profiler import SamplingProfiler, install_slow_task_detection
+    install_slow_task_detection(loop)
+    if os.environ.get("FDB_PROFILE") == "1":
+        profiler = SamplingProfiler()
+        profiler.start()
+
+        async def _profile_dump() -> None:
+            from ..core.scheduler import delay
+            while True:
+                await delay(30.0)
+                profiler.log_report()
+
+        proc.spawn(_profile_dump(), f"{proc.name}.profiler")
 
     async def _flush_trace() -> None:
         from ..core.scheduler import delay
@@ -138,6 +187,20 @@ def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
             get_tracer().flush()
 
     proc.spawn(_flush_trace(), f"{proc.name}.traceFlush")
+
+    async def _gc_tick() -> None:
+        """Periodic cycle collection: broken-promise delivery for DROPPED
+        (not explicitly errored) ReplyPromises rides __del__, and a
+        cancelled actor's frame can sit in a reference cycle; an idle
+        process may not allocate enough to trigger gen-2 GC for minutes,
+        stalling remote failure detection that long."""
+        import gc
+        from ..core.scheduler import delay
+        while True:
+            await delay(5.0)
+            gc.collect()
+
+    proc.spawn(_gc_tick(), f"{proc.name}.gcTick")
     TraceEvent("FdbServerStarted").detail("Address", str(proc.address)) \
         .detail("Class", process_class).detail(
         "Coordinator", is_coordinator).log()
@@ -156,6 +219,9 @@ def main(argv=None) -> None:
     ap.add_argument("--config", default=None,
                     help="DatabaseConfiguration overrides as JSON")
     ap.add_argument("--name", default="")
+    ap.add_argument("--coordination", action="store_true",
+                    help="serve generation registers even if this address "
+                         "is not in the spec (changeQuorum target)")
     args = ap.parse_args(argv)
     # "coordinator" class == a stateless worker that also serves
     # coordination if its address is in the coordinator list.
@@ -163,7 +229,8 @@ def main(argv=None) -> None:
               else args.process_class)
     serve(args.port, parse_coordinators(args.coordinators), args.datadir,
           process_class=pclass, config=build_config(args.config),
-          ip=args.ip, name=args.name)
+          ip=args.ip, name=args.name,
+          force_coordination=args.coordination)
 
 
 if __name__ == "__main__":

@@ -27,6 +27,16 @@ Tag = int
 # Metadata/state transactions tag (reference txsTag).  u32-max-adjacent so
 # it packs through the wire format; system_data re-exports this.
 TXS_TAG: Tag = 0xFFFFFFFE
+# Remote twin of the TXS stream (region replication): proxies mirror every
+# TXS_TAG metadata mutation onto this tag so a region failover can replay
+# the epoch's metadata deltas from the REMOTE TLog (master.py failover).
+# Deliberately outside the twin_tag involution range — special tags have
+# explicit twins.
+REMOTE_TXS_TAG: Tag = 0xFFFFFFFC
+# Mutations for cached key ranges additionally ride this tag so the
+# StorageCache role stays fresh (reference cacheTag,
+# CommitProxyServer.actor.cpp:959 + fdbserver/StorageCache.actor.cpp).
+CACHE_TAG: Tag = 0xFFFFFFFB
 
 
 def zone_of(iface) -> str:
@@ -121,11 +131,15 @@ class MasterInterface:
         # master dies when configuration != lastConfiguration).
         self.config_changed = RequestStream(
             "master.configChanged", TaskPriority.DefaultEndpoint)
+        # One-way nudge on a committed backup activation: (active, url) —
+        # the master recruits/halts the backup worker role mid-epoch.
+        self.backup_changed = RequestStream(
+            "master.backupChanged", TaskPriority.DefaultEndpoint)
 
     def streams(self) -> List[RequestStream]:
         return [self.get_commit_version, self.report_live_committed_version,
                 self.get_live_committed_version, self.wait_failure,
-                self.config_changed]
+                self.config_changed, self.backup_changed]
 
 
 @dataclass
@@ -143,11 +157,26 @@ class DatabaseConfiguration:
     conflict_backend: Optional[str] = None
     storage_engine: str = "memory"     # memory | btree (reference ssd-2)
     min_workers: int = 1
+    # Region replication (reference RegionInfo in DatabaseConfiguration.h,
+    # \xff/conf usable_regions): >= 2 recruits the async remote plane —
+    # log routers pulling twin tags from the primary log system, remote
+    # TLogs fed from the routers, and remote storage replicas in
+    # `remote_dc` (server/log_router.py topology).
+    usable_regions: int = 1
+    remote_dc: str = ""
+    n_log_routers: int = 1
+    n_remote_tlogs: int = 1
+    # StorageCache roles (reference StorageCache.actor.cpp): read replicas
+    # for committed \xff/cacheRanges/ hot ranges, kept fresh by CACHE_TAG
+    # commit routing.
+    n_storage_caches: int = 0
 
     _INT_FIELDS = ("n_tlogs", "n_commit_proxies", "n_grv_proxies",
                    "n_resolvers", "n_storage", "log_replication",
-                   "storage_replication", "min_workers")
-    _STR_FIELDS = ("conflict_backend", "storage_engine")
+                   "storage_replication", "min_workers",
+                   "usable_regions", "n_log_routers", "n_remote_tlogs",
+                   "n_storage_caches")
+    _STR_FIELDS = ("conflict_backend", "storage_engine", "remote_dc")
 
     def with_conf(self, conf: Dict[str, Optional[bytes]]
                   ) -> "DatabaseConfiguration":
@@ -486,6 +515,13 @@ class ServerDBInfo:
     # singletons like the DD reach the worker registry for storage
     # recruitment without a private channel.
     cluster_controller: Any = None
+    # Region replication plane (usable_regions >= 2): log routers pulling
+    # twin tags from `tlogs`, the remote TLog set they feed, and the
+    # remote dc's storage replicas keyed by twin tag (reference
+    # ServerDBInfo.logSystemConfig's remote tLog sets).
+    log_routers: List[Any] = field(default_factory=list)
+    remote_tlogs: List[Any] = field(default_factory=list)
+    remote_storage: Dict[Tag, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -495,6 +531,10 @@ class ClientDBInfo:
     epoch: int = 0
     grv_proxies: List[Any] = field(default_factory=list)
     commit_proxies: List[Any] = field(default_factory=list)
+    # Wire protocol generation (reference ProtocolVersion reported
+    # through coordinators): the multi-version client selects the
+    # implementation whose protocol matches (client/multi_version.py).
+    protocol_version: int = 0
 
 
 @dataclass
@@ -513,6 +553,10 @@ class RegisterWorkerRequest:
     # MOST data — an id/tag collision resolved arbitrarily can roll the
     # tag back to empty.
     storage_versions: Dict[int, int] = field(default_factory=dict)
+    # (dcid, zoneid, machineid) of the hosting process — region-aware
+    # recruitment places remote-plane roles by dcid (reference
+    # RegisterWorkerRequest carries LocalityData).
+    locality: tuple = ("", "", "")
     reply: Any = None
 
 
@@ -525,6 +569,7 @@ class WorkerRegistration:
     recovered_logs: Dict[str, Any] = field(default_factory=dict)
     recovered_storage: Dict[int, Any] = field(default_factory=dict)
     storage_versions: Dict[int, int] = field(default_factory=dict)
+    locality: tuple = ("", "", "")
 
 
 # -- placement fitness (reference flow/ProcessClass machineClassFitness +
@@ -593,6 +638,11 @@ class InitializeTLogRequest:
     recover_tags: Dict[Tag, Any] = field(default_factory=dict)
     recover_popped: Dict[Tag, Version] = field(default_factory=dict)
     epoch: int = 0
+    # REMOTE TLog recruitment (region replication): the worker also spawns
+    # remote_tlog_feeder pulling `feeder_tags` (twin tags) from a log
+    # system over `feeder_routers` (server/log_router.py).
+    feeder_routers: Optional[List[Any]] = None
+    feeder_tags: List[Tag] = field(default_factory=list)
     reply: Any = None     # -> TLogInterface
 
 
@@ -608,6 +658,12 @@ class InitializeCommitProxyRequest:
     storage_interfaces: Dict[Tag, Any]
     recovery_version: Version
     backup_active: bool = False
+    # Region replication: mirror every storage-tag mutation onto its twin
+    # tag (and TXS onto REMOTE_TXS) so the log routers can pull them.
+    region_replication: bool = False
+    # StorageCache interfaces: cached-range mutations also ride CACHE_TAG
+    # and location replies append these to the replica set.
+    storage_caches: List[Any] = field(default_factory=list)
     reply: Any = None     # -> CommitProxyInterface
 
 
@@ -721,7 +777,41 @@ class DataDistributorInterface:
 class InitializeStorageRequest:
     ss_id: str
     tag: Tag
+    # Remote replicas pull from their REGION's TLog set instead of the
+    # primary one in db_info (server/log_router.py topology); None keeps
+    # the default.
+    pull_tlogs: Optional[List[Any]] = None
+    # StorageCache recruitment: own NOTHING by default (ranges arrive via
+    # the \xff/cacheRanges watch + fetch), skip the serverTag registry.
+    cache_role: bool = False
     reply: Any = None     # -> StorageServerInterface
+
+
+@dataclass
+class InitializeBackupWorkerRequest:
+    """Recruit a backup worker pulling BACKUP_TAG into the container
+    (reference fdbserver/BackupWorker.actor.cpp recruitment)."""
+
+    bw_id: str
+    epoch: int
+    tlogs: List[Any] = field(default_factory=list)
+    log_replication: int = 1
+    container_url: str = ""
+    reply: Any = None     # -> TLogInterface (failure-watchable handle)
+
+
+@dataclass
+class InitializeLogRouterRequest:
+    """Recruit a LogRouter pulling twin tags from the primary log system
+    (reference fdbserver/WorkerInterface.actor.h InitializeLogRouterRequest,
+    LogRouter.actor.cpp:308)."""
+
+    router_id: str
+    epoch: int
+    tlogs: List[Any] = field(default_factory=list)   # primary log system
+    log_replication: int = 1
+    start_version: Version = 0
+    reply: Any = None     # -> TLogInterface (the router serves peek/pop)
 
 
 class WorkerInterface:
@@ -745,6 +835,10 @@ class WorkerInterface:
                                              TaskPriority.DefaultEndpoint)
         self.init_data_distributor = RequestStream(
             "worker.initDataDistributor", TaskPriority.DefaultEndpoint)
+        self.init_log_router = RequestStream("worker.initLogRouter",
+                                             TaskPriority.DefaultEndpoint)
+        self.init_backup_worker = RequestStream("worker.initBackupWorker",
+                                                TaskPriority.DefaultEndpoint)
         self.wait_failure = RequestStream("worker.waitFailure",
                                           TaskPriority.FailureMonitor)
 
@@ -752,6 +846,7 @@ class WorkerInterface:
         return [self.init_master, self.init_tlog, self.init_commit_proxy,
                 self.init_grv_proxy, self.init_resolver, self.init_storage,
                 self.init_ratekeeper, self.init_data_distributor,
+                self.init_log_router, self.init_backup_worker,
                 self.wait_failure]
 
 

@@ -238,17 +238,41 @@ async def remote_tlog_feeder(tlog, router_log_system: Any,
             await delay(0.5)           # router epoch mid-recovery
             return None
 
+    def _forward_pops(sent: Dict[Tag, Version]) -> None:
+        """Propagate pops router-ward.  Pops track the REMOTE REPLICAS'
+        applied points (this TLog's per-tag pops), not our durable
+        frontier: router->primary pops are what retire the primary's
+        twin-tag retention, and that retention is what lets the next
+        epoch's remote TLogs recover a lagging replica's un-applied
+        backlog (master.py remote_recover_tags).  The REMOTE_TXS stream
+        has no replica; its consumer is this TLog itself (failover
+        metadata replay), so it pops at our durable frontier."""
+        from .interfaces import REMOTE_TXS_TAG
+        durable = tlog.durable_version.get()
+        for t in tags:
+            applied = (durable if t == REMOTE_TXS_TAG
+                       else min(tlog.poppedtags.get(t, 0), durable))
+            to = min(applied, cursors[t] - 1)
+            if to > sent.get(t, 0):
+                sent[t] = to
+                router_log_system.pop(t, to)
+
     pending: Dict[Tag, Any] = {}
+    sent_pops: Dict[Tag, Version] = {}
     try:
         while not tlog.stopped:
             for t in tags:
                 if t not in pending:
                     pending[t] = _spawn(_peek_wrapped(t, cursors[t]),
                                         f"{tlog.id}.feedPeek{t}")
+            # The delay tick keeps pop forwarding flowing while the
+            # stream is quiesced (every peek parked on its frontier) —
+            # replica pops arrive without any new commits.
             await wait_any(list(pending.values()) +
-                           [tlog._stop_promise.get_future()])
+                           [tlog._stop_promise.get_future(), delay(0.5)])
             if tlog.stopped:
                 return
+            _forward_pops(sent_pops)
             for t in list(pending):
                 f = pending[t]
                 if not f.is_ready():
@@ -277,15 +301,12 @@ async def remote_tlog_feeder(tlog, router_log_system: Any,
                 await _commit(lim, {})
                 committed_any = True
             if committed_any:
-                # Only durable data may be popped off the routers (and
-                # transitively off the primary): wait for the fsync
-                # frontier.
-                durable = tlog.durable_version.get()
+                # Let the fsync frontier catch up before the next pop
+                # forwarding round (REMOTE_TXS pops are durability-bound).
                 target = min(tlog.version.get(), lim)
-                if durable < target:
+                if tlog.durable_version.get() < target:
                     await tlog.durable_version.when_at_least(target)
-                for t in tags:
-                    router_log_system.pop(t, min(cursors[t] - 1, target))
+                _forward_pops(sent_pops)
     finally:
         for f in pending.values():
             if not f.is_ready():

@@ -217,6 +217,17 @@ class DBCoreState:
     # baseline snapshot rides the cstate like key_servers_ranges, with
     # TXS replay applying later changes on top).
     conf: Dict[str, bytes] = field(default_factory=dict)
+    # Region replication plane (usable_regions >= 2): the remote TLog set
+    # and the remote storage replicas keyed by TWIN tag — what a region
+    # failover locks and recovers from (reference DBCoreState's remote
+    # tLog sets in oldTLogData).
+    remote_tlogs: List[Any] = field(default_factory=list)
+    remote_storage: Dict[Tag, Any] = field(default_factory=dict)
+    remote_tlog_ids: List[str] = field(default_factory=list)
+    remote_storage_ids: Dict[Tag, str] = field(default_factory=dict)
+    # Active backup's container URL (committed alongside the flag): the
+    # recruited backup worker role resumes appending here.
+    backup_container: str = ""
 
     def pack(self) -> bytes:
         from ..core.wire import Writer
@@ -241,6 +252,16 @@ class DBCoreState:
         w.u16(len(self.conf))
         for name, raw in self.conf.items():
             w.str_(name).bytes_(raw)
+        rt_ids = self.remote_tlog_ids or [t.id for t in self.remote_tlogs]
+        w.u16(len(rt_ids))
+        for tid in rt_ids:
+            w.str_(tid)
+        rs_ids = self.remote_storage_ids or {
+            tag: s.id for tag, s in self.remote_storage.items()}
+        w.u16(len(rs_ids))
+        for tag, sid in rs_ids.items():
+            w.u32(tag).str_(sid)
+        w.str_(self.backup_container)
         return w.done()
 
     @staticmethod
@@ -272,13 +293,25 @@ class DBCoreState:
             for _ in range(r.u16()):
                 name = r.str_()
                 conf[name] = r.bytes_()
+        remote_tlog_ids: List[str] = []
+        remote_storage_ids: Dict[Tag, str] = {}
+        backup_container = ""
+        if not r.at_end():
+            remote_tlog_ids = [r.str_() for _ in range(r.u16())]
+            remote_storage_ids = {r.u32(): r.str_()
+                                  for _ in range(r.u16())}
+        if not r.at_end():
+            backup_container = r.str_()
         return cls(epoch=epoch, recovery_version=rv,
                    tlogs=[None] * len(tlog_ids), log_replication=log_rep,
                    storage_servers={t: None for t in storage_ids},
                    key_servers_ranges=ranges, n_resolvers=n_res,
                    tlog_ids=tlog_ids, storage_ids=storage_ids,
                    map_version=map_version, backup_active=backup_active,
-                   conf=conf)
+                   conf=conf, remote_tlog_ids=remote_tlog_ids,
+                   remote_storage={t: None for t in remote_storage_ids},
+                   remote_storage_ids=remote_storage_ids,
+                   backup_container=backup_container)
 
 
 def _split_points(n: int) -> List[bytes]:
@@ -351,6 +384,199 @@ async def resolution_balancing(master: Master, resolvers: List[Any],
             "Loads", loads).log()
 
 
+async def _recruit_region(master, process, workers, config, tlogs,
+                          storage_servers, key_servers_ranges,
+                          recovery_version, prev, recovered_storage,
+                          remote_recover_tags, remote_recover_popped):
+    """Recruit the async remote plane (reference remote recruitment in
+    TagPartitionedLogSystem newEpoch + RemoteLogsTaken): log routers over
+    the primary log system, remote TLogs fed from them, remote storage
+    replicas (twin tags) in config.remote_dc.  Returns (log_routers,
+    remote_tlogs, remote_storage); raises FdbError when the remote dc has
+    no workers (caller degrades to primary-only)."""
+    from ..core.futures import wait_all as _wait_all
+    from .commit_proxy import LogSystemClient
+    from .interfaces import (InitializeLogRouterRequest,
+                             InitializeStorageRequest, InitializeTLogRequest,
+                             REMOTE_TXS_TAG)
+    from .log_router import twin_tag
+    # The PRIMARY region is where the serving storage set lives (the
+    # master process itself may have been placed anywhere); a remote_dc
+    # that overlaps it cannot host an async replica plane.
+    primary_dcs = {getattr(i, "locality", ("", "", ""))[0]
+                   for i in storage_servers.values()}
+    primary_dcs.discard("")
+    if not config.remote_dc or config.remote_dc in primary_dcs:
+        raise err("operation_failed",
+                  f"remote_dc {config.remote_dc!r} unusable "
+                  f"(primary dcs: {sorted(primary_dcs)})")
+    remote_regs = [reg for reg in workers
+                   if reg.locality[0] == config.remote_dc]
+    if not remote_regs:
+        raise err("operation_failed",
+                  f"no workers registered in remote dc "
+                  f"{config.remote_dc!r}")
+    r_state = [reg.worker for reg in remote_regs
+               if reg.process_class != "storage"] or \
+        [reg.worker for reg in remote_regs]
+    r_store = [reg.worker for reg in remote_regs
+               if reg.process_class == "storage"] or \
+        [reg.worker for reg in remote_regs]
+
+    twin_tags = sorted(twin_tag(t) for t in storage_servers)
+    all_remote_tags = twin_tags + [REMOTE_TXS_TAG]
+
+    # Log routers first (the remote TLog feeders pull through them).
+    router_futures = [RequestStream.at(
+        r_state[i % len(r_state)].init_log_router.endpoint).get_reply(
+        InitializeLogRouterRequest(
+            router_id=f"router{i}.e{master.epoch}", epoch=master.epoch,
+            tlogs=tlogs, log_replication=config.log_replication,
+            start_version=recovery_version))
+        for i in range(config.n_log_routers)]
+    log_routers = await _wait_all(router_futures)
+
+    # Remote TLogs: tag t lives on remote tlog (t % n) — mirrored by the
+    # replication=1 team selection replicas and feeders use.
+    n_rt = max(1, config.n_remote_tlogs)
+    rt_futures = []
+    tuid = deterministic_random().random_unique_id()[:8]
+    for i in range(n_rt):
+        my_tags = [t for t in all_remote_tags if t % n_rt == i]
+        rt_futures.append(RequestStream.at(
+            r_state[(i + 1) % len(r_state)].init_tlog.endpoint).get_reply(
+            InitializeTLogRequest(
+                tlog_id=f"rlog{i}.{tuid}.e{master.epoch}",
+                recovery_version=recovery_version,
+                recover_tags={t: h for t, h in remote_recover_tags.items()
+                              if t % n_rt == i},
+                recover_popped={t: p
+                                for t, p in remote_recover_popped.items()
+                                if t % n_rt == i},
+                epoch=master.epoch,
+                feeder_routers=log_routers,
+                feeder_tags=my_tags)))
+    remote_tlogs = await _wait_all(rt_futures)
+
+    # Remote storage replicas: surviving ones are adopted (they keep
+    # their engines and cursors; the db_info watch re-targets them to the
+    # new remote TLog set), missing/fresh ones are recruited and — unless
+    # this is a cold boot — seeded via fetch_keys from the primary
+    # replica of their twin so the stream has a base to apply onto.
+    remote_storage: Dict[Tag, Any] = {}
+    fresh: List[Tag] = []
+    prev_ids = (prev.remote_storage_ids if prev is not None else {}) or {}
+    for t in storage_servers:
+        tt = twin_tag(t)
+        iface = recovered_storage.get(tt)
+        if iface is None and prev is not None:
+            iface = prev.remote_storage.get(tt)
+        if iface is not None and tt in prev_ids:
+            remote_storage[tt] = iface
+        else:
+            fresh.append(t)
+    if fresh:
+        init_futures = {
+            t: RequestStream.at(
+                r_store[i % len(r_store)].init_storage.endpoint).get_reply(
+                InitializeStorageRequest(
+                    ss_id=f"rss{twin_tag(t)}", tag=twin_tag(t),
+                    pull_tlogs=remote_tlogs))
+            for i, t in enumerate(fresh)}
+        for t, f in init_futures.items():
+            remote_storage[twin_tag(t)] = await f
+    seed_fetches = []
+    if fresh and prev is not None:
+        # Mid-life recruitment: each fresh replica must be seeded with
+        # its twin's current data (live twin-tag mutations buffer during
+        # the fetch and apply on top — storage _fetch_keys).  Deferred to
+        # a post-recovery actor: the snapshot is served at
+        # min_version >= recovery_version, which the source only reaches
+        # once the new epoch's TLogs feed it.
+        for t in fresh:
+            for b, e, team in key_servers_ranges:
+                if t in team:
+                    seed_fetches.append(
+                        (remote_storage[twin_tag(t)], b, e,
+                         storage_servers[t], recovery_version))
+    TraceEvent("RegionRecruited").detail(
+        "Routers", len(log_routers)).detail(
+        "RemoteTLogs", len(remote_tlogs)).detail(
+        "Replicas", len(remote_storage)).detail(
+        "Fresh", len(fresh)).log()
+    return log_routers, remote_tlogs, remote_storage, seed_fetches
+
+
+async def _failover_to_remote_prep(prev: "DBCoreState", recovered_logs,
+                                   recovered_storage):
+    """Rewrite the previous core state for a REGION FAILOVER: lock the
+    remote TLogs and return (prev', locked) where prev' presents the
+    remote plane as the old generation — remote TLogs as prev.tlogs,
+    remote replicas (twin tags) as prev.storage_servers, and keyServers
+    teams mapped through the twin involution.  Returns (prev, {}) when
+    the remote plane is unreachable (caller then fails recovery).
+
+    Reference: TagPartitionedLogSystem.actor.cpp epochEnd choosing a
+    remote log set when the primary's is gone."""
+    import dataclasses as _dc
+    from .log_router import twin_tag
+    from ..core.futures import swallow, wait_all
+    rt_ids = prev.remote_tlog_ids or [t.id for t in prev.remote_tlogs]
+    remote_ifaces = []
+    for i, tid in enumerate(rt_ids):
+        iface = recovered_logs.get(tid) or (
+            prev.remote_tlogs[i] if i < len(prev.remote_tlogs) else None)
+        remote_ifaces.append(iface)
+    lock_futures = {
+        i: RequestStream.at(t.lock.endpoint).get_reply(
+            TLogLockRequest(epoch=prev.epoch + 1))
+        for i, t in enumerate(remote_ifaces) if t is not None}
+    if not lock_futures:
+        return prev, {}
+    await wait_all([swallow(f) for f in lock_futures.values()])
+    locked = {i: f.get() for i, f in lock_futures.items()
+              if not f.is_error()}
+    if not locked:
+        return prev, {}
+    new_storage = {}
+    for twin_t, sid in (prev.remote_storage_ids or
+                        {t: getattr(s, "id", "") for t, s in
+                         prev.remote_storage.items()}).items():
+        iface = recovered_storage.get(twin_t) or \
+            prev.remote_storage.get(twin_t)
+        if iface is None:
+            TraceEvent("RegionFailoverMissingReplica",
+                       Severity.Error).detail("Tag", twin_t).log()
+            return prev, {}
+        new_storage[twin_t] = iface
+    ranges = []
+    for b, e, team in prev.key_servers_ranges:
+        new_team = [twin_tag(t) for t in team if twin_tag(t) in new_storage]
+        if not new_team:
+            TraceEvent("RegionFailoverShardUncovered",
+                       Severity.Error).detail("Begin", b).log()
+            return prev, {}
+        ranges.append((b, e, new_team))
+    prev2 = _dc.replace(
+        prev,
+        # FULL-length list (unresolvable entries stay None): `locked` is
+        # keyed by original indices and team_for_tag runs mod the set
+        # size — compacting would shift both.
+        tlogs=list(remote_ifaces),
+        tlog_ids=list(rt_ids),
+        log_replication=1,
+        storage_servers=new_storage,
+        storage_ids={t: "" for t in new_storage},
+        key_servers_ranges=ranges,
+        # The backup stream's un-pulled tail died with the primary: force
+        # the operator to take a fresh snapshot rather than silently
+        # restoring across a hole.
+        backup_active=False,
+        remote_tlogs=[], remote_tlog_ids=[],
+        remote_storage={}, remote_storage_ids={})
+    return prev2, locked
+
+
 # ---------------------------------------------------------------------------
 # The recovery state machine (reference masterCore :1670)
 # ---------------------------------------------------------------------------
@@ -410,6 +636,12 @@ async def master_server(master: Master, process, coordinators,
         # LOCKING_CSTATE: lock the previous TLog generation (epoch end).
         old_tag_holders: Dict[Tag, Any] = {}
         old_popped: Dict[Tag, Version] = {}
+        # Twin-tag backlog holders for the NEW remote TLogs (region
+        # replication): kept separate from old_tag_holders so the new
+        # PRIMARY generation does not also carry them.
+        remote_recover_tags: Dict[Tag, Any] = {}
+        remote_recover_popped: Dict[Tag, Version] = {}
+        failed_over = False
         recovery_version: Version = 0
         if prev is not None:
             TraceEvent("MasterRecoveryState").detail(
@@ -430,6 +662,28 @@ async def master_server(master: Master, process, coordinators,
             locked: Dict[int, Any] = {
                 i: f.get() for i, f in lock_futures.items()
                 if not f.is_error()}
+            if not locked and (prev.remote_tlog_ids or prev.remote_tlogs):
+                # REGION FAILOVER (reference TagPartitionedLogSystem
+                # epochEnd on the remote log set + workloads/KillRegion):
+                # the whole primary log generation is gone; recover from
+                # the REMOTE plane — lock the remote TLogs (contiguous
+                # chains by construction, log_router.remote_tlog_feeder),
+                # adopt the remote replicas as the storage set (their twin
+                # tags become the serving tags), and replay metadata from
+                # the REMOTE_TXS stream.  Safe (no acked-commit loss) when
+                # the remote had drained to the last commit — the
+                # fdbcli-style drained switchover; an undrained hard kill
+                # loses the un-replicated tail, which min(end_version)
+                # makes explicit below.
+                prev, locked = await _failover_to_remote_prep(
+                    prev, recovered_logs, recovered_storage)
+                failed_over = bool(locked)
+                if failed_over:
+                    old_tlogs = prev.tlogs
+                    old_ls = LogSystemClient(old_tlogs, 1)
+                    TraceEvent("MasterRegionFailover", Severity.Warn).detail(
+                        "Epoch", master.epoch).detail(
+                        "RemoteTLogs", len(old_tlogs)).log()
             if not locked:
                 raise err("master_recovery_failed", "no old TLogs reachable")
             # Every tag needs a live holder; any team member suffices.
@@ -447,9 +701,29 @@ async def master_server(master: Master, process, coordinators,
                               f"tag {tag} has no surviving TLog holder")
                 old_tag_holders[tag] = old_tlogs[holder]
                 old_popped[tag] = locked[holder].tags.get(tag, 0)
+            # Twin-tag backlog (region replication, normal recovery): the
+            # old PRIMARY TLogs hold every twin-tagged mutation the remote
+            # replicas have not yet applied (the feeder pops routers — and
+            # transitively the primary — only at replica-applied points);
+            # the NEW remote TLogs recover it so replica streams have no
+            # hole across the generation change.
+            if not failed_over:
+                from .log_router import twin_tag as _twin
+                for t in prev.remote_storage_ids:
+                    holder = next((i for i in old_ls.team_for_tag(t)
+                                   if i in locked), None)
+                    if holder is None:
+                        TraceEvent("RegionTwinTagUnrecoverable",
+                                   Severity.Warn).detail("Tag", t).log()
+                        continue
+                    remote_recover_tags[t] = old_tlogs[holder]
+                    remote_recover_popped[t] = locked[holder].tags.get(t, 0)
             # Every client-visible commit was acked by ALL old TLogs, so
             # the min over locked end-versions is >= every visible commit.
             recovery_version = min(r.end_version for r in locked.values())
+            from ..core.coverage import test_coverage
+            test_coverage("RecoveryRegionFailover" if failed_over
+                          else "RecoveryMasterLockedOldGeneration")
 
             # Replay metadata deltas committed since the baseline snapshot
             # (TXS_TAG stream; reference txnStateStore seeding,
@@ -462,7 +736,11 @@ async def master_server(master: Master, process, coordinators,
             map_rm: RangeMap = RangeMap(default=None)
             for b, e, team in prev.key_servers_ranges:
                 map_rm.set_range(b, e, team)
-            txs_holder = next((i for i in old_ls.team_for_tag(TXS_TAG)
+            # After a region failover the metadata deltas live on the
+            # REMOTE_TXS twin stream of the locked remote TLogs.
+            from .interfaces import REMOTE_TXS_TAG
+            replay_tag = REMOTE_TXS_TAG if failed_over else TXS_TAG
+            txs_holder = next((i for i in old_ls.team_for_tag(replay_tag)
                                if i in locked), None)
             if txs_holder is None:
                 # Without the txs stream we cannot know whether boundary
@@ -472,18 +750,23 @@ async def master_server(master: Master, process, coordinators,
                           "txs tag has no surviving TLog holder")
             txs = await RequestStream.at(
                 old_tlogs[txs_holder].peek.endpoint).get_reply(
-                TLogPeekRequest(tag=TXS_TAG, begin=prev.map_version + 1))
+                TLogPeekRequest(tag=replay_tag, begin=prev.map_version + 1))
             from .system_data import (apply_metadata_mutation,
                                       parse_conf_mutation,
                                       parse_server_tag_mutation)
             n_deltas = 0
             replayed_rejoins = {}
+            from .system_data import BACKUP_CONTAINER_KEY
+            from ..txn.types import MutationType as _MT
             for v, msgs in txs.messages:
                 if prev.map_version < v <= recovery_version:
                     for m in msgs:
                         _h, backup_flag = apply_metadata_mutation(map_rm, m)
                         if backup_flag is not None:
                             prev.backup_active = backup_flag
+                        if m.type == _MT.SetValue and \
+                                m.param1 == BACKUP_CONTAINER_KEY:
+                            prev.backup_container = m.param2.decode()
                         cf = parse_conf_mutation(m)
                         if cf is not None:
                             # Configuration changes committed since the
@@ -498,6 +781,12 @@ async def master_server(master: Master, process, coordinators,
                                 else:
                                     prev.conf[fname] = raw
                         st = parse_server_tag_mutation(m)
+                        if st is not None and failed_over:
+                            # Registry entries on the replayed stream
+                            # reference the dead primary's tags and
+                            # interfaces; the failover storage set is the
+                            # remote replicas resolved in the prep step.
+                            st = None
                         if st is not None:
                             # Registry changes committed since the cstate
                             # snapshot: rejoins/recruits supersede the
@@ -545,6 +834,22 @@ async def master_server(master: Master, process, coordinators,
             prev.key_servers_ranges = [
                 (b, e, team) for b, e, team in map_rm.ranges()
                 if team is not None]
+            if failed_over:
+                # Replayed boundary changes carry PRIMARY team tags; map
+                # them through the twin involution onto the adopted
+                # replica set (prep already mapped the snapshot ranges).
+                from .log_router import twin_tag as _twin
+                mapped = []
+                for b, e, team in prev.key_servers_ranges:
+                    mt = [t for t in team if t in prev.storage_servers] + \
+                        [_twin(t) for t in team
+                         if t not in prev.storage_servers
+                         and _twin(t) in prev.storage_servers]
+                    if not mt:
+                        raise err("master_recovery_failed",
+                                  f"shard {b!r} uncovered after failover")
+                    mapped.append((b, e, mt))
+                prev.key_servers_ranges = mapped
 
         master.version = recovery_version
         master.last_epoch_end = recovery_version
@@ -584,9 +889,20 @@ async def master_server(master: Master, process, coordinators,
             dedicated/good/unset band when anyone is in it, spilling to
             OKAY then WORST classes only when the better bands are empty
             — round-robining across mixed tiers would place roles on
-            worse-class workers while better ones still had capacity."""
+            worse-class workers while better ones still had capacity.
+            With regions configured, PRIMARY roles stay out of the remote
+            dc (it hosts only the async plane) unless nothing else is
+            registered."""
+            cands = list(workers)
+            if config.usable_regions >= 2 and config.remote_dc:
+                non_remote = [reg for reg in cands
+                              if getattr(reg, "locality",
+                                         ("", "", ""))[0]
+                              != config.remote_dc]
+                if non_remote:
+                    cands = non_remote
             ranked = sorted(
-                (reg for reg in workers
+                (reg for reg in cands
                  if role_fitness(reg.process_class, role) < FITNESS_NEVER),
                 key=lambda reg: (role_fitness(reg.process_class, role),
                                  reg.worker.id))
@@ -605,6 +921,32 @@ async def master_server(master: Master, process, coordinators,
         # the master must never also take out the only TLog copy.
         others = [x for x in stateless if x.id != process.name] or stateless
         log_others = [x for x in log_pool if x.id != process.name] or log_pool
+
+        zone_by_worker_id = {
+            reg.worker.id: (reg.locality[1] or reg.locality[2]
+                            or reg.worker.id)
+            for reg in workers}
+
+        def _zone_interleave(ws):
+            """Order workers round-robin across failure zones so the
+            modular team mapping (LogSystemClient.team_for_tag:
+            (tag + j) % n) lands a tag's replicas in DISTINCT zones
+            whenever enough zones registered — the policy-driven analog
+            of the reference's PolicyAcross(zoneid) for tlog teams,
+            folded into recruitment order instead of a placement DSL."""
+            from collections import defaultdict, deque
+            by_zone = defaultdict(deque)
+            for w in ws:
+                by_zone[zone_by_worker_id.get(w.id, w.id)].append(w)
+            out, qs = [], deque(by_zone.values())
+            while qs:
+                q = qs.popleft()
+                out.append(q.popleft())
+                if q:
+                    qs.append(q)
+            return out
+
+        log_others = _zone_interleave(log_others)
 
         def pick(i: int):
             return others[i % len(others)]
@@ -700,6 +1042,71 @@ async def master_server(master: Master, process, coordinators,
                     j += 1
                 key_servers_ranges.append((bounds[i], bounds[i + 1], team))
 
+        # REGION RECRUITING (usable_regions >= 2), before the proxies so
+        # they know whether to mirror twin tags: log routers pulling twin
+        # tags from the new primary log system, remote TLogs fed from
+        # them (recovering the old twin backlog), and remote storage
+        # replicas in config.remote_dc.  Failure is non-fatal: a cluster
+        # whose remote dc is down keeps serving with replication degraded
+        # to primary-only (reference: remote recruitment retries while
+        # the primary accepts commits).
+        log_routers: List[Any] = []
+        remote_tlogs: List[Any] = []
+        remote_storage: Dict[Tag, Any] = {}
+        region_seed_fetches: List[Any] = []
+        if config.usable_regions >= 2 and not failed_over:
+            try:
+                (log_routers, remote_tlogs, remote_storage,
+                 region_seed_fetches) = await _recruit_region(
+                    master, process, workers, config, tlogs,
+                    storage_servers, key_servers_ranges,
+                    recovery_version, prev, recovered_storage,
+                    remote_recover_tags, remote_recover_popped)
+            except FdbError as e:
+                TraceEvent("RegionRecruitFailed", Severity.Warn).detail(
+                    "Error", e.name).detail("Message", str(e)).log()
+                log_routers, remote_tlogs, remote_storage = [], [], {}
+
+        # StorageCache roles (reference StorageCache.actor.cpp): stateless
+        # read replicas for committed hot ranges; non-fatal like regions.
+        storage_caches: List[Any] = []
+        if config.n_storage_caches >= 1:
+            from .interfaces import CACHE_TAG
+            try:
+                cache_futures = [RequestStream.at(
+                    pick(i + 3).init_storage.endpoint).get_reply(
+                    InitializeStorageRequest(
+                        ss_id=f"cache{i}.e{master.epoch}", tag=CACHE_TAG,
+                        cache_role=True))
+                    for i in range(config.n_storage_caches)]
+                storage_caches = await _wait_all(cache_futures)
+            except FdbError as e:
+                TraceEvent("StorageCacheRecruitFailed",
+                           Severity.Warn).detail("Error", e.name).log()
+                storage_caches = []
+
+        # Backup worker role (reference BackupWorker recruitment): resumes
+        # the container's log capture across the generation change.
+        from .interfaces import InitializeBackupWorkerRequest
+
+        async def _recruit_backup_worker(url: str):
+            return await RequestStream.at(
+                pick(2).init_backup_worker.endpoint).get_reply(
+                InitializeBackupWorkerRequest(
+                    bw_id=f"bw.e{master.epoch}", epoch=master.epoch,
+                    tlogs=tlogs, log_replication=config.log_replication,
+                    container_url=url))
+
+        backup_worker_iface = None
+        if prev is not None and prev.backup_active and \
+                prev.backup_container:
+            try:
+                backup_worker_iface = await _recruit_backup_worker(
+                    prev.backup_container)
+            except FdbError as e:
+                TraceEvent("BackupWorkerRecruitFailed",
+                           Severity.Warn).detail("Error", e.name).log()
+
         # Second wave: ratekeeper + data distributor + proxies.
         from .interfaces import (InitializeDataDistributorRequest,
                                  InitializeRatekeeperRequest)
@@ -727,7 +1134,9 @@ async def master_server(master: Master, process, coordinators,
                 key_servers_ranges=key_servers_ranges,
                 storage_interfaces=storage_servers,
                 recovery_version=recovery_version,
-                backup_active=prev.backup_active if prev else False))
+                backup_active=prev.backup_active if prev else False,
+                region_replication=bool(remote_tlogs),
+                storage_caches=storage_caches))
             for i in range(config.n_commit_proxies)]
         grv_proxy_futures = [RequestStream.at(
             pick(i + 1).init_grv_proxy.endpoint).get_reply(
@@ -751,7 +1160,10 @@ async def master_server(master: Master, process, coordinators,
             n_resolvers=config.n_resolvers,
             map_version=recovery_version,
             backup_active=prev.backup_active if prev else False,
-            conf=dict(prev.conf) if prev else {}))
+            conf=dict(prev.conf) if prev else {},
+            remote_tlogs=remote_tlogs,
+            remote_storage=remote_storage,
+            backup_container=prev.backup_container if prev else ""))
 
         # ACCEPTING_COMMITS (:1943): start the allocator + announce.
         adopt(master._serve_commit_versions(), "master.serveVersions")
@@ -766,13 +1178,109 @@ async def master_server(master: Master, process, coordinators,
             resolvers=resolvers, tlogs=tlogs,
             storage_servers=storage_servers, ratekeeper=ratekeeper,
             data_distributor=data_distributor,
-            cluster_controller=cc_interface)
+            cluster_controller=cc_interface,
+            log_routers=log_routers, remote_tlogs=remote_tlogs,
+            remote_storage=remote_storage)
         await RequestStream.at(
             cc_interface.master_registration.endpoint).get_reply(
             MasterRegistrationRequest(epoch=master.epoch, db_info=db_info))
         TraceEvent("MasterRecoveryState").detail(
             "State", "accepting_commits").detail(
             "Epoch", master.epoch).log()
+
+        async def _backup_watch() -> None:
+            """Mid-epoch backup activation (the proxies' one-way nudge):
+            recruit the worker role NOW so capture starts in this epoch
+            rather than at the next recovery.  A NEW container URL
+            recruits a replacement; the superseded worker self-retires
+            via its committed-URL watch (backup_worker.py _url_watch)."""
+            nonlocal backup_worker_iface
+            current_url = (prev.backup_container
+                           if prev is not None else "") or ""
+            async for flag, url in master.interface.backup_changed.queue:
+                # Recruit whenever a container URL is known and no worker
+                # serves it — even with the flag OFF: the worker's job
+                # includes draining already-tagged data to the container
+                # (stop() self-heals a lost recruitment this way); the
+                # capture gate itself is the proxies' backup_active flag.
+                if not url:
+                    continue
+                if backup_worker_iface is not None and url == current_url:
+                    continue        # idempotent re-nudge
+                try:
+                    backup_worker_iface = \
+                        await _recruit_backup_worker(url)
+                    current_url = url
+                except FdbError as e:
+                    TraceEvent("BackupWorkerRecruitFailed",
+                               Severity.Warn).detail(
+                        "Error", e.name).log()
+        adopt(_backup_watch(), "master.backupWatch")
+
+        if failed_over:
+            async def _failover_registry_migration() -> None:
+                """Commit the failover's identity changes: serverTag
+                entries move from the dead primary tags to the adopted
+                twin replicas, and keyServers values adopt the twin
+                teams — so the DD's registry scan and every other reader
+                of committed metadata sees the post-failover world
+                instead of resurrecting dead interfaces."""
+                from ..client.database import ClusterConnection, Database
+                from ..core.error import FdbError as _FErr
+                from .log_router import twin_tag as _twin
+                from .system_data import (key_servers_key,
+                                          key_servers_value,
+                                          server_tag_key, server_tag_value)
+                db = Database(ClusterConnection(coordinators))
+                try:
+                    t = db.create_transaction()
+                    t.access_system_keys = True
+                    while True:
+                        try:
+                            for tt, iface in storage_servers.items():
+                                t.set(server_tag_key(tt),
+                                      server_tag_value(iface))
+                                t.clear(server_tag_key(_twin(tt)))
+                            for b, e, team in key_servers_ranges:
+                                t.set(key_servers_key(b),
+                                      key_servers_value(team))
+                            await t.commit()
+                            TraceEvent("RegionFailoverRegistryMigrated"
+                                       ).detail(
+                                "Tags", sorted(storage_servers)).log()
+                            return
+                        except _FErr as e:
+                            await t.on_error(e)
+                finally:
+                    close = getattr(db.cluster, "close", None)
+                    if close is not None:
+                        close()
+            adopt(_failover_registry_migration(), "master.failoverRegistry")
+
+        if region_seed_fetches:
+            async def _seed_remote_replicas() -> None:
+                """Seed freshly recruited remote replicas from their twins
+                (deferred from _recruit_region: the snapshot needs the
+                source caught up to the new epoch).  Retries per range —
+                replication converges behind the serving cluster."""
+                from ..core.futures import swallow as _sw
+                from ..core.scheduler import delay as _d
+                from .interfaces import FetchKeysRequest
+                done = 0
+                for iface, b, e, src, min_v in region_seed_fetches:
+                    while True:
+                        f = RequestStream.at(iface.fetch_keys.endpoint
+                                             ).get_reply(FetchKeysRequest(
+                            begin=b, end=e, sources=[src],
+                            min_version=min_v))
+                        await _sw(f)
+                        if not f.is_error():
+                            done += 1
+                            break
+                        await _d(1.0)
+                TraceEvent("RegionReplicasSeeded").detail(
+                    "Ranges", done).log()
+            adopt(_seed_remote_replicas(), "master.regionSeed")
 
         # Steady state: serve until killed, or until any recruited
         # transaction-system role fails — either way the epoch ends and the
@@ -847,6 +1355,61 @@ async def master_server(master: Master, process, coordinators,
                 if close is not None:
                     close()
 
+        async def _coordinators_watch() -> None:
+            """Movable coordinated state (reference ManagementAPI
+            changeQuorum): when the committed \\xff/coordinators spec
+            diverges from the quorum this epoch recovered on, seed the new
+            quorum with the current DBCoreState, forward the old one, and
+            end the epoch — every campaigning/monitoring process is then
+            redirected by the coordinators' forward replies."""
+            from ..client.database import ClusterConnection, Database
+            from ..client.management import _retrying
+            from .coordination import move_coordinated_state
+            from .system_data import COORDINATORS_KEY
+            parts = []
+            for c in coordinators:
+                addr = getattr(getattr(c, "reg_read", None), "address", None)
+                if addr is None:
+                    return      # address-less (test-local) coordinators
+                parts.append(f"{addr.ip}:{addr.port}")
+            cur_spec = ",".join(parts)
+            db = Database(ClusterConnection(coordinators))
+            try:
+                while True:
+                    await _delay(2.0)
+                    try:
+                        async def go(t):
+                            return await t.get(COORDINATORS_KEY)
+                        raw = await _retrying(db, go)
+                    except Exception:  # noqa: BLE001 — mid-recovery blips
+                        continue
+                    from .coordination import normalize_spec
+                    try:
+                        want = normalize_spec(raw.decode()) if raw else ""
+                    except (ValueError, UnicodeDecodeError):
+                        continue        # unparseable committed spec
+                    if want and want != cur_spec:
+                        TraceEvent("CoordinatorsChangeDetected").detail(
+                            "From", cur_spec).detail("To", want).log()
+                        try:
+                            await move_coordinated_state(cstate, want)
+                        except FdbError as e:
+                            if e.name == "client_invalid_operation":
+                                # Unmovable target (e.g. overlapping
+                                # quorum slipped in): ending the epoch
+                                # would just retry forever — stay put.
+                                TraceEvent("CoordinatorsMoveRejected",
+                                           Severity.Warn).detail(
+                                    "To", want).detail(
+                                    "Error", str(e)).log()
+                                continue
+                            raise
+                        return          # end the epoch; recover on the move
+            finally:
+                close = getattr(db.cluster, "close", None)
+                if close is not None:
+                    close()
+
         from ..core.scheduler import delay as _delay
         role_failures = [
             spawn(wait_failure_of(x), "master.roleWatch")
@@ -854,11 +1417,17 @@ async def master_server(master: Master, process, coordinators,
                       [ratekeeper])]
         config_watch = spawn(_config_change_watch(), "master.confWatch")
         config_poll = spawn(_config_poll(), "master.confPoll")
+        coord_watch = spawn(_coordinators_watch(), "master.coordWatch")
         children.extend(role_failures)
         children.append(config_watch)
         children.append(config_poll)
-        idx, _ = await _wait_any(role_failures + [config_watch, config_poll])
-        reason = ("configuration changed" if idx >= len(role_failures)
+        children.append(coord_watch)
+        idx, _ = await _wait_any(role_failures +
+                                 [config_watch, config_poll, coord_watch])
+        reason = ("coordinators changed"
+                  if idx == len(role_failures) + 2
+                  else "configuration changed"
+                  if idx >= len(role_failures)
                   else "recruited role failed")
         TraceEvent("MasterTerminated", Severity.Warn).detail(
             "Epoch", master.epoch).detail(

@@ -258,6 +258,8 @@ class Ratekeeper:
             cur = self.tag_throttles.get(r.busiest_read_tag)
             if cur is not None:
                 tps = min(tps, cur[0])    # tighten, never loosen mid-storm
+            from ..core.coverage import test_coverage
+            test_coverage("RatekeeperThrottling")
             self.tag_throttles[r.busiest_read_tag] = (
                 tps, t + float(knobs.AUTO_TAG_THROTTLE_DURATION))
             TraceEvent("RkTagThrottled").detail(

@@ -7,10 +7,13 @@ written against the SimFile surface (server/sim_fs.py); this module serves
 that surface from a real directory so the identical role code runs in real
 OS processes (server/fdbserver.py).
 
-IO is synchronous under the async signatures: writes/fsyncs on a local SSD
-are bounded and the durable actors already batch them (the reference's KAIO
-threadpool is an optimization this deployment plane can adopt later; the
-semantics — data is durable only after sync() — are identical).
+Writes/reads are synchronous under the async signatures (bounded page-cache
+ops on a local SSD), but sync() — the actually-blocking disk barrier every
+DiskQueue group commit and B-tree checkpoint waits on — runs on the loop's
+thread pool (core/threadpool.py, the reference's AsyncFileKAIO/IThreadPool
+split): a slow fsync must not stall every connection and timer of the
+process.  Ordering is preserved because callers pwrite before awaiting
+sync() and ack only after it resolves.
 """
 
 from __future__ import annotations
@@ -41,7 +44,8 @@ class RealFile:
 
     async def sync(self) -> None:
         self._check_open()
-        os.fsync(self._fd)
+        from ..core.threadpool import run_blocking
+        await run_blocking(os.fsync, self._fd)
 
     async def read(self, offset: int, length: int) -> bytes:
         self._check_open()

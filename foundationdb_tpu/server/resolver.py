@@ -97,8 +97,20 @@ class Resolver:
                          req.version -
                          int(knobs.MAX_WRITE_TRANSACTION_LIFE_VERSIONS))
         _t0 = now()
-        committed, conflicting = self.conflict_set.resolve_with_conflicts(
-            req.transactions, req.version, new_oldest_version=new_oldest)
+        cs = self.conflict_set
+        if getattr(cs, "offload_blocking", False):
+            # Synchronous native engines run on the thread pool (reference
+            # IThreadPool): a large batch must not stall this process's
+            # reactor — co-hosted roles and every connection keep flowing.
+            # Safe because version chaining already serializes batches:
+            # nothing touches the window while the worker thread runs.
+            from ..core.threadpool import run_blocking
+            committed, conflicting = await run_blocking(
+                cs.resolve_with_conflicts, req.transactions, req.version,
+                new_oldest)
+        else:
+            committed, conflicting = cs.resolve_with_conflicts(
+                req.transactions, req.version, new_oldest_version=new_oldest)
         self.metrics.histogram("Resolve").record(now() - _t0)
         self.metrics.counter("TxnResolved").add(len(req.transactions))
         self._sample_batch(req.transactions)

@@ -131,6 +131,16 @@ async def build_status(cc) -> Dict[str, Any]:
             "layers": {"_valid": True},
             "roles": roles,
             "cluster_controller_timestamp": round(now(), 3),
+            # The quorum this CC is operating against (reference status
+            # coordinators section; addresses resolved from the CC's own
+            # coordinator handles, which forward-following keeps current).
+            "coordinators": {
+                "quorum": [
+                    f"{a.ip}:{a.port}" for a in (
+                        getattr(getattr(c, "reg_read", None), "address",
+                                None) for c in cc.coordinators)
+                    if a is not None],
+            },
             "configuration": {
                 "logs": len(info.tlogs),
                 "resolvers": len(info.resolvers),

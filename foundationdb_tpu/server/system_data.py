@@ -41,6 +41,10 @@ SERVER_TAG_END = b"\xff/serverTag0"
 EXCLUDED_PREFIX = b"\xff/conf/excluded/"
 EXCLUDED_END = b"\xff/conf/excluded0"
 BACKUP_STARTED_KEY = b"\xff/backupStarted"
+# Container URL of the active backup (committed with the flag; reference
+# backup config in \xff/backup/ via TaskBucket): the recruited backup
+# worker role appends the log stream here (server/backup_worker.py).
+BACKUP_CONTAINER_KEY = b"\xff/backupContainer"
 # Monotonic allocator floor for storage tags: committed data, so a tag can
 # never be reissued across recoveries even after its serverTag/excluded
 # entries are retired (the reference's serverTag allocation scans committed
@@ -103,6 +107,43 @@ def excluded_key(tag: Tag) -> bytes:
 # subspace nests here but is NOT a configuration field.
 CONF_PREFIX = b"\xff/conf/"
 CONF_END = b"\xff/conf0"
+
+# The cluster's coordinator connection spec as committed data (reference
+# \xff/coordinators, fdbclient/ManagementAPI.actor.cpp changeQuorum): the
+# management API writes the NEW spec here; the master polls it against the
+# quorum it recovered on and performs the movable-coordinated-state
+# transition when they diverge (master.py _coordinators_watch).
+COORDINATORS_KEY = b"\xff/coordinators"
+
+# Dynamic knobs as committed data (the reference's config DB —
+# fdbserver/ConfigNode.actor.cpp + ConfigBroadcaster.actor.cpp — hosts
+# versioned knob overrides on the coordinators; here they are ordinary
+# transactional keys, consistent with this repo's configuration-as-data
+# design: \xff/knobs/<scope>/<NAME> = printed value).  Every write also
+# bumps KNOBS_CHANGED_KEY so each worker's LocalConfiguration watch
+# (worker.py _knob_watch) re-reads and applies WITHOUT a restart or
+# recovery.  Trade-off vs the reference: knob changes need a working
+# commit pipeline; bootstrap values come from static defaults/env.
+KNOBS_PREFIX = b"\xff/knobs/"
+KNOBS_END = b"\xff/knobs0"
+KNOBS_CHANGED_KEY = b"\xff/knobsChanged"
+
+
+def knob_key(scope: str, name: str) -> bytes:
+    return KNOBS_PREFIX + scope.encode() + b"/" + name.encode()
+
+
+# Cached key ranges (reference \xff/storageCache + cacheKeysPrefix,
+# fdbserver/StorageCache.actor.cpp): \xff/cacheRanges/<begin> = <end>.
+# Commit proxies route mutations inside these ranges onto CACHE_TAG; the
+# cache role watches the prefix, fetches new ranges, drops removed ones.
+CACHE_RANGES_PREFIX = b"\xff/cacheRanges/"
+CACHE_RANGES_END = b"\xff/cacheRanges0"
+CACHE_RANGES_CHANGED_KEY = b"\xff/cacheRangesChanged"
+
+
+def cache_range_key(begin: bytes) -> bytes:
+    return CACHE_RANGES_PREFIX + begin
 
 
 def conf_key(field_name: str) -> bytes:

@@ -323,6 +323,8 @@ class TLog:
             if not progressed:
                 break          # nothing durable to evict yet; retry later
         if spilled_bytes:
+            from ..core.coverage import test_coverage
+            test_coverage("TLogSpillActivated")
             self.bytes_spilled += spilled_bytes
             TraceEvent("TLogSpilled").detail("Id", self.id).detail(
                 "Bytes", spilled_bytes).detail(

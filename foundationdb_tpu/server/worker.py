@@ -196,10 +196,39 @@ class Worker:
         if req.recover_tags:
             await tlog.recover_from(req.recover_tags, req.recover_popped,
                                     req.recovery_version)
+        if getattr(req, "feeder_routers", None):
+            # REMOTE TLog: fed asynchronously from the log routers with
+            # this log's twin tags (server/log_router.py topology).
+            from .log_router import remote_tlog_feeder
+            self.process.spawn(
+                remote_tlog_feeder(
+                    tlog, LogSystemClient(req.feeder_routers, replication=1),
+                    list(req.feeder_tags), req.recovery_version),
+                f"{self.process.name}.remoteFeeder")
         self._gc_tlog_files(req.epoch)
         self.recovered_logs[req.tlog_id] = tlog.interface
         self._announce_roles()
         req.reply.send(tlog.interface)
+
+    async def _init_backup_worker(self, req) -> None:
+        from ..client.database import ClusterConnection, Database
+        from .backup_worker import BackupWorker
+        bw = BackupWorker(req.bw_id, req.epoch,
+                          LogSystemClient(req.tlogs,
+                                          replication=req.log_replication),
+                          req.container_url,
+                          db=Database(ClusterConnection(self.coordinators)))
+        self.process.spawn(bw.run(), f"{self.process.name}.backupWorker")
+        req.reply.send(bw.interface)
+
+    async def _init_log_router(self, req) -> None:
+        from .log_router import LogRouter
+        router = LogRouter(req.router_id,
+                           LogSystemClient(req.tlogs,
+                                           replication=req.log_replication),
+                           start_version=req.start_version)
+        router.run(self.process)
+        req.reply.send(router.interface)
 
     def _gc_tlog_files(self, epoch: int) -> None:
         """Delete local TLog files two or more generations old: epoch e
@@ -233,6 +262,8 @@ class Worker:
             key_resolvers, key_servers, req.storage_interfaces,
             req.recovery_version)
         proxy.backup_active = req.backup_active
+        proxy.region_replication = getattr(req, "region_replication", False)
+        proxy.storage_caches = list(getattr(req, "storage_caches", ()) or ())
         proxy.run(self.process)
         req.reply.send(proxy.interface)
 
@@ -296,9 +327,30 @@ class Worker:
                     self._commit_server_tags(remaining),
                     f"{self.process.name}.ssRejoin")
         info = self.db_info.get()
-        ls = LogSystemClient(info.tlogs,
-                             replication=self._log_replication()) \
-            if info.tlogs else None
+        if getattr(req, "pull_tlogs", None):
+            # Remote replica: pull from its region's TLog set (each remote
+            # TLog carries the twin tags the master's feeder assignment
+            # gave it, mirrored by replication=1 team selection).
+            ls = LogSystemClient(req.pull_tlogs, replication=1)
+        else:
+            ls = LogSystemClient(info.tlogs,
+                                 replication=self._log_replication()) \
+                if info.tlogs else None
+        if getattr(req, "cache_role", False):
+            # StorageCache (reference StorageCache.actor.cpp:149): a
+            # memory-only read replica of the committed \xff/cacheRanges/
+            # registry, fed by CACHE_TAG and seeded/maintained by its
+            # registry watch below.  Owns nothing until ranges arrive,
+            # and never opens a durable engine.
+            ss = StorageServer(req.ss_id, req.tag, ls, engine=None)
+            ss.shards.set_range(b"", b"\xff\xff", ("absent", 0))
+            ss.run(self.process)
+            self._stamp_locality(ss)
+            self.storage_roles.append(ss)
+            self.process.spawn(self._storage_cache_watch(ss),
+                               f"{self.process.name}.cacheWatch")
+            req.reply.send(ss.interface)
+            return
         # init_storage only happens before any commit was ever acked
         # (cold boot / failed first recovery): stale files are safe to
         # wipe, and must be (same stale-tail hazard as init_tlog).
@@ -309,6 +361,7 @@ class Worker:
         engine = open_kv_store(engine_name, self._fs(),
                                f"storage-{req.tag}")
         ss = StorageServer(req.ss_id, req.tag, ls, engine=engine)
+        ss.remote = bool(getattr(req, "pull_tlogs", None))
         # Seed the engine's identity metadata durably before serving so
         # a power failure at any later point finds a recoverable store.
         engine.set(_META_KEY, ss._meta_blob(0))
@@ -319,12 +372,15 @@ class Worker:
         self.recovered_storage[req.tag] = ss.interface
         self.storage_versions[req.tag] = 0
         self._announce_roles()
-        # Keep the serverTag registry on the NEWEST incarnation: a
-        # stale rejoin entry from a replaced role must not win the
-        # DD's registry scan over this recruitment.
-        self.process.spawn(
-            self._commit_server_tags({req.tag: ss.interface}),
-            f"{self.process.name}.ssRegistry")
+        if not ss.remote:
+            # Keep the serverTag registry on the NEWEST incarnation: a
+            # stale rejoin entry from a replaced role must not win the
+            # DD's registry scan over this recruitment.  Remote replicas
+            # stay OUT of the registry: the DD manages primary tags only,
+            # and failover discovers twins via worker registration.
+            self.process.spawn(
+                self._commit_server_tags({req.tag: ss.interface}),
+                f"{self.process.name}.ssRegistry")
         req.reply.send(ss.interface)
 
     def _announce_roles(self) -> None:
@@ -334,22 +390,160 @@ class Worker:
         these registrations."""
         if self._current_cc is None:
             return
+        loc = getattr(self.process, "locality", None)
         RequestStream.at(self._current_cc.register_worker.endpoint).send(
             RegisterWorkerRequest(
                 worker=self.interface,
                 process_class=self.process_class,
                 recovered_logs=dict(self.recovered_logs),
                 recovered_storage=dict(self.recovered_storage),
-                storage_versions=dict(self.storage_versions)))
+                storage_versions=dict(self.storage_versions),
+                locality=((loc.dcid, loc.zoneid, loc.machineid)
+                          if loc is not None else ("", "", ""))))
 
     async def _serve_wait_failure(self) -> None:
         """Hold requests forever; process death breaks their promises —
         the cross-process failure signal (reference WaitFailure.actor.cpp).
         The held list must be LOCAL: it has to die with this actor so the
         promises break when the process is killed."""
-        held = []
-        async for req in self.interface.wait_failure.queue:
-            held.append(req)
+        from .failure import hold_wait_failure
+        await hold_wait_failure(self.interface.wait_failure)
+
+    async def _storage_cache_watch(self, ss) -> None:
+        """The StorageCache's registry loop (reference storageCache's
+        cached-range management): track committed \\xff/cacheRanges/,
+        fetch newly cached ranges from their primary teams, drop removed
+        ones, and RE-ASSERT the registry after every epoch change — the
+        touch repopulates the new proxies' CACHE_TAG routing, and the
+        re-fetch that follows covers any routing gap the recovery window
+        opened (fetch buffers the live stream while snapshotting)."""
+        from ..client.database import ClusterConnection, Database
+        from ..core.error import FdbError
+        from ..core.futures import wait_any
+        from ..core.scheduler import delay
+        from .interfaces import FetchKeysRequest, RemoveShardRequest
+        from .system_data import (CACHE_RANGES_CHANGED_KEY,
+                                  CACHE_RANGES_END, CACHE_RANGES_PREFIX)
+        db = Database(ClusterConnection(self.coordinators))
+        holding: dict = {}
+        known_epoch = -1
+        try:
+            while True:
+                info = self.db_info.get()
+                epoch_changed = (info.epoch != known_epoch and
+                                 info.recovery_state in
+                                 ("accepting_commits", "fully_recovered"))
+                watch_f = None
+                try:
+                    t = db.create_transaction()
+                    t.access_system_keys = True
+                    rows = await t.get_range(CACHE_RANGES_PREFIX,
+                                             CACHE_RANGES_END)
+                    if epoch_changed:
+                        # Touch every entry: the new epoch's proxies
+                        # rebuild their CACHE_TAG routing from these
+                        # metadata mutations.
+                        for k, v in rows:
+                            t.set(k, v)
+                    watch_f = await t.watch(CACHE_RANGES_CHANGED_KEY)
+                    v_commit = await t.commit()
+                except FdbError:
+                    await delay(1.0)
+                    continue
+                if epoch_changed:
+                    known_epoch = info.epoch
+                want = {k[len(CACHE_RANGES_PREFIX):]: v for k, v in rows}
+                for b, e in list(holding.items()):
+                    if want.get(b) != e:
+                        del holding[b]
+                        await RequestStream.at(
+                            ss.interface.remove_shard.endpoint).get_reply(
+                            RemoveShardRequest(begin=b, end=e))
+                for b, e in want.items():
+                    if holding.get(b) == e and not epoch_changed:
+                        continue
+                    try:
+                        sources = await db.get_key_location(b)
+                        p = RequestStream.at(
+                            ss.interface.fetch_keys.endpoint).get_reply(
+                            FetchKeysRequest(
+                                begin=b, end=e, sources=list(sources),
+                                min_version=max(v_commit or 0, 0)))
+                        await p
+                        holding[b] = e
+                        TraceEvent("StorageCacheRangeLoaded").detail(
+                            "Id", ss.id).detail("Begin", b).detail(
+                            "End", e).log()
+                    except FdbError as e2:
+                        TraceEvent("StorageCacheFetchFailed",
+                                   Severity.Warn).detail(
+                            "Begin", b).detail("Error", e2.name).log()
+                        db.invalidate_cache(b)
+                await wait_any([watch_f, delay(10.0),
+                                self.db_info.on_change()])
+        finally:
+            close = getattr(db.cluster, "close", None)
+            if close is not None:
+                close()
+
+    async def _knob_watch(self) -> None:
+        """LocalConfiguration (reference fdbserver/LocalConfiguration.actor
+        .cpp over the ConfigBroadcaster): apply committed dynamic-knob
+        overrides (\\xff/knobs/) to this process's knob registry LIVE —
+        no restart, no recovery.  Re-reads on the change-marker watch;
+        plain 10s polling backstops a lost watch."""
+        from ..client.database import ClusterConnection, Database
+        from ..core.error import FdbError
+        from ..core.futures import wait_any
+        from ..core.knobs import get_knobs
+        from ..core.scheduler import delay
+        from .system_data import KNOBS_CHANGED_KEY, KNOBS_END, KNOBS_PREFIX
+        db = Database(ClusterConnection(self.coordinators))
+        knobs = get_knobs()
+        scopes = {"server": knobs.server, "client": knobs.client,
+                  "flow": knobs.flow}
+        # (scope, name) -> pre-override value, so CLEARING an override
+        # restores the default live (not just on restart).
+        originals: Dict = {}
+        try:
+            while True:
+                watch_f = None
+                try:
+                    t = db.create_transaction()
+                    t.access_system_keys = True
+                    rows = await t.get_range(KNOBS_PREFIX, KNOBS_END)
+                    watch_f = await t.watch(KNOBS_CHANGED_KEY)
+                    await t.commit()
+                    seen = set()
+                    for k, v in rows:
+                        parts = k[len(KNOBS_PREFIX):].split(b"/", 1)
+                        if len(parts) != 2:
+                            continue
+                        sname, name = parts[0].decode(), parts[1].decode()
+                        scope = scopes.get(sname)
+                        if scope is None or not hasattr(scope, name):
+                            continue
+                        seen.add((sname, name))
+                        originals.setdefault((sname, name),
+                                             getattr(scope, name))
+                        scope.apply_dynamic(name, v)
+                    for (sname, name) in list(originals):
+                        if (sname, name) not in seen:
+                            setattr(scopes[sname], name,
+                                    originals.pop((sname, name)))
+                            TraceEvent("DynamicKnobRestored").detail(
+                                "Name", name).log()
+                except FdbError:
+                    await delay(2.0)     # pipeline mid-recovery
+                    continue
+                except Exception:  # noqa: BLE001 — never kill the watch
+                    await delay(5.0)
+                    continue
+                await wait_any([watch_f, delay(10.0)])
+        finally:
+            close = getattr(db.cluster, "close", None)
+            if close is not None:
+                close()
 
     # -- ServerDBInfo watch: re-target storage pull cursors ------------------
     async def _watch_db_info(self) -> None:
@@ -362,7 +556,27 @@ class Worker:
                 known_epoch = info.epoch
                 ls = LogSystemClient(info.tlogs,
                                      replication=self._log_replication())
+                remote_ls = (LogSystemClient(info.remote_tlogs,
+                                             replication=1)
+                             if getattr(info, "remote_tlogs", None) else None)
                 for ss in self.storage_roles:
+                    if getattr(ss, "remote", False):
+                        if ss.tag in info.storage_servers:
+                            # A region failover ADOPTED this replica as a
+                            # serving (primary) storage server: from now
+                            # on its twin tag is pushed to the primary
+                            # TLogs — flip to an ordinary puller.
+                            ss.remote = False
+                        elif remote_ls is not None:
+                            # Re-target to the NEW epoch's remote TLog
+                            # set; with the remote plane gone they keep
+                            # their old cursor until one exists again.
+                            ss.set_log_system(remote_ls,
+                                              info.recovery_version,
+                                              info.epoch)
+                            continue
+                        else:
+                            continue
                     ss.set_log_system(ls, info.recovery_version, info.epoch)
             await self.db_info.on_change()
 
@@ -429,11 +643,16 @@ class Worker:
              "ratekeeper"),
             (self.interface.init_data_distributor,
              self._init_data_distributor, "dataDistributor"),
+            (self.interface.init_log_router, self._init_log_router,
+             "logRouter"),
+            (self.interface.init_backup_worker, self._init_backup_worker,
+             "backupWorker"),
         ]
         for stream, handler, name in inits:
             p.spawn(self._serve_inits(stream.queue, handler, name),
                     f"{p.name}.init:{name}")
         p.spawn(self._serve_wait_failure(), f"{p.name}.waitFailure")
         p.spawn(self._watch_db_info(), f"{p.name}.watchDbInfo")
+        p.spawn(self._knob_watch(), f"{p.name}.knobWatch")
         p.spawn(self._register_loop(leader_var), f"{p.name}.register")
 

@@ -165,6 +165,49 @@ class Cli:
         tags = self.run_async(excluded_servers(self.db))
         return f"Excluded tags: {tags or 'none'}"
 
+    def cmd_setknob(self, name: str, value: str = "",
+                    scope: str = "server") -> str:
+        """setknob NAME VALUE [scope] — live dynamic-knob change (empty
+        VALUE clears the override)."""
+        from ..client.management import set_knob
+        self.run_async(set_knob(self.db, name, value or None, scope=scope))
+        return (f"Knob {scope}/{name} "
+                f"{'cleared' if not value else 'set to ' + value} "
+                "(workers apply without restart)")
+
+    def cmd_getknobs(self) -> str:
+        from ..client.management import get_knob_overrides
+        overrides = self.run_async(get_knob_overrides(self.db))
+        if not overrides:
+            return "No dynamic knob overrides"
+        return "\n".join(f"{k} = {v}" for k, v in sorted(overrides.items()))
+
+    def cmd_cache_range(self, action: str, begin: str,
+                        end: str = "") -> str:
+        """cache_range set BEGIN END | cache_range clear BEGIN"""
+        from ..client.management import cache_range, uncache_range
+        if action == "set":
+            self.run_async(cache_range(self.db, _unescape(begin),
+                                       _unescape(end)))
+            return f"Caching [{begin}, {end})"
+        if action == "clear":
+            self.run_async(uncache_range(self.db, _unescape(begin)))
+            return f"Uncached range at {begin}"
+        return "usage: cache_range set BEGIN END | cache_range clear BEGIN"
+
+    def cmd_coordinators(self, *spec: str) -> str:
+        """coordinators                 — show the committed quorum spec
+           coordinators ip:port,...    — changeQuorum to the new spec"""
+        from ..client.management import (change_coordinators,
+                                         get_coordinators)
+        if not spec:
+            cur = self.run_async(get_coordinators(self.db))
+            return f"Coordinators: {cur or '(boot spec; never changed)'}"
+        new_spec = ",".join(spec)
+        self.run_async(change_coordinators(self.db, new_spec))
+        return (f"Coordinators changing to {new_spec} (the master moves "
+                "the quorum and recovers; clients follow the forward)")
+
     def cmd_watch(self, key: str) -> str:
         async def go():
             t = self.db.create_transaction()

@@ -79,17 +79,24 @@ for _ in range(REPS):
 print(f"{'host _pack (incl. grouping)':35s} "
       f"{(time.perf_counter() - t0) / REPS * 1e3:9.2f} ms")
 
-# ---- h2d transfer of the packed blocks ------------------------------------
-dig = packed["digests"]
-meta = packed["meta"]
+# ---- h2d transfer of the packed block(s) ----------------------------------
+if packed.get("compact"):
+    buf = packed["buf"]
 
+    def h2d(a):
+        return jax.device_put(a)
 
-def h2d(a, b):
-    return jax.device_put(a), jax.device_put(b)
+    da = timed(f"h2d compact buf ({buf.nbytes / 1e6:.1f} MB)", h2d, buf)
+else:
+    dig = packed["digests"]
+    meta = packed["meta"]
 
+    def h2d(a, b):
+        return jax.device_put(a), jax.device_put(b)
 
-da, db = timed("h2d digests+meta "
-               f"({(dig.nbytes + meta.nbytes) / 1e6:.0f} MB)", h2d, dig, meta)
+    da, db = timed("h2d digests+meta "
+                   f"({(dig.nbytes + meta.nbytes) / 1e6:.0f} MB)",
+                   h2d, dig, meta)
 
 # ---- full step + merge ----------------------------------------------------
 for v, enc, _k, _s in batches[:2]:
@@ -112,7 +119,10 @@ print(f"{'merge (overlay+GC+rebase+table)':35s} "
 
 # ---- isolated pieces at the same shapes -----------------------------------
 r_cap = bucket(R)
-qb = jnp.asarray(dig[:, :r_cap])
+from foundationdb_tpu.ops.digest import max_digest_block  # noqa: E402
+qsrc = max_digest_block(r_cap)
+qsrc[:, :enc0.r_begin.shape[1]] = enc0.r_begin[:, :r_cap]
+qb = jnp.asarray(qsrc)
 timed("searchsorted R queries into CAP",
       jax.jit(lambda bk, q: searchsorted_left(bk, q)), cs.bk, qb)
 timed("searchsorted R queries into DCAP",

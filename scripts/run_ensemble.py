@@ -92,6 +92,12 @@ def main() -> int:
                 print(f"FAIL {tag} ({time.time() - t0:.1f}s)")
                 traceback.print_exc()
                 failures.append(tag)
+    from foundationdb_tpu.core.coverage import missing, report
+    hit = {k: v for k, v in report().items() if v}
+    print(f"\ncoverage markers hit: {sorted(hit)}")
+    if missing():
+        print(f"coverage markers NEVER hit: {missing()} "
+              "(reference TestHarness-style coverage ledger)")
     print(f"\n{total - len(failures)}/{total} passed")
     for f in failures:
         print(f"  FAILED: {f}")

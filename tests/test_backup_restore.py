@@ -86,3 +86,116 @@ def test_backup_restore_roundtrip(teardown):  # noqa: F811
     restored = dst.run_until(dst.loop.spawn(run_restore()), timeout=300)
     assert restored == expected, (
         f"restore divergence: {len(restored)} vs {len(expected)} keys")
+
+
+def test_backup_capture_survives_recovery_and_agent_death(teardown):  # noqa: F811,E501
+    """The backup worker ROLE (server/backup_worker.py) owns log capture:
+    it is re-recruited each epoch and resumes from the container tail, so
+    neither a recovery nor the submitting agent's death leaves a hole."""
+    from foundationdb_tpu.core.scheduler import delay
+    src = SimFdbCluster(config=DatabaseConfiguration(), n_workers=5,
+                        n_storage_workers=2)
+    db = src.database()
+    backup_fs = SimFileSystem()
+
+    async def run_backup():
+        for i in range(10):
+            await commit_kv(db, b"pre/%03d" % i, b"v%03d" % i)
+        agent = FileBackupAgent(src, db, backup_fs)
+        await agent.submit()
+        for i in range(10):
+            await commit_kv(db, b"mid/%03d" % i, b"m%03d" % i)
+        # Epoch change mid-capture: kill the master; the next epoch
+        # re-recruits a backup worker that resumes from the container.
+        epoch = src.current_cc().db_info.epoch
+        mp = src.process_of(src.current_cc().db_info.master)
+        src.sim.kill_process(mp)
+        for _ in range(200):
+            cc = src.current_cc()
+            if cc is not None and cc.db_info.epoch > epoch and \
+                    cc.db_info.recovery_state in ("accepting_commits",
+                                                  "fully_recovered"):
+                break
+            await delay(0.25)
+        for i in range(10):
+            await commit_kv(db, b"post/%03d" % i, b"p%03d" % i)
+        await agent.stop()
+        return await read_all(db)
+
+    expected = src.run_until(src.loop.spawn(run_backup()), timeout=600)
+    assert any(k.startswith(b"post/") for k in expected)
+
+    from foundationdb_tpu.core import DeterministicRandom, \
+        set_deterministic_random
+    set_deterministic_random(DeterministicRandom(78))
+    dst = SimFdbCluster(config=DatabaseConfiguration(), n_workers=5,
+                        n_storage_workers=2)
+    db2 = dst.database()
+
+    async def run_restore():
+        n = await restore(db2, backup_fs)
+        assert n > 0
+        return await read_all(db2)
+
+    restored = dst.run_until(dst.loop.spawn(run_restore()), timeout=300)
+    assert restored == expected, (
+        f"restore divergence: {len(restored)} vs {len(expected)} keys")
+
+
+def test_snapshot_tasks_resume_after_agent_death(teardown):  # noqa: F811
+    """The snapshot is a TaskBucket chunk chain: killing the executing
+    agent mid-snapshot leaves claimable/reclaimable tasks that a SECOND
+    agent finishes — resumable-by-any-agent (reference TaskBucket)."""
+    from foundationdb_tpu.core.scheduler import delay
+    src = SimFdbCluster(config=DatabaseConfiguration(), n_workers=5,
+                        n_storage_workers=2)
+    db = src.database()
+    backup_fs = SimFileSystem()
+
+    async def go():
+        # Enough keys for several 500-key chunks.
+        for i in range(60):
+            t = db.create_transaction()
+            while True:
+                try:
+                    for j in range(20):
+                        t.set(b"bulk/%03d/%02d" % (i, j), b"x%04d" % j)
+                    await t.commit()
+                    break
+                except FdbError as e:
+                    await t.on_error(e)
+        agent = FileBackupAgent(src, db, backup_fs)
+        agent.bucket.timeout = 400_000      # fast reclaim (~0.4s versions)
+        # Submit WITHOUT letting the internal agent finish: start it,
+        # then kill the executing agent after the FIRST chunk lands.
+        start_f = src.loop.spawn(agent.submit(), "submitBackup")
+        for _ in range(400):
+            if await agent.container.snapshot_complete():
+                break
+            try:
+                backup_fs.open("backup.snap.part0", create=False)
+            except FdbError:
+                await delay(0.02)
+                continue
+            if agent._agent_f is not None:
+                agent._agent_f.cancel()      # the first agent dies
+            break
+        # Burn a little version time so its claimed mid-flight task (if
+        # any) times out, then a second agent drains the chain.
+        for i in range(8):
+            await commit_kv(db, b"burn", b"%d" % i)
+            await delay(0.08)
+        agent.run_agent("agent1")
+        for _ in range(600):
+            if await agent.container.snapshot_complete():
+                break
+            await delay(0.05)
+        assert await agent.container.snapshot_complete()
+        await start_f
+        _v, kvs = await agent.container.read_snapshot()
+        keys = {k for k, _ in kvs}
+        assert all(b"bulk/%03d/00" % i in keys for i in range(60))
+        await agent.stop()
+        return True
+
+    assert src.run_until(src.loop.spawn(go()), timeout=600)

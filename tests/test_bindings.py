@@ -1,0 +1,145 @@
+"""Binding surface tests: tuple layer + frozen fdb API + stack tester.
+
+Reference: bindings/python/fdb (API shapes), design/tuple.md (encoding),
+bindings/bindingtester (the stack-machine cross-check: the same op
+stream must behave identically through the frozen binding and through
+direct internal-client calls)."""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.bindings import tuple as fdb_tuple
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+from test_recovery import teardown  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# Tuple layer
+# ---------------------------------------------------------------------------
+
+CASES = [
+    (),
+    (None,),
+    (b"", b"\x00", b"a\x00b", b"\xff" * 3),
+    ("", "hello", "unié中"),
+    (0, 1, -1, 255, 256, -255, -256, 2**40, -(2**40), 2**63 - 1),
+    (1.5, -1.5, 0.0, 3.141592653589793, float("inf"), float("-inf")),
+    (True, False),
+    ((1, (b"nest", None)), "outer"),
+    (b"k", 7, "s", (None, b"\x00\xff")),
+]
+
+
+def test_tuple_roundtrip():
+    for t in CASES:
+        assert fdb_tuple.unpack(fdb_tuple.pack(t)) == t, t
+
+
+def test_tuple_order_matches_value_order():
+    # Packing preserves order within each type family (the layer's core
+    # guarantee: sorted keys == sorted tuples).
+    ints = sorted([0, 1, -1, 7, -300, 1000, 2**30, -(2**30), 255, 256])
+    packed = [fdb_tuple.pack((v,)) for v in ints]
+    assert packed == sorted(packed)
+    strs = sorted(["", "a", "ab", "b", "a\x00c"])
+    packed = [fdb_tuple.pack((s,)) for s in strs]
+    assert packed == sorted(packed)
+    floats = sorted([-1e9, -1.0, -0.5, 0.0, 0.5, 1.0, 1e9])
+    packed = [fdb_tuple.pack((f,)) for f in floats]
+    assert packed == sorted(packed)
+
+
+def test_tuple_range():
+    b, e = fdb_tuple.range_of((b"dir",))
+    inside = fdb_tuple.pack((b"dir", 1))
+    assert b <= inside < e
+    assert not b <= fdb_tuple.pack((b"dis",)) < e
+
+
+# ---------------------------------------------------------------------------
+# Frozen API + stack tester
+# ---------------------------------------------------------------------------
+
+def make_cluster():
+    return SimFdbCluster(config=DatabaseConfiguration(), n_workers=4,
+                         n_storage_workers=2)
+
+
+def test_frozen_api_basics(teardown):  # noqa: F811
+    import foundationdb_tpu.bindings.fdb_api as fdb
+    fdb._API_VERSION = None
+    fdb.api_version(710)
+    c = make_cluster()
+    db = fdb.open(c.database())
+
+    async def go():
+        tr = db.create_transaction()
+        tr.set(b"bind/a", b"1")
+        tr.add(b"bind/ctr", (5).to_bytes(8, "little"))
+        await tr.commit()
+        assert tr.get_committed_version() > 0
+
+        tr2 = db.create_transaction()
+        assert await tr2.get(b"bind/a") == b"1"
+        assert (await tr2.get(b"bind/ctr"))[:1] == b"\x05"
+        rows = await tr2.get_range(b"bind/", b"bind0")
+        assert [k for k, _v in rows] == [b"bind/a", b"bind/ctr"]
+        k = await tr2.get_key(
+            fdb.KeySelector.first_greater_or_equal(b"bind/"))
+        assert k == b"bind/a"
+        k = await tr2.get_key(
+            fdb.KeySelector.last_less_or_equal(b"bind/zzz"))
+        assert k == b"bind/ctr"
+        # cancel() forbids commit until reset.
+        tr3 = db.create_transaction()
+        tr3.set(b"bind/x", b"y")
+        tr3.cancel()
+        try:
+            await tr3.commit()
+            raise AssertionError("commit after cancel must fail")
+        except fdb.FDBError as e:
+            assert e.code == 1025
+        assert await db.get(b"bind/x") is None
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=120)
+
+
+def test_stack_tester_frozen_vs_direct(teardown):  # noqa: F811
+    """The bindingtester cross-check: one op stream, two executors, same
+    stack and same final database state."""
+    import foundationdb_tpu.bindings.fdb_api as fdb
+    from foundationdb_tpu.bindings.stack_tester import (
+        DirectClientExecutor, FrozenApiExecutor, StackMachine,
+        generate_ops)
+    fdb._API_VERSION = None
+    fdb.api_version(710)
+    c = make_cluster()
+    raw_db = c.database()
+    fdb_db = fdb.open(raw_db)
+
+    rng = np.random.default_rng(20260731)
+    ops = generate_ops(rng, 120)
+    ops.append(("COMMIT",))
+
+    async def run_one(executor):
+        sm = StackMachine(executor)
+        stack = await sm.run(ops)
+        tr = raw_db.create_transaction()
+        snapshot = await tr.get_range(b"bt/", b"bt0", limit=100000)
+        # Wipe for the next executor.
+        tr.clear(b"bt/", b"bt0")
+        await tr.commit()
+        return stack, snapshot
+
+    async def go():
+        s1, snap1 = await run_one(FrozenApiExecutor(fdb_db))
+        s2, snap2 = await run_one(DirectClientExecutor(raw_db))
+        assert s1 == s2, (s1, s2)
+        assert snap1 == snap2
+        assert snap1 or any(op[0] == "SET" for op in ops) is False
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=300)

@@ -1,0 +1,19 @@
+"""Coverage markers (reference flow TEST() + TestHarness coverage ledger):
+the registry records which interesting code paths tests exercised; the
+ensemble runner reports never-hit markers."""
+
+from foundationdb_tpu.core import coverage
+
+
+def test_coverage_registry_and_hits():
+    coverage.register("UnitTestOnlyMarker")
+    assert not coverage.covered("UnitTestOnlyMarker")
+    assert "UnitTestOnlyMarker" in coverage.missing()
+    coverage.test_coverage("UnitTestOnlyMarker")
+    coverage.test_coverage("UnitTestOnlyMarker")
+    assert coverage.covered("UnitTestOnlyMarker")
+    assert coverage.hits("UnitTestOnlyMarker") == 2
+    assert "UnitTestOnlyMarker" not in coverage.missing()
+    # The built-in ledger knows the codebase's marked paths even before
+    # they fire.
+    assert "RecoveryRegionFailover" in coverage.report()

@@ -1,0 +1,65 @@
+"""Dynamic knobs (the config-DB analog): committed \xff/knobs/ overrides
+apply to running workers live — no restart, no recovery.
+
+Reference: fdbserver/ConfigNode.actor.cpp + ConfigBroadcaster.actor.cpp +
+LocalConfiguration; here the store is ordinary transactional keys and
+each worker watches the change marker (worker.py _knob_watch)."""
+
+import pytest
+
+from foundationdb_tpu.client.management import (get_knob_overrides,
+                                                set_knob)
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.core.scheduler import delay
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+
+def test_dynamic_knob_applies_live_without_recovery(teardown):  # noqa: F811
+    c = SimFdbCluster(config=DatabaseConfiguration(), n_workers=4,
+                      n_storage_workers=2)
+    db = c.database()
+    original = server_knobs().DD_SHARD_SPLIT_BYTES
+
+    async def go():
+        await commit_kv(db, b"k", b"v")
+        epoch_before = c.current_cc().db_info.epoch
+        await set_knob(db, "DD_SHARD_SPLIT_BYTES", original * 2)
+        # The worker watch applies it without any epoch change.
+        for _ in range(100):
+            if server_knobs().DD_SHARD_SPLIT_BYTES == original * 2:
+                break
+            await delay(0.2)
+        assert server_knobs().DD_SHARD_SPLIT_BYTES == original * 2
+        assert c.current_cc().db_info.epoch == epoch_before
+        assert (await get_knob_overrides(db)
+                )["server/DD_SHARD_SPLIT_BYTES"] == str(original * 2)
+        # Overrides survive a recovery (they are committed data): kill
+        # the master, wait for the next epoch, knob still applied.
+        mp = c.process_of(c.current_cc().db_info.master)
+        c.sim.kill_process(mp)
+        for _ in range(200):
+            cc = c.current_cc()
+            if cc is not None and cc.db_info.epoch > epoch_before and \
+                    cc.db_info.recovery_state in ("accepting_commits",
+                                                  "fully_recovered"):
+                break
+            await delay(0.25)
+        assert await read_key(db, b"k") == b"v"
+        assert server_knobs().DD_SHARD_SPLIT_BYTES == original * 2
+        # Unknown knob names are ignored (warning), never wedge the watch.
+        await set_knob(db, "NO_SUCH_KNOB_EXISTS", 7)
+        await set_knob(db, "DD_SHARD_SPLIT_BYTES", original * 3)
+        for _ in range(100):
+            if server_knobs().DD_SHARD_SPLIT_BYTES == original * 3:
+                break
+            await delay(0.2)
+        assert server_knobs().DD_SHARD_SPLIT_BYTES == original * 3
+        return True
+
+    try:
+        assert c.run_until(c.loop.spawn(go()), timeout=300)
+    finally:
+        server_knobs().DD_SHARD_SPLIT_BYTES = original

@@ -99,3 +99,47 @@ def test_replica_death_zero_client_errors(teardown):  # noqa: F811
         return True
 
     assert c.run_until(c.loop.spawn(go()), timeout=120)
+
+
+def test_tlog_teams_zone_diverse(teardown):  # noqa: F811
+    """Weak-spot fix (VERDICT r4 weak 8): TLog recruitment interleaves
+    failure zones so the modular team mapping places a tag's log replicas
+    in distinct zones (reference PolicyAcross(zoneid) for tlog teams)."""
+    from foundationdb_tpu.core.scheduler import delay
+    from foundationdb_tpu.server.cluster import SimFdbCluster
+    from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+    c = SimFdbCluster(
+        config=DatabaseConfiguration(n_tlogs=2, log_replication=2),
+        n_workers=5, n_storage_workers=2)
+    # Log-class workers concentrated two-per-zone: naive id ordering
+    # would team both replicas into one zone.
+    for i, z in enumerate(["zA", "zA", "zB", "zB"]):
+        c.add_worker("log", name=f"logw{i}", zoneid=z)
+    db = c.database()
+
+    async def go():
+        await commit_kv(db, b"zz", b"1")
+        # Force a recovery so recruitment sees the log-class workers.
+        mp = c.process_of(c.current_cc().db_info.master)
+        epoch = c.current_cc().db_info.epoch
+        c.sim.kill_process(mp)
+        for _ in range(200):
+            cc = c.current_cc()
+            if cc is not None and cc.db_info.epoch > epoch and \
+                    cc.db_info.recovery_state in ("accepting_commits",
+                                                  "fully_recovered"):
+                break
+            await delay(0.25)
+        await commit_kv(db, b"zz", b"2")
+        tlogs = c.current_cc().db_info.tlogs
+        assert len(tlogs) == 2
+        zones = []
+        for t in tlogs:
+            p = c.process_of(t)
+            zones.append(p.locality.zoneid)
+        # A team is consecutive tlogs (mod n): with 2 tlogs the team IS
+        # both — they must be in different zones.
+        assert zones[0] != zones[1], zones
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=240)

@@ -102,20 +102,26 @@ def test_router_feeds_remote_tlog(teardown):  # noqa: F811
         await remote._peek(TLogPeekRequest(tag=t1r, begin=1, reply=p2))
         got1 = [vv for vv, _m in (await p2.get_future()).messages]
         assert 18 not in got1 and len(got1) == 29
-        # The feeder popped the routers after durability; the router
-        # forwarded pops to the primary, trimming the twin tags there.
-        # (The feeder's pop fires after the same durability event we just
-        # awaited — give it a tick.)
+        # Pops track the REMOTE REPLICAS' applied points: until a replica
+        # pops the remote TLog, the router (and primary) must RETAIN the
+        # twin backlog — it is the recovery source for a lagging replica
+        # across generation changes.
         from foundationdb_tpu.core.scheduler import delay as _delay
-        for _ in range(100):
-            if router.buffered_bytes == 0:
-                break
-            await _delay(0.05)
-        assert router.buffered_bytes == 0
-        assert primary.poppedtags.get(t0r, 0) >= 29
-        # Remote storage-style consumption: pop the remote TLog.
+        await _delay(2.0)
+        assert router.buffered_bytes > 0
+        assert primary.poppedtags.get(t0r, 0) == 0
+        # Remote storage-style consumption pops the remote TLog; the
+        # feeder forwards those applied points router-ward, which trims
+        # the router buffer and the primary's twin tags.
         remote._pop(TLogPopRequest(tag=t0r, to=30))
         remote._pop(TLogPopRequest(tag=t1r, to=30))
+        for _ in range(100):
+            if router.buffered_bytes == 0 and \
+                    primary.poppedtags.get(t0r, 0) >= 29:
+                break
+            await _delay(0.1)
+        assert router.buffered_bytes == 0
+        assert primary.poppedtags.get(t0r, 0) >= 29
         return True
 
     assert lp.run_until(lp.spawn(go()), timeout=300)

@@ -1,0 +1,47 @@
+"""Sampling profiler + slow-task detection (reference flow/Profiler.actor.cpp
++ Net2 slow-task TraceEvents)."""
+
+import time
+
+from foundationdb_tpu.core.profiler import (SamplingProfiler,
+                                            install_slow_task_detection)
+from foundationdb_tpu.core.scheduler import EventLoop, set_event_loop
+from foundationdb_tpu.core.trace import get_tracer
+
+
+def teardown_function(_fn):
+    set_event_loop(None)
+
+
+def test_slow_task_emits_trace_event():
+    loop = EventLoop(sim=False)
+    set_event_loop(loop)
+    install_slow_task_detection(loop, threshold_s=0.05)
+    before = len(get_tracer().find("SlowTask"))
+
+    async def hog():
+        time.sleep(0.12)        # deliberately blocks the reactor
+        return True
+
+    assert loop.run_until(loop.spawn(hog(), "hog"), timeout=10)
+    events = get_tracer().find("SlowTask")
+    assert len(events) > before
+    assert events[-1]["DurationMs"] >= 100
+
+
+def test_sampling_profiler_catches_hot_function():
+    prof = SamplingProfiler(interval_s=0.002)
+    prof.start()
+
+    def busy_function():
+        x = 0
+        deadline = time.monotonic() + 0.3
+        while time.monotonic() < deadline:
+            x += 1
+        return x
+
+    busy_function()
+    prof.stop()
+    assert prof.total > 20
+    report = prof.report()
+    assert any("busy_function" in stack for _frac, stack in report), report

@@ -1,0 +1,155 @@
+"""Region replication + KillRegion failover (VERDICT r4 item 4).
+
+Reference: fdbserver/LogRouter.actor.cpp (router pull plane),
+TagPartitionedLogSystem.actor.cpp (remote tlog sets, epochEnd on the
+remote set), workloads/KillRegion.actor.cpp (fail over, verify, fail
+back).  Topology under test (server/log_router.py):
+
+    proxies --twin tags--> primary TLogs <--peek-- LogRouter
+        <--peek-- remote TLog <--peek-- remote storage replicas
+
+The failover is the DRAINED switchover (fdbcli-style): writes stop, the
+remote plane converges to the last commit, then the primary dc dies and
+recovery adopts the remote replicas — no acked commit may be lost.
+"""
+
+import pytest
+
+from foundationdb_tpu.client.management import change_configuration
+from foundationdb_tpu.core.scheduler import delay
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+from foundationdb_tpu.server.log_router import twin_tag
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+N_KEYS = 20
+
+
+def make_region_cluster():
+    config = DatabaseConfiguration()
+    return SimFdbCluster(config=config, n_workers=5, n_storage_workers=2)
+
+
+def add_remote_dc(c):
+    """The remote dc joins AFTER cold boot (like provisioning a second
+    region for an existing cluster): storage for replicas, stateless for
+    the remote plane's routers/TLogs — and a CC candidate so the dc can
+    elect a controller once the primary dc dies.  Joining post-boot also
+    keeps cold-boot storage placement inside dc0 (the primary)."""
+    c.add_worker("storage", name="rworker0", dcid="dcR")
+    c.add_worker("storage", name="rworker1", dcid="dcR")
+    c.add_worker("stateless", name="rworker2", dcid="dcR", campaign=True)
+
+
+async def _wait_remote_plane(c, timeout_s=60.0):
+    waited = 0.0
+    while waited < timeout_s:
+        cc = c.current_cc()
+        info = cc.db_info if cc is not None else None
+        if info is not None and getattr(info, "remote_tlogs", None) and \
+                getattr(info, "remote_storage", None):
+            return info
+        await delay(0.5)
+        waited += 0.5
+    raise AssertionError("remote plane never recruited")
+
+
+async def _wait_replicas_at(info, version, timeout_s=120.0):
+    """Drained convergence: every remote replica applied >= version."""
+    waited = 0.0
+    while waited < timeout_s:
+        roles = [getattr(i, "role", None)
+                 for i in info.remote_storage.values()]
+        if all(r is not None and r.version.get() >= version for r in roles):
+            return
+        await delay(0.5)
+        waited += 0.5
+    raise AssertionError(
+        f"replicas never converged to {version}: "
+        f"{[(r.id, r.version.get()) for r in roles if r is not None]}")
+
+
+def test_region_replication_and_drained_failover(teardown):  # noqa: F811
+    c = make_region_cluster()
+    db = c.database()
+
+    async def load():
+        for i in range(N_KEYS):
+            await commit_kv(db, b"rk%03d" % i, b"rv%03d" % i)
+        return True
+
+    c.run_until(c.loop.spawn(load()), timeout=180)
+    add_remote_dc(c)
+
+    async def configure():
+        # Turn the region on mid-life: the next epoch recruits routers,
+        # a remote TLog, and replicas seeded via fetch from their twins.
+        await change_configuration(db, usable_regions=2, remote_dc="dcR")
+        return True
+
+    c.run_until(c.loop.spawn(configure()), timeout=120)
+
+    info = c.run_until(c.loop.spawn(_wait_remote_plane(c)), timeout=120)
+    assert len(info.log_routers) >= 1
+    # Replicas carry TWIN tags of the primary storage tags.
+    for tt in info.remote_storage:
+        assert twin_tag(tt) in info.storage_servers
+
+    async def drain():
+        # A marker commit, then wait until every replica applied it —
+        # this also proves the mid-life fetch seeding converged.
+        t = db.create_transaction()
+        v = None
+        while v is None:
+            try:
+                t.set(b"marker", b"drained")
+                v = await t.commit()
+            except Exception as e:  # noqa: BLE001
+                await t.on_error(e)
+        cc = c.current_cc()
+        await _wait_replicas_at(cc.db_info, v)
+        return True
+
+    c.run_until(c.loop.spawn(drain()), timeout=300)
+
+    # KillRegion: every process in the primary dc dies (workers AND the
+    # current CC/master with them).  Coordinators live outside both dcs.
+    for p, _w, _cc, _lv in list(c.workers):
+        if p.locality.dcid == "dc0":
+            c.sim.kill_process(p)
+
+    async def after_failover():
+        # The remote dc elects a CC, recovery fails over to the remote
+        # plane, and EVERY acked commit is still readable.
+        for i in range(N_KEYS):
+            assert await read_key(db, b"rk%03d" % i) == b"rv%03d" % i, i
+        assert await read_key(db, b"marker") == b"drained"
+        await commit_kv(db, b"post-failover", b"yes")
+        assert await read_key(db, b"post-failover") == b"yes"
+        return True
+
+    c.run_until(c.loop.spawn(after_failover()), timeout=600)
+
+    # The adopted storage set serves under twin tags.
+    cc = c.current_cc()
+    assert cc is not None
+    assert all(t >= 1_000_000 for t in cc.db_info.storage_servers), \
+        cc.db_info.storage_servers.keys()
+
+
+def test_region_recruit_skipped_without_remote_workers(teardown):  # noqa: F811
+    """usable_regions=2 with no workers in remote_dc degrades to
+    primary-only instead of wedging recovery."""
+    config = DatabaseConfiguration(usable_regions=2, remote_dc="dcR")
+    c = SimFdbCluster(config=config, n_workers=4, n_storage_workers=2)
+    db = c.database()
+
+    async def go():
+        await commit_kv(db, b"k", b"v")
+        assert await read_key(db, b"k") == b"v"
+        return True
+
+    c.run_until(c.loop.spawn(go()), timeout=120)
+    cc = c.current_cc()
+    assert cc is not None and not cc.db_info.remote_tlogs

@@ -1,0 +1,160 @@
+"""Restarting test: quorum migration survives a whole-cluster restart.
+
+Phase 1 boots a real-process cluster whose quorum is coordinator A, runs
+changeQuorum onto a standby coordinator B (booted with --coordination),
+and waits until every process's fdb.cluster file has been rewritten by
+the forward replies.  Then every process is SIGKILLed and phase 2
+restarts all of them EXCEPT the old coordinator — recovery must elect
+and read the coordinated state purely through the new quorum, with the
+old one gone for good.
+
+Reference: fdbclient/ManagementAPI.actor.cpp changeQuorum (cluster-file
+rewrite on LeaderInfo.forward) + tests/restarting/ two-phase specs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 47620
+OLD_COORDS = f"127.0.0.1:{BASE_PORT}"
+NEW_COORDS = f"127.0.0.1:{BASE_PORT + 4}"
+CONFIG = json.dumps({"n_storage": 2, "min_workers": 3})
+
+NAMES = {"coord0": (BASE_PORT, "stateless", False),
+         "stateless1": (BASE_PORT + 1, "stateless", False),
+         "storage0": (BASE_PORT + 2, "storage", False),
+         "storage1": (BASE_PORT + 3, "storage", False),
+         "newcoord": (BASE_PORT + 4, "stateless", True)}
+
+
+def _spawn(base, name, generation):
+    port, pclass, coordination = NAMES[name]
+    cmd = [sys.executable, "-m", "foundationdb_tpu.server.fdbserver",
+           "--port", str(port), "--coordinators", OLD_COORDS,
+           "--datadir", os.path.join(base, name), "--class", pclass,
+           "--config", CONFIG, "--name", f"{name}.g{generation}"]
+    if coordination:
+        cmd.append("--coordination")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=open(os.path.join(base, f"{name}.g{generation}.out"), "wb"),
+        stderr=subprocess.STDOUT)
+
+
+def _client(spec):
+    from foundationdb_tpu.client.database import open_cluster
+    return open_cluster(spec)
+
+
+def _teardown_client():
+    from foundationdb_tpu.core.scheduler import set_event_loop
+    from foundationdb_tpu.rpc.network import get_network, set_network
+    try:
+        get_network().close()
+    except Exception:
+        pass
+    set_network(None)
+    set_event_loop(None)
+
+
+async def _commit_kv(db, k, v):
+    t = db.create_transaction()
+    while True:
+        try:
+            t.set(k, v)
+            return await t.commit()
+        except Exception as e:
+            await t.on_error(e)
+
+
+async def _read_key(db, k):
+    t = db.create_transaction()
+    while True:
+        try:
+            return await t.get(k)
+        except Exception as e:
+            await t.on_error(e)
+
+
+def _cluster_files(base, names):
+    out = {}
+    for n in names:
+        path = os.path.join(base, n, "fdb.cluster")
+        try:
+            with open(path) as f:
+                out[n] = f.read().strip()
+        except OSError:
+            out[n] = None
+    return out
+
+
+def test_quorum_migration_survives_restart(tmp_path):
+    base = str(tmp_path)
+    procs = {n: _spawn(base, n, 1) for n in NAMES}
+    try:
+        time.sleep(2.5)
+        dead = {n: p.poll() for n, p in procs.items()
+                if p.poll() is not None}
+        assert not dead, f"phase-1 processes died at boot: {dead}"
+        loop, db = _client(OLD_COORDS)
+
+        async def phase1():
+            for i in range(10):
+                await _commit_kv(db, b"q/%03d" % i, b"v%03d" % i)
+            from foundationdb_tpu.client.management import \
+                change_coordinators
+            await change_coordinators(db, NEW_COORDS)
+            return True
+
+        assert loop.run_until(loop.spawn(phase1()), timeout=90)
+        _teardown_client()
+
+        # Every process learns the move via forward replies and rewrites
+        # its fdb.cluster; wait for all of them (incl. the old
+        # coordinator's own worker half).  Generous deadline: under a
+        # full-suite run the five server processes share one starved
+        # core and wall-clock progress is ~5x slower than standalone.
+        deadline = time.time() + 150
+        while time.time() < deadline:
+            files = _cluster_files(base, NAMES)
+            if all(v == NEW_COORDS for v in files.values()):
+                break
+            time.sleep(1.0)
+        else:
+            raise AssertionError(
+                f"cluster files never converged: {_cluster_files(base, NAMES)}")
+
+        # SaveAndKill, then phase 2 WITHOUT the old coordinator.
+        for p in procs.values():
+            p.kill()
+        for p in procs.values():
+            p.wait()
+        time.sleep(1.0)
+
+        survivors = [n for n in NAMES if n != "coord0"]
+        procs = {n: _spawn(base, n, 2) for n in survivors}
+        time.sleep(2.5)
+        dead = {n: p.poll() for n, p in procs.items()
+                if p.poll() is not None}
+        assert not dead, f"phase-2 processes died at boot: {dead}"
+        loop, db = _client(NEW_COORDS)
+
+        async def phase2():
+            for i in range(10):
+                assert await _read_key(db, b"q/%03d" % i) == b"v%03d" % i
+            await _commit_kv(db, b"post-migrate", b"alive")
+            assert await _read_key(db, b"post-migrate") == b"alive"
+            return True
+
+        assert loop.run_until(loop.spawn(phase2()), timeout=120)
+        _teardown_client()
+    finally:
+        for p in procs.values():
+            p.kill()
+        for p in procs.values():
+            p.wait()

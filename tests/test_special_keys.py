@@ -1,0 +1,37 @@
+"""SpecialKeySpace modules (reference SpecialKeySpace.actor.cpp): status
+json and the management mirror readable through plain transaction gets,
+alongside the existing conflicting-keys module."""
+
+import json
+
+import pytest
+
+from foundationdb_tpu.client.management import exclude_servers
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+from test_recovery import commit_kv, teardown  # noqa: F401
+
+
+def test_status_json_and_management_special_keys(teardown):  # noqa: F811
+    c = SimFdbCluster(config=DatabaseConfiguration(), n_workers=5,
+                      n_storage_workers=3)
+    db = c.database()
+
+    async def go():
+        await commit_kv(db, b"k", b"v")
+        t = db.create_transaction()
+        raw = await t.get(b"\xff\xff/status/json")
+        assert raw is not None
+        doc = json.loads(raw)
+        assert doc["cluster"]["database_available"] is True
+        assert doc["cluster"]["coordinators"]["quorum"]
+        # Management module mirrors the exclusion list.
+        t2 = db.create_transaction()
+        assert await t2.get(b"\xff\xff/management/excluded/2") is None
+        await exclude_servers(db, [2])
+        t3 = db.create_transaction()
+        assert await t3.get(b"\xff\xff/management/excluded/2") == b"1"
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=180)

@@ -1,0 +1,136 @@
+"""StorageCache role (reference fdbserver/StorageCache.actor.cpp +
+CommitProxyServer.actor.cpp:959 cacheTag routing): committed
+\xff/cacheRanges/ entries route their mutations onto CACHE_TAG, the
+cache role fetches + serves them, and location replies add the cache to
+the replica set for hot-shard read scaling."""
+
+import pytest
+
+from foundationdb_tpu.client.management import cache_range, uncache_range
+from foundationdb_tpu.core.scheduler import delay
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import (CACHE_TAG,
+                                                DatabaseConfiguration,
+                                                GetValueRequest)
+from foundationdb_tpu.rpc.endpoint import RequestStream
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+
+def make_cluster():
+    return SimFdbCluster(
+        config=DatabaseConfiguration(n_storage_caches=1),
+        n_workers=5, n_storage_workers=2)
+
+
+def _cache_role(c):
+    for _p, w, _cc, _lv in c.workers:
+        for ss in w.storage_roles:
+            if ss.tag == CACHE_TAG:
+                return ss
+    return None
+
+
+def test_cache_serves_hot_range_and_stays_fresh(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        for i in range(10):
+            await commit_kv(db, b"hot/%03d" % i, b"h%03d" % i)
+        await commit_kv(db, b"cold/x", b"c")
+        await cache_range(db, b"hot/", b"hot0")
+
+        cache = _cache_role(c)
+        assert cache is not None
+        # The cache fetches the range and serves reads for it.
+        for _ in range(100):
+            st = cache.shards.lookup(b"hot/000")
+            if st and st[0] == "owned":
+                break
+            await delay(0.2)
+        st = cache.shards.lookup(b"hot/000")
+        assert st and st[0] == "owned", st
+
+        async def cache_get(key):
+            for _ in range(60):
+                v = cache.version.get()
+                try:
+                    reply = await RequestStream.at(
+                        cache.interface.get_value.endpoint).get_reply(
+                        GetValueRequest(key=key, version=v))
+                    return reply.value
+                except Exception:  # noqa: BLE001 — still catching up
+                    await delay(0.2)
+            return None
+
+        assert await cache_get(b"hot/003") == b"h003"
+        # Freshness: a NEW commit to the cached range rides CACHE_TAG and
+        # reaches the cache without any re-fetch.
+        await commit_kv(db, b"hot/003", b"h003v2")
+        for _ in range(100):
+            if await cache_get(b"hot/003") == b"h003v2":
+                break
+            await delay(0.2)
+        assert await cache_get(b"hot/003") == b"h003v2"
+        # Cold keys are NOT cached (absent -> wrong_shard_server).
+        st = cache.shards.lookup(b"cold/x")
+        assert not st or st[0] != "owned"
+        # Clients still read correctly with the cache in the replica set.
+        assert await read_key(db, b"hot/003") == b"h003v2"
+        assert await read_key(db, b"cold/x") == b"c"
+        # Uncache drops the range from the cache role.
+        await uncache_range(db, b"hot/")
+        for _ in range(100):
+            st = cache.shards.lookup(b"hot/000")
+            if not st or st[0] != "owned":
+                break
+            await delay(0.2)
+        st = cache.shards.lookup(b"hot/000")
+        assert not st or st[0] != "owned"
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=300)
+
+
+def test_cache_survives_recovery(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        await commit_kv(db, b"hot/a", b"1")
+        await cache_range(db, b"hot/", b"hot0")
+        cache = _cache_role(c)
+        for _ in range(100):
+            st = cache.shards.lookup(b"hot/a")
+            if st and st[0] == "owned":
+                break
+            await delay(0.2)
+        epoch = c.current_cc().db_info.epoch
+        mp = c.process_of(c.current_cc().db_info.master)
+        c.sim.kill_process(mp)
+        for _ in range(200):
+            cc = c.current_cc()
+            if cc is not None and cc.db_info.epoch > epoch and \
+                    cc.db_info.recovery_state in ("accepting_commits",
+                                                  "fully_recovered"):
+                break
+            await delay(0.25)
+        # After the epoch change the (new) cache role re-asserts the
+        # registry and re-fetches; a post-recovery commit still reaches
+        # whichever cache now serves the range.
+        await commit_kv(db, b"hot/a", b"2")
+        assert await read_key(db, b"hot/a") == b"2"
+        cache2 = _cache_role(c)
+        assert cache2 is not None
+        for _ in range(200):
+            st = cache2.shards.lookup(b"hot/a")
+            if st and st[0] == "owned" and \
+                    cache2.version.get() > 0:
+                break
+            await delay(0.25)
+        st = cache2.shards.lookup(b"hot/a")
+        assert st and st[0] == "owned"
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=400)

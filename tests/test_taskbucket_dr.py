@@ -1,0 +1,162 @@
+"""TaskBucket (resumable task queue) + DR (second-cluster replication).
+
+Reference: fdbclient/TaskBucket.actor.cpp (claim/timeout/reclaim,
+exactly-once finish) and fdbclient/DatabaseBackupAgent.actor.cpp (DR
+snapshot + continuous apply + drained switchover)."""
+
+import pytest
+
+from foundationdb_tpu.client.taskbucket import TaskBucket, run_tasks
+from foundationdb_tpu.core.scheduler import delay
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+
+def make_cluster(**kw):
+    return SimFdbCluster(config=DatabaseConfiguration(), n_workers=4,
+                         n_storage_workers=2, **kw)
+
+
+def test_taskbucket_claim_finish_and_reclaim(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+    bucket = TaskBucket(timeout_versions=2_000_000)   # ~2s of versions
+
+    async def go():
+        await bucket.add_task(db, "work", {b"n": b"1"})
+        task = await bucket.claim_one(db)
+        assert task is not None and task.type == "work"
+        # Claimed: nothing else claimable.
+        assert await bucket.claim_one(db) is None
+        # Finish inside a transaction: effects + completion atomic.
+        t = db.create_transaction()
+        while True:
+            try:
+                t.set(b"tb/done", b"1")
+                await bucket.finish(t, task)
+                await t.commit()
+                break
+            except Exception as e:  # noqa: BLE001
+                await t.on_error(e)
+        assert await read_key(db, b"tb/done") == b"1"
+        assert await bucket.is_empty(db)
+
+        # Crash path: claim then DIE (never finish); the deadline passes
+        # (version time flows with commits) and another agent reclaims.
+        await bucket.add_task(db, "work", {b"n": b"2"})
+        dead = await bucket.claim_one(db)
+        assert dead is not None
+        for i in range(40):     # burn ~4s of version time
+            await commit_kv(db, b"tb/burn", b"%d" % i)
+            await delay(0.12)
+        re = await bucket.claim_one(db)
+        assert re is not None and re.uid == dead.uid
+        # The dead agent's late finish must FAIL (reclaimed ownership).
+        t = db.create_transaction()
+        failed = False
+        try:
+            t.set(b"tb/dead", b"oops")
+            await bucket.finish(t, dead)
+            await t.commit()
+        except Exception:  # noqa: BLE001
+            failed = True
+        assert failed
+        assert await read_key(db, b"tb/dead") is None
+        # The reclaimer finishes cleanly.
+        t = db.create_transaction()
+        while True:
+            try:
+                await bucket.finish(t, re)
+                await t.commit()
+                break
+            except Exception as e:  # noqa: BLE001
+                await t.on_error(e)
+        assert await bucket.is_empty(db)
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=300)
+
+
+def test_taskbucket_two_agents_split_work(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+    bucket = TaskBucket()
+    done = []
+
+    async def handler(db_, bucket_, task):
+        t = db_.create_transaction()
+        while True:
+            try:
+                t.set(b"tb/out/" + task.params[b"k"], task.params[b"k"])
+                await bucket_.finish(t, task)
+                await t.commit()
+                done.append(task.params[b"k"])
+                return
+            except Exception as e:  # noqa: BLE001
+                await t.on_error(e)
+
+    async def go():
+        for i in range(12):
+            await bucket.add_task(db, "emit", {b"k": b"%03d" % i})
+        stop = {"n": False}
+        for a in range(2):
+            c.loop.spawn(run_tasks(db, bucket, {"emit": handler},
+                                   agent_id=f"a{a}",
+                                   stop=lambda: stop["n"]),
+                         f"agent{a}")
+        for _ in range(300):
+            if len(done) >= 12 and await bucket.is_empty(db):
+                break
+            await delay(0.1)
+        stop["n"] = True
+        assert sorted(done) == [b"%03d" % i for i in range(12)]
+        # Exactly once each.
+        assert len(done) == 12
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=300)
+
+
+def test_dr_to_second_cluster_and_switchover(teardown):  # noqa: F811
+    from foundationdb_tpu.client.dr_agent import DatabaseBackupAgent
+    src = make_cluster()
+    dst = SimFdbCluster(config=DatabaseConfiguration(), n_workers=4,
+                        n_storage_workers=2, sim=src.sim, loop=src.loop,
+                        name_prefix="drb.")
+    src_db = src.database()
+    dst_db = dst.database()
+
+    async def go():
+        for i in range(15):
+            await commit_kv(src_db, b"dr/%03d" % i, b"v%03d" % i)
+        agent = DatabaseBackupAgent(src, src_db, dst_db)
+        await agent.submit()
+        # Writes AFTER submit stream across continuously.
+        for i in range(15, 25):
+            await commit_kv(src_db, b"dr/%03d" % i, b"v%03d" % i)
+        await commit_kv(src_db, b"dr/003", b"updated")
+        t = src_db.create_transaction()
+        t.atomic_op(__import__(
+            "foundationdb_tpu.txn.types", fromlist=["MutationType"]
+        ).MutationType.AddValue, b"dr/ctr", (7).to_bytes(8, "little"))
+        while True:
+            try:
+                await t.commit()
+                break
+            except Exception as e:  # noqa: BLE001
+                await t.on_error(e)
+        await agent.drain()
+        for i in range(25):
+            want = b"updated" if i == 3 else b"v%03d" % i
+            assert await read_key(dst_db, b"dr/%03d" % i) == want, i
+        assert (await read_key(dst_db, b"dr/ctr"))[:1] == b"\x07"
+        # Drained switchover: the target is an exact copy and accepts
+        # its own writes afterwards.
+        await agent.switchover()
+        await commit_kv(dst_db, b"dr/post", b"target-live")
+        assert await read_key(dst_db, b"dr/post") == b"target-live"
+        return True
+
+    assert src.run_until(src.loop.spawn(go()), timeout=600)

@@ -1,0 +1,92 @@
+"""Thread offload (core/threadpool.py — reference flow/IThreadPool.h).
+
+Real mode: blocking work leaves the reactor thread, the reactor keeps
+dispatching timers while it runs, and the self-pipe wakes a selector-parked
+loop.  Sim mode: inline execution + timer delivery keeps determinism.
+"""
+
+import threading
+import time
+
+from foundationdb_tpu.core.scheduler import EventLoop, delay, set_event_loop
+from foundationdb_tpu.core.threadpool import pool_for, run_blocking
+
+
+def teardown_function(_fn):
+    set_event_loop(None)
+
+
+def test_real_mode_runs_off_reactor_and_loop_stays_live():
+    loop = EventLoop(sim=False)
+    set_event_loop(loop)
+    reactor_thread = threading.current_thread()
+    ticks = []
+
+    async def ticker():
+        for _ in range(10):
+            await delay(0.01)
+            ticks.append(time.monotonic())
+
+    def blocking():
+        time.sleep(0.12)
+        return threading.current_thread()
+
+    async def main():
+        t = loop.spawn(ticker(), "ticker")
+        worker = await run_blocking(blocking)
+        assert worker is not reactor_thread
+        await t
+        return True
+
+    assert loop.run_until(loop.spawn(main(), "main"), timeout=10)
+    # The ticker kept firing DURING the 120ms block: its ticks span the
+    # blocking window instead of bunching after it.
+    assert len(ticks) == 10
+    spread = ticks[-1] - ticks[0]
+    assert spread >= 0.08, f"timers stalled while blocking ran ({spread:.3f}s)"
+    pool_for(loop).close()
+
+
+def test_real_mode_propagates_exceptions():
+    loop = EventLoop(sim=False)
+    set_event_loop(loop)
+
+    def boom():
+        raise ValueError("worker failed")
+
+    async def main():
+        try:
+            await run_blocking(boom)
+        except ValueError as e:
+            return str(e)
+        return None
+
+    assert loop.run_until(loop.spawn(main(), "main"),
+                          timeout=10) == "worker failed"
+    pool_for(loop).close()
+
+
+def test_sim_mode_is_deterministic_inline():
+    loop = EventLoop(sim=True)
+    set_event_loop(loop)
+    order = []
+
+    def work(tag):
+        order.append(("ran", tag))
+        return tag
+
+    async def main():
+        a = await run_blocking(work, "a", sim_cost=0.5)
+        order.append(("got", a, loop.now()))
+        b = await run_blocking(work, "b")
+        order.append(("got", b, loop.now()))
+        return True
+
+    assert loop.run_until(loop.spawn(main(), "main"), timeout=30)
+    # Inline execution order is the call order; sim_cost charges virtual
+    # time; no OS threads are involved.
+    assert order[0] == ("ran", "a")
+    assert order[1][0:2] == ("got", "a") and order[1][2] >= 0.5
+    assert order[2] == ("ran", "b")
+    # Sim mode must never create OS threads.
+    assert pool_for(loop)._executor is None
