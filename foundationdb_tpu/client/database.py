@@ -176,24 +176,83 @@ class Database:
         self._replica_latency[k] = 0.8 * prev + 0.2 * dt
 
     async def read_replica(self, ssis, stream_of, make_request):
-        """One storage read with REPLICA FAILOVER (reference
+        """One storage read with REPLICA FAILOVER and HEDGING (reference
         LoadBalance.actor.h): replicas are tried fastest-first; transport
         failures move to the next replica instead of surfacing, so a dead
-        replica costs latency, not a client error.  Non-transport errors
-        (wrong_shard_server, future_version, ...) raise through."""
+        replica costs latency, not a client error.  When the preferred
+        replica is SLOW (no reply within the hedge delay) the request is
+        duplicated to the next replica and the first answer wins — a
+        degraded-but-alive replica costs the hedge delay, not its full
+        stall (reference secondRequestPool duplicate requests).
+        Non-transport errors (wrong_shard_server, future_version, ...)
+        raise through."""
+        from ..core.futures import swallow, wait_any
+        from ..core.knobs import client_knobs
+        from ..core.scheduler import delay as _delay
         from ..core.scheduler import now as _now
+        hedge_s = float(client_knobs().HEDGE_REQUEST_DELAY)
+        ordered = self._order_replicas(list(ssis))
         last: Optional[BaseException] = None
-        for ssi in self._order_replicas(list(ssis)):
+        i = 0
+        while i < len(ordered):
+            ssi = ordered[i]
             t0 = _now()
+            f = RequestStream.at(
+                stream_of(ssi).endpoint).get_reply(make_request())
+            hedge = None
+            hedge_ssi = None
+            hedge_t0 = 0.0
+            demoted = False
+            if i + 1 < len(ordered):
+                # The losing hedge timer stays in the scheduler heap
+                # until it fires: one (float, lambda) tuple living
+                # hedge_s — a few hundred entries even at 10k reads/s,
+                # not worth a cancellable-timer mechanism.
+                idx, _ = await wait_any([swallow(f), _delay(hedge_s)])
+                if idx == 1 and not f.is_ready():
+                    hedge_ssi = ordered[i + 1]
+                    hedge_t0 = _now()
+                    hedge = RequestStream.at(
+                        stream_of(hedge_ssi).endpoint).get_reply(
+                        make_request())
+                    await wait_any([swallow(f), swallow(hedge)])
+                    if hedge.is_ready() and not f.is_ready():
+                        # Hedge won: demote the laggard so later reads
+                        # prefer the responsive replica.  Its own latency
+                        # is measured from ITS send, not t0 — charging
+                        # the hedge delay to the winner would misorder
+                        # it below genuinely slower replicas.
+                        self._note_latency(ssi, 1.0)
+                        demoted = True
+                        if not hedge.is_error():
+                            self._note_latency(hedge_ssi,
+                                               _now() - hedge_t0)
+                            return hedge.get()
+                        # Hedge errored: fall through and await `f`.
             try:
-                reply = await RequestStream.at(
-                    stream_of(ssi).endpoint).get_reply(make_request())
+                reply = await f
                 self._note_latency(ssi, _now() - t0)
                 return reply
             except FdbError as e:
                 if e.name in self._FAILOVER_ERRORS:
-                    self._note_latency(ssi, 1.0)   # demote; decays back
+                    if not demoted:
+                        self._note_latency(ssi, 1.0)  # demote; decays back
                     last = e
+                    # The hedge may still deliver: harvest it before
+                    # moving on (it targeted the NEXT replica).
+                    if hedge is not None:
+                        try:
+                            reply = await hedge
+                            self._note_latency(hedge_ssi,
+                                               _now() - hedge_t0)
+                            return reply
+                        except FdbError as e2:
+                            if e2.name not in self._FAILOVER_ERRORS:
+                                raise
+                            self._note_latency(hedge_ssi, 1.0)
+                            last = e2
+                            i += 1      # both tried: skip the hedged one
+                    i += 1
                     continue
                 raise
         raise last or err("wrong_shard_server", "no replica answered")

@@ -178,6 +178,10 @@ class ClientKnobs(KnobBase):
         self.TRANSACTION_SIZE_LIMIT = 1 << 24
         self.KEY_SIZE_LIMIT = 10000
         self.VALUE_SIZE_LIMIT = 100000
+        # Duplicate a storage read to the next replica when the preferred
+        # one hasn't answered within this delay (reference LoadBalance
+        # second-request hedging).
+        self.HEDGE_REQUEST_DELAY = 0.075
 
 
 class Knobs:
