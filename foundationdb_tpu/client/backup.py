@@ -373,6 +373,166 @@ class FileBackupAgent:
         return self.end_version
 
 
+RESTORE_RANGES = 4
+
+
+async def _restore_snapshot_task(db, bucket, task) -> None:
+    """Fast-restore loader/applier for one snapshot PART (reference
+    fdbserver/RestoreLoader + RestoreApplier roles): parts are disjoint
+    key sets, so any number of agents apply them concurrently."""
+    url = task.params[b"url"].decode()
+    part = int(task.params[b"part"])
+    container = open_container(url)
+    pf = container.fs.open(f"{container.name}.snap.part{part}",
+                           create=False)
+    r = Reader(await pf.read(0, pf.size()))
+    r.i64()
+    kvs = [(r.bytes_(), r.bytes_()) for _ in range(r.u32())]
+    for i in range(0, max(len(kvs), 1), 500):
+        t = db.create_transaction()
+        last = i + 500 >= len(kvs)
+        while True:
+            try:
+                # Ownership guard: a reclaimed task's zombie must not
+                # re-commit stale snapshot values over phase-2 replay.
+                await bucket.check_owned(t, task)
+                for k, v in kvs[i:i + 500]:
+                    t.set(k, v)
+                if last:
+                    await bucket.finish(t, task)
+                await t.commit()
+                break
+            except FdbError as e:
+                await t.on_error(e)
+
+
+async def _restore_logrange_task(db, bucket, task) -> None:
+    """Fast-restore applier for one KEY RANGE of the log stream: each
+    range's mutations are applied in version order, and disjoint ranges
+    commute — so ranges parallelize across agents exactly like the
+    reference's per-applier key partitions.  Progress markers make each
+    version-batch exactly-once under retries."""
+    url = task.params[b"url"].decode()
+    begin = task.params[b"begin"]
+    end = task.params[b"end"]
+    snap_v = int(task.params[b"snap_v"])
+    end_v = int(task.params[b"end_v"])
+    container = open_container(url)
+    progress_key = (b"\xff/restoreProgress/" + container.name.encode() +
+                    b"/" + begin)
+
+    def clip(m):
+        if m.type == MutationType.ClearRange:
+            b = max(m.param1, begin)
+            e = min(m.param2, end)
+            if b >= e:
+                return None
+            return Mutation(MutationType.ClearRange, b, e)
+        if begin <= m.param1 < end:
+            return m
+        return None
+
+    for idx, (version, muts) in enumerate(await container.read_log()):
+        if not snap_v < version <= end_v:
+            continue
+        clipped = [c for c in (clip(m) for m in muts) if c is not None]
+        if not clipped:
+            continue
+        marker = b"%020d" % idx
+        t = db.create_transaction()
+        t.access_system_keys = True
+        while True:
+            try:
+                # Ownership guard per batch: without it a zombie whose
+                # task was reclaimed (and whose progress marker the
+                # reclaimer's finish cleared) would re-apply atomic ops
+                # a second time.
+                await bucket.check_owned(t, task)
+                seen = await t.get(progress_key)
+                if seen is not None and seen >= marker:
+                    break
+                t.set(progress_key, marker)
+                for m in clipped:
+                    if m.type == MutationType.SetValue:
+                        t.set(m.param1, m.param2)
+                    elif m.type == MutationType.ClearRange:
+                        t.clear(m.param1, m.param2)
+                    else:
+                        t.atomic_op(m.type, m.param1, m.param2)
+                await t.commit()
+                break
+            except FdbError as e:
+                await t.on_error(e)
+        if idx % 8 == 0:
+            # Heartbeat so a long replay outlives the claim timeout
+            # instead of churning through reclaims.
+            if not await bucket.extend(db, task):
+                raise err("operation_failed", "task reclaimed")
+    t = db.create_transaction()
+    t.access_system_keys = True
+    while True:
+        try:
+            t.clear(progress_key)
+            await bucket.finish(t, task)
+            await t.commit()
+            return
+        except FdbError as e:
+            await t.on_error(e)
+
+
+RESTORE_TASK_HANDLERS = {
+    "restore_snapshot_part": _restore_snapshot_task,
+    "restore_log_range": _restore_logrange_task,
+}
+
+
+async def restore_distributed(cluster, db, fs, name: str = "backup",
+                              n_agents: int = 3) -> None:
+    """Fast restore (reference fdbserver/RestoreLoader/RestoreApplier/
+    RestoreController roles): the restore is decomposed into TaskBucket
+    tasks — one per snapshot part, one per log KEY RANGE — executed by a
+    fleet of agents; any agent may die and another resumes its task.
+    Phases are sequenced by the controller here: snapshot parts must all
+    land before log ranges replay on top."""
+    from .taskbucket import TaskBucket, run_tasks
+    set_sim_blob_store(fs)
+    url = f"sim://{name}"
+    container = BackupContainer(fs, name)
+    _start, snap_v, end_v = await container.read_meta()
+    bucket = TaskBucket(prefix=b"\xff/taskBucket/restore/")
+
+    # Phase 1: snapshot parts in parallel.
+    df = container.fs.open(f"{container.name}.snap.done", create=False)
+    n_parts = Reader(await df.read(0, 4)).u32()
+    for part in range(n_parts):
+        await bucket.add_task(db, "restore_snapshot_part", {
+            b"url": url.encode(), b"part": b"%d" % part})
+    stop = {"flag": False}
+    agents = [cluster.loop.spawn(
+        run_tasks(db, bucket, RESTORE_TASK_HANDLERS,
+                  agent_id=f"restore{i}", stop=lambda: stop["flag"]),
+        f"restoreAgent{i}") for i in range(n_agents)]
+    while not await bucket.is_empty(db):
+        await delay(0.1)
+
+    # Phase 2: log replay, partitioned by key range.
+    bounds = [b""] + [bytes([(256 * i) // RESTORE_RANGES])
+                      for i in range(1, RESTORE_RANGES)] + [b"\xff"]
+    for i in range(RESTORE_RANGES):
+        await bucket.add_task(db, "restore_log_range", {
+            b"url": url.encode(), b"begin": bounds[i],
+            b"end": bounds[i + 1], b"snap_v": b"%d" % snap_v,
+            b"end_v": b"%d" % end_v})
+    while not await bucket.is_empty(db):
+        await delay(0.1)
+    stop["flag"] = True
+    for a in agents:
+        if not a.is_ready():
+            a.cancel()
+    TraceEvent("FastRestoreComplete").detail("Parts", n_parts).detail(
+        "Ranges", RESTORE_RANGES).log()
+
+
 async def restore(db, fs, name: str = "backup") -> int:
     """Restore a container into an (empty) cluster: snapshot state, then
     log replay for versions after the snapshot (reference FileBackupAgent

@@ -228,7 +228,18 @@ class Database:
                             self._note_latency(hedge_ssi,
                                                _now() - hedge_t0)
                             return hedge.get()
-                        # Hedge errored: fall through and await `f`.
+                        # Hedge errored while the preferred replica is
+                        # STILL silent: move on to the replicas beyond
+                        # both rather than waiting out the stall (the
+                        # abandoned read is idempotent).
+                        e2 = hedge.error
+                        if getattr(e2, "name", "") in \
+                                self._FAILOVER_ERRORS:
+                            self._note_latency(hedge_ssi, 1.0)
+                            last = e2
+                            i += 2
+                            continue
+                        raise e2
             try:
                 reply = await f
                 self._note_latency(ssi, _now() - t0)

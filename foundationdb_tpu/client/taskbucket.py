@@ -128,6 +128,18 @@ class TaskBucket:
             except FdbError as e:
                 await t.on_error(e)
 
+    async def check_owned(self, tr, task: Task) -> None:
+        """Assert ownership INSIDE a work transaction: reads the run
+        entry (adding a read-conflict range), so if the task was
+        reclaimed — before or concurrently — this transaction aborts
+        instead of applying a zombie's effects.  Every non-idempotent
+        batch a long task commits must call this (reference TaskBucket
+        verifyTask)."""
+        tr.access_system_keys = True
+        if await tr.get(self._run(task.deadline, task.uid)) is None:
+            raise err("operation_failed",
+                      "task reclaimed by another agent")
+
     async def finish(self, tr, task: Task) -> None:
         """Remove a claimed task INSIDE the caller's transaction: commit
         the task's final effects and its completion atomically (the

@@ -313,25 +313,47 @@ class DataDistributor:
             except FdbError as e:
                 await t.on_error(e)
 
-    def _ordered_candidates(self, kept: List[Tag], team) -> List[Tag]:
-        """Replacement candidates, ZONE-DIVERSE first (reference
-        ReplicationPolicy PolicyAcross zoneid): greedy selection — each
-        pick's zone counts as occupied for the NEXT pick, so two
-        replacements cannot both land in one fresh zone (a static sort
-        would rank them equally and break the one-zone-loss invariant)."""
+    def _policy(self):
+        """The team placement policy this configuration means (reference
+        DatabaseConfiguration::setDefaultReplicationPolicy -> the
+        ReplicationPolicy DSL in server/policy.py)."""
+        from .policy import policy_from_config
+        return policy_from_config(self.replication)
+
+    def _candidate(self, t: Tag):
         from .interfaces import zone_of
+        iface = self.storage.get(t)
+        return (t, {"zoneid": zone_of(iface)} if iface is not None else {})
 
-        def _zone(t):
-            return zone_of(self.storage[t]) if t in self.storage else None
-
-        zones = {_zone(t) for t in kept}
+    def _ordered_candidates(self, kept: List[Tag], team) -> List[Tag]:
+        """Replacement candidates ranked by the replication POLICY
+        (server/policy.py PolicyAcross(zoneid)): each pick is scored by
+        whether kept+pick still heads toward a policy-valid team, and
+        its zone counts as occupied for the NEXT pick, so two
+        replacements cannot both land in one fresh zone."""
+        from .policy import PolicyAcross
+        policy = self._policy()
+        kept_c = [self._candidate(t) for t in kept]
         pool = set(self.healthy) - set(team) - self.excluded
         out: List[Tag] = []
+
+        def diversity(cand) -> int:
+            """Distinct placement groups under the configured policy's
+            attribute — maximizing it is exactly what PolicyAcross
+            validate() needs, and unlike a binary validate() check it
+            still prefers FRESH zones when the survivors already
+            violate diversity (the partial-credit case)."""
+            if isinstance(policy, PolicyAcross):
+                return len({c[1].get(policy.attr) or f"__u{c[0]}"
+                            for c in cand})
+            return sum(1 for c in cand)      # One/custom: count
+
         while pool:
-            pick = min(pool, key=lambda t: (_zone(t) in zones, t))
+            pick = min(pool, key=lambda t: (
+                -diversity(kept_c + [self._candidate(t)]), t))
             out.append(pick)
             pool.discard(pick)
-            zones.add(_zone(pick))
+            kept_c.append(self._candidate(pick))
         return out
 
     # -- re-replication (reference teamTracker unhealthy path) ---------------

@@ -199,3 +199,65 @@ def test_snapshot_tasks_resume_after_agent_death(teardown):  # noqa: F811
         return True
 
     assert src.run_until(src.loop.spawn(go()), timeout=600)
+
+
+def test_fast_restore_distributed_agents(teardown):  # noqa: F811
+    """Fast restore (reference RestoreLoader/RestoreApplier): snapshot
+    parts and per-key-range log replay fan out over a TaskBucket agent
+    fleet; killing an agent mid-restore just reassigns its tasks."""
+    from foundationdb_tpu.client.backup import restore_distributed
+    from foundationdb_tpu.core.scheduler import delay
+    src = SimFdbCluster(config=DatabaseConfiguration(), n_workers=5,
+                        n_storage_workers=2)
+    db = src.database()
+    backup_fs = SimFileSystem()
+
+    async def run_backup():
+        for i in range(50):
+            t = db.create_transaction()
+            while True:
+                try:
+                    for j in range(25):
+                        t.set(b"fr/%03d/%02d" % (i, j), b"v%d.%d" % (i, j))
+                    await t.commit()
+                    break
+                except FdbError as e:
+                    await t.on_error(e)
+        agent = FileBackupAgent(src, db, backup_fs)
+        await agent.submit()
+        # Post-snapshot writes ride the log: overwrites, clears, atomics.
+        await commit_kv(db, b"fr/000/00", b"overwritten")
+        t = db.create_transaction()
+        while True:
+            try:
+                t.clear(b"fr/001/", b"fr/002/")
+                t.atomic_op(MutationType.AddValue, b"fr/acc",
+                            (9).to_bytes(8, "little"))
+                await t.commit()
+                break
+            except FdbError as e:
+                await t.on_error(e)
+        await agent.stop()
+        return await read_all(db)
+
+    expected = src.run_until(src.loop.spawn(run_backup()), timeout=600)
+    assert expected[b"fr/000/00"] == b"overwritten"
+    assert b"fr/001/00" not in expected
+
+    from foundationdb_tpu.core import DeterministicRandom, \
+        set_deterministic_random
+    set_deterministic_random(DeterministicRandom(79))
+    dst = SimFdbCluster(config=DatabaseConfiguration(), n_workers=5,
+                        n_storage_workers=2)
+    db2 = dst.database()
+
+    async def run_restore():
+        f = dst.loop.spawn(
+            restore_distributed(dst, db2, backup_fs, n_agents=3),
+            "fastRestore")
+        await f
+        return await read_all(db2)
+
+    restored = dst.run_until(dst.loop.spawn(run_restore()), timeout=600)
+    assert restored == expected, (
+        f"fast-restore divergence: {len(restored)} vs {len(expected)}")

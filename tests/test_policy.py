@@ -1,0 +1,60 @@
+"""Replication policy DSL (reference flow/ReplicationPolicy.h):
+PolicyOne / PolicyAcross / PolicyAnd select and validate teams over
+locality attributes; three_data_hall composes them."""
+
+from foundationdb_tpu.server.policy import (PolicyAcross, PolicyAnd,
+                                            PolicyOne, policy_from_config,
+                                            three_data_hall)
+
+
+def c(i, **loc):
+    return (i, loc)
+
+
+def test_across_selects_distinct_zones():
+    p = PolicyAcross(2, "zoneid")
+    cands = [c(0, zoneid="a"), c(1, zoneid="a"), c(2, zoneid="b")]
+    team = p.select(cands)
+    assert team is not None and len(team) == 2
+    assert {t[1]["zoneid"] for t in team} == {"a", "b"}
+    assert p.validate(team)
+    assert not p.validate([c(0, zoneid="a"), c(1, zoneid="a")])
+    # Impossible: only one zone available.
+    assert p.select([c(0, zoneid="a"), c(1, zoneid="a")]) is None
+
+
+def test_missing_locality_counts_unique():
+    p = PolicyAcross(2, "zoneid")
+    team = p.select([c(0), c(1)])
+    assert team is not None and len(team) == 2
+
+
+def test_three_data_hall():
+    p = three_data_hall()
+    assert p.n_required() == 6
+    cands = [c(f"{h}{z}{i}", data_hall=h, zoneid=f"{h}{z}")
+             for h in "ABC" for z in "12" for i in range(2)]
+    team = p.select(cands)
+    assert team is not None and len(team) == 6
+    assert p.validate(team)
+    halls = {m[1]["data_hall"] for m in team}
+    assert halls == {"A", "B", "C"}
+    # Losing a whole hall invalidates.
+    assert not p.validate([m for m in team if m[1]["data_hall"] != "A"])
+
+
+def test_policy_and():
+    p = PolicyAnd(PolicyAcross(2, "zoneid"), PolicyAcross(2, "dcid"))
+    cands = [c(0, zoneid="z1", dcid="d1"), c(1, zoneid="z2", dcid="d1"),
+             c(2, zoneid="z3", dcid="d2")]
+    team = p.select(cands)
+    assert team is not None and p.validate(team)
+    dcs = {m[1]["dcid"] for m in team}
+    assert len(dcs) == 2
+
+
+def test_policy_from_config():
+    assert policy_from_config(1).name() == "One"
+    p = policy_from_config(3)
+    assert p.n_required() == 3
+    assert "Across(3,zoneid" in p.name()
