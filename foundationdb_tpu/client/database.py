@@ -229,17 +229,21 @@ class Database:
                                                _now() - hedge_t0)
                             return hedge.get()
                         # Hedge errored while the preferred replica is
-                        # STILL silent: move on to the replicas beyond
-                        # both rather than waiting out the stall (the
-                        # abandoned read is idempotent).
+                        # STILL silent: if replicas remain beyond both,
+                        # move on rather than waiting out the stall (the
+                        # abandoned read is idempotent); with nothing
+                        # left, the slow-but-alive replica is still the
+                        # best bet — fall through and await it.
                         e2 = hedge.error
-                        if getattr(e2, "name", "") in \
+                        if getattr(e2, "name", "") not in \
                                 self._FAILOVER_ERRORS:
-                            self._note_latency(hedge_ssi, 1.0)
-                            last = e2
+                            raise e2
+                        self._note_latency(hedge_ssi, 1.0)
+                        last = e2
+                        if i + 2 < len(ordered):
                             i += 2
                             continue
-                        raise e2
+                        hedge = None       # spent; await f below
             try:
                 reply = await f
                 self._note_latency(ssi, _now() - t0)
@@ -788,7 +792,8 @@ def _coalesce(ranges: List[Tuple[bytes, bytes]]
     return out
 
 
-def open_cluster(cluster_spec: str, ip: str = "127.0.0.1"):
+def open_cluster(cluster_spec: str, ip: str = "127.0.0.1",
+                 tls: Optional[dict] = None):
     """Real-mode client bootstrap (reference fdb_c fdb_setup_network +
     cluster-file open): installs a real-IO EventLoop and RealNetwork in
     this process and returns (loop, Database) connected to the
@@ -805,7 +810,7 @@ def open_cluster(cluster_spec: str, ip: str = "127.0.0.1"):
     set_event_loop(loop)
     import os
     set_deterministic_random(DeterministicRandom(os.getpid() & 0x7FFFFFFF))
-    net = RealNetwork(loop, ip, 0)
+    net = RealNetwork(loop, ip, 0, tls=tls)
     set_network(net)
     coords = [CoordinationClientInterface.at_address(a)
               for a in parse_coordinators(cluster_spec)]

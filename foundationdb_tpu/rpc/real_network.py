@@ -52,7 +52,13 @@ _MAX_FRAME = 64 << 20
 
 
 class _Conn:
-    """One peer connection (either direction) on the reactor."""
+    """One peer connection (either direction) on the reactor.
+
+    With TLS configured on the network (reference flow/TLSConfig +
+    mutual-auth transport), every byte between the sockets passes through
+    an ssl.MemoryBIO pair driven from the same reactor callbacks: the
+    framing/handshake logic above the pump is unchanged — it reads and
+    writes PLAINTEXT buffers, and _tls_pump() shuttles ciphertext."""
 
     def __init__(self, net: "RealNetwork", sock: socket.socket,
                  peer_key: Optional[Tuple[str, int]], outbound: bool,
@@ -66,6 +72,16 @@ class _Conn:
         self._out = bytearray()
         self._hs_done = False
         self._writer_on = False
+        self._ssl = None
+        self._plain_out = bytearray()
+        if net.tls_enabled:
+            import ssl as _ssl
+            self._tls_in = _ssl.MemoryBIO()
+            self._tls_out = _ssl.MemoryBIO()
+            ctx = net._client_ctx if outbound else net._server_ctx
+            self._ssl = ctx.wrap_bio(self._tls_in, self._tls_out,
+                                     server_side=not outbound)
+            self._tls_hs_done = False
         # Non-blocking dial in progress: frames buffer into _out; the
         # writer callback fires on connect completion (or SO_ERROR).
         self._connecting = connecting
@@ -75,9 +91,13 @@ class _Conn:
         except OSError:
             pass
         if outbound:
-            self._out += _HS.pack(MAGIC, PROTOCOL_VERSION)
+            self._queue_out(_HS.pack(MAGIC, PROTOCOL_VERSION))
             if not connecting:
                 self._flush()
+        elif self._ssl is not None:
+            # Server side: kick the TLS handshake state machine so the
+            # ServerHello flows as soon as the ClientHello arrives.
+            self._tls_pump()
         if connecting:
             self._writer_on = True
             self.net.loop.add_writer(self.sock, self._on_connect_complete)
@@ -87,6 +107,57 @@ class _Conn:
             self.net.loop.call_at(self.net.loop.now() + 5.0,
                                   self._on_connect_deadline)
         self.net.loop.add_reader(self.sock, self._on_readable)
+
+    # -- TLS pump (MemoryBIO shuttle) -----------------------------------------
+    def _queue_out(self, data: bytes) -> None:
+        """Queue PLAINTEXT application bytes for the peer."""
+        if self._ssl is None:
+            self._out += data
+        else:
+            self._plain_out += data
+            self._tls_pump()
+
+    def _tls_pump(self) -> None:
+        """Drive the TLS state machine: handshake, encrypt queued
+        plaintext, decrypt received ciphertext, move ciphertext toward
+        the socket.  Safe to call at any point; WantRead/WantWrite just
+        mean 'need more bytes from the wire'."""
+        import ssl as _ssl
+        if self.closed or self._ssl is None:
+            return
+        try:
+            if not self._tls_hs_done:
+                try:
+                    self._ssl.do_handshake()
+                    self._tls_hs_done = True
+                except _ssl.SSLWantReadError:
+                    pass
+            if self._tls_hs_done:
+                while self._plain_out:
+                    try:
+                        n = self._ssl.write(bytes(self._plain_out[:1 << 16]))
+                    except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError):
+                        break
+                    del self._plain_out[:n]
+                while True:
+                    try:
+                        data = self._ssl.read(1 << 16)
+                    except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError):
+                        break
+                    except _ssl.SSLZeroReturnError:
+                        self.close()
+                        return
+                    if not data:
+                        break
+                    self._in += data
+        except _ssl.SSLError as e:
+            TraceEvent("TLSError", Severity.Warn).detail(
+                "Peer", f"{self.peer_key}").detail("Error", str(e)).log()
+            self.close()
+            return
+        pending = self._tls_out.read()
+        if pending:
+            self._out += pending
 
     # -- non-blocking connect completion --------------------------------------
     def _on_connect_complete(self) -> None:
@@ -118,7 +189,7 @@ class _Conn:
     def send_frame(self, kind: int, body: bytes) -> None:
         if self.closed:
             return
-        self._out += _LEN.pack(1 + len(body)) + bytes([kind]) + body
+        self._queue_out(_LEN.pack(1 + len(body)) + bytes([kind]) + body)
         self._flush()
 
     def _flush(self) -> None:
@@ -155,14 +226,20 @@ class _Conn:
                 if not chunk:
                     self.close()
                     return
-                self._in += chunk
-                if len(self._in) < (1 << 18):
+                if self._ssl is not None:
+                    self._tls_in.write(chunk)
+                else:
+                    self._in += chunk
+                if len(chunk) < (1 << 18):
                     break
         except BlockingIOError:
             pass
         except OSError:
             self.close()
             return
+        if self._ssl is not None:
+            self._tls_pump()
+            self._flush()      # handshake replies / encrypted app bytes
         self._drain_frames()
 
     def _drain_frames(self) -> None:
@@ -178,7 +255,7 @@ class _Conn:
             del self._in[:_HS.size]
             self._hs_done = True
             if not self.outbound:
-                self._out += _HS.pack(MAGIC, PROTOCOL_VERSION)
+                self._queue_out(_HS.pack(MAGIC, PROTOCOL_VERSION))
                 self._flush()
         while True:
             if len(self._in) < 4:
@@ -225,8 +302,27 @@ class RealNetwork:
     """Token-addressed RPC over real TCP; same surface as SimNetwork."""
 
     def __init__(self, loop: EventLoop, listen_ip: str = "127.0.0.1",
-                 listen_port: int = 0) -> None:
+                 listen_port: int = 0,
+                 tls: Optional[dict] = None) -> None:
+        """`tls`: {"cert": path, "key": path, "ca": path} enables mutual
+        TLS on every connection (reference flow/TLSConfig: one
+        certificate pair per process, peers verified against the CA;
+        plaintext peers cannot join a TLS cluster and vice versa)."""
         self.loop = loop
+        self.tls_enabled = tls is not None
+        if tls is not None:
+            import ssl as _ssl
+            sctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            sctx.load_cert_chain(tls["cert"], tls["key"])
+            sctx.load_verify_locations(tls["ca"])
+            sctx.verify_mode = _ssl.CERT_REQUIRED
+            cctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+            cctx.load_cert_chain(tls["cert"], tls["key"])
+            cctx.load_verify_locations(tls["ca"])
+            cctx.check_hostname = False
+            cctx.verify_mode = _ssl.CERT_REQUIRED
+            self._server_ctx = sctx
+            self._client_ctx = cctx
         self._endpoints: Dict[Endpoint, Tuple[RequestStream, int]] = {}
         self._conns: Dict[Tuple[str, int], _Conn] = {}
         self._all_conns: List[_Conn] = []

@@ -321,9 +321,16 @@ class DataDistributor:
         return policy_from_config(self.replication)
 
     def _candidate(self, t: Tag):
-        from .interfaces import zone_of
+        """(tag, full locality dict) — the Candidate shape the policy DSL
+        expects; carrying every attribute keeps non-zoneid policies
+        (data_hall, dcid) meaningful."""
         iface = self.storage.get(t)
-        return (t, {"zoneid": zone_of(iface)} if iface is not None else {})
+        loc = getattr(iface, "locality", None) if iface is not None else None
+        if not loc:
+            return (t, {})
+        dcid, zoneid, machineid = (tuple(loc) + ("", "", ""))[:3]
+        return (t, {"dcid": dcid, "zoneid": zoneid or machineid,
+                    "machineid": machineid})
 
     def _ordered_candidates(self, kept: List[Tag], team) -> List[Tag]:
         """Replacement candidates ranked by the replication POLICY

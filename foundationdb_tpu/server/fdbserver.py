@@ -75,7 +75,8 @@ async def _cc_runner(process, cc, leader_var, my_change_id) -> None:
 def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
           process_class: str = "stateless", config=None,
           ip: str = "127.0.0.1", name: str = "", seed: int = 0,
-          force_coordination: bool = False) -> None:
+          force_coordination: bool = False,
+          tls: Optional[dict] = None) -> None:
     """Boot this process and serve forever."""
     from .cluster_controller import ClusterController
     from .worker import Worker
@@ -121,7 +122,7 @@ def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
     set_deterministic_random(DeterministicRandom(
         seed or ((os.getpid() << 16) ^ (_time.time_ns() & 0x7FFFFFFF)
                  ) & 0x7FFFFFFF))
-    net = RealNetwork(loop, ip, port)
+    net = RealNetwork(loop, ip, port, tls=tls)
     set_network(net)
     fs = RealFileSystem(datadir)
     proc = RealProcess(loop, net, name=name or f"fdbserver:{port}",
@@ -222,7 +223,14 @@ def main(argv=None) -> None:
     ap.add_argument("--coordination", action="store_true",
                     help="serve generation registers even if this address "
                          "is not in the spec (changeQuorum target)")
+    ap.add_argument("--tls-cert", default=None)
+    ap.add_argument("--tls-key", default=None)
+    ap.add_argument("--tls-ca", default=None)
     args = ap.parse_args(argv)
+    tls = None
+    if args.tls_cert:
+        tls = {"cert": args.tls_cert, "key": args.tls_key or args.tls_cert,
+               "ca": args.tls_ca or args.tls_cert}
     # "coordinator" class == a stateless worker that also serves
     # coordination if its address is in the coordinator list.
     pclass = ("stateless" if args.process_class == "coordinator"
@@ -230,7 +238,7 @@ def main(argv=None) -> None:
     serve(args.port, parse_coordinators(args.coordinators), args.datadir,
           process_class=pclass, config=build_config(args.config),
           ip=args.ip, name=args.name,
-          force_coordination=args.coordination)
+          force_coordination=args.coordination, tls=tls)
 
 
 if __name__ == "__main__":

@@ -151,6 +151,22 @@ class TLog:
             "Records", len(records)).log()
         return t
 
+    async def write_genesis(self) -> None:
+        """Durably record this generation's STARTING version as an empty
+        commit record.  An epoch that never commits (idle, or killed
+        right after a configuration/quorum change) otherwise leaves an
+        empty WAL, and a whole-cluster restart re-instantiates it with
+        end_version 0 — the next recovery's min(end_version) then rolls
+        the cluster's version BELOW the storage servers' applied state
+        (observed: post-quorum-migration restart wedged with
+        RecoveryVersion 0 against storage at ~1.5M)."""
+        if self.disk_queue is None or self.version.get() <= 0:
+            return
+        blob = _pack_commit(self.version.get(), self.version.get(),
+                            self.known_committed_version, {}, {})
+        self.disk_queue.push(blob)
+        await self.disk_queue.commit()
+
     # -- generation handoff --------------------------------------------------
     async def recover_from(self, recover_tags: Dict[Tag, object],
                            recover_popped: Dict[Tag, Version],

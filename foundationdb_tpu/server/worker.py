@@ -196,6 +196,9 @@ class Worker:
         if req.recover_tags:
             await tlog.recover_from(req.recover_tags, req.recover_popped,
                                     req.recovery_version)
+        # Durable starting-version floor BEFORE acking recruitment: an
+        # idle generation must never restart as end_version 0.
+        await tlog.write_genesis()
         if getattr(req, "feeder_routers", None):
             # REMOTE TLog: fed asynchronously from the log routers with
             # this log's twin tags (server/log_router.py topology).

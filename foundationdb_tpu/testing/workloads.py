@@ -522,3 +522,93 @@ class WatchesWorkload(TestWorkload):
     async def check(self) -> bool:
         return self.metrics.get("watches_fired", 0) == int(
             self.config.get("watchCount", 8))
+
+
+@register_workload
+class KillRegionWorkload(TestWorkload):
+    """Region failover chaos (reference workloads/KillRegion.actor.cpp):
+    provisions a remote dc mid-run, waits for the async plane to
+    converge to a marker commit (the drained switchover point), kills
+    the ENTIRE primary dc, and verifies the cluster recovers onto the
+    remote replicas with every acked commit intact.
+
+    check() leaves the cluster serving from the remote dc — pair with
+    Cycle/ConsistencyCheck workloads whose checks then run post-failover."""
+
+    name = "KillRegion"
+
+    async def setup(self) -> None:
+        c = self.cluster
+        self._remote_dc = str(self.config.get("remoteDc", "dcR"))
+        n_storage = int(self.config.get("remoteStorage", 2))
+        for i in range(n_storage):
+            c.add_worker("storage", name=f"krw{i}", dcid=self._remote_dc)
+        c.add_worker("stateless", name="krwcc", dcid=self._remote_dc,
+                     campaign=True)
+        from ..client.management import change_configuration
+        await change_configuration(self.db, usable_regions=2,
+                                   remote_dc=self._remote_dc)
+
+    async def start(self) -> None:
+        from ..core.error import FdbError
+        c = self.cluster
+        # Wait for the remote plane.
+        for _ in range(int(self.config.get("planeTimeout", 120) / 0.5)):
+            cc = c.current_cc()
+            info = cc.db_info if cc is not None else None
+            if info is not None and getattr(info, "remote_tlogs", None) \
+                    and getattr(info, "remote_storage", None):
+                break
+            await delay(0.5)
+        else:
+            raise AssertionError("remote plane never recruited")
+        # Drained switchover point: a marker commit fully replicated.
+        t = self.db.create_transaction()
+        v = None
+        while v is None:
+            try:
+                t.set(b"killregion/marker", b"1")
+                v = await t.commit()
+            except FdbError as e:
+                await t.on_error(e)
+        for _ in range(int(self.config.get("drainTimeout", 240) / 0.5)):
+            cc = c.current_cc()
+            info = cc.db_info if cc is not None else None
+            roles = [getattr(i, "role", None)
+                     for i in (info.remote_storage.values()
+                               if info is not None else ())]
+            if roles and all(r is not None and r.version.get() >= v
+                             for r in roles):
+                break
+            await delay(0.5)
+        else:
+            raise AssertionError("remote replicas never converged")
+        # KillRegion: the whole primary dc dies at once.
+        primary_dc = str(self.config.get("primaryDc", "dc0"))
+        killed = 0
+        for p, _w, _cc, _lv in list(c.workers):
+            if p.alive and p.locality.dcid == primary_dc:
+                c.sim.kill_process(p)
+                killed += 1
+        self.metrics["killed"] = killed
+
+    async def check(self) -> bool:
+        from ..core.error import FdbError
+        t = self.db.create_transaction()
+        while True:
+            try:
+                ok = (await t.get(b"killregion/marker")) == b"1"
+                break
+            except FdbError as e:
+                await t.on_error(e)
+        cc = self.cluster.current_cc()
+        self.metrics["post_failover_epoch"] = (
+            cc.db_info.epoch if cc is not None else -1)
+        # The serving storage set is the adopted twin replicas (non-empty:
+        # an all() over an empty dict must not vacuously pass).
+        adopted = (cc is not None and
+                   len(cc.db_info.storage_servers) > 0 and
+                   all(tag >= 1_000_000
+                       for tag in cc.db_info.storage_servers))
+        self.metrics["adopted_remote"] = float(adopted)
+        return ok and adopted

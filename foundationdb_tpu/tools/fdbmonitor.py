@@ -1,0 +1,202 @@
+"""fdbmonitor: the process supervisor (reference fdbmonitor/fdbmonitor.cpp).
+
+Reads a foundationdb.conf-style INI, spawns one fdbserver OS process per
+[fdbserver.<port>] section, restarts crashed children with exponential
+backoff (reset after a stable run), reloads the conf on SIGHUP or when
+its mtime changes (starting added sections, stopping removed ones), and
+tears everything down on SIGTERM/SIGINT — the piece that makes a real
+deployment self-healing at the process level.
+
+Conf format (a practical subset of the reference's):
+
+    [general]
+    cluster-file = /var/fdb/fdb.cluster   ; seeds --coordinators
+    restart-delay = 1                     ; seconds, doubles per crash
+    restart-backoff-max = 30
+
+    [fdbserver]                            ; defaults for all servers
+    class = stateless
+    datadir = /var/fdb/data/$PORT          ; $PORT substituted
+
+    [fdbserver.4500]
+    class = storage
+    coordination = true                    ; pass --coordination
+
+Run: python -m foundationdb_tpu.tools.fdbmonitor --conf foundationdb.conf
+"""
+
+from __future__ import annotations
+
+import configparser
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+
+class _Child:
+    def __init__(self, port: int, cmd: list) -> None:
+        self.port = port
+        self.cmd = cmd
+        self.proc: Optional[subprocess.Popen] = None
+        self.backoff = 0.0
+        self.next_start = 0.0
+        self.started_at = 0.0
+        self.restarts = 0
+
+
+class FdbMonitor:
+    def __init__(self, conf_path: str, log=print) -> None:
+        self.conf_path = conf_path
+        self.log = log
+        self.children: Dict[int, _Child] = {}
+        self.restart_delay = 1.0
+        self.backoff_max = 30.0
+        self.cluster_file = ""
+        self._conf_mtime = 0.0
+        self._stop = False
+
+    # -- conf ---------------------------------------------------------------
+    def _build_cmd(self, port: int, section: dict) -> list:
+        datadir = section.get("datadir", f"./data/{port}")
+        datadir = datadir.replace("$PORT", str(port))
+        coordinators = section.get("coordinators", "")
+        if not coordinators and self.cluster_file and \
+                os.path.exists(self.cluster_file):
+            with open(self.cluster_file) as f:
+                coordinators = f.read().strip()
+        cmd = [sys.executable, "-m", "foundationdb_tpu.server.fdbserver",
+               "--port", str(port),
+               "--coordinators", coordinators or f"127.0.0.1:{port}",
+               "--datadir", datadir,
+               "--class", section.get("class", "stateless"),
+               "--name", section.get("name", f"fdbserver.{port}")]
+        if section.get("config"):
+            cmd += ["--config", section["config"]]
+        if section.get("coordination", "").lower() in ("1", "true", "on"):
+            cmd.append("--coordination")
+        return cmd
+
+    def load_conf(self) -> None:
+        cp = configparser.ConfigParser(inline_comment_prefixes=(";", "#"))
+        cp.read(self.conf_path)
+        self._conf_mtime = os.path.getmtime(self.conf_path)
+        general = dict(cp["general"]) if "general" in cp else {}
+        self.restart_delay = float(general.get("restart-delay", 1.0))
+        self.backoff_max = float(general.get("restart-backoff-max", 30.0))
+        self.cluster_file = general.get("cluster-file", "")
+        defaults = dict(cp["fdbserver"]) if "fdbserver" in cp else {}
+        wanted: Dict[int, dict] = {}
+        for section in cp.sections():
+            if not section.startswith("fdbserver."):
+                continue
+            port = int(section.split(".", 1)[1])
+            merged = dict(defaults)
+            merged.update(dict(cp[section]))
+            wanted[port] = merged
+        # Stop removed children; (re)configure the rest.
+        for port in list(self.children):
+            if port not in wanted:
+                self.log(f"fdbmonitor: section removed, stopping {port}")
+                self._stop_child(self.children.pop(port))
+        for port, section in wanted.items():
+            cmd = self._build_cmd(port, section)
+            cur = self.children.get(port)
+            if cur is None:
+                self.children[port] = _Child(port, cmd)
+            elif cur.cmd != cmd:
+                self.log(f"fdbmonitor: conf changed, restarting {port}")
+                self._stop_child(cur)
+                self.children[port] = _Child(port, cmd)
+
+    # -- children -----------------------------------------------------------
+    def _start_child(self, c: _Child) -> None:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        c.proc = subprocess.Popen(
+            c.cmd, env=env, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        c.started_at = time.monotonic()
+        self.log(f"fdbmonitor: started fdbserver.{c.port} "
+                 f"pid={c.proc.pid} (restart #{c.restarts})")
+
+    def _stop_child(self, c: _Child) -> None:
+        if c.proc is not None and c.proc.poll() is None:
+            c.proc.terminate()
+            try:
+                c.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                c.proc.kill()
+                c.proc.wait()
+        c.proc = None
+
+    def poll_once(self) -> None:
+        """One supervision pass: reap, backoff, (re)start, conf reload."""
+        try:
+            if os.path.getmtime(self.conf_path) != self._conf_mtime:
+                self.log("fdbmonitor: conf changed on disk, reloading")
+                self.load_conf()
+        except OSError:
+            pass
+        except Exception as e:  # noqa: BLE001 — a malformed conf edit
+            # must never kill the supervisor (children would be orphaned);
+            # keep running the LAST good configuration.
+            self.log(f"fdbmonitor: conf reload failed, keeping previous: "
+                     f"{e!r}")
+            try:
+                self._conf_mtime = os.path.getmtime(self.conf_path)
+            except OSError:
+                pass
+        now = time.monotonic()
+        for c in self.children.values():
+            if c.proc is not None:
+                rc = c.proc.poll()
+                if rc is None:
+                    # Stable for a while: forgive past crashes.
+                    if c.backoff and now - c.started_at > 10.0:
+                        c.backoff = 0.0
+                    continue
+                self.log(f"fdbmonitor: fdbserver.{c.port} exited rc={rc}")
+                c.proc = None
+                c.restarts += 1
+                c.backoff = min(max(c.backoff * 2, self.restart_delay),
+                                self.backoff_max)
+                c.next_start = now + c.backoff
+            if c.proc is None and now >= c.next_start:
+                self._start_child(c)
+
+    def run(self) -> None:
+        self.load_conf()
+        signal.signal(signal.SIGTERM, self._on_term)
+        signal.signal(signal.SIGINT, self._on_term)
+        try:
+            signal.signal(signal.SIGHUP, self._on_hup)
+        except (AttributeError, ValueError):
+            pass
+        while not self._stop:
+            self.poll_once()
+            time.sleep(0.25)
+        for c in self.children.values():
+            self._stop_child(c)
+
+    def _on_term(self, _sig, _frm) -> None:
+        self.log("fdbmonitor: shutting down")
+        self._stop = True
+
+    def _on_hup(self, _sig, _frm) -> None:
+        self.log("fdbmonitor: SIGHUP, reloading conf")
+        self.load_conf()
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(prog="fdbmonitor")
+    ap.add_argument("--conf", required=True)
+    args = ap.parse_args(argv)
+    FdbMonitor(args.conf).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""fdbmonitor (reference fdbmonitor/fdbmonitor.cpp): supervises real
+fdbserver OS processes — crash restart with backoff, conf reload adding
+and removing sections, clean teardown."""
+
+import os
+import signal
+import time
+
+from foundationdb_tpu.tools.fdbmonitor import FdbMonitor
+
+
+def _write_conf(path, ports, datadir_base, extra=""):
+    sections = "\n".join(
+        f"[fdbserver.{p}]\ndatadir = {datadir_base}/{p}\n" for p in ports)
+    with open(path, "w") as f:
+        f.write(f"""
+[general]
+restart-delay = 0.2
+restart-backoff-max = 2
+
+[fdbserver]
+class = stateless
+coordinators = 127.0.0.1:{ports[0]}
+{extra}
+{sections}
+""")
+
+
+def test_monitor_restarts_crashed_child_and_reloads_conf(tmp_path):
+    conf = str(tmp_path / "foundationdb.conf")
+    _write_conf(conf, [47820, 47821], str(tmp_path))
+    logs = []
+    mon = FdbMonitor(conf, log=logs.append)
+    mon.load_conf()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            mon.poll_once()
+            if all(c.proc is not None and c.proc.poll() is None
+                   for c in mon.children.values()):
+                break
+            time.sleep(0.1)
+        assert set(mon.children) == {47820, 47821}
+        assert all(c.proc is not None for c in mon.children.values())
+
+        # Crash one child: the monitor restarts it (with backoff).
+        victim = mon.children[47821]
+        pid1 = victim.proc.pid
+        os.kill(pid1, signal.SIGKILL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            mon.poll_once()
+            p = mon.children[47821].proc
+            if p is not None and p.poll() is None and p.pid != pid1:
+                break
+            time.sleep(0.1)
+        p = mon.children[47821].proc
+        assert p is not None and p.pid != pid1 and p.poll() is None
+        assert mon.children[47821].restarts == 1
+
+        # Conf reload: drop one section, add another.
+        _write_conf(conf, [47820, 47822], str(tmp_path))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            mon.poll_once()
+            if set(mon.children) == {47820, 47822} and \
+                    mon.children[47822].proc is not None:
+                break
+            time.sleep(0.1)
+        assert set(mon.children) == {47820, 47822}
+        assert mon.children[47822].proc.poll() is None
+    finally:
+        for c in mon.children.values():
+            mon._stop_child(c)

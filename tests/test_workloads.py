@@ -118,3 +118,21 @@ def test_movekeys_cycle_spec(teardown):
 def test_watches_spec(teardown):
     m = _run_spec("WatchesTest.toml")
     assert m["Watches"]["watches_fired"] == 8
+
+
+def test_kill_region_spec(teardown):
+    """Region failover under a live cycle workload: the ring invariant
+    holds on the adopted remote replicas after the primary dc dies."""
+    c = SimFdbCluster(config=DatabaseConfiguration(),
+                      n_workers=5, n_storage_workers=2)
+    spec = load_spec(os.path.join(SPECS, "KillRegionTest.toml"))
+
+    async def go():
+        metrics = await run_test(c, spec)
+        assert metrics["Cycle"]["swaps"] > 0
+        assert metrics["KillRegion"]["killed"] >= 4
+        assert metrics["KillRegion"]["adopted_remote"] == 1.0
+        return metrics
+
+    metrics = c.run_until(c.loop.spawn(go()), timeout=1200)
+    print("metrics:", metrics)
