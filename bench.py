@@ -242,6 +242,22 @@ def child_main(backend: str) -> None:
         return
 
     def make_cs():
+        if os.environ.get("BENCH_BACKEND") == "sharded":
+            # BASELINE config 5 axis: the REAL resolve step sharded over
+            # every attached device ("kr" mesh); per-shard capacity makes
+            # the window size a device-count multiplier.  On one chip
+            # this measures shard_map overhead; on a pod slice it is the
+            # 1M-in-flight-ranges configuration.
+            import jax
+            from foundationdb_tpu.parallel.sharded_resolver import (
+                ShardedTpuConflictSet, make_conflict_mesh)
+            mesh = make_conflict_mesh(jax.devices())
+            n_kr = int(mesh.shape["kr"])   # power of two by construction
+            _phase(f"sharded backend: {n_kr} 'kr' shard(s) over "
+                   f"{len(jax.devices())} device(s)")
+            return ShardedTpuConflictSet(
+                mesh, 0, capacity=CAPACITY // n_kr,
+                delta_capacity=DELTA_CAPACITY // n_kr)
         return TpuConflictSet(0, capacity=CAPACITY,
                               delta_capacity=DELTA_CAPACITY)
 
