@@ -526,8 +526,13 @@ def parent_main(backend: str) -> None:
 
 def main() -> None:
     backend = sys.argv[1] if len(sys.argv) > 1 else "tpu"
+    if backend == "sharded":
+        # Mesh-sharded resolver over every attached device (BASELINE
+        # config 5 axis); otherwise identical to the tpu run.
+        os.environ["BENCH_BACKEND"] = "sharded"
+        backend = "tpu"
     if backend not in ("tpu", "cpu"):
-        print(f"unknown backend {backend!r}: expected tpu|cpu",
+        print(f"unknown backend {backend!r}: expected tpu|cpu|sharded",
               file=sys.stderr)
         sys.exit(2)
     if os.environ.get("BENCH_CHILD") == "1":

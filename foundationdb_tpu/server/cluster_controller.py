@@ -191,16 +191,42 @@ class ClusterController:
             self._worker_arrived.append(p)
             await wait_any([p.get_future(), delay(0.25)])
 
+    def _primary_dc(self) -> str:
+        """The dc where the SERVING storage set lives (majority of
+        registered storage localities).  With regions configured, the
+        master — and through its pools, the whole transaction system —
+        must stay there: placing the master in the remote dc would make
+        the async replica plane share fate with the primary."""
+        from collections import Counter
+        counts: Counter = Counter()
+        for iface in (self.db_info.storage_servers or {}).values():
+            loc = getattr(iface, "locality", None)
+            if loc and loc[0]:
+                counts[loc[0]] += 1
+        if not counts:
+            for reg in self.workers.values():
+                if reg.process_class == "storage" and reg.locality[0]:
+                    counts[reg.locality[0]] += 1
+        if not counts:
+            return ""
+        # Deterministic on ties (registration order must not coin-flip
+        # master placement between symmetric dcs).
+        best = max(counts.items(), key=lambda kv: (kv[1], kv[0]))
+        return best[0]
+
     def _pick_master_worker(self) -> WorkerInterface:
         """Best-fitness worker for the master role (reference
-        clusterRecruitFromConfiguration placement fitness); deterministic
-        tiebreak by id."""
+        clusterRecruitFromConfiguration placement fitness), preferring
+        the primary-storage dc; deterministic tiebreak by id."""
         from .interfaces import FITNESS_NEVER, role_fitness
+        primary_dc = self._primary_dc()
         ranked = sorted(
             (reg for reg in self.workers.values()
              if role_fitness(reg.process_class, "master") < FITNESS_NEVER),
-            key=lambda reg: (role_fitness(reg.process_class, "master"),
-                             reg.worker.id))
+            key=lambda reg: (
+                bool(primary_dc) and reg.locality[0] != primary_dc,
+                role_fitness(reg.process_class, "master"),
+                reg.worker.id))
         if not ranked:
             return sorted(self.workers.items())[0][1].worker
         return ranked[0].worker

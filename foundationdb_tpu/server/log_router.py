@@ -180,12 +180,34 @@ class LogRouter:
         async for req in self.interface.pop.queue:
             self._pop(req)
 
+    async def _serve_lock(self) -> None:
+        """Retire this router (the in-epoch plane heal locks the OLD
+        plane before recruiting its replacement, so two generations
+        never pull the primary concurrently)."""
+        from .interfaces import TLogLockReply
+        async for req in self.interface.lock.queue:
+            self.halt()
+            req.reply.send(TLogLockReply(
+                end_version=max((nv.get() for nv in
+                                 self.frontier.values()), default=0),
+                known_committed_version=0,
+                tags=dict(self.popped)))
+
     def run(self, process) -> None:
         self._process = process
-        for s in (self.interface.peek, self.interface.pop):
+        for s in (self.interface.peek, self.interface.pop,
+                  self.interface.lock, self.interface.wait_failure):
             process.register(s)
         process.spawn(self._serve_peek(), f"{self.id}.servePeek")
         process.spawn(self._serve_pop(), f"{self.id}.servePop")
+        process.spawn(self._serve_lock(), f"{self.id}.serveLock")
+        # Held-forever failure signal (reference WaitFailure): the
+        # master's in-epoch region-plane watch parks on this; an
+        # unregistered stream would break its promise instantly and spin
+        # the heal loop.
+        from .failure import hold_wait_failure
+        process.spawn(hold_wait_failure(self.interface.wait_failure),
+                      f"{self.id}.waitFailure")
 
     def halt(self) -> None:
         self.stopped = True

@@ -1257,30 +1257,158 @@ async def master_server(master: Master, process, coordinators,
                         close()
             adopt(_failover_registry_migration(), "master.failoverRegistry")
 
+        region_plane_gen = {"n": 0}
+
+        async def _seed_region_replicas(fetches, gen: int) -> None:
+            """Seed freshly recruited remote replicas from their twins
+            with retries (the snapshot needs the source caught up past
+            min_version).  Aborts if the plane generation moves on — the
+            captured interfaces are stale then and the NEXT generation's
+            seeder owns the job."""
+            from ..core.futures import swallow as _sw
+            from ..core.scheduler import delay as _d
+            from .interfaces import FetchKeysRequest
+            done = 0
+            for iface, b, e2, src, mv in fetches:
+                while region_plane_gen["n"] == gen:
+                    f2 = RequestStream.at(
+                        iface.fetch_keys.endpoint).get_reply(
+                        FetchKeysRequest(begin=b, end=e2, sources=[src],
+                                         min_version=mv))
+                    await _sw(f2)
+                    if not f2.is_error():
+                        done += 1
+                        break
+                    await _d(1.0)
+            TraceEvent("RegionReplicasSeeded").detail(
+                "Ranges", done).detail("Gen", gen).log()
+
         if region_seed_fetches:
-            async def _seed_remote_replicas() -> None:
-                """Seed freshly recruited remote replicas from their twins
-                (deferred from _recruit_region: the snapshot needs the
-                source caught up to the new epoch).  Retries per range —
-                replication converges behind the serving cluster."""
+            adopt(_seed_region_replicas(region_seed_fetches, 0),
+                  "master.regionSeed")
+
+        if remote_tlogs:
+            async def _region_plane_watch() -> None:
+                """In-epoch remote-plane healing (reference: remote
+                recruitment retries inside TagPartitionedLogSystem while
+                the primary serves): a dead log router or remote TLog is
+                replaced WITHOUT ending the epoch.  Sequence per heal:
+                LOCK the old plane's survivors (no two generations may
+                pull the primary concurrently), recruit a fresh plane —
+                LIVE replicas are adopted, dead ones re-recruited and
+                seeded — re-publish db_info (workers re-target replicas
+                on the remote-set change), and refresh the cstate's
+                remote ids through a PRIVATE CoordinatedState so a later
+                failover locks the live set.  Correctness of the
+                rebuild-from-scratch log plane: primary twin-tag pops
+                are gated on REPLICA-applied versions, so fresh routers
+                re-serve every un-applied version from the primary's
+                retention."""
+                nonlocal log_routers, remote_tlogs, remote_storage, db_info
+                import dataclasses as _dc2
                 from ..core.futures import swallow as _sw
+                from ..core.futures import wait_all as _wall
+                from ..core.futures import wait_any as _wa
                 from ..core.scheduler import delay as _d
-                from .interfaces import FetchKeysRequest
-                done = 0
-                for iface, b, e, src, min_v in region_seed_fetches:
+                from .coordination import CoordinatedState as _CS
+                from .failure import wait_failure_of as _wf
+                from .interfaces import GetWorkersRequest
+                while True:
+                    watches = [spawn(_wf(x), "master.regionRoleWatch")
+                               for x in list(log_routers) +
+                               list(remote_tlogs)]
+                    if not watches:
+                        return
+                    try:
+                        await _wa(watches)
+                    finally:
+                        # Also reached via cancellation at epoch end:
+                        # bare-spawned watches outlive the children list.
+                        for w in watches:
+                            if not w.is_ready():
+                                w.cancel()
+                    TraceEvent("RegionPlaneFailed", Severity.Warn).detail(
+                        "Epoch", master.epoch).log()
+                    # Retire the old plane's SURVIVORS before any
+                    # replacement exists (frozen pop floors on a zombie
+                    # generation would otherwise leak router buffers and
+                    # duplicate primary peek traffic all epoch).
+                    lockfs = [RequestStream.at(x.lock.endpoint).get_reply(
+                        TLogLockRequest(epoch=master.epoch))
+                        for x in list(log_routers) + list(remote_tlogs)]
+                    await _wall([_sw(f) for f in lockfs])
                     while True:
-                        f = RequestStream.at(iface.fetch_keys.endpoint
-                                             ).get_reply(FetchKeysRequest(
-                            begin=b, end=e, sources=[src],
-                            min_version=min_v))
-                        await _sw(f)
-                        if not f.is_error():
-                            done += 1
+                        try:
+                            regs = await RequestStream.at(
+                                cc_interface.get_workers.endpoint
+                            ).get_reply(GetWorkersRequest())
+                            # Adoption is keyed on replicas that LIVE
+                            # workers currently report (the in-memory
+                            # remote_storage map can hold dead handles
+                            # when a replica shared its process with the
+                            # failed plane role): only worker-registered
+                            # twins survive; the rest re-recruit + seed.
+                            live_twins = {}
+                            for reg in regs:
+                                for tt, iface in \
+                                        reg.recovered_storage.items():
+                                    if tt in remote_storage:
+                                        live_twins[tt] = iface
+                            shim = _dc2.replace(
+                                prev if prev is not None else DBCoreState(
+                                    epoch=master.epoch,
+                                    recovery_version=0),
+                                remote_storage=dict(live_twins),
+                                remote_storage_ids={
+                                    t: getattr(i, "id", "")
+                                    for t, i in live_twins.items()})
+                            (lr, rt, rs, seeds) = await _recruit_region(
+                                master, process, regs, config, tlogs,
+                                storage_servers, key_servers_ranges,
+                                0, shim, dict(live_twins), {}, {})
+                            new_info = _dc2.replace(
+                                db_info, log_routers=lr,
+                                remote_tlogs=rt, remote_storage=rs)
+                            await RequestStream.at(
+                                cc_interface.master_registration.endpoint
+                            ).get_reply(MasterRegistrationRequest(
+                                epoch=master.epoch, db_info=new_info))
+                            # Private CoordinatedState: sharing the
+                            # recovery instance's generation with the
+                            # coordinators-watch would let interleaved
+                            # read()s commit under each other's gens.
+                            cs2 = _CS(coordinators)
+                            cur = DBCoreState.coerce(await cs2.read())
+                            if cur is None or cur.epoch != master.epoch:
+                                raise err("coordinated_state_conflict",
+                                          "superseded while healing")
+                            cur.remote_tlogs = rt
+                            cur.remote_tlog_ids = [t.id for t in rt]
+                            cur.remote_storage = dict(rs)
+                            cur.remote_storage_ids = {
+                                t: getattr(i, "id", "")
+                                for t, i in rs.items()}
+                            await cs2.write(cur.pack())
                             break
-                        await _d(1.0)
-                TraceEvent("RegionReplicasSeeded").detail(
-                    "Ranges", done).log()
-            adopt(_seed_remote_replicas(), "master.regionSeed")
+                        except FdbError as e:
+                            if e.name == "coordinated_state_conflict":
+                                raise      # superseded: stop healing
+                            TraceEvent("RegionReRecruitFailed",
+                                       Severity.Warn).detail(
+                                "Error", e.name).log()
+                            await _d(5.0)
+                    region_plane_gen["n"] += 1
+                    log_routers, remote_tlogs, remote_storage = lr, rt, rs
+                    db_info = new_info
+                    if seeds:
+                        adopt(_seed_region_replicas(
+                            seeds, region_plane_gen["n"]),
+                            "master.regionReseed")
+                    TraceEvent("RegionPlaneHealed").detail(
+                        "Epoch", master.epoch).detail(
+                        "Routers", len(lr)).log()
+            adopt(_region_plane_watch(), "master.regionPlaneWatch")
+
 
         # Steady state: serve until killed, or until any recruited
         # transaction-system role fails — either way the epoch ends and the

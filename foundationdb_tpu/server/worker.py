@@ -551,12 +551,25 @@ class Worker:
     # -- ServerDBInfo watch: re-target storage pull cursors ------------------
     async def _watch_db_info(self) -> None:
         known_epoch = -1
+        known_remote_ids = None
         while True:
             info: ServerDBInfo = self.db_info.get()
-            if (info.epoch != known_epoch and info.tlogs and
-                    info.recovery_state in ("accepting_commits",
-                                            "fully_recovered")):
+            remote_ids = tuple(getattr(t, "id", "")
+                               for t in (getattr(info, "remote_tlogs",
+                                                 None) or ()))
+            epoch_changed = (info.epoch != known_epoch and info.tlogs and
+                             info.recovery_state in ("accepting_commits",
+                                                     "fully_recovered"))
+            # The remote TLog set can also be REPLACED within an epoch
+            # (in-epoch remote-plane re-recruitment after a router/remote
+            # TLog death): remote replicas must re-target then too.
+            remote_changed = (not epoch_changed and
+                              known_epoch == info.epoch and
+                              remote_ids != known_remote_ids and
+                              remote_ids)
+            if epoch_changed or remote_changed:
                 known_epoch = info.epoch
+                known_remote_ids = remote_ids
                 ls = LogSystemClient(info.tlogs,
                                      replication=self._log_replication())
                 remote_ls = (LogSystemClient(info.remote_tlogs,
@@ -571,16 +584,18 @@ class Worker:
                             # TLogs — flip to an ordinary puller.
                             ss.remote = False
                         elif remote_ls is not None:
-                            # Re-target to the NEW epoch's remote TLog
-                            # set; with the remote plane gone they keep
-                            # their old cursor until one exists again.
+                            # Re-target to the NEW remote TLog set; with
+                            # the remote plane gone they keep their old
+                            # cursor until one exists again.
                             ss.set_log_system(remote_ls,
                                               info.recovery_version,
                                               info.epoch)
                             continue
                         else:
                             continue
-                    ss.set_log_system(ls, info.recovery_version, info.epoch)
+                    if epoch_changed:
+                        ss.set_log_system(ls, info.recovery_version,
+                                          info.epoch)
             await self.db_info.on_change()
 
     # -- CC registration + ServerDBInfo subscription -------------------------

@@ -153,3 +153,75 @@ def test_region_recruit_skipped_without_remote_workers(teardown):  # noqa: F811
     c.run_until(c.loop.spawn(go()), timeout=120)
     cc = c.current_cc()
     assert cc is not None and not cc.db_info.remote_tlogs
+
+
+def test_remote_plane_heals_in_epoch(teardown):  # noqa: F811
+    """In-epoch remote-plane healing: killing the process hosting the
+    remote TLog (and router) replaces the plane WITHOUT an epoch change;
+    replication converges again on the new plane."""
+    c = make_region_cluster()
+    db = c.database()
+
+    async def setup():
+        for i in range(8):
+            await commit_kv(db, b"hk%03d" % i, b"hv%03d" % i)
+        return True
+
+    c.run_until(c.loop.spawn(setup()), timeout=180)
+    add_remote_dc(c)
+
+    async def configure():
+        await change_configuration(db, usable_regions=2, remote_dc="dcR")
+        return True
+
+    c.run_until(c.loop.spawn(configure()), timeout=120)
+    info = c.run_until(c.loop.spawn(_wait_remote_plane(c)), timeout=120)
+    epoch_before = c.current_cc().db_info.epoch
+    old_rt_ids = [t.id for t in info.remote_tlogs]
+
+    # Kill every dcR worker hosting a remote TLog or router.
+    victims = set()
+    for t in list(info.remote_tlogs) + list(info.log_routers):
+        p = c.process_of(t)
+        if p is not None:
+            victims.add(p)
+    assert victims
+    for p in victims:
+        c.sim.kill_process(p)
+    # Replacement capacity in the remote dc.
+    c.add_worker("stateless", name="rheal0", dcid="dcR")
+
+    async def wait_healed():
+        from foundationdb_tpu.core.scheduler import delay
+        for _ in range(240):
+            cc = c.current_cc()
+            info2 = cc.db_info if cc is not None else None
+            if info2 is not None and info2.remote_tlogs and \
+                    [t.id for t in info2.remote_tlogs] != old_rt_ids:
+                return info2
+            await delay(0.5)
+        raise AssertionError("remote plane never healed")
+
+    info2 = c.run_until(c.loop.spawn(wait_healed()), timeout=300)
+    # Same epoch: healed WITHOUT a recovery.
+    assert c.current_cc().db_info.epoch == epoch_before
+    # Surviving replicas were ADOPTED (same live role objects), not
+    # wiped and re-recruited.
+    before_roles = {t: getattr(i, "role", None)
+                    for t, i in info.remote_storage.items()}
+    for t, i in info2.remote_storage.items():
+        assert getattr(i, "role", None) is before_roles.get(t), t
+
+    async def converges():
+        t = db.create_transaction()
+        v = None
+        while v is None:
+            try:
+                t.set(b"post-heal", b"1")
+                v = await t.commit()
+            except Exception as e:  # noqa: BLE001
+                await t.on_error(e)
+        await _wait_replicas_at(c.current_cc().db_info, v)
+        return True
+
+    assert c.run_until(c.loop.spawn(converges()), timeout=300)
