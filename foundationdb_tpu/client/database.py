@@ -144,6 +144,9 @@ class Database:
         # attempt is penalized so the replica sorts last until the
         # penalty decays and it proves itself again.
         self._replica_latency: dict = {}
+        # TSS comparison mismatches observed by this client (reference
+        # TSS metrics); tests assert on it.
+        self.tss_mismatches = 0
 
     from ..rpc.endpoint import TRANSPORT_ERRORS as _FAILOVER_ERRORS
 
@@ -174,6 +177,38 @@ class Database:
         k = self._replica_key(ssi)
         prev = self._replica_latency.get(k, dt)
         self._replica_latency[k] = 0.8 * prev + 0.2 * dt
+
+    def _tss_compare(self, pair, stream_of, make_request, reply) -> None:
+        """TSS comparison (reference fdbrpc/TSSComparison.h + LoadBalance
+        duplicate-to-TSS): mirror the read to the shadow OUT OF BAND and
+        trace any divergence — the client never waits on the shadow.
+        TSS_SAMPLE_RATE bounds the duplicate-read overhead."""
+        from ..core.knobs import client_knobs
+        from ..core.rng import deterministic_random
+        from ..core.scheduler import spawn as _spawn
+        rate = float(client_knobs().TSS_SAMPLE_RATE)
+        if rate < 1.0 and deterministic_random().random01() > rate:
+            return
+
+        async def compare() -> None:
+            from ..core.error import FdbError
+            from ..core.trace import Severity, TraceEvent
+            try:
+                shadow = await RequestStream.at(
+                    stream_of(pair).endpoint).get_reply(make_request())
+            except FdbError:
+                return          # shadow lag/death is not a mismatch
+            for attr in ("value", "data"):
+                a = getattr(reply, attr, None)
+                b = getattr(shadow, attr, None)
+                if a != b:
+                    self.tss_mismatches += 1
+                    TraceEvent("TSSMismatch", Severity.Error).detail(
+                        "Field", attr).detail(
+                        "Primary", repr(a)[:80]).detail(
+                        "Shadow", repr(b)[:80]).log()
+                    return
+        _spawn(compare(), "client.tssCompare")
 
     async def read_replica(self, ssis, stream_of, make_request):
         """One storage read with REPLICA FAILOVER and HEDGING (reference
@@ -247,6 +282,9 @@ class Database:
             try:
                 reply = await f
                 self._note_latency(ssi, _now() - t0)
+                pair = getattr(ssi, "tss_pair", None)
+                if pair is not None:
+                    self._tss_compare(pair, stream_of, make_request, reply)
                 return reply
             except FdbError as e:
                 if e.name in self._FAILOVER_ERRORS:

@@ -182,6 +182,9 @@ class ClientKnobs(KnobBase):
         # one hasn't answered within this delay (reference LoadBalance
         # second-request hedging).
         self.HEDGE_REQUEST_DELAY = 0.075
+        # Fraction of reads against a TSS-paired primary that are also
+        # mirrored to the shadow for comparison (1.0 = every read).
+        self.TSS_SAMPLE_RATE = 1.0
 
 
 class Knobs:

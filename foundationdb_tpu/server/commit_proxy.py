@@ -642,6 +642,15 @@ class CommitProxy:
                         # Cached range: the mutation also rides CACHE_TAG
                         # (reference CommitProxyServer.actor.cpp:959).
                         messages.setdefault(CACHE_TAG, []).append(m)
+        if getattr(self, "tss_mapping", None):
+            # TSS mirror tags (reference tssMapping routing): the shadow
+            # receives exactly its primary's stream.
+            from .interfaces import tss_tag as _tsst
+            tss_extra = {}
+            for tag, msgs in messages.items():
+                if tag in self.tss_mapping:
+                    tss_extra[_tsst(tag)] = msgs
+            messages.update(tss_extra)
         if getattr(self, "region_replication", False):
             # Mirror onto twin tags (region replication): the log routers
             # pull twins from the primary TLogs and feed the remote plane

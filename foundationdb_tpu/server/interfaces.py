@@ -37,6 +37,15 @@ REMOTE_TXS_TAG: Tag = 0xFFFFFFFC
 # StorageCache role stays fresh (reference cacheTag,
 # CommitProxyServer.actor.cpp:959 + fdbserver/StorageCache.actor.cpp).
 CACHE_TAG: Tag = 0xFFFFFFFB
+# TSS (testing storage server) mirror tags: shadow of primary tag t is
+# TSS_TAG_OFFSET + t; proxies route a mirrored copy of t's mutations
+# there (reference tssMapping in ProxyCommitData + fdbrpc/TSSComparison:
+# the shadow applies the same stream and client reads compare replies).
+TSS_TAG_OFFSET: Tag = 2_000_000
+
+
+def tss_tag(tag: Tag) -> Tag:
+    return TSS_TAG_OFFSET + tag
 
 
 def zone_of(iface) -> str:
@@ -170,12 +179,16 @@ class DatabaseConfiguration:
     # for committed \xff/cacheRanges/ hot ranges, kept fresh by CACHE_TAG
     # commit routing.
     n_storage_caches: int = 0
+    # TSS pairs (reference tss_count in DatabaseConfiguration): the first
+    # N storage tags get memory-only shadow servers fed by mirror tags;
+    # clients duplicate sampled reads to the shadow and trace mismatches.
+    tss_count: int = 0
 
     _INT_FIELDS = ("n_tlogs", "n_commit_proxies", "n_grv_proxies",
                    "n_resolvers", "n_storage", "log_replication",
                    "storage_replication", "min_workers",
                    "usable_regions", "n_log_routers", "n_remote_tlogs",
-                   "n_storage_caches")
+                   "n_storage_caches", "tss_count")
     _STR_FIELDS = ("conflict_backend", "storage_engine", "remote_dc")
 
     def with_conf(self, conf: Dict[str, Optional[bytes]]
@@ -664,6 +677,10 @@ class InitializeCommitProxyRequest:
     # StorageCache interfaces: cached-range mutations also ride CACHE_TAG
     # and location replies append these to the replica set.
     storage_caches: List[Any] = field(default_factory=list)
+    # TSS pairs: primary tag -> shadow interface; mutations of t are
+    # mirrored to tss_tag(t) and the primary's location entries carry
+    # the pair for client-side comparison.
+    tss_mapping: Dict[Tag, Any] = field(default_factory=dict)
     reply: Any = None     # -> CommitProxyInterface
 
 
@@ -784,6 +801,17 @@ class InitializeStorageRequest:
     # StorageCache recruitment: own NOTHING by default (ranges arrive via
     # the \xff/cacheRanges watch + fetch), skip the serverTag registry.
     cache_role: bool = False
+    # TSS shadow: memory-only, fed by its mirror tag, invisible to the
+    # DD registry and to boot-scan recovery.  `own_ranges` lists the
+    # shard spans valid for comparison from creation (cold boot: the
+    # paired tag's shards — both sides start empty); everything else
+    # stays ABSENT until a seed fetch owns it, so comparisons never see
+    # a shadow that simply lacks data (DD moves into the team land in
+    # absent ranges and are skipped, not flagged).
+    tss_role: bool = False
+    own_ranges: List[Tuple[bytes, bytes]] = field(default_factory=list)
+    # Recruiting epoch (tss shadows retire when a NEWER epoch appears).
+    epoch: int = 0
     reply: Any = None     # -> StorageServerInterface
 
 

@@ -1107,6 +1107,52 @@ async def master_server(master: Master, process, coordinators,
                 TraceEvent("BackupWorkerRecruitFailed",
                            Severity.Warn).detail("Error", e.name).log()
 
+        # TSS pairs (reference tss_count): memory-only shadows of the
+        # first N storage tags, fed by mirror tags and compared against
+        # on sampled client reads.  Non-fatal like caches/regions.
+        tss_mapping: Dict[Tag, Any] = {}
+        tss_seed_fetches: List[Any] = []
+        if config.tss_count >= 1:
+            from .interfaces import tss_tag as _tsst
+            from .log_router import is_remote_tag as _is_remote
+            try:
+                want = sorted(t for t in storage_servers
+                              if not _is_remote(t))[:config.tss_count]
+                tss_futures = {
+                    t: RequestStream.at(
+                        pick_storage(i + 1).init_storage.endpoint
+                    ).get_reply(InitializeStorageRequest(
+                        ss_id=f"tss{t}.e{master.epoch}", tag=_tsst(t),
+                        tss_role=True, epoch=master.epoch,
+                        # Cold boot: the paired tag's shards are
+                        # comparison-valid from creation (both sides
+                        # empty); mid-life everything stays absent until
+                        # the seed fetch owns it.
+                        own_ranges=([(b, e) for b, e, team in
+                                     key_servers_ranges if t in team]
+                                    if prev is None else [])))
+                    for i, t in enumerate(want)}
+                for t, f in tss_futures.items():
+                    tss_mapping[t] = await f
+                if prev is not None:
+                    # Mid-life pairing: seed each shadow from its
+                    # primary.  SEPARATE list from the region seeds: a
+                    # remote-plane heal aborts that generation's seeder,
+                    # but TSS targets are unaffected by plane changes.
+                    for t in want:
+                        for b, e, team in key_servers_ranges:
+                            if t in team:
+                                tss_seed_fetches.append(
+                                    (tss_mapping[t], b, e,
+                                     storage_servers[t],
+                                     recovery_version))
+                TraceEvent("TSSRecruited").detail(
+                    "Pairs", sorted(tss_mapping)).log()
+            except FdbError as e:
+                TraceEvent("TSSRecruitFailed", Severity.Warn).detail(
+                    "Error", e.name).log()
+                tss_mapping = {}
+
         # Second wave: ratekeeper + data distributor + proxies.
         from .interfaces import (InitializeDataDistributorRequest,
                                  InitializeRatekeeperRequest)
@@ -1136,7 +1182,8 @@ async def master_server(master: Master, process, coordinators,
                 recovery_version=recovery_version,
                 backup_active=prev.backup_active if prev else False,
                 region_replication=bool(remote_tlogs),
-                storage_caches=storage_caches))
+                storage_caches=storage_caches,
+                tss_mapping=tss_mapping))
             for i in range(config.n_commit_proxies)]
         grv_proxy_futures = [RequestStream.at(
             pick(i + 1).init_grv_proxy.endpoint).get_reply(
@@ -1259,18 +1306,20 @@ async def master_server(master: Master, process, coordinators,
 
         region_plane_gen = {"n": 0}
 
-        async def _seed_region_replicas(fetches, gen: int) -> None:
-            """Seed freshly recruited remote replicas from their twins
-            with retries (the snapshot needs the source caught up past
-            min_version).  Aborts if the plane generation moves on — the
+        async def _seed_region_replicas(fetches, gen) -> None:
+            """Seed freshly recruited remote replicas (or TSS shadows)
+            from their sources with retries (the snapshot needs the
+            source caught up past min_version).  With a generation, the
+            seeder aborts if the plane generation moves on — the
             captured interfaces are stale then and the NEXT generation's
-            seeder owns the job."""
+            seeder owns the job; gen=None never aborts (TSS targets
+            outlive plane heals)."""
             from ..core.futures import swallow as _sw
             from ..core.scheduler import delay as _d
             from .interfaces import FetchKeysRequest
             done = 0
             for iface, b, e2, src, mv in fetches:
-                while region_plane_gen["n"] == gen:
+                while gen is None or region_plane_gen["n"] == gen:
                     f2 = RequestStream.at(
                         iface.fetch_keys.endpoint).get_reply(
                         FetchKeysRequest(begin=b, end=e2, sources=[src],
@@ -1286,6 +1335,9 @@ async def master_server(master: Master, process, coordinators,
         if region_seed_fetches:
             adopt(_seed_region_replicas(region_seed_fetches, 0),
                   "master.regionSeed")
+        if tss_seed_fetches:
+            adopt(_seed_region_replicas(tss_seed_fetches, None),
+                  "master.tssSeed")
 
         if remote_tlogs:
             async def _region_plane_watch() -> None:

@@ -267,6 +267,16 @@ class Worker:
         proxy.backup_active = req.backup_active
         proxy.region_replication = getattr(req, "region_replication", False)
         proxy.storage_caches = list(getattr(req, "storage_caches", ()) or ())
+        tssm = dict(getattr(req, "tss_mapping", None) or {})
+        proxy.tss_mapping = tssm
+        # Pair (or un-pair) the primaries' interfaces so location replies
+        # carry the shadow for client-side comparison; clearing stale
+        # pairs matters when tss_count drops between epochs.
+        for t, iface in (req.storage_interfaces or {}).items():
+            try:
+                iface.tss_pair = tssm.get(t)
+            except Exception:  # noqa: BLE001 — frozen/odd iface objects
+                pass
         proxy.run(self.process)
         req.reply.send(proxy.interface)
 
@@ -339,6 +349,24 @@ class Worker:
             ls = LogSystemClient(info.tlogs,
                                  replication=self._log_replication()) \
                 if info.tlogs else None
+        if getattr(req, "tss_role", False):
+            # TSS shadow (reference TSS pairs): memory-only mirror fed by
+            # its tss_tag stream; never in the serverTag registry, never
+            # boot-scanned — a testing aid, not a durability participant.
+            # Comparison-valid ranges only: absent elsewhere, so a read
+            # of data the shadow never received errs (skipped by the
+            # comparer) instead of tracing a false mismatch.
+            ss = StorageServer(req.ss_id, req.tag, ls, engine=None)
+            ss.shards.set_range(b"", b"\xff\xff", ("absent", 0))
+            for b, e in getattr(req, "own_ranges", ()) or ():
+                ss.shards.set_range(b, e, ("owned", 0))
+            ss.tss = True
+            ss.tss_epoch = getattr(req, "epoch", 0)
+            ss.run(self.process)
+            self._stamp_locality(ss)
+            self.storage_roles.append(ss)
+            req.reply.send(ss.interface)
+            return
         if getattr(req, "cache_role", False):
             # StorageCache (reference StorageCache.actor.cpp:149): a
             # memory-only read replica of the committed \xff/cacheRanges/
@@ -575,7 +603,25 @@ class Worker:
                 remote_ls = (LogSystemClient(info.remote_tlogs,
                                              replication=1)
                              if getattr(info, "remote_tlogs", None) else None)
+                retired = []
                 for ss in self.storage_roles:
+                    if getattr(ss, "tss", False):
+                        if epoch_changed:
+                            if info.epoch > getattr(ss, "tss_epoch", 0):
+                                # Shadows are per-epoch: the new epoch
+                                # recruits a fresh (re-seeded) pair; a
+                                # stale one pulling the same mirror tag
+                                # would race pops and diverge.
+                                ss.halt()
+                                retired.append(ss)
+                            else:
+                                # Its OWN epoch's broadcast: first real
+                                # log-system target (recruitment ran
+                                # mid-recovery with a stale db_info).
+                                ss.set_log_system(ls,
+                                                  info.recovery_version,
+                                                  info.epoch)
+                        continue
                     if getattr(ss, "remote", False):
                         if ss.tag in info.storage_servers:
                             # A region failover ADOPTED this replica as a
@@ -596,6 +642,9 @@ class Worker:
                     if epoch_changed:
                         ss.set_log_system(ls, info.recovery_version,
                                           info.epoch)
+                for ss in retired:
+                    if ss in self.storage_roles:
+                        self.storage_roles.remove(ss)
             await self.db_info.on_change()
 
     # -- CC registration + ServerDBInfo subscription -------------------------
