@@ -186,6 +186,18 @@ def child_main(backend: str) -> None:
     global TXNS_PER_BATCH, N_BATCHES, N_LATENCY, CAPACITY, DELTA_CAPACITY
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         _force_cpu_backend()
+    try:
+        # Persistent XLA compile cache: the axon tunnel's remote compile
+        # costs minutes per program shape; a crashed/retried run should
+        # not pay it twice.
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_CACHE_DIR",
+                                         "/tmp/jax_bench_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception:  # noqa: BLE001 — older jax: cache is best-effort
+        pass
     if os.environ.get("BENCH_SMALL") == "1":
         # Degraded (XLA-CPU fallback) sizing.  The fused step is TUNED
         # FOR TPU (row-gather searchsorted, big fused sorts); XLA CPU
