@@ -84,7 +84,8 @@ class ClusterController:
                 req.worker, req.process_class,
                 req.recovered_logs, req.recovered_storage,
                 getattr(req, "storage_versions", {}) or {},
-                getattr(req, "locality", ("", "", "")) or ("", "", ""))
+                getattr(req, "locality", ("", "", "")) or ("", "", ""),
+                getattr(req, "machine_stats", {}) or {})
             arrived, self._worker_arrived = self._worker_arrived, []
             for p in arrived:
                 p.send(None)

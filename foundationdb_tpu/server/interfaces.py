@@ -570,6 +570,10 @@ class RegisterWorkerRequest:
     # recruitment places remote-plane roles by dcid (reference
     # RegisterWorkerRequest carries LocalityData).
     locality: tuple = ("", "", "")
+    # Machine/process stats snapshot (reference SystemMonitor's periodic
+    # ProcessMetrics): cpu seconds, RSS bytes, uptime — refreshed by the
+    # worker's periodic re-announce and surfaced in status JSON.
+    machine_stats: Dict[str, float] = field(default_factory=dict)
     reply: Any = None
 
 
@@ -583,6 +587,7 @@ class WorkerRegistration:
     recovered_storage: Dict[int, Any] = field(default_factory=dict)
     storage_versions: Dict[int, int] = field(default_factory=dict)
     locality: tuple = ("", "", "")
+    machine_stats: Dict[str, float] = field(default_factory=dict)
 
 
 # -- placement fitness (reference flow/ProcessClass machineClassFitness +

@@ -70,7 +70,20 @@ async def build_status(cc) -> Dict[str, Any]:
 
     processes = {}
     for wid, reg in sorted(cc.workers.items()):
-        processes[wid] = {"class_type": reg.process_class, "excluded": False}
+        entry = {"class_type": reg.process_class, "excluded": False}
+        loc = getattr(reg, "locality", ("", "", ""))
+        if loc and loc[0]:
+            entry["locality"] = {"dcid": loc[0], "zoneid": loc[1],
+                                 "machineid": loc[2]}
+        stats = getattr(reg, "machine_stats", None)
+        if stats:
+            # Reference status process sections: cpu/memory per process
+            # (SystemMonitor ProcessMetrics).
+            entry["cpu"] = {"usage_seconds": stats.get("cpu_seconds")}
+            entry["memory"] = {
+                "rss_bytes": stats.get("memory_rss_bytes")}
+            entry["uptime_seconds"] = stats.get("uptime_seconds")
+        processes[wid] = entry
 
     # Role latency/counter metrics via the sim-side interface backrefs
     # (reference: roles push TDMetrics / the status collector polls each

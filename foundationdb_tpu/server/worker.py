@@ -430,7 +430,54 @@ class Worker:
                 recovered_storage=dict(self.recovered_storage),
                 storage_versions=dict(self.storage_versions),
                 locality=((loc.dcid, loc.zoneid, loc.machineid)
-                          if loc is not None else ("", "", ""))))
+                          if loc is not None else ("", "", "")),
+                machine_stats=self._machine_stats()))
+
+    def _machine_stats(self) -> Dict[str, float]:
+        """Process metrics snapshot (reference flow/SystemMonitor.cpp
+        ProcessMetrics).  SIMULATION reports deterministic virtual-clock
+        stubs — real OS counters would make same-seed runs diverge."""
+        from ..core.scheduler import get_event_loop
+        loop = get_event_loop()
+        if loop.sim:
+            return {"cpu_seconds": 0.0, "memory_rss_bytes": 0.0,
+                    "uptime_seconds": round(loop.now(), 1)}
+        import os as _os
+        import sys as _sys
+        import time as _time
+        if not hasattr(self, "_started_mono"):
+            self._started_mono = _time.monotonic()
+        t = _os.times()
+        rss = 0.0
+        try:
+            with open("/proc/self/statm") as f:     # CURRENT rss (linux)
+                rss = float(f.read().split()[1]) * _os.sysconf("SC_PAGE_SIZE")
+        except OSError:
+            import resource
+            peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            rss = float(peak if _sys.platform == "darwin" else peak * 1024)
+        return {
+            "cpu_seconds": round(t.user + t.system, 3),
+            "memory_rss_bytes": rss,
+            "uptime_seconds": round(_time.monotonic() -
+                                    self._started_mono, 1),
+        }
+
+    async def _stats_announce_loop(self) -> None:
+        """REAL mode only: periodic re-announce keeps the CC's machine
+        stats fresh (role-change announces alone would leave them stale
+        for hours).  Re-sending the full registration is deliberate —
+        it is the single idempotent refresh path — and the payload is a
+        handful of interface references every 30s.  Simulation skips the
+        loop: stats there are deterministic stubs, and the churn would
+        be pure noise in ensembles."""
+        from ..core.scheduler import delay, get_event_loop
+        if get_event_loop().sim:
+            return
+        while True:
+            await delay(30.0)
+            if self._current_cc is not None:
+                self._announce_roles()
 
     async def _serve_wait_failure(self) -> None:
         """Hold requests forever; process death breaks their promises —
@@ -721,5 +768,6 @@ class Worker:
         p.spawn(self._serve_wait_failure(), f"{p.name}.waitFailure")
         p.spawn(self._watch_db_info(), f"{p.name}.watchDbInfo")
         p.spawn(self._knob_watch(), f"{p.name}.knobWatch")
+        p.spawn(self._stats_announce_loop(), f"{p.name}.statsAnnounce")
         p.spawn(self._register_loop(leader_var), f"{p.name}.register")
 

@@ -26,6 +26,9 @@ def test_status_json_and_management_special_keys(teardown):  # noqa: F811
         doc = json.loads(raw)
         assert doc["cluster"]["database_available"] is True
         assert doc["cluster"]["coordinators"]["quorum"]
+        # Process sections carry SystemMonitor-style machine stats.
+        procs = doc["cluster"]["processes"]
+        assert procs and any("cpu" in p for p in procs.values())
         # Management module mirrors the exclusion list.
         t2 = db.create_transaction()
         assert await t2.get(b"\xff\xff/management/excluded/2") is None
