@@ -51,6 +51,7 @@ ERROR_CODES = {
     "worker_removed": 1202,
     "coordinators_changed": 1203,
     "please_reboot": 1207,
+    "movekeys_conflict": 1208,
     "transaction_too_large": 2101,
     "key_too_large": 2102,
     "value_too_large": 2103,

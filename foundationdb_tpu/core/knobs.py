@@ -120,6 +120,16 @@ class ServerKnobs(KnobBase):
         # under the split threshold to avoid split/merge ping-pong).
         self.DD_SHARD_MERGE_BYTES = (1 << 20) // 4
 
+        # Perpetual storage wiggle (reference DataDistribution.actor.cpp
+        # storage wiggle / perpetual_storage_wiggle configuration): when
+        # non-zero, DD slowly cycles through storage servers, draining
+        # one at a time and letting it refill — rewriting every replica
+        # in place (the reference uses it for engine migrations and
+        # latent-disk-error scrubbing).  Dynamic: `setknob
+        # PERPETUAL_STORAGE_WIGGLE 1` turns it on cluster-wide.
+        self.PERPETUAL_STORAGE_WIGGLE = 0
+        self.STORAGE_WIGGLE_INTERVAL = 5.0
+
         # GRV / ratekeeper
         self.START_TRANSACTION_BATCH_INTERVAL_MIN = 1e-6
         self.START_TRANSACTION_BATCH_INTERVAL_MAX = 0.010

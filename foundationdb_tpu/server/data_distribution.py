@@ -131,6 +131,11 @@ class DataDistributor:
             self.map.set_boundary(b, list(team))
         self.healthy = set(self.storage)
         self.excluded: set = set()
+        # Tag currently being wiggled (perpetual storage wiggle): not a
+        # placement DESTINATION while draining, but — unlike exclusion —
+        # still healthy, still a fetch source, and re-admitted the moment
+        # its drain finishes.
+        self.wiggling: set = set()
         # Desired storage-server count (the configured pool size): lost
         # servers are REPLACED until the healthy pool is back at this
         # size, spare workers permitting.
@@ -152,7 +157,8 @@ class DataDistributor:
         # re-poll every cold pair each sweep (that would undo the poll
         # backoff's load reduction).
         self._shard_sizes: Dict[bytes, int] = {}
-        self.stats = {"splits": 0, "moves": 0, "rereplications": 0}
+        self.stats = {"splits": 0, "moves": 0, "rereplications": 0,
+                      "wiggles": 0}
 
     # -- metadata transactions ----------------------------------------------
     async def _commit_boundaries(self, sets) -> int:
@@ -187,6 +193,16 @@ class DataDistributor:
 
     async def _move_shard_locked(self, begin: bytes, end: bytes,
                                  new_team: List[Tag]) -> None:
+        # Callers compute (begin, end) BEFORE queueing on the relocation
+        # lock; a split/merge that committed while we waited makes them
+        # stale, and proceeding would phase-2 RemoveShardRequest a span
+        # the boundary map still assigns to the old team — replica loss.
+        # Re-validate under the lock (reference MoveKeys checks the
+        # keyServers boundaries inside its own transaction).
+        if self.map.shard_end(begin) != end:
+            from ..core.error import err
+            raise err("movekeys_conflict",
+                      f"shard at {begin!r} changed while move queued")
         old_team = list(self.map.lookup(begin) or [])
         union = old_team + [t for t in new_team if t not in old_team]
         self.moves_in_flight += 1
@@ -332,16 +348,23 @@ class DataDistributor:
         return (t, {"dcid": dcid, "zoneid": zoneid or machineid,
                     "machineid": machineid})
 
-    def _ordered_candidates(self, kept: List[Tag], team) -> List[Tag]:
+    def _ordered_candidates(self, kept: List[Tag], team,
+                            avoid=frozenset()) -> List[Tag]:
         """Replacement candidates ranked by the replication POLICY
         (server/policy.py PolicyAcross(zoneid)): each pick is scored by
         whether kept+pick still heads toward a policy-valid team, and
         its zone counts as occupied for the NEXT pick, so two
-        replacements cannot both land in one fresh zone."""
+        replacements cannot both land in one fresh zone.  `avoid` is the
+        WIGGLE's own exclusion only — emergency re-replication and
+        exclusion drains deliberately keep wiggling servers in the pool
+        (they are healthy; a replica landed mid-drain just gets picked
+        up by a later rotation) so background maintenance can never
+        force a short team."""
         from .policy import PolicyAcross
         policy = self._policy()
         kept_c = [self._candidate(t) for t in kept]
-        pool = set(self.healthy) - set(team) - self.excluded
+        pool = (set(self.healthy) - set(team) - self.excluded -
+                set(avoid))
         out: List[Tag] = []
 
         def diversity(cand) -> int:
@@ -410,6 +433,8 @@ class DataDistributor:
                                Severity.Warn).detail(
                         "Begin", begin).detail("Error", e.name).detail(
                         "Attempt", attempt).log()
+                    if e.name == "movekeys_conflict":
+                        break   # bounds stale; outer scan re-derives them
                     await delay(0.5 * (1 << attempt))
         await self._maybe_retire(dead_tag)
 
@@ -679,9 +704,105 @@ class DataDistributor:
                                    Severity.Warn).detail(
                             "Begin", begin).detail("Error", e.name).detail(
                             "Attempt", attempt).log()
+                        if e.name == "movekeys_conflict":
+                            break  # bounds stale; registry scan retries
                         await delay(0.5 * (1 << attempt))
         finally:
             self._draining = False
+
+    # -- perpetual storage wiggle (reference DataDistribution.actor.cpp
+    # storage wiggle / perpetualStorageWiggler: slowly rotate through the
+    # storage pool, draining one server at a time and letting it refill,
+    # so every replica is periodically rewritten in place) ------------------
+    async def _wiggle_pos(self) -> int:
+        from .system_data import STORAGE_WIGGLE_POS_KEY
+        t = self.db.create_transaction()
+        t.access_system_keys = True
+        while True:
+            try:
+                raw = await t.get(STORAGE_WIGGLE_POS_KEY)
+                return int(raw) if raw else -1
+            except FdbError as e:
+                await t.on_error(e)
+
+    async def _set_wiggle_pos(self, tag: Tag) -> None:
+        from .system_data import STORAGE_WIGGLE_POS_KEY
+        t = self.db.create_transaction()
+        t.access_system_keys = True
+        while True:
+            try:
+                t.set(STORAGE_WIGGLE_POS_KEY, b"%d" % tag)
+                await t.commit()
+                return
+            except FdbError as e:
+                await t.on_error(e)
+
+    async def _wiggle_one(self, tag: Tag) -> None:
+        """Drain every shard off `tag`, then re-admit it.  The server
+        stays healthy (a valid fetch SOURCE) throughout; it only stops
+        being a destination.  Shards the pool can't rehome at full
+        replication are left alone — the wiggle never degrades
+        redundancy, it just skips and moves on."""
+        self.wiggling.add(tag)
+        try:
+            TraceEvent("DDWiggleStart").detail("Tag", tag).log()
+            for begin, _e, _t in list(self.map.ranges()):
+                team = self.map.lookup(begin)
+                end = self.map.shard_end(begin)
+                if not team or tag not in team or self.halted:
+                    continue
+                keep = [t for t in team if t != tag]
+                candidates = self._ordered_candidates(keep, team,
+                                                      avoid=self.wiggling)
+                need = len(team) - len(keep)
+                if len(candidates) < need:
+                    TraceEvent("DDWiggleSkipShard", Severity.Warn).detail(
+                        "Begin", begin).detail("Tag", tag).log()
+                    continue
+                new_team = keep + candidates[:need]
+                for attempt in range(5):
+                    try:
+                        await self.move_shard(begin, end, new_team)
+                        break
+                    except FdbError as e:
+                        TraceEvent("DDWiggleMoveFailed",
+                                   Severity.Warn).detail(
+                            "Begin", begin).detail("Error", e.name).detail(
+                            "Attempt", attempt).log()
+                        if e.name == "movekeys_conflict":
+                            break     # bounds stale; next rotation retries
+                        await delay(0.5 * (1 << attempt))
+            remaining = sum(1 for _b, _e, t in self.map.ranges()
+                            if tag in (t or []))
+            self.stats["wiggles"] += 1
+            TraceEvent("DDWiggleDone").detail("Tag", tag).detail(
+                "ShardsRemaining", remaining).log()
+        finally:
+            self.wiggling.discard(tag)
+
+    async def _wiggle_loop(self) -> None:
+        """Rotation driver: picks the next healthy tag after the persisted
+        position (so a restarted DD resumes, not restarts), drains it,
+        advances.  Gated each cycle on the PERPETUAL_STORAGE_WIGGLE knob
+        — dynamic knob commits turn it on/off live — and on pool headroom
+        (wiggling with pool <= replication would force under-replicated
+        placements)."""
+        knobs = server_knobs()
+        while True:
+            await delay(float(knobs.STORAGE_WIGGLE_INTERVAL))
+            if not knobs.PERPETUAL_STORAGE_WIGGLE or self._draining:
+                continue
+            pool = sorted(t for t in self.healthy
+                          if t not in self.excluded)
+            if len(pool) <= self.replication:
+                TraceEvent("DDWiggleNoHeadroom", Severity.Warn).detail(
+                    "Pool", pool).detail(
+                    "Replication", self.replication).log()
+                continue
+            pos = await self._wiggle_pos()
+            tag = next((t for t in pool if t > pos), pool[0])
+            await self._wiggle_one(tag)
+            await self._set_wiggle_pos(tag)
 
     async def _check_removed(self, db_info_var, epoch: int) -> None:
         """Halt when the announced transaction system carries a different
@@ -727,6 +848,8 @@ class DataDistributor:
                                           f"{self.id}.shardTracker"))
         self._actors.append(process.spawn(self._registry_scan(),
                                           f"{self.id}.registryScan"))
+        self._actors.append(process.spawn(self._wiggle_loop(),
+                                          f"{self.id}.storageWiggler"))
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),
                       f"{self.id}.waitFailure")

@@ -52,6 +52,11 @@ BACKUP_CONTAINER_KEY = b"\xff/backupContainer"
 # retired number and inherit stale per-tag state).
 MAX_TAG_KEY = b"\xff/maxServerTag"
 
+# Last storage tag the perpetual wiggle finished (reference
+# perpetualStorageWiggleIDPrefix): a restarted DD resumes the rotation
+# after this tag instead of always re-wiggling the lowest one.
+STORAGE_WIGGLE_POS_KEY = b"\xff/storageWigglePos"
+
 # All user mutations additionally ride this tag while a backup is active
 # (reference: backup workers pull dedicated backup tags from the log
 # system, BackupWorker.actor.cpp:1033).  Must fit the wire u32.
