@@ -28,7 +28,7 @@ from typing import List, Optional, Tuple
 
 from ..core.error import FdbError, err
 from ..core.scheduler import delay
-from ..core.trace import TraceEvent
+from ..core.trace import Severity, TraceEvent
 from ..core.wire import Reader, Writer
 from ..txn.types import Mutation, MutationType, Version
 from ..server.system_data import (BACKUP_CONTAINER_KEY, BACKUP_STARTED_KEY,
@@ -116,6 +116,24 @@ class BackupContainer:
             return f.size() >= 12
         except FdbError:
             return False
+
+    async def snapshot_version(self) -> Version:
+        """The version the completed snapshot was read at (0 if none) —
+        the single parser of snap.done's u32(parts)+i64(version) header."""
+        try:
+            f = self.fs.open(f"{self.name}.snap.done", create=False)
+            r = Reader(await f.read(0, 12))
+            r.u32()
+            return r.i64()
+        except FdbError:
+            return 0
+
+    async def snapshot_parts(self) -> int:
+        try:
+            f = self.fs.open(f"{self.name}.snap.done", create=False)
+            return Reader(await f.read(0, 4)).u32()
+        except FdbError:
+            return 0
 
     async def read_snapshot(self) -> Tuple[Version, List]:
         try:
@@ -270,14 +288,26 @@ class FileBackupAgent:
     backup worker ROLE appends the log stream, server/backup_worker.py);
     the snapshot is a TaskBucket task chain any agent can resume."""
 
-    def __init__(self, cluster, db, fs, name: str = "backup") -> None:
+    def __init__(self, cluster, db, fs=None, name: str = "backup",
+                 url: Optional[str] = None) -> None:
         from .taskbucket import TaskBucket
         self.cluster = cluster
         self.db = db
-        # The fs acts as this test universe's shared blob store.
-        set_sim_blob_store(fs)
-        self.url = f"sim://{name}"
-        self.container = BackupContainer(fs, name)
+        if url is not None:
+            # Real deployments pass a container URL (file://...); the
+            # committed BACKUP_CONTAINER_KEY must be resolvable by the
+            # server-side backup worker in ITS process, so sim:// only
+            # works when every role shares this interpreter.
+            self.url = url
+            self.container = open_container(url)
+        else:
+            if fs is None:
+                raise err("client_invalid_operation",
+                          "FileBackupAgent needs either fs= or url=")
+            # The fs acts as this test universe's shared blob store.
+            set_sim_blob_store(fs)
+            self.url = f"sim://{name}"
+            self.container = BackupContainer(fs, name)
         self.bucket = TaskBucket(prefix=b"\xff/taskBucket/backup/")
         self.start_version: Version = 0
         self.snapshot_version: Version = 0
@@ -354,6 +384,28 @@ class FileBackupAgent:
                 TraceEvent("BackupStopDrainStalled").detail(
                     "Frontier", await self.container.read_frontier()).detail(
                     "StopVersion", stop_version).log()
+        # The snapshot chunk chain may still be in flight (a discontinue
+        # racing submit, or a fresh CLI process stopping someone else's
+        # backup): sealing meta now would record snapshot=0 and restore
+        # would double-apply the pre-snapshot log range.  Run an agent to
+        # finish the chain — TaskBucket reclaim means abandoned chunks
+        # get picked up too — and only then seal.
+        if not await self.container.snapshot_complete():
+            if self._agent_f is None:
+                self._agent_f = self.run_agent("stopAgent")
+            while not await self.container.snapshot_complete():
+                if await self.bucket.is_empty(self.db):
+                    # No chain to finish (submit never ran against this
+                    # container): seal what exists rather than spin.
+                    TraceEvent("BackupStopNoSnapshot",
+                               Severity.Warn).detail(
+                        "Url", self.url).log()
+                    break
+                await delay(0.1)
+        # A fresh process has no in-object history; the container itself
+        # records the snapshot version.
+        if not self.snapshot_version:
+            self.snapshot_version = await self.container.snapshot_version()
         records = await self.container.read_log()
         last_logged = records[-1][0] if records else self.snapshot_version
         # A user transaction batched AFTER the flag-off mutation shares
@@ -502,8 +554,7 @@ async def restore_distributed(cluster, db, fs, name: str = "backup",
     bucket = TaskBucket(prefix=b"\xff/taskBucket/restore/")
 
     # Phase 1: snapshot parts in parallel.
-    df = container.fs.open(f"{container.name}.snap.done", create=False)
-    n_parts = Reader(await df.read(0, 4)).u32()
+    n_parts = await container.snapshot_parts()
     for part in range(n_parts):
         await bucket.add_task(db, "restore_snapshot_part", {
             b"url": url.encode(), b"part": b"%d" % part})

@@ -459,6 +459,12 @@ class RealNetwork:
             entry = self._find_endpoint(token)
             if entry is not None:
                 entry[0].deliver(message)
+            else:
+                # One-way sends have no reply channel to carry an error;
+                # a token miss here is the ONLY record the message ever
+                # existed (observed: a master nudge dropped this way).
+                TraceEvent("OneWayDropped", Severity.Warn).detail(
+                    "Token", token).log()
 
     def _find_endpoint(self, token: str):
         return self._endpoints.get(Endpoint(self.address, token))
@@ -478,13 +484,26 @@ class RealNetwork:
             if conn.closed:
                 return
             w = Writer().i64(reply_id)
+            if e is None:
+                try:
+                    serde.encode_value(w, value)
+                except Exception as enc:  # noqa: BLE001
+                    # The promise is already consumed by the time encode
+                    # runs; swallowing here would leave the caller's
+                    # get_reply hanging FOREVER (observed with an
+                    # unregistered stream inside a reply payload).  Turn
+                    # it into an error reply instead.
+                    TraceEvent("ReplyEncodeFailed", Severity.Error).detail(
+                        "Token", token).detail("Error", repr(enc)).log()
+                    e = err("internal_error",
+                            f"reply encode failed: {enc!r}")
+                    w = Writer().i64(reply_id)
             if e is not None:
                 if not isinstance(e, Exception) or not hasattr(e, "code"):
                     e = err("operation_failed", repr(e))
                 serde.encode_value(w, e)
                 conn.send_frame(K_REPLY_ER, w.done())
             else:
-                serde.encode_value(w, value)
                 conn.send_frame(K_REPLY_OK, w.done())
 
         request.reply = ReplyPromise(route_reply)
@@ -543,6 +562,8 @@ class RealNetwork:
             return
         conn = self._get_conn(ep.address)
         if conn is None:
+            TraceEvent("OneWayNoConn", Severity.Warn).detail(
+                "Peer", f"{ep.address}").log()
             return
         w = Writer().str_(ep.token)
         serde.encode_value(w, message)

@@ -462,6 +462,9 @@ class CommitProxy:
             try:
                 RequestStream.at(self.master.backup_changed.endpoint).send(
                     (backup_flag, getattr(self, "backup_container", "")))
+                TraceEvent("BackupNudgeSent").detail(
+                    "Flag", backup_flag).detail(
+                    "Url", getattr(self, "backup_container", "")).log()
             except Exception:  # noqa: BLE001 — next recovery recruits
                 pass
         from .system_data import parse_conf_mutation

@@ -81,6 +81,10 @@ class BoundaryMap:
         i = self._bisect.bisect_right(self._bounds, begin)
         return self._bounds[i] if i < len(self._bounds) else b"\xff\xff"
 
+    def is_boundary(self, key: bytes) -> bool:
+        i = self._bisect.bisect_left(self._bounds, key)
+        return i < len(self._bounds) and self._bounds[i] == key
+
     def ranges(self):
         for i, b in enumerate(self._bounds):
             e = (self._bounds[i + 1] if i + 1 < len(self._bounds)
@@ -196,10 +200,12 @@ class DataDistributor:
         # Callers compute (begin, end) BEFORE queueing on the relocation
         # lock; a split/merge that committed while we waited makes them
         # stale, and proceeding would phase-2 RemoveShardRequest a span
-        # the boundary map still assigns to the old team — replica loss.
+        # the boundary map still assigns to the old team — replica loss —
+        # or re-split a just-merged shard (begin no longer a boundary).
         # Re-validate under the lock (reference MoveKeys checks the
         # keyServers boundaries inside its own transaction).
-        if self.map.shard_end(begin) != end:
+        if not self.map.is_boundary(begin) or \
+                self.map.shard_end(begin) != end:
             from ..core.error import err
             raise err("movekeys_conflict",
                       f"shard at {begin!r} changed while move queued")
@@ -790,19 +796,26 @@ class DataDistributor:
         knobs = server_knobs()
         while True:
             await delay(float(knobs.STORAGE_WIGGLE_INTERVAL))
-            if not knobs.PERPETUAL_STORAGE_WIGGLE or self._draining:
-                continue
-            pool = sorted(t for t in self.healthy
-                          if t not in self.excluded)
-            if len(pool) <= self.replication:
-                TraceEvent("DDWiggleNoHeadroom", Severity.Warn).detail(
-                    "Pool", pool).detail(
-                    "Replication", self.replication).log()
-                continue
-            pos = await self._wiggle_pos()
-            tag = next((t for t in pool if t > pos), pool[0])
-            await self._wiggle_one(tag)
-            await self._set_wiggle_pos(tag)
+            try:
+                if not knobs.PERPETUAL_STORAGE_WIGGLE or self._draining:
+                    continue
+                pool = sorted(t for t in self.healthy
+                              if t not in self.excluded)
+                if len(pool) <= self.replication:
+                    TraceEvent("DDWiggleNoHeadroom", Severity.Warn).detail(
+                        "Pool", pool).detail(
+                        "Replication", self.replication).log()
+                    continue
+                pos = await self._wiggle_pos()
+                tag = next((t for t in pool if t > pos), pool[0])
+                await self._wiggle_one(tag)
+                await self._set_wiggle_pos(tag)
+            except FdbError as e:
+                # One non-retryable error (e.g. operation_failed inside a
+                # recovery window) must not kill the wiggler for the rest
+                # of this DD's life — back off and try again next cycle.
+                TraceEvent("DDWiggleError", Severity.Warn).detail(
+                    "Error", e.name).log()
 
     async def _check_removed(self, db_info_var, epoch: int) -> None:
         """Halt when the announced transaction system carries a different

@@ -597,7 +597,8 @@ async def master_server(master: Master, process, coordinators,
     try:
         master._process = process
         for s in master.interface.streams():
-            process.register(s)
+            if s._endpoint is None:   # _init_master registers pre-reply
+                process.register(s)
         adopt(master._serve_wait_failure(), "master.waitFailure")
 
         # READING_CSTATE (:1678).  After a full-cluster power failure the
@@ -1245,6 +1246,9 @@ async def master_server(master: Master, process, coordinators,
             current_url = (prev.backup_container
                            if prev is not None else "") or ""
             async for flag, url in master.interface.backup_changed.queue:
+                TraceEvent("BackupNudgeReceived").detail(
+                    "Flag", flag).detail("Url", url).detail(
+                    "Current", current_url).log()
                 # Recruit whenever a container URL is known and no worker
                 # serves it — even with the flag OFF: the worker's job
                 # includes draining already-tagged data to the container

@@ -177,6 +177,14 @@ class Worker:
     async def _init_master(self, req) -> None:
         from .master import Master, master_server
         master = Master(epoch=req.epoch)
+        # Register BEFORE replying: master_server is spawned (deferred),
+        # so its own registration runs after this reply serializes the
+        # interface — an unregistered stream fails the encode and the
+        # CC's recruit reply never arrives (every CROSS-process master
+        # recruitment failed this way; co-located recruits skip serde,
+        # which masked it).
+        for s in master.interface.streams():
+            self.process.register(s)
         self.process.spawn(
             master_server(master, self.process, self.coordinators,
                           self.config, req.cc),
@@ -221,6 +229,15 @@ class Worker:
                                           replication=req.log_replication),
                           req.container_url,
                           db=Database(ClusterConnection(self.coordinators)))
+        # Register BEFORE replying: the reply serializes the interface's
+        # stream endpoints, and an unregistered stream raises mid-encode
+        # (observed: every cross-process backup recruit failed this way,
+        # wedging the master's backup watch).
+        for s in bw.interface.streams():
+            self.process.register(s)
+        from .failure import hold_wait_failure
+        self.process.spawn(hold_wait_failure(bw.interface.wait_failure),
+                           f"{req.bw_id}.waitFailure")
         self.process.spawn(bw.run(), f"{self.process.name}.backupWorker")
         req.reply.send(bw.interface)
 

@@ -1,0 +1,158 @@
+"""fdbbackup / fdbrestore: backup and restore command-line tools.
+
+Reference: fdbbackup/backup.actor.cpp — one program surfacing backup
+(`fdbbackup start|status|discontinue|abort`) and restore (`fdbrestore
+start`) against a cluster, with container URLs (here file:///dir/name;
+the reference adds blobstore://).  Connects as an ordinary client
+(client/database.open_cluster); the server-side backup worker role does
+the log capture, the CLI's agent loop executes snapshot/restore chunk
+tasks from the shared TaskBucket.
+
+    python -m foundationdb_tpu.tools.fdbbackup start \
+        -C 127.0.0.1:4770 -d file:///tmp/backups/b1
+    python -m foundationdb_tpu.tools.fdbbackup status -d file:///tmp/backups/b1
+    python -m foundationdb_tpu.tools.fdbbackup discontinue -C 127.0.0.1:4770 \
+        -d file:///tmp/backups/b1
+    python -m foundationdb_tpu.tools.fdbrestore start \
+        -C 127.0.0.1:4770 -r file:///tmp/backups/b1
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from types import SimpleNamespace
+
+
+def _open(coords: str):
+    from ..client.database import open_cluster
+    loop, db = open_cluster(coords)
+    return loop, db
+
+
+def _container(url: str):
+    from ..client.backup import open_container
+    return open_container(url)
+
+
+def cmd_start(args) -> int:
+    from ..client.backup import FileBackupAgent
+    loop, db = _open(args.cluster)
+    agent = FileBackupAgent(SimpleNamespace(loop=loop), db, url=args.destcontainer)
+
+    async def go():
+        await agent.submit()
+        return agent.snapshot_version
+
+    snap_v = loop.run_until(loop.spawn(go()), timeout=args.timeout)
+    print(f"Backup started; snapshot complete at version {snap_v}. "
+          "The log stream continues until `discontinue` or `abort`.")
+    return 0
+
+
+def cmd_status(args) -> int:
+    loop, db = (None, None)
+    c = _container(args.destcontainer)
+    from ..core.scheduler import EventLoop, set_event_loop
+    loop = EventLoop(sim=False)
+    set_event_loop(loop)
+
+    async def go():
+        from ..core.error import FdbError
+        try:
+            # Meta lands at discontinue/stop; an ACTIVE backup has none.
+            start, snap, end = await c.read_meta()
+        except FdbError:
+            start = snap = end = None
+        complete = await c.snapshot_complete()
+        frontier = await c.read_frontier()
+        return start, snap, end, complete, frontier
+
+    start, snap, end, complete, frontier = loop.run_until(
+        loop.spawn(go()), timeout=args.timeout)
+    print(f"Container:          {args.destcontainer}")
+    print(f"State:              "
+          f"{'stopped (meta sealed)' if end is not None else 'active'}")
+    print(f"Snapshot:           "
+          f"{'complete' if complete else 'IN PROGRESS'}")
+    print(f"Log frontier:       {frontier}")
+    if end is not None:
+        restorable = complete and frontier >= snap
+        print(f"Restorable:         {'yes' if restorable else 'no'}"
+              + (f" (snapshot {snap}, end {end})" if restorable else ""))
+    else:
+        print("Restorable:         after discontinue (meta not sealed yet)")
+    return 0
+
+
+def cmd_discontinue(args) -> int:
+    from ..client.backup import FileBackupAgent
+    loop, db = _open(args.cluster)
+    agent = FileBackupAgent(SimpleNamespace(loop=loop), db, url=args.destcontainer)
+    end_v = loop.run_until(loop.spawn(agent.stop()), timeout=args.timeout)
+    print(f"Backup discontinued; restorable through version {end_v}.")
+    return 0
+
+
+def cmd_abort(args) -> int:
+    from ..client.backup import FileBackupAgent
+    loop, db = _open(args.cluster)
+    agent = FileBackupAgent(SimpleNamespace(loop=loop), db, url=args.destcontainer)
+    loop.run_until(loop.spawn(agent._set_backup_flag(False)),
+                   timeout=args.timeout)
+    print("Backup aborted (capture stopped immediately; the container may "
+          "not be restorable past its snapshot).")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    from ..client.backup import restore
+    loop, db = _open(args.cluster)
+    c = _container(args.sourcecontainer)
+    applied = loop.run_until(loop.spawn(restore(db, c.fs, c.name)),
+                             timeout=args.timeout)
+    print(f"Restore complete: {applied} mutations applied.")
+    return 0
+
+
+def _parser(restore_mode: bool) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fdbrestore" if restore_mode else "fdbbackup")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp, container_flag, container_dest, need_cluster=True):
+        if need_cluster:
+            sp.add_argument("-C", "--cluster", required=True,
+                            help="coordinator list host:port[,host:port...]")
+        sp.add_argument(container_flag, dest=container_dest, required=True,
+                        help="container URL (file:///dir/name)")
+        sp.add_argument("--timeout", type=float, default=300.0)
+
+    if restore_mode:
+        sp = sub.add_parser("start", help="restore a container into the cluster")
+        common(sp, "-r", "sourcecontainer")
+        sp.set_defaults(fn=cmd_restore)
+    else:
+        sp = sub.add_parser("start", help="submit a backup (snapshot + log stream)")
+        common(sp, "-d", "destcontainer")
+        sp.set_defaults(fn=cmd_start)
+        sp = sub.add_parser("status", help="describe a backup container")
+        common(sp, "-d", "destcontainer", need_cluster=False)
+        sp.set_defaults(fn=cmd_status)
+        sp = sub.add_parser("discontinue",
+                            help="stop capture after making the backup restorable")
+        common(sp, "-d", "destcontainer")
+        sp.set_defaults(fn=cmd_discontinue)
+        sp = sub.add_parser("abort", help="stop capture immediately")
+        common(sp, "-d", "destcontainer")
+        sp.set_defaults(fn=cmd_abort)
+    return p
+
+
+def main(argv=None, restore_mode: bool = False) -> int:
+    args = _parser(restore_mode).parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,13 @@
+"""fdbrestore: restore CLI (reference fdbbackup/backup.actor.cpp, the
+fdbrestore program alias).  Thin entry point over tools/fdbbackup.py.
+
+    python -m foundationdb_tpu.tools.fdbrestore start \
+        -C 127.0.0.1:4770 -r file:///tmp/backups/b1
+"""
+
+import sys
+
+from .fdbbackup import main
+
+if __name__ == "__main__":
+    sys.exit(main(restore_mode=True))
