@@ -30,15 +30,24 @@ class DatabaseBackupAgent:
     """One DR relationship: source cluster -> target db."""
 
     def __init__(self, source_cluster, source_db, target_db,
-                 tag: str = "dr") -> None:
+                 tag: str = "dr", info_fn=None) -> None:
         self.cluster = source_cluster
         self.src = source_db
         self.dst = target_db
         self.tag = tag
+        # Real-mode source of the live ServerDBInfo (the CLI's CC
+        # long-poll); sim tests read it straight off the cluster object.
+        self._info_fn = info_fn
         self.start_version: Version = 0
         self.applied_through: Version = 0
         self._stop = False
         self._agent_f = None
+
+    async def _server_db_info(self):
+        if self._info_fn is not None:
+            return await self._info_fn()
+        cc = self.cluster.current_cc()
+        return cc.db_info if cc is not None else None
 
     async def _set_flag(self, on: bool) -> Version:
         t = self.src.create_transaction()
@@ -109,14 +118,19 @@ class DatabaseBackupAgent:
         the target in version order."""
         fetch_from = from_version + 1
         while not self._stop:
-            cc = self.cluster.current_cc()
-            info = cc.db_info if cc is not None else None
+            info = await self._server_db_info()
             if info is None or not info.tlogs:
                 await delay(0.2)
                 continue
             from ..server.commit_proxy import LogSystemClient
-            ls = LogSystemClient(info.tlogs, getattr(
-                self.cluster.config, "log_replication", 1))
+            # Replication from the broadcast info itself (sim config as
+            # fallback for old snapshots): popping with too small a
+            # factor would leave replica TLogs' BACKUP_TAG queues
+            # growing forever.
+            repl = getattr(info, "log_replication", 0) or getattr(
+                getattr(self.cluster, "config", None),
+                "log_replication", 1)
+            ls = LogSystemClient(info.tlogs, repl)
             try:
                 reply = await ls.peek_tag(BACKUP_TAG, fetch_from)
             except FdbError:

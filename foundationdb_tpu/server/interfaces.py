@@ -535,6 +535,11 @@ class ServerDBInfo:
     log_routers: List[Any] = field(default_factory=list)
     remote_tlogs: List[Any] = field(default_factory=list)
     remote_storage: Dict[Tag, Any] = field(default_factory=dict)
+    # TLog replication factor of this generation: consumers peeking the
+    # log system directly (DR agents, backup workers started outside the
+    # recruiting process) need it to pop every team member, not just the
+    # primary (reference carries it in LogSystemConfig's tLogSets).
+    log_replication: int = 1
 
 
 @dataclass

@@ -1228,7 +1228,8 @@ async def master_server(master: Master, process, coordinators,
             data_distributor=data_distributor,
             cluster_controller=cc_interface,
             log_routers=log_routers, remote_tlogs=remote_tlogs,
-            remote_storage=remote_storage)
+            remote_storage=remote_storage,
+            log_replication=config.log_replication)
         await RequestStream.at(
             cc_interface.master_registration.endpoint).get_reply(
             MasterRegistrationRequest(epoch=master.epoch, db_info=db_info))

@@ -1,0 +1,134 @@
+"""fdbdr: cluster-to-cluster disaster-recovery replication CLI.
+
+Reference: fdbbackup/backup.actor.cpp (the fdbdr program alias) +
+fdbclient/DatabaseBackupAgent.actor.cpp — continuous replication of a
+source cluster into a target cluster, with drained switchover.  This CLI
+combines the reference's `fdbdr start` and the dr_agent daemon in one
+process: `run` submits the relationship (snapshot copy + live mutation
+stream) and keeps applying until interrupted; `--switchover` instead
+drains and hands over once in sync, then exits — the migration workflow.
+
+    python -m foundationdb_tpu.tools.fdbdr run \
+        -s 127.0.0.1:4770 -d 127.0.0.1:4780 [--switchover]
+
+Both clusters are spoken to from this one process: the source also via
+its cluster controller (get_server_db_info long-poll) so the agent can
+peek the BACKUP_TAG mutation stream off the live TLogs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from types import SimpleNamespace
+
+
+def _second_database(coords_spec: str):
+    """Another cluster's Database on the ALREADY-RUNNING loop/network
+    (open_cluster installs process-globals; only the first cluster may
+    create them)."""
+    from ..client.database import ClusterConnection, Database
+    from ..server.coordination import CoordinationClientInterface
+    from ..server.fdbserver import parse_coordinators
+    coords = [CoordinationClientInterface.at_address(a)
+              for a in parse_coordinators(coords_spec)]
+    return Database(ClusterConnection(coords))
+
+
+def _make_info_fn(cluster_connection, loop):
+    """Live ServerDBInfo off the source's CC (the worker subscription
+    path), reusing the ClusterConnection's existing leader monitor —
+    no second monitor_leader against the same coordinators."""
+    from ..rpc.endpoint import RequestStream
+    from ..server.cluster_controller import GetServerDBInfoRequest
+    leader_var = cluster_connection.leader
+    # known_version resets whenever the CC identity changes: a fresh
+    # CC's db_info_version restarts at 0, and long-polling it with the
+    # OLD counter would block until it catches up — forever, in steady
+    # state (the worker's _register_loop resets the same way).
+    state = {"version": -1, "info": None, "ts": -1e9, "cc": None}
+
+    async def info_fn():
+        from ..core.error import FdbError
+        from ..core.scheduler import delay
+        # The apply loop asks once per peek; cache briefly so the CC
+        # isn't polled at the peek cadence.
+        if state["info"] is not None and loop.now() - state["ts"] < 2.0:
+            return state["info"]
+        leader = leader_var.get()
+        cc = leader.serialized_info if leader else None
+        if cc is None or getattr(leader, "forward", False):
+            await delay(0.2)
+            return state["info"]
+        if cc is not state["cc"]:
+            state["cc"] = cc
+            state["version"] = -1
+        try:
+            version, info = await RequestStream.at(
+                cc.get_server_db_info.endpoint).get_reply(
+                GetServerDBInfoRequest(known_version=state["version"] - 1))
+            state["version"], state["info"] = version, info
+            state["ts"] = loop.now()
+        except FdbError:
+            await delay(0.2)
+        return state["info"]
+
+    return info_fn
+
+
+def cmd_run(args) -> int:
+    from ..client.database import open_cluster
+    from ..client.dr_agent import DatabaseBackupAgent
+    from ..core.scheduler import delay
+    loop, src_db = open_cluster(args.source)
+    dst_db = _second_database(args.destination)
+    agent = DatabaseBackupAgent(
+        SimpleNamespace(loop=loop, config=None), src_db, dst_db,
+        info_fn=_make_info_fn(src_db.cluster, loop))
+
+    async def go():
+        await agent.submit()
+        print(f"DR active: snapshot copied through version "
+              f"{agent.applied_through}; streaming mutations.",
+              flush=True)
+        if args.switchover:
+            v = await agent.switchover()
+            print(f"Switchover complete: target is an exact copy through "
+                  f"version {v}. Point clients at the target cluster.")
+            return 0
+        while True:
+            await delay(5.0)
+            print(f"DR applied through version {agent.applied_through}",
+                  flush=True)
+
+    from ..core.error import FdbError
+    try:
+        return loop.run_until(loop.spawn(go()), timeout=args.timeout) or 0
+    except (KeyboardInterrupt, FdbError) as e:
+        agent.abort()
+        reason = "interrupted" if isinstance(e, KeyboardInterrupt) \
+            else f"stopped ({getattr(e, 'name', 'error')})"
+        print(f"DR {reason} (source capture flag left ON; rerun to "
+              "resume or finish with run --switchover).")
+        return 0 if isinstance(e, KeyboardInterrupt) else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fdbdr")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("run", help="replicate source -> target "
+                                    "continuously (Ctrl-C stops)")
+    sp.add_argument("-s", "--source", required=True,
+                    help="source coordinators host:port[,...]")
+    sp.add_argument("-d", "--destination", required=True,
+                    help="target coordinators host:port[,...]")
+    sp.add_argument("--switchover", action="store_true",
+                    help="drain and hand over once in sync, then exit")
+    sp.add_argument("--timeout", type=float, default=86400.0)
+    sp.set_defaults(fn=cmd_run)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
