@@ -294,10 +294,12 @@ class DataDistributor:
         if idle is None:
             return None
         new_tag = await self._alloc_tag()
+        engine = getattr(info, "storage_engine", "") or ""
         try:
             ssi = await RequestStream.at(
                 idle.init_storage.endpoint).get_reply(
-                InitializeStorageRequest(ss_id=f"ss{new_tag}", tag=new_tag))
+                InitializeStorageRequest(ss_id=f"ss{new_tag}", tag=new_tag,
+                                         engine=engine))
         except FdbError as e:
             TraceEvent("DDRecruitFailed", Severity.Warn).detail(
                 "Worker", idle.id).detail("Error", e.name).log()
@@ -780,11 +782,42 @@ class DataDistributor:
                         await delay(0.5 * (1 << attempt))
             remaining = sum(1 for _b, _e, t in self.map.ranges()
                             if tag in (t or []))
+            # The wiggle's reference purpose: a drained server is
+            # re-imaged onto the CONFIGURED storage engine when it runs
+            # a different one (engine migrations ride the rotation).
+            await self._maybe_migrate_engine(tag, remaining)
             self.stats["wiggles"] += 1
             TraceEvent("DDWiggleDone").detail("Tag", tag).detail(
                 "ShardsRemaining", remaining).log()
         finally:
             self.wiggling.discard(tag)
+
+    async def _maybe_migrate_engine(self, tag: Tag, remaining: int) -> None:
+        info = self._db_info_var.get() if self._db_info_var else None
+        want = getattr(info, "storage_engine", "") or ""
+        ssi = self.storage.get(tag)
+        have = getattr(ssi, "engine_name", "") if ssi is not None else ""
+        if not want or not have or want == have:
+            return
+        if remaining:
+            # Still owns shards (pool couldn't rehome them): re-imaging
+            # now would copy that data through the swap — allowed, but
+            # the rotation will retry once the pool has headroom.
+            TraceEvent("DDWiggleEngineDeferred").detail(
+                "Tag", tag).detail("Want", want).log()
+            return
+        from .interfaces import MigrateEngineRequest
+        if getattr(ssi, "migrate_engine", None) is None:
+            return                    # pre-migration interface snapshot
+        try:
+            await RequestStream.at(ssi.migrate_engine.endpoint).get_reply(
+                MigrateEngineRequest(engine=want))
+            ssi.engine_name = want           # refresh the DD's copy
+            TraceEvent("DDWiggleEngineMigrated").detail(
+                "Tag", tag).detail("To", want).log()
+        except FdbError as e:
+            TraceEvent("DDWiggleEngineFailed", Severity.Warn).detail(
+                "Tag", tag).detail("Error", e.name).log()
 
     async def _wiggle_loop(self) -> None:
         """Rotation driver: picks the next healthy tag after the persisted

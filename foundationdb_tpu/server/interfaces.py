@@ -540,6 +540,10 @@ class ServerDBInfo:
     # recruiting process) need it to pop every team member, not just the
     # primary (reference carries it in LogSystemConfig's tLogSets).
     log_replication: int = 1
+    # Effective storage engine of this generation's configuration — the
+    # DD recruits replacements mid-epoch and must honor a committed
+    # `configure storage_engine=...` without a private channel.
+    storage_engine: str = ""
 
 
 @dataclass
@@ -781,6 +785,17 @@ class RemoveShardRequest:
 
 
 @dataclass
+class MigrateEngineRequest:
+    """DD (wiggle) -> SS: re-image the durable store onto `engine`.
+    Answered once the new store is durable and the old one's files are
+    gone (the boot scan recovers by file extension — leftovers would
+    resurrect a stale twin on restart)."""
+
+    engine: str = "memory"
+    reply: Any = None
+
+
+@dataclass
 class InitializeDataDistributorRequest:
     dd_id: str = ""
     epoch: int = 0
@@ -822,6 +837,12 @@ class InitializeStorageRequest:
     own_ranges: List[Tuple[bytes, bytes]] = field(default_factory=list)
     # Recruiting epoch (tss shadows retire when a NEWER epoch appears).
     epoch: int = 0
+    # Storage engine for the new server, from the recruiting epoch's
+    # EFFECTIVE configuration (committed \xff/conf overrides included);
+    # "" falls back to the worker's static boot config.  Without this a
+    # `configure storage_engine=...` never reaches new recruits — the
+    # worker only knows its --config flag.
+    engine: str = ""
     reply: Any = None     # -> StorageServerInterface
 
 
@@ -947,10 +968,16 @@ class StorageServerInterface:
             "storage.shardMetrics", TaskPriority.DefaultEndpoint)
         self.remove_shard = RequestStream(
             "storage.removeShard", TaskPriority.DefaultEndpoint)
+        # Perpetual-wiggle engine rewrite: re-image this server's store
+        # onto a different IKeyValueStore (reference: the wiggle recreates
+        # storage with the configured storeType for engine migrations).
+        self.migrate_engine = RequestStream(
+            "storage.migrateEngine", TaskPriority.DefaultEndpoint)
         self.wait_failure = RequestStream("storage.waitFailure",
                                           TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
         return [self.get_value, self.get_key_values, self.watch_value,
                 self.queuing_metrics, self.fetch_keys, self.fetch_shard,
-                self.shard_metrics, self.remove_shard, self.wait_failure]
+                self.shard_metrics, self.remove_shard, self.migrate_engine,
+                self.wait_failure]

@@ -481,7 +481,8 @@ async def _recruit_region(master, process, workers, config, tlogs,
                 r_store[i % len(r_store)].init_storage.endpoint).get_reply(
                 InitializeStorageRequest(
                     ss_id=f"rss{twin_tag(t)}", tag=twin_tag(t),
-                    pull_tlogs=remote_tlogs))
+                    pull_tlogs=remote_tlogs,
+                    engine=config.storage_engine))
             for i, t in enumerate(fresh)}
         for t, f in init_futures.items():
             remote_storage[twin_tag(t)] = await f
@@ -1010,7 +1011,8 @@ async def master_server(master: Master, process, coordinators,
         else:
             storage_futures = [RequestStream.at(
                 pick_storage(i).init_storage.endpoint).get_reply(
-                InitializeStorageRequest(ss_id=f"ss{i}", tag=i))
+                InitializeStorageRequest(ss_id=f"ss{i}", tag=i,
+                                         engine=config.storage_engine))
                 for i in range(config.n_storage)]
         tlogs = await _wait_all(tlog_futures)
         resolvers = await _wait_all(resolver_futures)
@@ -1229,7 +1231,8 @@ async def master_server(master: Master, process, coordinators,
             cluster_controller=cc_interface,
             log_routers=log_routers, remote_tlogs=remote_tlogs,
             remote_storage=remote_storage,
-            log_replication=config.log_replication)
+            log_replication=config.log_replication,
+            storage_engine=config.storage_engine)
         await RequestStream.at(
             cc_interface.master_registration.endpoint).get_reply(
             MasterRegistrationRequest(epoch=master.epoch, db_info=db_info))

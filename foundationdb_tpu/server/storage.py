@@ -210,6 +210,13 @@ class StorageServer:
         # the updateStorage actor batches them into the engine.
         self.engine = engine
         self._durable_pending: List[Tuple[Version, int, bytes, bytes]] = []
+        # Engine-migration support (perpetual wiggle): the hosting worker
+        # injects a factory `name -> (new_engine, cleanup_old_files)`;
+        # the swap itself happens inside _update_storage_loop so it is
+        # serialized with durability batches.
+        self.engine_name = ""
+        self._engine_factory = None
+        self._pending_engine = None      # in-flight MigrateEngineRequest
         # Epoch of the log system that fed this server's data; rollback on
         # set_log_system applies only when crossing to a NEWER epoch (data
         # beyond the epoch boundary may never have been committed) — never
@@ -360,6 +367,9 @@ class StorageServer:
                 continue   # stretched durability lag (reference BUGGIFY)
             if self._rebuild_f is not None and not self._rebuild_f.is_ready():
                 continue                     # epoch rollback re-image running
+            if self._pending_engine is not None:
+                req, self._pending_engine = self._pending_engine, None
+                await self._do_migrate_engine(req)
             target = self.version.get()
             dv = self.durable_version
             epoch0 = self.log_epoch
@@ -653,13 +663,67 @@ class StorageServer:
             if hasattr(self, "_role_actors"):
                 self._role_actors.append(self._pull_actor)
 
-    async def _rebuild_engine(self, version: Version) -> None:
-        self.engine.clear(b"", b"\xff\xff\xff")
+    async def _migrate_engine(self, req) -> None:
+        """Queue an engine rewrite; _update_storage_loop performs it so
+        the swap is serialized with durability batches (reference: the
+        wiggle recreates storage with the configured storeType)."""
+        from ..core.error import err
+        if self.engine is None or self._engine_factory is None:
+            req.reply.send_error(err(
+                "client_invalid_operation",
+                "role has no durable engine / no factory"))
+            return
+        if req.engine == self.engine_name:
+            req.reply.send(False)            # already there
+            return
+        if self._pending_engine is not None:
+            req.reply.send_error(err("operation_failed",
+                                     "migration already in flight"))
+            return
+        self._pending_engine = req
+
+    async def _do_migrate_engine(self, req) -> None:
+        """Image the durable state at durable_version into a fresh engine
+        of the requested kind, swap, and delete the old engine's files
+        (leftovers would make the next boot scan resurrect a stale twin).
+        _durable_pending (versions past durable_version) stays queued and
+        lands on the NEW engine in later batches — no mutation is lost or
+        double-applied."""
+        try:
+            new_engine, cleanup_old = self._engine_factory(req.engine)
+            dv = self.durable_version.get()
+            await self._image_engine(new_engine, dv)
+            old_name = self.engine_name
+            self.engine = new_engine
+            self.engine_name = req.engine
+            self.interface.engine_name = req.engine
+            cleanup_old()
+            TraceEvent("SSEngineMigrated").detail("Id", self.id).detail(
+                "From", old_name).detail("To", req.engine).detail(
+                "Version", dv).log()
+            req.reply.send(True)
+        except Exception as e:  # noqa: BLE001 — reply the error; the old
+            # engine is untouched until the swap point, so the server
+            # keeps running on it.
+            TraceEvent("SSEngineMigrateFailed", Severity.Error).detail(
+                "Id", self.id).detail("Error", repr(e)).log()
+            from ..core.error import FdbError, err
+            req.reply.send_error(e if isinstance(e, FdbError) else
+                                 err("operation_failed", repr(e)))
+
+    async def _image_engine(self, engine, version: Version) -> None:
+        """Replace `engine`'s contents with this server's MVCC state at
+        `version` + identity meta, durably (shared by epoch-rollback
+        re-imaging and engine migration)."""
+        engine.clear(b"", b"\xff\xff\xff")
         for k, v in self.data.range_read(b"", b"\xff\xff", version,
                                          1 << 30, 1 << 40)[0]:
-            self.engine.set(k, v)
-        self.engine.set(_META_KEY, self._meta_blob(version))
-        await self.engine.commit()
+            engine.set(k, v)
+        engine.set(_META_KEY, self._meta_blob(version))
+        await engine.commit()
+
+    async def _rebuild_engine(self, version: Version) -> None:
+        await self._image_engine(self.engine, version)
 
     # -- serving -------------------------------------------------------------
     async def _serve(self, queue, handler) -> None:
@@ -703,6 +767,9 @@ class StorageServer:
         a.append(process.spawn(self._serve(self.interface.remove_shard.queue,
                                            self._remove_shard),
                                f"{self.id}.removeShard"))
+        a.append(process.spawn(self._serve(
+            self.interface.migrate_engine.queue, self._migrate_engine),
+            f"{self.id}.migrateEngine"))
         from .failure import hold_wait_failure
         a.append(process.spawn(hold_wait_failure(self.interface.wait_failure),
                                f"{self.id}.waitFailure"))

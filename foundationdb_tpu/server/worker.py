@@ -79,6 +79,7 @@ class Worker:
         from .kvstore import open_kv_store
         try:
             fs = self._fs()
+            storage_found: Dict[str, list] = {}
             for name in sorted(fs.files):
                 if name.startswith("tlog-") and name.endswith(".wal"):
                     tlog_id = name[len("tlog-"):-len(".wal")]
@@ -89,19 +90,44 @@ class Worker:
                 elif name.startswith("storage-") and (
                         name.endswith(".wal") or name.endswith(".btree")):
                     if name.endswith(".wal"):
-                        engine = open_kv_store("memory", fs,
-                                               name[:-len(".wal")])
+                        kind, prefix = "memory", name[:-len(".wal")]
                     else:
-                        engine = open_kv_store("btree", fs,
-                                               name[:-len(".btree")])
+                        kind, prefix = "btree", name[:-len(".btree")]
+                    storage_found.setdefault(prefix, []).append(kind)
+            for prefix, kinds in sorted(storage_found.items()):
+                # BOTH kinds present = a crash between an engine
+                # migration's commit and its old-file cleanup.  Keep the
+                # store that is further along (the migration imaged the
+                # new one at the durable frontier, so ties favor it) and
+                # delete the loser — instantiating both would run twin
+                # servers on one tag, cross-popping the shared TLog
+                # cursor and corrupting the registry.
+                candidates = []
+                for kind in kinds:
+                    engine = open_kv_store(kind, fs, prefix)
                     ss = await StorageServer.from_engine(engine)
-                    if ss is None:
-                        continue
-                    ss.run(self.process)
-                    self._stamp_locality(ss)
-                    self.storage_roles.append(ss)
-                    self.recovered_storage[ss.tag] = ss.interface
-                    self.storage_versions[ss.tag] = ss.version.get()
+                    if ss is not None:
+                        candidates.append((ss.version.get(),
+                                           kind != "memory", kind, ss))
+                if not candidates:
+                    continue
+                candidates.sort()
+                _v, _pref, kind, ss = candidates[-1]
+                for _lv, _lp, lkind, _lss in candidates[:-1]:
+                    TraceEvent("WorkerBootScanTwinDropped",
+                               Severity.Warn).detail(
+                        "Prefix", prefix).detail("Kept", kind).detail(
+                        "Dropped", lkind).log()
+                    for ext in self._ENGINE_FILES.get(lkind, ()):
+                        fs.delete(prefix + ext)
+                ss.engine_name = kind
+                ss.interface.engine_name = kind
+                ss._engine_factory = self._make_engine_factory(ss.tag, ss)
+                ss.run(self.process)
+                self._stamp_locality(ss)
+                self.storage_roles.append(ss)
+                self.recovered_storage[ss.tag] = ss.interface
+                self.storage_versions[ss.tag] = ss.version.get()
             if self.recovered_logs or self.recovered_storage:
                 TraceEvent("WorkerBootScan").detail(
                     "Worker", self.process.name).detail(
@@ -220,6 +246,29 @@ class Worker:
         self.recovered_logs[req.tlog_id] = tlog.interface
         self._announce_roles()
         req.reply.send(tlog.interface)
+
+    _ENGINE_FILES = {"memory": (".wal", ".snap"), "btree": (".btree",)}
+
+    def _make_engine_factory(self, tag, ss):
+        """`name -> (new_engine, cleanup_old)` closure for perpetual-
+        wiggle engine migration: the cleanup deletes the OLD engine's
+        files (the boot scan recovers by file extension; leftovers would
+        resurrect a stale twin on the next restart)."""
+        from .kvstore import open_kv_store
+
+        def factory(name: str):
+            fs = self._fs()
+            prefix = f"storage-{tag}"
+            for ext in self._ENGINE_FILES.get(name, ()):
+                fs.delete(prefix + ext)       # stale target-kind leftovers
+            new_engine = open_kv_store(name, fs, prefix)
+
+            def cleanup_old(_old=ss.engine_name):
+                for ext in self._ENGINE_FILES.get(_old, ()):
+                    fs.delete(prefix + ext)
+            return new_engine, cleanup_old
+
+        return factory
 
     async def _init_backup_worker(self, req) -> None:
         from ..client.database import ClusterConnection, Database
@@ -405,10 +454,15 @@ class Worker:
         self._fs().delete(f"storage-{req.tag}.wal")
         self._fs().delete(f"storage-{req.tag}.snap")
         self._fs().delete(f"storage-{req.tag}.btree")
-        engine_name = getattr(self.config, "storage_engine", "memory")                 if self.config else "memory"
+        engine_name = getattr(req, "engine", "") or (
+            getattr(self.config, "storage_engine", "memory")
+            if self.config else "memory")
         engine = open_kv_store(engine_name, self._fs(),
                                f"storage-{req.tag}")
         ss = StorageServer(req.ss_id, req.tag, ls, engine=engine)
+        ss.engine_name = engine_name
+        ss.interface.engine_name = engine_name
+        ss._engine_factory = self._make_engine_factory(req.tag, ss)
         ss.remote = bool(getattr(req, "pull_tlogs", None))
         # Seed the engine's identity metadata durably before serving so
         # a power failure at any later point finds a recoverable store.
@@ -481,18 +535,21 @@ class Worker:
         }
 
     async def _stats_announce_loop(self) -> None:
-        """REAL mode only: periodic re-announce keeps the CC's machine
-        stats fresh (role-change announces alone would leave them stale
-        for hours).  Re-sending the full registration is deliberate —
-        it is the single idempotent refresh path — and the payload is a
-        handful of interface references every 30s.  Simulation skips the
-        loop: stats there are deterministic stubs, and the churn would
-        be pure noise in ensembles."""
+        """Periodic re-registration (reference registrationClient's
+        REGISTER poll).  Two jobs: keep machine stats fresh in REAL mode,
+        and — in BOTH modes — heal a LOST registration: the one-way
+        register send has no ack, and a worker can observe a new leader a
+        hair before that CC's register_worker stream exists (observed
+        seed-dependent wedge: every worker registered into the void and
+        the CC waited for min_workers forever).  Re-sending the full
+        registration is deliberate — it is the single idempotent refresh
+        path, a handful of interface references per send; the sim
+        interval is deterministic virtual time, so same-seed runs still
+        replay identically."""
         from ..core.scheduler import delay, get_event_loop
-        if get_event_loop().sim:
-            return
+        interval = 10.0 if get_event_loop().sim else 30.0
         while True:
-            await delay(30.0)
+            await delay(interval)
             if self._current_cc is not None:
                 self._announce_roles()
 

@@ -1,0 +1,158 @@
+"""Committed storage-engine configuration reaches NEW recruits.
+
+Reference: `configure ssd|memory` (fdbclient/ManagementAPI.actor.cpp) —
+the engine is part of the committed DatabaseConfiguration, and servers
+recruited after the change open the configured store.  Here the worker
+only knows its static --config flag, so the recruiting epoch's EFFECTIVE
+configuration must ride the InitializeStorageRequest (and ServerDBInfo,
+for the DD's mid-epoch replacements)."""
+
+import pytest
+
+from foundationdb_tpu.client.management import change_configuration
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+
+from test_data_distribution import current_dd
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+from test_storage_wiggle import wiggle_knobs  # noqa: F401
+
+
+def test_configure_engine_reaches_replacement(teardown):  # noqa: F811
+    c = SimFdbCluster(
+        config=DatabaseConfiguration(n_storage=2, storage_replication=2),
+        n_workers=6, n_storage_workers=3)   # one spare storage worker
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.core.scheduler import delay
+        for i in range(20):
+            await commit_kv(db, b"ec/%03d" % i, b"v%03d" % i)
+        # Commit the engine change; the config bounce recovers an epoch
+        # whose effective configuration carries it.
+        await change_configuration(db, storage_engine="btree")
+        await commit_kv(db, b"ec/post", b"after-configure")
+        # Kill one storage machine: the DD replaces it, and the recruit
+        # must open the CONFIGURED engine, not the worker's boot default.
+        c.sim.power_fail_machine("mach.worker0")
+        deadline = 90.0
+        dd = None
+        while deadline > 0:
+            await delay(0.5)
+            deadline -= 0.5
+            dd = current_dd(c) or dd
+            if dd is not None and dd.stats.get("rereplications", 0) > 0 \
+                    and dd.moves_in_flight == 0:
+                break
+        assert dd is not None and dd.stats["rereplications"] > 0
+        engines = {t: getattr(ssi, "engine_name", "?")
+                   for t, ssi in dd.storage.items() if t in dd.healthy}
+        # The replacement (highest tag) runs the configured engine.
+        newest = max(engines)
+        assert engines[newest] == "btree", engines
+        for i in range(20):
+            assert await read_key(db, b"ec/%03d" % i) == b"v%03d" % i
+        assert await read_key(db, b"ec/post") == b"after-configure"
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=300)
+
+
+def test_wiggle_migrates_engines(teardown, wiggle_knobs):  # noqa: F811
+    """configure storage_engine=btree + perpetual wiggle => every storage
+    server is re-imaged onto btree as the rotation reaches it (the
+    reference wiggle's storeType-migration purpose)."""
+    knobs = wiggle_knobs
+    knobs.PERPETUAL_STORAGE_WIGGLE = 1
+    knobs.STORAGE_WIGGLE_INTERVAL = 0.5
+    c = SimFdbCluster(
+        config=DatabaseConfiguration(n_storage=3, storage_replication=2),
+        n_workers=6, n_storage_workers=3)
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.core.scheduler import delay
+        for i in range(20):
+            await commit_kv(db, b"em/%03d" % i, b"v%03d" % i)
+        await change_configuration(db, storage_engine="btree")
+        await commit_kv(db, b"em/post", b"after")
+        deadline = 150.0
+        dd = None
+        while deadline > 0:
+            await delay(1.0)
+            deadline -= 1.0
+            dd = current_dd(c) or dd
+            if dd is None:
+                continue
+            engines = {t: getattr(ssi, "engine_name", "")
+                       for t, ssi in dd.storage.items()
+                       if t in dd.healthy}
+            if engines and all(e == "btree" for e in engines.values()):
+                break
+        assert engines and all(e == "btree" for e in engines.values()), \
+            engines
+        for i in range(20):
+            assert await read_key(db, b"em/%03d" % i) == b"v%03d" % i
+        assert await read_key(db, b"em/post") == b"after"
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=400)
+
+    # Whole-cluster power-fail AFTER the migration: the boot scan must
+    # recover the btree stores (and find no stale .wal twin — the swap
+    # deletes the old engine's files) with every acked key intact.
+    c.power_fail_reboot()
+    db2 = c.database()
+
+    async def check():
+        for i in range(20):
+            assert await read_key(db2, b"em/%03d" % i) == b"v%03d" % i
+        assert await read_key(db2, b"em/post") == b"after"
+        await commit_kv(db2, b"em/rebooted", b"yes")
+        assert await read_key(db2, b"em/rebooted") == b"yes"
+        return True
+
+    assert c.run_until(c.loop.spawn(check()), timeout=200)
+
+
+def test_boot_scan_drops_stale_engine_twin(teardown):  # noqa: F811
+    """Crash window between a migration's commit and its old-file cleanup
+    leaves BOTH engine kinds on disk; the boot scan must keep the one
+    that is further along and delete the stale twin — twin servers on
+    one tag would cross-pop the shared TLog cursor."""
+    from foundationdb_tpu.server.kvstore import open_kv_store
+    from foundationdb_tpu.server.storage import _META_KEY
+    c = SimFdbCluster(
+        config=DatabaseConfiguration(n_storage=2, storage_replication=2),
+        n_workers=5, n_storage_workers=2)
+    db = c.database()
+
+    async def seed():
+        for i in range(15):
+            await commit_kv(db, b"tw/%02d" % i, b"v%02d" % i)
+        # Plant a STALE btree twin (version 0) next to a live memory
+        # store — what a crash mid-migration leaves behind.
+        dd = current_dd(c)
+        tag = sorted(dd.healthy)[0]
+        ss = dd.storage[tag].role
+        fs = c.sim.fs_for(ss._process)
+        twin = open_kv_store("btree", fs, f"storage-{tag}")
+        twin.set(_META_KEY, ss._meta_blob(0))
+        await twin.commit()
+        return tag, ss._process
+
+    tag, proc = c.run_until(c.loop.spawn(seed()), timeout=120)
+    c.power_fail_reboot()
+    db2 = c.database()
+
+    async def check():
+        for i in range(15):
+            assert await read_key(db2, b"tw/%02d" % i) == b"v%02d" % i
+        return True
+
+    assert c.run_until(c.loop.spawn(check()), timeout=120)
+    # The stale twin's file is gone; the live memory store survived.
+    fs = c.sim.fs_for(proc)
+    assert not fs.exists(f"storage-{tag}.btree")
+    assert fs.exists(f"storage-{tag}.wal")
