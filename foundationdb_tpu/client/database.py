@@ -406,6 +406,10 @@ class Transaction:
         # rejected unless explicitly enabled (management/DD transactions).
         if not hasattr(self, "access_system_keys"):
             self.access_system_keys = False
+        # Reference LOCK_AWARE option: commits pass the database lock
+        # fence (\xff/dbLocked); management/DR traffic only.
+        if not hasattr(self, "lock_aware"):
+            self.lock_aware = False
         # REPORT_CONFLICTING_KEYS option + the resulting ranges of the
         # last not_committed attempt, surfaced via
         # \xff\xff/transaction/conflicting_keys (reference RYW +
@@ -744,7 +748,8 @@ class Transaction:
                                    _coalesce(wcr)],
             mutations=self.writes.mutations,
             read_snapshot=read_snapshot,
-            report_conflicting_keys=self.report_conflicting_keys)
+            report_conflicting_keys=self.report_conflicting_keys,
+            lock_aware=self.lock_aware)
         if txn.expected_size() > client_knobs().TRANSACTION_SIZE_LIMIT:
             raise err("transaction_too_large")
         await self.db._await_ready()

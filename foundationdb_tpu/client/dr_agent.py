@@ -52,6 +52,7 @@ class DatabaseBackupAgent:
     async def _set_flag(self, on: bool) -> Version:
         t = self.src.create_transaction()
         t.access_system_keys = True
+        t.lock_aware = True        # switchover sets the flag under lock
         while True:
             try:
                 t.set(BACKUP_STARTED_KEY, b"1" if on else b"0")
@@ -179,10 +180,14 @@ class DatabaseBackupAgent:
         return target
 
     async def switchover(self) -> Version:
-        """Drained handover (reference atomicSwitchover): stop source
+        """Drained handover (reference atomicSwitchover): LOCK the source
+        (write fence — no commit can land past the drain point), stop
         capture, apply the tail, and return the version through which the
         target is an exact copy.  The caller then points clients at the
-        target cluster."""
+        target cluster; the source stays locked until an operator
+        unlock_database()s it."""
+        from .management import lock_database
+        await lock_database(self.src, uid=b"dr:" + self.tag.encode())
         stop_version = await self._set_flag(False)
         while self.applied_through < stop_version - 1:
             await delay(0.05)

@@ -79,6 +79,60 @@ async def change_configuration(db, **fields) -> None:
     await _retrying(db, go)
 
 
+async def lock_database(db, uid: bytes = None) -> bytes:
+    """Lock the database (reference ManagementAPI lockDatabase /
+    `fdbcli lock`): commits a UID to \\xff/dbLocked; from that version
+    on, proxies reject every non-LOCK_AWARE commit with database_locked.
+    Returns the UID (needed to unlock).  Locking an already-locked
+    database with a DIFFERENT uid raises database_locked."""
+    from ..core.error import err
+    from ..server.system_data import DB_LOCKED_KEY
+    if uid is None:
+        from ..core.rng import deterministic_random
+        uid = deterministic_random().random_unique_id()[:16].encode()
+    t = db.create_transaction()
+    t.access_system_keys = True
+    t.lock_aware = True
+    while True:
+        try:
+            cur = await t.get(DB_LOCKED_KEY)
+            if cur is not None and cur != uid:
+                raise err("database_locked",
+                          "already locked by another uid")
+            if cur is None:
+                t.set(DB_LOCKED_KEY, uid)
+                await t.commit()
+            return uid
+        except FdbError as e:
+            if e.name == "database_locked":
+                raise
+            await t.on_error(e)
+
+
+async def unlock_database(db, uid: bytes) -> None:
+    """Unlock (reference unlockDatabase / `fdbcli unlock`): the UID must
+    match the one that locked, or database_locked is raised."""
+    from ..core.error import err
+    from ..server.system_data import DB_LOCKED_KEY
+    t = db.create_transaction()
+    t.access_system_keys = True
+    t.lock_aware = True
+    while True:
+        try:
+            cur = await t.get(DB_LOCKED_KEY)
+            if cur is None:
+                return
+            if cur != uid:
+                raise err("database_locked", "uid mismatch")
+            t.clear(DB_LOCKED_KEY)
+            await t.commit()
+            return
+        except FdbError as e:
+            if e.name == "database_locked":
+                raise
+            await t.on_error(e)
+
+
 async def change_coordinators(db, new_spec: str) -> None:
     """changeQuorum (reference fdbclient/ManagementAPI.actor.cpp
     changeQuorumChecker): verify the target quorum answers a coordinated

@@ -46,6 +46,7 @@ ERROR_CODES = {
     "request_maybe_delivered": 1034,
     "proxy_memory_limit_exceeded": 1042,
     "cluster_version_changed": 1039,
+    "database_locked": 1038,
     "master_recovery_failed": 1201,
     "tlog_stopped": 1206,
     "worker_removed": 1202,

@@ -158,6 +158,10 @@ class CommitProxy:
         # While a backup is active (\xff/backupStarted set), every user
         # mutation additionally rides BACKUP_TAG for the backup worker.
         self.backup_active = False
+        # Database lock UID (\xff/dbLocked): while set, commits from
+        # transactions without lock_aware are rejected (reference
+        # databaseLockedKey fencing; DR switchover locks the source).
+        self.db_locked: Optional[bytes] = None
         # Exactly-once cursor over foreign state transactions (version,
         # origin proxy, seq); see _apply_foreign_state.
         self._state_hwm: Tuple[Version, str, int] = (-1, "", -1)
@@ -181,6 +185,15 @@ class CommitProxy:
                 from ..core.error import err
                 first.reply.send_error(err("commit_unknown_result"))
                 continue
+            if self.db_locked is not None and \
+                    not getattr(first.transaction, "lock_aware", False):
+                # Locked database (reference databaseLockedKey): fenced
+                # BEFORE batching so a locked txn never touches the
+                # resolver window or the mutation stream.
+                from ..core.error import err
+                self.metrics.counter("TxnRejectedLocked").add(1)
+                first.reply.send_error(err("database_locked"))
+                continue
             batch = [first]
             batch_bytes = first.transaction.expected_size()
             if buggify("proxy.earlyBatchClose"):
@@ -196,6 +209,13 @@ class CommitProxy:
                    len(batch) < knobs.COMMIT_TRANSACTION_BATCH_COUNT_MAX):
                 if not queue.empty():
                     req = await queue.pop()
+                    if self.db_locked is not None and \
+                            not getattr(req.transaction, "lock_aware",
+                                        False):
+                        from ..core.error import err
+                        self.metrics.counter("TxnRejectedLocked").add(1)
+                        req.reply.send_error(err("database_locked"))
+                        continue
                     batch.append(req)
                     batch_bytes += req.transaction.expected_size()
                     continue
@@ -450,10 +470,17 @@ class CommitProxy:
         backup-active flag, and storage-server registry (serverTag) rejoin
         updates.  True if the mutation was metadata."""
         handled, backup_flag = apply_metadata_mutation(self.key_servers, m)
-        from .system_data import BACKUP_CONTAINER_KEY
+        from .system_data import BACKUP_CONTAINER_KEY, DB_LOCKED_KEY
         if m.type == MutationType.SetValue and \
                 m.param1 == BACKUP_CONTAINER_KEY:
             self.backup_container = m.param2.decode()
+            handled = True
+        if m.type == MutationType.SetValue and m.param1 == DB_LOCKED_KEY:
+            self.db_locked = m.param2
+            handled = True
+        elif m.type == MutationType.ClearRange and \
+                m.param1 <= DB_LOCKED_KEY < m.param2:
+            self.db_locked = None
             handled = True
         if backup_flag is not None:
             self.backup_active = backup_flag

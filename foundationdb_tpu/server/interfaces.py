@@ -685,6 +685,9 @@ class InitializeCommitProxyRequest:
     storage_interfaces: Dict[Tag, Any]
     recovery_version: Version
     backup_active: bool = False
+    # Database lock UID as of recovery (committed \xff/dbLocked): the
+    # proxy enforces the fence from its first batch.
+    db_locked: Optional[bytes] = None
     # Region replication: mirror every storage-tag mutation onto its twin
     # tag (and TXS onto REMOTE_TXS) so the log routers can pull them.
     region_replication: bool = False

@@ -228,6 +228,10 @@ class DBCoreState:
     # Active backup's container URL (committed alongside the flag): the
     # recruited backup worker role resumes appending here.
     backup_container: str = ""
+    # Database lock UID (\xff/dbLocked): recruited proxies must enforce
+    # the fence from their first batch, even after a full power failure
+    # (the lock is committed data; reference databaseLockedKey).
+    locked: Optional[bytes] = None
 
     def pack(self) -> bytes:
         from ..core.wire import Writer
@@ -262,6 +266,9 @@ class DBCoreState:
         for tag, sid in rs_ids.items():
             w.u32(tag).str_(sid)
         w.str_(self.backup_container)
+        w.u8(1 if self.locked is not None else 0)
+        if self.locked is not None:
+            w.bytes_(self.locked)
         return w.done()
 
     @staticmethod
@@ -302,6 +309,9 @@ class DBCoreState:
                                   for _ in range(r.u16())}
         if not r.at_end():
             backup_container = r.str_()
+        locked: Optional[bytes] = None
+        if not r.at_end() and r.u8():
+            locked = r.bytes_()
         return cls(epoch=epoch, recovery_version=rv,
                    tlogs=[None] * len(tlog_ids), log_replication=log_rep,
                    storage_servers={t: None for t in storage_ids},
@@ -311,7 +321,7 @@ class DBCoreState:
                    conf=conf, remote_tlog_ids=remote_tlog_ids,
                    remote_storage={t: None for t in remote_storage_ids},
                    remote_storage_ids=remote_storage_ids,
-                   backup_container=backup_container)
+                   backup_container=backup_container, locked=locked)
 
 
 def _split_points(n: int) -> List[bytes]:
@@ -769,6 +779,13 @@ async def master_server(master: Master, process, coordinators,
                         if m.type == _MT.SetValue and \
                                 m.param1 == BACKUP_CONTAINER_KEY:
                             prev.backup_container = m.param2.decode()
+                        from .system_data import DB_LOCKED_KEY
+                        if m.type == _MT.SetValue and \
+                                m.param1 == DB_LOCKED_KEY:
+                            prev.locked = m.param2
+                        elif m.type == _MT.ClearRange and \
+                                m.param1 <= DB_LOCKED_KEY < m.param2:
+                            prev.locked = None
                         cf = parse_conf_mutation(m)
                         if cf is not None:
                             # Configuration changes committed since the
@@ -1184,6 +1201,7 @@ async def master_server(master: Master, process, coordinators,
                 storage_interfaces=storage_servers,
                 recovery_version=recovery_version,
                 backup_active=prev.backup_active if prev else False,
+                db_locked=prev.locked if prev else None,
                 region_replication=bool(remote_tlogs),
                 storage_caches=storage_caches,
                 tss_mapping=tss_mapping))
@@ -1213,7 +1231,8 @@ async def master_server(master: Master, process, coordinators,
             conf=dict(prev.conf) if prev else {},
             remote_tlogs=remote_tlogs,
             remote_storage=remote_storage,
-            backup_container=prev.backup_container if prev else ""))
+            backup_container=prev.backup_container if prev else "",
+            locked=prev.locked if prev else None))
 
         # ACCEPTING_COMMITS (:1943): start the allocator + announce.
         adopt(master._serve_commit_versions(), "master.serveVersions")

@@ -41,6 +41,12 @@ SERVER_TAG_END = b"\xff/serverTag0"
 EXCLUDED_PREFIX = b"\xff/conf/excluded/"
 EXCLUDED_END = b"\xff/conf/excluded0"
 BACKUP_STARTED_KEY = b"\xff/backupStarted"
+
+# Database lock (reference databaseLockedKey, SystemData.cpp): value is
+# the locking UID; while set, commit proxies reject every transaction
+# not flagged LOCK_AWARE with database_locked.  DR switchover uses it to
+# fence writes on the source cluster.
+DB_LOCKED_KEY = b"\xff/dbLocked"
 # Container URL of the active backup (committed with the flag; reference
 # backup config in \xff/backup/ via TaskBucket): the recruited backup
 # worker role appends the log stream here (server/backup_worker.py).

@@ -331,6 +331,7 @@ class Worker:
             key_resolvers, key_servers, req.storage_interfaces,
             req.recovery_version)
         proxy.backup_active = req.backup_active
+        proxy.db_locked = getattr(req, "db_locked", None)
         proxy.region_replication = getattr(req, "region_replication", False)
         proxy.storage_caches = list(getattr(req, "storage_caches", ()) or ())
         tssm = dict(getattr(req, "tss_mapping", None) or {})

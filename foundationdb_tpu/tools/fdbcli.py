@@ -41,6 +41,8 @@ Commands (reference fdbcli command set):
   status [json]              cluster status summary (or the raw document)
   configure FIELD=VALUE ...  change configuration transactionally
   getconfiguration           committed \\xff/conf overrides
+  lock                       reject non-LOCK_AWARE commits (prints uid)
+  unlock UID                 release the database lock
   exclude TAG [TAG...]       drain + exclude storage servers by tag
   include [TAG...]           re-admit excluded servers (no args: all)
   excluded                   list excluded tags
@@ -140,6 +142,17 @@ class Cli:
             fields[k] = v
         self.run_async(change_configuration(self.db, **fields), timeout=60)
         return "Configuration changed"
+
+    def cmd_lock(self) -> str:
+        from ..client.management import lock_database
+        uid = self.run_async(lock_database(self.db), timeout=60)
+        return (f"Database locked (uid {uid.decode()}). Only LOCK_AWARE "
+                "transactions commit until `unlock <uid>'.")
+
+    def cmd_unlock(self, uid: str) -> str:
+        from ..client.management import unlock_database
+        self.run_async(unlock_database(self.db, uid.encode()), timeout=60)
+        return "Database unlocked"
 
     def cmd_getconfiguration(self) -> str:
         from ..client.management import get_configuration

@@ -149,6 +149,9 @@ class CommitTransactionRef:
     mutations: List[Mutation] = field(default_factory=list)
     read_snapshot: Version = 0
     report_conflicting_keys: bool = False
+    # LOCK_AWARE transaction option (reference FDBTransactionOptions):
+    # commits pass the \xff/dbLocked fence — management/DR traffic only.
+    lock_aware: bool = False
 
     def expected_size(self) -> int:
         s = sum(len(r.begin) + len(r.end) for r in

@@ -7,6 +7,7 @@ snapshot + continuous apply + drained switchover)."""
 import pytest
 
 from foundationdb_tpu.client.taskbucket import TaskBucket, run_tasks
+from foundationdb_tpu.core.error import FdbError
 from foundationdb_tpu.core.scheduler import delay
 from foundationdb_tpu.server.cluster import SimFdbCluster
 from foundationdb_tpu.server.interfaces import DatabaseConfiguration
@@ -157,6 +158,21 @@ def test_dr_to_second_cluster_and_switchover(teardown):  # noqa: F811
         await agent.switchover()
         await commit_kv(dst_db, b"dr/post", b"target-live")
         assert await read_key(dst_db, b"dr/post") == b"target-live"
+        # The source is LOCKED (reference atomicSwitchover write fence):
+        # plain commits bounce with database_locked until an operator
+        # unlocks; reads still work.
+        t = src_db.create_transaction()
+        t.set(b"dr/stale", b"must-not-land")
+        try:
+            await t.commit()
+            raise AssertionError("source accepted a commit after "
+                                 "switchover")
+        except FdbError as e:
+            assert e.name == "database_locked", e.name
+        assert await read_key(src_db, b"dr/003") == b"updated"
+        from foundationdb_tpu.client.management import unlock_database
+        await unlock_database(src_db, b"dr:dr")
+        await commit_kv(src_db, b"dr/unlocked", b"ok")
         return True
 
     assert src.run_until(src.loop.spawn(go()), timeout=600)
