@@ -18,13 +18,18 @@ target (BASELINE.md).  Also measured and printed on the same JSON line:
   commit_rate_low  low-contention regime (uniform 100M keys, ~all commit)
 
 Resilience (the round-3 run produced NO number because one axon-tunnel
-outage crashed the process): the measurement runs in a CHILD process.
-The parent probes the TPU backend with a bounded-timeout trivial jit
-(retried with backoff — the tunnel hangs rather than erroring when down),
-runs the child under a timeout, and on persistent TPU failure re-runs the
-child on the JAX CPU backend so a real, parity-checked number is always
-emitted — with an "error" field recording the degradation.  The parent
-ALWAYS prints exactly one JSON line with at least:
+outage crashed the process; the round-5 run produced NO number because
+probing outlived the driver's timeout): the measurement runs in a CHILD
+process, and the parent budgets EVERYTHING from one external deadline
+(BENCH_DEADLINE_S).  A provisional fallback JSON line (carrying the
+last-known-good TPU figure) is printed FIRST, so even a SIGKILL at any
+later point leaves a parseable artifact; the parent then probes the TPU
+backend with bounded-timeout trivial jits (the tunnel hangs rather than
+erroring when down), runs the child under the remaining budget, and on
+persistent TPU failure re-runs the child on the JAX CPU backend so a
+real, parity-checked number supersedes the provisional line — with an
+"error" field recording the degradation.  The LAST JSON line on stdout is
+always the best available result:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
 
@@ -56,14 +61,33 @@ CAPACITY = 1 << 21
 DELTA_CAPACITY = 1 << 20
 
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
-# Long-horizon probe schedule: the axon tunnel has documented multi-minute
-# outages that can end — surrendering after ~7 minutes wasted a whole round
-# (round 4).  Keep re-probing every PROBE_INTERVAL_S until PROBE_TOTAL_S
-# elapses before falling back to XLA-CPU.
-PROBE_INTERVAL_S = int(os.environ.get("BENCH_PROBE_INTERVAL", "300"))
+# The whole run is budgeted from ONE externally supplied deadline
+# (BENCH_DEADLINE_S): round 5 lost its entire window because the probe
+# schedule assumed the bench owned its wall clock while the driver's
+# timeout fired first (BENCH_r05.json rc=124, parsed=null).  Every phase
+# below (probing, TPU child, CPU-fallback child) is clipped to the time
+# remaining under the deadline, and a provisional fallback JSON line is
+# printed FIRST so even a SIGKILL mid-run leaves a parseable artifact.
+# The default must sit comfortably under any sane driver timeout.
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
+# Fraction of the deadline reserved for the XLA-CPU fallback child (it
+# must still fit after probing + a failed TPU attempt burn their share).
+CPU_RESERVE_S = float(os.environ.get("BENCH_CPU_RESERVE",
+                                     str(min(600.0, DEADLINE_S * 0.4))))
+# Probe schedule inside the budget: tunnel outages are often transient,
+# so re-probe every PROBE_INTERVAL_S — but never past the point where the
+# CPU fallback could no longer run.
+PROBE_INTERVAL_S = int(os.environ.get("BENCH_PROBE_INTERVAL", "120"))
 PROBE_TOTAL_S = int(os.environ.get("BENCH_PROBE_TOTAL", "2700"))
 CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2700"))
 CPU_CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CPU_CHILD_TIMEOUT", "2400"))
+
+_START_MONO = time.monotonic()
+
+
+def _remaining_s() -> float:
+    """Seconds left under the external deadline."""
+    return DEADLINE_S - (time.monotonic() - _START_MONO)
 # Last-known-good real-TPU figure, persisted next to this file on every
 # successful TPU run and re-emitted with stale:true on fallback, so an
 # outage round still reports the project's actual measured capability.
@@ -189,13 +213,17 @@ def child_main(backend: str) -> None:
     try:
         # Persistent XLA compile cache: the axon tunnel's remote compile
         # costs minutes per program shape; a crashed/retried run should
-        # not pay it twice.
+        # not pay it twice.  Gated on modern jax — on 0.4.x, executables
+        # reloaded from this cache for mesh-sharded programs on XLA:CPU
+        # were observed to return wrong verdicts and corrupt the heap
+        # (tests/conftest.py carries the same gate).
         import jax
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("JAX_CACHE_DIR",
-                                         "/tmp/jax_bench_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          1.0)
+        if hasattr(jax, "shard_map"):
+            jax.config.update("jax_compilation_cache_dir",
+                              os.environ.get("JAX_CACHE_DIR",
+                                             "/tmp/jax_bench_cache"))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # noqa: BLE001 — older jax: cache is best-effort
         pass
     if os.environ.get("BENCH_SMALL") == "1":
@@ -396,18 +424,24 @@ _PROBE_SRC = ("import jax, numpy as np; "
 def _probe_tpu() -> bool:
     """Trivial jit on the default (axon/TPU) backend with a hard timeout.
     The tunnel HANGS rather than erroring when down, so an in-process
-    probe could wedge the whole benchmark.  Probes repeat on a long
-    horizon (see PROBE_TOTAL_S): tunnel outages are often transient and a
-    round's headline number is worth waiting most of an hour for."""
-    deadline = time.monotonic() + PROBE_TOTAL_S
+    probe could wedge the whole benchmark.  Probes repeat (tunnel outages
+    are often transient) but ONLY while the external deadline leaves room
+    for the probe itself plus the CPU-fallback reserve — the round-5
+    failure mode was probing past the driver's own timeout."""
+    probe_deadline = time.monotonic() + PROBE_TOTAL_S
     attempt = 0
     while True:
+        budget = min(PROBE_TIMEOUT_S, _remaining_s() - CPU_RESERVE_S)
+        if budget <= 5:
+            print("# probe window exhausted by BENCH_DEADLINE_S budget",
+                  file=sys.stderr)
+            return False
         attempt += 1
         started = time.monotonic()
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
-                timeout=PROBE_TIMEOUT_S, capture_output=True, text=True,
+                timeout=budget, capture_output=True, text=True,
                 cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
             if r.returncode == 0 and "probe-ok" in r.stdout:
                 return True
@@ -416,8 +450,9 @@ def _probe_tpu() -> bool:
                   file=sys.stderr)
         except subprocess.TimeoutExpired:
             print(f"# tpu probe attempt {attempt} timed out "
-                  f"({PROBE_TIMEOUT_S}s)", file=sys.stderr)
-        remaining = deadline - time.monotonic()
+                  f"({int(budget)}s)", file=sys.stderr)
+        remaining = min(probe_deadline - time.monotonic(),
+                        _remaining_s() - CPU_RESERVE_S)
         if remaining <= 0:
             return False
         wait = min(max(PROBE_INTERVAL_S - (time.monotonic() - started), 5),
@@ -492,48 +527,72 @@ def _run_child(backend: str, platform_env: str, timeout_s: int):
     return None, "child produced no JSON line"
 
 
+def _provisional_line() -> None:
+    """Print a parseable provisional JSON result IMMEDIATELY (before any
+    probing): if the driver's timeout kills this process at ANY later
+    point, the captured stdout still ends with (at least) this line, so
+    the round records the last-known-good figure instead of parsed=null.
+    Every later phase prints a fresh (final) line that supersedes it."""
+    print(json.dumps(_attach_lkg({
+        "metric": "conflict_range_checks_per_s", "value": 0.0,
+        "unit": "ranges/s", "vs_baseline": 0.0, "provisional": True,
+        "error": "provisional: measurement still running when emitted "
+                 f"(deadline budget {int(DEADLINE_S)}s)"})), flush=True)
+
+
 def parent_main(backend: str) -> None:
     errors = []
     if backend == "tpu":
+        _provisional_line()
         forced = os.environ.get("BENCH_FORCE_FALLBACK") == "1"
         if not forced and _probe_tpu():
             for attempt in range(2):
-                parsed, note = _run_child("tpu", "", CHILD_TIMEOUT_S)
+                budget = _remaining_s() - CPU_RESERVE_S
+                if budget <= 30:
+                    errors.append("tpu child skipped: deadline budget "
+                                  "exhausted")
+                    break
+                parsed, note = _run_child(
+                    "tpu", "", min(CHILD_TIMEOUT_S, budget))
                 if parsed is not None:
                     _save_lkg(parsed)
-                    print(json.dumps(parsed))
+                    print(json.dumps(parsed), flush=True)
                     return
                 errors.append(f"tpu run {attempt + 1}: {note}")
                 print(f"# {errors[-1]}", file=sys.stderr)
         else:
             errors.append(
                 "forced fallback (BENCH_FORCE_FALLBACK=1)" if forced else
-                f"axon/TPU backend unreachable after {PROBE_TOTAL_S}s of "
-                f"probing every {PROBE_INTERVAL_S}s")
+                "axon/TPU backend unreachable within the probe budget "
+                f"(deadline {int(DEADLINE_S)}s)")
         # Degraded mode: same kernels, same parity assertions, XLA CPU,
         # smaller stream (a full-size run exceeds any sane timeout there).
         print("# falling back to JAX CPU backend", file=sys.stderr)
         os.environ["BENCH_SMALL"] = "1"
-        parsed, note = _run_child("tpu", "cpu", CPU_CHILD_TIMEOUT_S)
+        budget = max(_remaining_s() - 15, 30)
+        parsed, note = _run_child("tpu", "cpu",
+                                  min(CPU_CHILD_TIMEOUT_S, budget))
         if parsed is not None:
             parsed["error"] = ("TPU unavailable; measured on XLA-CPU "
                                "fallback — " + "; ".join(errors))
-            print(json.dumps(_attach_lkg(parsed)))
+            print(json.dumps(_attach_lkg(parsed)), flush=True)
             return
         errors.append(f"cpu fallback: {note}")
         print(json.dumps(_attach_lkg({
             "metric": "conflict_range_checks_per_s", "value": 0.0,
             "unit": "ranges/s", "vs_baseline": 0.0,
-            "error": "; ".join(errors)})))
+            "error": "; ".join(errors)})), flush=True)
         return
     # backend == "cpu": oracle-only mode, no TPU involved.
-    parsed, note = _run_child("cpu", "cpu", CPU_CHILD_TIMEOUT_S)
+    parsed, note = _run_child("cpu", "cpu",
+                              min(CPU_CHILD_TIMEOUT_S,
+                                  max(_remaining_s() - 15, 30)))
     if parsed is not None:
-        print(json.dumps(parsed))
+        print(json.dumps(parsed), flush=True)
         return
     print(json.dumps({
         "metric": "conflict_range_checks_per_s", "value": 0.0,
-        "unit": "ranges/s", "vs_baseline": 0.0, "error": note}))
+        "unit": "ranges/s", "vs_baseline": 0.0, "error": note}), flush=True)
 
 
 def main() -> None:

@@ -147,6 +147,9 @@ class Database:
         # TSS comparison mismatches observed by this client (reference
         # TSS metrics); tests assert on it.
         self.tss_mismatches = 0
+        # Shadows this client already quarantined (by mirror endpoint):
+        # no further comparison traffic is sent to a benched TSS.
+        self._tss_quarantined: set = set()
 
     from ..rpc.endpoint import TRANSPORT_ERRORS as _FAILOVER_ERRORS
 
@@ -186,6 +189,8 @@ class Database:
         from ..core.knobs import client_knobs
         from ..core.rng import deterministic_random
         from ..core.scheduler import spawn as _spawn
+        if self._replica_key(pair) in self._tss_quarantined:
+            return              # already benched: no more compare traffic
         rate = float(client_knobs().TSS_SAMPLE_RATE)
         if rate < 1.0 and deterministic_random().random01() > rate:
             return
@@ -207,8 +212,39 @@ class Database:
                         "Field", attr).detail(
                         "Primary", repr(a)[:80]).detail(
                         "Shadow", repr(b)[:80]).log()
+                    await self._quarantine_tss(pair, attr)
                     return
         _spawn(compare(), "client.tssCompare")
+
+    async def _quarantine_tss(self, pair, field: str) -> None:
+        """Bench a mismatching shadow (reference tssQuarantine follow-up to
+        TSSComparison): tell the TSS to stop serving, and record the
+        quarantine in the system keyspace so operators can find — and,
+        after inspection, clear — it.  Both steps are best-effort: the
+        mismatch is already traced, and a dead shadow needs no benching."""
+        from ..core.error import FdbError
+        from ..server.interfaces import TssQuarantineRequest
+        from ..server.system_data import tss_quarantine_key
+        self._tss_quarantined.add(self._replica_key(pair))
+        try:
+            await RequestStream.at(
+                pair.tss_quarantine.endpoint).get_reply(
+                TssQuarantineRequest(reason=f"mismatch on {field}"))
+        except FdbError:
+            pass
+        for _ in range(5):      # commit the marker; retry cheap conflicts
+            t = self.create_transaction()
+            t.access_system_keys = True
+            try:
+                t.set(tss_quarantine_key(getattr(pair, "tag", 0)),
+                      field.encode())
+                await t.commit()
+                return
+            except FdbError as e:
+                try:
+                    await t.on_error(e)
+                except FdbError:
+                    return
 
     async def read_replica(self, ssis, stream_of, make_request):
         """One storage read with REPLICA FAILOVER and HEDGING (reference

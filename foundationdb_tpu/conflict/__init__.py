@@ -1,7 +1,11 @@
-"""ConflictSet backends: CPU oracle, native C++, TPU kernel (north star)."""
+"""ConflictSet backends: CPU oracle, native C++, TPU kernel (north star),
+and the supervision layer that keeps device backends production-shaped."""
 
 from .api import ConflictSet, new_conflict_set
 from .oracle import OracleConflictSet, VersionHistory
+from .supervisor import (BackendHealthMonitor, SupervisedConflictSet,
+                         host_digest)
 
 __all__ = ["ConflictSet", "new_conflict_set", "OracleConflictSet",
-           "VersionHistory"]
+           "VersionHistory", "SupervisedConflictSet",
+           "BackendHealthMonitor", "host_digest"]

@@ -59,17 +59,24 @@ class ConflictSet:
         note in ReadYourWrites.actor.cpp); OracleConflictSet reports the
         exact ranges."""
         verdicts = self.resolve(transactions, now, new_oldest_version)
-        ranges = {}
-        for i, (v, tr) in enumerate(zip(verdicts, transactions)):
-            if v == CommitResult.CONFLICT and \
-                    getattr(tr, "report_conflicting_keys", False):
-                ranges[i] = [(r.begin, r.end)
-                             for r in tr.read_conflict_ranges]
-        return verdicts, ranges
+        return verdicts, conservative_conflict_ranges(verdicts, transactions)
 
     def clear(self, version: Version) -> None:
         """Reset all history (reference clearConflictSet)."""
         raise NotImplementedError
+
+
+def conservative_conflict_ranges(verdicts, transactions) -> dict:
+    """{txn_index: [(begin, end), ...]} reporting EVERY read range of each
+    conflicted reporter — the conservative superset contract shared by the
+    base class and the supervisor's device path."""
+    ranges: dict = {}
+    for i, (v, tr) in enumerate(zip(verdicts, transactions)):
+        if v == CommitResult.CONFLICT and \
+                getattr(tr, "report_conflicting_keys", False):
+            ranges[i] = [(r.begin, r.end)
+                         for r in tr.read_conflict_ranges]
+    return ranges
 
 
 def new_conflict_set(backend: Optional[str] = None,
@@ -78,7 +85,14 @@ def new_conflict_set(backend: Optional[str] = None,
 
     "auto" resolves at creation time: the TPU backend when a JAX accelerator
     is attached, otherwise the CPU oracle (the window state is a single
-    shared history, so the choice cannot vary per batch)."""
+    shared history, so the choice cannot vary per batch).
+
+    Device backends ("tpu" and the mesh-"sharded" variant) are wrapped in
+    the supervision layer (conflict/supervisor.py) unless the
+    CONFLICT_BACKEND_SUPERVISED knob is off: deadline-budgeted dispatch,
+    health-monitored degrade to an exact CPU mirror, re-probe/promotion,
+    and the exact long-key recheck.  `backend="tpu-raw"` bypasses the
+    supervisor explicitly (tests of the bare device path)."""
     backend = backend or server_knobs().CONFLICT_SET_BACKEND
     if backend == "auto":
         backend = "cpu"
@@ -101,9 +115,24 @@ def new_conflict_set(backend: Optional[str] = None,
     if backend == "cpu":
         from .oracle import OracleConflictSet
         return OracleConflictSet(oldest_version)
-    if backend == "tpu":
-        from .tpu_backend import TpuConflictSet
-        return TpuConflictSet(oldest_version, **kwargs)
+    if backend in ("tpu", "tpu-raw", "sharded"):
+        sharded = backend == "sharded"
+
+        def make_device(oldest_version: Version = oldest_version):
+            if sharded:
+                import jax
+                from ..parallel.sharded_resolver import ShardedTpuConflictSet
+                from ..parallel.sharded_window import make_conflict_mesh
+                mesh = make_conflict_mesh(jax.devices())
+                return ShardedTpuConflictSet(mesh, oldest_version, **kwargs)
+            from .tpu_backend import TpuConflictSet
+            return TpuConflictSet(oldest_version, **kwargs)
+
+        if backend != "tpu-raw" and \
+                server_knobs().CONFLICT_BACKEND_SUPERVISED:
+            from .supervisor import SupervisedConflictSet
+            return SupervisedConflictSet(make_device, oldest_version)
+        return make_device()
     if backend == "native":
         from .native import NativeConflictSet
         return NativeConflictSet(oldest_version)

@@ -17,6 +17,11 @@ P_FIRES = 0.25
 
 _enabled = False
 _site_active: Dict[str, bool] = {}
+# Deterministic per-site overrides (tests/chaos drivers): True = the site
+# fires on EVERY evaluation, False = never, absent = probabilistic.
+# Overrides apply even with buggify globally disabled, so a chaos test
+# can kill exactly one site without randomizing every other one.
+_forced: Dict[str, bool] = {}
 
 
 def enable_buggify(on: bool = True) -> None:
@@ -29,8 +34,23 @@ def buggify_enabled() -> bool:
     return _enabled
 
 
+def force_buggify(site: str, fire: bool = True) -> None:
+    """Pin a site: buggify(site) returns `fire` until unforce_buggify."""
+    _forced[site] = fire
+
+
+def unforce_buggify(site: str = None) -> None:
+    """Drop one forced site (or all of them with no argument)."""
+    if site is None:
+        _forced.clear()
+    else:
+        _forced.pop(site, None)
+
+
 def buggify(site: str) -> bool:
     """True (rarely, deterministically) when fault injection should happen."""
+    if site in _forced:
+        return _forced[site]
     if not _enabled:
         return False
     rng = deterministic_random()

@@ -107,6 +107,31 @@ class ServerKnobs(KnobBase):
         self.CONFLICT_SET_BACKEND = "cpu"
         self.TPU_CONFLICT_CAPACITY = 1 << 17  # max resident history segments
 
+        # Device-backend supervision (conflict/supervisor.py): deadline
+        # budget per device call, transient-retry policy, health-trip
+        # thresholds, and the degraded-mode re-probe cadence.  Device
+        # backends ("tpu"/"sharded") are wrapped in the supervisor by
+        # default so a dead/stalling accelerator degrades the Resolver to
+        # the exact CPU mirror instead of wedging the commit pipeline.
+        self.CONFLICT_BACKEND_SUPERVISED = True
+        # Per-call deadline.  Generous: its job is catching INDEFINITE
+        # hangs (a dead tunnel), not slow batches — first-use-of-a-shape
+        # calls legitimately carry minutes of in-band XLA compile (the
+        # axon remote compile service measured 150-400s/shape, PERF.md).
+        self.CONFLICT_DEVICE_TIMEOUT_S = 600.0    # 0 disables thread guard
+        self.CONFLICT_DEVICE_MAX_RETRIES = 2      # transient-error retries
+        self.CONFLICT_DEVICE_RETRY_BACKOFF_S = 0.05   # doubles per retry
+        # Health-monitor failure-streak length (BackendHealthMonitor).
+        # NOTE: an UNRECOVERED hard failure always degrades the backend
+        # immediately — a mid-batch failure leaves device state
+        # unknowable, and wrong verdicts are worse than a conservative
+        # degrade — so this streak matters for monitors tracking
+        # survivable signals, not for hard dispatch/wait errors.
+        self.CONFLICT_BACKEND_FAILURE_THRESHOLD = 3
+        self.CONFLICT_DEVICE_LATENCY_SLO_S = 0.0  # 0 disables the SLO trip
+        self.CONFLICT_DEVICE_SLO_STRIKES = 8      # consecutive slow batches
+        self.CONFLICT_BACKEND_REPROBE_S = 5.0     # doubles per failed probe
+
         # Resolution balancing (reference masterserver.actor.cpp:1318)
         self.RESOLUTION_BALANCING_INTERVAL = 0.5
         self.RESOLUTION_BALANCING_MIN_LOAD = 50   # ranges/poll to bother

@@ -290,6 +290,24 @@ class EventLoop:
             if steps >= max_steps:
                 raise err("internal_error", "EventLoop.drain exceeded max_steps")
 
+    def shutdown(self) -> None:
+        """Close actors that were spawned but never stepped.  A discarded
+        loop (workload teardown, cluster restart) can hold ActorTasks whose
+        _initial_step never ran; their coroutine objects would emit
+        "coroutine ... was never awaited" RuntimeWarnings at GC — exactly
+        where a dropped-callback liveness bug would hide, so the teardown
+        path must be warning-clean by construction.  Started actors are
+        left alone: their coroutines have begun and GC handles them
+        silently."""
+        for task in list(self._tasks):
+            if not task._started and not task._finished:
+                task._finished = True
+                try:
+                    task.coro.close()
+                except Exception:  # noqa: BLE001 — teardown is best-effort
+                    pass
+        self._tasks.clear()
+
 
 # ---------------------------------------------------------------------------
 # Global current-loop access (the reference's g_network equivalent)
@@ -299,8 +317,14 @@ _current: Optional[EventLoop] = None
 
 
 def set_event_loop(loop: Optional[EventLoop]) -> None:
+    """Install `loop` as the current reactor.  A DIFFERENT loop being
+    replaced is shut down (see EventLoop.shutdown): the old world is dead,
+    and its never-started actors must not leak warning-emitting coroutine
+    objects into the new one's run."""
     global _current
-    _current = loop
+    old, _current = _current, loop
+    if old is not None and old is not loop:
+        old.shutdown()
 
 
 def get_event_loop() -> EventLoop:

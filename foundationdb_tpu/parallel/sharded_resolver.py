@@ -40,7 +40,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..conflict.tpu_backend import TpuConflictSet
 from ..ops.digest import KEY_LANES, MAX_DIGEST
 from ..ops.rangemax import NEG_INF
-from .sharded_window import digest_splits, make_conflict_mesh  # noqa: F401
+from .sharded_window import (digest_splits, jit_sharded,  # noqa: F401
+                             make_conflict_mesh, shard_map_compat)
 
 
 class ShardedTpuConflictSet(TpuConflictSet):
@@ -137,14 +138,12 @@ class ShardedTpuConflictSet(TpuConflictSet):
         spec_state3 = P("kr", None, None)
         spec_state2 = P("kr", None)
         spec_1 = P("kr")
-        mapped = jax.shard_map(
-            shard_fn, mesh=self.mesh,
+        mapped = shard_map_compat(shard_fn, self.mesh,
             in_specs=(spec_state3, spec_state2, spec_state3, spec_1,
                       spec_state3, spec_state2, spec_1, spec_1,
                       P(None, None), P(None), spec_state3),
-            out_specs=(spec_state3, spec_state2, spec_1, spec_1, P(None)),
-            check_vma=False)
-        fn = jax.jit(mapped, donate_argnums=(4, 5, 6, 7))
+            out_specs=(spec_state3, spec_state2, spec_1, spec_1, P(None)))
+        fn = jit_sharded(mapped, donate_argnums=(4, 5, 6, 7))
         self._step_cache[key] = fn
         return fn
 
@@ -166,12 +165,10 @@ class ShardedTpuConflictSet(TpuConflictSet):
         s3 = P("kr", None, None)
         s2 = P("kr", None)
         s1 = P("kr")
-        mapped = jax.shard_map(
-            shard_fn, mesh=self.mesh,
+        mapped = shard_map_compat(shard_fn, self.mesh,
             in_specs=(s3, s2, s3, s1, s3, s2, s1, s1, P(None), s3),
-            out_specs=(s3, s2, s1, s1, P(None)),
-            check_vma=False)
-        fn = jax.jit(mapped, donate_argnums=(4, 5, 6, 7))
+            out_specs=(s3, s2, s1, s1, P(None)))
+        fn = jit_sharded(mapped, donate_argnums=(4, 5, 6, 7))
         self._step_cache[key] = fn
         return fn
 
@@ -192,12 +189,10 @@ class ShardedTpuConflictSet(TpuConflictSet):
         s3 = P("kr", None, None)
         s2 = P("kr", None)
         s1 = P("kr")
-        mapped = jax.shard_map(
-            shard_fn, mesh=self.mesh,
+        mapped = shard_map_compat(shard_fn, self.mesh,
             in_specs=(s3, s2, s1, s3, s2, s1, s1, P(None), s2),
-            out_specs=(s3, s2, s3, s1, s3, s2, s1, s1),
-            check_vma=False)
-        fn = jax.jit(mapped, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+            out_specs=(s3, s2, s3, s1, s3, s2, s1, s1))
+        fn = jit_sharded(mapped, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
         self._merge_cache[key] = fn
         return fn
 
@@ -245,3 +240,21 @@ class ShardedTpuConflictSet(TpuConflictSet):
     def shard_sizes(self) -> List[int]:
         """Live base-boundary count per shard (syncs the device)."""
         return [int(x) for x in np.asarray(self.size)]
+
+    @classmethod
+    def supervised(cls, mesh: Mesh, oldest_version=0, monitor=None,
+                   **kwargs):
+        """The mesh-sharded backend under the supervision layer
+        (conflict/supervisor.py): deadline-budgeted dispatch, health
+        monitoring, degrade-to-CPU against the exact mirror, re-probe /
+        promotion (the promotion replay rebuilds the whole sharded window
+        from the mirror history), and the exact long-key recheck.  This is
+        the production-shaped entry point for a resolver running its
+        window across chips."""
+        from ..conflict.supervisor import SupervisedConflictSet
+
+        def make_device(oldest_version=oldest_version):
+            return cls(mesh, oldest_version, **kwargs)
+
+        return SupervisedConflictSet(make_device, oldest_version,
+                                     monitor=monitor)

@@ -490,6 +490,15 @@ class GetKeyValuesReply:
 
 
 @dataclass
+class TssQuarantineRequest:
+    """Take a mismatching TSS shadow out of service (reference
+    storageserver.actor.cpp tssQuarantine): it stops serving reads but
+    keeps applying its mirror tag so the divergence stays inspectable."""
+    reason: str = ""
+    reply: Any = None
+
+
+@dataclass
 class WatchValueRequest:
     key: bytes
     value: Optional[bytes]   # trigger when stored value differs from this
@@ -976,6 +985,12 @@ class StorageServerInterface:
         # storage with the configured storeType for engine migrations).
         self.migrate_engine = RequestStream(
             "storage.migrateEngine", TaskPriority.DefaultEndpoint)
+        # TSS quarantine (reference storageserver.actor.cpp:558-568
+        # tssQuarantine): a detected mismatch takes the shadow out of
+        # service — it stops answering reads but keeps pulling its mirror
+        # tag so the divergent state stays inspectable.
+        self.tss_quarantine = RequestStream(
+            "storage.tssQuarantine", TaskPriority.DefaultEndpoint)
         self.wait_failure = RequestStream("storage.waitFailure",
                                           TaskPriority.FailureMonitor)
 
@@ -983,4 +998,4 @@ class StorageServerInterface:
         return [self.get_value, self.get_key_values, self.watch_value,
                 self.queuing_metrics, self.fetch_keys, self.fetch_shard,
                 self.shard_metrics, self.remove_shard, self.migrate_engine,
-                self.wait_failure]
+                self.tss_quarantine, self.wait_failure]

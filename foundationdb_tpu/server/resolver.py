@@ -113,6 +113,12 @@ class Resolver:
                 req.transactions, req.version, new_oldest_version=new_oldest)
         self.metrics.histogram("Resolve").record(now() - _t0)
         self.metrics.counter("TxnResolved").add(len(req.transactions))
+        if getattr(cs, "degraded", False):
+            # Supervised device backend running on its CPU-mirror fallback
+            # (conflict/supervisor.py): correct but slow — make the
+            # degradation visible to status/ratekeeper consumers.
+            self.metrics.counter("TxnResolvedDegraded").add(
+                len(req.transactions))
         self._sample_batch(req.transactions)
         # Foreign state txns resolved since this proxy last heard from us
         # (strictly before this batch's version; ours are appended below).
@@ -232,5 +238,15 @@ class Resolver:
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),
                       f"{self.id}.waitFailure")
-        TraceEvent("ResolverStarted").detail("Id", self.id).detail(
-            "Backend", type(self.conflict_set).__name__).log()
+        ev = TraceEvent("ResolverStarted").detail("Id", self.id).detail(
+            "Backend", type(self.conflict_set).__name__)
+        inner = getattr(self.conflict_set, "device", None)
+        if inner is not None:
+            ev.detail("Device", type(inner).__name__)
+        ev.log()
+
+    def backend_status(self) -> dict:
+        """Supervision state of the conflict backend (degraded/tripped/
+        fallback counters) for status JSON; {} for unsupervised backends."""
+        status = getattr(self.conflict_set, "status", None)
+        return status() if callable(status) else {}

@@ -99,7 +99,14 @@ async def build_status(cc) -> Dict[str, Any]:
             role = getattr(iface, "role", None)
             metrics = getattr(role, "metrics", None)
             if metrics is not None:
-                entries[metrics.role_id] = metrics.to_status()
+                entry = metrics.to_status()
+                # Resolver conflict-backend supervision state (degraded /
+                # tripped / fallback counters, conflict/supervisor.py).
+                backend = getattr(role, "backend_status", None)
+                bs = backend() if callable(backend) else None
+                if bs:
+                    entry["conflict_backend"] = bs
+                entries[metrics.role_id] = entry
         roles[kind] = entries
 
     return {

@@ -230,6 +230,11 @@ class StorageServer:
         # min_read_version <= the read version, else wrong_shard_server.
         from .shardmap import RangeMap
         self.shards: RangeMap = RangeMap(default=("owned", 0))
+        # TSS quarantine (reference storageserver.actor.cpp:558-568): a
+        # quarantined shadow answers no reads (so no further comparisons
+        # can even fire against it) but keeps pulling its mirror tag —
+        # the divergent state stays alive for inspection.
+        self.quarantined = False
 
     @classmethod
     async def from_engine(cls, engine) -> Optional["StorageServer"]:
@@ -435,9 +440,15 @@ class StorageServer:
             if st[0] != "owned" or version < st[1]:
                 raise err("wrong_shard_server")
 
+    def _check_quarantine(self) -> None:
+        if self.quarantined:
+            from ..core.error import err
+            raise err("operation_failed", "storage server quarantined (TSS)")
+
     async def _get_value(self, req: GetValueRequest) -> None:
         _t0 = now()
         try:
+            self._check_quarantine()
             await self._wait_for_version(req.version)
             self._check_owned(req.key, req.key + b"\x00", req.version)
             self.stats["reads"] += 1
@@ -451,6 +462,7 @@ class StorageServer:
 
     async def _get_key_values(self, req: GetKeyValuesRequest) -> None:
         try:
+            self._check_quarantine()
             await self._wait_for_version(req.version)
             self._check_owned(req.begin, req.end, req.version)
             self.stats["range_reads"] += 1
@@ -725,6 +737,18 @@ class StorageServer:
     async def _rebuild_engine(self, version: Version) -> None:
         await self._image_engine(self.engine, version)
 
+    async def _tss_quarantine(self, req) -> None:
+        """Bench this role (reference tssQuarantine): no more reads are
+        answered, so no further TSS comparisons can fire against it; the
+        mirror-tag pull keeps running so the divergent state is preserved
+        for inspection.  Idempotent — a second detection is a no-op."""
+        if not self.quarantined:
+            self.quarantined = True
+            TraceEvent("TSSQuarantineApplied", Severity.Warn).detail(
+                "Id", self.id).detail("Tag", self.tag).detail(
+                "Reason", getattr(req, "reason", "")).log()
+        req.reply.send(True)
+
     # -- serving -------------------------------------------------------------
     async def _serve(self, queue, handler) -> None:
         async for req in queue:
@@ -770,6 +794,9 @@ class StorageServer:
         a.append(process.spawn(self._serve(
             self.interface.migrate_engine.queue, self._migrate_engine),
             f"{self.id}.migrateEngine"))
+        a.append(process.spawn(self._serve(
+            self.interface.tss_quarantine.queue, self._tss_quarantine),
+            f"{self.id}.tssQuarantine"))
         from .failure import hold_wait_failure
         a.append(process.spawn(hold_wait_failure(self.interface.wait_failure),
                                f"{self.id}.waitFailure"))

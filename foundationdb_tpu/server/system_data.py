@@ -144,6 +144,19 @@ def knob_key(scope: str, name: str) -> bytes:
     return KNOBS_PREFIX + scope.encode() + b"/" + name.encode()
 
 
+# TSS quarantine markers (reference tssQuarantineKeys,
+# fdbclient/SystemData.cpp tssQuarantineKeyFor): \xff/tss/quarantine/
+# <mirror tag> = reason.  Written by the client that detected the
+# mismatch; operators (and tests) read the prefix to find benched
+# shadows, and `fdbcli` could clear it to re-admit one after inspection.
+TSS_QUARANTINE_PREFIX = b"\xff/tss/quarantine/"
+TSS_QUARANTINE_END = b"\xff/tss/quarantine0"
+
+
+def tss_quarantine_key(mirror_tag: int) -> bytes:
+    return TSS_QUARANTINE_PREFIX + b"%010d" % mirror_tag
+
+
 # Cached key ranges (reference \xff/storageCache + cacheKeysPrefix,
 # fdbserver/StorageCache.actor.cpp): \xff/cacheRanges/<begin> = <end>.
 # Commit proxies route mutations inside these ranges onto CACHE_TAG; the

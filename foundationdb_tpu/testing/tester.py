@@ -10,8 +10,16 @@ recovery settled, queues drained), then runs every workload's check.
 
 from __future__ import annotations
 
-import tomllib
 from typing import Any, Dict, List, Optional
+
+try:
+    import tomllib                      # Python >= 3.11
+except ImportError:                     # pragma: no cover - version-dependent
+    try:
+        import tomli as tomllib         # the pre-3.11 backport, if present
+    except ImportError:
+        tomllib = None                  # minimal built-in parser below
+
 
 from ..core.error import FdbError
 from ..core.futures import wait_all
@@ -20,6 +28,67 @@ from ..core.trace import Severity, TraceEvent
 from .workload import TestWorkload, workload_registry
 from . import workloads as _builtin  # noqa: F401 - populates the registry
 
+
+def _parse_toml_subset(text: str) -> Dict[str, Any]:
+    """Parser for the TOML subset the test specs use (no external deps —
+    the container's Python may predate tomllib): comments, [table] /
+    [[array.of.tables]] headers with dotted paths, and scalar
+    `key = value` pairs (single/double-quoted strings, ints, floats,
+    booleans).  Nested inline structures are not needed by any spec."""
+
+    def scalar(raw: str) -> Any:
+        raw = raw.strip()
+        if len(raw) >= 2 and raw[0] == raw[-1] and raw[0] in "'\"":
+            return raw[1:-1]
+        if raw in ("true", "false"):
+            return raw == "true"
+        try:
+            return int(raw)
+        except ValueError:
+            return float(raw)           # raises on junk: better than silent
+
+    root: Dict[str, Any] = {}
+    current = root
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            is_array = line.startswith("[[")
+            close = line.find("]]" if is_array else "]")
+            if close < 0:
+                raise ValueError(f"unclosed table header line {lineno}: "
+                                 f"{line!r}")
+            path = line[2 if is_array else 1:close].strip().split(".")
+            node: Any = root
+            for part in path[:-1]:
+                nxt = node.setdefault(part, {})
+                if isinstance(nxt, list):   # descend into latest entry
+                    nxt = nxt[-1]
+                node = nxt
+            leaf = path[-1]
+            if is_array:
+                node.setdefault(leaf, []).append({})
+                current = node[leaf][-1]
+            else:
+                current = node.setdefault(leaf, {})
+        elif "=" in line:
+            key, _, raw = line.partition("=")
+            raw = raw.strip()
+            if raw and raw[0] in "'\"":
+                # Quoted string: everything past the CLOSING quote (e.g.
+                # an inline comment) is dropped.
+                close = raw.find(raw[0], 1)
+                if close < 0:
+                    raise ValueError(f"unclosed string line {lineno}: "
+                                     f"{line!r}")
+                raw = raw[:close + 1]
+            elif "#" in raw:
+                raw = raw.split("#", 1)[0]
+            current[key.strip()] = scalar(raw)
+        else:
+            raise ValueError(f"unparseable spec line {lineno}: {line!r}")
+    return root
 
 def load_spec(path_or_text: str) -> Dict[str, Any]:
     """Parse a TOML test spec (reference tests/fast/*.toml layout):
@@ -33,9 +102,13 @@ def load_spec(path_or_text: str) -> Dict[str, Any]:
           testName = 'RandomClogging'
     """
     if "\n" in path_or_text or "[" in path_or_text.split("\n")[0]:
-        return tomllib.loads(path_or_text)
-    with open(path_or_text, "rb") as f:
-        return tomllib.load(f)
+        text = path_or_text
+    else:
+        with open(path_or_text, "rb") as f:
+            text = f.read().decode()
+    if tomllib is not None:
+        return tomllib.loads(text)
+    return _parse_toml_subset(text)
 
 
 async def quiet_database(cluster, db, timeout: float = 60.0) -> None:

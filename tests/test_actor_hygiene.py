@@ -1,0 +1,66 @@
+"""Future/actor teardown hygiene (VERDICT round-5 weak #7).
+
+A discarded sim world can hold actors that were spawned but never
+stepped; their coroutine objects used to surface as `RuntimeWarning:
+coroutine '...' was never awaited` at GC (monitor_leader /
+_open_database_loop during workload teardown) — exactly the noise a real
+dropped-callback bug would hide behind.  EventLoop.shutdown() (invoked
+when set_event_loop replaces a loop) must close them, keeping teardown
+warning-clean by construction."""
+
+import gc
+import warnings
+
+from foundationdb_tpu.core import EventLoop, set_event_loop
+
+
+def _collect_warning_clean():
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        gc.collect()
+
+
+def test_unstarted_actor_teardown_is_warning_clean():
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+
+    async def never_stepped():
+        await lp.delay(1.0)
+
+    # Spawned but the loop never runs — the workload-teardown shape.
+    lp.spawn(never_stepped(), "a")
+    lp.spawn(never_stepped(), "b")
+    set_event_loop(None)            # replaces the loop -> shutdown()
+    del lp
+    _collect_warning_clean()
+
+
+def test_cluster_connection_teardown_is_warning_clean():
+    """The exact VERDICT reproducer: ClusterConnection spawns
+    monitor_leader + _open_database_loop; the world is torn down before
+    the reactor ever steps them."""
+    from foundationdb_tpu.client.database import ClusterConnection
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    conn = ClusterConnection(coordinators=[])
+    set_event_loop(None)
+    del conn, lp
+    _collect_warning_clean()
+
+
+def test_started_actors_unaffected_by_shutdown():
+    """shutdown() must not disturb actors that already ran: their results
+    stand, and re-running a fresh loop afterwards works."""
+    lp = EventLoop(sim=True)
+    set_event_loop(lp)
+    results = []
+
+    async def work():
+        results.append(1)
+        return "done"
+
+    fut = lp.spawn(work(), "w")
+    assert lp.run_until(fut) == "done"
+    set_event_loop(None)
+    assert results == [1]
+    _collect_warning_clean()

@@ -77,3 +77,50 @@ def test_forced_fallback_emits_both_numbers():
     assert "error" in out
     assert out["last_known_good_tpu"]["stale"] is True
     assert out["last_known_good_tpu"]["value"] > 0
+
+
+def test_provisional_line_precedes_result():
+    """The FIRST JSON line is printed before any probing so a driver
+    SIGKILL at any later point still leaves a parseable artifact carrying
+    the last-known-good TPU figure (VERDICT round-5 'Next round' #1)."""
+    env = dict(os.environ)
+    env["BENCH_FORCE_FALLBACK"] = "1"       # tunnel forced dead via env
+    env["BENCH_DEADLINE_S"] = "60"
+    env["BENCH_FAKE_CHILD"] = json.dumps(
+        {"metric": "conflict_range_checks_per_s", "value": 525.0,
+         "unit": "ranges/s", "vs_baseline": 0.0005})
+    r = subprocess.run([sys.executable, _BENCH], capture_output=True,
+                       text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) >= 2, "expected provisional + final JSON lines"
+    first, last = json.loads(lines[0]), json.loads(lines[-1])
+    assert first.get("provisional") is True
+    assert first["metric"] == "conflict_range_checks_per_s"
+    assert first["last_known_good_tpu"]["value"] > 0   # parsed != null
+    assert "provisional" not in last and last["value"] == 525.0
+
+
+def test_dead_tunnel_respects_deadline_budget():
+    """With the tunnel forced dead and a tiny BENCH_DEADLINE_S, the whole
+    run (probe + fallback) completes well inside the budget instead of
+    probing past it (the round-5 failure mode).  The probe path is real
+    (no fake child short-circuit for probing decisions) but the fallback
+    child is faked so the test stays fast."""
+    import time
+    env = dict(os.environ)
+    env["BENCH_FORCE_FALLBACK"] = "1"
+    env["BENCH_DEADLINE_S"] = "30"
+    env["BENCH_FAKE_CHILD"] = json.dumps(
+        {"metric": "conflict_range_checks_per_s", "value": 1.0,
+         "unit": "ranges/s", "vs_baseline": 0.0})
+    t0 = time.monotonic()
+    r = subprocess.run([sys.executable, _BENCH], capture_output=True,
+                       text=True, timeout=90, env=env)
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 0, r.stderr
+    assert elapsed < 45, f"bench overran its deadline budget: {elapsed:.0f}s"
+    last = json.loads([ln for ln in r.stdout.strip().splitlines()
+                       if ln.startswith("{")][-1])
+    assert last["value"] == 1.0 and "error" in last

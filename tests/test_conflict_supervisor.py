@@ -1,0 +1,434 @@
+"""Supervision layer for device conflict backends (conflict/supervisor.py).
+
+Three contracts under test:
+
+1. **Parity** — the supervised TPU backend (healthy, degraded, or moving
+   between the two) produces commit/abort decisions bit-identical to an
+   all-oracle run, INCLUDING keys longer than the 23-byte digest prefix
+   (the exact long-key recheck; SURVEY §7 hard part 1, replacing the old
+   "conservative-only" guarantee).
+2. **Robustness** — a BUGGIFY-killed / timing-out / transiently-erroring
+   device never loses a commit batch: in-flight batches replay through
+   the exact host mirror, and the backend re-promotes after recovery.
+3. **Health machinery** — the failure/latency monitor and the deadline
+   guard behave as specified in isolation.
+"""
+
+import time
+
+import pytest
+
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.conflict.supervisor import (BackendHealthMonitor,
+                                                  SupervisedConflictSet,
+                                                  host_digest)
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+from foundationdb_tpu.core import DeterministicRandom
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.txn import CommitResult, CommitTransactionRef, KeyRange
+
+from test_conflict_oracle import make_domain, random_txn
+
+
+@pytest.fixture()
+def knobs():
+    """Mutable server knobs restored after the test."""
+    k = server_knobs()
+    saved = dict(k.__dict__)
+    yield k
+    for name, value in saved.items():
+        setattr(k, name, value)
+
+
+def make_tpu(oldest_version=0):
+    return TpuConflictSet(oldest_version, capacity=1 << 12)
+
+
+def make_supervised(**kw):
+    return SupervisedConflictSet(make_tpu, **kw)
+
+
+def never_reprobe_monitor():
+    return BackendHealthMonitor(reprobe_interval_s=1e9)
+
+
+# ---------------------------------------------------------------------------
+# 1. Parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [71, 72])
+def test_supervised_matches_oracle_random(seed):
+    rng = DeterministicRandom(seed)
+    domain = make_domain()
+    oracle = OracleConflictSet(0)
+    sup = make_supervised()
+    now = 0
+    for _ in range(25):
+        now += rng.random_int(1, 2_000_000)
+        batch = [random_txn(rng, domain, now, 4_000_000)
+                 for _ in range(rng.random_int(1, 10))]
+        new_oldest = now - 5_000_000 if rng.coinflip() else None
+        got = sup.resolve(batch, now, new_oldest)
+        want = oracle.resolve(batch, now, new_oldest)
+        assert got == want, f"divergence at now={now}"
+    assert sup.stats["device_batches"] > 0
+    assert sup.stats["fallback_batches"] == 0
+
+
+def random_long_key(rng) -> bytes:
+    """Keys 24-1000 bytes, biased toward shared 23-byte prefixes so digest
+    collisions actually occur (the case the recheck exists for)."""
+    prefix = b"p%02d" % rng.random_int(0, 2)
+    prefix = prefix + b"x" * (23 - len(prefix))     # 23 shared bytes
+    tail_len = rng.random_int(1, 977)
+    tail = bytes(rng.random_int(97, 122) for _ in range(min(tail_len, 8)))
+    return prefix + tail * ((tail_len // len(tail)) + 1)
+
+
+def random_long_txn(rng, now, window):
+    """Mixed batch material: long (24-1000B) keys, short keys, and ranges
+    whose endpoints straddle the truncation boundary."""
+    snap = now - rng.random_int(0, window)
+    tr = CommitTransactionRef(read_snapshot=max(snap, 0))
+
+    def key():
+        pick = rng.random_int(0, 3)
+        if pick == 0:
+            return b"s%03d" % rng.random_int(0, 30)          # short
+        return random_long_key(rng)                          # truncated
+
+    for _ in range(rng.random_int(0, 3)):
+        k = key()
+        if rng.coinflip():
+            tr.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+        else:
+            e = key()
+            if k < e:
+                tr.read_conflict_ranges.append(KeyRange(k, e))
+    for _ in range(rng.random_int(0, 2)):
+        k = key()
+        tr.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+    return tr
+
+
+@pytest.mark.parametrize("seed", [81, 82, 83])
+def test_long_key_parity_bit_identical(seed):
+    """Keys 24-1000 bytes: decisions BIT-IDENTICAL to the oracle — not
+    merely conservative (ISSUE acceptance criterion; replaces
+    test_conflict_tpu.test_long_keys_conservative's weaker assertion)."""
+    rng = DeterministicRandom(seed)
+    oracle = OracleConflictSet(0)
+    sup = make_supervised()
+    now = 0
+    rechecks_before = sup.stats["rechecked_batches"]
+    for _ in range(25):
+        now += rng.random_int(1, 2_000_000)
+        batch = [random_long_txn(rng, now, 4_000_000)
+                 for _ in range(rng.random_int(1, 8))]
+        new_oldest = now - 5_000_000 if rng.coinflip() else None
+        got = sup.resolve(batch, now, new_oldest)
+        want = oracle.resolve(batch, now, new_oldest)
+        assert got == want, f"long-key divergence at now={now}"
+    # The recheck path actually ran (long keys were present), and the
+    # device still carried unflagged batches when short-key-only ones
+    # appeared — this is a mixed-path parity proof, not oracle-vs-oracle.
+    assert sup.stats["rechecked_batches"] > rechecks_before
+    assert sup.stats["device_batches"] > 0
+
+
+def test_digest_collision_commits_exactly():
+    """The canonical collision: two 30-byte keys sharing a 23-byte prefix.
+    The old conservative backend was allowed to abort the non-conflicting
+    reader; the supervised backend must COMMIT it, like the oracle."""
+    long_a = b"x" * 30
+    long_b = b"x" * 23 + b"zzz"
+    assert host_digest(long_a) == host_digest(long_b)   # really collides
+    sup = make_supervised()
+    oracle = OracleConflictSet(0)
+    w = CommitTransactionRef(
+        write_conflict_ranges=[KeyRange(long_a, long_a + b"\x00")])
+    assert sup.resolve([w], 100) == oracle.resolve([w], 100)
+    r_hit = CommitTransactionRef(
+        read_snapshot=50,
+        read_conflict_ranges=[KeyRange(long_a, long_a + b"\x00")])
+    r_collide = CommitTransactionRef(
+        read_snapshot=50,
+        read_conflict_ranges=[KeyRange(long_b, long_b + b"\x00")])
+    got = sup.resolve([r_hit, r_collide], 200)
+    want = oracle.resolve([r_hit, r_collide], 200)
+    assert got == want == [CommitResult.CONFLICT, CommitResult.COMMITTED]
+
+
+def test_taint_flags_short_key_reader_near_widened_insert():
+    """A truncated WRITE widens the device insert; a SHORT-key read that
+    digest-lands inside the widened region must be rechecked (and decided
+    exactly) even though the reader itself has no long keys."""
+    sup = make_supervised()
+    oracle = OracleConflictSet(0)
+    long_w = b"x" * 23 + b"\x00\x01" + b"tail"      # truncated write key
+    w = CommitTransactionRef(
+        write_conflict_ranges=[KeyRange(long_w, long_w + b"\x00")])
+    assert sup.resolve([w], 100) == oracle.resolve([w], 100)
+    assert sup.stats["taint_size"] > 0
+    # 23-byte read key: untruncated digest, but digest-adjacent to the
+    # widened region.  Exact answer: COMMITTED (the keys differ).
+    short_r = b"x" * 23
+    r = CommitTransactionRef(
+        read_snapshot=50,
+        read_conflict_ranges=[KeyRange(short_r, short_r + b"\x00")])
+    got, want = sup.resolve([r], 200), oracle.resolve([r], 200)
+    assert got == want == [CommitResult.COMMITTED]
+
+
+def test_pipelined_async_waits_fold_in_order():
+    """resolve_async pipelining: waiting a LATER handle first folds its
+    predecessors transparently; verdicts equal a serial oracle run."""
+    rng = DeterministicRandom(9)
+    domain = make_domain()
+    oracle = OracleConflictSet(0)
+    sup = make_supervised()
+    now = 0
+    handles, batches = [], []
+    for _ in range(6):
+        now += 1_000_000
+        batch = [random_txn(rng, domain, now, 3_000_000) for _ in range(5)]
+        handles.append(sup.resolve_async(batch, now, now - 5_000_000))
+        batches.append((batch, now))
+    got_last = handles[-1].wait()            # folds 0..5 in order
+    for h, (batch, v) in zip(handles, batches):
+        want = oracle.resolve(batch, v, v - 5_000_000)
+        assert h.wait() == want
+    assert handles[-1].wait() is got_last    # idempotent
+
+
+# ---------------------------------------------------------------------------
+# 2. Robustness / chaos
+# ---------------------------------------------------------------------------
+
+def run_chaos_stream(sup, oracle, rng, domain, n_batches, on_batch):
+    """Drive identical streams through `sup` and `oracle`, with `on_batch`
+    injecting chaos; assert bit-identical verdicts (zero abort-set
+    divergence) on EVERY batch."""
+    now = 0
+    for i in range(n_batches):
+        now += 1_000_000
+        on_batch(i)
+        batch = [random_txn(rng, domain, now, 4_000_000)
+                 for _ in range(rng.random_int(1, 8))]
+        new_oldest = now - 5_000_000
+        got = sup.resolve(batch, now, new_oldest)
+        want = oracle.resolve(batch, now, new_oldest)
+        assert got == want, f"divergence at batch {i}"
+    return now
+
+
+def test_buggify_backend_death_degrades_and_repromotes():
+    """ISSUE acceptance: the device backend is BUGGIFY-killed mid-workload
+    — every batch (including those in flight) resolves via the CPU
+    fallback with abort sets identical to an all-oracle run, and the
+    backend re-promotes once the device recovers."""
+    from foundationdb_tpu.core.buggify import force_buggify, unforce_buggify
+    rng = DeterministicRandom(17)
+    domain = make_domain()
+    sup = make_supervised(monitor=never_reprobe_monitor())
+    oracle = OracleConflictSet(0)
+
+    def on_batch(i):
+        if i == 8:
+            # Deterministically fire the BUGGIFY site: the device goes
+            # (stickily) dead at this batch's dispatch.
+            force_buggify("conflict.device.dead")
+        if i == 9:
+            # The site fired (sticky death): stop injecting so recovery
+            # is observable, but the backend must STAY degraded until the
+            # re-probe window opens.
+            unforce_buggify("conflict.device.dead")
+            assert sup.degraded and sup._buggify_dead
+        if i == 16:
+            # Device "recovers": clear the sticky death and open the
+            # re-probe window; the next dispatch rebuilds from the mirror.
+            sup._buggify_dead = False
+            sup.monitor.tripped_at = -1e12
+
+    try:
+        run_chaos_stream(sup, oracle, rng, domain, 24, on_batch)
+    finally:
+        unforce_buggify()
+    st = sup.status()
+    assert st["degrades"] == 1
+    assert st["promotions"] == 1
+    assert not st["degraded"]
+    assert st["fallback_batches"] >= 7          # batches 9..15 via mirror
+    assert st["device_batches"] >= 8 + 8        # before death + after revive
+
+
+def test_inflight_batches_survive_death():
+    """Batches already DISPATCHED to the device when it dies replay
+    through the mirror in dispatch order: no batch lost, no divergence."""
+    rng = DeterministicRandom(23)
+    domain = make_domain()
+    sup = make_supervised(monitor=never_reprobe_monitor())
+    oracle = OracleConflictSet(0)
+    now = 0
+    handles, batches = [], []
+    for _ in range(5):
+        now += 1_000_000
+        batch = [random_txn(rng, domain, now, 3_000_000) for _ in range(5)]
+        handles.append(sup.resolve_async(batch, now, now - 5_000_000))
+        batches.append((batch, now))
+    sup.force_device_error = "timeout"      # device dies before any wait
+    for h, (batch, v) in zip(handles, batches):
+        want = oracle.resolve(batch, v, v - 5_000_000)
+        assert h.wait() == want
+    assert sup.degraded
+    assert sup.stats["fallback_batches"] == 5
+
+
+def test_transient_error_retried_with_backoff(knobs):
+    """A transient device error is retried (exponential backoff) and the
+    batch still lands on the device — no degrade, no fallback."""
+    knobs.CONFLICT_DEVICE_RETRY_BACKOFF_S = 0.0
+    sup = make_supervised()
+    sup.force_device_error = ["operation_failed"]   # one-shot injection
+    w = CommitTransactionRef(write_conflict_ranges=[KeyRange(b"a", b"b")])
+    assert sup.resolve([w], 100) == [CommitResult.COMMITTED]
+    assert sup.stats["retries"] >= 1
+    assert not sup.degraded
+    assert sup.stats["fallback_batches"] == 0
+
+
+def test_deadline_guard_degrades_on_stall(knobs):
+    """A device whose resolve stalls past CONFLICT_DEVICE_TIMEOUT_S is
+    abandoned mid-call; the batch resolves via the mirror and the backend
+    is degraded."""
+    knobs.CONFLICT_DEVICE_TIMEOUT_S = 0.1
+
+    class StallingDevice(OracleConflictSet):
+        def resolve(self, *a, **kw):
+            time.sleep(0.5)
+            return super().resolve(*a, **kw)
+
+    sup = SupervisedConflictSet(
+        lambda oldest_version=0: StallingDevice(oldest_version),
+        monitor=never_reprobe_monitor())
+    oracle = OracleConflictSet(0)
+    w = CommitTransactionRef(write_conflict_ranges=[KeyRange(b"a", b"b")])
+    r = CommitTransactionRef(read_snapshot=50,
+                             read_conflict_ranges=[KeyRange(b"a", b"b")])
+    assert sup.resolve([w], 100) == oracle.resolve([w], 100)
+    assert sup.degraded
+    assert sup.resolve([r], 200) == oracle.resolve([r], 200) \
+        == [CommitResult.CONFLICT]
+
+
+def test_promotion_rebuilds_history_from_mirror():
+    """History written BEFORE death and DURING degradation must both be
+    visible to the device after promotion (the rebuild replay)."""
+    sup = make_supervised(monitor=never_reprobe_monitor())
+    w1 = CommitTransactionRef(write_conflict_ranges=[KeyRange(b"a", b"b")])
+    assert sup.resolve([w1], 100) == [CommitResult.COMMITTED]
+    sup.force_device_error = "timeout"
+    w2 = CommitTransactionRef(write_conflict_ranges=[KeyRange(b"m", b"n")])
+    assert sup.resolve([w2], 200) == [CommitResult.COMMITTED]
+    assert sup.degraded
+    sup.force_device_error = None
+    sup.monitor.tripped_at = -1e12          # re-probe due now
+    # Reads with snapshots below each write version: conflicts from BOTH
+    # epochs of history, answered by the promoted device.
+    r1 = CommitTransactionRef(read_snapshot=50,
+                              read_conflict_ranges=[KeyRange(b"a", b"b")])
+    r2 = CommitTransactionRef(read_snapshot=150,
+                              read_conflict_ranges=[KeyRange(b"m", b"n")])
+    r3 = CommitTransactionRef(read_snapshot=150,
+                              read_conflict_ranges=[KeyRange(b"x", b"y")])
+    got = sup.resolve([r1, r2, r3], 300)
+    assert got == [CommitResult.CONFLICT, CommitResult.CONFLICT,
+                   CommitResult.COMMITTED]
+    assert not sup.degraded
+    assert sup.stats["promotions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 3. Health machinery
+# ---------------------------------------------------------------------------
+
+def test_slo_trip_does_not_skip_recheck_of_tripping_batch():
+    """Regression: the latency-SLO degrade clears the taint set, but the
+    batch that LANDS the final strike must still be judged against the
+    pre-degrade taint — its verdicts fold before the degrade."""
+    monitor = BackendHealthMonitor(latency_slo_s=1e-9, slo_strikes=2,
+                                   reprobe_interval_s=1e9)
+    sup = make_supervised(monitor=monitor)
+    long_w = b"x" * 23 + b"\x00\x01" + b"tail"
+    w = CommitTransactionRef(
+        write_conflict_ranges=[KeyRange(long_w, long_w + b"\x00")])
+    assert sup.resolve([w], 100) == [CommitResult.COMMITTED]   # strike 1
+    assert sup.stats["taint_size"] > 0 and not sup.degraded
+    # Strike 2 trips the monitor; this same batch's short-key read
+    # digest-lands inside the widened region — exact answer: COMMITTED.
+    short_r = b"x" * 23
+    r = CommitTransactionRef(
+        read_snapshot=50,
+        read_conflict_ranges=[KeyRange(short_r, short_r + b"\x00")])
+    assert sup.resolve([r], 200) == [CommitResult.COMMITTED]
+    assert sup.degraded                     # degrade landed AFTER the fold
+
+
+def test_health_monitor_failure_threshold():
+    t = [0.0]
+    m = BackendHealthMonitor(failure_threshold=3, time_fn=lambda: t[0])
+    m.record_failure(); m.record_failure()
+    assert not m.tripped
+    m.record_success(0.01)                  # success resets the streak
+    m.record_failure(); m.record_failure()
+    assert not m.tripped
+    m.record_failure()
+    assert m.tripped
+
+
+def test_health_monitor_latency_slo_strikes():
+    m = BackendHealthMonitor(latency_slo_s=0.1, slo_strikes=3,
+                             time_fn=lambda: 0.0)
+    for _ in range(2):
+        m.record_success(0.5)
+    assert not m.tripped
+    m.record_success(0.01)                  # fast batch resets strikes
+    for _ in range(3):
+        m.record_success(0.5)
+    assert m.tripped
+
+
+def test_health_monitor_reprobe_backoff():
+    t = [0.0]
+    m = BackendHealthMonitor(reprobe_interval_s=10.0, reprobe_max_s=1000.0,
+                             time_fn=lambda: t[0])
+    m.trip()
+    assert not m.reprobe_due()
+    t[0] = 11.0
+    assert m.reprobe_due()
+    m.record_probe_failure()                # backoff doubles: 20s now
+    t[0] = 25.0
+    assert not m.reprobe_due()
+    t[0] = 32.0
+    assert m.reprobe_due()
+    m.reset()
+    assert not m.tripped and not m.reprobe_due()
+
+
+def test_resolve_with_conflicts_reports_ranges():
+    """The resolver-facing API keeps working across the fallback: a
+    reporting reader gets its conflicting ranges in both modes."""
+    sup = make_supervised(monitor=never_reprobe_monitor())
+    for fail_first in (False, True):
+        if fail_first:
+            sup.force_device_error = "timeout"
+        w = CommitTransactionRef(
+            write_conflict_ranges=[KeyRange(b"k", b"l")])
+        r = CommitTransactionRef(
+            read_snapshot=50,
+            read_conflict_ranges=[KeyRange(b"k", b"l")])
+        r.report_conflicting_keys = True
+        base = 1000 if fail_first else 0
+        verdicts, ranges = sup.resolve_with_conflicts([w, r], base + 100)
+        assert verdicts == [CommitResult.COMMITTED, CommitResult.CONFLICT]
+        assert ranges == {1: [(b"k", b"l")]}

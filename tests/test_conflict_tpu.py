@@ -106,7 +106,11 @@ def test_tpu_gc_and_rebase(small_caps):
 
 
 def test_long_keys_conservative(small_caps):
-    """Keys > 23 bytes: no missed conflicts; extra conflicts allowed."""
+    """Keys > 23 bytes on the BARE device backend: no missed conflicts;
+    extra conflicts allowed.  This is the raw-kernel contract only — the
+    production path (SupervisedConflictSet, the default for backend
+    "tpu") upgrades it to BIT-IDENTICAL decisions via the host exact
+    recheck; see tests/test_conflict_supervisor.py."""
     long_a = b"x" * 30
     long_b = b"x" * 23 + b"zzz"        # same 23-byte prefix, digest-collides
     tpu = TpuConflictSet(0, **small_caps)

@@ -297,8 +297,15 @@ def test_pipeline_with_tpu_conflict_backend():
         assert await t3.get(b"k") == b"a"
 
     c.run_until(c.loop.spawn(go()), timeout=30)
+    # The "tpu" backend arrives wrapped in the supervision layer (this is
+    # the production shape: deadline budget + degrade-to-CPU + exact
+    # long-key recheck); the device underneath is the JAX kernel, healthy.
+    from foundationdb_tpu.conflict.supervisor import SupervisedConflictSet
     from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
-    assert isinstance(c.resolvers[0].conflict_set, TpuConflictSet)
+    cs = c.resolvers[0].conflict_set
+    assert isinstance(cs, SupervisedConflictSet)
+    assert isinstance(cs.device, TpuConflictSet)
+    assert not cs.degraded and cs.stats["device_batches"] > 0
     from foundationdb_tpu.core import set_event_loop
     from foundationdb_tpu.rpc.sim import set_simulator
     set_simulator(None)

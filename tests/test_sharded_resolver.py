@@ -34,8 +34,9 @@ def test_mesh_shape(mesh):
     assert mesh.shape["kr"] >= 2, "need real key-range sharding to test"
 
 
-@pytest.mark.parametrize("seed", [31, 32])
-def test_sharded_matches_oracle_general(mesh, seed):
+@pytest.mark.slow          # heavyweight shapes: many XLA compiles; the
+@pytest.mark.parametrize("seed", [31, 32])   # light parity proofs below
+def test_sharded_matches_oracle_general(mesh, seed):  # stay in tier-1
     """Random GENERAL ranges (spanning shard splits) through the sharded
     step vs the oracle; merges every 3 batches; floor advances+rebases."""
     rng = DeterministicRandom(seed)
@@ -57,6 +58,7 @@ def test_sharded_matches_oracle_general(mesh, seed):
     assert sum(cs.shard_sizes()) >= mesh.shape["kr"]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [41, 42])
 def test_sharded_matches_oracle_points(mesh, seed):
     """Hot point-key batches (deep intra-batch chains) through the sharded
@@ -146,3 +148,42 @@ def test_sharded_overflow_flag_raises(mesh):
                          b"\x01%05d\x00" % (i * 10 + j))])
                 for j in range(10)]
             cs.resolve(txns, now)      # floor never advances
+
+
+def test_supervised_sharded_degrades_and_repromotes(mesh):
+    """The supervision layer over the MESH-SHARDED backend: device killed
+    mid-stream -> exact CPU fallback; promotion rebuilds the whole sharded
+    window from the mirror (digest-split state re-created across shards)."""
+    from foundationdb_tpu.conflict.supervisor import BackendHealthMonitor
+    rng = DeterministicRandom(55)
+    sup = ShardedTpuConflictSet.supervised(
+        mesh, capacity=1 << 10, delta_capacity=1 << 9,
+        monitor=BackendHealthMonitor(reprobe_interval_s=1e9))
+    oracle = OracleConflictSet(0)
+    now = 0
+    for i in range(9):
+        now += 1_000_000
+        if i == 3:
+            sup.force_device_error = "timeout"      # kill mid-stream
+        if i == 6:
+            sup.force_device_error = None           # device recovers
+            sup.monitor.tripped_at = -1e12
+        batch = []
+        for _ in range(6):
+            # Random leading byte -> keys land on every shard.
+            k = bytes([rng.random_int(0, 255)]) + b"k%03d" % rng.random_int(
+                0, 40)
+            tr = CommitTransactionRef(
+                read_snapshot=max(now - rng.random_int(0, 3_000_000), 0))
+            if rng.coinflip():
+                tr.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            tr.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+            batch.append(tr)
+        got = sup.resolve(batch, now, now - 5_000_000)
+        want = oracle.resolve(batch, now, now - 5_000_000)
+        assert got == want, f"supervised-sharded divergence at batch {i}"
+    st = sup.status()
+    assert st["degrades"] == 1 and st["promotions"] == 1
+    assert not st["degraded"]
+    assert isinstance(sup.device, ShardedTpuConflictSet)
+    assert sum(sup.device.shard_sizes()) >= 1

@@ -67,3 +67,77 @@ def test_tss_shadow_tracks_and_detects_divergence(teardown):  # noqa: F811
         return True
 
     assert c.run_until(c.loop.spawn(go()), timeout=300)
+
+
+def test_tss_mismatch_quarantines_shadow(teardown):  # noqa: F811
+    """VERDICT missing #10 follow-through: a detected mismatch must BENCH
+    the shadow (reference storageserver.actor.cpp tssQuarantine), not just
+    log — it stops serving reads, the quarantine is recorded in the system
+    keyspace, and the client sends no further comparison traffic."""
+    from foundationdb_tpu.core.error import FdbError
+    from foundationdb_tpu.server.system_data import tss_quarantine_key
+
+    c = SimFdbCluster(config=DatabaseConfiguration(tss_count=1),
+                      n_workers=5, n_storage_workers=3)
+    db = c.database()
+
+    async def go():
+        for i in range(12):
+            await commit_kv(db, b"q/%03d" % i, b"qv%03d" % i)
+        shadow = _shadow_role(c, 0)
+        assert shadow is not None
+        for _ in range(100):
+            if shadow.version.get() > 0 and \
+                    await read_key(db, b"q/000") == b"qv000":
+                break
+            await delay(0.2)
+        key = None
+        for i in range(12):
+            k = b"q/%03d" % i
+            if shadow.data.get(k, shadow.version.get()) is not None:
+                key = k
+                break
+        assert key is not None, "no key landed on the paired shard"
+        shadow.data.set(key, b"CORRUPT", shadow.version.get())
+        before = db.tss_mismatches
+        for _ in range(100):
+            if db.tss_mismatches > before:
+                break
+            await read_key(db, key)
+            await delay(0.1)
+        assert db.tss_mismatches > before
+
+        # 1. The shadow is benched: flag set, reads answered with errors.
+        for _ in range(100):
+            if shadow.quarantined:
+                break
+            await delay(0.1)
+        assert shadow.quarantined
+        assert get_tracer().find("TSSQuarantineApplied")
+
+        # 2. The marker landed in the system keyspace.
+        marker = None
+        for _ in range(100):
+            t = db.create_transaction()
+            t.access_system_keys = True
+            try:
+                marker = await t.get(tss_quarantine_key(shadow.tag))
+            except FdbError as e:
+                await t.on_error(e)
+                continue
+            if marker is not None:
+                break
+            await delay(0.1)
+        assert marker is not None
+
+        # 3. No further comparisons fire (the shadow stays corrupt, the
+        # client skips benched pairs, and the quarantined role would
+        # error any compare read anyway).
+        count = db.tss_mismatches
+        for _ in range(10):
+            assert await read_key(db, key) == b"qv" + key[-3:]
+        await delay(2.0)
+        assert db.tss_mismatches == count
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=300)
