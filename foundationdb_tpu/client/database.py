@@ -414,6 +414,12 @@ class Database:
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
 
+    async def open_tenant(self, name: bytes):
+        """Open a Tenant handle by name (tenant/handle.py); raises
+        tenant_not_found for unknown names."""
+        from ..tenant.handle import open_tenant
+        return await open_tenant(self, name)
+
 
 class Transaction:
     """One transaction attempt chain (reference Transaction + RYW)."""
@@ -463,6 +469,17 @@ class Transaction:
         # enforcement) and on reads (storage busy-tag sampling).
         if not hasattr(self, "tag"):
             self.tag: str = ""
+        # Tenant identity (reference TenantInfo on CommitTransactionRef):
+        # set by tenant handles (tenant/handle.py); commit proxies
+        # validate tenant-tagged commits against their tenant cache and
+        # reject prefix escapes.  -1 = raw (tenant-less) transaction.
+        if not hasattr(self, "tenant_id"):
+            self.tenant_id: int = -1
+        # DEBUG_TRANSACTION_IDENTIFIER (reference option): a non-empty id
+        # rides the commit request and is correlated to the proxy's batch
+        # span in CommitDebug trace events.
+        if not hasattr(self, "debug_id"):
+            self.debug_id: str = ""
 
     def reset(self) -> None:
         self._conflicting_keys = []
@@ -532,8 +549,66 @@ class Transaction:
     # management mirror — read-your-cluster through plain key reads.
     STATUS_JSON_KEY = b"\xff\xff/status/json"
     MANAGEMENT_EXCLUDED_PREFIX = b"\xff\xff/management/excluded/"
+    # Read-only tenant-map mirror (reference SpecialKeySpace
+    # TenantMapRangeImpl): \xff\xff/management/tenant/map/<name> = JSON
+    # {id, prefix-hex} — tooling lists tenants without raw-\xff access.
+    MANAGEMENT_TENANT_MAP_PREFIX = b"\xff\xff/management/tenant/map/"
+
+    @staticmethod
+    def _tenant_entry_json(entry) -> bytes:
+        import json as _json
+        return _json.dumps({"id": entry.id,
+                            "prefix": entry.prefix.hex()}).encode()
+
+    async def _tenant_sub_txn(self):
+        """System-keys sub-transaction PINNED to this transaction's read
+        version: tenant-mirror reads are repeatable within one attempt
+        (a concurrent delete cannot flip a re-read) and cost no extra
+        GRV — the reference SpecialKeySpace reads at the enclosing
+        transaction's snapshot the same way."""
+        sub = self.db.create_transaction()
+        sub.access_system_keys = True
+        sub.set_read_version(await self._ensure_read_version())
+        return sub
+
+    async def _tenant_map_rows(self, begin: bytes, end: bytes, limit: int,
+                               reverse: bool = False
+                               ) -> List[Tuple[bytes, bytes]]:
+        """Rows of the tenant-map special-key module inside [begin, end)
+        (both in \xff\xff space), in iteration order (descending when
+        reverse), backed by a system-keys sub-read.  The raw read runs in
+        the SAME direction so `limit` selects the correct end of a large
+        tenant list."""
+        from ..server.system_data import TENANT_MAP_END, TENANT_MAP_PREFIX
+        from ..tenant.map import TenantMapEntry
+        p = self.MANAGEMENT_TENANT_MAP_PREFIX
+        lo = max(begin, p)
+        if lo >= end:
+            return []
+        name_lo = lo[len(p):] if lo.startswith(p) else b""
+        raw_end = (min(TENANT_MAP_PREFIX + end[len(p):], TENANT_MAP_END)
+                   if end.startswith(p) else TENANT_MAP_END)
+        sub = await self._tenant_sub_txn()
+        raw = await sub.get_range(TENANT_MAP_PREFIX + name_lo, raw_end,
+                                  limit=limit, reverse=reverse)
+        return [(p + k[len(TENANT_MAP_PREFIX):],
+                 self._tenant_entry_json(TenantMapEntry.decode(v)))
+                for k, v in raw]
 
     async def _special_key_get(self, key: bytes) -> Optional[bytes]:
+        if key.startswith(self.MANAGEMENT_TENANT_MAP_PREFIX):
+            # Read-only mirror: a plain read of a nonexistent/odd name
+            # (empty, NUL, overlong) is ABSENT, never a name-validation
+            # error — GET and GETRANGE must agree on the same keys, so
+            # read the raw map directly rather than via get_tenant().
+            from ..tenant.map import TenantMapEntry, tenant_map_key
+            name = key[len(self.MANAGEMENT_TENANT_MAP_PREFIX):]
+            if not name:
+                return None
+            sub = await self._tenant_sub_txn()
+            raw = await sub.get(tenant_map_key(name))
+            return (self._tenant_entry_json(TenantMapEntry.decode(raw))
+                    if raw is not None else None)
         if key == self.STATUS_JSON_KEY:
             import json as _json
             get_status = getattr(self.db.cluster, "get_status", None)
@@ -609,6 +684,9 @@ class Transaction:
             if reverse:
                 rows.reverse()
             return rows[:limit]
+        tp = self.MANAGEMENT_TENANT_MAP_PREFIX
+        if begin.startswith(tp) or (begin <= tp and end > tp):
+            return await self._tenant_map_rows(begin, end, limit, reverse)
         if not snapshot:
             self.read_conflict_ranges.append((begin, end))
         version = await self._ensure_read_version()
@@ -785,14 +863,16 @@ class Transaction:
             mutations=self.writes.mutations,
             read_snapshot=read_snapshot,
             report_conflicting_keys=self.report_conflicting_keys,
-            lock_aware=self.lock_aware)
+            lock_aware=self.lock_aware,
+            tenant_id=self.tenant_id)
         if txn.expected_size() > client_knobs().TRANSACTION_SIZE_LIMIT:
             raise err("transaction_too_large")
         await self.db._await_ready()
         proxy = self.db._commit_proxy()
         from ..core.futures import wait_any
         f = RequestStream.at(proxy.commit.endpoint).get_reply(
-            CommitTransactionRequest(transaction=txn))
+            CommitTransactionRequest(transaction=txn,
+                                     debug_id=self.debug_id))
         try:
             idx, _ = await wait_any([f, delay(self.COMMIT_TIMEOUT)])
         except FdbError as e:

@@ -26,26 +26,44 @@ class EncodedBatch:
 
     r_/w_ arrays are parallel: range i of the batch belongs to transaction
     ``*_txn[i]`` and spans digest interval [``*_begin[i]``, ``*_end[i]``).
-    Empty ranges (begin >= end) must already be dropped."""
+    Empty ranges (begin >= end) must already be dropped.
+
+    Digest lanes 0..SALT_LANES-1 are the TENANT-SALT COLUMN (the first 8
+    key bytes — for tenant-prefixed keys, exactly the tenant's fixed-width
+    id from tenant/map.py); the remaining lanes digest the tenant-relative
+    tail.  See ops/digest.py."""
 
     n_txns: int
     t_snap: np.ndarray        # int64[n_txns]  absolute read snapshots
     t_has_reads: np.ndarray   # bool[n_txns]
     r_txn: np.ndarray         # int32[NR]
-    r_begin: np.ndarray       # uint32[6, NR]  (planar, ops/digest.py)
-    r_end: np.ndarray         # uint32[6, NR]
+    r_begin: np.ndarray       # uint32[8, NR]  (planar, ops/digest.py)
+    r_end: np.ndarray         # uint32[8, NR]
     w_txn: np.ndarray         # int32[NW]
-    w_begin: np.ndarray       # uint32[6, NW]
-    w_end: np.ndarray         # uint32[6, NW]
+    w_begin: np.ndarray       # uint32[8, NW]
+    w_end: np.ndarray         # uint32[8, NW]
     # True iff EVERY conflict range is a single key [k, k+\x00) with
-    # len(k) <= 23 (untruncated digest).  Lets the device use the point
-    # fast path (fused.py make_resolve_step all_point) — same verdicts,
-    # ~10x cheaper intra-batch rounds.  False is always safe.
+    # len(k) <= PREFIX_BYTES (untruncated digest; tenant prefix + up to 23
+    # relative bytes fits).  Lets the device use the point fast path
+    # (fused.py make_resolve_step all_point) — same verdicts, ~10x cheaper
+    # intra-batch rounds.  False is always safe.
     all_point: bool = False
 
     @property
     def n_ranges(self) -> int:
         return int(self.r_txn.shape[0] + self.w_txn.shape[0])
+
+    @property
+    def r_salt(self) -> np.ndarray:
+        """Tenant-salt column of the read-range begins: uint32[2, NR]."""
+        from ..ops.digest import SALT_LANES
+        return self.r_begin[:SALT_LANES]
+
+    @property
+    def w_salt(self) -> np.ndarray:
+        """Tenant-salt column of the write-range begins: uint32[2, NW]."""
+        from ..ops.digest import SALT_LANES
+        return self.w_begin[:SALT_LANES]
 
     @classmethod
     def from_transactions(cls, transactions: Sequence[CommitTransactionRef]

@@ -99,8 +99,9 @@ def meta_size(t_cap: int, r_cap: int, w_cap: int) -> int:
 #
 #   ubytes  uint8[u_pad, L+1]   unique sorted begin-key digests, compacted
 #                               to L prefix bytes + the length-marker byte
-#                               (L = longest key in the batch; bytes L..22
-#                               of every digest are zero by construction)
+#                               (L = longest key in the batch; bytes
+#                               L..PREFIX_BYTES-1 of every digest are zero
+#                               by construction)
 #   r_uid   int32[r_pad]        each read's slot in the unique table
 #   w_uid   int32[w_pad]        each write's slot
 #   r_start int32[t_cap]        first read index per txn (reads grouped by
@@ -111,9 +112,10 @@ def meta_size(t_cap: int, r_cap: int, w_cap: int) -> int:
 #   scalars int32[6]            u_n, n_r, n_w, n_t, now_rel, oldest_rel
 #
 # End digests are NOT shipped: a point range [k, k+"\x00") has
-# digest(end) == digest(begin) with the marker byte (lane 5's low byte)
-# incremented — exact for every all_point batch (encoded.py guarantees
-# len(k) <= 23).  History search runs ONCE over the unique table and is
+# digest(end) == digest(begin) with the marker byte (the last lane's low
+# byte) incremented — exact for every all_point batch (encoded.py
+# guarantees len(k) <= PREFIX_BYTES).  History search runs ONCE over the
+# unique table and is
 # gathered per range, which also cuts the binary-search probe count ~3x.
 COMPACT_SCALARS = 6
 

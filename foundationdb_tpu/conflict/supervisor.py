@@ -25,7 +25,9 @@ failure story the Resolver needs:
     periodically (exponential backoff) rebuilds a fresh device backend
     from the mirror history and promotes back to the device path;
   * **exact long-key recheck** (SURVEY §7 hard part 1) — device digests
-    truncate keys >23 bytes, which is only *conservatively* correct.
+    truncate keys past the digest prefix (31 bytes: the 8-byte tenant-salt
+    column + 23 relative bytes, ops/digest.py), which is only
+    *conservatively* correct.
     The supervisor flags transactions whose verdict could hinge on a
     truncated digest (the txn carries a truncated key, or a read range
     overlaps a *tainted* digest region where device and exact history
@@ -37,7 +39,7 @@ faults into the device-dispatch path so simulation exercises every
 degradation branch.
 
 Soundness of the recheck (why unflagged batches need no oracle work):
-digests of keys <= 23 bytes are a strict order-embedding, so for a batch
+digests of keys <= 31 bytes are a strict order-embedding, so for a batch
 with no truncated keys and no tainted-region reads, the device decision
 procedure is isomorphic to the oracle's.  Divergence can enter only
 through truncated keys — a widened insert (device V raised above exact V
@@ -60,10 +62,14 @@ from ..txn.types import CommitResult, CommitTransactionRef, KeyRange, Version
 from .api import ConflictSet
 from .oracle import OracleConflictSet, combine_write_ranges
 
-_PREFIX_BYTES = 23
-_DIGEST_BYTES = 24
-# Strictly above every real key digest (decodes to prefix 0xff*23 + marker
-# 0xff while real length markers are <= 24); the open end of the mirror
+# Single source of truth for the digest geometry (ops/digest.py): the
+# 8-byte tenant-salt column + 23 relative bytes digest exactly, so only
+# keys past PREFIX_BYTES (31) ever reach the exact recheck below.
+from ..ops.digest import DIGEST_BYTES as _DIGEST_BYTES  # noqa: E402
+from ..ops.digest import PREFIX_BYTES as _PREFIX_BYTES  # noqa: E402
+
+# Strictly above every real key digest (decodes to prefix 0xff*31 + marker
+# 0xff while real length markers are <= 32); the open end of the mirror
 # history's final (unbounded) segment during promotion replay.
 _INF_KEY = b"\xff" * _DIGEST_BYTES
 
@@ -73,10 +79,10 @@ TRANSIENT_ERRORS = frozenset({
 
 
 def host_digest(key: bytes, round_up: bool = False) -> bytes:
-    """The 24-byte device digest of a key, computed host-side
-    (ops/digest.py semantics: 23-byte zero-padded prefix + length marker;
-    round_up adds 1ulp to truncated keys so a digest range always covers
-    the true key range)."""
+    """The 32-byte device digest of a key, computed host-side
+    (ops/digest.py semantics: 31-byte zero-padded prefix — tenant salt +
+    relative tail — plus length marker; round_up adds 1ulp to truncated
+    keys so a digest range always covers the true key range)."""
     d = key[:_PREFIX_BYTES].ljust(_PREFIX_BYTES, b"\x00") + \
         bytes([min(len(key), _PREFIX_BYTES + 1)])
     if round_up and len(key) > _PREFIX_BYTES:

@@ -16,8 +16,10 @@ CommitTransactionRef objects (converted by EncodedBatch.from_transactions).
 Batch arrays are padded to power-of-two buckets so XLA compiles one program
 per bucket (SURVEY.md §7 hard part 2).  Versions are int32 offsets from
 self.version_base (rebased during merges).  Decisions are bit-identical to
-the CPU oracle for keys <= 23 bytes; longer keys round conservatively (extra
-aborts possible, missed conflicts impossible) — see ops/digest.py.
+the CPU oracle for keys <= 31 bytes — the 8-byte tenant-salt column plus a
+23-byte tenant-relative key digests exactly (ops/digest.py), so tenant
+traffic stays on this fast path; longer keys round conservatively (extra
+aborts possible, missed conflicts impossible).
 
 Capacity overflow (live boundaries > capacity at a merge) sets a sticky
 device-side flag surfaced as an error at the next wait(); with the window
@@ -234,15 +236,17 @@ class TpuConflictSet(ConflictSet):
         txn, or two unique WRITE keys digest-adjacent (the interleaved
         insert needs strictly separated ranges) — and the caller falls
         back to the general interval path."""
-        from ..ops.digest import DIGEST_BYTES, PREFIX_BYTES, planar_to_s24
+        from ..ops.digest import (DIGEST_BYTES, KEY_LANES, PREFIX_BYTES,
+                                  planar_to_s24)
         n = enc.n_txns
         nr = enc.r_txn.shape[0]
         nw = enc.w_txn.shape[0]
         # End digests must be begin-with-marker+1 (what the device derives).
+        last = KEY_LANES - 1
         for b_, e_ in ((enc.r_begin, enc.r_end), (enc.w_begin, enc.w_end)):
             if b_.shape[1] and not (
-                    np.array_equal(b_[:5], e_[:5])
-                    and np.array_equal(b_[5] + 1, e_[5])):
+                    np.array_equal(b_[:last], e_[:last])
+                    and np.array_equal(b_[last] + 1, e_[last])):
                 return None
         # Ranges must be grouped by txn so r_txn/w_txn reduce to per-txn
         # start offsets (re-derived on device via rank_count).

@@ -61,5 +61,7 @@ for _name in (
     "TaskBucketReclaim",
     "DDShardMerge",
     "RatekeeperThrottling",
+    "RatekeeperTenantQuota",
+    "ProxyTenantRejected",
 ):
     register(_name)

@@ -53,6 +53,13 @@ ERROR_CODES = {
     "coordinators_changed": 1203,
     "please_reboot": 1207,
     "movekeys_conflict": 1208,
+    # Tenant errors (reference error_definitions.h 2130-2137).
+    "tenant_name_required": 2130,
+    "tenant_not_found": 2131,
+    "tenant_already_exists": 2132,
+    "tenant_not_empty": 2133,
+    "invalid_tenant_name": 2134,
+    "illegal_tenant_access": 2137,
     "transaction_too_large": 2101,
     "key_too_large": 2102,
     "value_too_large": 2103,

@@ -64,6 +64,28 @@ def get_tracer() -> Tracer:
     return _tracer
 
 
+# Process-wide "current span context" (reference TraceEvent's implicit
+# span association via the actor's SpanContext): set by transports and
+# role handlers around request processing, stamped onto every TraceEvent
+# emitted inside, so cross-process hops correlate without threading the
+# id through every call signature.
+_current_span: str = ""
+
+
+def set_current_span(ctx: str) -> str:
+    """Install `ctx` as the ambient span; returns the previous one so
+    callers can restore (set/emit/restore, not a context manager, to stay
+    cheap on the hot path)."""
+    global _current_span
+    prev = _current_span
+    _current_span = ctx
+    return prev
+
+
+def get_current_span() -> str:
+    return _current_span
+
+
 class TraceEvent:
     """Builder-style structured log record."""
 
@@ -78,6 +100,8 @@ class TraceEvent:
             "Severity": severity,
             "Time": round(t, 6),
         }
+        if _current_span:
+            self._event["SpanContext"] = _current_span
         if id:
             self._event["ID"] = id
         self._logged = False

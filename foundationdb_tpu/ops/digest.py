@@ -2,13 +2,23 @@
 
 TPU kernels need fixed-width lanes; FDB keys are variable-length bytes (the
 reference's SkipList compares raw memory, SkipList.cpp:302 less()).  We embed
-keys into 24-byte digests = 6 big-endian uint32 lanes:
+keys into 32-byte digests = 8 big-endian uint32 lanes:
 
-    digest(k) = k[:23] zero-padded to 23 bytes || min(len(k), 24)
+    digest(k) = k[:31] zero-padded to 31 bytes || min(len(k), 32)
 
-For keys <= 23 bytes this is a strict order-embedding (the trailing length
+The leading SALT_LANES (2 lanes = bytes 0..7) are the TENANT-SALT COLUMN:
+multi-tenant traffic prefixes every key with its tenant's fixed 8-byte id
+(tenant/map.py), so those bytes land whole in their own lanes and the
+remaining 23 prefix bytes cover the tenant-RELATIVE key.  A tenant-relative
+key of up to 23 bytes therefore digests exactly — tenant traffic stays on
+the TPU fast path instead of flooding the supervisor's long-key recheck
+(conflict/supervisor.py).  For non-tenant keys the salt lanes simply hold
+the first 8 key bytes; the encoding is one uniform order-embedding either
+way.
+
+For keys <= 31 bytes this is a strict order-embedding (the trailing length
 marker disambiguates prefixes: "a" < "a\\x00" holds because padding ties are
-broken by length).  Keys >= 24 bytes are truncated and share the marker 24;
+broken by length).  Keys >= 32 bytes are truncated and share the marker 32;
 such collisions are handled conservatively: range begins round DOWN
 (enc_down) and range ends round UP (enc_up = enc+1ulp when truncated), so a
 digest-space range always covers the true key range.  Conservative widening
@@ -18,10 +28,10 @@ tests/test_conflict_tpu.py::test_long_keys_conservative.
 Digest arrays are PLANAR (structure-of-arrays): uint32[KEY_LANES, N], lane
 major.  Lexicographic compares and binary searches then touch one 1-D lane
 array at a time — the layout XLA vectorizes well on both CPU and TPU (row
-gathers of 6-element rows inside the search loop were measured ~1000x slower
+gathers of 8-element rows inside the search loop were measured ~1000x slower
 on CPU than planar 1-D gathers), and the natural layout for Pallas kernels.
 
-Device-side helpers give lexicographic comparison over the 6 uint32 lanes and
+Device-side helpers give lexicographic comparison over the 8 uint32 lanes and
 a vectorized lower/upper-bound binary search against the sorted boundary
 array.
 """
@@ -33,12 +43,14 @@ from typing import Sequence
 import jax.numpy as jnp
 import numpy as np
 
-KEY_LANES = 6
-PREFIX_BYTES = 23  # bytes 0..22 of the key; byte 23 is the length marker
+SALT_LANES = 2     # tenant-salt column: bytes 0..7 (the 8-byte tenant prefix)
+SALT_BYTES = 4 * SALT_LANES
+KEY_LANES = 8
+PREFIX_BYTES = 31  # bytes 0..30 of the key; byte 31 is the length marker
 DIGEST_BYTES = 4 * KEY_LANES
 
 # Digest of b"" is all zeros; all-0xFF is strictly above every real digest
-# (real marker byte <= 24), so it serves as the +inf padding sentinel.
+# (real marker byte <= 32), so it serves as the +inf padding sentinel.
 MAX_DIGEST = np.full((KEY_LANES,), 0xFFFFFFFF, dtype=np.uint32)
 MIN_DIGEST = np.zeros((KEY_LANES,), dtype=np.uint32)
 
@@ -116,7 +128,7 @@ def encode_fixed(mat: np.ndarray, lens: np.ndarray = None,
 
 
 def _add_one_ulp(d: np.ndarray) -> np.ndarray:
-    """Add 1 to the 24-byte big-endian integer formed by the lanes.
+    """Add 1 to the 32-byte big-endian integer formed by the lanes.
 
     d: uint32[N, 6] (row-major, pre-transpose)."""
     d = d.copy()
@@ -128,8 +140,9 @@ def _add_one_ulp(d: np.ndarray) -> np.ndarray:
 
 
 def planar_to_s24(planar: np.ndarray) -> np.ndarray:
-    """Host: planar uint32[6, N] -> numpy S24[N] whose ordering equals
-    digest lexicographic order (the big-endian byte concatenation).
+    """Host: planar uint32[8, N] -> numpy S<DIGEST_BYTES>[N] whose ordering
+    equals digest lexicographic order (the big-endian byte concatenation).
+    (Name kept from the 24-byte era; the width tracks DIGEST_BYTES.)
 
     Feeds np.sort / np.unique / np.searchsorted so batch key-grouping can
     run on the HOST — the basis of the sort-free device point path
@@ -177,16 +190,18 @@ def lex_min_cols(a: jnp.ndarray, b_col: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(lex_less(b, a)[None, :], b, a)
 
 
-ROW_PAD = 8  # gather row width: 6 key lanes padded to a power of two
+ROW_PAD = 8  # gather row width: the 8 key lanes exactly fill a row
 
 
 def planar_to_rows(planar: jnp.ndarray) -> jnp.ndarray:
-    """uint32[6, N] -> uint32[N, 8] interleaved rows (pad lanes zero).
+    """uint32[8, N] -> uint32[N, 8] interleaved rows (pad lanes zero).
 
     TPU gathers/scatters of whole rows run ~40x faster than six strided
     per-lane accesses; use rows for any digest gather/scatter with dynamic
     indices and convert back with rows_to_planar.  XLA CSEs repeated
     conversions of the same array inside one jit."""
+    if ROW_PAD == KEY_LANES:
+        return planar.T
     n = planar.shape[1]
     return jnp.concatenate(
         [planar.T, jnp.zeros((n, ROW_PAD - KEY_LANES), dtype=planar.dtype)],

@@ -12,10 +12,14 @@ Framing (all little-endian, over the reactor in core/scheduler.py):
 
     handshake := u32 magic 0x0FDB7C02 | u16 protocol version
     frame     := u32 length | u8 kind | body
-    kind 0 REQUEST : token str | u64 reply_id | serde(request)
+    kind 0 REQUEST : token str | u64 reply_id | bytes envelope(request)
     kind 1 REPLY_OK: u64 reply_id | serde(value)
     kind 2 REPLY_ER: u64 reply_id | serde(FdbError)
-    kind 3 ONEWAY  : token str | serde(message)
+    kind 3 ONEWAY  : token str | bytes envelope(message)
+
+envelope() is serde.encode_envelope: the sender's span context + the
+serde value — span ids ride the RPC envelope so TraceEvents correlate
+across processes (satellite of ISSUE 2; core/trace.py ambient span).
 
 Failure semantics match what upper layers can observe in simulation: a dead
 peer / reset connection breaks every pending reply promise routed over that
@@ -39,7 +43,7 @@ from . import serde
 from .endpoint import Endpoint, NetworkAddress, ReplyPromise, RequestStream
 
 MAGIC = 0x0FDB7C02
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = 3   # v3: requests/one-ways ship as span envelopes
 _HS = struct.Struct("<IH")
 _LEN = struct.Struct("<I")
 
@@ -437,8 +441,12 @@ class RealNetwork:
         if kind == K_REQUEST:
             token = r.str_()
             reply_id = r.i64()
-            request = serde.decode_value(r)
-            self._deliver_request(conn, token, reply_id, request)
+            # Span-carrying envelope (serde.encode_envelope): the
+            # caller's trace context rides every request so the far
+            # side's TraceEvents correlate (reference SpanContext on
+            # each FlowTransport packet).
+            request, span = serde.decode_envelope(r.bytes_())
+            self._deliver_request(conn, token, reply_id, request, span)
         elif kind in (K_REPLY_OK, K_REPLY_ER):
             reply_id = r.i64()
             entry = self._pending.pop(reply_id, None)
@@ -455,9 +463,14 @@ class RealNetwork:
                 promise.send(value)
         elif kind == K_ONEWAY:
             token = r.str_()
-            message = serde.decode_value(r)
+            message, span = serde.decode_envelope(r.bytes_())
             entry = self._find_endpoint(token)
             if entry is not None:
+                if span:
+                    try:
+                        message.span_context = span
+                    except Exception:  # noqa: BLE001 — slots/immutable
+                        pass
                 entry[0].deliver(message)
             else:
                 # One-way sends have no reply channel to carry an error;
@@ -470,7 +483,7 @@ class RealNetwork:
         return self._endpoints.get(Endpoint(self.address, token))
 
     def _deliver_request(self, conn: _Conn, token: str, reply_id: int,
-                         request: Any) -> None:
+                         request: Any, span: str = "") -> None:
         from ..core.wire import Writer
         entry = self._find_endpoint(token)
         if entry is None:
@@ -507,7 +520,23 @@ class RealNetwork:
                 conn.send_frame(K_REPLY_OK, w.done())
 
         request.reply = ReplyPromise(route_reply)
-        stream.deliver(request)
+        if span:
+            # Handlers run later as actors, so the ambient global cannot
+            # cover them; hang the context on the request itself (and
+            # stamp the ambient for anything emitted synchronously in
+            # deliver).
+            try:
+                request.span_context = span
+            except Exception:  # noqa: BLE001 — slots/immutable payloads
+                pass
+            from ..core.trace import set_current_span
+            prev = set_current_span(span)
+            try:
+                stream.deliver(request)
+            finally:
+                set_current_span(prev)
+        else:
+            stream.deliver(request)
 
     # -- sending (SimNetwork surface) ----------------------------------------
     def send_request(self, ep: Endpoint, request: Any,
@@ -545,7 +574,9 @@ class RealNetwork:
         self._next_reply_id += 1
         self._pending[reply_id] = (promise, conn)
         w = Writer().str_(ep.token).i64(reply_id)
-        serde.encode_value(w, request)
+        # encode_envelope attaches the AMBIENT span (core/trace.py) so a
+        # handler issuing follow-on RPCs propagates its caller's context.
+        w.bytes_(serde.encode_envelope(request))
         conn.send_frame(K_REQUEST, w.done())
         return promise.get_future()
 
@@ -566,7 +597,7 @@ class RealNetwork:
                 "Peer", f"{ep.address}").log()
             return
         w = Writer().str_(ep.token)
-        serde.encode_value(w, message)
+        w.bytes_(serde.encode_envelope(message))
         conn.send_frame(K_ONEWAY, w.done())
 
     def close(self) -> None:

@@ -287,6 +287,29 @@ def decode_message(b: bytes) -> Any:
     return decode_value(Reader(b))
 
 
+# -- span-carrying envelope (reference flow/Tracing.h SpanContext riding
+# every FlowTransport packet): the span id travels OUTSIDE the value so
+# transports can stamp/propagate it without understanding the payload. ----
+
+def encode_envelope(v: Any, span: str = "") -> bytes:
+    """Message + span context.  With span omitted, the ambient current
+    span (core/trace.py) is attached, so a handler that issues follow-on
+    RPCs propagates its caller's context for free."""
+    if not span:
+        from ..core.trace import get_current_span
+        span = get_current_span()
+    w = Writer().str_(span)
+    encode_value(w, v)
+    return w.done()
+
+
+def decode_envelope(b: bytes):
+    """(value, span) from an envelope frame."""
+    r = Reader(b)
+    span = r.str_()
+    return decode_value(r), span
+
+
 _bootstrapped = False
 
 

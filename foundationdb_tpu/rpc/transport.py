@@ -5,10 +5,18 @@ Reference: fdbrpc/FlowTransport.actor.cpp — one connection per peer pair, a
 (`deliver` :919).  This module is the multi-process half of that design for
 the framework's wire format (core/wire.py):
 
-    frame    := u32 length | u64 token | u8 kind | payload
+    frame    := u32 length | u64 token | u8 kind | u8 span_len | span
+                | payload
     kind     := 0 request (payload ends with a u64 reply token)
                 1 reply
     handshake:= u32 magic 0x0FDB7C01 | u16 protocol version
+
+The span field is the cross-process trace context (reference
+flow/Tracing.h SpanContext riding every FlowTransport packet): a request
+carries its caller's span id, the server installs it as the ambient span
+(core/trace.py set_current_span) while the handler runs — so every
+TraceEvent the handler emits is stamped with it — and the reply echoes it
+back.  Protocol version 2 (v1 frames had no span field).
 
 Serialization of the demonstrator messages lives in `serialize_kv_*` —
 the classic length-prefixed field order of flow/serialize.h.  The
@@ -31,7 +39,7 @@ from typing import Callable, Dict, Optional, Tuple
 from ..core.wire import Reader, Writer
 
 MAGIC = 0x0FDB7C01
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2                # v2: frames carry a span context
 _HDR = struct.Struct("<I")          # frame length
 _TOKEN_KIND = struct.Struct("<QB")  # token, kind
 
@@ -50,12 +58,15 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def _send_frame(sock: socket.socket, token: int, kind: int,
-                payload: bytes) -> None:
-    body = _TOKEN_KIND.pack(token, kind) + payload
+                payload: bytes, span: str = "") -> None:
+    sb = span.encode()[:255]
+    body = (_TOKEN_KIND.pack(token, kind) + bytes([len(sb)]) + sb +
+            payload)
     sock.sendall(_HDR.pack(len(body)) + body)
 
 
-def _recv_frame(sock: socket.socket) -> Optional[Tuple[int, int, bytes]]:
+def _recv_frame(sock: socket.socket
+                ) -> Optional[Tuple[int, int, bytes, str]]:
     hdr = _recv_exact(sock, 4)
     if hdr is None:
         return None
@@ -64,7 +75,10 @@ def _recv_frame(sock: socket.socket) -> Optional[Tuple[int, int, bytes]]:
     if body is None:
         return None
     token, kind = _TOKEN_KIND.unpack_from(body, 0)
-    return token, kind, body[_TOKEN_KIND.size:]
+    o = _TOKEN_KIND.size
+    span_len = body[o]
+    span = body[o + 1:o + 1 + span_len].decode(errors="replace")
+    return token, kind, body[o + 1 + span_len:], span
 
 
 class TcpTransport:
@@ -122,11 +136,12 @@ class TcpTransport:
         self._frame_loop(conn)
 
     def _frame_loop(self, conn: socket.socket) -> None:
+        from ..core.trace import set_current_span
         while True:
             frame = _recv_frame(conn)
             if frame is None:
                 return
-            token, kind, payload = frame
+            token, kind, payload, span = frame
             if kind == KIND_REQUEST:
                 r = Reader(payload)
                 body = r.bytes_()
@@ -134,14 +149,22 @@ class TcpTransport:
                 handler = self._handlers.get(token)
                 if handler is None:
                     continue   # unknown endpoint: drop (broken promise)
+                # The caller's span becomes the ambient context while the
+                # handler runs: every TraceEvent it emits carries it, and
+                # the reply echoes it back (reference: SpanContext rides
+                # each FlowTransport packet).
+                prev = set_current_span(span)
                 try:
                     result = handler(body)
                 except Exception:  # noqa: BLE001 — one bad request must
                     # not tear down the connection; the caller's reply
                     # promise breaks via its timeout.
                     continue
+                finally:
+                    set_current_span(prev)
                 with self._send_lock:
-                    _send_frame(conn, reply_token, KIND_REPLY, result)
+                    _send_frame(conn, reply_token, KIND_REPLY, result,
+                                span)
             elif kind == KIND_REPLY:
                 with self._lock:
                     ev = self._replies.get(token)
@@ -177,8 +200,13 @@ class TcpTransport:
         return sock
 
     def request(self, addr: Tuple[str, int], token: int, payload: bytes,
-                timeout: float = 10.0) -> bytes:
-        """Blocking request/reply over the peer connection."""
+                timeout: float = 10.0, span: str = "") -> bytes:
+        """Blocking request/reply over the peer connection.  `span`
+        (default: the ambient current span) rides the frame so the far
+        side's TraceEvents correlate with this caller's."""
+        if not span:
+            from ..core.trace import get_current_span
+            span = get_current_span()
         sock = self._connect(addr)
         with self._lock:
             reply_token = self._next_reply_token
@@ -187,7 +215,7 @@ class TcpTransport:
             self._replies[reply_token] = ev
         body = Writer().bytes_(payload).i64(reply_token).done()
         with self._send_lock:
-            _send_frame(sock, token, KIND_REQUEST, body)
+            _send_frame(sock, token, KIND_REQUEST, body, span)
         try:
             if not ev.wait(timeout):
                 raise TimeoutError(f"no reply for token {token}")

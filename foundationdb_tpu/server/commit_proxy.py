@@ -57,7 +57,8 @@ class LogSystemClient:
 
     def push(self, prev_version: Version, version: Version,
              known_committed_version: Version,
-             messages: Dict[Tag, List[Mutation]]) -> Future:
+             messages: Dict[Tag, List[Mutation]],
+             span: str = "") -> Future:
         per_log: List[Dict[Tag, List[Mutation]]] = [
             {} for _ in self.tlogs]
         for tag, msgs in messages.items():
@@ -68,7 +69,7 @@ class LogSystemClient:
             replies.append(tlog.commit.get_reply(TLogCommitRequest(
                 prev_version=prev_version, version=version,
                 known_committed_version=known_committed_version,
-                messages=msgs)))
+                messages=msgs, span=span)))
         return wait_all(replies)
 
     def pop(self, tag: Tag, to: Version) -> None:
@@ -110,7 +111,9 @@ class CommitProxy:
                  key_resolvers: RangeMap,
                  key_servers: RangeMap,
                  storage_interfaces: Optional[Dict[Tag, Any]] = None,
-                 recovery_version: Version = 0) -> None:
+                 recovery_version: Version = 0,
+                 tenants: Optional[Dict[int, bytes]] = None,
+                 tenant_metadata_version: int = 0) -> None:
         self.id = proxy_id
         self.master = master            # MasterInterface
         self.resolvers = resolvers      # [ResolverInterface]
@@ -165,6 +168,17 @@ class CommitProxy:
         # Exactly-once cursor over foreign state transactions (version,
         # origin proxy, seq); see _apply_foreign_state.
         self._state_hwm: Tuple[Version, str, int] = (-1, "", -1)
+        # Tenant cache {id: name} (reference ProxyCommitData tenantMap):
+        # seeded at recruitment from the master's replayed metadata, kept
+        # current by committed \xff/tenant/map/ mutations — our own via
+        # _apply_metadata, other proxies' via _apply_foreign_state — so a
+        # validation at batch time is never stale past commit_version.
+        self.tenants: Dict[int, bytes] = dict(tenants or {})
+        self.tenant_metadata_version = tenant_metadata_version
+        # Per-tenant write metering (bytes/ops committed through this
+        # proxy), surfaced in status alongside storage read metering.
+        self.tenant_write_ops: Dict[bytes, int] = {}
+        self.tenant_write_bytes: Dict[bytes, int] = {}
 
     @property
     def stats(self):
@@ -174,6 +188,33 @@ class CommitProxy:
                 "too_old": c("TxnTooOld").value,
                 "batches": c("TxnCommitBatches").value,
                 "mutations": c("Mutations").value}
+
+    @staticmethod
+    def _tenant_prefix_ok(txn) -> bool:
+        """Intrinsic tenant-prefix check (no map lookup): every mutation
+        and write conflict range of a tenant-tagged transaction must stay
+        inside the claimed id's 8-byte prefix.  Enforced at BATCH
+        ASSEMBLY so a forged cross-prefix (or \xff-touching) transaction
+        never reaches resolution — otherwise resolvers would treat its
+        metadata mutations as a state transaction and FOREIGN proxies
+        would apply them while the origin proxy rejects it (cache
+        divergence).  The tenant-EXISTS check stays post-resolution
+        (_validate_tenants), where the cache is exact."""
+        tid = getattr(txn, "tenant_id", -1)
+        if tid is None or tid < 0:
+            return True
+        from ..tenant.map import tenant_prefix
+        from ..txn.types import strinc
+        p = tenant_prefix(tid)
+        p_end = strinc(p)
+        for m in txn.mutations:
+            if m.type == MutationType.ClearRange:
+                if not (p <= m.param1 and m.param2 <= p_end):
+                    return False
+            elif not m.param1.startswith(p):
+                return False
+        return all(p <= w.begin and w.end <= p_end
+                   for w in txn.write_conflict_ranges)
 
     # -- batcher (reference commitBatcher :199) ------------------------------
     async def _commit_batcher(self) -> None:
@@ -193,6 +234,13 @@ class CommitProxy:
                 from ..core.error import err
                 self.metrics.counter("TxnRejectedLocked").add(1)
                 first.reply.send_error(err("database_locked"))
+                continue
+            if not self._tenant_prefix_ok(first.transaction):
+                from ..core.error import err
+                self.metrics.counter("TxnTenantRejected").add(1)
+                first.reply.send_error(err(
+                    "illegal_tenant_access",
+                    "mutation outside the claimed tenant prefix"))
                 continue
             batch = [first]
             batch_bytes = first.transaction.expected_size()
@@ -215,6 +263,13 @@ class CommitProxy:
                         from ..core.error import err
                         self.metrics.counter("TxnRejectedLocked").add(1)
                         req.reply.send_error(err("database_locked"))
+                        continue
+                    if not self._tenant_prefix_ok(req.transaction):
+                        from ..core.error import err
+                        self.metrics.counter("TxnTenantRejected").add(1)
+                        req.reply.send_error(err(
+                            "illegal_tenant_access",
+                            "mutation outside the claimed tenant prefix"))
                         continue
                     batch.append(req)
                     batch_bytes += req.transaction.expected_size()
@@ -262,6 +317,18 @@ class CommitProxy:
                                  batch_num: int) -> None:
         self.metrics.counter("TxnCommitBatches").add(1)
         t_start = now()
+        # One span per commit batch (reference Span("commitBatch") in
+        # CommitBatchContext): rides the resolution requests and the TLog
+        # push explicitly (an ambient global would leak across actor
+        # interleavings in the async body); any client-provided debug ids
+        # correlate to it here.
+        from ..core.trace import trace_batch_event
+        span = f"{self.id}.b{batch_num}"
+        trace_batch_event("CommitDebug", span, "CommitProxy.batchStart")
+        for req in batch:
+            if req.debug_id:
+                trace_batch_event("CommitDebug", req.debug_id,
+                                  f"CommitProxy.batch:{span}")
 
         # Phase 1: pre-resolution. Gate: the previous batch must have entered
         # resolution so master versions are requested in order (:589).
@@ -279,6 +346,8 @@ class CommitProxy:
         # Phase 2: resolution — fan out to resolvers (:660).
         requests, index_maps = self._build_resolution_requests(
             batch, prev_version, commit_version)
+        for r in requests:
+            r.span = span
         self.batch_resolving.set_at_least(batch_num)  # next may fetch a version
         resolution_futures = [
             RequestStream.at(r.resolve.endpoint).get_reply(req)
@@ -292,6 +361,10 @@ class CommitProxy:
         await self.batch_logging.when_at_least(batch_num - 1)
         self._apply_foreign_state(resolutions)
         verdicts = self._determine_committed(batch, index_maps, resolutions)
+        # Tenant fence AFTER foreign state: the cache now reflects every
+        # tenant create/delete committed below commit_version, so a
+        # deleted tenant's writes can never reach the mutation stream.
+        tenant_errors = self._validate_tenants(batch, verdicts)
         messages = self._assign_mutations_to_tags(
             batch, verdicts, commit_version)
         self.metrics.counter("Mutations").add(
@@ -301,7 +374,7 @@ class CommitProxy:
         log_done = self.log_system.push(
             prev_version, commit_version,
             known_committed_version=self.committed_version.get(),
-            messages=messages)
+            messages=messages, span=span)
         self.batch_logging.set_at_least(batch_num)  # next may enter logging
         t_log = now()
         await log_done
@@ -330,7 +403,13 @@ class CommitProxy:
                     t_idx = index_maps[r_idx][local_i]
                     conflict_ranges.setdefault(t_idx, []).extend(ranges)
         for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
-            if verdict == CommitResult.COMMITTED:
+            if t_idx in tenant_errors:
+                # Tenant fence rejection: a SPECIFIC, non-retryable error
+                # (not not_committed — retrying a dead tenant's write
+                # would loop forever).
+                self.metrics.counter("TxnTenantRejected").add(1)
+                req.reply.send_error(tenant_errors[t_idx])
+            elif verdict == CommitResult.COMMITTED:
                 self.metrics.counter("TxnCommitted").add(1)
                 req.reply.send(CommitID(version=commit_version,
                                         txn_batch_id=batch_num,
@@ -494,6 +573,12 @@ class CommitProxy:
                     "Url", getattr(self, "backup_container", "")).log()
             except Exception:  # noqa: BLE001 — next recovery recruits
                 pass
+        from ..tenant.map import apply_tenant_mutation
+        if apply_tenant_mutation(self.tenants, m):
+            # Tenant map changed: invalidate caches keyed by the metadata
+            # version (clients re-read; tests assert monotonicity).
+            self.tenant_metadata_version += 1
+            handled = True
         from .system_data import parse_conf_mutation
         cf = parse_conf_mutation(m)
         if cf is not None:
@@ -582,6 +667,81 @@ class CommitProxy:
                 continue
             for m in mutations:
                 self._apply_metadata(m)
+
+    def _validate_tenants(self, batch, verdicts) -> Dict[int, Any]:
+        """Tenant fence (reference CommitProxyServer verifyTenantPrefix +
+        tenant map validation): for every still-COMMITTED tenant-tagged
+        transaction, require (a) the tenant exists as of ITS position in
+        the batch — the proxy cache (kept exact below commit_version by
+        _apply_metadata/_apply_foreign_state) overlaid with tenant-map
+        mutations of EARLIER committed transactions in this same batch,
+        so a same-batch create admits and a same-batch delete fences —
+        and (b) the intrinsic prefix check (_tenant_prefix_ok; already
+        enforced at batch assembly, re-checked here as the last line).
+        Violations flip the verdict to CONFLICT (so no mutation routes)
+        and record the specific error for the reply loop; surviving
+        tenant commits are metered per tenant.  Returns
+        {batch index: FdbError}."""
+        from ..core.error import err as _err
+        from ..tenant.map import apply_tenant_mutation
+        from .system_data import TENANT_MAP_PREFIX
+        errors: Dict[int, Any] = {}
+        overlay: Optional[Dict[int, bytes]] = None   # copied lazily
+        for t_idx, req in enumerate(batch):
+            txn = req.transaction
+            tid = getattr(txn, "tenant_id", -1)
+            if verdicts[t_idx] == CommitResult.COMMITTED and \
+                    any(m.param1.startswith(TENANT_MAP_PREFIX) or
+                        (m.type == MutationType.ClearRange and
+                         m.param2 > TENANT_MAP_PREFIX)
+                        for m in txn.mutations):
+                # Fold this committed management txn's map changes so
+                # LATER txns of the batch validate against them (batch
+                # order = the order effects apply at commit_version).
+                if overlay is None:
+                    overlay = dict(self.tenants)
+                for m in txn.mutations:
+                    apply_tenant_mutation(overlay, m)
+            if tid is None or tid < 0 or \
+                    verdicts[t_idx] != CommitResult.COMMITTED:
+                continue
+            tenants = overlay if overlay is not None else self.tenants
+            name = tenants.get(tid)
+            if name is None:
+                from ..core.coverage import test_coverage
+                test_coverage("ProxyTenantRejected")
+                verdicts[t_idx] = CommitResult.CONFLICT
+                errors[t_idx] = _err(
+                    "tenant_not_found",
+                    f"tenant id {tid} unknown or deleted")
+                TraceEvent("ProxyTenantRejected", Severity.Warn).detail(
+                    "Proxy", self.id).detail("TenantId", tid).log()
+                continue
+            if not self._tenant_prefix_ok(txn):
+                verdicts[t_idx] = CommitResult.CONFLICT
+                errors[t_idx] = _err(
+                    "illegal_tenant_access",
+                    f"mutation outside tenant {name!r} prefix")
+                TraceEvent("ProxyTenantPrefixViolation",
+                           Severity.Error).detail(
+                    "Proxy", self.id).detail("TenantId", tid).log()
+                continue
+            self.tenant_write_ops[name] = \
+                self.tenant_write_ops.get(name, 0) + len(txn.mutations)
+            self.tenant_write_bytes[name] = \
+                self.tenant_write_bytes.get(name, 0) + txn.expected_size()
+        return errors
+
+    def tenant_status(self) -> Dict[str, Any]:
+        """Tenant cache + write metering for status JSON."""
+        return {
+            "count": len(self.tenants),
+            "metadata_version": self.tenant_metadata_version,
+            "write_ops": {n.decode("utf-8", "backslashreplace"): v
+                          for n, v in self.tenant_write_ops.items()},
+            "write_bytes": {n.decode("utf-8", "backslashreplace"): v
+                            for n, v in self.tenant_write_bytes.items()},
+        }
 
     def _determine_committed(self, batch, index_maps, resolutions
                              ) -> List[CommitResult]:

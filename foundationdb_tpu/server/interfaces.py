@@ -225,6 +225,10 @@ class ResolveTransactionBatchRequest:
     transactions: List[CommitTransactionRef]
     txn_state_transactions: List[int] = field(default_factory=list)
     proxy_id: str = ""
+    # Commit-batch span context (reference Span riding resolution
+    # requests, flow/Tracing.h): stamps resolver TraceEvents so the
+    # proxy->resolver->tlog hop correlates cross-process.
+    span: str = ""
     reply: Any = None
 
 
@@ -384,6 +388,8 @@ class TLogCommitRequest:
     known_committed_version: Version
     # tag -> serialized mutation list for that tag at this version.
     messages: Dict[Tag, List[Mutation]]
+    # Commit-batch span context (see ResolveTransactionBatchRequest.span).
+    span: str = ""
     reply: Any = None
 
 
@@ -707,6 +713,11 @@ class InitializeCommitProxyRequest:
     # mirrored to tss_tag(t) and the primary's location entries carry
     # the pair for client-side comparison.
     tss_mapping: Dict[Tag, Any] = field(default_factory=dict)
+    # Tenant map snapshot {id: name} as of recovery (committed
+    # \xff/tenant/map/ state, replayed by the master): the proxy's tenant
+    # fence is exact from its first batch.
+    tenants: Dict[int, bytes] = field(default_factory=dict)
+    tenant_metadata_version: int = 0
     reply: Any = None     # -> CommitProxyInterface
 
 

@@ -232,6 +232,12 @@ class DBCoreState:
     # the fence from their first batch, even after a full power failure
     # (the lock is committed data; reference databaseLockedKey).
     locked: Optional[bytes] = None
+    # Tenant map snapshot {id: name} as of map_version (committed
+    # \xff/tenant/map/ state; TXS replay applies later creates/deletes on
+    # top) — recruited proxies enforce the tenant fence from their first
+    # batch, across full power failures.
+    tenants: Dict[int, bytes] = field(default_factory=dict)
+    tenant_metadata_version: int = 0
 
     def pack(self) -> bytes:
         from ..core.wire import Writer
@@ -269,6 +275,12 @@ class DBCoreState:
         w.u8(1 if self.locked is not None else 0)
         if self.locked is not None:
             w.bytes_(self.locked)
+        # u32 count: per-user tenancy targets millions of tenants and a
+        # u16 here would wedge every future recovery past 65535.
+        w.u32(len(self.tenants))
+        for tid, tname in sorted(self.tenants.items()):
+            w.i64(tid).bytes_(tname)
+        w.i64(self.tenant_metadata_version)
         return w.done()
 
     @staticmethod
@@ -312,6 +324,13 @@ class DBCoreState:
         locked: Optional[bytes] = None
         if not r.at_end() and r.u8():
             locked = r.bytes_()
+        tenants: Dict[int, bytes] = {}
+        tenant_metadata_version = 0
+        if not r.at_end():
+            for _ in range(r.u32()):
+                tid = r.i64()
+                tenants[tid] = r.bytes_()
+            tenant_metadata_version = r.i64()
         return cls(epoch=epoch, recovery_version=rv,
                    tlogs=[None] * len(tlog_ids), log_replication=log_rep,
                    storage_servers={t: None for t in storage_ids},
@@ -321,7 +340,9 @@ class DBCoreState:
                    conf=conf, remote_tlog_ids=remote_tlog_ids,
                    remote_storage={t: None for t in remote_storage_ids},
                    remote_storage_ids=remote_storage_ids,
-                   backup_container=backup_container, locked=locked)
+                   backup_container=backup_container, locked=locked,
+                   tenants=tenants,
+                   tenant_metadata_version=tenant_metadata_version)
 
 
 def _split_points(n: int) -> List[bytes]:
@@ -786,6 +807,12 @@ async def master_server(master: Master, process, coordinators,
                         elif m.type == _MT.ClearRange and \
                                 m.param1 <= DB_LOCKED_KEY < m.param2:
                             prev.locked = None
+                        from ..tenant.map import apply_tenant_mutation
+                        if apply_tenant_mutation(prev.tenants, m):
+                            # Tenant creates/deletes since the snapshot:
+                            # this epoch's proxies fence against the
+                            # replayed map from their first batch.
+                            prev.tenant_metadata_version += 1
                         cf = parse_conf_mutation(m)
                         if cf is not None:
                             # Configuration changes committed since the
@@ -1204,7 +1231,10 @@ async def master_server(master: Master, process, coordinators,
                 db_locked=prev.locked if prev else None,
                 region_replication=bool(remote_tlogs),
                 storage_caches=storage_caches,
-                tss_mapping=tss_mapping))
+                tss_mapping=tss_mapping,
+                tenants=dict(prev.tenants) if prev else {},
+                tenant_metadata_version=(
+                    prev.tenant_metadata_version if prev else 0)))
             for i in range(config.n_commit_proxies)]
         grv_proxy_futures = [RequestStream.at(
             pick(i + 1).init_grv_proxy.endpoint).get_reply(
@@ -1232,7 +1262,10 @@ async def master_server(master: Master, process, coordinators,
             remote_tlogs=remote_tlogs,
             remote_storage=remote_storage,
             backup_container=prev.backup_container if prev else "",
-            locked=prev.locked if prev else None))
+            locked=prev.locked if prev else None,
+            tenants=dict(prev.tenants) if prev else {},
+            tenant_metadata_version=(
+                prev.tenant_metadata_version if prev else 0)))
 
         # ACCEPTING_COMMITS (:1943): start the allocator + announce.
         adopt(master._serve_commit_versions(), "master.serveVersions")

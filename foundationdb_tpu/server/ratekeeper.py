@@ -103,6 +103,10 @@ class StorageQueuingMetricsReply:
     busiest_read_tag: str = ""
     busiest_read_rate: float = 0.0   # ops/s attributed to that tag
     total_read_rate: float = 0.0     # ops/s total on this server
+    # Per-tag read metering (top tags only; tenant tags "t/<name>" feed
+    # per-tenant quota enforcement + status).
+    tag_read_ops: Dict[str, float] = field(default_factory=dict)
+    tag_read_bytes: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -130,6 +134,11 @@ class RatekeeperStatusReply:
     worst_queue_bytes: int
     worst_tlog_queue_bytes: int = 0
     throttled_tags: Dict[str, float] = field(default_factory=dict)
+    # Committed per-tenant quotas (throttle tag -> tps) and the measured
+    # per-tag read rates they are enforced against.
+    tenant_quotas: Dict[str, float] = field(default_factory=dict)
+    tag_read_ops: Dict[str, float] = field(default_factory=dict)
+    tag_read_bytes: Dict[str, float] = field(default_factory=dict)
 
 
 class RatekeeperInterface:
@@ -149,13 +158,16 @@ class RatekeeperInterface:
 class Ratekeeper:
     def __init__(self, rk_id: str, storage_interfaces: Dict[int, Any],
                  tlog_interfaces: List[Any] = (),
-                 poll_interval: float = 0.5) -> None:
+                 poll_interval: float = 0.5, db: Any = None) -> None:
         self.id = rk_id
         self.interface = RatekeeperInterface(rk_id)
         self.interface.role = self   # sim-side backref for status/tests
         self.storage_interfaces = storage_interfaces
         self.tlog_interfaces = list(tlog_interfaces)
         self.poll_interval = poll_interval
+        # Optional db client (worker-injected): polls committed
+        # per-tenant quotas — configuration as data, no private channel.
+        self.db = db
         self.tps_limit: float = float("inf")
         self.batch_tps_limit: float = float("inf")
         self.limit_reason = "workload"
@@ -170,6 +182,16 @@ class Ratekeeper:
         # tag -> Smoother over proxy-reported per-tag release totals.
         self._tag_released: Dict[str, Smoother] = {}
         self._proxy_tag_released: Dict[str, Dict[str, int]] = {}
+        # Per-tenant quotas: throttle tag ("t/<name>") -> committed tps
+        # ceiling (\xff/tenant/quota/, polled via self.db).  Enforced as
+        # continuously refreshed tag throttles so the existing GRV-proxy
+        # token buckets do the holding.
+        self.tenant_quotas: Dict[str, float] = {}
+        self._quota_traced: set = set()
+        # Cluster-wide measured per-tag read rates from the last storage
+        # poll (ops/s and bytes/s), for status + quota decisions.
+        self.tag_read_ops: Dict[str, float] = {}
+        self.tag_read_bytes: Dict[str, float] = {}
 
     # -- rate computation (reference updateRate :991) ------------------------
     def _release_rate(self) -> float:
@@ -271,6 +293,58 @@ class Ratekeeper:
                 del self.tag_throttles[tag]
                 TraceEvent("RkTagUnthrottled").detail("Tag", tag).log()
 
+    # -- per-tenant quotas (ISSUE 2: tenant quotas through tag throttles) ----
+    def _update_tag_metering(self, ss_replies: List[Any]) -> None:
+        """Fold per-tag read metering across servers (status + quota
+        visibility) and trace newly enforced quotas."""
+        ops: Dict[str, float] = {}
+        nbytes: Dict[str, float] = {}
+        for r in ss_replies:
+            for tag, rate in getattr(r, "tag_read_ops", {}).items():
+                ops[tag] = ops.get(tag, 0.0) + rate
+            for tag, rate in getattr(r, "tag_read_bytes", {}).items():
+                nbytes[tag] = nbytes.get(tag, 0.0) + rate
+        self.tag_read_ops = ops
+        self.tag_read_bytes = nbytes
+        for tag, quota in self.tenant_quotas.items():
+            if tag not in self._quota_traced:
+                self._quota_traced.add(tag)
+                from ..core.coverage import test_coverage
+                test_coverage("RatekeeperTenantQuota")
+                TraceEvent("RkTenantQuotaThrottled").detail(
+                    "Tag", tag).detail("Tps", quota).detail(
+                    "ReadOps", ops.get(tag, 0.0)).log()
+        self._quota_traced &= set(self.tenant_quotas)
+
+    def effective_throttles(self) -> Dict[str, float]:
+        """Tag -> enforced tps ceiling: expiring auto-throttles merged
+        with standing tenant quotas (min when both).  Quotas deliberately
+        live OUTSIDE tag_throttles: writing them there and re-arming the
+        expiry each poll would latch a transient auto-throttle value
+        below the quota FOREVER ('tighten, never loosen' + refreshed
+        expiry), permanently over-throttling a tenant after a brief
+        storm."""
+        out = {tag: tps for tag, (tps, _exp) in self.tag_throttles.items()}
+        for tag, quota in self.tenant_quotas.items():
+            cur = out.get(tag)
+            out[tag] = quota if cur is None else min(cur, quota)
+        return out
+
+    async def _poll_quotas(self) -> None:
+        """Read committed \xff/tenant/quota/ state (tenant/management.py)
+        through the injected db client.  Pipeline-down windows (recovery)
+        just keep the last map."""
+        from ..tenant.management import get_tenant_quotas
+        from ..tenant.map import tenant_tag
+        while True:
+            try:
+                quotas = await get_tenant_quotas(self.db)
+                self.tenant_quotas = {tenant_tag(name): tps
+                                      for name, tps in quotas.items()}
+            except Exception:  # noqa: BLE001 — retry forever
+                pass
+            await delay(max(self.poll_interval * 2, 1.0))
+
     async def _poll_storage(self) -> None:
         from ..core.futures import swallow, wait_all
         while True:
@@ -295,6 +369,7 @@ class Ratekeeper:
                 (f.get().queue_bytes for f in t_futures
                  if not f.is_error()), default=0)
             self._update_tag_throttles(replies)
+            self._update_tag_metering(replies)
             self._update_rate()
             await delay(self.poll_interval)
 
@@ -340,8 +415,8 @@ class Ratekeeper:
                 tps=self.tps_limit / n_proxies,
                 batch_tps=self.batch_tps_limit / n_proxies,
                 tag_throttles={tag: tps / n_proxies
-                               for tag, (tps, _exp)
-                               in self.tag_throttles.items()},
+                               for tag, tps
+                               in self.effective_throttles().items()},
                 lease_duration=self.poll_interval * 2))
 
     async def _serve_status(self) -> None:
@@ -352,8 +427,10 @@ class Ratekeeper:
                 released_tps=self._release_rate(),
                 worst_queue_bytes=self.worst_queue_bytes,
                 worst_tlog_queue_bytes=self.worst_tlog_queue_bytes,
-                throttled_tags={tag: tps for tag, (tps, _exp)
-                                in self.tag_throttles.items()}))
+                throttled_tags=self.effective_throttles(),
+                tenant_quotas=dict(self.tenant_quotas),
+                tag_read_ops=dict(self.tag_read_ops),
+                tag_read_bytes=dict(self.tag_read_bytes)))
 
     def run(self, process) -> None:
         for s in self.interface.streams():
@@ -361,6 +438,8 @@ class Ratekeeper:
         process.spawn(self._poll_storage(), f"{self.id}.pollStorage")
         process.spawn(self._serve_rate_info(), f"{self.id}.serveRate")
         process.spawn(self._serve_status(), f"{self.id}.serveStatus")
+        if self.db is not None:
+            process.spawn(self._poll_quotas(), f"{self.id}.pollQuotas")
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),
                       f"{self.id}.waitFailure")

@@ -92,6 +92,13 @@ class Resolver:
             f"resolver {self.id}: version chain broken "
             f"{self.version.get()} != {req.prev_version}")
 
+        if req.span:
+            # Cross-process commit correlation (reference g_traceBatch
+            # CommitDebug points): the proxy's batch span stamps this hop.
+            from ..core.trace import trace_batch_event
+            trace_batch_event("CommitDebug", req.span,
+                              f"Resolver.{self.id}.resolveBatch")
+
         knobs = server_knobs()
         new_oldest = max(self.conflict_set.oldest_version,
                          req.version -

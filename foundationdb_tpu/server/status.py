@@ -89,6 +89,7 @@ async def build_status(cc) -> Dict[str, Any]:
     # (reference: roles push TDMetrics / the status collector polls each
     # worker; here the collections are read in place).
     roles = {}
+    tenants_doc: Dict[str, Any] = {}
     for kind, ifaces in (
             ("commit_proxies", info.commit_proxies),
             ("grv_proxies", info.grv_proxies),
@@ -106,8 +107,26 @@ async def build_status(cc) -> Dict[str, Any]:
                 bs = backend() if callable(backend) else None
                 if bs:
                     entry["conflict_backend"] = bs
+                # Commit-proxy tenant cache + per-tenant write metering
+                # (tenant fence, server/commit_proxy.py).
+                ts = getattr(role, "tenant_status", None)
+                td = ts() if callable(ts) else None
+                if td:
+                    entry["tenants"] = td
+                    tenants_doc.setdefault("num_tenants", td["count"])
+                    tenants_doc.setdefault(
+                        "metadata_version", td["metadata_version"])
                 entries[metrics.role_id] = entry
         roles[kind] = entries
+    if rk is not None:
+        # Per-tenant quotas + measured read rates + live throttles
+        # (server/ratekeeper.py quota enforcement).
+        tenants_doc["quotas"] = getattr(rk, "tenant_quotas", {}) or {}
+        tenants_doc["throttled_tags"] = rk.throttled_tags
+        tenants_doc["tag_read_ops_per_sec"] = \
+            getattr(rk, "tag_read_ops", {}) or {}
+        tenants_doc["tag_read_bytes_per_sec"] = \
+            getattr(rk, "tag_read_bytes", {}) or {}
 
     return {
         "client": {
@@ -149,6 +168,7 @@ async def build_status(cc) -> Dict[str, Any]:
                 "state": {"healthy": True, "name": "healthy"},
             },
             "layers": {"_valid": True},
+            "tenants": tenants_doc,
             "roles": roles,
             "cluster_controller_timestamp": round(now(), 3),
             # The quorum this CC is operating against (reference status

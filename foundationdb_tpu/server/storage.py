@@ -197,7 +197,10 @@ class StorageServer:
         self.stats = {"reads": 0, "range_reads": 0, "mutations": 0,
                       "watches": 0}
         # Busy-read tag sampling window (reset each ratekeeper poll).
+        # Tenant tags ("t/<name>", tenant/map.py tenant_tag) additionally
+        # meter bytes, feeding per-tenant quotas in the ratekeeper.
         self._tag_read_ops: Dict[str, int] = {}
+        self._tag_read_bytes: Dict[str, int] = {}
         self._read_ops_window = 0
         self._read_window_start = now()
         self._process = None
@@ -452,11 +455,11 @@ class StorageServer:
             await self._wait_for_version(req.version)
             self._check_owned(req.key, req.key + b"\x00", req.version)
             self.stats["reads"] += 1
-            self._sample_read_tag(req.tag)
+            value = self.data.get(req.key, req.version)
+            self._sample_read_tag(
+                req.tag, len(req.key) + (len(value) if value else 0))
             self.metrics.histogram("ReadLatency").record(now() - _t0)
-            req.reply.send(GetValueReply(
-                value=self.data.get(req.key, req.version),
-                version=req.version))
+            req.reply.send(GetValueReply(value=value, version=req.version))
         except Exception as e:   # noqa: BLE001 - errors propagate via reply
             req.reply.send_error(e)
 
@@ -466,10 +469,11 @@ class StorageServer:
             await self._wait_for_version(req.version)
             self._check_owned(req.begin, req.end, req.version)
             self.stats["range_reads"] += 1
-            self._sample_read_tag(req.tag)
             data, more = self.data.range_read(
                 req.begin, req.end, req.version, req.limit, req.limit_bytes,
                 req.reverse)
+            self._sample_read_tag(
+                req.tag, sum(len(k) + len(v) for k, v in data))
             req.reply.send(GetKeyValuesReply(data=data, more=more,
                                              version=req.version))
         except Exception as e:   # noqa: BLE001
@@ -574,13 +578,21 @@ class StorageServer:
                 (self.version.get(), 1, req.begin, req.end))
         req.reply.send(None)
 
-    def _sample_read_tag(self, tag: str) -> None:
+    # At most this many per-tag rows ride one queuing-metrics reply (tags
+    # are arbitrary client strings; tenant tags dominate in practice).
+    _TAG_REPORT_MAX = 64
+
+    def _sample_read_tag(self, tag: str, nbytes: int = 0) -> None:
         """Busy-read sampling for ratekeeper tag auto-throttling
         (reference storage server busiest-tag tracking feeding
-        StorageQueuingMetricsReply.busiestTag)."""
+        StorageQueuingMetricsReply.busiestTag) + per-tag byte metering
+        (tenant quotas: tenant/map.py tenant_tag rides every read)."""
         self._read_ops_window += 1
         if tag:
             self._tag_read_ops[tag] = self._tag_read_ops.get(tag, 0) + 1
+            if nbytes:
+                self._tag_read_bytes[tag] = \
+                    self._tag_read_bytes.get(tag, 0) + nbytes
 
     async def _queuing_metrics(self, req) -> None:
         from .ratekeeper import StorageQueuingMetricsReply
@@ -592,10 +604,15 @@ class StorageServer:
             if n > busiest_ops:
                 busiest_tag, busiest_ops = tag, n
         total_rate = self._read_ops_window / dt
+        top = sorted(self._tag_read_ops.items(), key=lambda kv: -kv[1])
+        tag_ops = {tag: n / dt for tag, n in top[:self._TAG_REPORT_MAX]}
+        tag_bytes = {tag: self._tag_read_bytes.get(tag, 0) / dt
+                     for tag in tag_ops}
         # Reset the sampling window each poll so rates track the current
         # storm, not all of history.
         self._read_ops_window = 0
         self._tag_read_ops = {}
+        self._tag_read_bytes = {}
         self._read_window_start = t
         req.reply.send(StorageQueuingMetricsReply(
             queue_bytes=lag * 64,            # approx bytes per version
@@ -603,7 +620,9 @@ class StorageServer:
             stored_bytes=len(self.data),
             busiest_read_tag=busiest_tag,
             busiest_read_rate=busiest_ops / dt,
-            total_read_rate=total_rate))
+            total_read_rate=total_rate,
+            tag_read_ops=tag_ops,
+            tag_read_bytes=tag_bytes))
 
     # -- watches (reference watchValueQ, trigger :2622) ----------------------
     def _trigger_watch(self, key: bytes) -> None:

@@ -157,6 +157,27 @@ def tss_quarantine_key(mirror_tag: int) -> bytes:
     return TSS_QUARANTINE_PREFIX + b"%010d" % mirror_tag
 
 
+# Tenant metadata (reference fdbclient/Tenant.h tenantMapPrefix /
+# TenantManagement): \xff/tenant/map/<name> = encoded TenantMapEntry.
+# Committed through the normal pipeline like every other piece of
+# metadata: commit proxies apply map mutations to their tenant caches
+# (commit_proxy._apply_metadata), the mutations ride TXS_TAG so recovery
+# replays them, and the metadata version key invalidates client caches.
+TENANT_MAP_PREFIX = b"\xff/tenant/map/"
+TENANT_MAP_END = b"\xff/tenant/map0"
+# Monotone id allocator floor (committed so ids never repeat; the
+# reference's tenant id counter behaves the same way).
+TENANT_LAST_ID_KEY = b"\xff/tenant/lastId"
+# Bumped by every tenant create/delete; caches (client handles, tooling)
+# key their entries by it and re-read when it moves.
+TENANT_METADATA_VERSION_KEY = b"\xff/tenant/metadataVersion"
+# Per-tenant transaction-rate quotas (reference fdbcli `quota set`):
+# \xff/tenant/quota/<name> = printed tps.  The ratekeeper polls this
+# range and enforces quotas through the tag-throttle machinery.
+TENANT_QUOTA_PREFIX = b"\xff/tenant/quota/"
+TENANT_QUOTA_END = b"\xff/tenant/quota0"
+
+
 # Cached key ranges (reference \xff/storageCache + cacheKeysPrefix,
 # fdbserver/StorageCache.actor.cpp): \xff/cacheRanges/<begin> = <end>.
 # Commit proxies route mutations inside these ranges onto CACHE_TAG; the

@@ -246,6 +246,12 @@ class TLog:
             assert self.version.get() == req.prev_version, (
                 f"tlog {self.id}: version chain broken "
                 f"{self.version.get()} != {req.prev_version}")
+            if getattr(req, "span", ""):
+                # Cross-process commit correlation: the proxy's batch
+                # span stamps the logging hop too (proxy->resolver->tlog).
+                from ..core.trace import trace_batch_event
+                trace_batch_event("CommitDebug", req.span,
+                                  f"TLog.{self.id}.commit")
             for tag, msgs in req.messages.items():
                 if not msgs:
                     continue

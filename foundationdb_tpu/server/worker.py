@@ -329,7 +329,10 @@ class Worker:
             LogSystemClient(req.tlogs,
                             replication=self._log_replication()),
             key_resolvers, key_servers, req.storage_interfaces,
-            req.recovery_version)
+            req.recovery_version,
+            tenants=dict(getattr(req, "tenants", None) or {}),
+            tenant_metadata_version=getattr(
+                req, "tenant_metadata_version", 0))
         proxy.backup_active = req.backup_active
         proxy.db_locked = getattr(req, "db_locked", None)
         proxy.region_replication = getattr(req, "region_replication", False)
@@ -357,9 +360,14 @@ class Worker:
         req.reply.send(proxy.interface)
 
     async def _init_ratekeeper(self, req) -> None:
+        from ..client.database import ClusterConnection, Database
         from .ratekeeper import Ratekeeper
+        # A db client lets the ratekeeper read committed per-tenant
+        # quotas (\xff/tenant/quota/) — configuration as data, like the
+        # DD's registry scans.
         rk = Ratekeeper(req.rk_id, req.storage_interfaces,
-                        getattr(req, "tlog_interfaces", ()) or ())
+                        getattr(req, "tlog_interfaces", ()) or (),
+                        db=Database(ClusterConnection(self.coordinators)))
         rk.run(self.process)
         req.reply.send(rk.interface)
 

@@ -1,0 +1,31 @@
+"""Multi-tenant keyspace (reference fdbclient/Tenant.h + TenantManagement).
+
+A tenant is a named, isolated slice of the user keyspace: every key a
+tenant writes is transparently prefixed with the tenant's fixed 8-byte id,
+and stripped again on the way out.  The pieces:
+
+  * map.py        — the transactional tenant map under \\xff/tenant/map/
+                    (TenantMapEntry, key conventions, mutation parsing
+                    shared by the commit proxies and recovery replay)
+  * management.py — create/delete/list/get + per-tenant quota knobs, all
+                    ordinary serializable transactions
+  * handle.py     — the client-side Tenant handle / TenantTransaction
+                    wrapper that applies and strips the prefix
+
+Isolation is enforced twice: the handle never emits a key outside its
+prefix, and the commit proxies validate every tenant-tagged commit against
+their (metadata-versioned) tenant cache — a deleted tenant's writes can
+never commit, and a mutation outside the claimed prefix is rejected with
+illegal_tenant_access.  Per-tenant admission control rides the existing
+tag-throttle machinery: tenant transactions carry the tenant's throttle
+tag, storage meters reads per tag, and the ratekeeper turns committed
+quotas (\\xff/tenant/quota/) into GRV-proxy tag throttles.
+"""
+
+from .handle import Tenant, TenantTransaction, open_tenant  # noqa: F401
+from .management import (create_tenant, delete_tenant,  # noqa: F401
+                         get_tenant, get_tenant_quotas, list_tenants,
+                         set_tenant_quota)
+from .map import (TENANT_PREFIX_LEN, TenantMapEntry,  # noqa: F401
+                  parse_tenant_mutation, tenant_map_key, tenant_prefix,
+                  tenant_quota_key, tenant_tag)

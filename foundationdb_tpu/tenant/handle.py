@@ -1,0 +1,183 @@
+"""Client-side tenant handles: prefixed transactions.
+
+Reference: fdbclient/Tenant.h Tenant + NativeAPI's tenant-aware
+Transaction — a TenantTransaction is an ordinary Transaction whose keys
+are transparently rebased into [prefix, strinc(prefix)): applied on every
+get/set/clear/range/watch/atomic op/conflict range going in, stripped from
+every key coming out.  Raw cross-prefix access is impossible through the
+handle: relative keys are validated BEFORE prefixing, and results are
+asserted to carry the prefix before stripping.
+
+The prefix is immutable per tenant id, so the handle caches its
+TenantMapEntry forever; a deleted tenant is fenced authoritatively by the
+commit proxies (tenant_not_found at commit — never retryable), at which
+point the handle is dead and the caller re-opens by name.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..core.error import err
+from ..txn.types import MutationType, Version, key_after, strinc
+from .map import TENANT_PREFIX_LEN, TenantMapEntry, tenant_tag
+
+
+async def open_tenant(db, name: bytes) -> "Tenant":
+    """Open a handle to an existing tenant (reference fdb_database_open_
+    tenant; raises tenant_not_found rather than creating implicitly)."""
+    from .management import get_tenant
+    entry = await get_tenant(db, name)
+    if entry is None:
+        raise err("tenant_not_found", f"no tenant {name!r}")
+    return Tenant(db, entry)
+
+
+class Tenant:
+    """A database handle scoped to one tenant's keyspace."""
+
+    def __init__(self, db, entry: TenantMapEntry) -> None:
+        self.db = db
+        self.entry = entry
+        self.name = entry.name
+        self.prefix = entry.prefix
+        assert len(self.prefix) == TENANT_PREFIX_LEN
+
+    def create_transaction(self) -> "TenantTransaction":
+        return TenantTransaction(self.db.create_transaction(), self)
+
+    async def run(self, fn):
+        """Retry-loop helper mirroring Transaction.run: `await fn(txn)`
+        against a TenantTransaction, committed, retried on retryables."""
+        txn = self.create_transaction()
+        while True:
+            try:
+                result = await fn(txn)
+                await txn.commit()
+                return result
+            except BaseException as e:  # noqa: BLE001
+                await txn.on_error(e)
+
+
+class TenantTransaction:
+    """One transaction attempt chain confined to a tenant's prefix.
+
+    Wraps (rather than subclasses) Transaction so every key crosses
+    exactly one audited boundary: _pack going in, _strip coming out."""
+
+    def __init__(self, inner, tenant: Tenant) -> None:
+        self._inner = inner
+        self.tenant = tenant
+        self._prefix = tenant.prefix
+        # Tenant identity rides the commit for proxy-side validation, and
+        # the tenant's throttle tag rides GRVs + storage reads so the
+        # per-tenant metering/quota machinery sees this traffic.
+        inner.tenant_id = tenant.entry.id
+        inner.tag = tenant_tag(tenant.name)
+
+    # -- key translation ----------------------------------------------------
+    def _pack(self, key: bytes) -> bytes:
+        if not isinstance(key, (bytes, bytearray, memoryview)):
+            raise err("client_invalid_operation",
+                      f"tenant key must be bytes, not {type(key).__name__}")
+        key = bytes(key)
+        if key >= b"\xff":
+            # The tenant-relative keyspace is [b"", b"\xff"), exactly like
+            # the raw user keyspace; \xff-and-above is rejected so a
+            # tenant can never address another tenant or system keys.
+            raise err("key_outside_legal_range",
+                      "tenant-relative key outside [\"\", \\xff)")
+        return self._prefix + key
+
+    def _pack_end(self, end: bytes) -> bytes:
+        """Range ends may be b"\xff" (the whole tenant): clamp to the
+        prefix's upper bound."""
+        end = bytes(end)
+        if end > b"\xff":
+            raise err("key_outside_legal_range")
+        if end == b"\xff":
+            return strinc(self._prefix)
+        return self._prefix + end
+
+    def _strip(self, key: bytes) -> bytes:
+        assert key.startswith(self._prefix), \
+            f"cross-tenant key {key!r} leaked through tenant handle"
+        return key[TENANT_PREFIX_LEN:]
+
+    # -- reads ----------------------------------------------------------------
+    async def get(self, key: bytes, snapshot: bool = False
+                  ) -> Optional[bytes]:
+        return await self._inner.get(self._pack(key), snapshot=snapshot)
+
+    async def get_range(self, begin: bytes, end: bytes, limit: int = 1000,
+                        reverse: bool = False, snapshot: bool = False
+                        ) -> List[Tuple[bytes, bytes]]:
+        rows = await self._inner.get_range(
+            self._pack(begin), self._pack_end(end), limit=limit,
+            reverse=reverse, snapshot=snapshot)
+        return [(self._strip(k), v) for k, v in rows]
+
+    async def watch(self, key: bytes):
+        return await self._inner.watch(self._pack(key))
+
+    # -- writes ---------------------------------------------------------------
+    def set(self, key: bytes, value: bytes) -> None:
+        self._inner.set(self._pack(key), value)
+
+    def clear(self, key: bytes, end: Optional[bytes] = None) -> None:
+        packed = self._pack(key)
+        self._inner.clear(packed, self._pack_end(end) if end is not None
+                          else key_after(packed))
+
+    def atomic_op(self, op: MutationType, key: bytes,
+                  operand: bytes) -> None:
+        self._inner.atomic_op(op, self._pack(key), operand)
+
+    def set_versionstamped_key(self, key_template: bytes, offset: int,
+                               value: bytes) -> None:
+        # The stamp slot shifts by the prefix the template gains.
+        self._inner.set_versionstamped_key(
+            self._pack(key_template), offset + TENANT_PREFIX_LEN, value)
+
+    def set_versionstamped_value(self, key: bytes, value_template: bytes,
+                                 offset: int = 0) -> None:
+        self._inner.set_versionstamped_value(self._pack(key),
+                                             value_template, offset)
+
+    def add_read_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._inner.add_read_conflict_range(self._pack(begin),
+                                            self._pack_end(end))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes) -> None:
+        self._inner.add_write_conflict_range(self._pack(begin),
+                                             self._pack_end(end))
+
+    # -- lifecycle ------------------------------------------------------------
+    async def commit(self) -> Version:
+        return await self._inner.commit()
+
+    async def on_error(self, e: BaseException) -> None:
+        await self._inner.on_error(e)
+
+    def reset(self) -> None:
+        self._inner.reset()
+        self._inner.tenant_id = self.tenant.entry.id
+        self._inner.tag = tenant_tag(self.tenant.name)
+
+    def get_versionstamp(self):
+        return self._inner.get_versionstamp()
+
+    def get_read_version(self):
+        return self._inner.get_read_version()
+
+    @property
+    def committed_version(self) -> Version:
+        return self._inner.committed_version
+
+    @property
+    def priority(self):
+        return self._inner.priority
+
+    @priority.setter
+    def priority(self, value) -> None:
+        self._inner.priority = value

@@ -1,0 +1,135 @@
+"""Tenant management: create/delete/list/get + quotas, as transactions.
+
+Reference: fdbclient/TenantManagement.actor.h — tenant operations are
+ordinary serializable transactions against the \\xff/tenant/ keyspace, so
+they inherit the database's own consistency and durability and need no
+private channel into the cluster (the same "configuration as data" stance
+as client/management.py).
+
+Every create/delete bumps \\xff/tenant/metadataVersion so caches key their
+entries by it; both operations are idempotent (a retry after
+commit_unknown_result converges).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..core.error import FdbError, err
+from .map import (TENANT_LAST_ID_KEY, TENANT_MAP_END, TENANT_MAP_PREFIX,
+                  TENANT_METADATA_VERSION_KEY, TENANT_QUOTA_END,
+                  TENANT_QUOTA_PREFIX, TenantMapEntry, check_tenant_name,
+                  tenant_map_key, tenant_prefix, tenant_quota_key)
+
+
+async def _retrying(db, fn):
+    t = db.create_transaction()
+    t.access_system_keys = True
+    while True:
+        try:
+            r = await fn(t)
+            await t.commit()
+            return r
+        except FdbError as e:
+            await t.on_error(e)
+
+
+async def _bump_metadata_version(t) -> int:
+    raw = await t.get(TENANT_METADATA_VERSION_KEY)
+    version = (int(raw) if raw else 0) + 1
+    t.set(TENANT_METADATA_VERSION_KEY, b"%d" % version)
+    return version
+
+
+async def tenant_metadata_version(db) -> int:
+    async def go(t):
+        raw = await t.get(TENANT_METADATA_VERSION_KEY)
+        return int(raw) if raw else 0
+    return await _retrying(db, go)
+
+
+async def create_tenant(db, name: bytes) -> TenantMapEntry:
+    """Create `name` (idempotent: an existing tenant is returned as-is —
+    the reference's createTenant ignore-existing mode, which is what a
+    retry loop needs after commit_unknown_result)."""
+    check_tenant_name(name)
+
+    async def go(t):
+        raw = await t.get(tenant_map_key(name))
+        if raw is not None:
+            return TenantMapEntry.decode(raw)
+        last_raw = await t.get(TENANT_LAST_ID_KEY)
+        tenant_id = (int(last_raw) if last_raw else 0) + 1
+        t.set(TENANT_LAST_ID_KEY, b"%d" % tenant_id)
+        entry = TenantMapEntry(id=tenant_id, name=name)
+        t.set(tenant_map_key(name), entry.encode())
+        await _bump_metadata_version(t)
+        return entry
+    return await _retrying(db, go)
+
+
+async def delete_tenant(db, name: bytes) -> None:
+    """Delete `name` (idempotent; raises tenant_not_empty while the
+    tenant's keyspace still holds data, like the reference)."""
+    check_tenant_name(name)
+
+    async def go(t):
+        raw = await t.get(tenant_map_key(name))
+        if raw is None:
+            return
+        entry = TenantMapEntry.decode(raw)
+        p = tenant_prefix(entry.id)
+        from ..txn.types import strinc
+        rows = await t.get_range(p, strinc(p), limit=1)
+        if rows:
+            raise err("tenant_not_empty",
+                      f"tenant {name!r} still holds keys")
+        t.clear(tenant_map_key(name))
+        t.clear(tenant_quota_key(name))
+        await _bump_metadata_version(t)
+    await _retrying(db, go)
+
+
+async def get_tenant(db, name: bytes) -> Optional[TenantMapEntry]:
+    check_tenant_name(name)
+
+    async def go(t):
+        raw = await t.get(tenant_map_key(name))
+        return TenantMapEntry.decode(raw) if raw is not None else None
+    return await _retrying(db, go)
+
+
+async def list_tenants(db, begin: bytes = b"", end: bytes = b"\xff",
+                       limit: int = 1000) -> List[TenantMapEntry]:
+    async def go(t):
+        rows = await t.get_range(TENANT_MAP_PREFIX + begin,
+                                 min(TENANT_MAP_PREFIX + end,
+                                     TENANT_MAP_END),
+                                 limit=limit)
+        return [TenantMapEntry.decode(v) for _k, v in rows]
+    return await _retrying(db, go)
+
+
+async def set_tenant_quota(db, name: bytes, tps: Optional[float]) -> None:
+    """Set (or with tps=None clear) a tenant's transaction-rate quota.
+    The ratekeeper polls the quota range and enforces it through the
+    tag-throttle machinery (server/ratekeeper.py); the tenant must
+    exist."""
+    check_tenant_name(name)
+
+    async def go(t):
+        if await t.get(tenant_map_key(name)) is None:
+            raise err("tenant_not_found", f"no tenant {name!r}")
+        if tps is None:
+            t.clear(tenant_quota_key(name))
+        else:
+            t.set(tenant_quota_key(name), b"%g" % float(tps))
+    await _retrying(db, go)
+
+
+async def get_tenant_quotas(db) -> Dict[bytes, float]:
+    """{tenant name: tps} for every committed quota."""
+    async def go(t):
+        rows = await t.get_range(TENANT_QUOTA_PREFIX, TENANT_QUOTA_END)
+        return {k[len(TENANT_QUOTA_PREFIX):]: float(v) for k, v in rows}
+    return await _retrying(db, go)

@@ -1,0 +1,134 @@
+"""The tenant map: entry format, key conventions, mutation parsing.
+
+Reference: fdbclient/Tenant.h (TenantMapEntry, tenantMapPrefix) — each
+tenant owns the keyspace [prefix, strinc(prefix)) where prefix is the
+tenant id packed big-endian into 8 bytes.  Fixed-width prefixes are what
+makes the conflict path cheap: the prefix fills exactly the digest's
+tenant-salt column (ops/digest.py SALT_LANES), so a tenant-relative key of
+up to 23 bytes digests exactly and tenant traffic never routes through the
+supervisor's long-key recheck.
+
+The map itself is ordinary committed data under \\xff/tenant/map/<name>;
+commit proxies interpret map mutations into their tenant caches
+(parse_tenant_mutation below, the tenant analog of ApplyMetadataMutation),
+and the mutations ride TXS_TAG so a recovery replays them onto the
+DBCoreState baseline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..core.wire import Reader, Writer
+from ..server.system_data import (TENANT_MAP_END,  # noqa: F401
+                                  TENANT_LAST_ID_KEY, TENANT_MAP_PREFIX,
+                                  TENANT_METADATA_VERSION_KEY,
+                                  TENANT_QUOTA_END, TENANT_QUOTA_PREFIX)
+from ..txn.types import Mutation, MutationType
+
+# Every tenant prefix is exactly this long — the digest salt column's width
+# (ops/digest.py SALT_BYTES); the two must agree or tenant keys would
+# straddle the salt/relative-key lane boundary.
+TENANT_PREFIX_LEN = 8
+
+
+def tenant_prefix(tenant_id: int) -> bytes:
+    """The 8-byte keyspace prefix of a tenant id (reference
+    TenantMapEntry::idToPrefix: big-endian, so prefix order == id order)."""
+    return tenant_id.to_bytes(TENANT_PREFIX_LEN, "big")
+
+
+def prefix_to_id(prefix: bytes) -> int:
+    return int.from_bytes(prefix, "big")
+
+
+@dataclass(frozen=True)
+class TenantMapEntry:
+    """One tenant: immutable id (hence immutable prefix) + name."""
+
+    id: int
+    name: bytes
+
+    @property
+    def prefix(self) -> bytes:
+        return tenant_prefix(self.id)
+
+    def encode(self) -> bytes:
+        return Writer().i64(self.id).bytes_(self.name).done()
+
+    @classmethod
+    def decode(cls, blob: bytes) -> "TenantMapEntry":
+        r = Reader(blob)
+        return cls(id=r.i64(), name=r.bytes_())
+
+
+def check_tenant_name(name: bytes) -> None:
+    """Validity rules (reference TenantAPI::checkTenantMode + name
+    checks): non-empty, no \\xff prefix (reserved), no NUL (it would be
+    ambiguous against the map key encoding), bounded length."""
+    from ..core.error import err
+    if not isinstance(name, bytes) or not name:
+        raise err("tenant_name_required", "tenant name must be non-empty")
+    if name.startswith(b"\xff") or b"\x00" in name or len(name) > 128:
+        raise err("invalid_tenant_name", f"bad tenant name {name!r}")
+
+
+def tenant_map_key(name: bytes) -> bytes:
+    return TENANT_MAP_PREFIX + name
+
+
+def tenant_quota_key(name: bytes) -> bytes:
+    return TENANT_QUOTA_PREFIX + name
+
+
+def tenant_tag(name: bytes) -> str:
+    """The throttle tag tenant transactions carry (GRV + storage reads):
+    per-tenant metering and quotas ride the existing tag machinery."""
+    return "t/" + name.decode("utf-8", "backslashreplace")
+
+
+def parse_tenant_mutation(
+        m: Mutation) -> Optional[List[Tuple[bytes,
+                                            Optional[TenantMapEntry]]]]:
+    """[(name, entry)] for a tenant-map SetValue, [(name, None), ...] for
+    names a ClearRange retires, else None.  For broad clears the caller
+    supplies its cache's name list via the returned wildcard: a clear that
+    cannot be enumerated yields [(b"*", None)] and the applier drops every
+    cached name inside the clear's bounds (it knows them; we don't)."""
+    if m.type == MutationType.SetValue and \
+            m.param1.startswith(TENANT_MAP_PREFIX):
+        name = m.param1[len(TENANT_MAP_PREFIX):]
+        return [(name, TenantMapEntry.decode(m.param2))]
+    if m.type == MutationType.ClearRange and \
+            m.param2 > TENANT_MAP_PREFIX and m.param1 < TENANT_MAP_END:
+        lo = max(m.param1, TENANT_MAP_PREFIX)
+        hi = min(m.param2, TENANT_MAP_END)
+        if hi == lo + b"\x00" and lo.startswith(TENANT_MAP_PREFIX):
+            # Point clear (Transaction.clear emits [key, key+\x00)).
+            return [(lo[len(TENANT_MAP_PREFIX):], None)]
+        return [(b"*", None)]
+    return None
+
+
+def apply_tenant_mutation(tenants: dict, m: Mutation) -> bool:
+    """Fold one committed mutation into a {id: name} tenant cache (the
+    shared core used by commit proxies and the master's recovery replay).
+    Returns True iff the mutation touched the tenant map."""
+    parsed = parse_tenant_mutation(m)
+    if parsed is None:
+        return False
+    for name, entry in parsed:
+        if entry is not None:
+            tenants[entry.id] = name
+        elif name == b"*":
+            lo = max(m.param1, TENANT_MAP_PREFIX)
+            hi = min(m.param2, TENANT_MAP_END)
+            for tid, tname in list(tenants.items()):
+                if lo <= tenant_map_key(tname) < hi:
+                    del tenants[tid]
+        else:
+            for tid, tname in list(tenants.items()):
+                if tname == name:
+                    del tenants[tid]
+    return True

@@ -46,6 +46,13 @@ Commands (reference fdbcli command set):
   exclude TAG [TAG...]       drain + exclude storage servers by tag
   include [TAG...]           re-admit excluded servers (no args: all)
   excluded                   list excluded tags
+  tenant create NAME         create a tenant (idempotent)
+  tenant delete NAME         delete an (empty) tenant
+  tenant list [BEGIN [END]]  list tenants by name range
+  tenant get NAME            one tenant's id/prefix
+  quota set NAME TPS         per-tenant transaction-rate quota
+  quota clear NAME           remove a tenant's quota
+  quota get [NAME]           committed quotas (all, or one tenant's)
   watch KEY                  block until KEY changes once
   help                       this text
   exit / quit
@@ -220,6 +227,63 @@ class Cli:
         self.run_async(change_coordinators(self.db, new_spec))
         return (f"Coordinators changing to {new_spec} (the master moves "
                 "the quorum and recovers; clients follow the forward)")
+
+    def cmd_tenant(self, action: str, *args: str) -> str:
+        """tenant create/delete/list/get (reference fdbcli tenant
+        command family, TenantManagement)."""
+        from ..tenant import management as tm
+        if action == "create" and len(args) == 1:
+            entry = self.run_async(
+                tm.create_tenant(self.db, _unescape(args[0])))
+            return (f"The tenant `{args[0]}' has been created "
+                    f"(id {entry.id}, prefix {_printable(entry.prefix)})")
+        if action == "delete" and len(args) == 1:
+            self.run_async(tm.delete_tenant(self.db, _unescape(args[0])))
+            return f"The tenant `{args[0]}' has been deleted"
+        if action == "list" and len(args) <= 2:
+            begin = _unescape(args[0]) if args else b""
+            end = _unescape(args[1]) if len(args) > 1 else b"\xff"
+            entries = self.run_async(
+                tm.list_tenants(self.db, begin, end))
+            if not entries:
+                return "The cluster has no tenants in that range"
+            return "\n".join(
+                f"{i + 1}. {_printable(e.name)}"
+                for i, e in enumerate(entries))
+        if action == "get" and len(args) == 1:
+            entry = self.run_async(
+                tm.get_tenant(self.db, _unescape(args[0])))
+            if entry is None:
+                return f"ERROR: tenant `{args[0]}' not found"
+            return (f"id: {entry.id}\n"
+                    f"prefix: {_printable(entry.prefix)}")
+        return ("usage: tenant create NAME | tenant delete NAME | "
+                "tenant list [BEGIN [END]] | tenant get NAME")
+
+    def cmd_quota(self, action: str, *args: str) -> str:
+        """quota set/clear/get — per-tenant tps quotas enforced by the
+        ratekeeper through tag throttles."""
+        from ..tenant import management as tm
+        if action == "set" and len(args) == 2:
+            self.run_async(tm.set_tenant_quota(
+                self.db, _unescape(args[0]), float(args[1])))
+            return f"Quota for `{args[0]}' set to {args[1]} tps"
+        if action == "clear" and len(args) == 1:
+            self.run_async(tm.set_tenant_quota(
+                self.db, _unescape(args[0]), None))
+            return f"Quota for `{args[0]}' cleared"
+        if action == "get" and len(args) <= 1:
+            quotas = self.run_async(tm.get_tenant_quotas(self.db))
+            if args:
+                tps = quotas.get(_unescape(args[0]))
+                return (f"`{args[0]}': {tps:g} tps" if tps is not None
+                        else f"`{args[0]}': no quota")
+            if not quotas:
+                return "No tenant quotas set"
+            return "\n".join(f"{_printable(n)} = {tps:g} tps"
+                             for n, tps in sorted(quotas.items()))
+        return ("usage: quota set NAME TPS | quota clear NAME | "
+                "quota get [NAME]")
 
     def cmd_watch(self, key: str) -> str:
         async def go():

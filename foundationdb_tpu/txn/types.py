@@ -152,6 +152,11 @@ class CommitTransactionRef:
     # LOCK_AWARE transaction option (reference FDBTransactionOptions):
     # commits pass the \xff/dbLocked fence — management/DR traffic only.
     lock_aware: bool = False
+    # Tenant identity (reference TenantInfo riding the commit): -1 = raw.
+    # Commit proxies validate tenant-tagged transactions against their
+    # tenant cache post-resolution — a deleted tenant can never commit —
+    # and reject mutations outside the tenant's 8-byte prefix.
+    tenant_id: int = -1
 
     def expected_size(self) -> int:
         s = sum(len(r.begin) + len(r.end) for r in

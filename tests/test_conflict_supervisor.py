@@ -4,7 +4,7 @@ Three contracts under test:
 
 1. **Parity** — the supervised TPU backend (healthy, degraded, or moving
    between the two) produces commit/abort decisions bit-identical to an
-   all-oracle run, INCLUDING keys longer than the 23-byte digest prefix
+   all-oracle run, INCLUDING keys longer than the digest prefix
    (the exact long-key recheck; SURVEY §7 hard part 1, replacing the old
    "conservative-only" guarantee).
 2. **Robustness** — a BUGGIFY-killed / timing-out / transiently-erroring
@@ -22,6 +22,7 @@ from foundationdb_tpu.conflict.oracle import OracleConflictSet
 from foundationdb_tpu.conflict.supervisor import (BackendHealthMonitor,
                                                   SupervisedConflictSet,
                                                   host_digest)
+from foundationdb_tpu.ops.digest import PREFIX_BYTES
 from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
 from foundationdb_tpu.core import DeterministicRandom
 from foundationdb_tpu.core.knobs import server_knobs
@@ -76,17 +77,18 @@ def test_supervised_matches_oracle_random(seed):
 
 
 def random_long_key(rng) -> bytes:
-    """Keys 24-1000 bytes, biased toward shared 23-byte prefixes so digest
-    collisions actually occur (the case the recheck exists for)."""
+    """Keys past the digest prefix (PREFIX_BYTES..~1000 bytes), biased
+    toward shared truncated prefixes so digest collisions actually occur
+    (the case the recheck exists for)."""
     prefix = b"p%02d" % rng.random_int(0, 2)
-    prefix = prefix + b"x" * (23 - len(prefix))     # 23 shared bytes
+    prefix = prefix + b"x" * (PREFIX_BYTES - len(prefix))
     tail_len = rng.random_int(1, 977)
     tail = bytes(rng.random_int(97, 122) for _ in range(min(tail_len, 8)))
     return prefix + tail * ((tail_len // len(tail)) + 1)
 
 
 def random_long_txn(rng, now, window):
-    """Mixed batch material: long (24-1000B) keys, short keys, and ranges
+    """Mixed batch material: truncated long keys, short keys, and ranges
     whose endpoints straddle the truncation boundary."""
     snap = now - rng.random_int(0, window)
     tr = CommitTransactionRef(read_snapshot=max(snap, 0))
@@ -113,7 +115,8 @@ def random_long_txn(rng, now, window):
 
 @pytest.mark.parametrize("seed", [81, 82, 83])
 def test_long_key_parity_bit_identical(seed):
-    """Keys 24-1000 bytes: decisions BIT-IDENTICAL to the oracle — not
+    """Keys past the digest prefix: decisions BIT-IDENTICAL to the
+    oracle — not
     merely conservative (ISSUE acceptance criterion; replaces
     test_conflict_tpu.test_long_keys_conservative's weaker assertion)."""
     rng = DeterministicRandom(seed)
@@ -137,11 +140,12 @@ def test_long_key_parity_bit_identical(seed):
 
 
 def test_digest_collision_commits_exactly():
-    """The canonical collision: two 30-byte keys sharing a 23-byte prefix.
-    The old conservative backend was allowed to abort the non-conflicting
-    reader; the supervised backend must COMMIT it, like the oracle."""
-    long_a = b"x" * 30
-    long_b = b"x" * 23 + b"zzz"
+    """The canonical collision: two truncated keys sharing the full
+    digest prefix.  The old conservative backend was allowed to abort the
+    non-conflicting reader; the supervised backend must COMMIT it, like
+    the oracle."""
+    long_a = b"x" * (PREFIX_BYTES + 7)
+    long_b = b"x" * PREFIX_BYTES + b"zzz"
     assert host_digest(long_a) == host_digest(long_b)   # really collides
     sup = make_supervised()
     oracle = OracleConflictSet(0)
@@ -165,14 +169,14 @@ def test_taint_flags_short_key_reader_near_widened_insert():
     exactly) even though the reader itself has no long keys."""
     sup = make_supervised()
     oracle = OracleConflictSet(0)
-    long_w = b"x" * 23 + b"\x00\x01" + b"tail"      # truncated write key
+    long_w = b"x" * PREFIX_BYTES + b"\x00\x01" + b"tail"  # truncated
     w = CommitTransactionRef(
         write_conflict_ranges=[KeyRange(long_w, long_w + b"\x00")])
     assert sup.resolve([w], 100) == oracle.resolve([w], 100)
     assert sup.stats["taint_size"] > 0
-    # 23-byte read key: untruncated digest, but digest-adjacent to the
-    # widened region.  Exact answer: COMMITTED (the keys differ).
-    short_r = b"x" * 23
+    # PREFIX_BYTES-long read key: untruncated digest, but digest-
+    # adjacent to the widened region.  Exact: COMMITTED (keys differ).
+    short_r = b"x" * PREFIX_BYTES
     r = CommitTransactionRef(
         read_snapshot=50,
         read_conflict_ranges=[KeyRange(short_r, short_r + b"\x00")])
@@ -359,14 +363,14 @@ def test_slo_trip_does_not_skip_recheck_of_tripping_batch():
     monitor = BackendHealthMonitor(latency_slo_s=1e-9, slo_strikes=2,
                                    reprobe_interval_s=1e9)
     sup = make_supervised(monitor=monitor)
-    long_w = b"x" * 23 + b"\x00\x01" + b"tail"
+    long_w = b"x" * PREFIX_BYTES + b"\x00\x01" + b"tail"
     w = CommitTransactionRef(
         write_conflict_ranges=[KeyRange(long_w, long_w + b"\x00")])
     assert sup.resolve([w], 100) == [CommitResult.COMMITTED]   # strike 1
     assert sup.stats["taint_size"] > 0 and not sup.degraded
     # Strike 2 trips the monitor; this same batch's short-key read
     # digest-lands inside the widened region — exact answer: COMMITTED.
-    short_r = b"x" * 23
+    short_r = b"x" * PREFIX_BYTES
     r = CommitTransactionRef(
         read_snapshot=50,
         read_conflict_ranges=[KeyRange(short_r, short_r + b"\x00")])

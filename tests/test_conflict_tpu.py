@@ -1,7 +1,7 @@
 """TPU conflict backend parity vs the CPU oracle (on virtual CPU devices).
 
 The contract (BASELINE.json): identical commit/abort decisions vs the
-SkipList-semantics baseline.  Short keys (<= 23 bytes) must match
+SkipList-semantics baseline.  Short keys (<= PREFIX_BYTES) must match
 bit-for-bit; longer keys may only add conflicts (conservative), never miss."""
 
 import numpy as np
@@ -106,13 +106,14 @@ def test_tpu_gc_and_rebase(small_caps):
 
 
 def test_long_keys_conservative(small_caps):
-    """Keys > 23 bytes on the BARE device backend: no missed conflicts;
-    extra conflicts allowed.  This is the raw-kernel contract only — the
-    production path (SupervisedConflictSet, the default for backend
-    "tpu") upgrades it to BIT-IDENTICAL decisions via the host exact
-    recheck; see tests/test_conflict_supervisor.py."""
-    long_a = b"x" * 30
-    long_b = b"x" * 23 + b"zzz"        # same 23-byte prefix, digest-collides
+    """Keys past the digest prefix on the BARE device backend: no missed
+    conflicts; extra conflicts allowed.  This is the raw-kernel contract
+    only — the production path (SupervisedConflictSet, the default for
+    backend "tpu") upgrades it to BIT-IDENTICAL decisions via the host
+    exact recheck; see tests/test_conflict_supervisor.py."""
+    from foundationdb_tpu.ops.digest import PREFIX_BYTES
+    long_a = b"x" * (PREFIX_BYTES + 7)
+    long_b = b"x" * PREFIX_BYTES + b"zzz"   # shared prefix, digest-collides
     tpu = TpuConflictSet(0, **small_caps)
     w = CommitTransactionRef(
         write_conflict_ranges=[KeyRange(long_a, long_a + b"\x00")])

@@ -33,6 +33,87 @@ def test_histogram_percentiles():
     assert c.counter("ops").rate_and_roll(2.0) == 0.0
 
 
+def test_commit_span_correlates_proxy_resolver_tlog(teardown):  # noqa: F811,E501
+    """ISSUE 2 satellite: the commit proxy mints one span per batch and
+    stamps it onto the resolution and TLog-commit hops, so CommitDebug
+    trace events form a cross-process timeline keyed by the span — plus
+    a client debug id correlates to the batch span."""
+    from foundationdb_tpu.core.trace import get_tracer
+    c = SimFdbCluster(config=DatabaseConfiguration(n_resolvers=2),
+                      n_workers=5, n_storage_workers=2)
+    db = c.database()
+
+    async def go():
+        t = db.create_transaction()
+        t.debug_id = "dbg-42"
+        from foundationdb_tpu.core import FdbError
+        while True:
+            try:
+                t.set(b"span-key", b"v")
+                await t.commit()
+                break
+            except FdbError as e:
+                await t.on_error(e)
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=120)
+    events = get_tracer().find("CommitDebug")
+    assert events, "no CommitDebug events traced"
+    # The client's debug id was correlated to SOME batch span at the
+    # proxy...
+    linked = [e for e in events if e.get("DebugID") == "dbg-42"]
+    assert linked, events[-5:]
+    span = linked[-1]["Location"].split(":", 1)[1]
+    # ...and that same span shows up at batch start, every resolver it
+    # fanned out to, and the TLog append — the full commit pipeline.
+    locs = {e["Location"] for e in events if e.get("DebugID") == span}
+    assert any(loc == "CommitProxy.batchStart" for loc in locs), locs
+    assert any(loc.startswith("Resolver.") for loc in locs), locs
+    assert any(loc.startswith("TLog.") for loc in locs), locs
+
+
+def test_tcp_envelope_carries_span():
+    """The serde envelope + TCP frame carry a span context end-to-end,
+    and the server installs it as the ambient span while the handler
+    runs (stamped onto TraceEvents)."""
+    from foundationdb_tpu.core.trace import (TraceEvent, get_current_span,
+                                             get_tracer, set_current_span)
+    from foundationdb_tpu.rpc.serde import decode_envelope, encode_envelope
+    from foundationdb_tpu.rpc.transport import TcpTransport
+
+    blob = encode_envelope({"op": "ping"}, span="span-abc")
+    value, span = decode_envelope(blob)
+    assert value == {"op": "ping"} and span == "span-abc"
+    # Ambient span is attached when none is given explicitly.
+    prev = set_current_span("ambient-1")
+    try:
+        _v, s2 = decode_envelope(encode_envelope(b"x"))
+        assert s2 == "ambient-1"
+    finally:
+        set_current_span(prev)
+
+    server = TcpTransport()
+    client = TcpTransport()
+    seen = {}
+
+    def handler(payload: bytes) -> bytes:
+        seen["span"] = get_current_span()
+        TraceEvent("TcpSpanProbe").detail("Payload", len(payload)).log()
+        return b"pong"
+
+    server.register(0x77, handler)
+    try:
+        reply = client.request(server.address, 0x77, b"ping",
+                               timeout=10.0, span="wire-span-9")
+        assert reply == b"pong"
+        assert seen["span"] == "wire-span-9"
+        probes = get_tracer().find("TcpSpanProbe")
+        assert probes and probes[-1]["SpanContext"] == "wire-span-9"
+    finally:
+        client.close()
+        server.close()
+
+
 def test_status_includes_role_latencies(teardown):  # noqa: F811
     c = SimFdbCluster(config=DatabaseConfiguration(),
                       n_workers=5, n_storage_workers=2)

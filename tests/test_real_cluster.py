@@ -42,6 +42,9 @@ def _spawn(base, name, suffix=""):
         stderr=subprocess.STDOUT)
 
 
+BOOT_TIMEOUT = 180.0   # wall-clock bound on boot + first recovery
+
+
 @pytest.fixture
 def real_cluster(tmp_path):
     base = str(tmp_path)
@@ -54,6 +57,33 @@ def real_cluster(tmp_path):
     dead = {n: p.poll() for n, p in procs.items() if p.poll() is not None}
     assert not dead, f"processes died at boot: {dead}"
     loop, db = open_cluster(COORDS)
+
+    # Wait for ACTUAL availability (a committed probe) before handing the
+    # cluster to a test: boot time is 4 subprocess interpreters importing
+    # jax plus an election and a recovery, all in real time — on a loaded
+    # machine that alone can eat a phase's entire wall-clock budget, so
+    # phase timeouts must start AFTER availability (tier-1 deflake:
+    # timing assumption, not a retry).  Process death during the wait
+    # fails fast with the culprit instead of timing out blind.
+    async def ready_probe():
+        from foundationdb_tpu.core.scheduler import delay
+        t = db.create_transaction()
+        while True:
+            crashed = {n: p.poll() for n, p in procs.items()
+                       if p.poll() is not None}
+            assert not crashed, f"processes died during boot: {crashed}"
+            try:
+                t.set(b"\x01boot-probe", b"up")
+                await t.commit()
+                return True
+            except Exception as e:  # noqa: BLE001
+                try:
+                    await t.on_error(e)
+                except Exception:   # noqa: BLE001 — non-retryable: fresh
+                    t = db.create_transaction()
+                    await delay(0.5)
+
+    assert loop.run_until(loop.spawn(ready_probe()), timeout=BOOT_TIMEOUT)
     try:
         yield base, procs, loop, db
     finally:
@@ -89,8 +119,11 @@ def test_real_cluster_cycle_and_kill_recovery(real_cluster):
     from foundationdb_tpu.testing.workloads import CycleWorkload
 
     async def cycle_phase():
+        # minSwaps=1: progress is asserted below, so the workload must
+        # guarantee at least one committed swap even when a loaded
+        # machine stretches every commit past the wall-clock window.
         w = CycleWorkload(None, db, {"testDuration": 2.0, "actorCount": 2,
-                                     "nodeCount": 12})
+                                     "nodeCount": 12, "minSwaps": 1})
         await w.setup()
         await w.start()
         assert await w.check(), "cycle invariant violated"

@@ -11,7 +11,7 @@ jax = pytest.importorskip("jax")
 
 from foundationdb_tpu.conflict.window import (make_window_state, window_insert,
                                               window_query)
-from foundationdb_tpu.ops.digest import encode_keys
+from foundationdb_tpu.ops.digest import KEY_LANES, encode_keys
 from foundationdb_tpu.parallel import ShardedWindow, make_conflict_mesh
 
 
@@ -78,7 +78,8 @@ def test_sharded_gc_preserves_decisions():
     R = 32
     for v in (100, 200, 300):
         wb, we = _rand_ranges(rng, W)
-        sw.resolve_step(np.zeros((6, R), np.uint32), np.zeros((6, R), np.uint32),
+        sw.resolve_step(np.zeros((KEY_LANES, R), np.uint32),
+                        np.zeros((KEY_LANES, R), np.uint32),
                         np.zeros((R,), np.int32), np.zeros((R,), bool),
                         encode_keys(wb), encode_keys(we, round_up=True),
                         np.ones((W,), bool), v)
@@ -86,7 +87,7 @@ def test_sharded_gc_preserves_decisions():
     qb, qe = encode_keys(rb), encode_keys(re, round_up=True)
     snap = np.full((R,), 150, dtype=np.int32)
     valid = np.ones((R,), bool)
-    noW = np.zeros((6, W), np.uint32)
+    noW = np.zeros((KEY_LANES, W), np.uint32)
     noV = np.zeros((W,), bool)
     before, _ = sw.resolve_step(qb, qe, snap, valid, noW, noW, noV, 400)
     sw.gc(oldest_rel=150)  # floor below every live decision boundary we query

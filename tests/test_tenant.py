@@ -1,0 +1,340 @@
+"""Multi-tenant keyspace (ISSUE 2 tentpole): tenant map CRUD, prefixed
+Tenant handles, cross-tenant isolation under BUGGIFY chaos, the commit
+proxies' tenant fence, recovery persistence, and the special-keyspace
+tenant listing.
+
+Reference shape: fdbclient/Tenant.h + TenantManagement + the tenant
+validation in CommitProxyServer."""
+
+import pytest
+
+from foundationdb_tpu.core import FdbError
+
+from test_recovery import make_cluster, teardown  # noqa: F401
+
+
+def run(c, coro, timeout=300):
+    return c.run_until(c.loop.spawn(coro), timeout=timeout)
+
+
+async def tenant_txn(tenant, fn):
+    t = tenant.create_transaction()
+    while True:
+        try:
+            r = await fn(t)
+            await t.commit()
+            return r
+        except FdbError as e:
+            await t.on_error(e)
+
+
+def test_tenant_map_crud_and_metadata_version(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.tenant import management as tm
+        mv0 = await tm.tenant_metadata_version(db)
+        a = await tm.create_tenant(db, b"acme")
+        assert a.id >= 1 and len(a.prefix) == 8
+        # Idempotent create returns the SAME entry.
+        assert await tm.create_tenant(db, b"acme") == a
+        b = await tm.create_tenant(db, b"bcorp")
+        assert b.id != a.id and b.prefix != a.prefix
+        names = [e.name for e in await tm.list_tenants(db)]
+        assert names == [b"acme", b"bcorp"]
+        assert (await tm.get_tenant(db, b"acme")) == a
+        assert (await tm.get_tenant(db, b"nope")) is None
+        mv1 = await tm.tenant_metadata_version(db)
+        assert mv1 >= mv0 + 2          # one bump per create
+        await tm.delete_tenant(db, b"bcorp")
+        assert (await tm.get_tenant(db, b"bcorp")) is None
+        assert await tm.tenant_metadata_version(db) > mv1
+        # Delete is idempotent.
+        await tm.delete_tenant(db, b"bcorp")
+        # Recreation allocates a FRESH id (prefixes never recycle).
+        b2 = await tm.create_tenant(db, b"bcorp")
+        assert b2.id > b.id
+        # Name validation.
+        for bad in (b"", b"\xffx", b"a\x00b", b"x" * 200):
+            with pytest.raises(FdbError):
+                await tm.create_tenant(db, bad)
+        return True
+
+    assert run(c, go())
+
+
+def test_tenant_handle_isolation_and_rejection(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.tenant import management as tm
+        await tm.create_tenant(db, b"t1")
+        await tm.create_tenant(db, b"t2")
+        t1 = await db.open_tenant(b"t1")
+        t2 = await db.open_tenant(b"t2")
+
+        async def put(t, v):
+            async def fn(txn):
+                txn.set(b"shared", v)
+                txn.set(b"mine/" + v, v)
+            await tenant_txn(t, fn)
+        await put(t1, b"one")
+        await put(t2, b"two")
+
+        async def read(t, k):
+            async def fn(txn):
+                return await txn.get(k)
+            return await tenant_txn(t, fn)
+        # Identical relative key, different values per tenant.
+        assert await read(t1, b"shared") == b"one"
+        assert await read(t2, b"shared") == b"two"
+        # Range reads stay inside the prefix and strip it.
+        async def scan(t):
+            async def fn(txn):
+                return await txn.get_range(b"", b"\xff", limit=100)
+            return await tenant_txn(t, fn)
+        rows1 = await scan(t1)
+        assert [k for k, _v in rows1] == [b"mine/one", b"shared"]
+        # Raw view: the data lives under each tenant's committed prefix.
+        raw = db.create_transaction()
+        while True:
+            try:
+                got = await raw.get(t2.prefix + b"shared")
+                break
+            except FdbError as e:
+                await raw.on_error(e)
+        assert got == b"two"
+        # The handle cannot address outside its prefix.
+        txn = t1.create_transaction()
+        with pytest.raises(FdbError):
+            txn.set(b"\xff/system", b"x")
+        with pytest.raises(FdbError):
+            await txn.get(b"\xff\xff/status/json")
+        return True
+
+    assert run(c, go())
+
+
+def test_two_tenants_same_keys_never_conflict_under_chaos(teardown):  # noqa: F811,E501
+    """ISSUE acceptance: two tenants writing IDENTICAL tenant-relative
+    keys never conflict with each other and can never read each other's
+    data through Tenant handles, under BUGGIFY chaos."""
+    from foundationdb_tpu.core import enable_buggify
+    c = make_cluster(n_workers=6)
+    db = c.database()
+    enable_buggify(True)
+    try:
+        async def go():
+            from foundationdb_tpu.core.futures import wait_all
+            from foundationdb_tpu.core.scheduler import spawn
+            from foundationdb_tpu.tenant import management as tm
+            await tm.create_tenant(db, b"ca")
+            await tm.create_tenant(db, b"cb")
+            ta = await db.open_tenant(b"ca")
+            tb = await db.open_tenant(b"cb")
+            conflicts = [0]
+
+            async def writer(tenant, tag, rounds=25):
+                for i in range(rounds):
+                    txn = tenant.create_transaction()
+                    while True:
+                        try:
+                            # Same relative keys from both tenants, with
+                            # reads so cross-tenant conflicts WOULD fire
+                            # if prefixes ever collided.
+                            await txn.get(b"hot")
+                            txn.set(b"hot", tag + b"%04d" % i)
+                            txn.set(b"k%02d" % (i % 7), tag)
+                            await txn.commit()
+                            break
+                        except FdbError as e:
+                            if e.name == "not_committed":
+                                conflicts[0] += 1
+                            await txn.on_error(e)
+
+            await wait_all([spawn(writer(ta, b"A")),
+                            spawn(writer(tb, b"B"))])
+            # The two tenants ran interleaved on the same relative keys:
+            # NO conflict can have fired (their prefixed keys are
+            # disjoint, and nothing else writes in this test).
+            assert conflicts[0] == 0, \
+                f"{conflicts[0]} cross-tenant conflicts"
+
+            async def read(t, k):
+                async def fn(txn):
+                    return await txn.get(k)
+                return await tenant_txn(t, fn)
+            va, vb = await read(ta, b"hot"), await read(tb, b"hot")
+            assert va is not None and va.startswith(b"A")
+            assert vb is not None and vb.startswith(b"B")
+            return True
+
+        assert run(c, go(), timeout=600)
+    finally:
+        enable_buggify(False)
+
+
+def test_deleted_tenant_writes_fenced_by_proxy(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.tenant import management as tm
+        await tm.create_tenant(db, b"victim")
+        t = await db.open_tenant(b"victim")
+
+        async def put(txn):
+            txn.set(b"a", b"1")
+        await tenant_txn(t, put)
+        # Delete requires an empty keyspace.
+        with pytest.raises(FdbError) as ei:
+            await tm.delete_tenant(db, b"victim")
+        assert ei.value.name == "tenant_not_empty"
+
+        async def wipe(txn):
+            txn.clear(b"", b"\xff")
+        await tenant_txn(t, wipe)
+        await tm.delete_tenant(db, b"victim")
+        # A stale handle's write is rejected by the commit proxy with a
+        # SPECIFIC non-retryable error — never not_committed (which
+        # would loop), never a silent commit.
+        txn = t.create_transaction()
+        txn.set(b"zombie", b"x")
+        with pytest.raises(FdbError) as ei:
+            await txn.commit()
+        assert ei.value.name == "tenant_not_found"
+        # And a forged tenant id pointing at someone ELSE's prefix is
+        # rejected as illegal access.
+        await tm.create_tenant(db, b"honest")
+        honest = await db.open_tenant(b"honest")
+        forged = db.create_transaction()
+        forged.tenant_id = honest.entry.id
+        forged.set(b"outside-prefix", b"x")   # raw key, not prefixed
+        with pytest.raises(FdbError) as ei:
+            await forged.commit()
+        assert ei.value.name == "illegal_tenant_access"
+        return True
+
+    assert run(c, go())
+
+
+def test_same_batch_delete_fences_tenant_write(teardown):  # noqa: F811
+    """Review regression: a tenant delete and a tenant write landing in
+    the SAME commit batch must not both commit — the later-in-batch
+    write validates against the batch-local tenant-map overlay."""
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.core.futures import swallow, wait_all
+        from foundationdb_tpu.core.scheduler import spawn
+        from foundationdb_tpu.tenant import management as tm
+        from foundationdb_tpu.tenant.map import tenant_map_key
+        entry = await tm.create_tenant(db, b"race")
+        t = await db.open_tenant(b"race")
+        # Build both commits by hand and fire them CONCURRENTLY so the
+        # proxy batches them together (sequential awaits would land in
+        # separate batches and prove nothing).
+        from foundationdb_tpu.txn.types import strinc
+        del_txn = db.create_transaction()
+        del_txn.access_system_keys = True
+        # Same shape as management.delete_tenant: the emptiness check is
+        # a read conflict range over the tenant's whole prefix, so a
+        # racing write either aborts this delete (write earlier) or is
+        # fenced by the batch-local overlay (write later).
+        del_txn.add_read_conflict_range(entry.prefix, strinc(entry.prefix))
+        del_txn.clear(tenant_map_key(b"race"))
+        wr_txn = t.create_transaction()
+        wr_txn.set(b"zombie", b"x")
+        f_del = spawn(del_txn.commit())
+        f_wr = spawn(wr_txn._inner.commit())
+        await wait_all([swallow(f_del), swallow(f_wr)])
+        # Whatever the interleaving, the invariant holds: data exists
+        # under the prefix ONLY IF the tenant still exists.
+        raw = db.create_transaction()
+        while True:
+            try:
+                data = await raw.get(entry.prefix + b"zombie")
+                break
+            except FdbError as e:
+                await raw.on_error(e)
+        still_there = (await tm.get_tenant(db, b"race")) is not None
+        assert still_there or data is None, (
+            "write committed under a deleted tenant's prefix")
+        return True
+
+    assert run(c, go())
+
+
+def test_tenants_survive_recovery(teardown):  # noqa: F811
+    """The tenant fence must hold across an epoch change: the new
+    proxies' caches are seeded from the master's replayed metadata."""
+    c = make_cluster(n_workers=6)
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.core.scheduler import delay
+        from foundationdb_tpu.tenant import management as tm
+        await tm.create_tenant(db, b"durable")
+        t = await db.open_tenant(b"durable")
+
+        async def put(txn):
+            txn.set(b"k", b"before")
+        await tenant_txn(t, put)
+        # Force a recovery: kill the master's process.
+        cc = c.current_cc()
+        proc = c.process_of(cc.db_info.master)
+        c.sim.kill_process(proc)
+        await delay(1.0)
+
+        async def put2(txn):
+            txn.set(b"k", b"after")
+        await tenant_txn(t, put2)      # validated by the NEW epoch's fence
+
+        async def read(txn):
+            return await txn.get(b"k")
+        assert await tenant_txn(t, read) == b"after"
+        # The map survived too.
+        assert (await tm.get_tenant(db, b"durable")) is not None
+        return True
+
+    assert run(c, go(), timeout=600)
+
+
+def test_special_keyspace_tenant_map(teardown):  # noqa: F811
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        import json
+        from foundationdb_tpu.tenant import management as tm
+        e1 = await tm.create_tenant(db, b"ska")
+        e2 = await tm.create_tenant(db, b"skb")
+        t = db.create_transaction()
+        p = b"\xff\xff/management/tenant/map/"
+        rows = await t.get_range(p, p + b"\xff", limit=10)
+        assert [k for k, _v in rows] == [p + b"ska", p + b"skb"]
+        doc = json.loads(rows[0][1])
+        assert doc["id"] == e1.id
+        assert bytes.fromhex(doc["prefix"]) == e1.prefix
+        # Point get of one entry.
+        t2 = db.create_transaction()
+        one = await t2.get(p + b"skb")
+        assert json.loads(one)["id"] == e2.id
+        assert await t2.get(p + b"nope") is None
+        # Review regression: odd names on the READ-ONLY mirror are
+        # absent, never name-validation errors (GET agrees with
+        # GETRANGE on the same keyspace).
+        assert await t2.get(p) is None                    # empty name
+        assert await t2.get(p + b"a\x00b") is None        # NUL name
+        # Review regression: reverse + limit selects the LAST entries
+        # (limit applied in iteration direction, not before reversal).
+        await tm.create_tenant(db, b"skc")
+        t3 = db.create_transaction()
+        tail = await t3.get_range(p, p + b"\xff", limit=2, reverse=True)
+        assert [k for k, _v in tail] == [p + b"skc", p + b"skb"]
+        return True
+
+    assert run(c, go())

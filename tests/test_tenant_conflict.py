@@ -1,0 +1,128 @@
+"""Tenant-salted digest fast path (ISSUE 2 acceptance): randomized
+tenant-prefixed workloads produce BIT-IDENTICAL commit/abort verdicts
+between the supervised TPU backend and the CPU oracle, and tenant-
+relative short keys never route through the supervisor's long-key exact
+recheck (taint/recheck counters stay zero).
+
+Why this holds: an 8-byte tenant prefix fills exactly the digest's
+tenant-salt column (ops/digest.py SALT_LANES), leaving the full 23-byte
+relative span for the tenant's own key — so prefixed keys of relative
+length <= 23 digest exactly (total <= PREFIX_BYTES = 31)."""
+
+import pytest
+
+from foundationdb_tpu.conflict.encoded import EncodedBatch
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.conflict.supervisor import SupervisedConflictSet
+from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+from foundationdb_tpu.core import DeterministicRandom
+from foundationdb_tpu.ops.digest import PREFIX_BYTES, SALT_BYTES
+from foundationdb_tpu.tenant.map import tenant_prefix
+from foundationdb_tpu.txn import CommitTransactionRef, KeyRange
+
+
+def make_supervised():
+    return SupervisedConflictSet(
+        lambda oldest_version=0: TpuConflictSet(oldest_version,
+                                                capacity=1 << 12))
+
+
+def random_tenant_txn(rng, now, window, n_tenants=4):
+    """Point reads/writes on tenant-prefixed keys with relative length
+    <= 23 — the shape ALL tenant traffic has (tenant/handle.py)."""
+    snap = now - rng.random_int(0, window)
+    tr = CommitTransactionRef(read_snapshot=max(snap, 0))
+
+    def key():
+        p = tenant_prefix(rng.random_int(1, n_tenants))
+        rel = b"k%02d" % rng.random_int(0, 40)
+        if rng.coinflip():
+            rel += b"/sub%08d" % rng.random_int(0, 99)   # up to 16 bytes
+        assert len(rel) <= PREFIX_BYTES - SALT_BYTES
+        return p + rel
+
+    for _ in range(rng.random_int(0, 3)):
+        k = key()
+        tr.read_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+    for _ in range(rng.random_int(0, 2)):
+        k = key()
+        tr.write_conflict_ranges.append(KeyRange(k, k + b"\x00"))
+    return tr
+
+
+@pytest.mark.parametrize("seed", [131, 132, 133])
+def test_tenant_abort_set_parity_and_fast_path(seed):
+    """Bit-identical verdicts TPU-vs-oracle on tenant-prefixed traffic,
+    with ZERO batches routed through the exact long-key recheck."""
+    rng = DeterministicRandom(seed)
+    oracle = OracleConflictSet(0)
+    sup = make_supervised()
+    now = 0
+    for _ in range(30):
+        now += rng.random_int(1, 2_000_000)
+        batch = [random_tenant_txn(rng, now, 4_000_000)
+                 for _ in range(rng.random_int(1, 10))]
+        new_oldest = now - 5_000_000 if rng.coinflip() else None
+        got = sup.resolve(batch, now, new_oldest)
+        want = oracle.resolve(batch, now, new_oldest)
+        assert got == want, f"tenant parity divergence at now={now}"
+    # Fast-path assertion (ISSUE acceptance): tenant-relative short keys
+    # must NOT hit the long-key machinery — no recheck, no taint, and
+    # the device (not the mirror fallback) carried every batch.
+    assert sup.stats["rechecked_batches"] == 0, sup.stats
+    assert sup.stats["taint_size"] == 0
+    assert sup.stats["fallback_batches"] == 0
+    assert sup.stats["device_batches"] == 30
+
+
+def test_tenant_point_batches_take_compact_path():
+    """Tenant-prefixed point batches qualify for the all_point compact
+    device layout (the cheapest kernel): the salt column keeps them
+    under the digest prefix."""
+    txns = []
+    for tid in (1, 2, 3):
+        txns.append(CommitTransactionRef(
+            read_snapshot=0,
+            read_conflict_ranges=[KeyRange(
+                tenant_prefix(tid) + b"k", tenant_prefix(tid) + b"k\x00")],
+            write_conflict_ranges=[KeyRange(
+                tenant_prefix(tid) + b"w%02d" % tid,
+                tenant_prefix(tid) + b"w%02d\x00" % tid)]))
+    enc = EncodedBatch.from_transactions(txns)
+    assert enc.all_point
+    packed = TpuConflictSet._pack_compact(enc)
+    assert packed is not None and packed["compact"]
+    # The salt column carries the tenant prefixes: lane pair (0, 1)
+    # equals each key's first 8 bytes big-endian.
+    import numpy as np
+    salts = enc.w_salt
+    assert salts.shape[0] == 2
+    expect = [int.from_bytes(tenant_prefix(t), "big") for t in (1, 2, 3)]
+    got = (salts[0].astype(np.uint64) << np.uint64(32)) | \
+        salts[1].astype(np.uint64)
+    assert list(got) == expect
+
+
+def test_cross_tenant_same_relative_key_no_conflict_on_device():
+    """Two tenants writing the SAME relative key never conflict at the
+    resolver: their salted digests differ in the salt column."""
+    sup = make_supervised()
+    oracle = OracleConflictSet(0)
+    ka = tenant_prefix(1) + b"hot"
+    kb = tenant_prefix(2) + b"hot"
+    w_a = CommitTransactionRef(
+        write_conflict_ranges=[KeyRange(ka, ka + b"\x00")])
+    assert sup.resolve([w_a], 100) == oracle.resolve([w_a], 100)
+    # Tenant 2 reads its own "hot" at an old snapshot: tenant 1's write
+    # must NOT conflict it; tenant 1's own reader MUST conflict.
+    r_b = CommitTransactionRef(
+        read_snapshot=50,
+        read_conflict_ranges=[KeyRange(kb, kb + b"\x00")])
+    r_a = CommitTransactionRef(
+        read_snapshot=50,
+        read_conflict_ranges=[KeyRange(ka, ka + b"\x00")])
+    got = sup.resolve([r_b, r_a], 200)
+    want = oracle.resolve([r_b, r_a], 200)
+    from foundationdb_tpu.txn import CommitResult
+    assert got == want == [CommitResult.COMMITTED, CommitResult.CONFLICT]
+    assert sup.stats["rechecked_batches"] == 0

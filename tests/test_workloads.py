@@ -94,6 +94,14 @@ def test_api_correctness_spec(teardown):
     assert m["ApiCorrectness"]["transactions"] > 0
 
 
+def test_tenant_spec_under_chaos(teardown):
+    """TenantManagement workload (ISSUE 2): tenant lifecycle + cross-
+    tenant isolation under clogging chaos, from its TOML spec (also run
+    by scripts/run_ensemble.py)."""
+    m = _run_spec("TenantTest.toml", buggify=True)
+    assert m["TenantManagement"]["tenant_ops"] > 0
+
+
 def test_rollback_spec(teardown):
     m = _run_spec("RollbackTest.toml", buggify=True)
     assert m["Rollback"]["recoveries_forced"] >= 1
