@@ -489,10 +489,19 @@ class Transaction:
     # -- read version --------------------------------------------------------
     def get_read_version(self) -> Future:
         if self._read_version is None:
+            if self.debug_id:
+                # GRV leg of the cross-role timeline (reference
+                # g_traceBatch "TransactionDebug" NativeAPI points,
+                # reassembled by tools/commit_debug.py).
+                from ..core.trace import trace_batch_event
+                trace_batch_event(
+                    "TransactionDebug", self.debug_id,
+                    "NativeAPI.getConsistentReadVersion.Before")
             proxy = self.db._grv_proxy()
             self._read_version = RequestStream.at(
                 proxy.get_consistent_read_version.endpoint).get_reply(
                 GetReadVersionRequest(priority=self.priority,
+                                      debug_id=self.debug_id,
                                       tags=(self.tag,) if self.tag else ()))
         return self._read_version
 
@@ -511,7 +520,8 @@ class Transaction:
 
     async def _ensure_read_version(self) -> Version:
         from ..core.futures import wait_any
-        if self._read_version is None:
+        first_acquire = self._read_version is None
+        if first_acquire:
             await self.db._await_ready()
         f = self.get_read_version()
         idx, _ = await wait_any([f, delay(self.GRV_TIMEOUT)])
@@ -519,6 +529,10 @@ class Transaction:
             # Recovery in flight: the proxy we asked is gone or wedged.
             self._read_version = None
             raise err("request_maybe_delivered", "GRV timed out")
+        if self.debug_id and first_acquire:
+            from ..core.trace import trace_batch_event
+            trace_batch_event("TransactionDebug", self.debug_id,
+                              "NativeAPI.getConsistentReadVersion.After")
         return f.get().version
 
     # Special keyspace (reference SpecialKeySpace.actor.h ConflictingKeys
@@ -870,6 +884,10 @@ class Transaction:
         await self.db._await_ready()
         proxy = self.db._commit_proxy()
         from ..core.futures import wait_any
+        if self.debug_id:
+            from ..core.trace import trace_batch_event
+            trace_batch_event("TransactionDebug", self.debug_id,
+                              "NativeAPI.commit.Before")
         f = RequestStream.at(proxy.commit.endpoint).get_reply(
             CommitTransactionRequest(transaction=txn,
                                      debug_id=self.debug_id))
@@ -892,6 +910,10 @@ class Transaction:
         if idx == 1:
             raise err("commit_unknown_result", "commit timed out")
         reply = f.get()
+        if self.debug_id:
+            from ..core.trace import trace_batch_event
+            trace_batch_event("TransactionDebug", self.debug_id,
+                              "NativeAPI.commit.After")
         self.committed_version = reply.version
         from ..txn.types import make_versionstamp
         self._committed_stamp = make_versionstamp(reply.version,

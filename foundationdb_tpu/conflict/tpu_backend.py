@@ -131,6 +131,11 @@ class TpuConflictSet(ConflictSet):
         self.d_cap = self._d_cap0
         self._inflight: List[ResolveHandle] = []
         self._gc_interval = gc_interval_batches
+        # Dispatch-shape profile (read by the supervisor's status):
+        # txns vs padded txn slots = batch occupancy (bucket quantization
+        # cost on the tunnel), plus merge cadence and compact-path hits.
+        self.profile = {"batches": 0, "txns": 0, "txn_slots": 0,
+                        "merges": 0, "compact_batches": 0}
         self._reset_state(oldest_version)
 
     # An int32 offset span we never let live versions approach; beyond this
@@ -192,6 +197,7 @@ class TpuConflictSet(ConflictSet):
     def merge(self) -> None:
         """Overlay delta onto base, GC vs the window floor, rebase, rebuild
         the base range-max table, reset delta.  Fully async (no sync)."""
+        self.profile["merges"] += 1
         delta_reb = max(self.oldest_version - self.version_base, 0)
         scalars = np.asarray(
             [self._rel(self.oldest_version), delta_reb], dtype=np.int32)
@@ -392,6 +398,11 @@ class TpuConflictSet(ConflictSet):
         meta[sc:sc + 2] = (self._rel(now), self._rel(oldest_floor))
 
         out = self._invoke_step(enc, meta)
+        self.profile["batches"] += 1
+        self.profile["txns"] += n_txns
+        self.profile["txn_slots"] += t_cap
+        if enc["compact"]:
+            self.profile["compact_batches"] += 1
         handle = ResolveHandle(self, out, n_txns, t_cap)
         self._inflight.append(handle)
         return handle

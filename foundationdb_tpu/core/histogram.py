@@ -20,7 +20,13 @@ class Histogram:
     """Power-of-two log-scale histogram of seconds (reference Histogram.h).
 
     Bucket i counts samples in (BASE*2^(i-1), BASE*2^i]; percentiles are
-    bucket upper bounds (exact enough for p50/p95/p99 reporting)."""
+    bucket upper bounds (exact enough for p50/p95/p99 reporting).
+
+    Two tiers: the CURRENT INTERVAL (buckets/count/... below, what the
+    periodic LatencyBand emission reports and then roll()s away) and a
+    lifetime ACCUMULATOR of rolled intervals — snapshot()/to_status()
+    merge both, so status percentiles always reflect the full
+    distribution regardless of the emission cadence."""
 
     def __init__(self, group: str = "", op: str = "") -> None:
         self.group = group
@@ -30,6 +36,8 @@ class Histogram:
         self.total = 0.0
         self.min: Optional[float] = None
         self.max = 0.0
+        from .metrics import HistogramSnapshot
+        self._accumulated = HistogramSnapshot()
 
     def record(self, seconds: float) -> None:
         self.count += 1
@@ -43,39 +51,54 @@ class Histogram:
             i += 1
         self.buckets[i] += 1
 
-    def percentile(self, p: float) -> float:
-        """Upper bound of the bucket containing the p-quantile (0..1)."""
-        if self.count == 0:
-            return 0.0
-        target = max(1, int(self.count * p))
-        acc = 0
-        bound = _BASE
-        for i, c in enumerate(self.buckets):
-            acc += c
-            if acc >= target:
-                return bound
-            bound *= 2
-        return bound
+    def snapshot(self):
+        """Mergeable lifetime snapshot (accumulated intervals + the
+        current one) — the aggregation currency of core/metrics.py."""
+        from .metrics import HistogramSnapshot
+        return HistogramSnapshot(
+            self._accumulated.buckets, self._accumulated.count,
+            self._accumulated.total, self._accumulated.min,
+            self._accumulated.max).merge(HistogramSnapshot(
+                self.buckets, self.count, self.total, self.min, self.max))
 
-    @property
-    def mean(self) -> float:
-        return self.total / self.count if self.count else 0.0
-
-    def to_status(self) -> Dict[str, float]:
-        """The status-JSON latency_statistics shape (reference
-        mr-status latency_statistics docs)."""
-        return {"count": self.count, "mean": self.mean,
-                "min": self.min or 0.0, "max": self.max,
-                "p50": self.percentile(0.50),
-                "p95": self.percentile(0.95),
-                "p99": self.percentile(0.99)}
-
-    def clear(self) -> None:
+    def roll(self):
+        """Fold the current interval into the lifetime accumulator and
+        reset it; returns the interval's snapshot (what one LatencyBand
+        emission reports)."""
+        from .metrics import HistogramSnapshot
+        interval = HistogramSnapshot(self.buckets, self.count, self.total,
+                                     self.min, self.max)
+        self._accumulated.merge(interval)
         self.buckets = [0] * _N_BUCKETS
         self.count = 0
         self.total = 0.0
         self.min = None
         self.max = 0.0
+        return interval
+
+    def percentile(self, p: float) -> float:
+        """Upper bound of the bucket containing the p-quantile (0..1),
+        over the LIFETIME distribution."""
+        return self.snapshot().percentile(p)
+
+    @property
+    def mean(self) -> float:
+        s = self.snapshot()
+        return s.mean
+
+    def to_status(self) -> Dict[str, float]:
+        """The status-JSON latency_statistics shape (reference
+        mr-status latency_statistics docs)."""
+        return self.snapshot().to_status()
+
+    def clear(self) -> None:
+        from .metrics import HistogramSnapshot
+        self.buckets = [0] * _N_BUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = 0.0
+        self._accumulated = HistogramSnapshot()
 
 
 class Counter:
@@ -104,6 +127,11 @@ class CounterCollection:
         self.role_id = role_id
         self.counters: Dict[str, Counter] = {}
         self.histograms: Dict[str, Histogram] = {}
+        # Every collection is visible to the process-wide registry (weakly
+        # — it dies with the owning role) so status / fdbcli `metrics` can
+        # aggregate without threading references through every layer.
+        from .metrics import get_metrics_registry
+        get_metrics_registry().register(self)
 
     def counter(self, name: str) -> Counter:
         c = self.counters.get(name)
@@ -117,30 +145,24 @@ class CounterCollection:
             h = self.histograms[name] = Histogram(self.group, name)
         return h
 
-    async def emit_loop(self, interval: float = 5.0) -> None:
-        """Periodic TraceEvent with each counter's rate and histogram p50s
-        (the reference's traceCounters actor)."""
+    async def emit_loop(self, interval: Optional[float] = None) -> None:
+        """The traceCounters actor: periodic {group}Metrics + LatencyBand
+        emission (core/metrics.emit_collection); cadence from the
+        METRICS_EMIT_INTERVAL knob unless overridden."""
+        from .knobs import server_knobs
+        from .metrics import emit_collection
         from .scheduler import delay, now
-        from .trace import TraceEvent
         last = now()
         while True:
-            await delay(interval)
+            # Knob re-read per tick (when not explicitly overridden) so a
+            # dynamic METRICS_EMIT_INTERVAL change applies to running
+            # roles without a restart.
+            await delay(interval if interval is not None
+                        else float(server_knobs().METRICS_EMIT_INTERVAL))
             t = now()
             dt = t - last
             last = t
-            ev = TraceEvent(f"{self.group}Metrics").detail(
-                "Id", self.role_id).detail("Elapsed", round(dt, 3))
-            for name, c in self.counters.items():
-                ev.detail(name, c.value).detail(
-                    f"{name}PerSec", round(c.rate_and_roll(dt), 2))
-            for name, h in self.histograms.items():
-                ev.detail(f"{name}P50", h.percentile(0.50)).detail(
-                    f"{name}P99", h.percentile(0.99))
-                # Reference Histogram::writeToLog clears on emission so
-                # each report (and to_status) reflects the current
-                # interval, not a lifetime-diluted distribution.
-                h.clear()
-            ev.log()
+            emit_collection(self, dt)
 
     def to_status(self) -> Dict[str, object]:
         return {

@@ -74,6 +74,16 @@ class FlowKnobs(KnobBase):
         self.DELAY_JITTER_OFFSET = 0.9
         self.DELAY_JITTER_RANGE = 0.2
         self.HUGE_ARENA_LOGGING_BYTES = 100e6
+        # Trace file hygiene (reference FileTraceLogWriter.cpp +
+        # MAX_TRACE_LOG_FILE_SIZE / TRACE_RETAIN_FILES): roll the JSONL
+        # output past this size, keep at most KEEP rolled files, and
+        # flush every FLUSH_EVERY events so a crash leaves usable traces.
+        self.TRACE_ROLL_FILE_BYTES = 10 << 20
+        self.TRACE_KEEP_FILES = 5
+        self.TRACE_FLUSH_EVERY_EVENTS = 64
+        # Reactor slow-task detection threshold (core/profiler.py): a
+        # callback holding the loop longer than this emits SlowTask.
+        self.SLOW_TASK_THRESHOLD_S = 0.25
 
 
 class ServerKnobs(KnobBase):
@@ -95,6 +105,11 @@ class ServerKnobs(KnobBase):
         self.COMMIT_TRANSACTION_BATCH_COUNT_MAX = 32768
         self.COMMIT_TRANSACTION_BATCH_BYTES_MAX = 8 << 20
         self.RESOLVER_COALESCE_TIME = 1.0
+
+        # Metrics emission cadence (reference Stats.h traceCounters
+        # interval): how often every role's CounterCollection emits its
+        # {group}Metrics + LatencyBand trace events (core/metrics.py).
+        self.METRICS_EMIT_INTERVAL = 5.0
 
         # Resolver (reference ServerKnobs.cpp:439)
         self.RESOLVER_STATE_MEMORY_LIMIT = 1_000_000

@@ -1,0 +1,230 @@
+"""Process-wide metrics registry: mergeable histogram snapshots and the
+periodic Metrics/LatencyBand trace emission shared by every role.
+
+Reference: fdbrpc/Stats.h — traceCounters (:183) is the per-role actor
+emitting counter rates on a cadence; LatencyBands (:240) publishes
+latency percentiles per request class; Status.actor.cpp aggregates the
+per-role histograms into the status document's latency_statistics.
+
+Design here:
+
+* every CounterCollection (core/histogram.py) registers itself into the
+  process-wide MetricsRegistry on construction (weakly — a dead role's
+  collection vanishes with the role object);
+* ``HistogramSnapshot`` is the MERGEABLE value type: bucket counts +
+  count/total/min/max, closed under ``merge`` so status can aggregate one
+  latency band across all instances of a role (and, in simulation, across
+  the whole cluster living in one process);
+* ``emit_collection`` is the traceCounters body: one ``{group}Metrics``
+  event with counter values + rates, and one ``LatencyBand`` event per
+  histogram that saw samples this interval (p50/p95/p99 + rate).  The hot
+  path only bumps counters / histogram buckets — TraceEvents happen ONLY
+  here, on the periodic cadence (METRICS_EMIT_INTERVAL knob).
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Dict, Iterable, List, Optional
+
+_N_BUCKETS = 40
+_BASE = 1e-6          # bucket 0 upper bound: 1us; bucket i: 1us * 2^i
+
+
+class HistogramSnapshot:
+    """Immutable-ish, mergeable view of a log-scale histogram (the wire /
+    aggregation shape of core/histogram.Histogram)."""
+
+    __slots__ = ("buckets", "count", "total", "min", "max")
+
+    def __init__(self, buckets: Optional[List[int]] = None, count: int = 0,
+                 total: float = 0.0, min_: Optional[float] = None,
+                 max_: float = 0.0) -> None:
+        self.buckets = list(buckets) if buckets is not None \
+            else [0] * _N_BUCKETS
+        self.count = count
+        self.total = total
+        self.min = min_
+        self.max = max_
+
+    def merge(self, other: "HistogramSnapshot") -> "HistogramSnapshot":
+        """Fold `other` into self (in place; returns self for chaining).
+        Exact for everything a log-scale histogram can be exact about:
+        bucket counts/total/max add and combine losslessly."""
+        for i, c in enumerate(other.buckets):
+            self.buckets[i] += c
+        self.count += other.count
+        self.total += other.total
+        self.max = max(self.max, other.max)
+        if other.min is not None:
+            self.min = other.min if self.min is None \
+                else min(self.min, other.min)
+        return self
+
+    @classmethod
+    def merged(cls, snaps: Iterable["HistogramSnapshot"]
+               ) -> "HistogramSnapshot":
+        out = cls()
+        for s in snaps:
+            out.merge(s)
+        return out
+
+    def percentile(self, p: float) -> float:
+        """Upper bound of the bucket containing the p-quantile (0..1),
+        nearest-rank (ceil) so small counts behave: p99 of 2 samples is
+        the 2nd, not the 1st.  Merged snapshots report the same value a
+        single histogram holding all samples would."""
+        if self.count == 0:
+            return 0.0
+        import math
+        target = min(max(1, math.ceil(self.count * p)), self.count)
+        acc = 0
+        bound = _BASE
+        for c in self.buckets:
+            acc += c
+            if acc >= target:
+                return bound
+            bound *= 2
+        return bound
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def to_status(self) -> Dict[str, float]:
+        return {"count": self.count, "mean": self.mean,
+                "min": self.min or 0.0, "max": self.max,
+                "p50": self.percentile(0.50),
+                "p95": self.percentile(0.95),
+                "p99": self.percentile(0.99)}
+
+    def to_wire(self) -> Dict[str, object]:
+        """Plain-data form (rides RegisterWorkerRequest.metrics_doc so a
+        real cluster's status builder can merge remote snapshots)."""
+        return {"buckets": list(self.buckets), "count": self.count,
+                "total": self.total, "min": self.min, "max": self.max}
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, object]) -> "HistogramSnapshot":
+        return cls(d.get("buckets"), int(d.get("count", 0)),
+                   float(d.get("total", 0.0)), d.get("min"),
+                   float(d.get("max", 0.0)))
+
+
+class MetricsRegistry:
+    """All live CounterCollections of this process, weakly held.
+
+    In simulation the whole cluster shares one Python process, so the
+    registry sees every role of every simulated machine — which is exactly
+    what cluster-wide aggregation wants.  In a real deployment each
+    fdbserver process has its own registry and the status builder merges
+    role snapshots it can reach (server/status.py)."""
+
+    def __init__(self) -> None:
+        self._collections: "weakref.WeakSet" = weakref.WeakSet()
+
+    def register(self, collection) -> None:
+        self._collections.add(collection)
+
+    def collections(self, group: Optional[str] = None) -> List:
+        out = [c for c in self._collections
+               if group is None or c.group == group]
+        out.sort(key=lambda c: (c.group, c.role_id))
+        return out
+
+    def merged_histogram(self, group: str, name: str) -> HistogramSnapshot:
+        """One latency band merged across every live instance of `group`
+        (lifetime samples, not just the current emission interval)."""
+        return HistogramSnapshot.merged(
+            c.histograms[name].snapshot()
+            for c in self.collections(group) if name in c.histograms)
+
+    def aggregate_counters(self) -> Dict[str, Dict[str, int]]:
+        """{group: {counter: summed value}} across all live collections."""
+        out: Dict[str, Dict[str, int]] = {}
+        for c in self.collections():
+            g = out.setdefault(c.group, {})
+            for name, counter in c.counters.items():
+                g[name] = g.get(name, 0) + counter.value
+        return out
+
+    def export(self) -> Dict[str, object]:
+        """Plain-data snapshot of every group (counter sums + lifetime
+        histogram wires) — what a real-mode worker attaches to its
+        periodic CC registration so the status builder can aggregate
+        bands across processes it has no object references into."""
+        out: Dict[str, object] = {}
+        for c in self.collections():
+            g = out.setdefault(c.group, {"counters": {}, "histograms": {}})
+            for name, counter in c.counters.items():
+                g["counters"][name] = \
+                    g["counters"].get(name, 0) + counter.value
+            for name, h in c.histograms.items():
+                snap = h.snapshot()
+                prev = g["histograms"].get(name)
+                if prev is not None:
+                    snap = HistogramSnapshot.from_wire(prev).merge(snap)
+                g["histograms"][name] = snap.to_wire()
+        return out
+
+    def to_status(self) -> Dict[str, object]:
+        """The cluster.metrics status shape: per-group counter sums plus
+        merged latency bands for every histogram name seen in a group."""
+        doc: Dict[str, object] = {}
+        for c in self.collections():
+            g = doc.setdefault(c.group, {"counters": {},
+                                         "latency_statistics": {}})
+            for name, counter in c.counters.items():
+                g["counters"][name] = \
+                    g["counters"].get(name, 0) + counter.value
+        for group, g in doc.items():
+            names = set()
+            for c in self.collections(group):
+                names.update(c.histograms)
+            g["latency_statistics"] = {
+                name: self.merged_histogram(group, name).to_status()
+                for name in sorted(names)}
+        return doc
+
+
+_registry = MetricsRegistry()
+
+
+def get_metrics_registry() -> MetricsRegistry:
+    return _registry
+
+
+def set_metrics_registry(r: MetricsRegistry) -> MetricsRegistry:
+    """Install a fresh registry (tests); returns the previous one."""
+    global _registry
+    prev = _registry
+    _registry = r
+    return prev
+
+
+def emit_collection(coll, dt: float) -> None:
+    """One traceCounters tick for `coll`: a ``{group}Metrics`` event with
+    values + rates, then one ``LatencyBand`` event per histogram that saw
+    samples this interval.  Rolls each histogram's interval into its
+    lifetime accumulator (so to_status()/snapshot() keep the full
+    distribution while each LatencyBand reflects only its interval)."""
+    from .trace import TraceEvent
+    ev = TraceEvent(f"{coll.group}Metrics").detail(
+        "Id", coll.role_id).detail("Elapsed", round(dt, 3))
+    for name, c in coll.counters.items():
+        ev.detail(name, c.value).detail(
+            f"{name}PerSec", round(c.rate_and_roll(dt), 2))
+    for name, h in coll.histograms.items():
+        interval = h.roll()
+        if interval.count == 0:
+            continue           # idle op: no event (trace hygiene)
+        TraceEvent("LatencyBand").detail("Group", coll.group).detail(
+            "Id", coll.role_id).detail("Op", name).detail(
+            "Count", interval.count).detail(
+            "PerSec", round(interval.count / dt, 2) if dt > 0 else 0.0
+        ).detail("Mean", round(interval.mean, 6)).detail(
+            "P50", interval.percentile(0.50)).detail(
+            "P95", interval.percentile(0.95)).detail(
+            "P99", interval.percentile(0.99)).detail(
+            "Max", round(interval.max, 6)).log()
+    ev.log()

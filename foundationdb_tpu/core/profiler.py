@@ -32,12 +32,18 @@ from .trace import Severity, TraceEvent
 SLOW_TASK_THRESHOLD_S = 0.25
 
 
-def install_slow_task_detection(loop,
-                                threshold_s: float = SLOW_TASK_THRESHOLD_S
+def install_slow_task_detection(loop, threshold_s: Optional[float] = None
                                 ) -> None:
     """Time each dispatched CALLBACK (via EventLoop.callback_hook — idle
     sleeps and selector waits are not counted) and emit a SlowTask
-    TraceEvent when one holds the reactor past the threshold."""
+    TraceEvent when one holds the reactor past the threshold (the
+    SLOW_TASK_THRESHOLD_S knob unless overridden).  Installed by default
+    at worker startup — sim and real clusters both get SlowTask events
+    without a test wiring it."""
+    if threshold_s is None:
+        from .knobs import get_knobs
+        threshold_s = float(getattr(get_knobs().flow, "SLOW_TASK_THRESHOLD_S",
+                                    SLOW_TASK_THRESHOLD_S))
     if getattr(loop, "_slow_task_installed", False):
         return
     loop._slow_task_installed = True
@@ -114,3 +120,34 @@ class SamplingProfiler:
         for frac, stack in self.report(top):
             TraceEvent("ProfilerHotStack").detail(
                 "Fraction", round(frac, 4)).detail("Stack", stack).log()
+
+
+# One profiler per OS process: worker startup calls maybe_start_profiler
+# from every hosted role's process, but only the first call (with
+# FDB_PROFILE=1) actually starts the sampling thread.
+_profiler: Optional[SamplingProfiler] = None
+
+
+def maybe_start_profiler(spawn=None, dump_interval_s: float = 30.0
+                         ) -> Optional[SamplingProfiler]:
+    """Start the process-wide SamplingProfiler when FDB_PROFILE=1
+    (reference --profile / Profiler.actor.cpp); idempotent.  With `spawn`
+    (an actor-spawning callable) a periodic hot-stack dump actor is also
+    started so long-running servers trace their profile without being
+    asked."""
+    import os
+    global _profiler
+    if os.environ.get("FDB_PROFILE") != "1":
+        return None
+    if _profiler is not None:
+        return _profiler
+    _profiler = SamplingProfiler()
+    _profiler.start()
+    if spawn is not None:
+        async def _dump() -> None:
+            from .scheduler import delay
+            while True:
+                await delay(dump_interval_s)
+                _profiler.log_report()
+        spawn(_dump(), "profiler.dump")
+    return _profiler

@@ -80,7 +80,16 @@ class EventLoop:
         self._time = start_time
         self._epoch_real = _time.monotonic() - start_time
         self._heap: List = []  # (time, -priority, seq, fn)
-        self._seq = 0
+        # Tie-break sequence for heap entries.  An itertools.count, not
+        # `self._seq += 1`: call_at can be reached from outside the
+        # reactor thread (threadpool completions, __del__-driven
+        # broken-promise delivery runs on whatever thread GC happens to
+        # use), and a racy read-modify-write can mint DUPLICATE seqs —
+        # heapq then falls through to comparing the callback functions
+        # (TypeError, observed as a once-per-thousand-runs suite crash).
+        # count.__next__ is a single C call, atomic under the GIL.
+        import itertools
+        self._seq_counter = itertools.count(1)
         self._tasks: set = set()
         self._stopped = False
         # Real-IO reactor half (reference Net2: boost::asio reactor fused
@@ -172,8 +181,8 @@ class EventLoop:
     # -- scheduling primitives ---------------------------------------------
     def call_at(self, when: float, fn: Callable[[], None],
                 priority: TaskPriority = TaskPriority.DefaultDelay) -> None:
-        self._seq += 1
-        heapq.heappush(self._heap, (when, -int(priority), self._seq, fn))
+        heapq.heappush(self._heap,
+                       (when, -int(priority), next(self._seq_counter), fn))
 
     def call_soon(self, fn: Callable[[], None],
                   priority: TaskPriority = TaskPriority.DefaultYield) -> None:

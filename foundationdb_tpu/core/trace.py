@@ -24,32 +24,100 @@ class Severity:
 
 
 class Tracer:
-    def __init__(self, ring_size: int = 20000, path: Optional[str] = None) -> None:
+    """In-memory ring + optional rolling JSONL file.
+
+    File hygiene (reference flow/FileTraceLogWriter.cpp): the active file
+    rolls once it exceeds `roll_bytes` (trace.0.jsonl -> trace.1.jsonl,
+    older files shifting up, at most `keep_files` rolled files kept), and
+    the writer flushes every `flush_every` events so a crashed process
+    leaves a usable trace tail.  close() emits a final TraceStats event
+    so the error count of the run is never lost."""
+
+    def __init__(self, ring_size: int = 20000, path: Optional[str] = None,
+                 roll_bytes: int = 0, keep_files: int = 5,
+                 flush_every: int = 64) -> None:
         self.ring: Deque[Dict[str, Any]] = deque(maxlen=ring_size)
         self.path = path
         self._fh = open(path, "a", encoding="utf-8") if path else None
         self.error_count = 0
+        self.events_emitted = 0
+        self.roll_bytes = roll_bytes
+        self.keep_files = max(1, keep_files)
+        self.flush_every = max(1, flush_every)
+        self._bytes_written = (os.path.getsize(path)
+                               if path and os.path.exists(path) else 0)
+        self._since_flush = 0
         self._lock = threading.Lock()
+
+    def _rolled_name(self, i: int) -> str:
+        """trace.0.jsonl -> trace.<i>.jsonl; trace.jsonl -> trace.<i>.jsonl."""
+        root, ext = os.path.splitext(self.path)
+        if root.endswith(".0"):
+            root = root[:-2]
+        return f"{root}.{i}{ext}"
+
+    def _roll(self) -> None:
+        """Shift rolled files up one slot and start a fresh active file
+        (caller holds the lock)."""
+        self._fh.close()
+        try:
+            last = self._rolled_name(self.keep_files)
+            if os.path.exists(last):
+                os.remove(last)
+            for i in range(self.keep_files - 1, 0, -1):
+                src = self._rolled_name(i)
+                if os.path.exists(src):
+                    os.replace(src, self._rolled_name(i + 1))
+            os.replace(self.path, self._rolled_name(1))
+        except OSError:  # pragma: no cover - a lost roll keeps appending
+            pass
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._bytes_written = 0
 
     def emit(self, event: Dict[str, Any]) -> None:
         with self._lock:
             self.ring.append(event)
+            self.events_emitted += 1
             if event.get("Severity", 10) >= Severity.Error:
                 self.error_count += 1
             if self._fh:
-                self._fh.write(json.dumps(event, default=str) + "\n")
+                line = json.dumps(event, default=str) + "\n"
+                self._fh.write(line)
+                self._bytes_written += len(line)
+                self._since_flush += 1
+                if self._since_flush >= self.flush_every:
+                    self._since_flush = 0
+                    self._fh.flush()
+                if self.roll_bytes and self._bytes_written >= self.roll_bytes:
+                    self._roll()
 
     def flush(self) -> None:
-        if self._fh:
-            self._fh.flush()
+        with self._lock:
+            if self._fh:
+                self._fh.flush()
 
     def find(self, type_name: str) -> List[Dict[str, Any]]:
         return [e for e in self.ring if e.get("Type") == type_name]
 
     def close(self) -> None:
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+        if self._fh is None:
+            return
+        # Final accounting (the reference's TraceLog close summary): a
+        # run's error count must reach the file even when nothing reads
+        # the live ring.  Built by hand — TraceEvent would re-enter emit
+        # through the global tracer, which may not be this instance.
+        # Events counts the run's events, excluding this summary record.
+        from .scheduler import _current
+        n_events = self.events_emitted
+        self.emit({"Type": "TraceStats", "Severity": Severity.Info,
+                   "Time": round(_current.now() if _current is not None
+                                 else 0.0, 6),
+                   "Events": n_events,
+                   "ErrorCount": self.error_count})
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None
 
 
 _tracer = Tracer()
@@ -64,26 +132,28 @@ def get_tracer() -> Tracer:
     return _tracer
 
 
-# Process-wide "current span context" (reference TraceEvent's implicit
-# span association via the actor's SpanContext): set by transports and
-# role handlers around request processing, stamped onto every TraceEvent
+# Ambient "current span context" (reference TraceEvent's implicit span
+# association via the actor's SpanContext): set by transports and role
+# handlers around request processing, stamped onto every TraceEvent
 # emitted inside, so cross-process hops correlate without threading the
-# id through every call signature.
-_current_span: str = ""
+# id through every call signature.  THREAD-LOCAL, not a module global:
+# TcpTransport handlers run on per-connection threads, and a shared
+# global would stamp one connection's events with another's span (and
+# restore a stale value on exit) under concurrent requests.
+_span_local = threading.local()
 
 
 def set_current_span(ctx: str) -> str:
-    """Install `ctx` as the ambient span; returns the previous one so
-    callers can restore (set/emit/restore, not a context manager, to stay
-    cheap on the hot path)."""
-    global _current_span
-    prev = _current_span
-    _current_span = ctx
+    """Install `ctx` as this thread's ambient span; returns the previous
+    one so callers can restore (set/emit/restore, not a context manager,
+    to stay cheap on the hot path)."""
+    prev = getattr(_span_local, "ctx", "")
+    _span_local.ctx = ctx
     return prev
 
 
 def get_current_span() -> str:
-    return _current_span
+    return getattr(_span_local, "ctx", "")
 
 
 class TraceEvent:
@@ -100,8 +170,9 @@ class TraceEvent:
             "Severity": severity,
             "Time": round(t, 6),
         }
-        if _current_span:
-            self._event["SpanContext"] = _current_span
+        span = getattr(_span_local, "ctx", "")
+        if span:
+            self._event["SpanContext"] = span
         if id:
             self._event["ID"] = id
         self._logged = False

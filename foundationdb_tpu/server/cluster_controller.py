@@ -85,7 +85,8 @@ class ClusterController:
                 req.recovered_logs, req.recovered_storage,
                 getattr(req, "storage_versions", {}) or {},
                 getattr(req, "locality", ("", "", "")) or ("", "", ""),
-                getattr(req, "machine_stats", {}) or {})
+                getattr(req, "machine_stats", {}) or {},
+                getattr(req, "metrics_doc", {}) or {})
             arrived, self._worker_arrived = self._worker_arrived, []
             for p in arrived:
                 p.send(None)

@@ -243,6 +243,7 @@ class CommitProxy:
                     "mutation outside the claimed tenant prefix"))
                 continue
             batch = [first]
+            t_first = now()   # BatchAssembly band: first arrival -> dispatch
             batch_bytes = first.transaction.expected_size()
             if buggify("proxy.earlyBatchClose"):
                 # Single-transaction batches stress the per-batch paths
@@ -278,6 +279,7 @@ class CommitProxy:
                 if remaining <= 0:
                     break
                 await delay(remaining)
+            self.metrics.histogram("BatchAssembly").record(now() - t_first)
             self.local_batch_number += 1
             self._spawn(self._commit_batch(batch, self.local_batch_number),
                         f"{self.id}.commitBatch")
@@ -320,15 +322,21 @@ class CommitProxy:
         # One span per commit batch (reference Span("commitBatch") in
         # CommitBatchContext): rides the resolution requests and the TLog
         # push explicitly (an ambient global would leak across actor
-        # interleavings in the async body); any client-provided debug ids
-        # correlate to it here.
+        # interleavings in the async body); client-provided debug ids
+        # correlate to it here.  SAMPLED (reference g_traceBatch: only
+        # debug-tagged transactions emit): an untagged batch mints no
+        # span, so neither this proxy nor the resolvers/TLogs downstream
+        # write any CommitDebug event for it — steady-state traffic does
+        # not churn the trace ring/files.
         from ..core.trace import trace_batch_event
-        span = f"{self.id}.b{batch_num}"
-        trace_batch_event("CommitDebug", span, "CommitProxy.batchStart")
-        for req in batch:
-            if req.debug_id:
-                trace_batch_event("CommitDebug", req.debug_id,
-                                  f"CommitProxy.batch:{span}")
+        span = ""
+        if any(req.debug_id for req in batch):
+            span = f"{self.id}.b{batch_num}"
+            trace_batch_event("CommitDebug", span, "CommitProxy.batchStart")
+            for req in batch:
+                if req.debug_id:
+                    trace_batch_event("CommitDebug", req.debug_id,
+                                      f"CommitProxy.batch:{span}")
 
         # Phase 1: pre-resolution. Gate: the previous batch must have entered
         # resolution so master versions are requested in order (:589).
@@ -340,6 +348,8 @@ class CommitProxy:
                                     proxy_id=self.id))
         commit_version: Version = vreply.version
         prev_version: Version = vreply.prev_version
+        self.metrics.histogram("VersionWait").record(now() - t_start)
+        trace_batch_event("CommitDebug", span, "CommitProxy.gotCommitVersion")
         if vreply.resolver_changes:
             self._apply_resolver_changes(vreply.resolver_changes)
 
@@ -355,6 +365,7 @@ class CommitProxy:
         t_res = now()
         resolutions = await wait_all(resolution_futures)
         self.metrics.histogram("Resolution").record(now() - t_res)
+        trace_batch_event("CommitDebug", span, "CommitProxy.afterResolution")
         self.last_resolved_version = commit_version
 
         # Phase 3: post-resolution. Gate on logging order (:1075).
@@ -379,6 +390,8 @@ class CommitProxy:
         t_log = now()
         await log_done
         self.metrics.histogram("TLogLogging").record(now() - t_log)
+        trace_batch_event("CommitDebug", span, "CommitProxy.afterTLogCommit")
+        t_reply = now()
 
         # Phase 5: reply. The TLog ack implies every lower version (from any
         # proxy) is appended and covered by the same group fsync, so commit
@@ -428,6 +441,9 @@ class CommitProxy:
                     # SpecialKeySpace ConflictingKeysImpl).
                     e.details = conflict_ranges[t_idx]
                 req.reply.send_error(e)
+        # Reply stage: committed-version report + client reply fan-out.
+        self.metrics.histogram("Reply").record(now() - t_reply)
+        trace_batch_event("CommitDebug", span, "CommitProxy.reply")
 
     def _spawn(self, coro, name: str):
         """Handlers are PROCESS-scoped: a killed process must cancel its
@@ -684,7 +700,7 @@ class CommitProxy:
         {batch index: FdbError}."""
         from ..core.error import err as _err
         from ..tenant.map import apply_tenant_mutation
-        from .system_data import TENANT_MAP_PREFIX
+        from .system_data import TENANT_MAP_END, TENANT_MAP_PREFIX
         errors: Dict[int, Any] = {}
         overlay: Optional[Dict[int, bytes]] = None   # copied lazily
         for t_idx, req in enumerate(batch):
@@ -693,7 +709,8 @@ class CommitProxy:
             if verdicts[t_idx] == CommitResult.COMMITTED and \
                     any(m.param1.startswith(TENANT_MAP_PREFIX) or
                         (m.type == MutationType.ClearRange and
-                         m.param2 > TENANT_MAP_PREFIX)
+                         m.param2 > TENANT_MAP_PREFIX and
+                         m.param1 < TENANT_MAP_END)
                         for m in txn.mutations):
                 # Fold this committed management txn's map changes so
                 # LATER txns of the batch validate against them (batch

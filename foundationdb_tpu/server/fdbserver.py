@@ -82,9 +82,17 @@ def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
     from .worker import Worker
 
     import os
+    from ..core.knobs import get_knobs
     from ..core.trace import Tracer, set_tracer
     os.makedirs(datadir, exist_ok=True)
-    set_tracer(Tracer(path=os.path.join(datadir, "trace.jsonl")))
+    # Rolling trace output (reference FileTraceLogWriter): the active
+    # trace.0.jsonl rolls to trace.1.jsonl (... keep-N) past the size
+    # knob, and flushes every few events so a crash leaves usable traces.
+    flow = get_knobs().flow
+    set_tracer(Tracer(path=os.path.join(datadir, "trace.0.jsonl"),
+                      roll_bytes=int(flow.TRACE_ROLL_FILE_BYTES),
+                      keep_files=int(flow.TRACE_KEEP_FILES),
+                      flush_every=int(flow.TRACE_FLUSH_EVERY_EVENTS)))
 
     # Cluster file (reference fdb.cluster): the durable connection spec.
     # An existing file WINS over --coordinators (the file tracks quorum
@@ -164,21 +172,14 @@ def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
     worker.run(leader_var)
 
     # Production observability (reference Net2 slow-task warnings +
-    # flow/Profiler): every dispatched callback is timed; FDB_PROFILE=1
-    # also samples the reactor thread's stack into periodic trace dumps.
-    from ..core.profiler import SamplingProfiler, install_slow_task_detection
+    # flow/Profiler): every dispatched callback is timed against the
+    # SLOW_TASK_THRESHOLD_S knob; FDB_PROFILE=1 also samples the reactor
+    # thread's stack into periodic trace dumps (worker.run installs the
+    # same hooks, so recruited-role processes are covered either way).
+    from ..core.profiler import install_slow_task_detection, \
+        maybe_start_profiler
     install_slow_task_detection(loop)
-    if os.environ.get("FDB_PROFILE") == "1":
-        profiler = SamplingProfiler()
-        profiler.start()
-
-        async def _profile_dump() -> None:
-            from ..core.scheduler import delay
-            while True:
-                await delay(30.0)
-                profiler.log_report()
-
-        proc.spawn(_profile_dump(), f"{proc.name}.profiler")
+    maybe_start_profiler(spawn=proc.spawn)
 
     async def _flush_trace() -> None:
         from ..core.scheduler import delay

@@ -58,6 +58,11 @@ class GrvProxy:
         async for req in self.interface.get_consistent_read_version.queue:
             pri = min(max(req.priority, TransactionPriority.BATCH),
                       TransactionPriority.IMMEDIATE)
+            # Arrival stamp for the QueueWait latency band (reference
+            # GrvProxyStats grvLatencyBands: time spent queued under the
+            # ratekeeper budget, measured per request, emitted
+            # periodically — no per-request TraceEvent).
+            req._t_queued = now()
             self.queues[pri].append(req)
             if self._wakeup is not None:
                 w, self._wakeup = self._wakeup, None
@@ -253,9 +258,26 @@ class GrvProxy:
             return
         self.stats["grvs"] += len(batch)
         self.metrics.counter("TxnStarted").add(len(batch))
+        # Separate bands: QueueWait ends at batch formation (_t0) — time
+        # spent held under the ratekeeper budget — while GRVLatency is
+        # the reply path from there (liveness confirm + master version
+        # fetch, ours).  Measuring the queue to reply completion would
+        # make a slow master read as ratekeeper throttling.
         self.metrics.histogram("GRVLatency").record(now() - _t0)
-        throttles = dict(self._tag_rates) if self._tag_rates else None
+        qw = self.metrics.histogram("QueueWait")
         for req in batch:
+            t_in = getattr(req, "_t_queued", None)
+            if t_in is not None:
+                qw.record(max(_t0 - t_in, 0.0))
+        throttles = dict(self._tag_rates) if self._tag_rates else None
+        from ..core.trace import trace_batch_event
+        for req in batch:
+            if req.debug_id:
+                # GRV hop of the cross-role commit timeline
+                # (tools/commit_debug.py; reference g_traceBatch
+                # "TransactionDebug" points at the GRV proxy).
+                trace_batch_event("TransactionDebug", req.debug_id,
+                                  "GrvProxy.reply")
             req.reply.send(GetReadVersionReply(version=vreply.version,
                                                locked=vreply.locked,
                                                tag_throttles=throttles))

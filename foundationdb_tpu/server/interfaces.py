@@ -598,6 +598,11 @@ class RegisterWorkerRequest:
     # ProcessMetrics): cpu seconds, RSS bytes, uptime — refreshed by the
     # worker's periodic re-announce and surfaced in status JSON.
     machine_stats: Dict[str, float] = field(default_factory=dict)
+    # This process's MetricsRegistry export ({group: {counters,
+    # histograms}}; core/metrics.py): real-mode workers attach it so the
+    # CC's status builder can merge latency bands across processes.
+    # Empty in simulation (backrefs are authoritative there).
+    metrics_doc: Dict[str, Any] = field(default_factory=dict)
     reply: Any = None
 
 
@@ -612,6 +617,7 @@ class WorkerRegistration:
     storage_versions: Dict[int, int] = field(default_factory=dict)
     locality: tuple = ("", "", "")
     machine_stats: Dict[str, float] = field(default_factory=dict)
+    metrics_doc: Dict[str, Any] = field(default_factory=dict)
 
 
 # -- placement fitness (reference flow/ProcessClass machineClassFitness +

@@ -17,7 +17,7 @@ from ..core.buggify import buggify
 from ..core.knobs import server_knobs
 from ..core.scheduler import now
 from ..core.trace import TraceEvent
-from ..txn.types import Version
+from ..txn.types import CommitResult, Version
 from .interfaces import (ResolverInterface, ResolveTransactionBatchReply,
                          ResolveTransactionBatchRequest)
 from .notified import NotifiedVersion
@@ -70,6 +70,7 @@ class Resolver:
         self.state_txns: List[tuple] = []
 
     async def _resolve_batch(self, req: ResolveTransactionBatchRequest) -> None:
+        t_in = now()
         if buggify("resolver.slowBatch"):
             from ..core.scheduler import delay
             await delay(0.02)   # stalls the version chain (pipeline stress)
@@ -79,6 +80,9 @@ class Resolver:
         # batch's prev_version (reference :141-151).
         if req.prev_version > self.version.get():
             await self.version.when_at_least(req.prev_version)
+        # Queue band: arrival -> eligible to run (the version-chain wait
+        # IS this resolver's queue; reference queueWaitLatencyDist).
+        self.metrics.histogram("QueueWait").record(now() - t_in)
 
         if req.version <= proxy.last_version:
             # Duplicate (resend): answer from cache; a superseded request is
@@ -119,7 +123,13 @@ class Resolver:
             committed, conflicting = cs.resolve_with_conflicts(
                 req.transactions, req.version, new_oldest_version=new_oldest)
         self.metrics.histogram("Resolve").record(now() - _t0)
+        if req.span:
+            from ..core.trace import trace_batch_event
+            trace_batch_event("CommitDebug", req.span,
+                              f"Resolver.{self.id}.afterResolve")
         self.metrics.counter("TxnResolved").add(len(req.transactions))
+        self.metrics.counter("TxnConflicts").add(
+            sum(1 for c in committed if c == CommitResult.CONFLICT))
         if getattr(cs, "degraded", False):
             # Supervised device backend running on its CPU-mirror fallback
             # (conflict/supervisor.py): correct but slow — make the
@@ -242,6 +252,13 @@ class Resolver:
         process.spawn(self._serve_metrics(), f"{self.id}.resolutionMetrics")
         process.spawn(self._serve_split(), f"{self.id}.resolutionSplit")
         process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
+        backend_metrics = getattr(self.conflict_set, "metrics", None)
+        if backend_metrics is not None:
+            # The supervised device backend keeps its own "TpuBackend"
+            # collection (conflict/supervisor.py); its traceCounters
+            # actor lives with the hosting resolver.
+            process.spawn(backend_metrics.emit_loop(),
+                          f"{self.id}.backendMetrics")
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),
                       f"{self.id}.waitFailure")

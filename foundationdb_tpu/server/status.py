@@ -31,6 +31,118 @@ _RECOVERY_DESCRIPTIONS = {
 }
 
 
+def _roles_of(ifaces):
+    for iface in ifaces:
+        role = getattr(iface, "role", None)
+        if role is not None:
+            yield role
+
+
+def _merge_band(roles, group: str, hist_name: str, worker_docs=()):
+    """One merged latency band (HistogramSnapshot status dict) for
+    `hist_name` across every role instance that recorded it; None when no
+    samples exist anywhere.  The metrics docs workers attach to their CC
+    registrations WIN when any process shipped one: on a real cluster
+    they cover every process — including roles co-hosted with the CC,
+    whose locally-delivered interfaces keep `.role` backrefs that would
+    otherwise mask the remote processes entirely.  Sim workers ship
+    empty docs, so simulation always reads the (complete, fresh)
+    backrefs; nothing is ever counted twice."""
+    from ..core.metrics import HistogramSnapshot
+    snaps = [HistogramSnapshot.from_wire(wire) for doc in worker_docs
+             for wire in [doc.get(group, {}).get("histograms", {})
+                          .get(hist_name)] if wire is not None]
+    if not worker_docs:
+        snaps = [role.metrics.histograms[hist_name].snapshot()
+                 for role in roles
+                 if getattr(role, "metrics", None) is not None
+                 and hist_name in role.metrics.histograms]
+    if not snaps:
+        return None
+    merged = HistogramSnapshot.merged(snaps)
+    return merged.to_status() if merged.count else None
+
+
+def collect_latency_bands(info, worker_docs=()) -> Dict[str, Any]:
+    """cluster.latency_statistics: every commit-pipeline stage as a
+    p50/p95/p99 band, merged across role instances (reference
+    latency_statistics in mr-status; the sub-stage split mirrors
+    CommitProxyServer.actor.cpp:403-409's per-stage histograms).  TPU
+    bands come from the resolvers' supervised conflict backends
+    (conflict/supervisor.py "TpuBackend" collections)."""
+    grv = list(_roles_of(info.grv_proxies))
+    cp = list(_roles_of(info.commit_proxies))
+    res = list(_roles_of(info.resolvers))
+    tlogs = list(_roles_of(info.tlogs))
+    ss = list(_roles_of(info.storage_servers.values()))
+    backends = [r.conflict_set for r in res
+                if getattr(getattr(r, "conflict_set", None),
+                           "metrics", None) is not None]
+    spec = [
+        ("grv", grv, "GrvProxy", "GRVLatency"),
+        ("grv_queue", grv, "GrvProxy", "QueueWait"),
+        ("commit", cp, "CommitProxy", "Commit"),
+        ("commit_batch_assembly", cp, "CommitProxy", "BatchAssembly"),
+        ("commit_version_wait", cp, "CommitProxy", "VersionWait"),
+        ("commit_resolution", cp, "CommitProxy", "Resolution"),
+        ("commit_tlog_logging", cp, "CommitProxy", "TLogLogging"),
+        ("commit_reply", cp, "CommitProxy", "Reply"),
+        ("resolver_queue", res, "Resolver", "QueueWait"),
+        ("resolver_resolve", res, "Resolver", "Resolve"),
+        ("tlog_append", tlogs, "TLog", "Append"),
+        ("tlog_durable", tlogs, "TLog", "DurableWait"),
+        ("storage_read", ss, "StorageServer", "ReadLatency"),
+        ("storage_fetch", ss, "StorageServer", "TLogPeek"),
+        ("tpu_dispatch", backends, "TpuBackend", "Dispatch"),
+        ("tpu_device_batch", backends, "TpuBackend", "DeviceBatch"),
+        ("tpu_mirror_resolve", backends, "TpuBackend", "MirrorResolve"),
+    ]
+    out: Dict[str, Any] = {}
+    for name, roles, group, hist in spec:
+        band = _merge_band(roles, group, hist, worker_docs)
+        if band is not None:
+            out[name] = band
+    return out
+
+
+def collect_cluster_metrics(info, worker_docs=()) -> Dict[str, Any]:
+    """cluster.metrics: per-group counter sums across the role instances
+    this status builder can reach — sim backrefs, or (real clusters) the
+    workers' registered metrics docs."""
+    groups = [
+        ("GrvProxy", _roles_of(info.grv_proxies)),
+        ("CommitProxy", _roles_of(info.commit_proxies)),
+        ("Resolver", _roles_of(info.resolvers)),
+        ("TLog", _roles_of(info.tlogs)),
+        ("StorageServer", _roles_of(info.storage_servers.values())),
+        ("TpuBackend", (r.conflict_set for r in _roles_of(info.resolvers)
+                        if getattr(getattr(r, "conflict_set", None),
+                                   "metrics", None) is not None)),
+    ]
+    out: Dict[str, Any] = {}
+    if worker_docs:
+        # Real cluster: the workers' registered counter docs cover every
+        # process (co-hosted backref roles included) — summing backrefs
+        # on top would double-count the CC's local roles.
+        for doc in worker_docs:
+            for group, g in doc.items():
+                sums = out.setdefault(group, {})
+                for name, v in (g.get("counters") or {}).items():
+                    sums[name] = sums.get(name, 0) + v
+        return out
+    for group, roles in groups:
+        sums: Dict[str, int] = {}
+        for role in roles:
+            metrics = getattr(role, "metrics", None)
+            if metrics is None:
+                continue
+            for name, c in metrics.counters.items():
+                sums[name] = sums.get(name, 0) + c.value
+        if sums:
+            out[group] = sums
+    return out
+
+
 async def build_status(cc) -> Dict[str, Any]:
     """Assemble the status document from the CC's view + live role polls
     (all polls issued in parallel — one clogged role must not stall the
@@ -94,6 +206,7 @@ async def build_status(cc) -> Dict[str, Any]:
             ("commit_proxies", info.commit_proxies),
             ("grv_proxies", info.grv_proxies),
             ("resolvers", info.resolvers),
+            ("logs", info.tlogs),
             ("storage_servers", list(info.storage_servers.values()))):
         entries = {}
         for iface in ifaces:
@@ -170,6 +283,16 @@ async def build_status(cc) -> Dict[str, Any]:
             "layers": {"_valid": True},
             "tenants": tenants_doc,
             "roles": roles,
+            # Per-stage commit-pipeline latency bands + per-group counter
+            # sums (ISSUE 3: the `fdbcli metrics` surface).  Sources:
+            # sim-side role backrefs, else the workers' registered
+            # metrics docs (real clusters).
+            "latency_statistics": collect_latency_bands(
+                info, [r.metrics_doc for r in cc.workers.values()
+                       if getattr(r, "metrics_doc", None)]),
+            "metrics": collect_cluster_metrics(
+                info, [r.metrics_doc for r in cc.workers.values()
+                       if getattr(r, "metrics_doc", None)]),
             "cluster_controller_timestamp": round(now(), 3),
             # The quorum this CC is operating against (reference status
             # coordinators section; addresses resolved from the CC's own

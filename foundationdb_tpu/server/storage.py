@@ -329,12 +329,16 @@ class StorageServer:
                 continue
             if buggify("storage.slowPull"):
                 await delay(0.05)   # lagging replica (reference BUGGIFY)
+            _t_peek = now()
             try:
                 reply = await self.log_system.peek_tag(self.tag, fetch_from)
             except FdbError:
                 # Whole team unreachable: wait for recovery to re-target us.
                 await delay(0.5)
                 continue
+            # The commit pipeline's last hop: mutation fetch from the
+            # TLogs (reference SS fetchLatencyDist over the peek cursor).
+            self.metrics.histogram("TLogPeek").record(now() - _t_peek)
             new_version = self.version.get()
             for version, msgs in reply.messages:
                 assert version > self.version.get()
@@ -487,6 +491,7 @@ class StorageServer:
         from ..core.error import FdbError, err
         from .interfaces import FetchShardRequest
         fetch = _Fetch()
+        _t0 = now()
         self.shards.set_range(req.begin, req.end, ("fetching", fetch))
         try:
             reply = None
@@ -521,6 +526,7 @@ class StorageServer:
                     self._apply_direct(m, version)
             min_read = max(vf, self.version.get())
             self.shards.set_range(req.begin, req.end, ("owned", min_read))
+            self.metrics.histogram("FetchKeys").record(now() - _t0)
             TraceEvent("SSFetchKeysDone").detail("Id", self.id).detail(
                 "Begin", req.begin).detail("End", req.end).detail(
                 "Keys", len(reply.data)).detail("MinRead", min_read).log()

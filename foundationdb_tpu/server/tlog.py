@@ -18,7 +18,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 from ..core.buggify import buggify
 from ..core.futures import Promise
 from ..core.knobs import server_knobs
-from ..core.scheduler import delay, get_event_loop
+from ..core.scheduler import delay, get_event_loop, now
 from ..core.trace import TraceEvent
 from ..core.wire import Reader, Writer
 from ..txn.types import Mutation, MutationType, Version
@@ -101,6 +101,11 @@ class TLog:
         # Durable backing (None = pure in-memory mode for static harnesses;
         # fsync is then just a simulated latency).
         self.disk_queue = disk_queue
+        # Append/DurableWait latency bands + byte/commit counters with
+        # periodic emission (reference TLogMetrics traceCounters).
+        from ..core.histogram import CounterCollection
+        self.metrics = CounterCollection("TLog", tlog_id)
+        self.interface.role = self   # sim-side backref for status/tests
         # (version, queue seq, tags in record) per pushed record, for
         # pop-driven trimming.
         self._record_seqs: Deque[Tuple[Version, int, frozenset]] = \
@@ -234,10 +239,18 @@ class TLog:
             # Locked: drop the request; the proxy sees broken_promise and
             # fails over (reference tlog_stopped error).
             return
+        t_in = now()
+        appended = False
         if req.prev_version > self.version.get():
             await self.version.when_at_least(req.prev_version)
         if self.stopped:
             return
+        # The prev-version chain wait is a pipeline-stall band of its
+        # own; Append below must time only the append work, or stalled
+        # peers would read as slow in-memory appends.
+        t_chained = now()
+        if t_chained > t_in:
+            self.metrics.histogram("QueueWait").record(t_chained - t_in)
         if req.version <= self.version.get():
             # Duplicate append (proxy resend after reconnect): already have
             # it; just wait for durability below.
@@ -252,15 +265,20 @@ class TLog:
                 from ..core.trace import trace_batch_event
                 trace_batch_event("CommitDebug", req.span,
                                   f"TLog.{self.id}.commit")
+            appended = True
+            nbytes_in = 0
             for tag, msgs in req.messages.items():
                 if not msgs:
                     continue
                 q = self.tag_data.setdefault(tag, deque())
                 q.append((req.version, msgs))
                 nbytes = sum(m.expected_size() for m in msgs)
+                nbytes_in += nbytes
                 self.bytes_input += nbytes
                 self.bytes_in_memory += nbytes
                 self.tag_bytes[tag] = self.tag_bytes.get(tag, 0) + nbytes
+            self.metrics.counter("Commits").add(1)
+            self.metrics.counter("BytesInput").add(nbytes_in)
             self.known_committed_version = max(self.known_committed_version,
                                                req.known_committed_version)
             if self.disk_queue is not None:
@@ -272,9 +290,20 @@ class TLog:
                     (req.version, seq, frozenset(req.messages)))
                 self._seq_of_version[req.version] = seq
             self.version.set(req.version)
+            self.metrics.histogram("Append").record(now() - t_chained)
             self._start_sync()
             self._maybe_spill()
+        t_append_done = now()
         await self.durable_version.when_at_least(req.version)
+        if appended:
+            # DurableWait band: appended -> covered by a group fsync
+            # (reference TLogCommitDurable histograms).
+            self.metrics.histogram("DurableWait").record(
+                now() - t_append_done)
+            if getattr(req, "span", ""):
+                from ..core.trace import trace_batch_event
+                trace_batch_event("CommitDebug", req.span,
+                                  f"TLog.{self.id}.durable")
         req.reply.send(self.version.get())
 
     def _start_sync(self) -> None:
@@ -522,6 +551,7 @@ class TLog:
         process.spawn(self._serve_lock(), f"{self.id}.serveLock")
         process.spawn(self._serve_queuing_metrics(),
                       f"{self.id}.serveQueuingMetrics")
+        process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
         from .failure import hold_wait_failure
         process.spawn(hold_wait_failure(self.interface.wait_failure),
                       f"{self.id}.waitFailure")

@@ -511,7 +511,22 @@ class Worker:
                 storage_versions=dict(self.storage_versions),
                 locality=((loc.dcid, loc.zoneid, loc.machineid)
                           if loc is not None else ("", "", "")),
-                machine_stats=self._machine_stats()))
+                machine_stats=self._machine_stats(),
+                metrics_doc=self._metrics_doc()))
+
+    def _metrics_doc(self) -> Dict[str, Any]:
+        """This process's metrics registry export, attached to the
+        periodic re-registration so the CC's status builder can merge
+        latency bands across REAL processes (it has no object references
+        into them).  Empty in simulation: every sim role lives in the
+        status builder's process and is read through interface backrefs —
+        shipping the shared registry from every sim worker would count
+        the same histograms once per worker."""
+        from ..core.metrics import get_metrics_registry
+        from ..core.scheduler import get_event_loop
+        if get_event_loop().sim:
+            return {}
+        return get_metrics_registry().export()
 
     def _machine_stats(self) -> Dict[str, float]:
         """Process metrics snapshot (reference flow/SystemMonitor.cpp
@@ -827,6 +842,15 @@ class Worker:
         p = self.process
         for s in self.interface.streams():
             p.register(s)
+        # Production observability by default (ISSUE 3 satellite): every
+        # worker's reactor gets slow-task detection (SLOW_TASK_THRESHOLD_S
+        # knob) — sim clusters included, not just tests that install it —
+        # and FDB_PROFILE=1 starts the process-wide sampling profiler.
+        from ..core.profiler import (install_slow_task_detection,
+                                     maybe_start_profiler)
+        from ..core.scheduler import get_event_loop
+        install_slow_task_detection(get_event_loop())
+        maybe_start_profiler(spawn=p.spawn)
         p.spawn(self._boot_scan(), f"{p.name}.bootScan")
         inits = [
             (self.interface.init_master, self._init_master, "master"),

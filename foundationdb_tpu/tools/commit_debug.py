@@ -1,0 +1,196 @@
+"""commit_debug: reconstruct cross-role commit timelines from traces.
+
+Reference: contrib/commit_debug.py in the reference repo — post-processes
+g_traceBatch "TransactionDebug"/"CommitDebug" point events from the trace
+files of every process into a per-transaction waterfall (client GRV ->
+commit proxy batch -> resolver -> TLog -> reply), which is how "where does
+a commit spend its time" questions get answered in production.
+
+Event model (core/trace.trace_batch_event):
+
+* TransactionDebug events are keyed by the CLIENT's debug id
+  (transaction.debug_id): NativeAPI.getConsistentReadVersion.Before/.After,
+  GrvProxy.reply, NativeAPI.commit.Before/.After.
+* CommitDebug events are keyed by the commit proxy's per-batch SPAN:
+  CommitProxy.batchStart/gotCommitVersion/afterResolution/afterTLogCommit/
+  reply, Resolver.<id>.resolveBatch/afterResolve, TLog.<id>.commit/durable.
+* The link between the two is the proxy's "CommitProxy.batch:<span>"
+  CommitDebug event, emitted with DebugID = the client debug id.
+
+Usage:
+
+    python -m foundationdb_tpu.tools.commit_debug trace.0.jsonl \
+        [more.jsonl ...] [--debug-id ID]
+
+prints one waterfall per debug-id-tagged transaction plus a stage summary
+table aggregated over all reconstructed timelines.
+
+Caveat for REAL multi-process traces: each process's trace Time field is
+monotonic since THAT process's start, so cross-file ordering is skewed —
+hop pairs within one process stay valid, and simulation traces (one
+shared clock) reconstruct exactly.  Client-side NativeAPI.* points land
+in the CLIENT's tracer (its datadir/ring), so include its trace file too
+or expect the completeness check to name them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# Location substrings a COMPLETE GRV->reply timeline must contain (the
+# test gate for the instrumentation staying wired end-to-end).
+REQUIRED_STAGES = (
+    "NativeAPI.getConsistentReadVersion.Before",
+    "GrvProxy.reply",
+    "NativeAPI.commit.Before",
+    "CommitProxy.batchStart",
+    "CommitProxy.gotCommitVersion",
+    "CommitProxy.afterResolution",
+    "Resolver.",          # any resolver instance
+    "TLog.",              # any TLog instance
+    "CommitProxy.afterTLogCommit",
+    "CommitProxy.reply",
+    "NativeAPI.commit.After",
+)
+
+_BATCH_LINK_PREFIX = "CommitProxy.batch:"
+
+
+def load_events(paths: Iterable[str]) -> List[Dict[str, Any]]:
+    """Parse trace JSONL files into event dicts (unparseable lines — e.g.
+    the torn tail of a crashed process — are skipped)."""
+    events: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
+    return events
+
+
+def build_timelines(events: Iterable[Dict[str, Any]],
+                    debug_id: Optional[str] = None
+                    ) -> Dict[str, List[Tuple[float, str]]]:
+    """{debug_id: [(time, location), ...] time-sorted} for every client
+    debug id seen (or just `debug_id`).  A debug id's timeline is its own
+    TransactionDebug/CommitDebug points plus every point of each commit
+    batch span it was correlated to."""
+    by_id: Dict[str, List[Tuple[float, str]]] = {}
+    span_points: Dict[str, List[Tuple[float, str]]] = {}
+    links: Dict[str, List[str]] = {}   # debug id -> [span, ...]
+    for e in events:
+        if e.get("Type") not in ("CommitDebug", "TransactionDebug"):
+            continue
+        did = e.get("DebugID")
+        loc = e.get("Location", "")
+        t = float(e.get("Time", 0.0))
+        if loc.startswith(_BATCH_LINK_PREFIX):
+            links.setdefault(did, []).append(
+                loc[len(_BATCH_LINK_PREFIX):])
+            by_id.setdefault(did, []).append((t, "CommitProxy.batch"))
+            continue
+        # A point is a span point iff some link names its DebugID as a
+        # span; collected for both roles — resolution happens below.
+        span_points.setdefault(did, []).append((t, loc))
+        by_id.setdefault(did, []).append((t, loc))
+    spans = {s for ss in links.values() for s in ss}
+    out: Dict[str, List[Tuple[float, str]]] = {}
+    for did, points in by_id.items():
+        if did in spans or (debug_id is not None and did != debug_id):
+            continue   # a bare span is not a client transaction
+        timeline = list(points)
+        for span in dict.fromkeys(links.get(did, ())):   # dedupe resends
+            timeline.extend(span_points.get(span, ()))
+        timeline.sort()
+        out[did] = timeline
+    return out
+
+
+def is_complete(timeline: List[Tuple[float, str]]) -> bool:
+    """True iff the timeline covers every REQUIRED_STAGES hop."""
+    locs = [loc for _t, loc in timeline]
+    return all(any(req in loc for loc in locs) for req in REQUIRED_STAGES)
+
+
+def render_waterfall(debug_id: str,
+                     timeline: List[Tuple[float, str]],
+                     width: int = 40) -> str:
+    """ASCII waterfall: per-hop offset from the first event plus a bar
+    marking where in the total span the hop landed."""
+    if not timeline:
+        return f"{debug_id}: no events"
+    t0 = timeline[0][0]
+    total = max(timeline[-1][0] - t0, 1e-9)
+    lines = [f"Commit timeline for {debug_id!r} "
+             f"(total {total * 1e3:.3f} ms, {len(timeline)} hops)"]
+    prev = t0
+    for t, loc in timeline:
+        off = t - t0
+        start = int((prev - t0) / total * width)
+        end = max(int(off / total * width), start + 1)
+        bar = " " * start + "#" * (end - start)
+        lines.append(f"  {off * 1e3:9.3f} ms  |{bar:<{width}}|  {loc}")
+        prev = t
+    return "\n".join(lines)
+
+
+def stage_summary(timelines: Dict[str, List[Tuple[float, str]]]
+                  ) -> List[Tuple[str, int, float, float]]:
+    """Aggregate consecutive-hop durations across all timelines:
+    [(\"from -> to\", count, mean_s, max_s), ...] sorted by total time
+    spent (the top row is where commits spend their time)."""
+    agg: Dict[str, List[float]] = {}
+    for timeline in timelines.values():
+        for (t_a, loc_a), (t_b, loc_b) in zip(timeline, timeline[1:]):
+            agg.setdefault(f"{loc_a} -> {loc_b}", []).append(t_b - t_a)
+    rows = [(stage, len(ds), sum(ds) / len(ds), max(ds))
+            for stage, ds in agg.items()]
+    rows.sort(key=lambda r: -(r[1] * r[2]))
+    return rows
+
+
+def render_summary(rows: List[Tuple[str, int, float, float]]) -> str:
+    lines = ["Stage summary (by total time):",
+             f"  {'count':>5}  {'mean ms':>9}  {'max ms':>9}  stage"]
+    for stage, count, mean, mx in rows:
+        lines.append(f"  {count:>5}  {mean * 1e3:>9.3f}  "
+                     f"{mx * 1e3:>9.3f}  {stage}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="commit_debug",
+        description="Reconstruct cross-role commit timelines from "
+                    "trace JSONL files.")
+    ap.add_argument("traces", nargs="+", help="trace JSONL file(s)")
+    ap.add_argument("--debug-id", default=None,
+                    help="only this transaction's timeline")
+    args = ap.parse_args(argv)
+    timelines = build_timelines(load_events(args.traces),
+                                debug_id=args.debug_id)
+    if not timelines:
+        print("no debug-id-tagged transactions found "
+              "(set transaction.debug_id to trace one)")
+        return 1
+    for did in sorted(timelines):
+        print(render_waterfall(did, timelines[did]))
+        if not is_complete(timelines[did]):
+            missing = [r for r in REQUIRED_STAGES
+                       if not any(r in loc for _t, loc in timelines[did])]
+            print(f"  (incomplete: missing {', '.join(missing)})")
+        print()
+    print(render_summary(stage_summary(timelines)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,8 @@ Commands (reference fdbcli command set):
   clearrange BEGIN END       clear a range
   getrange BEGIN END [N]     read up to N (default 25) pairs
   status [json]              cluster status summary (or the raw document)
+  metrics [FILTER]           per-stage latency bands + role counters
+                             (FILTER substring narrows both sections)
   configure FIELD=VALUE ...  change configuration transactionally
   getconfiguration           committed \\xff/conf overrides
   lock                       reject non-LOCK_AWARE commits (prints uid)
@@ -137,6 +139,41 @@ class Cli:
             f"  Available              - "
             f"{doc.get('client', {}).get('database_status', {})}",
         ]
+        return "\n".join(lines)
+
+    def cmd_metrics(self, group: str = "") -> str:
+        """Commit-pipeline observability (ISSUE 3): per-stage latency
+        bands (cluster.latency_statistics) and per-group counter sums
+        (cluster.metrics) from the status document.  An optional FILTER
+        substring narrows BOTH sections (e.g. `metrics tlog`)."""
+        async def go():
+            return await self.db.cluster.get_status()
+        cl = self.run_async(go()).get("cluster", {})
+        needle = group.lower()
+        bands = {n: b for n, b in
+                 (cl.get("latency_statistics", {}) or {}).items()
+                 if needle in n.lower()}
+        counters = {g: c for g, c in (cl.get("metrics", {}) or {}).items()
+                    if needle in g.lower()}
+        lines = ["Latency bands (ms):",
+                 f"  {'stage':<24}{'count':>8}{'mean':>9}{'p50':>9}"
+                 f"{'p95':>9}{'p99':>9}{'max':>9}"]
+        for name in sorted(bands):
+            b = bands[name]
+            lines.append(
+                f"  {name:<24}{b['count']:>8}"
+                f"{b['mean'] * 1e3:>9.3f}{b['p50'] * 1e3:>9.3f}"
+                f"{b['p95'] * 1e3:>9.3f}{b['p99'] * 1e3:>9.3f}"
+                f"{b['max'] * 1e3:>9.3f}")
+        if len(lines) == 2:
+            lines.append(f"  (no samples{' matching ' + group if group else ' yet'})")
+        lines.append("Counters:")
+        if not counters:
+            lines.append(f"  (no counters{' matching ' + group if group else ''})")
+        for g in sorted(counters):
+            vals = ", ".join(f"{k}={v}" for k, v in
+                             sorted(counters[g].items()))
+            lines.append(f"  {g}: {vals}")
         return "\n".join(lines)
 
     def cmd_configure(self, *assignments: str) -> str:

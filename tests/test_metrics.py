@@ -1,0 +1,448 @@
+"""ISSUE 3: the unified metrics subsystem — registry counters/rates,
+mergeable histogram snapshots, LatencyBand emission cadence, supervisor
+degrade/promote transition counters, the cross-role commit_debug
+timeline, trace file hygiene, and the TraceEvent lint."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from foundationdb_tpu.core.histogram import CounterCollection, Histogram
+from foundationdb_tpu.core.metrics import (HistogramSnapshot,
+                                           MetricsRegistry,
+                                           get_metrics_registry,
+                                           set_metrics_registry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def registry():
+    """Fresh process registry so collections of other tests don't leak in."""
+    fresh = MetricsRegistry()
+    prev = set_metrics_registry(fresh)
+    yield fresh
+    set_metrics_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# Histogram snapshots: merge + percentile math at bucket edges
+# ---------------------------------------------------------------------------
+
+def test_histogram_snapshot_merge_and_bucket_edges(registry):
+    # Bucket i spans (1us * 2^(i-1), 1us * 2^i]; a sample exactly at a
+    # bucket's upper bound must land in that bucket, and percentile()
+    # must return the bucket's UPPER bound.
+    h = Histogram("G", "op")
+    for _ in range(50):
+        h.record(1e-6)          # bucket 0, bound 1us
+    for _ in range(50):
+        h.record(2e-6)          # bucket 1, bound 2us (exactly at edge)
+    assert h.percentile(0.50) == 1e-6      # 50th sample is in bucket 0
+    assert h.percentile(0.51) == 2e-6      # first bucket-1 sample
+    assert h.percentile(0.99) == 2e-6
+
+    # Merge must equal one histogram holding all samples.
+    h1 = Histogram("G", "a")
+    h2 = Histogram("G", "b")
+    both = Histogram("G", "ab")
+    for us, target in ((1, h1), (1000, h2)):
+        for _ in range(100):
+            target.record(us * 1e-6)
+            both.record(us * 1e-6)
+    merged = HistogramSnapshot.merged([h1.snapshot(), h2.snapshot()])
+    ref = both.snapshot()
+    assert merged.buckets == ref.buckets
+    assert merged.count == ref.count == 200
+    for p in (0.25, 0.5, 0.75, 0.95, 0.99):
+        assert merged.percentile(p) == ref.percentile(p)
+    assert merged.min == ref.min and merged.max == ref.max
+    s = merged.to_status()
+    assert s["count"] == 200 and s["p50"] == 1e-6 and s["p99"] >= 1e-3
+
+
+def test_histogram_lifetime_survives_roll(registry):
+    # roll() feeds the periodic LatencyBand (interval-scoped) but
+    # to_status()/snapshot() keep the lifetime distribution.
+    h = Histogram("G", "op")
+    for _ in range(10):
+        h.record(1e-3)
+    interval = h.roll()
+    assert interval.count == 10
+    assert h.roll().count == 0           # nothing new this interval
+    assert h.to_status()["count"] == 10  # lifetime retained
+    h.record(1e-3)
+    assert h.to_status()["count"] == 11
+
+
+# ---------------------------------------------------------------------------
+# Registry: registration, counter sums, rates
+# ---------------------------------------------------------------------------
+
+def test_registry_counters_and_aggregation(registry):
+    c1 = CounterCollection("CommitProxy", "p0")
+    c2 = CounterCollection("CommitProxy", "p1")
+    c3 = CounterCollection("Resolver", "r0")
+    assert set(registry.collections("CommitProxy")) == {c1, c2}
+    c1.counter("TxnCommitted").add(7)
+    c2.counter("TxnCommitted").add(5)
+    c3.counter("TxnResolved").add(3)
+    c1.histogram("Commit").record(2e-3)
+    c2.histogram("Commit").record(8e-3)
+    agg = registry.aggregate_counters()
+    assert agg["CommitProxy"]["TxnCommitted"] == 12
+    assert agg["Resolver"]["TxnResolved"] == 3
+    band = registry.merged_histogram("CommitProxy", "Commit")
+    assert band.count == 2 and band.percentile(0.99) >= 8e-3
+    doc = registry.to_status()
+    assert doc["CommitProxy"]["counters"]["TxnCommitted"] == 12
+    assert doc["CommitProxy"]["latency_statistics"]["Commit"]["count"] == 2
+    json.dumps(doc)
+    # rate_and_roll: delta since last emission over dt.
+    assert c1.counter("TxnCommitted").rate_and_roll(2.0) == 3.5
+    assert c1.counter("TxnCommitted").rate_and_roll(2.0) == 0.0
+    # The registry holds collections weakly: a dead role's collection
+    # disappears (no unbounded growth across recruitments).
+    del c3
+    import gc
+    gc.collect()
+    assert registry.collections("Resolver") == []
+
+
+# ---------------------------------------------------------------------------
+# LatencyBand emission cadence under the sim clock
+# ---------------------------------------------------------------------------
+
+def test_latency_band_emission_cadence(registry, loop):
+    from foundationdb_tpu.core.knobs import server_knobs
+    from foundationdb_tpu.core.scheduler import delay
+    from foundationdb_tpu.core.trace import Tracer, get_tracer, set_tracer
+    set_tracer(Tracer())
+    interval = float(server_knobs().METRICS_EMIT_INTERVAL)
+    coll = CounterCollection("TestRole", "t0")
+
+    async def driver():
+        loop.spawn(coll.emit_loop())
+        # One sample mid-interval-1, one mid-interval-2, none in 3 —
+        # offsets keep the recorder off the emitter's tick instants.
+        coll.counter("Ops").add(4)
+        coll.histogram("OpLatency").record(3e-3)
+        await delay(interval * 1.2)              # -> interval 2
+        coll.counter("Ops").add(4)
+        coll.histogram("OpLatency").record(3e-3)
+        await delay(interval * 2.0)              # through interval 3
+        return True
+
+    assert loop.run_until(loop.spawn(driver()), timeout=60)
+    bands = get_tracer().find("LatencyBand")
+    assert len(bands) == 2, bands       # idle interval 3 emitted nothing
+    for b in bands:
+        assert b["Group"] == "TestRole" and b["Op"] == "OpLatency"
+        assert b["Count"] == 1 and b["P50"] > 0 and b["P99"] >= b["P50"]
+        assert b["PerSec"] > 0
+    # The periodic Metrics event carries counter values + rates and keeps
+    # firing even in idle intervals (it is the liveness signal).
+    mev = get_tracer().find("TestRoleMetrics")
+    assert len(mev) == 3
+    assert mev[0]["Ops"] == 4 and mev[0]["OpsPerSec"] > 0
+    assert mev[-1]["OpsPerSec"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Supervisor degrade/promote transitions as counters
+# ---------------------------------------------------------------------------
+
+def test_supervisor_transition_counters(registry):
+    from foundationdb_tpu.conflict.oracle import OracleConflictSet
+    from foundationdb_tpu.conflict.supervisor import (BackendHealthMonitor,
+                                                      SupervisedConflictSet)
+    from foundationdb_tpu.txn.types import (CommitResult,
+                                            CommitTransactionRef, KeyRange)
+
+    def txn(i):
+        return CommitTransactionRef(
+            write_conflict_ranges=[KeyRange(b"k%03d" % i,
+                                            b"k%03d\x00" % i)])
+
+    sup = SupervisedConflictSet(
+        lambda oldest_version=0: OracleConflictSet(oldest_version),
+        monitor=BackendHealthMonitor(failure_threshold=1,
+                                     reprobe_interval_s=0.0))
+    c = sup.metrics.counters
+    assert sup.resolve([txn(0)], 100) == [CommitResult.COMMITTED]
+    assert c["DeviceBatches"].value == 1
+    assert c["DeviceTxns"].value == 1
+    assert "Dispatch" in sup.metrics.histograms
+
+    sup.force_device_error = "operation_failed"
+    assert sup.resolve([txn(1)], 200) == [CommitResult.COMMITTED]
+    sup.force_device_error = None
+    assert sup.degraded
+    assert c["Degrades"].value == 1
+    assert c["FallbackBatches"].value == 1
+
+    # reprobe_interval 0: the next resolve promotes straight back.
+    assert sup.resolve([txn(2)], 300) == [CommitResult.COMMITTED]
+    assert not sup.degraded
+    assert c["Promotions"].value == 1
+    st = sup.status()
+    assert st["degrades"] == 1 and st["promotions"] == 1
+    assert "latency_statistics" in st
+    assert st["latency_statistics"]["Dispatch"]["count"] >= 1
+    # Transition counters ride the TpuBackend group for status rollup.
+    assert get_metrics_registry().aggregate_counters()[
+        "TpuBackend"]["Degrades"] == 1
+
+
+# ---------------------------------------------------------------------------
+# commit_debug: cross-role GRV -> reply timeline from a sim cluster
+# ---------------------------------------------------------------------------
+
+def test_commit_debug_reconstructs_full_timeline():
+    from foundationdb_tpu.core import (DeterministicRandom,
+                                       set_deterministic_random,
+                                       set_event_loop)
+    from foundationdb_tpu.core.trace import Tracer, get_tracer, set_tracer
+    from foundationdb_tpu.rpc.sim import set_simulator
+    from foundationdb_tpu.server.cluster import SimCluster
+    from foundationdb_tpu.tools.commit_debug import (REQUIRED_STAGES,
+                                                     build_timelines,
+                                                     is_complete,
+                                                     render_waterfall,
+                                                     stage_summary)
+    set_tracer(Tracer())
+    set_deterministic_random(DeterministicRandom(7))
+    c = SimCluster(n_resolvers=2, n_storage=2, n_tlogs=2)
+    try:
+        db = c.database()
+
+        async def go():
+            t = db.create_transaction()
+            t.debug_id = "dbg-tl"
+            await t.get(b"timeline-key")       # forces a real GRV
+            t.set(b"timeline-key", b"v1")
+            await t.commit()
+            return True
+
+        assert c.run_until(c.loop.spawn(go()), timeout=60)
+        timelines = build_timelines(list(get_tracer().ring))
+        assert "dbg-tl" in timelines, timelines.keys()
+        tl = timelines["dbg-tl"]
+        assert is_complete(tl), (
+            f"missing stages: "
+            f"{[r for r in REQUIRED_STAGES if not any(r in loc for _, loc in tl)]}")
+        # Causal order along the waterfall.
+        times = {loc: t for t, loc in tl}
+        assert times["GrvProxy.reply"] <= times["NativeAPI.commit.Before"]
+        assert times["CommitProxy.batchStart"] <= \
+            times["CommitProxy.afterResolution"]
+        assert times["CommitProxy.afterResolution"] <= \
+            times["CommitProxy.afterTLogCommit"]
+        assert times["CommitProxy.afterTLogCommit"] <= \
+            times["NativeAPI.commit.After"]
+        # Renderers produce usable text.
+        out = render_waterfall("dbg-tl", tl)
+        assert "dbg-tl" in out and "TLog" in out
+        rows = stage_summary(timelines)
+        assert rows and all(len(r) == 4 for r in rows)
+    finally:
+        set_simulator(None)
+        set_event_loop(None)
+
+
+def test_commit_debug_cli_reads_jsonl(tmp_path):
+    # The CLI path: JSONL file in, waterfall + summary out.
+    events = [
+        {"Type": "TransactionDebug", "Time": 0.0, "DebugID": "d1",
+         "Location": "NativeAPI.getConsistentReadVersion.Before"},
+        {"Type": "TransactionDebug", "Time": 0.001, "DebugID": "d1",
+         "Location": "GrvProxy.reply"},
+        {"Type": "CommitDebug", "Time": 0.002, "DebugID": "d1",
+         "Location": "CommitProxy.batch:p0.b1"},
+        {"Type": "CommitDebug", "Time": 0.003, "DebugID": "p0.b1",
+         "Location": "CommitProxy.batchStart"},
+        {"Type": "CommitDebug", "Time": 0.004, "DebugID": "p0.b1",
+         "Location": "TLog.log0.commit"},
+    ]
+    p = tmp_path / "trace.0.jsonl"
+    p.write_text("\n".join(json.dumps(e) for e in events) +
+                 "\ngarbage-torn-tail")
+    out = subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.tools.commit_debug",
+         str(p)], capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    assert "d1" in out.stdout and "TLog.log0.commit" in out.stdout
+    assert "Stage summary" in out.stdout
+    assert "p0.b1" not in out.stdout.split("Commit timeline")[0]
+
+
+# ---------------------------------------------------------------------------
+# Trace file hygiene: roll + final TraceStats
+# ---------------------------------------------------------------------------
+
+def test_tracer_rolls_and_reports_stats(tmp_path):
+    from foundationdb_tpu.core.trace import Tracer
+    path = str(tmp_path / "trace.0.jsonl")
+    tr = Tracer(path=path, roll_bytes=400, keep_files=2, flush_every=1)
+    for i in range(60):
+        tr.emit({"Type": "Filler", "Severity": 10, "N": i})
+    tr.emit({"Type": "Boom", "Severity": 40})
+    assert tr.error_count == 1
+    tr.close()
+    # Rolled generations exist, bounded by keep_files.
+    assert os.path.exists(str(tmp_path / "trace.1.jsonl"))
+    assert os.path.exists(str(tmp_path / "trace.2.jsonl"))
+    assert not os.path.exists(str(tmp_path / "trace.3.jsonl"))
+    # close() leaves a final TraceStats with the error count (the
+    # "Tracer.close() loses error_count" fix).
+    last = open(path).read().strip().splitlines()[-1]
+    stats = json.loads(last)
+    assert stats["Type"] == "TraceStats"
+    assert stats["ErrorCount"] == 1 and stats["Events"] == 61
+    # All 61 real events + TraceStats survive across the generations.
+    total = 0
+    for f in ("trace.0.jsonl", "trace.1.jsonl", "trace.2.jsonl"):
+        total += len((tmp_path / f).read_text().strip().splitlines())
+    assert total <= 62          # keep_files bounds retention
+
+
+# ---------------------------------------------------------------------------
+# Status surfacing: per-stage bands + cluster.metrics rollup
+# ---------------------------------------------------------------------------
+
+def test_status_collects_stage_bands(registry):
+    from types import SimpleNamespace
+    from foundationdb_tpu.server.status import (collect_cluster_metrics,
+                                                collect_latency_bands)
+
+    def role(group, rid, hists, counters=()):
+        r = SimpleNamespace(metrics=CounterCollection(group, rid))
+        for name, val in hists:
+            r.metrics.histogram(name).record(val)
+        for name, n in counters:
+            r.metrics.counter(name).add(n)
+        return SimpleNamespace(role=r)
+
+    grv = role("GrvProxy", "g0", [("GRVLatency", 1e-3), ("QueueWait", 1e-4)],
+               [("TxnStarted", 5)])
+    cp = role("CommitProxy", "p0",
+              [("Commit", 5e-3), ("BatchAssembly", 1e-3),
+               ("Resolution", 2e-3), ("TLogLogging", 1e-3),
+               ("Reply", 5e-4), ("VersionWait", 2e-4)],
+              [("TxnCommitted", 9)])
+    backend = SimpleNamespace(metrics=CounterCollection("TpuBackend", "b0"))
+    backend.metrics.histogram("Dispatch").record(4e-4)
+    backend.metrics.counter("DeviceBatches").add(2)
+    res_role = SimpleNamespace(
+        metrics=CounterCollection("Resolver", "r0"), conflict_set=backend)
+    res_role.metrics.histogram("Resolve").record(3e-4)
+    res = SimpleNamespace(role=res_role)
+    tlog = role("TLog", "l0", [("Append", 1e-4), ("DurableWait", 5e-4)])
+    ss = role("StorageServer", "s0",
+              [("ReadLatency", 2e-4), ("TLogPeek", 1e-4)])
+
+    info = SimpleNamespace(grv_proxies=[grv], commit_proxies=[cp],
+                           resolvers=[res], tlogs=[tlog],
+                           storage_servers={0: ss})
+    bands = collect_latency_bands(info)
+    for key in ("grv", "grv_queue", "commit", "commit_batch_assembly",
+                "commit_resolution", "commit_tlog_logging", "commit_reply",
+                "resolver_resolve", "tlog_append", "tlog_durable",
+                "storage_read", "storage_fetch", "tpu_dispatch"):
+        assert key in bands, (key, sorted(bands))
+        for stat in ("p50", "p95", "p99", "count", "mean"):
+            assert stat in bands[key]
+    assert bands["tpu_dispatch"]["count"] == 1
+    metrics = collect_cluster_metrics(info)
+    assert metrics["CommitProxy"]["TxnCommitted"] == 9
+    assert metrics["TpuBackend"]["DeviceBatches"] == 2
+    json.dumps({"latency_statistics": bands, "metrics": metrics})
+
+
+def test_status_json_and_fdbcli_metrics_live():
+    """Acceptance: `status json` exposes p50/p95/p99 bands for grv,
+    commit sub-stages, and the resolver conflict check on a live sim
+    cluster, and `fdbcli metrics` renders them."""
+    from foundationdb_tpu.core import (DeterministicRandom,
+                                       set_deterministic_random,
+                                       set_event_loop)
+    from foundationdb_tpu.rpc.sim import set_simulator
+    from foundationdb_tpu.server.cluster import SimFdbCluster
+    from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+    from foundationdb_tpu.tools.fdbcli import Cli
+    set_deterministic_random(DeterministicRandom(7))
+    try:
+        c = SimFdbCluster(config=DatabaseConfiguration(),
+                          n_workers=5, n_storage_workers=2)
+        db = c.database()
+
+        async def go():
+            from foundationdb_tpu.core import FdbError
+            for i in range(8):
+                t = db.create_transaction()
+                while True:
+                    try:
+                        await t.get(b"mk%02d" % i)
+                        t.set(b"mk%02d" % i, b"v")
+                        await t.commit()
+                        break
+                    except FdbError as e:
+                        await t.on_error(e)
+            return await db.cluster.get_status()
+
+        status = c.run_until(c.loop.spawn(go()), timeout=120)
+        json.dumps(status)
+        bands = status["cluster"]["latency_statistics"]
+        for key in ("grv", "commit", "commit_batch_assembly",
+                    "commit_resolution", "commit_tlog_logging",
+                    "resolver_resolve"):
+            assert key in bands, sorted(bands)
+            b = bands[key]
+            assert b["count"] >= 1 and b["p50"] > 0
+            assert b["p50"] <= b["p95"] <= b["p99"]
+        metrics = status["cluster"]["metrics"]
+        assert metrics["CommitProxy"]["TxnCommitted"] >= 8
+        assert metrics["GrvProxy"]["TxnStarted"] >= 8
+        assert "TLog" in status["cluster"]["metrics"]
+        assert "logs" in status["cluster"]["roles"]
+
+        cli = Cli.__new__(Cli)
+        cli.loop, cli.db = c.loop, db
+        out = cli.dispatch("metrics")
+        assert "Latency bands" in out and "commit_resolution" in out
+        assert "Counters:" in out and "TxnCommitted" in out
+    finally:
+        set_simulator(None)
+        set_event_loop(None)
+
+
+# ---------------------------------------------------------------------------
+# CI lint: TraceEvent naming + schema drift
+# ---------------------------------------------------------------------------
+
+def test_trace_event_lint_clean():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_trace_events.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_trace_event_lint_catches_violations(tmp_path):
+    (tmp_path / "a.py").write_text(
+        'TraceEvent("badCase").detail("K", 1).log()\n'
+        'TraceEvent("Dup").detail("A", 1).log()\n')
+    (tmp_path / "b.py").write_text('TraceEvent("Dup").detail("B", 1).log()\n')
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_trace_events import check
+    finally:
+        sys.path.pop(0)
+    errors = check(str(tmp_path))
+    assert any("badCase" in e for e in errors)
+    assert any("Dup" in e and "different detail schemas" in e
+               for e in errors)
