@@ -63,5 +63,18 @@ for _name in (
     "RatekeeperThrottling",
     "RatekeeperTenantQuota",
     "ProxyTenantRejected",
+    # Chaos engine (ISSUE 4): disk-fault detection paths.  Every injected
+    # corruption/IO fault must be CAUGHT by the layer above — these mark
+    # the catch sites, so a suite that injects faults the code silently
+    # serves through shows up as a never-hit marker.
+    "SimDiskIoErrorInjected",
+    "SimDiskBitRotInjected",
+    "DiskQueueCrcCaught",
+    "BTreeSlotCrcCaught",
+    "StorageIoErrorDeath",
+    "TLogIoErrorDeath",
+    "ChaosNemesisSwizzle",
+    "ChaosNemesisAttrition",
+    "ChaosNemesisPartition",
 ):
     register(_name)

@@ -53,6 +53,9 @@ ERROR_CODES = {
     "coordinators_changed": 1203,
     "please_reboot": 1207,
     "movekeys_conflict": 1208,
+    # Disk faults (reference error_definitions.h: io_error 1510 is
+    # process-fatal — fdbserver dies and gets re-recruited).
+    "io_error": 1510,
     # Tenant errors (reference error_definitions.h 2130-2137).
     "tenant_name_required": 2130,
     "tenant_not_found": 2131,

@@ -192,6 +192,15 @@ class ServerKnobs(KnobBase):
         self.DESIRED_TOTAL_BYTES = 150000
         self.STORAGE_LIMIT_BYTES = 500000
 
+        # Simulated disk fault injection (server/sim_fs.py, reference
+        # AsyncFileNonDurable + BUGGIFY'd diskFailureInjector): when the
+        # BUGGIFY site "sim_fs.fault_profile" is active for a run, newly
+        # opened sim files get an ambient LATENCY-ONLY profile with these
+        # magnitudes (fatal faults — io_error, bit-rot — are injected via
+        # explicit DiskFaultProfiles only; see from_knobs).
+        self.SIM_DISK_LATENCY_SPIKE_P = 0.01  # per write/sync op
+        self.SIM_DISK_LATENCY_SPIKE_S = 0.05  # spike duration
+
         # TLog
         self.TLOG_SPILL_THRESHOLD = 1500e6
         # Resident TLog bytes target for the ratekeeper spring (reference

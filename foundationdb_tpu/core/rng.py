@@ -8,7 +8,10 @@ that must not perturb replay (reference flow/IRandom.h g_nondeterministic_random
 from __future__ import annotations
 
 import random
-from typing import Optional, Sequence, TypeVar
+import struct
+import zlib
+from collections import deque
+from typing import Deque, List, Optional, Sequence, Tuple, TypeVar
 
 T = TypeVar("T")
 
@@ -53,6 +56,80 @@ class DeterministicRandom:
 
     def coinflip(self) -> bool:
         return self.random01() < 0.5
+
+    def unseed(self) -> int:
+        """Digest of the FINAL generator state (reference
+        DeterministicRandom::randomUInt32 drawn at simulation end — the
+        'unseed').  Two same-seed runs that made identical draw sequences
+        end in identical states; any extra/missing/reordered draw anywhere
+        in the run changes this value.  Reading it does NOT perturb the
+        state, so it can be sampled mid-run for checkpointing."""
+        return zlib.crc32(repr(self._r.getstate()).encode()) & 0xFFFFFFFF
+
+
+class RunDigest:
+    """Rolling hash of a simulation's observable schedule.
+
+    The unseed alone only witnesses RNG draws; a run can diverge without
+    touching the RNG (e.g. a wall-clock-dependent branch issuing one more
+    transaction).  The scheduler folds every dispatched (virtual time,
+    task seq) and the tracer folds every (event name, time) into this
+    digest, so ANY difference in what ran, when, or what it logged is
+    caught.  Periodic checkpoints (every CHECKPOINT_EVERY folds) keep a
+    bounded trail used for first-divergence reports when two same-seed
+    runs disagree (reference TestHarness unseed mismatch triage)."""
+
+    CHECKPOINT_EVERY = 1024
+    MAX_CHECKPOINTS = 1 << 16
+
+    __slots__ = ("value", "folds", "checkpoints", "last_event")
+
+    def __init__(self) -> None:
+        self.value = 0
+        self.folds = 0
+        # (fold count, digest value, last trace event name, last time)
+        self.checkpoints: Deque[Tuple[int, int, str, float]] = deque(
+            maxlen=self.MAX_CHECKPOINTS)
+        self.last_event = ""
+
+    _TASK = struct.Struct("<dI")
+
+    def fold_task(self, when: float, seq: int) -> None:
+        self.value = zlib.crc32(
+            self._TASK.pack(when, seq & 0xFFFFFFFF), self.value)
+        self.folds += 1
+        if self.folds % self.CHECKPOINT_EVERY == 0:
+            self.checkpoints.append(
+                (self.folds, self.value, self.last_event, when))
+
+    def fold_event(self, name: str, t: float) -> None:
+        self.value = zlib.crc32(name.encode(), self.value ^ hash(t) &
+                                0xFFFFFFFF)
+        self.folds += 1
+        self.last_event = name
+
+
+_run_digest = RunDigest()
+
+
+def run_digest() -> RunDigest:
+    return _run_digest
+
+
+def reset_run_digest() -> RunDigest:
+    """Fresh digest for a new simulation run.  EventLoops bind the digest
+    current at THEIR construction, so reset before building the world."""
+    global _run_digest
+    _run_digest = RunDigest()
+    return _run_digest
+
+
+def run_unseed() -> int:
+    """The run's combined unseed: final deterministic-RNG state folded
+    with the schedule digest.  Equal across two runs iff both the draw
+    sequence and the dispatched schedule/trace stream were identical."""
+    return (deterministic_random().unseed() ^
+            (_run_digest.value * 0x9E3779B1 & 0xFFFFFFFF))
 
 
 _det: Optional[DeterministicRandom] = None

@@ -90,6 +90,13 @@ class EventLoop:
         # count.__next__ is a single C call, atomic under the GIL.
         import itertools
         self._seq_counter = itertools.count(1)
+        # Unseed verification (core/rng.py RunDigest): in sim mode every
+        # dispatched (virtual time, task seq) folds into the run digest,
+        # making the SCHEDULE itself part of the reproducibility witness.
+        # Bound at construction: this loop belongs to the digest that was
+        # current when its world was built.
+        from .rng import run_digest
+        self._run_digest = run_digest() if sim else None
         self._tasks: set = set()
         self._stopped = False
         # Real-IO reactor half (reference Net2: boost::asio reactor fused
@@ -246,6 +253,7 @@ class EventLoop:
             heapq.heappop(self._heap)
             if when > self._time:
                 self._time = when
+            self._run_digest.fold_task(when, seq)
             self._dispatch(fn)
             return True
         # Real mode: fuse the timer heap with the IO reactor.  Wait for

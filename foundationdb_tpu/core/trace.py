@@ -75,6 +75,19 @@ class Tracer:
         self._bytes_written = 0
 
     def emit(self, event: Dict[str, Any]) -> None:
+        # Unseed verification: the (event name, time) stream is part of
+        # the run digest — a divergent run that logs one extra event is
+        # caught even if it never touched the RNG or the scheduler heap.
+        # Details are NOT folded: they may legitimately carry
+        # nondeterministic ids (nondeterministic_random unique ids).
+        # SIM ONLY, like the scheduler's fold: real-mode events are
+        # wall-clock-timed (meaningless to digest) and can arrive from
+        # per-connection threads (racy against an unlocked RunDigest).
+        from .scheduler import _current
+        if _current is not None and _current.sim:
+            from .rng import run_digest
+            run_digest().fold_event(event.get("Type", ""),
+                                    event.get("Time", 0.0))
         with self._lock:
             self.ring.append(event)
             self.events_emitted += 1

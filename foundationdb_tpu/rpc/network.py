@@ -41,6 +41,9 @@ class SimNetwork:
         self.processes: Dict[NetworkAddress, Any] = {}
         # (ip, ip) -> virtual time until which the pair is clogged
         self._clog_until: Dict[Tuple[str, str], float] = {}
+        # ip -> virtual time until which ALL its traffic is clogged
+        # (reference sim2 clogInterface — the unit the nemesis swizzles).
+        self._clog_ip_until: Dict[str, float] = {}
         self._partitioned: set = set()  # frozenset({ip, ip})
         self.messages_sent = 0
 
@@ -86,6 +89,21 @@ class SimNetwork:
         TraceEvent("ClogPair", Severity.Info).detail("A", a).detail("B", b) \
             .detail("Seconds", seconds).log()
 
+    def clog_ip(self, ip: str, seconds: float) -> None:
+        """Delay ALL traffic to and from `ip` (reference
+        ISimulator::clogInterface): the swizzle nemesis clogs whole
+        machines one at a time and unclogs them in reverse order."""
+        until = get_event_loop().now() + seconds
+        self._clog_ip_until[ip] = max(self._clog_ip_until.get(ip, 0.0),
+                                      until)
+        TraceEvent("ClogInterface", Severity.Info).detail(
+            "IP", ip).detail("Seconds", seconds).log()
+
+    def unclog_ip(self, ip: str) -> None:
+        if self._clog_ip_until.pop(ip, None) is not None:
+            TraceEvent("UnclogInterface", Severity.Info).detail(
+                "IP", ip).log()
+
     def partition_pair(self, a: str, b: str) -> None:
         self._partitioned.add(frozenset((a, b)))
 
@@ -95,6 +113,7 @@ class SimNetwork:
     def heal_all(self) -> None:
         self._partitioned.clear()
         self._clog_until.clear()
+        self._clog_ip_until.clear()
 
     # -- delivery -----------------------------------------------------------
     def _latency(self) -> float:
@@ -102,14 +121,46 @@ class SimNetwork:
         return (self.MIN_LATENCY +
                 rng.random01() * (self.MAX_LATENCY - self.MIN_LATENCY))
 
+    _CLOG_RECHECK_S = 0.25
+
     def _delivery_time(self, src: str, dst: str) -> Optional[float]:
-        """Virtual time at which a message sent now arrives, or None if the
-        pair is partitioned."""
+        """Virtual time at which a message sent now arrives (latency
+        only — clogging is re-evaluated at delivery time, see
+        _deliver_when_unclogged), or None if the pair is partitioned."""
         if frozenset((src, dst)) in self._partitioned and src != dst:
             return None
-        t = get_event_loop().now() + self._latency()
+        return get_event_loop().now() + self._latency()
+
+    def _clog_time(self, src: str, dst: str) -> float:
         clog = self._clog_until.get((src, dst), 0.0)
-        return max(t, clog)
+        if self._clog_ip_until and src != dst:
+            # Self-traffic is exempt, like partitions: co-hosted roles
+            # talk in-process, not over the clogged interface.
+            clog = max(clog, self._clog_ip_until.get(src, 0.0),
+                       self._clog_ip_until.get(dst, 0.0))
+        return clog
+
+    def _deliver_when_unclogged(self, src: str, dst: str, when: float,
+                                fn, priority: TaskPriority) -> None:
+        """Run `fn` at `when`, deferred while the (src, dst) path is
+        clogged — re-checked AT DELIVERY TIME, not frozen at send time:
+        an unclog (the nemesis's reverse-order swizzle release) must
+        free traffic captured mid-clog, and a clog extended after the
+        send must keep holding it.  While clogged, the re-check hops at
+        min(clog expiry, now + _CLOG_RECHECK_S) so a shrunk clog
+        releases within one bounded, deterministic step."""
+        loop = get_event_loop()
+
+        def step() -> None:
+            clog = self._clog_time(src, dst)
+            t = loop.now()
+            if clog > t:
+                loop.call_at(min(clog, t + self._CLOG_RECHECK_S), step,
+                             priority)
+            else:
+                fn()
+
+        loop.call_at(when, step, priority)
 
     def _process_alive(self, address: NetworkAddress, epoch: int) -> bool:
         p = self.processes.get(address)
@@ -159,7 +210,8 @@ class SimNetwork:
             if back is None:
                 loop.call_at(loop.now() + self._latency(), fail, priority)
             else:
-                loop.call_at(back, deliver_reply, priority)
+                self._deliver_when_unclogged(ep.address.ip, src_ip, back,
+                                             deliver_reply, priority)
 
         def deliver() -> None:
             entry = self._endpoints.get(ep)
@@ -170,7 +222,8 @@ class SimNetwork:
             request.reply = ReplyPromise(route_reply)
             stream.deliver(request)
 
-        loop.call_at(when, deliver, priority)
+        self._deliver_when_unclogged(src_ip, ep.address.ip, when, deliver,
+                                     priority)
         return reply_promise.get_future()
 
     def send_one_way(self, ep: Endpoint, message: Any,
@@ -189,7 +242,8 @@ class SimNetwork:
                 return
             entry[0].deliver(message)
 
-        get_event_loop().call_at(when, deliver, priority)
+        self._deliver_when_unclogged(src_ip, ep.address.ip, when, deliver,
+                                     priority)
 
 
 _network: Optional[SimNetwork] = None

@@ -196,8 +196,28 @@ class Simulator:
     def clog_pair(self, a: SimProcess, b: SimProcess, seconds: float) -> None:
         self.network.clog_pair(a.address.ip, b.address.ip, seconds)
 
+    def clog_process(self, p: SimProcess, seconds: float) -> None:
+        """Clog ALL of one process's traffic (reference clogInterface) —
+        the unit the swizzle nemesis toggles."""
+        self.network.clog_ip(p.address.ip, seconds)
+
+    def unclog_process(self, p: SimProcess) -> None:
+        self.network.unclog_ip(p.address.ip)
+
+    def clog_machine(self, machineid: str, seconds: float) -> None:
+        for p in self.machines[machineid].processes:
+            if p.alive:
+                self.clog_process(p, seconds)
+
+    def unclog_machine(self, machineid: str) -> None:
+        for p in self.machines[machineid].processes:
+            self.unclog_process(p)
+
     def partition(self, a: SimProcess, b: SimProcess) -> None:
         self.network.partition_pair(a.address.ip, b.address.ip)
+
+    def heal_pair(self, a: SimProcess, b: SimProcess) -> None:
+        self.network.heal_partition(a.address.ip, b.address.ip)
 
     def heal(self) -> None:
         self.network.heal_all()

@@ -180,6 +180,10 @@ class SimFdbCluster:
             set_event_loop(self.loop)
             self.sim = Simulator()
             set_simulator(self.sim)
+        # Unique leadership change_ids for restarted workers (original
+        # workers use small ids; restarts must never collide, and higher
+        # ids lose ties — a rebooted worker doesn't steal leadership).
+        self._next_change_id = 10000
         self._boot()
 
     def _boot(self) -> None:
@@ -187,12 +191,8 @@ class SimFdbCluster:
         stable across calls, so a second _boot after power_fail_all() finds
         each machine's surviving files (coordinator registers, TLog queues,
         storage engines) and recovers from them."""
-        from ..core.futures import AsyncVar
-        from .cluster_controller import ClusterController
         from .coordination import (CoordinationClientInterface,
-                                   CoordinationServer, monitor_leader,
-                                   try_become_leader)
-        from .worker import Worker
+                                   CoordinationServer)
 
         self.coordinators = []
         self.coordinator_clients = []
@@ -215,28 +215,93 @@ class SimFdbCluster:
             p = self.sim.new_process(name=f"{self.name_prefix}worker{i}",
                                      machineid=f"mach.{self.name_prefix}worker{i}",
                                      process_class=pclass, zoneid=zone)
-            leader_var = AsyncVar(None)
             # Only stateless workers campaign for CC (a storage worker
-            # winning would put the control plane on a data node), so only
-            # they need a candidate ClusterController at all.
-            if pclass == "stateless":
-                cc = ClusterController(f"cc.worker{i}",
-                                       self.coordinator_clients, self.config)
-                cc.register_streams(p)   # endpoints exist before any win
-                p.spawn(try_become_leader(self.coordinator_clients,
-                                          cc.interface, leader_var,
-                                          change_id=i),
-                        f"worker{i}.campaign")
-                p.spawn(self._cc_runner(p, cc, leader_var, i),
-                        f"worker{i}.ccRunner")
-            else:
-                cc = None
-                p.spawn(monitor_leader(self.coordinator_clients, leader_var),
-                        f"worker{i}.monitorLeader")
-            worker = Worker(p, self.coordinator_clients,
-                            process_class=pclass, config=self.config)
-            worker.run(leader_var)
-            self.workers.append((p, worker, cc, leader_var))
+            # winning would put the control plane on a data node).
+            self.workers.append(
+                (p,) + self._spawn_worker_roles(
+                    p, campaign=(pclass == "stateless"), change_id=i))
+        # Rolling-reboot support (reference simulatedFDBDRebooter): a
+        # reboot_process'd worker gets its role stack re-run on the same
+        # (epoch-bumped) process, recovering from its machine's files.
+        self.sim.on_reboot = self._handle_reboot
+
+    def _spawn_worker_roles(self, p, campaign: bool, change_id: int):
+        """(Re)start the worker-side role stack on process `p`: the CC
+        candidacy (stateless campaigners), leader monitoring, and the
+        Worker recruitment surface (which re-scans the machine's durable
+        files, so a restarted process recovers its TLogs/engines).
+        Returns (worker, cc, leader_var) — one self.workers entry minus
+        the process.  Shared by _boot, add_worker, reboot and restart."""
+        from ..core.futures import AsyncVar
+        from .cluster_controller import ClusterController
+        from .coordination import monitor_leader, try_become_leader
+        from .worker import Worker
+        # Remember the campaign choice on the process: a reboot/restart
+        # must preserve it (a stateless worker deliberately added WITHOUT
+        # a CC candidacy — e.g. a remote-dc worker in a no-remote-CC
+        # topology — must not start campaigning after attrition).
+        p._cc_campaign = campaign
+        leader_var = AsyncVar(None)
+        cc = None
+        if campaign and p.process_class == "stateless":
+            cc = ClusterController(f"cc.{p.name}.c{change_id}",
+                                   self.coordinator_clients, self.config)
+            cc.register_streams(p)   # endpoints exist before any win
+            p.spawn(try_become_leader(self.coordinator_clients,
+                                      cc.interface, leader_var,
+                                      change_id=change_id),
+                    f"{p.name}.campaign")
+            p.spawn(self._cc_runner(p, cc, leader_var, change_id),
+                    f"{p.name}.ccRunner")
+        else:
+            p.spawn(monitor_leader(self.coordinator_clients, leader_var),
+                    f"{p.name}.monitorLeader")
+        worker = Worker(p, self.coordinator_clients,
+                        process_class=p.process_class, config=self.config)
+        worker.run(leader_var)
+        return worker, cc, leader_var
+
+    def _bump_change_id(self) -> int:
+        cid = self._next_change_id
+        self._next_change_id += 1
+        return cid
+
+    def _handle_reboot(self, p) -> None:
+        """sim.on_reboot hook: a reboot_process'd WORKER re-runs its role
+        stack on the same epoch-bumped process (coordinators are not
+        reboot targets — restart them with power_fail_reboot)."""
+        for idx, entry in enumerate(self.workers):
+            if entry[0] is p:
+                from ..core.trace import TraceEvent
+                roles = self._spawn_worker_roles(
+                    p, campaign=getattr(p, "_cc_campaign",
+                                        p.process_class == "stateless"),
+                    change_id=self._bump_change_id())
+                self.workers[idx] = (p,) + roles
+                TraceEvent("SimWorkerRebooted").detail(
+                    "Worker", p.name).detail("Epoch", p.epoch).log()
+                return
+
+    def restart_worker(self, i: int):
+        """Bring worker `i` back after a kill or machine power-fail: a
+        fresh process on the SAME machine (stable machineid => its
+        surviving durable files are found and recovered).  If the process
+        is still alive this is a clean rolling reboot instead."""
+        p_old = self.workers[i][0]
+        if p_old.alive:
+            self.sim.reboot_process(p_old)   # roles respawn via the hook
+            return p_old
+        p = self.sim.new_process(name=p_old.name,
+                                 machineid=p_old.locality.machineid,
+                                 process_class=p_old.process_class,
+                                 dcid=p_old.locality.dcid,
+                                 zoneid=p_old.locality.zoneid)
+        roles = self._spawn_worker_roles(
+            p, campaign=getattr(p_old, "_cc_campaign",
+                                p_old.process_class == "stateless"),
+            change_id=self._bump_change_id())
+        self.workers[i] = (p,) + roles
+        return p
 
     def add_coordinator(self, name: Optional[str] = None):
         """Start one more coordination server mid-run (a changeQuorum
@@ -266,35 +331,15 @@ class SimFdbCluster:
         betterMasterExists re-recruitment; region tests place workers in
         a second dc, with `campaign` giving the remote dc a CC candidate
         so it can elect a controller after the primary dc dies)."""
-        from ..core.futures import AsyncVar
-        from .cluster_controller import ClusterController
-        from .coordination import monitor_leader, try_become_leader
-        from .worker import Worker
         i = len(self.workers)
         name = name or f"worker{i}"
         p = self.sim.new_process(name=name, machineid=f"mach.{name}",
                                  process_class=pclass, dcid=dcid,
                                  zoneid=zoneid)
-        leader_var = AsyncVar(None)
-        cc = None
-        if campaign and pclass == "stateless":
-            cc = ClusterController(f"cc.{name}",
-                                   self.coordinator_clients, self.config)
-            cc.register_streams(p)
-            p.spawn(try_become_leader(self.coordinator_clients,
-                                      cc.interface, leader_var,
-                                      change_id=100 + i),
-                    f"{name}.campaign")
-            p.spawn(self._cc_runner(p, cc, leader_var, 100 + i),
-                    f"{name}.ccRunner")
-        else:
-            p.spawn(monitor_leader(self.coordinator_clients, leader_var),
-                    f"{name}.monitorLeader")
-        worker = Worker(p, self.coordinator_clients,
-                        process_class=pclass, config=self.config)
-        worker.run(leader_var)
-        self.workers.append((p, worker, cc, leader_var))
-        return p, worker
+        roles = self._spawn_worker_roles(p, campaign=campaign,
+                                         change_id=100 + i)
+        self.workers.append((p,) + roles)
+        return p, roles[0]
 
     def power_fail_reboot(self) -> None:
         """Whole-cluster unclean power loss + restart (reference

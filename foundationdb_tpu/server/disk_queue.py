@@ -9,7 +9,9 @@ durable PREFIX of pushed records survives a power loss.
 
 Record framing (little-endian): MAGIC:2 | seq:8 | popped:8 | len:4 | crc:4
 | payload.  `popped` persists the trim frontier piggybacked on appends
-(the reference stores it in page headers).
+(the reference stores it in page headers).  The crc spans the header
+fields AND the payload, so bit-rot anywhere in a frame — including the
+trim frontier — fails validation.
 """
 
 from __future__ import annotations
@@ -18,11 +20,23 @@ import struct
 import zlib
 from typing import List, Optional, Tuple
 
+from ..core.coverage import test_coverage
+from ..core.error import err
 from ..core.trace import Severity, TraceEvent
 from .sim_fs import SimFile
 
 _MAGIC = 0xFDB1
 _HDR = struct.Struct("<HQQII")
+# The CRC covers the header fields AND the payload (reference DiskQueue
+# page checksums span the whole page): a bit flipped in `popped` or
+# `seq` must be as detectable as one in the payload — the trim frontier
+# rides in headers, and silently corrupting it drops records.
+_HDR_CRC = struct.Struct("<HQQI")
+
+
+def _frame_crc(seq: int, popped: int, payload: bytes) -> int:
+    return zlib.crc32(payload, zlib.crc32(
+        _HDR_CRC.pack(_MAGIC, seq, popped, len(payload))))
 
 
 class DiskQueue:
@@ -44,7 +58,7 @@ class DiskQueue:
         """Append one record (buffered until commit); returns its seq."""
         seq = self.next_seq
         self.next_seq += 1
-        crc = zlib.crc32(payload)
+        crc = _frame_crc(seq, self.popped_seq, payload)
         frame = _HDR.pack(_MAGIC, seq, self.popped_seq,
                           len(payload), crc) + payload
         self._index[seq] = (self._write_offset + self._pending_offset +
@@ -55,14 +69,33 @@ class DiskQueue:
 
     async def read_payload(self, seq: int) -> Optional[bytes]:
         """Read one DURABLE record's payload by seq (spilled-tag peeks).
-        None if unknown or already popped."""
+        None if unknown or already popped.
+
+        The frame's CRC is re-verified on EVERY live read, not just at
+        recovery: post-sync bit-rot (sim_fs DiskFaultProfile) can land in
+        a record long after its durability was acked, and a spilled-tag
+        peek is the first reader to touch it again.  Corruption raises
+        io_error — the TLog converts that to process death (never serve
+        corrupt data; reference checksum failure is process-fatal)."""
         loc = self._index.get(seq)
         if loc is None or seq <= self.popped_seq:
             return None
         offset, length = loc
         if offset + length > self._write_offset:
             return None            # not yet committed to the file
-        return await self.file.read(offset, length)
+        hdr = await self.file.read(offset - _HDR.size, _HDR.size)
+        payload = await self.file.read(offset, length)
+        magic, hseq, popped, hlen, crc = _HDR.unpack(hdr)
+        if magic != _MAGIC or hseq != seq or hlen != length or \
+                _frame_crc(hseq, popped, payload) != crc:
+            test_coverage("DiskQueueCrcCaught")
+            TraceEvent("DiskQueueCorruptRecord", Severity.Error).detail(
+                "File", self.file.name).detail("Seq", seq).detail(
+                "Offset", offset).log()
+            raise err("io_error",
+                      f"disk queue record {seq} failed CRC in "
+                      f"{self.file.name}")
+        return payload
 
     async def commit(self) -> None:
         """Write buffered records and fsync (reference group commit)."""
@@ -100,7 +133,13 @@ class DiskQueue:
             if offset + _HDR.size + length > size:
                 break                      # torn tail
             payload = await self.file.read(offset + _HDR.size, length)
-            if zlib.crc32(payload) != crc:
+            if _frame_crc(seq, popped, payload) != crc:
+                # Corrupt record: recovery keeps the valid prefix only
+                # (torn tail OR mid-file rot — either way nothing past an
+                # invalid frame may be trusted or served).
+                test_coverage("DiskQueueCrcCaught")
+                TraceEvent("DiskQueueCrcMismatch", Severity.Warn).detail(
+                    "File", self.file.name).detail("Seq", seq).log()
                 break                      # corrupt tail
             records.append((seq, payload))
             self._index[seq] = (offset + _HDR.size, length)

@@ -133,16 +133,27 @@ class GrvProxy:
         from ..core.scheduler import now
         knobs = server_knobs()
         last = now()
+        # True after a drain pass released NOTHING while requests were
+        # still queued (token bucket empty): the next pass then waits the
+        # MAX batch interval instead of MIN.  Without this, a starved
+        # queue polls at INTERVAL_MIN (1us of virtual time) until budget
+        # accrues — ~500k wasted scheduler dispatches per virtual second
+        # whenever the ratekeeper clamps the rate (exactly what chaos
+        # runs do to it; found via the unseed digest's fold counts).
+        starved = False
         while True:
             have_deferred = any(self._tag_deferred.values())
             if not any(self.queues) and not have_deferred:
                 # Sleep until a request arrives (no virtual-time polling).
                 self._wakeup = Promise()
                 await self._wakeup.get_future()
+                starved = False
             # Tag-deferred requests wait on token accrual, not on new
             # arrivals: poll at a coarse interval instead of parking.
             await delay(0.05 if have_deferred and not any(self.queues)
-                        else knobs.START_TRANSACTION_BATCH_INTERVAL_MIN)
+                        else (knobs.START_TRANSACTION_BATCH_INTERVAL_MAX
+                              if starved else
+                              knobs.START_TRANSACTION_BATCH_INTERVAL_MIN))
             # Token bucket: accrue budget at the ratekeeper's tps, capped
             # at one lease's worth (reference transactionStarter :702).
             t = now()
@@ -178,7 +189,9 @@ class GrvProxy:
             batch, charged, batch_charged = self._drain(
                 self.transaction_budget, self.batch_budget)
             if not batch:
+                starved = bool(any(self.queues))
                 continue
+            starved = False
             if self.transaction_budget != float("inf"):
                 # Deficit carries forward (may go negative): overdraft now
                 # means fewer releases later, keeping the long-run rate at

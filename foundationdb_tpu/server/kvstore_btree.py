@@ -37,7 +37,8 @@ import bisect
 import zlib
 from typing import Dict, List, Optional, Tuple, Union
 
-from ..core.trace import TraceEvent
+from ..core.coverage import test_coverage
+from ..core.trace import Severity, TraceEvent
 from ..core.wire import Reader, Writer
 from .kvstore import IKeyValueStore
 from .sim_fs import SimFileSystem
@@ -50,8 +51,27 @@ _LEAF, _INTERNAL = 0, 1
 _SPLIT_BYTES = PAGE_SIZE - 64
 # Values above this spill to overflow page chains.
 _OVERFLOW_BYTES = 1024
-# Usable payload per overflow page (after the 4-byte length frame).
+# Usable payload per overflow page (after the 8-byte len+crc frame).
 _OVF_PAYLOAD = PAGE_SIZE - 8
+
+
+def _frame_page(blob: bytes) -> bytes:
+    """len:4 | crc:4 | blob — every data/overflow page carries a CRC
+    (reference: Redwood checksums every page).  Bit-rot that still
+    DECODES would otherwise be served silently; the header-slot CRC only
+    protects the roots."""
+    return (len(blob).to_bytes(4, "little") +
+            zlib.crc32(blob).to_bytes(4, "little") + blob)
+
+
+def _unframe_page(raw: bytes) -> Optional[bytes]:
+    """The page payload, or None if the frame fails its CRC."""
+    n = int.from_bytes(raw[:4], "little")
+    blob = raw[8:8 + n]
+    if len(blob) != n or \
+            zlib.crc32(blob) != int.from_bytes(raw[4:8], "little"):
+        return None
+    return blob
 
 
 class OverflowRef:
@@ -156,9 +176,23 @@ class KVStoreBTree(IKeyValueStore):
     async def _read_node(self, page_id: int) -> _Node:
         node = self._dirty.get(page_id) or self._cache.get(page_id)
         if node is None:
-            blob = await self.file.read(page_id * PAGE_SIZE, PAGE_SIZE)
-            (n,) = (int.from_bytes(blob[:4], "little"),)
-            node = _Node.decode(blob[4:4 + n])
+            raw = await self.file.read(page_id * PAGE_SIZE, PAGE_SIZE)
+            blob = _unframe_page(raw)
+            try:
+                if blob is None:
+                    raise ValueError("page CRC mismatch")
+                node = _Node.decode(blob)
+            except Exception as e:
+                # Rotted page (CRC) or undecodable bytes: this engine
+                # must never hand garbage upward — io_error is
+                # process-fatal in the storage role above.
+                from ..core.error import err
+                TraceEvent("BTreePageCorrupt", Severity.Error).detail(
+                    "File", self.file.name).detail(
+                    "Page", page_id).detail("Reason", repr(e)).log()
+                raise err("io_error",
+                          f"btree page {page_id} corrupt in "
+                          f"{self.file.name}")
             self._cache[page_id] = node
         return node
 
@@ -207,9 +241,16 @@ class KVStoreBTree(IKeyValueStore):
             if isinstance(raw, bytes):
                 part = raw
             else:
-                blob = await self.file.read(pid * PAGE_SIZE, PAGE_SIZE)
-                n = int.from_bytes(blob[:4], "little")
-                part = blob[4:4 + n]
+                part = _unframe_page(
+                    await self.file.read(pid * PAGE_SIZE, PAGE_SIZE))
+                if part is None:
+                    from ..core.error import err
+                    TraceEvent("BTreePageCorrupt", Severity.Error).detail(
+                        "File", self.file.name).detail("Page", pid).detail(
+                        "Reason", "overflow CRC mismatch").log()
+                    raise err("io_error",
+                              f"btree overflow page {pid} corrupt in "
+                              f"{self.file.name}")
             parts.append(part[:remaining])
             remaining -= len(parts[-1])
         return b"".join(parts)
@@ -349,7 +390,7 @@ class KVStoreBTree(IKeyValueStore):
                 encoded[page_id] = node        # raw overflow payload
                 continue
             blob = node.encode()
-            if 4 + len(blob) > PAGE_SIZE:
+            if 8 + len(blob) > PAGE_SIZE:
                 from ..core.error import err
                 self._dirty = {}
                 self.page_count = page_count0
@@ -361,8 +402,7 @@ class KVStoreBTree(IKeyValueStore):
         # Write dirty pages, fsync, then the next header slot, fsync
         # (reference: commit == one durable header write).
         for page_id, blob in encoded.items():
-            await self.file.write(page_id * PAGE_SIZE,
-                                  len(blob).to_bytes(4, "little") + blob)
+            await self.file.write(page_id * PAGE_SIZE, _frame_page(blob))
         await self.file.sync()
         for page_id, node in self._dirty.items():
             if isinstance(node, _Node):
@@ -440,6 +480,12 @@ class KVStoreBTree(IKeyValueStore):
                 continue
             body, crc = blob[:20], blob[20:24]
             if zlib.crc32(body) != int.from_bytes(crc, "little"):
+                # The double-slot protocol's whole point: a torn or rotted
+                # header slot is DETECTED here and the other (older but
+                # intact) slot wins — never a half-written root.
+                test_coverage("BTreeSlotCrcCaught")
+                TraceEvent("BTreeHeaderSlotCorrupt", Severity.Warn).detail(
+                    "File", self.file.name).detail("Slot", slot).log()
                 continue
             r = Reader(body)
             if r.u32() != _MAGIC:

@@ -13,8 +13,11 @@ so a failing seed replays identically.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
 
+from ..core.buggify import buggify
+from ..core.coverage import test_coverage
 from ..core.error import err
 from ..core.rng import deterministic_random
 from ..core.scheduler import delay
@@ -22,6 +25,48 @@ from ..core.trace import Severity, TraceEvent
 
 _SIM_WRITE_LATENCY = 0.0002
 _SIM_SYNC_LATENCY = 0.0005
+
+
+@dataclass
+class DiskFaultProfile:
+    """Live disk-fault behavior of one simulated file (reference
+    AsyncFileNonDurable's fault injection + diskFailureInjector): each IO
+    op independently draws from the deterministic RNG, so a failing seed
+    replays its exact fault sequence.
+
+    - io_error_*_p: probability the op raises io_error (process-fatal in
+      the roles above us — that conversion is what the chaos tests prove).
+    - latency_spike_p/s: probability an op stalls for `latency_spike_s`
+      (a slow disk, not a dead one).
+    - bitrot_sync_p: probability a SUCCESSFUL sync then flips one bit in
+      the durable image — corruption the next reader must catch via its
+      checksums, never serve.
+    - max_io_errors: budget of io_errors to inject (bit-rot/latency not
+      counted); lets a test inject exactly one fatal fault and then let
+      the restarted process recover against a healthy disk.
+    """
+
+    io_error_read_p: float = 0.0
+    io_error_write_p: float = 0.0
+    io_error_sync_p: float = 0.0
+    latency_spike_p: float = 0.0
+    latency_spike_s: float = 0.05
+    bitrot_sync_p: float = 0.0
+    max_io_errors: int = 1 << 30
+
+    @classmethod
+    def from_knobs(cls) -> "DiskFaultProfile":
+        """The AMBIENT profile BUGGIFY attaches to fresh files: latency
+        spikes only.  io_error and bit-rot are process-fatal once
+        detected, so ambient injection would slowly and permanently
+        shrink any cluster whose harness doesn't restart dead processes
+        — those faults are injected deliberately (explicit profiles from
+        chaos tests / the nemesis) against topologies that can absorb
+        them."""
+        from ..core.knobs import server_knobs
+        k = server_knobs()
+        return cls(latency_spike_p=k.SIM_DISK_LATENCY_SPIKE_P,
+                   latency_spike_s=k.SIM_DISK_LATENCY_SPIKE_S)
 
 
 class SimFile:
@@ -36,10 +81,67 @@ class SimFile:
         # Each op is ("w", offset, data) or ("t", size, b"").
         self.pending: List[Tuple[str, int, bytes]] = []
         self.open = True
+        # Live fault injection (None = healthy disk; see DiskFaultProfile).
+        self.faults: Optional[DiskFaultProfile] = None
+        self.io_errors_injected = 0
+
+    # -- fault injection ------------------------------------------------------
+    def _should_io_error(self, kind: str) -> bool:
+        """One shared predicate for every op kind: profile probability
+        gated by the remaining io_error budget, drawn from the
+        deterministic RNG ONLY when a profile is attached (fault-free
+        runs keep their historical draw sequence).  Synchronous on
+        purpose: the read path may be driven without an event loop."""
+        f = self.faults
+        if f is None:
+            return False
+        p = getattr(f, f"io_error_{kind}_p")
+        if not p or self.io_errors_injected >= f.max_io_errors:
+            return False
+        if deterministic_random().random01() >= p:
+            return False
+        self.io_errors_injected += 1
+        return True
+
+    async def _fault_point(self, kind: str) -> None:
+        """Evaluate this file's fault profile + the global BUGGIFY sites
+        for one async op kind ("write"/"sync")."""
+        f = self.faults
+        if f is not None and f.latency_spike_p and \
+                deterministic_random().random01() < f.latency_spike_p:
+            await delay(f.latency_spike_s)
+        if self._should_io_error(kind):
+            self._raise_io_error(kind)
+        if buggify("sim_fs.slowDisk"):
+            await delay(_SIM_SYNC_LATENCY * 20)
+
+    def _raise_io_error(self, kind: str) -> None:
+        test_coverage("SimDiskIoErrorInjected")
+        TraceEvent("SimDiskIoError", Severity.Warn).detail(
+            "File", self.name).detail("Op", kind).log()
+        raise err("io_error", f"injected {kind} error on {self.name}")
+
+    def _maybe_bitrot(self) -> None:
+        """Post-sync bit-rot: flip one bit somewhere in the durable image
+        (reference AsyncFileNonDurable corruption + latent sector errors).
+        The damage lands AFTER durability was acknowledged — exactly the
+        fault only end-to-end checksums can catch."""
+        f = self.faults
+        if f is None or not f.bitrot_sync_p or not self.durable:
+            return
+        rng = deterministic_random()
+        if rng.random01() >= f.bitrot_sync_p:
+            return
+        i = rng.random_int(0, len(self.durable))
+        self.durable[i] ^= 1 << rng.random_int(0, 8)
+        test_coverage("SimDiskBitRotInjected")
+        TraceEvent("SimDiskBitRot", Severity.Warn).detail(
+            "File", self.name).detail("Offset", i).log()
 
     # -- IAsyncFile surface --------------------------------------------------
     async def write(self, offset: int, data: bytes) -> None:
         self._check_open()
+        await self._fault_point("write")
         await delay(_SIM_WRITE_LATENCY)
         self.pending.append(("w", offset, bytes(data)))
 
@@ -49,12 +151,19 @@ class SimFile:
 
     async def sync(self) -> None:
         self._check_open()
+        await self._fault_point("sync")
         await delay(_SIM_SYNC_LATENCY)
         self._apply_pending()
+        self._maybe_bitrot()
 
     async def read(self, offset: int, length: int) -> bytes:
-        """Reads see the would-be-synced view (OS page cache semantics)."""
+        """Reads see the would-be-synced view (OS page cache semantics).
+        Read faults are raise-only (no latency spikes): engine read paths
+        legitimately drive this coroutine synchronously (kvstore_btree
+        _sync) and must never block on the event loop."""
         self._check_open()
+        if self._should_io_error("read"):
+            self._raise_io_error("read")
         img = self._cache_view()
         return bytes(img[offset:offset + length])
 
@@ -128,6 +237,24 @@ class SimFileSystem:
 
     def __init__(self) -> None:
         self.files: Dict[str, SimFile] = {}
+        # Fault profiles applied to files by name substring ("" matches
+        # everything), covering files opened before AND after the call.
+        self._fault_rules: List[Tuple[str, Optional[DiskFaultProfile]]] = []
+
+    def set_fault_profile(self, name_substr: str,
+                          profile: Optional[DiskFaultProfile]) -> None:
+        """Attach `profile` to every file whose name contains
+        `name_substr` (None detaches).  Targeted chaos: a test can rot
+        exactly one machine's btree file while its WAL stays healthy."""
+        self._fault_rules.append((name_substr, profile))
+        for name, f in self.files.items():
+            if name_substr in name:
+                f.faults = profile
+
+    def clear_fault_profiles(self) -> None:
+        self._fault_rules.clear()
+        for f in self.files.values():
+            f.faults = None
 
     def open(self, name: str, create: bool = True) -> SimFile:
         f = self.files.get(name)
@@ -135,6 +262,14 @@ class SimFileSystem:
             if not create:
                 raise err("operation_failed", f"no such file {name}")
             f = self.files[name] = SimFile(name)
+            # BUGGIFY'd ambient faults (reference diskFailureInjector):
+            # when the site is active this run, fresh files get the
+            # knob-magnitude profile.  Explicit rules below override.
+            if buggify("sim_fs.fault_profile"):
+                f.faults = DiskFaultProfile.from_knobs()
+            for substr, profile in self._fault_rules:
+                if substr in name:
+                    f.faults = profile
         f.open = True
         return f
 

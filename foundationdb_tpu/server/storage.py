@@ -406,6 +406,13 @@ class StorageServer:
                 # a later power-fail then loses.  An engine commit error
                 # is process-fatal (reference: io_error kills fdbserver),
                 # so failure monitors fire and DD re-replicates.
+                from ..core.error import FdbError
+                if isinstance(e, FdbError) and e.name == "io_error":
+                    # Injected/real disk fault caught on the durability
+                    # path: death + re-recruitment is the contract the
+                    # chaos tests verify (coverage ledger, ISSUE 4).
+                    from ..core.coverage import test_coverage
+                    test_coverage("StorageIoErrorDeath")
                 TraceEvent("SSUpdateStorageError", Severity.Error).detail(
                     "Id", self.id).detail("Error", repr(e)).log()
                 if self._process is not None and \

@@ -19,7 +19,7 @@ from ..core.buggify import buggify
 from ..core.futures import Promise
 from ..core.knobs import server_knobs
 from ..core.scheduler import delay, get_event_loop, now
-from ..core.trace import TraceEvent
+from ..core.trace import Severity, TraceEvent
 from ..core.wire import Reader, Writer
 from ..txn.types import Mutation, MutationType, Version
 from .disk_queue import DiskQueue
@@ -323,7 +323,17 @@ class TLog:
                     # commit windows (reference BUGGIFY in doQueueCommit).
                     await delay(0.05)
                 if self.disk_queue is not None:
-                    await self.disk_queue.commit()
+                    try:
+                        await self.disk_queue.commit()
+                    except Exception as e:  # noqa: BLE001
+                        # A WAL that cannot fsync must not keep acking:
+                        # durable_version would freeze while commits hang
+                        # forever.  io_error is process-fatal (reference
+                        # KeyValueStoreSQLite/DiskQueue io_error handling)
+                        # — die loudly; recovery recruits a replacement
+                        # and recovers this generation from its peers.
+                        self._die_on_disk_error("commit", e)
+                        return
                 else:
                     await delay(_SIM_FSYNC_SECONDS)
                 self.durable_version.set(target)
@@ -333,6 +343,22 @@ class TLog:
             self._sync_running = False
 
         get_event_loop().spawn(sync(), f"{self.id}.queueCommit")
+
+    def _die_on_disk_error(self, op: str, e: Exception) -> None:
+        """Disk fault -> process death (never limp along on a bad disk,
+        never serve corrupt data; reference: io_error kills fdbserver and
+        the CC re-recruits).  Old-generation TLogs reconstructed by
+        from_disk may not be running as a role yet — then the error
+        re-raises to the caller instead (it must surface either way)."""
+        from ..core.coverage import test_coverage
+        test_coverage("TLogIoErrorDeath")
+        TraceEvent("TLogDiskError", Severity.Error).detail(
+            "Id", self.id).detail("Op", op).detail("Error", repr(e)).log()
+        proc = getattr(self, "_process", None)
+        if proc is not None and hasattr(proc, "die"):
+            proc.die(f"TLogDiskError:{op}:{e!r}")
+        else:
+            raise e
 
     # -- spill-by-reference (reference TLogData spill fields :293) -----------
     def _maybe_spill(self) -> None:
@@ -427,7 +453,15 @@ class TLog:
             if sent_bytes >= budget:
                 cut = v
                 break
-            blob = await self.disk_queue.read_payload(seq)
+            try:
+                blob = await self.disk_queue.read_payload(seq)
+            except Exception as e:  # noqa: BLE001
+                # CRC failure / injected io_error on the spilled read:
+                # the one thing we may NOT do is skip the record and let
+                # the puller advance past it (silent data loss), or hand
+                # it garbage.  Die; the puller re-peeks a healthy peer.
+                self._die_on_disk_error("peek", e)
+                return
             if blob is None:
                 continue     # popped concurrently with this peek
             _v, _p, _k, _pop, messages = _unpack_commit(blob)

@@ -91,7 +91,13 @@ class TenantTransaction:
 
     def _pack_end(self, end: bytes) -> bytes:
         """Range ends may be b"\xff" (the whole tenant): clamp to the
-        prefix's upper bound."""
+        prefix's upper bound.  Same bytes-type audit as _pack: a str end
+        must raise here, not coerce into a wrong (usually empty) range
+        (ROADMAP nit from PR 3's review)."""
+        if not isinstance(end, (bytes, bytearray, memoryview)):
+            raise err("client_invalid_operation",
+                      f"tenant range end must be bytes, "
+                      f"not {type(end).__name__}")
         end = bytes(end)
         if end > b"\xff":
             raise err("key_outside_legal_range")

@@ -84,8 +84,18 @@ def tenant_quota_key(name: bytes) -> bytes:
 
 def tenant_tag(name: bytes) -> str:
     """The throttle tag tenant transactions carry (GRV + storage reads):
-    per-tenant metering and quotas ride the existing tag machinery."""
-    return "t/" + name.decode("utf-8", "backslashreplace")
+    per-tenant metering and quotas ride the existing tag machinery.
+
+    The byte->str encoding must be LOSSLESS AND INJECTIVE: the old
+    backslashreplace decoding mapped e.g. b"a\\xff" and b"a\\\\xff" to the
+    same tag, cross-wiring two tenants' quotas and metering (ROADMAP nit
+    from PR 3's review).  Printable ASCII passes through unchanged (tags
+    stay human-readable in status/fdbcli); backslash and everything
+    non-printable escape to \\xNN — backslash itself always escapes, so
+    no unescaped name can collide with an escaped one."""
+    return "t/" + "".join(
+        chr(b) if 0x20 <= b < 0x7F and b != 0x5C else f"\\x{b:02x}"
+        for b in name)
 
 
 def parse_tenant_mutation(

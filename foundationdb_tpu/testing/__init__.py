@@ -4,4 +4,5 @@ Reference layer: fdbserver/workloads/ + fdbserver/tester.actor.cpp +
 tests/*.toml (SURVEY.md §4)."""
 
 from .workload import TestWorkload, register_workload, workload_registry  # noqa: F401
-from .tester import run_test, load_spec  # noqa: F401
+from .tester import (NondeterminismAudit, SimRunReport, load_spec,  # noqa: F401
+                     run_simulation, run_test, run_test_twice)

@@ -131,6 +131,205 @@ async def quiet_database(cluster, db, timeout: float = 60.0) -> None:
     raise FdbError(1004, "timed_out", "quiet_database timed out")
 
 
+class NondeterminismAudit:
+    """Runtime detector of nondeterminism sources under simulation
+    (reference: the simulator's whole contract is that NOTHING reads the
+    outside world).  While installed, wall-clock and OS-entropy entry
+    points are wrapped to record any caller that lives inside THIS
+    package (third-party/test callers are someone else's business).
+    Findings are (function, file, line) tuples.
+
+    Allowlisted modules hold the framework's sanctioned escape hatches:
+    core/rng.py seeds the nondeterministic id generator from os.urandom
+    by design; core/scheduler.py reads the monotonic clock for its
+    real-mode epoch; threadpool/profiler/real_* are real-mode only."""
+
+    PATCHES = (("time", "time"), ("time", "time_ns"),
+               ("time", "monotonic"), ("time", "perf_counter"),
+               ("os", "urandom"), ("random", "random"),
+               ("random", "randrange"), ("random", "getrandbits"))
+    ALLOWED_FILES = ("core/rng.py", "core/scheduler.py",
+                     "core/threadpool.py", "core/profiler.py",
+                     "rpc/real_network.py", "server/real_fs.py")
+
+    def __init__(self) -> None:
+        import os as _os
+        self.findings: List[tuple] = []
+        self._saved: List[tuple] = []
+        pkg_dir = _os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__)))
+        self._pkg_prefix = pkg_dir + _os.sep
+
+    def _record(self, func_name: str) -> None:
+        import sys
+        frame = sys._getframe(2)
+        fn = frame.f_code.co_filename
+        if not fn.startswith(self._pkg_prefix):
+            return
+        rel = fn[len(self._pkg_prefix):].replace("\\", "/")
+        if rel.endswith(self.ALLOWED_FILES):
+            return
+        entry = (func_name, rel, frame.f_lineno)
+        if entry not in self.findings:
+            self.findings.append(entry)
+
+    def __enter__(self) -> "NondeterminismAudit":
+        import importlib
+        for mod_name, attr in self.PATCHES:
+            mod = importlib.import_module(mod_name)
+            orig = getattr(mod, attr)
+
+            def make(orig=orig, label=f"{mod_name}.{attr}"):
+                def wrapped(*a, **kw):
+                    self._record(label)
+                    return orig(*a, **kw)
+                return wrapped
+            self._saved.append((mod, attr, orig))
+            setattr(mod, attr, make())
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for mod, attr, orig in self._saved:
+            setattr(mod, attr, orig)
+        self._saved.clear()
+
+
+class SimRunReport:
+    """Everything one deterministic simulation run leaves behind."""
+
+    def __init__(self, seed: int, metrics, unseed: int, digest: int,
+                 folds: int, checkpoints, nondeterminism) -> None:
+        self.seed = seed
+        self.metrics = metrics
+        self.unseed = unseed
+        self.digest = digest
+        self.folds = folds
+        self.checkpoints = list(checkpoints)
+        self.nondeterminism = nondeterminism
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"SimRunReport(seed={self.seed}, unseed={self.unseed:#010x},"
+                f" digest={self.digest:#010x}, folds={self.folds})")
+
+
+def run_simulation(spec, seed: int, *, buggify: bool = False,
+                   config=None, n_workers: int = 7,
+                   n_storage_workers: int = 2, timeout: float = 1800.0,
+                   audit: bool = True) -> SimRunReport:
+    """One fully-seeded simulation run of a test spec on a fresh world:
+    fresh deterministic RNG, fresh run digest, fresh event loop +
+    SimFdbCluster — and a SimRunReport carrying the run's unseed.
+
+    Cyclic GC is disabled for the run's duration (after a full collect):
+    gc timing depends on allocation counters carried over from PREVIOUS
+    work in this process, so a gc pass firing __del__-driven broken-
+    promise delivery mid-run would make two otherwise identical runs
+    diverge.  Plain refcount-driven finalization is deterministic and
+    stays on."""
+    import gc
+    from ..core.buggify import enable_buggify
+    from ..core.rng import (DeterministicRandom, reset_run_digest,
+                            run_unseed, set_deterministic_random)
+    from ..core.scheduler import set_event_loop
+    from ..rpc.sim import set_simulator
+    from ..server.cluster import SimFdbCluster
+    from ..server.interfaces import DatabaseConfiguration
+
+    spec = load_spec(spec) if isinstance(spec, str) else spec
+    gc_was_enabled = gc.isenabled()
+    gc.collect()
+    gc.disable()
+    set_deterministic_random(DeterministicRandom(seed))
+    digest = reset_run_digest()
+    enable_buggify(buggify)
+    auditor = NondeterminismAudit() if audit else None
+    try:
+        if auditor is not None:
+            auditor.__enter__()
+        try:
+            cluster = SimFdbCluster(
+                config=config or DatabaseConfiguration(
+                    n_tlogs=2, log_replication=2, n_storage=2,
+                    storage_replication=2),
+                n_workers=n_workers, n_storage_workers=n_storage_workers)
+
+            async def go():
+                return await run_test(cluster, spec)
+
+            metrics = cluster.run_until(cluster.loop.spawn(go()),
+                                        timeout=timeout)
+        finally:
+            if auditor is not None:
+                auditor.__exit__()
+        return SimRunReport(
+            seed=seed, metrics=metrics, unseed=run_unseed(),
+            digest=digest.value, folds=digest.folds,
+            checkpoints=digest.checkpoints,
+            nondeterminism=auditor.findings if auditor else [])
+    finally:
+        enable_buggify(False)
+        set_simulator(None)
+        set_event_loop(None)
+        if gc_was_enabled:
+            gc.enable()
+
+
+def _divergence_report(r1: SimRunReport, r2: SimRunReport,
+                       tail: int = 8) -> str:
+    """First-divergence triage between two same-seed runs: align the
+    periodic digest checkpoints, find the first disagreeing one, and
+    show the last `tail` checkpoints around it from both runs."""
+    lines = [
+        f"unseed mismatch for seed {r1.seed}: "
+        f"{r1.unseed:#010x} != {r2.unseed:#010x} "
+        f"(digest {r1.digest:#010x} vs {r2.digest:#010x}, "
+        f"folds {r1.folds} vs {r2.folds})"]
+    c1, c2 = r1.checkpoints, r2.checkpoints
+    first = None
+    for i in range(min(len(c1), len(c2))):
+        if c1[i] != c2[i]:
+            first = i
+            break
+    if first is None and len(c1) != len(c2):
+        first = min(len(c1), len(c2))
+    if first is None:
+        lines.append("checkpoints identical — divergence after the last "
+                     "checkpoint (tail of the run)")
+    else:
+        lines.append(f"first divergent checkpoint: #{first} "
+                     f"(~fold {(first + 1) * 1024})")
+        lo = max(0, first - tail // 2)
+        for run_name, cps in (("run1", c1), ("run2", c2)):
+            lines.append(f"  {run_name} checkpoints "
+                         f"[{lo}..{min(first + tail // 2, len(cps) - 1)}]:")
+            for j in range(lo, min(first + tail // 2 + 1, len(cps))):
+                folds, value, last_event, t = cps[j]
+                marker = " <-- FIRST DIVERGENCE" if j == first else ""
+                lines.append(f"    #{j} folds={folds} "
+                             f"digest={value:#010x} t={t:.6f} "
+                             f"last_event={last_event!r}{marker}")
+    for run_name, r in (("run1", r1), ("run2", r2)):
+        if r.nondeterminism:
+            lines.append(f"  {run_name} nondeterminism sources flagged:")
+            for func, file, lineno in r.nondeterminism:
+                lines.append(f"    {func} called from {file}:{lineno}")
+    return "\n".join(lines)
+
+
+def run_test_twice(spec, seed: int, **kw):
+    """Replay the identical (spec, seed) twice and assert unseed
+    equality (reference TestHarness unseed check: same seed, same run —
+    bit for bit).  On divergence, raises AssertionError carrying a
+    first-divergence report over the digest checkpoint trail plus any
+    nondeterminism sources the audit flagged.  Returns both reports."""
+    r1 = run_simulation(spec, seed, **kw)
+    r2 = run_simulation(spec, seed, **kw)
+    if r1.unseed != r2.unseed or r1.digest != r2.digest or \
+            r1.folds != r2.folds:
+        raise AssertionError(_divergence_report(r1, r2))
+    return r1, r2
+
+
 async def run_test(cluster, spec: Dict[str, Any],
                    db=None) -> Dict[str, Dict[str, float]]:
     """Run one [[test]] entry; returns {workload: metrics}.  Raises

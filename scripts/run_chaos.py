@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Chaos seed-matrix runner: N seeds x spec list through the
+deterministic chaos engine, with optional unseed verification.
+
+Reference: contrib/TestHarness — run many (spec, seed, buggify) tuples,
+triage failures, and hand back an exact repro line.  Unlike
+run_ensemble.py this runner (a) uses testing.run_simulation, so every
+run carries its unseed (the determinism witness), (b) can double-run
+each tuple and fail on unseed mismatch (--verify-unseed), and (c) emits
+a machine-readable JSON summary with a copy-pastable repro command per
+failure.
+
+    python scripts/run_chaos.py --seeds 5
+    python scripts/run_chaos.py --spec tests/specs/ChaosTest.toml --seed 17
+    python scripts/run_chaos.py --seeds 3 --verify-unseed --json out.json
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_SPECS = ("ChaosTest.toml", "CycleTest.toml", "TenantTest.toml")
+
+
+def repro_command(spec_path: str, seed: int, buggify: bool,
+                  verify: bool) -> str:
+    cmd = (f"python scripts/run_chaos.py --spec {spec_path} "
+           f"--seed {seed}")
+    if not buggify:
+        cmd += " --no-buggify"
+    if verify:
+        cmd += " --verify-unseed"
+    return cmd
+
+
+def run_tuple(spec_path: str, seed: int, buggify: bool,
+              verify_unseed: bool) -> dict:
+    """One (spec, seed, buggify) run; returns a result record.  With
+    verify_unseed the tuple runs TWICE and an unseed mismatch is a
+    failure in its own right (kind 'nondeterminism')."""
+    from foundationdb_tpu.testing import run_simulation, run_test_twice
+    spec_text = open(spec_path).read()
+    t0 = time.time()
+    rec = {"spec": os.path.basename(spec_path), "seed": seed,
+           "buggify": buggify, "ok": False}
+    try:
+        if verify_unseed:
+            r1, _r2 = run_test_twice(spec_text, seed, buggify=buggify)
+        else:
+            r1 = run_simulation(spec_text, seed, buggify=buggify)
+        rec.update(ok=True, unseed=r1.unseed, folds=r1.folds,
+                   metrics=r1.metrics,
+                   nondeterminism=r1.nondeterminism)
+    except AssertionError as e:
+        kind = ("nondeterminism" if "unseed mismatch" in str(e)
+                else "check_failed")
+        rec.update(kind=kind, error=str(e))
+    except (KeyboardInterrupt, SystemExit):
+        raise                    # ^C must abort the matrix, not log a tuple
+    except BaseException as e:  # noqa: BLE001 - triage, don't crash
+        rec.update(kind="error", error=f"{type(e).__name__}: {e}",
+                   traceback=traceback.format_exc())
+    rec["seconds"] = round(time.time() - t0, 1)
+    if not rec["ok"]:
+        rec["repro"] = repro_command(spec_path, seed, buggify,
+                                     verify_unseed)
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--specs", default=None,
+                    help="directory of .toml specs (default: the chaos "
+                         f"trio {DEFAULT_SPECS} under tests/specs)")
+    ap.add_argument("--spec", default=None, help="run one spec file only")
+    ap.add_argument("--seeds", type=int, default=3,
+                    help="seeds per spec (default 3)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="run one seed only (repro mode)")
+    ap.add_argument("--first-seed", type=int, default=100)
+    ap.add_argument("--no-buggify", action="store_true")
+    ap.add_argument("--verify-unseed", action="store_true",
+                    help="run every tuple twice; unseed mismatch fails")
+    ap.add_argument("--json", default=None,
+                    help="write the JSON summary here (default stdout)")
+    args = ap.parse_args()
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.spec:
+        specs = [args.spec]
+    elif args.specs:
+        specs = sorted(glob.glob(os.path.join(args.specs, "*.toml")))
+    else:
+        specs = [os.path.join(here, "tests", "specs", name)
+                 for name in DEFAULT_SPECS]
+    seeds = [args.seed] if args.seed is not None else \
+        [args.first_seed + i for i in range(args.seeds)]
+
+    results = []
+    for spec_path in specs:
+        for seed in seeds:
+            buggify = (not args.no_buggify) and seed % 2 == 0
+            rec = run_tuple(spec_path, seed, buggify, args.verify_unseed)
+            status = "PASS" if rec["ok"] else f"FAIL({rec.get('kind')})"
+            print(f"{status} {rec['spec']} seed={seed} buggify={buggify} "
+                  f"({rec['seconds']}s)"
+                  + (f" unseed={rec['unseed']:#010x}" if rec["ok"] else ""))
+            results.append(rec)
+
+    from foundationdb_tpu.core.coverage import missing, report
+    failures = [r for r in results if not r["ok"]]
+    summary = {
+        "total": len(results),
+        "passed": len(results) - len(failures),
+        "failures": failures,
+        "coverage_hit": sorted(k for k, v in report().items() if v),
+        "coverage_missing": missing(),
+    }
+    out = json.dumps(summary, indent=2, default=str)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(out + "\n")
+        print(f"summary written to {args.json}")
+    else:
+        print(out)
+    for r in failures:
+        print(f"REPRO: {r['repro']}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

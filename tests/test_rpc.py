@@ -220,3 +220,51 @@ def test_serde_schema_evolution():
     serde.encode_value(w, EvolveMsgV2(a=5, b=b"z", added_in_v2="live"))
     got = serde.decode_value(Reader(w.done()))
     assert got == EvolveMsgV2(5, b"z", "live")
+
+
+def test_unclog_releases_inflight_messages(loop, sim):
+    """Interface clogs are re-evaluated at DELIVERY time (ISSUE 4
+    swizzle): a message captured mid-clog is released shortly after
+    unclog_process, not held until the original clog expiry."""
+    server = sim.new_process(name="server")
+    client = sim.new_process(name="client")
+    rs = start_echo_server(server)
+
+    async def go():
+        sim.clog_process(server, seconds=30.0)
+        t0 = loop.now()
+        reply = RequestStreamStub(rs.endpoint).get_reply(
+            EchoRequest(4), client.address)
+        from foundationdb_tpu.core import delay
+        await delay(1.0)
+        assert not reply.is_ready()         # held by the clog
+        sim.unclog_process(server)
+        assert await reply == 8
+        # Released within the bounded re-check hop, not at t0 + 30.
+        assert loop.now() - t0 < 2.0
+        return True
+
+    assert loop.run_until(loop.spawn(go()), timeout=60)
+
+
+def test_clog_extension_keeps_holding(loop, sim):
+    """The converse: extending an interface clog AFTER a send keeps the
+    in-flight message held past its original expiry."""
+    server = sim.new_process(name="server")
+    client = sim.new_process(name="client")
+    rs = start_echo_server(server)
+
+    async def go():
+        from foundationdb_tpu.core import delay
+        sim.clog_process(server, seconds=1.0)
+        t0 = loop.now()
+        reply = RequestStreamStub(rs.endpoint).get_reply(
+            EchoRequest(3), client.address)
+        sim.clog_process(server, seconds=5.0)   # extend before delivery
+        await delay(2.0)
+        assert not reply.is_ready()
+        assert await reply == 6
+        assert loop.now() - t0 >= 5.0
+        return True
+
+    assert loop.run_until(loop.spawn(go()), timeout=60)

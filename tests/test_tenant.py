@@ -338,3 +338,52 @@ def test_special_keyspace_tenant_map(teardown):  # noqa: F811
         return True
 
     assert run(c, go())
+
+
+def test_tenant_tag_lossless_no_collisions():
+    """ISSUE 4 satellite (PR 3 review nit): tenant_tag must be injective
+    over byte names.  The old backslashreplace decoding collapsed
+    b"a\\xff" and the literal bytes br"a\\xff" onto one throttle tag,
+    cross-wiring two tenants' quotas and metering."""
+    from foundationdb_tpu.tenant.map import tenant_tag
+    colliding = [
+        (b"a\xff", b"a\\xff"),            # the review's exact pair
+        (b"\xfe\xff", b"\\xfe\\xff"),     # every byte escaped
+        (b"hot\x80", b"hot\\x80"),        # lone continuation byte
+    ]
+    for left, right in colliding:
+        assert left.decode("utf-8", "backslashreplace") == \
+            right.decode("utf-8", "backslashreplace"), \
+            "pair no longer collides under the OLD encoding; update test"
+        assert tenant_tag(left) != tenant_tag(right)
+    # Injective across a broad sample of distinct names.
+    names = [bytes([a, b]) for a in (0, 0x5C, 0x61, 0xFF)
+             for b in (0, 0x5C, 0x62, 0xFE)] + [b"plain", b"pla\\in"]
+    tags = {tenant_tag(n) for n in names}
+    assert len(tags) == len(names)
+    # Printable names stay human-readable (status/fdbcli display).
+    assert tenant_tag(b"acme-prod") == "t/acme-prod"
+
+
+def test_tenant_pack_end_type_audit():
+    """ISSUE 4 satellite (PR 3 review nit): a non-bytes range END must
+    raise like a non-bytes key does — not silently coerce into a wrong
+    (usually empty) range."""
+    from types import SimpleNamespace
+    from foundationdb_tpu.core import FdbError
+    from foundationdb_tpu.tenant.handle import Tenant, TenantTransaction
+    from foundationdb_tpu.tenant.map import TenantMapEntry
+    tenant = Tenant(db=SimpleNamespace(create_transaction=lambda: None),
+                    entry=TenantMapEntry(id=7, name=b"t7"))
+    txn = TenantTransaction(SimpleNamespace(), tenant)
+    with pytest.raises(FdbError) as ei:
+        txn._pack_end("\xff")            # str, the silent-coercion case
+    assert ei.value.name == "client_invalid_operation"
+    with pytest.raises(FdbError):
+        txn._pack_end(3)
+    # bytes-like ends still work, including the whole-tenant sentinel.
+    from foundationdb_tpu.txn.types import strinc
+    assert txn._pack_end(b"\xff") == strinc(tenant.prefix)
+    assert txn._pack_end(bytearray(b"zz")) == tenant.prefix + b"zz"
+    with pytest.raises(FdbError):
+        txn._pack(None)                  # _pack audit still intact
