@@ -1,0 +1,32 @@
+"""flowlint: repo-wide static analysis for actor, determinism, and
+key-type hazards.
+
+The reference's actor compiler enforces a whole class of rules at
+COMPILE time (no ``this`` after ``wait()``, no uninitialized ``state``,
+no stray returns); this package is our Python analog: an AST pass over
+the package that pins down hazards the runtime machinery can only catch
+per-seed (testing/tester.py NondeterminismAudit sees the code paths one
+seed happens to execute — flowlint sees every line).
+
+Layout:
+  engine.py -- rule-engine core: one visitor pass per file, pluggable
+               Rule classes, per-line ``# flowlint: disable=FTL0NN``
+               suppressions, committed-baseline support, text + JSON
+               output, stable exit codes.
+  rules.py  -- the shipped rules (FTL001..FTL008), each grounded in a
+               bug class this repo has actually hit.
+
+Entry points: ``scripts/flowlint.py`` (CLI; scripts/run_chaos.py shells
+its ``--format json`` output to link static findings into chaos
+summaries), ``run_flowlint()`` (programmatic), and the shim kept at
+``scripts/check_trace_events.py`` (FTL007's old standalone home).
+"""
+
+from .engine import (Analyzer, Finding, LintResult, Rule, format_text,
+                     load_baseline, run_flowlint, write_baseline)
+from .rules import make_rules
+
+__all__ = [
+    "Analyzer", "Finding", "LintResult", "Rule", "format_text",
+    "load_baseline", "make_rules", "run_flowlint", "write_baseline",
+]

@@ -1,0 +1,345 @@
+"""flowlint rule-engine core.
+
+One AST pass per file: the Analyzer parses each ``.py`` once, builds a
+parent map + import-alias tables, and walks every node exactly once,
+dispatching to each registered Rule's ``visit``.  Rules are stateless
+between files except through their own attributes (cross-file rules use
+``finish`` — see FTL007's schema comparison).
+
+Suppression syntax (both forms take a comma list or ``all``):
+
+  x = time.time()        # flowlint: disable=FTL001  -- <why>
+  # flowlint: disable-file=FTL005  -- <why>          (anywhere in file)
+
+Baseline: a committed JSON list of ``{"rule", "path", "message"}``
+entries (no line numbers — findings must survive unrelated edits).
+Matching consumes entries with multiplicity; anything not covered is a
+NEW finding.  Exit codes (CLI): 0 clean / all-baselined, 1 new
+findings, 2 internal error.  Unparseable files are reported as FTL000,
+never silently skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+_SUPPRESS_LINE = re.compile(r"#\s*flowlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE = re.compile(r"#\s*flowlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+class Finding:
+    """One violation.  Identity for baseline purposes is (rule, path,
+    message) — deliberately line-free, so a baselined finding does not
+    resurface when unrelated lines shift."""
+
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule: str, path: str, line: int, message: str) -> None:
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.message = message
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def sort_key(self):
+        return (self.path, self.line, self.rule, self.message)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Finding({self.rule}, {self.path}:{self.line})"
+
+
+class Rule:
+    """Base class.  Subclasses set ``id`` (FTL0NN) and ``title`` and
+    override any of the four hooks.  ``visit`` is called for EVERY node
+    of every scanned file (one shared walk — a rule must not walk the
+    tree itself); per-file state belongs in ``begin_file``."""
+
+    id = "FTL000"
+    title = "base rule"
+
+    def begin_file(self, ctx: "FileContext") -> None:  # noqa: B027
+        pass
+
+    def visit(self, node: ast.AST, ctx: "FileContext") -> None:  # noqa: B027
+        pass
+
+    def end_file(self, ctx: "FileContext") -> None:  # noqa: B027
+        pass
+
+    def finish(self, report: Callable[[Finding], None]) -> None:  # noqa: B027
+        """Cross-file checks, called once after every file was walked."""
+        pass
+
+
+class FileContext:
+    """Per-file state shared by all rules during the single walk."""
+
+    def __init__(self, path: str, tree: ast.Module, source: str) -> None:
+        self.path = path                # root-relative, '/'-separated
+        self.tree = tree
+        self.source = source
+        self.findings: List[Finding] = []
+        # Suppression tables, visible to rules DURING the walk: a
+        # cross-file rule (FTL007) must drop suppressed callsites from
+        # its own state, or its finish()-time findings would bypass the
+        # suppression mechanism entirely.
+        self.suppress_line, self.suppress_file = _suppressions(source)
+        # Lexical stacks maintained by the Analyzer's walk.
+        self.func_stack: List[ast.AST] = []
+        self.class_stack: List[ast.ClassDef] = []
+        # Parent map: id(child) -> parent node (one pre-pass).
+        self._parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+        # Import alias tables (collected file-wide, including imports
+        # inside function bodies — the codebase uses `import time as
+        # _time` at both levels): alias -> module for `import m [as a]`,
+        # local name -> "module.orig" for `from m import orig [as a]`.
+        self.aliases: Dict[str, str] = {}
+        self.from_imports: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name != "*":
+                        self.from_imports[a.asname or a.name] = \
+                            f"{node.module}.{a.name}"
+
+    # -- helpers for rules ---------------------------------------------------
+    @property
+    def in_async(self) -> bool:
+        """True when the CLOSEST enclosing function is an actor
+        (``async def``); a sync helper nested in an actor is not 'in'
+        the actor for lexical-rule purposes."""
+        return bool(self.func_stack) and \
+            isinstance(self.func_stack[-1], ast.AsyncFunctionDef)
+
+    @property
+    def at_module_level(self) -> bool:
+        return not self.func_stack and not self.class_stack
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        ids = self.suppress_line.get(line, set()) | self.suppress_file
+        return rule_id in ids or "all" in ids
+
+    def resolve_call(self, func: ast.expr) -> Optional[str]:
+        """Dotted name of a call target through import aliases:
+        ``_time.monotonic(...)`` -> 'time.monotonic',
+        ``monotonic(...)`` after `from time import monotonic` ->
+        'time.monotonic', bare builtins -> their own name."""
+        if isinstance(func, ast.Name):
+            return self.from_imports.get(func.id, func.id)
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            mod = self.aliases.get(func.value.id)
+            if mod is not None:
+                return f"{mod}.{func.attr}"
+        return None
+
+    def report(self, rule: Rule, where, message: str) -> None:
+        line = where if isinstance(where, int) else \
+            getattr(where, "lineno", 0)
+        self.findings.append(Finding(rule.id, self.path, line, message))
+
+
+def _suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(per-line suppressed ids, file-wide suppressed ids).  'all' in a
+    set suppresses every rule."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    for lineno, text in enumerate(source.splitlines(), 1):
+        m = _SUPPRESS_FILE.search(text)
+        if m:
+            file_wide.update(
+                t.strip() for t in m.group(1).split(",") if t.strip())
+            continue
+        m = _SUPPRESS_LINE.search(text)
+        if m:
+            per_line.setdefault(lineno, set()).update(
+                t.strip() for t in m.group(1).split(",") if t.strip())
+    return per_line, file_wide
+
+
+class LintResult:
+    """Outcome of one analyzer run."""
+
+    def __init__(self) -> None:
+        self.new: List[Finding] = []
+        self.baselined: List[Finding] = []
+        self.suppressed: int = 0
+        self.files_scanned: int = 0
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new else 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "version": 1,
+            "files_scanned": self.files_scanned,
+            "counts": {"new": len(self.new),
+                       "baselined": len(self.baselined),
+                       "suppressed": self.suppressed},
+            "findings": [f.to_dict() for f in self.new],
+            "baselined": [f.to_dict() for f in self.baselined],
+        }
+
+
+class Analyzer:
+    """Runs a rule set over one or more roots (directories or files)."""
+
+    def __init__(self, rules: Sequence[Rule]) -> None:
+        self.rules = list(rules)
+
+    # -- file discovery ------------------------------------------------------
+    @staticmethod
+    def _iter_files(root: str):
+        """Yield (abspath, root-relative path) for every .py under root.
+        A single-FILE root is rel-ified against its topmost enclosing
+        PACKAGE (the dir the default directory scan uses as root), so a
+        directly-linted core/scheduler.py gets path 'core/scheduler.py'
+        — identical to the directory-scan finding: module exemptions
+        ('core/scheduler.py', 'server/') keep matching AND baseline
+        entries written by a full scan still cover it.  Outside any
+        package, fall back to cwd-relative (portable), then absolute."""
+        root = os.path.abspath(root)
+        if os.path.isfile(root):
+            pkg, top = os.path.dirname(root), None
+            while os.path.exists(os.path.join(pkg, "__init__.py")):
+                top = pkg
+                pkg = os.path.dirname(pkg)
+            rel = os.path.relpath(root, top or os.getcwd())
+            if top is None and rel.startswith(".."):
+                rel = root
+            yield root, rel.replace(os.sep, "/")
+            return
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    path = os.path.join(dirpath, fn)
+                    yield path, os.path.relpath(path, root).replace(
+                        os.sep, "/")
+
+    # -- the single shared walk ----------------------------------------------
+    def _walk(self, node: ast.AST, ctx: FileContext) -> None:
+        for rule in self.rules:
+            rule.visit(node, ctx)
+        scoped = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))
+        if scoped:
+            stack = ctx.class_stack if isinstance(node, ast.ClassDef) \
+                else ctx.func_stack
+            stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, ctx)
+        if scoped:
+            stack.pop()
+
+    def run(self, roots: Sequence[str],
+            baseline: Optional[List[Dict[str, str]]] = None) -> LintResult:
+        result = LintResult()
+        raw: List[Finding] = []
+        for root in roots:
+            for path, rel in self._iter_files(root):
+                result.files_scanned += 1
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        source = f.read()
+                    tree = ast.parse(source, filename=path)
+                except (SyntaxError, ValueError, OSError) as e:
+                    raw.append(Finding("FTL000", rel,
+                                       getattr(e, "lineno", 0) or 0,
+                                       f"unparseable file: {e}"))
+                    continue
+                ctx = FileContext(rel, tree, source)
+                for rule in self.rules:
+                    rule.begin_file(ctx)
+                self._walk(tree, ctx)
+                for rule in self.rules:
+                    rule.end_file(ctx)
+                for f in ctx.findings:
+                    if ctx.is_suppressed(f.rule, f.line):
+                        result.suppressed += 1
+                    else:
+                        raw.append(f)
+        for rule in self.rules:
+            rule.finish(raw.append)
+        # Baseline matching: consume entries with multiplicity.
+        remaining: Dict[Tuple[str, str, str], int] = {}
+        for entry in baseline or []:
+            k = (entry.get("rule", ""), entry.get("path", ""),
+                 entry.get("message", ""))
+            remaining[k] = remaining.get(k, 0) + 1
+        for f in sorted(raw, key=Finding.sort_key):
+            k = f.key()
+            if remaining.get(k, 0) > 0:
+                remaining[k] -= 1
+                result.baselined.append(f)
+            else:
+                result.new.append(f)
+        return result
+
+
+# -- baseline persistence ----------------------------------------------------
+
+def load_baseline(path: str) -> List[Dict[str, str]]:
+    """Load a baseline file; a missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, list):
+        raise ValueError(f"baseline {path}: expected a JSON list")
+    return data
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = [{"rule": f.rule, "path": f.path, "message": f.message}
+               for f in sorted(findings, key=Finding.sort_key)]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(entries, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# -- output ------------------------------------------------------------------
+
+def format_text(result: LintResult) -> str:
+    lines = []
+    for f in result.new:
+        where = f"{f.path}:{f.line}: " if f.line else (
+            f"{f.path}: " if f.path else "")
+        lines.append(f"{where}{f.rule} {f.message}")
+    lines.append(
+        f"flowlint: {len(result.new)} new finding(s), "
+        f"{len(result.baselined)} baselined, "
+        f"{result.suppressed} suppressed, "
+        f"{result.files_scanned} file(s) scanned")
+    return "\n".join(lines)
+
+
+def run_flowlint(roots: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+                 baseline_path: Optional[str] = None) -> LintResult:
+    """Programmatic entry point (fresh rule instances per run — rules
+    carry cross-file state)."""
+    from .rules import make_rules
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    return Analyzer(list(rules) if rules is not None
+                    else make_rules()).run(roots, baseline)

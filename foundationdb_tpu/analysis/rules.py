@@ -1,0 +1,456 @@
+"""flowlint rules FTL001..FTL008.
+
+Every rule is grounded in a bug class this repo has actually hit (see
+ISSUE/PR history): wall-clock reads that break unseed reproduction,
+str keys that crashed ``_pack_end``, broad excepts that can swallow
+``ActorCancelled``, tunables hardcoded outside core/knobs.py.
+
+Adding a rule: subclass ``engine.Rule``, set ``id``/``title``, implement
+``visit`` (called once per AST node — never walk the tree yourself;
+per-file prep goes in ``begin_file``, cross-file checks in ``finish``),
+append it in ``make_rules()``, document it in README's rule table, and
+add a known-bad fixture under tests/fixtures/flowlint/ with
+``# expect: FTLnNN:<line>`` markers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .engine import Finding, Rule
+
+# Modules that are real-mode-only BY CONSTRUCTION: never imported on a
+# simulation code path, so wall-clock/entropy/set-order hazards in them
+# cannot perturb a seeded run.  Mirrors (and extends, for the
+# process-supervisor tool) testing/tester.py NondeterminismAudit
+# ALLOWED_FILES — the runtime audit and the static pass must agree on
+# what counts as sanctioned.
+REAL_ONLY_MODULES = (
+    "core/rng.py",          # seeds the nondeterministic id gen by design
+    "core/scheduler.py",    # real-mode epoch reads the monotonic clock
+    "core/threadpool.py",   # real threads only
+    "core/profiler.py",     # wall-time slow-task detection
+    "rpc/real_network.py",  # real sockets
+    "server/real_fs.py",    # real disk
+    "server/fdbserver.py",  # real-mode process entry (EventLoop(sim=False));
+                            # per-incarnation entropy seeding is its job
+    "tools/fdbmonitor.py",  # process supervisor: spawns real fdbservers
+)
+
+
+def _sim_reachable(path: str) -> bool:
+    return not path.endswith(REAL_ONLY_MODULES)
+
+
+class WallClockRule(Rule):
+    """FTL001: wall-clock / OS-entropy calls in sim-reachable modules.
+
+    The static complement of testing/tester.py's NondeterminismAudit:
+    the audit only sees code paths a given seed executes; this rule sees
+    every line.  ``random.Random(seed)`` is allowed (a seeded instance
+    is deterministic); module-level ``random.*`` draws shared
+    interpreter state and is not."""
+
+    id = "FTL001"
+    title = "wall-clock/entropy call in sim-reachable module"
+
+    CLOCKS = {"time.time", "time.time_ns", "time.monotonic",
+              "time.monotonic_ns", "time.perf_counter",
+              "time.perf_counter_ns"}
+
+    def visit(self, node: ast.AST, ctx) -> None:
+        if not isinstance(node, ast.Call) or not _sim_reachable(ctx.path):
+            return
+        name = ctx.resolve_call(node.func)
+        if name is None:
+            return
+        if name in self.CLOCKS:
+            ctx.report(self, node,
+                       f"{name}() in sim-reachable code: route through "
+                       "core.scheduler.now() (virtual time) so seeded "
+                       "runs replay identically")
+        elif name == "os.urandom" or name == "random.SystemRandom":
+            ctx.report(self, node,
+                       f"{name} is OS entropy: draw from "
+                       "core.rng.deterministic_random() instead")
+        elif name.startswith("random.") and name != "random.Random":
+            ctx.report(self, node,
+                       f"module-level {name}() draws shared interpreter "
+                       "RNG state: use core.rng.deterministic_random() "
+                       "or a seeded random.Random instance")
+
+
+class UnawaitedCoroutineRule(Rule):
+    """FTL002: a coroutine created and immediately discarded.
+
+    ``foo()`` as a bare statement where ``foo`` is an ``async def`` in
+    the same file builds a coroutine object that never runs (Python only
+    warns at GC time, and only if the warning isn't swallowed).  The
+    call must be awaited or handed to ``spawn()``.  A name defined BOTH
+    async and sync in the file (e.g. fdb_api.py's FDBDatabase.set
+    convenience vs FDBTransaction.set) is ambiguous at a callsite and
+    not flagged."""
+
+    id = "FTL002"
+    title = "un-awaited coroutine call"
+
+    def begin_file(self, ctx) -> None:
+        async_defs: Set[str] = set()
+        sync_defs: Set[str] = set()
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.AsyncFunctionDef):
+                async_defs.add(n.name)
+            elif isinstance(n, ast.FunctionDef):
+                sync_defs.add(n.name)
+        self._async_defs = async_defs - sync_defs
+
+    def visit(self, node: ast.AST, ctx) -> None:
+        if not isinstance(node, ast.Expr) or \
+                not isinstance(node.value, ast.Call):
+            return
+        func = node.value.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        if name in self._async_defs:
+            ctx.report(self, node,
+                       f"coroutine {name}() is created but never awaited "
+                       "(await it, or hand it to spawn())")
+
+
+class BroadExceptInActorRule(Rule):
+    """FTL003: a handler inside an actor that can swallow cancellation.
+
+    ``ActorCancelled`` derives from ``BaseException`` (core/error.py) —
+    exactly so that ``except Exception`` is cancellation-safe, which is
+    why this rule does NOT flag it.  What it flags, inside ``async
+    def``: bare ``except:`` and ``except BaseException`` handlers that
+    neither re-raise nor delegate to an ``on_error()`` retry helper
+    (whose contract is to re-raise non-retryables, incl. cancellation)."""
+
+    id = "FTL003"
+    title = "broad except in actor can swallow ActorCancelled"
+
+    @staticmethod
+    def _catches_base(h: ast.ExceptHandler) -> bool:
+        if h.type is None:
+            return True
+        names = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        return any(isinstance(e, ast.Name) and e.id == "BaseException"
+                   for e in names)
+
+    @staticmethod
+    def _handles_cancellation(h: ast.ExceptHandler) -> bool:
+        for n in ast.walk(h):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "on_error":
+                return True
+        return False
+
+    def visit(self, node: ast.AST, ctx) -> None:
+        if not isinstance(node, ast.ExceptHandler) or not ctx.in_async:
+            return
+        if self._catches_base(node) and not self._handles_cancellation(node):
+            what = "bare except:" if node.type is None \
+                else "except BaseException"
+            ctx.report(self, node,
+                       f"{what} in an actor swallows ActorCancelled: "
+                       "re-raise (bare `raise`), narrow to Exception, or "
+                       "delegate to on_error()")
+
+
+class StrKeyRule(Rule):
+    """FTL004: a str literal flowing into a bytes-key API.
+
+    The ``_pack_end`` bug class (PR 2/4): FDB keys and values are bytes;
+    a str slips through dynamic paths until pack time, sometimes only on
+    rarely-taken branches.  Flags str literals (incl. f-strings and
+    ``"a" + x`` concatenations) at key/value positions of transaction
+    methods and pack helpers.  Plain ``.get()`` is deliberately NOT
+    checked: dict.get with str keys is pervasive and the noise would
+    drown the signal; ``.set()`` is only checked at arity >= 2 or with
+    a kv-style keyword (key=/value=/...) — signal objects like
+    ``shutdown_signal.set("kill")`` are unary and keyword-free."""
+
+    id = "FTL004"
+    title = "str literal flows into bytes-key API"
+
+    # method -> positional arg indices that must be bytes
+    KEY_POSITIONS = {"set": (0, 1), "clear": (0, 1), "clear_range": (0, 1),
+                     "get_range": (0, 1), "get_key": (0,), "watch": (0,),
+                     "add_read_conflict_range": (0, 1),
+                     "add_write_conflict_range": (0, 1),
+                     "atomic_op": (1,)}
+    KEY_KEYWORDS = ("key", "begin", "end", "value")
+    PACK_HELPERS = ("_pack", "_pack_end")
+
+    @classmethod
+    def _strish(cls, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, str)
+        if isinstance(node, ast.JoinedStr):
+            return True
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            return cls._strish(node.left) or cls._strish(node.right)
+        return False
+
+    def visit(self, node: ast.AST, ctx) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name in self.PACK_HELPERS:
+            positions = (0,)
+        elif name in self.KEY_POSITIONS:
+            positions = self.KEY_POSITIONS[name]
+            if name == "set" and len(node.args) < 2 and not any(
+                    kw.arg in self.KEY_KEYWORDS for kw in node.keywords):
+                return      # unary .set() is a signal/flag, not a kv
+                #             write; a kv-ish keyword (key=/value=)
+                #             re-qualifies it as one
+        else:
+            return
+        for i in positions:
+            if i < len(node.args) and self._strish(node.args[i]):
+                ctx.report(self, node,
+                           f"str literal passed to {name}() arg {i}: keys "
+                           "and values are bytes (b'...', or .encode())")
+                return
+        for kw in node.keywords:
+            if kw.arg in self.KEY_KEYWORDS and self._strish(kw.value):
+                ctx.report(self, node,
+                           f"str literal passed to {name}({kw.arg}=...): "
+                           "keys and values are bytes (b'...', or "
+                           ".encode())")
+                return
+
+
+class SetIterationRule(Rule):
+    """FTL005: iterating a set in sim-reachable code.
+
+    str hashing is salted by PYTHONHASHSEED, so set iteration order is
+    process-dependent — the exact hazard that breaks cross-process
+    unseed reproduction (ROADMAP chaos follow-up).  Flags ``for``
+    loops / comprehensions whose iterable is syntactically a set (set
+    literal, set comprehension, ``set(...)``/``frozenset(...)`` call);
+    wrap in ``sorted()`` to fix.  Dict iteration is NOT flagged:
+    Python dicts are insertion-ordered, hence deterministic."""
+
+    id = "FTL005"
+    title = "set iteration order is PYTHONHASHSEED-dependent"
+
+    @staticmethod
+    def _is_set_expr(node: ast.expr) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        return isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Name) and \
+            node.func.id in ("set", "frozenset")
+
+    def _check_iter(self, it: ast.expr, ctx) -> None:
+        if self._is_set_expr(it):
+            ctx.report(self, it,
+                       "iteration over a set: order depends on "
+                       "PYTHONHASHSEED for str elements — wrap in "
+                       "sorted() (deterministic) before iterating")
+
+    def visit(self, node: ast.AST, ctx) -> None:
+        if not _sim_reachable(ctx.path):
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._check_iter(node.iter, ctx)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                self._check_iter(gen.iter, ctx)
+
+
+class BlockingInActorRule(Rule):
+    """FTL006: a blocking call inside an actor.
+
+    Actors interleave only at awaits on ONE reactor thread: a
+    ``time.sleep`` stalls every other actor (and under sim stalls
+    *virtual* time for wall time), and direct ``open()``/``os.open``
+    bypasses sim_fs — the simulated power-loss/fault machinery never
+    sees that file.  Use ``core.scheduler.delay()`` and the worker's
+    filesystem handle (``sim_fs``/``real_fs``)."""
+
+    id = "FTL006"
+    title = "blocking call inside actor"
+
+    BLOCKING = {"time.sleep": "core.scheduler.delay() (non-blocking, "
+                              "virtual under sim)",
+                "open": "the role's filesystem handle (sim_fs/real_fs)",
+                "os.open": "the role's filesystem handle (sim_fs/real_fs)"}
+
+    def visit(self, node: ast.AST, ctx) -> None:
+        if not isinstance(node, ast.Call) or not ctx.in_async or \
+                not _sim_reachable(ctx.path):
+            return
+        name = ctx.resolve_call(node.func)
+        if name in self.BLOCKING:
+            ctx.report(self, node,
+                       f"blocking {name}() inside an actor: use "
+                       f"{self.BLOCKING[name]}")
+
+
+class TraceEventRule(Rule):
+    """FTL007: TraceEvent naming + cross-module schema drift (absorbed
+    from scripts/check_trace_events.py, which remains as a thin shim).
+
+    1. every ``TraceEvent("Name")`` literal must be UpperCamelCase;
+    2. no two modules may emit the same Type with different *chained*
+       detail schemas — a Type is a contract for trace consumers.
+       Details added through a variable are invisible statically and
+       make that callsite "open" (exempt from the comparison)."""
+
+    id = "FTL007"
+    title = "TraceEvent naming / schema drift"
+
+    CAMEL = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+    # Established cross-role correlation events whose Location field IS
+    # the schema discriminator (emitted via trace_batch_event).
+    SCHEMA_ALLOWLIST = {"CommitDebug", "TransactionDebug"}
+
+    def __init__(self) -> None:
+        # type -> {module: [keyset or None per callsite]}
+        self._by_type: Dict[str, Dict[str, List[Optional[frozenset]]]] = {}
+
+    @staticmethod
+    def _chain(call: ast.Call):
+        """For the OUTERMOST call of a TraceEvent(...).detail(...)...
+        chain, return (type_name, chained detail keys or None when a key
+        is not a literal); None for calls that are not such a chain."""
+        keys: Set[str] = set()
+        opaque = False
+        node = call
+        while True:
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "detail":
+                    if node.args and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        keys.add(node.args[0].value)
+                    else:
+                        opaque = True
+                elif f.attr not in ("error", "log"):
+                    return None
+                if not isinstance(f.value, ast.Call):
+                    return None
+                node = f.value
+                continue
+            if isinstance(f, ast.Name) and f.id == "TraceEvent":
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    return node.args[0].value, \
+                        (None if opaque else frozenset(keys))
+                return None
+            return None
+
+    def visit(self, node: ast.AST, ctx) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        # Only the outermost call of each chain: skip a Call that is the
+        # receiver of another attribute call.
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Attribute):
+            grand = ctx.parent(parent)
+            if isinstance(grand, ast.Call) and grand.func is parent:
+                return
+        got = self._chain(node)
+        if got is None:
+            return
+        type_name, keys = got
+        if not self.CAMEL.match(type_name):
+            ctx.report(self, node,
+                       f"TraceEvent type {type_name!r} is not "
+                       "UpperCamelCase")
+        # A suppressed callsite (per-line or disable-file) must not
+        # join the cross-file schema comparison either — finish()-time
+        # findings have no line of their own, so this is the only place
+        # the suppression can take effect for drift.
+        if ctx.is_suppressed(self.id, getattr(node, "lineno", 0)):
+            return
+        self._by_type.setdefault(type_name, {}).setdefault(
+            ctx.path, []).append(keys)
+
+    def finish(self, report) -> None:
+        for type_name, modules in sorted(self._by_type.items()):
+            if len(modules) < 2 or type_name in self.SCHEMA_ALLOWLIST:
+                continue
+            schemas = {}
+            for mod, keysets in modules.items():
+                if any(k is None for k in keysets):
+                    continue        # opaque callsite: module is "open"
+                schemas[mod] = frozenset().union(*keysets)
+            if len(set(schemas.values())) > 1:
+                detail = "; ".join(
+                    f"{m}: {sorted(s) or ['<none>']}"
+                    for m, s in sorted(schemas.items()))
+                report(Finding(
+                    self.id, sorted(modules)[0], 0,
+                    f"TraceEvent type {type_name!r} emitted from "
+                    f"{len(modules)} modules with different detail "
+                    f"schemas: {detail}"))
+
+
+class HardcodedTunableRule(Rule):
+    """FTL008: a hardcoded float tunable in a server/conflict hot path.
+
+    Timeouts, cadences, and latency magnitudes belong in core/knobs.py:
+    knobs are overridable at startup, BUGGIFY-randomizable per seed, and
+    dynamically updatable through the config DB — a module-level float
+    constant is none of those.  Int constants are NOT flagged: in this
+    codebase they are format/protocol constants (magics, page sizes,
+    opcode ids, lane counts), not tunables."""
+
+    id = "FTL008"
+    title = "hardcoded tunable should route through core/knobs.py"
+
+    NAME = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+    HOT_PATHS = ("server/", "conflict/")
+
+    @staticmethod
+    def _float_value(node: ast.expr):
+        if isinstance(node, ast.UnaryOp) and \
+                isinstance(node.op, ast.USub):
+            node = node.operand
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, float):
+            return node.value
+        return None
+
+    def visit(self, node: ast.AST, ctx) -> None:
+        if not isinstance(node, ast.Assign) or not ctx.at_module_level:
+            return
+        if not any(h in ctx.path for h in self.HOT_PATHS):
+            return
+        if len(node.targets) != 1 or \
+                not isinstance(node.targets[0], ast.Name):
+            return
+        name = node.targets[0].id
+        value = self._float_value(node.value)
+        if self.NAME.match(name) and value is not None:
+            ctx.report(self, node,
+                       f"hardcoded tunable {name} = {value!r}: move it "
+                       "to core/knobs.py (overridable, "
+                       "BUGGIFY-randomizable, dynamic-knob updatable)")
+
+
+def make_rules() -> List[Rule]:
+    """Fresh rule instances — ALWAYS construct per run: rules carry
+    cross-file state (TraceEventRule._by_type), so sharing instances
+    across Analyzer runs would accumulate callsites and emit phantom
+    schema-drift findings."""
+    return [WallClockRule(), UnawaitedCoroutineRule(),
+            BroadExceptInActorRule(), StrKeyRule(), SetIterationRule(),
+            BlockingInActorRule(), TraceEventRule(),
+            HardcodedTunableRule()]

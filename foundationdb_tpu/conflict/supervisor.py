@@ -101,7 +101,16 @@ def _now() -> float:
     loop = current_event_loop_or_none()
     if loop is not None:
         return loop.now()
-    return _time.monotonic()
+    return _time.monotonic()  # flowlint: disable=FTL001 -- real-mode fallback
+
+
+def _wall() -> float:
+    """Device-profiling clock: WALL time on purpose, even under sim.
+    TPU dispatch/wait and mirror resolves are real host/accelerator work
+    whose cost the TpuBackend histograms must report in real seconds;
+    none of these readings feed back into scheduling or verdicts, so
+    seeded runs still replay identically."""
+    return _time.monotonic()  # flowlint: disable=FTL001 -- see docstring
 
 
 class BackendHealthMonitor:
@@ -532,10 +541,10 @@ class SupervisedConflictSet(ConflictSet):
         if h.device_handle is not None and h.device_obj is self._device \
                 and self._device is not None:
             try:
-                _t_wait = _time.monotonic()
+                _t_wait = _wall()
                 device_codes = self._guarded(h.device_handle.wait,
                                              retry=True)
-                _t_done = _time.monotonic()
+                _t_done = _wall()
                 # Device-vs-mirror profiling: wait = d2h sync + any
                 # remaining device compute; end-to-end = dispatch->codes.
                 self.metrics.histogram("DeviceWait").record(
@@ -560,11 +569,11 @@ class SupervisedConflictSet(ConflictSet):
             h.via_fallback = True
             self.stats["fallback_batches"] += 1
             self.metrics.counter("FallbackBatches").add(1)
-            _t_m = _time.monotonic()
+            _t_m = _wall()
             h.results, h.conflicting = self._mirror.resolve_with_conflicts(
                 h.txns, h.now, h.new_oldest)
             self.metrics.histogram("MirrorResolve").record(
-                _time.monotonic() - _t_m)
+                _wall() - _t_m)
             self.oldest_version = self._mirror.oldest_version
             self._prune_taint()
             return
@@ -579,11 +588,11 @@ class SupervisedConflictSet(ConflictSet):
             h.rechecked = True
             self.stats["rechecked_batches"] += 1
             self.metrics.counter("RecheckedBatches").add(1)
-            _t_m = _time.monotonic()
+            _t_m = _wall()
             final, ranges = self._mirror.resolve_with_conflicts(
                 h.txns, h.now, h.new_oldest)
             self.metrics.histogram("MirrorResolve").record(
-                _time.monotonic() - _t_m)
+                _wall() - _t_m)
             self._taint_divergence(h.txns, device_codes, final, h.now)
             h.results, h.conflicting = final, ranges
         else:
@@ -608,7 +617,7 @@ class SupervisedConflictSet(ConflictSet):
             self._maybe_promote()
         if self._device is not None:
             dev = self._device
-            t0 = _time.monotonic()
+            t0 = _wall()
             try:
                 if hasattr(dev, "resolve_async"):
                     dh = self._guarded(lambda: dev.resolve_async(
@@ -620,7 +629,7 @@ class SupervisedConflictSet(ConflictSet):
                 # device step returns before compute finishes, so this
                 # isolates the tunnel-send half of a batch).
                 self.metrics.histogram("Dispatch").record(
-                    _time.monotonic() - t0)
+                    _wall() - t0)
                 h.device_handle = dh
                 h.device_obj = dev
                 h.dispatch_t0 = t0

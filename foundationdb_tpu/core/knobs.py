@@ -191,6 +191,12 @@ class ServerKnobs(KnobBase):
         self.STORAGE_DURABILITY_LAG_SOFT_MAX = 250e6
         self.DESIRED_TOTAL_BYTES = 150000
         self.STORAGE_LIMIT_BYTES = 500000
+        # Read-path future_version wait (reference waitForVersion timeout
+        # in storageserver.actor.cpp) and updateStorage durability-batch
+        # cadence (reference updateStorage :4002).  Promoted from
+        # module-level constants by flowlint FTL008.
+        self.STORAGE_FUTURE_VERSION_TIMEOUT = 1.0
+        self.UPDATE_STORAGE_INTERVAL = 0.05
 
         # Simulated disk fault injection (server/sim_fs.py, reference
         # AsyncFileNonDurable + BUGGIFY'd diskFailureInjector): when the
@@ -200,6 +206,13 @@ class ServerKnobs(KnobBase):
         # explicit DiskFaultProfiles only; see from_knobs).
         self.SIM_DISK_LATENCY_SPIKE_P = 0.01  # per write/sync op
         self.SIM_DISK_LATENCY_SPIKE_S = 0.05  # spike duration
+        # Baseline simulated disk-op costs (server/sim_fs.py, tlog
+        # fsync): virtual-time latencies every sim write/sync pays even
+        # without an injected fault profile.  Promoted from module-level
+        # constants by flowlint FTL008.
+        self.SIM_DISK_WRITE_LATENCY_S = 0.0002
+        self.SIM_DISK_SYNC_LATENCY_S = 0.0005
+        self.TLOG_SIM_FSYNC_S = 0.0005
 
         # TLog
         self.TLOG_SPILL_THRESHOLD = 1500e6

@@ -314,6 +314,11 @@ class CommitProxy:
             if self._wait_failure_actor is not None and \
                     not self._wait_failure_actor.is_ready():
                 self._wait_failure_actor.cancel()
+            if not isinstance(e, Exception):
+                # ActorCancelled (epoch teardown) must keep unwinding
+                # after the gates are released (FTL003) — the replies
+                # above already carry commit_unknown_result.
+                raise
 
     async def _commit_batch_impl(self, batch: List[CommitTransactionRequest],
                                  batch_num: int) -> None:

@@ -19,13 +19,10 @@ from typing import Dict, List, Optional, Tuple
 from ..core.buggify import buggify
 from ..core.coverage import test_coverage
 from ..core.error import err
+from ..core.knobs import server_knobs
 from ..core.rng import deterministic_random
 from ..core.scheduler import delay
 from ..core.trace import Severity, TraceEvent
-
-_SIM_WRITE_LATENCY = 0.0002
-_SIM_SYNC_LATENCY = 0.0005
-
 
 @dataclass
 class DiskFaultProfile:
@@ -113,7 +110,7 @@ class SimFile:
         if self._should_io_error(kind):
             self._raise_io_error(kind)
         if buggify("sim_fs.slowDisk"):
-            await delay(_SIM_SYNC_LATENCY * 20)
+            await delay(server_knobs().SIM_DISK_SYNC_LATENCY_S * 20)
 
     def _raise_io_error(self, kind: str) -> None:
         test_coverage("SimDiskIoErrorInjected")
@@ -142,7 +139,7 @@ class SimFile:
     async def write(self, offset: int, data: bytes) -> None:
         self._check_open()
         await self._fault_point("write")
-        await delay(_SIM_WRITE_LATENCY)
+        await delay(server_knobs().SIM_DISK_WRITE_LATENCY_S)
         self.pending.append(("w", offset, bytes(data)))
 
     async def truncate(self, size: int) -> None:
@@ -152,7 +149,7 @@ class SimFile:
     async def sync(self) -> None:
         self._check_open()
         await self._fault_point("sync")
-        await delay(_SIM_SYNC_LATENCY)
+        await delay(server_knobs().SIM_DISK_SYNC_LATENCY_S)
         self._apply_pending()
         self._maybe_bitrot()
 

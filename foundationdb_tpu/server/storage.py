@@ -33,9 +33,6 @@ from .interfaces import (GetKeyValuesReply, GetKeyValuesRequest,
                          TLogPopRequest, WatchValueReply, WatchValueRequest)
 from .notified import NotifiedVersion
 
-_FUTURE_VERSION_TIMEOUT = 1.0   # reference: future_version after wait
-
-
 class VersionedMap:
     """Per-key version chains with tombstones (None = cleared)."""
 
@@ -167,7 +164,6 @@ class VersionedMap:
 
 
 _META_KEY = b"\xff\xff/storageMeta"    # above every shard-map range end
-_UPDATE_STORAGE_INTERVAL = 0.05        # reference updateStorage cadence
 
 
 class _Fetch:
@@ -374,7 +370,7 @@ class StorageServer:
         updateStorage storageserver.actor.cpp:4002: makes versions durable
         in batches behind the in-memory MVCC window)."""
         while True:
-            await delay(_UPDATE_STORAGE_INTERVAL)
+            await delay(server_knobs().UPDATE_STORAGE_INTERVAL)
             if buggify("storage.slowDurable"):
                 continue   # stretched durability lag (reference BUGGIFY)
             if self._rebuild_f is not None and not self._rebuild_f.is_ready():
@@ -436,7 +432,7 @@ class StorageServer:
             raise err("transaction_too_old")
         if version > self.version.get():
             done = self.version.when_at_least(version)
-            timeout = delay(_FUTURE_VERSION_TIMEOUT)
+            timeout = delay(server_knobs().STORAGE_FUTURE_VERSION_TIMEOUT)
             idx, _ = await wait_any([done, timeout])
             if idx == 1:
                 raise err("future_version")
@@ -542,6 +538,8 @@ class StorageServer:
             # Failed fetch: disown the range (DD retries elsewhere).
             self.shards.set_range(req.begin, req.end, ("absent", 0))
             req.reply.send_error(e)
+            if not isinstance(e, Exception):
+                raise   # ActorCancelled must keep unwinding (FTL003)
 
     async def _fetch_shard(self, req) -> None:
         """Serve a snapshot of [begin, end) at our current version,

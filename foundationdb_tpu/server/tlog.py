@@ -28,9 +28,6 @@ from .interfaces import (Tag, TLogCommitRequest, TLogInterface,
                          TLogPopRequest)
 from .notified import NotifiedVersion
 
-_SIM_FSYNC_SECONDS = 0.0005
-
-
 def _pack_commit(version: Version, prev_version: Version,
                  known_committed: Version,
                  popped: Dict[Tag, Version],
@@ -335,7 +332,7 @@ class TLog:
                         self._die_on_disk_error("commit", e)
                         return
                 else:
-                    await delay(_SIM_FSYNC_SECONDS)
+                    await delay(server_knobs().TLOG_SIM_FSYNC_S)
                 self.durable_version.set(target)
                 # Entries appended before this fsync are durable now, so
                 # a pending overflow can finally evict them.

@@ -541,7 +541,9 @@ class Worker:
         import sys as _sys
         import time as _time
         if not hasattr(self, "_started_mono"):
-            self._started_mono = _time.monotonic()
+            # Real-mode-only branch (loop.sim returned the deterministic
+            # stub above); process uptime IS wall time here.
+            self._started_mono = _time.monotonic()  # flowlint: disable=FTL001
         t = _os.times()
         rss = 0.0
         try:
@@ -554,8 +556,9 @@ class Worker:
         return {
             "cpu_seconds": round(t.user + t.system, 3),
             "memory_rss_bytes": rss,
-            "uptime_seconds": round(_time.monotonic() -
-                                    self._started_mono, 1),
+            "uptime_seconds": round(
+                _time.monotonic()  # flowlint: disable=FTL001 -- real mode
+                - self._started_mono, 1),
         }
 
     async def _stats_announce_loop(self) -> None:

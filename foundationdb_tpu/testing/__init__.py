@@ -4,5 +4,7 @@ Reference layer: fdbserver/workloads/ + fdbserver/tester.actor.cpp +
 tests/*.toml (SURVEY.md §4)."""
 
 from .workload import TestWorkload, register_workload, workload_registry  # noqa: F401
-from .tester import (NondeterminismAudit, SimRunReport, load_spec,  # noqa: F401
-                     run_simulation, run_test, run_test_twice)
+from .tester import (NondeterminismAudit, SimRunReport,  # noqa: F401
+                     effective_hash_seed, load_spec,
+                     repro_hash_seed_prefix, run_simulation, run_test,
+                     run_test_twice)

@@ -131,6 +131,35 @@ async def quiet_database(cluster, db, timeout: float = 60.0) -> None:
     raise FdbError(1004, "timed_out", "quiet_database timed out")
 
 
+def effective_hash_seed() -> Optional[str]:
+    """The PYTHONHASHSEED this process effectively runs under, or None
+    when str hashing is randomized.  SAME-process double runs
+    (run_test_twice) never need it, but CROSS-process unseed
+    reproduction does: str-set iteration orders depend on the per-process
+    hash salt, so an unpinned replay of a failing seed can diverge for a
+    reason that has nothing to do with the bug being chased (ROADMAP
+    chaos follow-up; regression-tested with the HashOrderCanary
+    workload)."""
+    import os
+    import sys
+    if not sys.flags.hash_randomization:
+        # -R off entirely (e.g. PYTHONHASHSEED=0): hashing is the
+        # documented fixed function — any process reproduces it.
+        return "0"
+    seed = os.environ.get("PYTHONHASHSEED", "")
+    if seed and seed != "random":
+        return seed
+    return None
+
+
+def repro_hash_seed_prefix() -> str:
+    """Env prefix every cross-process repro command must carry.  When the
+    current process is itself randomized the prefix pins "0" — the repro
+    then reproduces the BUG CLASS deterministically even though it cannot
+    replay this exact process's str orders."""
+    return f"PYTHONHASHSEED={effective_hash_seed() or '0'} "
+
+
 class NondeterminismAudit:
     """Runtime detector of nondeterminism sources under simulation
     (reference: the simulator's whole contract is that NOTHING reads the
@@ -313,6 +342,11 @@ def _divergence_report(r1: SimRunReport, r2: SimRunReport,
             lines.append(f"  {run_name} nondeterminism sources flagged:")
             for func, file, lineno in r.nondeterminism:
                 lines.append(f"    {func} called from {file}:{lineno}")
+    if effective_hash_seed() is None:
+        lines.append(
+            "note: str hashing is RANDOMIZED in this process — set-order "
+            "divergence cannot be reproduced elsewhere; re-run repros "
+            "with " + repro_hash_seed_prefix().strip())
     return "\n".join(lines)
 
 

@@ -371,7 +371,9 @@ class NondeterminismCanaryWorkload(TestWorkload):
         # Two independent wall-clock residues: the chance of BOTH
         # colliding across two runs is ~1e-6, so the negative test is
         # solid without being flaky.
-        t = _time.time_ns()
+        # The wall-clock read IS this workload's entire purpose (negative
+        # control): the verifier must catch it, flowlint must not.
+        t = _time.time_ns()  # flowlint: disable=FTL001
         n1 = t % 997
         n2 = (t // 997) % 991
         rng = deterministic_random()
@@ -384,6 +386,42 @@ class NondeterminismCanaryWorkload(TestWorkload):
                 txn.set(b"canary/%02d" % i, b"x")
         await self.run_transaction(put)
         self.metrics["writes"] = writes
+
+
+@register_workload
+class HashOrderCanaryWorkload(TestWorkload):
+    """DELIBERATELY PYTHONHASHSEED-sensitive workload (negative control
+    for CROSS-process unseed reproduction, ISSUE 5): iterates a str SET
+    and folds the iteration order into both the deterministic RNG's draw
+    count and the transaction schedule.  Two runs in processes sharing a
+    pinned PYTHONHASHSEED replay bit-identically; different hash seeds
+    almost surely (collision ~1e-8: two independent ~1e4 residues) yield
+    different unseeds — the divergence scripts/run_chaos.py's pinned
+    repro commands exist to rule out.  Never include it in a real
+    correctness spec."""
+
+    name = "HashOrderCanary"
+
+    async def start(self) -> None:
+        from ..core.rng import deterministic_random
+        from ..core.scheduler import delay as sim_delay
+        n = int(self.config.get("nodeCount", 32))
+        sig = 0
+        # The set iteration below is this workload's entire purpose
+        # (order-sensitivity canary): flowlint must not flag it, the
+        # cross-process verifier must catch it when hash seeds differ.
+        for name in set("canary-%03d" % i for i in range(n)):  # flowlint: disable=FTL005
+            # Polynomial fold: permutation-sensitive, unlike sum/xor.
+            sig = (sig * 1000003 + int(name[-3:])) & 0xFFFFFFFF
+        rng = deterministic_random()
+        for _ in range(sig % 9973 + 1):
+            rng.random01()                  # draw count => unseed differs
+        await sim_delay((sig // 9973 % 9973) * 1e-6)   # schedule => digest
+
+        async def put(txn):
+            txn.set(b"hash_canary", b"%08x" % sig)
+        await self.run_transaction(put)
+        self.metrics["order_sig"] = float(sig)
 
 
 @register_workload

@@ -1,125 +1,38 @@
 #!/usr/bin/env python
-"""Lint TraceEvent type names across the codebase.
+"""Lint TraceEvent type names across the codebase — THIN SHIM.
 
-Run from a tier-1 test (tests/test_metrics.py) so drift fails fast:
+The actual analysis now lives in flowlint rule FTL007
+(foundationdb_tpu/analysis/rules.py TraceEventRule); this script keeps
+the original CLI surface (and the ``check(root)`` entry point
+tests/test_metrics.py imports) for compatibility:
 
-1. every ``TraceEvent("Name")`` literal must be UpperCamelCase
-   (``^[A-Z][A-Za-z0-9]*$`` — the reference's convention, and what keeps
-   the JSONL greppable);
-2. no two MODULES may emit the same Type with different *chained* detail
-   schemas: a Type is a contract for trace consumers (commit_debug,
-   tests, dashboards), so the same name meaning different shapes in
-   different files is a bug.  Only details chained directly onto the
-   TraceEvent(...) constructor call are compared — details added later
-   through a variable are invisible to static analysis and treated as
-   "open" (that callsite exempts itself from the schema comparison).
+1. every ``TraceEvent("Name")`` literal must be UpperCamelCase;
+2. no two MODULES may emit the same Type with different *chained*
+   detail schemas (details added through a variable make a callsite
+   "open" and exempt from the comparison).
 
 Exit status 0 = clean; 1 = violations (printed one per line).
+Prefer ``python scripts/flowlint.py`` for the full rule set.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
-from typing import Dict, List, Optional, Set, Tuple
+from typing import List
 
-CAMEL = re.compile(r"^[A-Z][A-Za-z0-9]*$")
-
-# Types allowed to differ across modules: established cross-role
-# correlation events whose Location field IS the schema discriminator,
-# emitted via the shared trace_batch_event helper.
-SCHEMA_ALLOWLIST = {"CommitDebug", "TransactionDebug"}
-
-
-def _chain(call: ast.Call) -> Optional[Tuple[str, Optional[Set[str]]]]:
-    """For the OUTERMOST call of a TraceEvent(...).detail(...)... chain,
-    return (type_name, chained detail keys or None when a key is not a
-    literal).  None for calls that are not such a chain."""
-    keys: Set[str] = set()
-    opaque = False
-    node = call
-    while True:
-        f = node.func
-        if isinstance(f, ast.Attribute):
-            if f.attr == "detail":
-                if node.args and isinstance(node.args[0], ast.Constant) \
-                        and isinstance(node.args[0].value, str):
-                    keys.add(node.args[0].value)
-                else:
-                    opaque = True
-            elif f.attr not in ("error", "log"):
-                return None
-            if not isinstance(f.value, ast.Call):
-                return None
-            node = f.value
-            continue
-        if isinstance(f, ast.Name) and f.id == "TraceEvent":
-            if node.args and isinstance(node.args[0], ast.Constant) \
-                    and isinstance(node.args[0].value, str):
-                return node.args[0].value, (None if opaque else keys)
-            return None
-        return None
-
-
-def scan_file(path: str):
-    """Yield (type_name, keys_or_None, lineno) for every TraceEvent chain
-    rooted in `path`."""
-    with open(path, "r", encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    # Only the outermost call of each chain: collect every Call that is
-    # the .func.value of another chain member and skip those.
-    inner = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Attribute) and \
-                isinstance(node.func.value, ast.Call):
-            inner.add(id(node.func.value))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and id(node) not in inner:
-            got = _chain(node)
-            if got is not None:
-                yield got[0], got[1], node.lineno
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def check(root: str) -> List[str]:
-    errors: List[str] = []
-    # type -> {module: [keyset or None, ...]}
-    by_type: Dict[str, Dict[str, List[Optional[Set[str]]]]] = {}
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root)
-            for type_name, keys, lineno in scan_file(path):
-                if not CAMEL.match(type_name):
-                    errors.append(
-                        f"{rel}:{lineno}: TraceEvent type "
-                        f"{type_name!r} is not UpperCamelCase")
-                by_type.setdefault(type_name, {}).setdefault(
-                    rel, []).append(keys)
-    for type_name, modules in sorted(by_type.items()):
-        if len(modules) < 2 or type_name in SCHEMA_ALLOWLIST:
-            continue
-        # Compare the union of literal keysets per module; an opaque
-        # callsite (None) makes that module "open" and exempt.
-        schemas = {}
-        for mod, keysets in modules.items():
-            if any(k is None for k in keysets):
-                continue
-            schemas[mod] = frozenset().union(*keysets)
-        distinct = set(schemas.values())
-        if len(distinct) > 1:
-            detail = "; ".join(
-                f"{m}: {sorted(s) or ['<none>']}"
-                for m, s in sorted(schemas.items()))
-            errors.append(
-                f"TraceEvent type {type_name!r} emitted from "
-                f"{len(modules)} modules with different detail "
-                f"schemas: {detail}")
+    """Run FTL007 only over `root`; returns the old-format error lines."""
+    from foundationdb_tpu.analysis.engine import Analyzer
+    from foundationdb_tpu.analysis.rules import TraceEventRule
+    result = Analyzer([TraceEventRule()]).run([root])
+    errors = []
+    for f in result.new:
+        errors.append(f"{f.path}:{f.line}: {f.message}" if f.line
+                      else f.message)
     return errors
 
 

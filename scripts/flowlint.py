@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""flowlint CLI: repo-wide static analysis for actor, determinism, and
+key-type hazards (foundationdb_tpu/analysis/).
+
+    python scripts/flowlint.py                      # lint the package
+    python scripts/flowlint.py foundationdb_tpu     # same, explicit
+    python scripts/flowlint.py --format json        # machine-readable
+    python scripts/flowlint.py --list-rules
+    python scripts/flowlint.py --write-baseline     # grandfather current
+
+Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
+2 = internal error.  Suppress a single line with
+``# flowlint: disable=FTL0NN -- <why>``; the committed baseline
+(flowlint_baseline.json) holds grandfathered findings, line-free so
+they survive unrelated edits.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "flowlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="flowlint: actor/determinism/key-type static analysis")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO, "foundationdb_tpu")],
+                    help="files or directories to lint (default: the "
+                         "foundationdb_tpu package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON path, or 'none' to disable "
+                         f"(default: {DEFAULT_BASELINE})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline file "
+                         "and exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    from foundationdb_tpu.analysis import format_text, load_baseline
+    from foundationdb_tpu.analysis.engine import Analyzer, write_baseline
+    from foundationdb_tpu.analysis.rules import make_rules
+
+    if args.list_rules:
+        for rule in make_rules():
+            print(f"{rule.id}  {rule.title}")
+        return 0
+
+    baseline_path = None if args.baseline == "none" else args.baseline
+    if args.write_baseline and baseline_path is None:
+        # Without this, the fallback below would silently overwrite the
+        # committed default baseline with whatever was being inspected.
+        ap.error("--write-baseline conflicts with --baseline none")
+    try:
+        baseline = load_baseline(baseline_path) if baseline_path else []
+        result = Analyzer(make_rules()).run(args.paths, baseline)
+    except Exception as e:  # noqa: BLE001 - CLI boundary: exit 2, not a trace
+        print(f"flowlint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        target = baseline_path or DEFAULT_BASELINE
+        write_baseline(target, result.new + result.baselined)
+        print(f"flowlint: baseline of "
+              f"{len(result.new) + len(result.baselined)} finding(s) "
+              f"written to {target}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        print(format_text(result))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,20 +6,32 @@ Reference: contrib/TestHarness — run many (spec, seed, buggify) tuples,
 triage failures, and hand back an exact repro line.  Unlike
 run_ensemble.py this runner (a) uses testing.run_simulation, so every
 run carries its unseed (the determinism witness), (b) can double-run
-each tuple and fail on unseed mismatch (--verify-unseed), and (c) emits
-a machine-readable JSON summary with a copy-pastable repro command per
-failure.
+each tuple and fail on unseed mismatch (--verify-unseed), in-process or
+against a freshly spawned subprocess (--cross-process), and (c) emits a
+machine-readable JSON summary with a copy-pastable repro command per
+failure plus the current flowlint static findings (a chaos failure
+sitting next to a fresh FTL001 wall-clock finding is usually not a
+coincidence).
+
+PYTHONHASHSEED: str-set iteration orders depend on the per-process hash
+salt, so cross-process unseed reproduction REQUIRES a pinned seed.  This
+runner re-execs itself once with PYTHONHASHSEED=0 when it finds hashing
+randomized, pins the same seed into every subprocess it spawns, and
+prefixes every repro command it prints accordingly.
 
     python scripts/run_chaos.py --seeds 5
     python scripts/run_chaos.py --spec tests/specs/ChaosTest.toml --seed 17
     python scripts/run_chaos.py --seeds 3 --verify-unseed --json out.json
+    python scripts/run_chaos.py --seeds 3 --verify-unseed --cross-process
 """
 
 import argparse
 import glob
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 import traceback
 
@@ -28,34 +40,104 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 DEFAULT_SPECS = ("ChaosTest.toml", "CycleTest.toml", "TenantTest.toml")
 
 
+def _ensure_hash_seed_pinned() -> None:
+    """Re-exec once with PYTHONHASHSEED=0 if str hashing is randomized:
+    every run this matrix produces must be reproducible from the repro
+    command it prints, including across processes."""
+    from foundationdb_tpu.testing import effective_hash_seed
+    if effective_hash_seed() is not None:
+        return
+    env = dict(os.environ, PYTHONHASHSEED="0")
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def repro_command(spec_path: str, seed: int, buggify: bool,
-                  verify: bool) -> str:
-    cmd = (f"python scripts/run_chaos.py --spec {spec_path} "
-           f"--seed {seed}")
+                  verify: bool, cross_process: bool = False) -> str:
+    from foundationdb_tpu.testing import repro_hash_seed_prefix
+    cmd = (f"{repro_hash_seed_prefix()}python scripts/run_chaos.py "
+           f"--spec {spec_path} --seed {seed}")
     if not buggify:
         cmd += " --no-buggify"
+    elif seed % 2 != 0:
+        # Seed parity alone would leave buggify off for this tuple.
+        cmd += " --buggify"
     if verify:
         cmd += " --verify-unseed"
+        if cross_process:
+            # A divergence caught only across processes often passes the
+            # in-process double run — the repro must use the same mode.
+            cmd += " --cross-process"
     return cmd
 
 
+def _run_in_subprocess(spec_path: str, seed: int, buggify: bool) -> dict:
+    """One run of the tuple in a FRESH process (PYTHONHASHSEED pinned to
+    this process's effective seed) via --emit-run-json; returns its
+    result record."""
+    from foundationdb_tpu.testing import effective_hash_seed
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        out_path = tf.name
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--spec", spec_path, "--seed", str(seed),
+           "--emit-run-json", out_path,
+           # Explicit, not re-derived from seed parity in the child: the
+           # verification run must use EXACTLY the caller's buggify.
+           "--buggify" if buggify else "--no-buggify"]
+    env = dict(os.environ,
+               PYTHONHASHSEED=effective_hash_seed() or "0",
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1800, env=env)
+        # A failed child still writes its full result record (kind,
+        # error, traceback) before exiting 1 — prefer that to scraping
+        # stderr, which is usually empty.
+        try:
+            with open(out_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"ok": False, "kind": "subprocess_error",
+                    "error": (proc.stderr or proc.stdout)[-2000:]}
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+
 def run_tuple(spec_path: str, seed: int, buggify: bool,
-              verify_unseed: bool) -> dict:
+              verify_unseed: bool, cross_process: bool = False) -> dict:
     """One (spec, seed, buggify) run; returns a result record.  With
-    verify_unseed the tuple runs TWICE and an unseed mismatch is a
-    failure in its own right (kind 'nondeterminism')."""
+    verify_unseed the tuple runs TWICE — in-process, or with the second
+    run in a fresh subprocess (cross_process) — and an unseed mismatch
+    is a failure in its own right (kind 'nondeterminism')."""
     from foundationdb_tpu.testing import run_simulation, run_test_twice
     spec_text = open(spec_path).read()
     t0 = time.time()
     rec = {"spec": os.path.basename(spec_path), "seed": seed,
            "buggify": buggify, "ok": False}
     try:
-        if verify_unseed:
+        if verify_unseed and cross_process:
+            r1 = run_simulation(spec_text, seed, buggify=buggify)
+            r2 = _run_in_subprocess(spec_path, seed, buggify)
+            if not r2.get("ok"):
+                raise RuntimeError(f"cross-process run failed: "
+                                   f"{r2.get('error', r2)}")
+            mine = {"unseed": r1.unseed, "digest": r1.digest,
+                    "folds": r1.folds}
+            theirs = {k: r2.get(k) for k in mine}
+            if mine != theirs:
+                raise AssertionError(
+                    f"unseed mismatch for seed {seed} ACROSS PROCESSES: "
+                    f"in-process {mine} vs subprocess {theirs} "
+                    "(PYTHONHASHSEED is pinned, so this is real "
+                    "nondeterminism, not str-hash order)")
+        elif verify_unseed:
             r1, _r2 = run_test_twice(spec_text, seed, buggify=buggify)
         else:
             r1 = run_simulation(spec_text, seed, buggify=buggify)
-        rec.update(ok=True, unseed=r1.unseed, folds=r1.folds,
-                   metrics=r1.metrics,
+        rec.update(ok=True, unseed=r1.unseed, digest=r1.digest,
+                   folds=r1.folds, metrics=r1.metrics,
                    nondeterminism=r1.nondeterminism)
     except AssertionError as e:
         kind = ("nondeterminism" if "unseed mismatch" in str(e)
@@ -69,8 +151,26 @@ def run_tuple(spec_path: str, seed: int, buggify: bool,
     rec["seconds"] = round(time.time() - t0, 1)
     if not rec["ok"]:
         rec["repro"] = repro_command(spec_path, seed, buggify,
-                                     verify_unseed)
+                                     verify_unseed, cross_process)
     return rec
+
+
+def collect_flowlint() -> dict:
+    """Static findings for the summary, via the flowlint CLI's JSON
+    output (so the chaos report and the lint CLI can never disagree).
+    Fail-soft: a lint crash must not take the chaos matrix down."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "flowlint.py"),
+             "--format", "json"],
+            capture_output=True, text=True, timeout=300)
+        data = json.loads(proc.stdout)
+        return {"exit_code": proc.returncode,
+                "counts": data.get("counts", {}),
+                "findings": data.get("findings", [])[:20]}
+    except Exception as e:  # noqa: BLE001
+        return {"exit_code": -1, "error": f"{type(e).__name__}: {e}"}
 
 
 def main() -> int:
@@ -85,11 +185,34 @@ def main() -> int:
                     help="run one seed only (repro mode)")
     ap.add_argument("--first-seed", type=int, default=100)
     ap.add_argument("--no-buggify", action="store_true")
+    ap.add_argument("--buggify", action="store_true",
+                    help=argparse.SUPPRESS)  # subprocess-side of
+    #                        --cross-process: force buggify ON regardless
+    #                        of the seed-parity default
     ap.add_argument("--verify-unseed", action="store_true",
                     help="run every tuple twice; unseed mismatch fails")
+    ap.add_argument("--cross-process", action="store_true",
+                    help="with --verify-unseed: second run in a fresh "
+                         "subprocess (PYTHONHASHSEED pinned) instead of "
+                         "in-process")
+    ap.add_argument("--emit-run-json", default=None, metavar="PATH",
+                    help=argparse.SUPPRESS)   # subprocess-side of
+    #                                           --cross-process
     ap.add_argument("--json", default=None,
                     help="write the JSON summary here (default stdout)")
     args = ap.parse_args()
+
+    _ensure_hash_seed_pinned()
+
+    if args.emit_run_json:
+        if not args.spec or args.seed is None:
+            ap.error("--emit-run-json requires --spec and --seed")
+        buggify = args.buggify or \
+            ((not args.no_buggify) and args.seed % 2 == 0)
+        rec = run_tuple(args.spec, args.seed, buggify, False)
+        with open(args.emit_run_json, "w") as f:
+            json.dump(rec, f, default=str)
+        return 0 if rec["ok"] else 1
 
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if args.spec:
@@ -105,8 +228,10 @@ def main() -> int:
     results = []
     for spec_path in specs:
         for seed in seeds:
-            buggify = (not args.no_buggify) and seed % 2 == 0
-            rec = run_tuple(spec_path, seed, buggify, args.verify_unseed)
+            buggify = args.buggify or \
+                ((not args.no_buggify) and seed % 2 == 0)
+            rec = run_tuple(spec_path, seed, buggify, args.verify_unseed,
+                            args.cross_process)
             status = "PASS" if rec["ok"] else f"FAIL({rec.get('kind')})"
             print(f"{status} {rec['spec']} seed={seed} buggify={buggify} "
                   f"({rec['seconds']}s)"
@@ -114,13 +239,16 @@ def main() -> int:
             results.append(rec)
 
     from foundationdb_tpu.core.coverage import missing, report
+    from foundationdb_tpu.testing import effective_hash_seed
     failures = [r for r in results if not r["ok"]]
     summary = {
         "total": len(results),
         "passed": len(results) - len(failures),
+        "hash_seed": effective_hash_seed(),
         "failures": failures,
         "coverage_hit": sorted(k for k, v in report().items() if v),
         "coverage_missing": missing(),
+        "flowlint": collect_flowlint(),
     }
     out = json.dumps(summary, indent=2, default=str)
     if args.json:

@@ -1,0 +1,22 @@
+"""Fixture with NO findings: the idiomatic versions of everything the
+other fixtures do wrong.  flowlint must report nothing here."""
+
+
+async def actor(txn, loop):
+    from foundationdb_tpu.core.scheduler import delay
+    await delay(0.5)
+    txn.set(b"key", b"value")
+    try:
+        await txn.commit()
+    except Exception:
+        raise
+
+
+def deterministic(rng, names):
+    for n in sorted(set(names)):
+        rng.random01()
+    return loop_time(None)
+
+
+def loop_time(loop):
+    return loop.now() if loop else 0.0

@@ -1,0 +1,32 @@
+"""Known-bad fixture: FTL001 wall-clock/entropy in sim-reachable code.
+
+Markers below drive tests/test_flowlint.py: every `# expect: FTLnnn:<line>`
+must be produced exactly, and nothing else."""
+# expect: FTL001:15
+# expect: FTL001:19
+# expect: FTL001:23
+# expect: FTL001:27
+import os
+import random
+import time as _time
+
+
+def stamp():
+    return _time.monotonic()
+
+
+def entropy():
+    return os.urandom(8)
+
+
+def draw():
+    return random.randrange(10)
+
+
+def stamp2():
+    return _time.time_ns()
+
+
+def fine_seeded():
+    # NOT flagged: a seeded instance is deterministic.
+    return random.Random(7).random()

@@ -1,0 +1,18 @@
+"""Known-bad fixture: FTL002 un-awaited coroutine call."""
+# expect: FTL002:10
+
+
+async def refill_cache():
+    return 1
+
+
+async def driver():
+    refill_cache()          # coroutine built and dropped: never runs
+    await refill_cache()    # NOT flagged: awaited
+
+
+def sync_driver():
+    refill_cache()          # expect-line: also flagged outside async
+
+
+# expect: FTL002:15

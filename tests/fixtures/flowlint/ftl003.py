@@ -1,0 +1,53 @@
+"""Known-bad fixture: FTL003 broad except in actor swallows cancellation."""
+# expect: FTL003:8 FTL003:15 FTL002:43
+
+
+async def actor_bare():
+    try:
+        await do_work()
+    except:                     # noqa: E722 - swallows ActorCancelled
+        pass
+
+
+async def actor_base():
+    try:
+        await do_work()
+    except BaseException:       # swallows ActorCancelled
+        log()
+
+
+async def actor_ok_reraise():
+    try:
+        await do_work()
+    except BaseException:       # NOT flagged: re-raises
+        log()
+        raise
+
+
+async def actor_ok_on_error(txn):
+    try:
+        await do_work()
+    except BaseException as e:  # NOT flagged: on_error re-raises
+        await txn.on_error(e)
+
+
+async def actor_ok_narrow():
+    try:
+        await do_work()
+    except Exception:           # NOT flagged: ActorCancelled is a
+        pass                    # BaseException by design (core/error.py)
+
+
+def sync_fn():
+    try:
+        do_work()
+    except BaseException:       # NOT flagged: not an actor
+        pass
+
+
+async def do_work():
+    return None
+
+
+def log():
+    return None

@@ -1,0 +1,31 @@
+"""Known-bad fixture: FTL004 str literal flows into a bytes-key API."""
+# expect: FTL004:6 FTL004:7 FTL004:8 FTL004:9 FTL004:10 FTL004:11 FTL004:15 FTL004:16 FTL004:20 FTL004:21
+
+
+def bad(txn):
+    txn.set("tenant/map", b"v")             # str key
+    txn.set(b"k", "value")                  # str value
+    txn.clear_range("a", b"b")              # str begin
+    txn.watch(f"watch/{1}")                 # f-string key
+    txn.get_range("p/" + chr(49), b"q")     # str concat begin
+    txn.atomic_op("add", "counter", b"\x01")
+
+
+def bad_pack(self):
+    self._pack("relative-key")
+    self._pack_end("end-key")
+
+
+def bad_kw(txn):
+    txn.get_range(begin=b"a", end="b")
+    txn.set(b"k", value=f"count-{1}")   # kv keyword defeats unary exempt
+
+
+def good(txn, sig):
+    txn.set(b"k", b"v")
+    txn.clear_range(b"a", b"b")
+    sig.set("kill")             # NOT flagged: unary .set is a signal
+    cfg = {}
+    cfg.get("name")             # NOT flagged: dict.get excluded
+    self_pack = None
+    return cfg, self_pack

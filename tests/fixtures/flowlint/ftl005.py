@@ -1,0 +1,20 @@
+"""Known-bad fixture: FTL005 set iteration order (PYTHONHASHSEED hazard)."""
+# expect: FTL005:7 FTL005:9 FTL005:11
+
+
+def bad(names):
+    out = []
+    for n in set(names):                    # set() call
+        out.append(n)
+    for n in {"a", "b", "c"}:               # set literal
+        out.append(n)
+    return [x for x in frozenset(names)]    # comprehension over frozenset
+
+
+def good(names):
+    out = []
+    for n in sorted(set(names)):            # NOT flagged: sorted
+        out.append(n)
+    for k in {"a": 1, "b": 2}:              # NOT flagged: dicts are
+        out.append(k)                       # insertion-ordered
+    return out

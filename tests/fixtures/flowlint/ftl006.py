@@ -1,0 +1,18 @@
+"""Known-bad fixture: FTL006 blocking call inside an actor."""
+# expect: FTL006:8 FTL006:9 FTL006:11
+import os
+import time
+
+
+async def actor():
+    time.sleep(0.5)             # stalls the whole reactor
+    with open("state.dat") as f:    # bypasses sim_fs
+        data = f.read()
+    fd = os.open("raw.dat", 0)
+    return data, fd
+
+
+def sync_helper():
+    # NOT flagged: not lexically inside an actor (host-side tool code).
+    with open("spec.toml") as f:
+        return f.read()

@@ -1,0 +1,9 @@
+"""Known-bad fixture: FTL007 TraceEvent naming + schema drift (with
+ftl007b.py, which emits 'DriftType' with a different detail schema)."""
+# expect: FTL007:0 FTL007:7
+
+
+def emit():
+    TraceEvent("badCamelName").detail("K", 1).log()
+    TraceEvent("DriftType").detail("Alpha", 1).log()
+    TraceEvent("GoodName").detail("K", 1).log()     # NOT flagged

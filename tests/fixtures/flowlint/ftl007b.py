@@ -1,0 +1,5 @@
+"""Second module of the FTL007 schema-drift pair (see ftl007.py)."""
+
+
+def emit():
+    TraceEvent("DriftType").detail("Beta", 2).log()

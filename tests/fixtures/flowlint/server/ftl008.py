@@ -1,0 +1,8 @@
+"""Known-bad fixture: FTL008 hardcoded tunable in a server/ hot path."""
+# expect: FTL008:4
+
+_RETRY_BACKOFF_S = 0.25         # float tunable: belongs in core/knobs.py
+
+_MAGIC = 0x0FDB                 # NOT flagged: int format constant
+_OP_SET = 0                     # NOT flagged: int opcode
+lowercase_float = 0.5           # NOT flagged: not a CONSTANT name
