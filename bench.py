@@ -60,6 +60,39 @@ PIPELINE_DEPTH = 8
 CAPACITY = 1 << 21
 DELTA_CAPACITY = 1 << 20
 
+# Supervised depth sweep (ISSUE 6): per-depth throughput through the
+# pipelined SupervisedConflictSet (CONFLICT_PIPELINE_DEPTH), depths
+# 1..SWEEP_MAX_DEPTH, cross-depth verdicts asserted bit-identical.  The
+# env var narrows/widens the sweep (e.g. CONFLICT_PIPELINE_DEPTH=2).
+SWEEP_MAX_DEPTH = max(1, min(
+    int(os.environ.get("CONFLICT_PIPELINE_DEPTH", "3")), 4))
+N_SWEEP_WARMUP = 2
+N_SWEEP = 4                # measured batches per depth (6 under SMALL)
+SWEEP_TXNS = None          # per-batch txns for the sweep (None = main size)
+SWEEP_CAPACITY = None      # sweep window sizing (None = main CAPACITY)
+SWEEP_DELTA_CAPACITY = None
+# Fallback-mode sweep: the XLA-CPU "device" has no transfer link, so the
+# pipeline has nothing to hide there (and this container is single-core:
+# host pack and XLA compute share the silicon outright).  The sweep
+# therefore emulates the ROUND-5 MEASURED axon tunnel transfer profile
+# (PERF.md: ~8 MB/s pipelined h2d at ~12.7 B/range packed, ~33 ms d2h
+# verdict fetch) as dispatch/fetch-lane sleeps — the latency structure
+# the depth-N pipeline exists to overlap.  Real-TPU runs never emulate
+# (their transfers are real); the JSON labels the emulation explicitly.
+TUNNEL_H2D_MB_S = 8.0
+TUNNEL_BYTES_PER_RANGE = 12.7
+TUNNEL_D2H_S = 0.033
+
+# BASELINE config 5 (sharded mode only): fill the mesh-sharded window to
+# >= 1M in-flight ranges (floor frozen), measure fill throughput and an
+# at-capacity conflict probe.  Equi-depth splits (splits_from_sample)
+# spread the bench's shared-prefix keyspace across the "kr" shards so
+# per-shard windows actually multiply capacity.
+CONFIG5_TXNS = 65_536
+CONFIG5_TARGET_RANGES = 1_000_000
+CONFIG5_CAPACITY = 1 << 22          # total boundaries across shards
+CONFIG5_DELTA = 1 << 20
+
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 # The whole run is budgeted from ONE externally supplied deadline
 # (BENCH_DEADLINE_S): round 5 lost its entire window because the probe
@@ -184,6 +217,247 @@ def run_parity_regime(make_cs, batches, floor, label: str):
     return committed / max(n, 1)
 
 
+class _EmulatedHandle:
+    """d2h half of the tunnel emulation: the fetch-lane sleep occupies
+    the emulated link before the (instant, XLA-CPU) verdict fetch."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def wait_codes(self):
+        time.sleep(TUNNEL_D2H_S)
+        return self._inner.wait_codes()
+
+    def wait(self):
+        time.sleep(TUNNEL_D2H_S)
+        return self._inner.wait()
+
+
+class TunnelEmulatedBackend:
+    """Raw device backend behind the ROUND-5 MEASURED axon tunnel
+    transfer profile (see TUNNEL_* constants), as sleeps on the
+    supervisor's dispatch/fetch lanes: h2d = packed bytes / 8 MB/s before
+    the step enqueue, d2h = 33 ms before the verdict fetch.  Fallback
+    depth-sweep only, labeled in the JSON — never a headline figure."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def resolve_encoded_async(self, enc, now, new_oldest_version=None):
+        time.sleep(enc.n_ranges * TUNNEL_BYTES_PER_RANGE /
+                   (TUNNEL_H2D_MB_S * 1e6))
+        return _EmulatedHandle(self._inner.resolve_encoded_async(
+            enc, now, new_oldest_version))
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def run_depth_sweep(make_cs, floor, emulate_tunnel):
+    """Supervised depth sweep (ISSUE 6): the SAME batch stream through
+    the pipelined SupervisedConflictSet at CONFLICT_PIPELINE_DEPTH =
+    1..SWEEP_MAX_DEPTH.  In-order verdict delivery makes the pipeline
+    invisible to results, so cross-depth verdicts are asserted
+    bit-identical (parity vs the oracle rides the main regimes, which
+    compare the identical device kernels).  Returns {depth: ranges/s}."""
+    global TXNS_PER_BATCH, CAPACITY, DELTA_CAPACITY
+    from foundationdb_tpu.conflict.supervisor import SupervisedConflictSet
+    from foundationdb_tpu.core.knobs import server_knobs
+
+    knobs = server_knobs()
+    saved_depth = knobs.CONFLICT_PIPELINE_DEPTH
+    saved_txns = TXNS_PER_BATCH
+    saved_caps = (CAPACITY, DELTA_CAPACITY)
+    if SWEEP_TXNS:
+        TXNS_PER_BATCH = SWEEP_TXNS
+    if SWEEP_CAPACITY:
+        # The sweep's batch size needs its own window sizing; the main
+        # phases keep theirs (round-over-round comparability).
+        CAPACITY = SWEEP_CAPACITY
+        DELTA_CAPACITY = SWEEP_DELTA_CAPACITY
+
+    def make_device(oldest_version=0):
+        dev = make_cs(oldest_version)
+        return TunnelEmulatedBackend(dev) if emulate_tunnel else dev
+
+    try:
+        rng = np.random.default_rng(909)
+        stream = []
+        version = 1_000
+        for _ in range(N_SWEEP_WARMUP + N_SWEEP):
+            prev, version = version, version + VERSIONS_PER_BATCH
+            enc, kids, snaps = gen_batch(rng, version, prev)
+            stream.append((version, enc, to_transactions(kids, snaps)))
+        measured_ranges = sum(e.n_ranges for _v, e, _t in
+                              stream[N_SWEEP_WARMUP:])
+        per_depth = {}
+        ref_codes = None
+        for depth in range(1, SWEEP_MAX_DEPTH + 1):
+            knobs.CONFLICT_PIPELINE_DEPTH = depth
+            sup = SupervisedConflictSet(make_device)
+            for v, enc, txns in stream[:N_SWEEP_WARMUP]:
+                sup.resolve_encoded_async(
+                    enc, v, floor(v), transactions=txns).wait_codes()
+            handles = []
+            t0 = time.perf_counter()
+            for v, enc, txns in stream[N_SWEEP_WARMUP:]:
+                handles.append(sup.resolve_encoded_async(
+                    enc, v, floor(v), transactions=txns))
+            codes = np.concatenate([h.wait_codes() for h in handles])
+            dt = time.perf_counter() - t0
+            if sup.degraded or sup.stats["fallback_batches"]:
+                print(f"depth {depth}: supervised sweep degraded to the "
+                      "mirror (not a device measurement)", file=sys.stderr)
+                sys.exit(1)
+            if ref_codes is None:
+                ref_codes = codes
+            elif not np.array_equal(ref_codes, codes):
+                print("PARITY FAILURE: depth-sweep verdicts diverge "
+                      f"between depth 1 and depth {depth}", file=sys.stderr)
+                sys.exit(1)
+            per_depth[str(depth)] = round(measured_ranges / dt, 1)
+            _phase(f"supervised depth {depth}: "
+                   f"{measured_ranges / dt:.0f} ranges/s "
+                   f"(stalls={sup.stats['pipeline_stalls']})")
+        return per_depth
+    finally:
+        knobs.CONFLICT_PIPELINE_DEPTH = saved_depth
+        TXNS_PER_BATCH = saved_txns
+        CAPACITY, DELTA_CAPACITY = saved_caps
+
+
+def run_config5():
+    """BASELINE config 5: fill the mesh-sharded window (equi-depth key
+    splits, pipelined supervisor) to >= CONFIG5_TARGET_RANGES in-flight
+    ranges with the floor frozen, then prove the window answers with an
+    at-capacity conflict probe.  Returns the JSON "config5" record."""
+    global TXNS_PER_BATCH
+    from collections import deque
+
+    import jax
+
+    from foundationdb_tpu.conflict.supervisor import SupervisedConflictSet
+    from foundationdb_tpu.core.knobs import server_knobs
+    from foundationdb_tpu.parallel.sharded_resolver import (
+        ShardedTpuConflictSet)
+    from foundationdb_tpu.parallel.sharded_window import (
+        make_conflict_mesh, splits_from_sample)
+    from foundationdb_tpu.txn.types import (CommitResult,
+                                            CommitTransactionRef, KeyRange)
+
+    knobs = server_knobs()
+    saved_txns, TXNS_PER_BATCH = TXNS_PER_BATCH, CONFIG5_TXNS
+    saved_depth = knobs.CONFLICT_PIPELINE_DEPTH
+    depth = min(SWEEP_MAX_DEPTH, 3)
+    knobs.CONFLICT_PIPELINE_DEPTH = depth
+    try:
+        mesh = make_conflict_mesh(jax.devices())
+        n_kr = int(mesh.shape["kr"])
+        rng = np.random.default_rng(5055)
+        # Equi-depth splits from a workload sample: bench keys share the
+        # b"k000..." prefix, so lane-0 splits would land EVERYTHING on
+        # one shard and void the capacity multiplier.
+        sample_enc, _k, _s = gen_batch(rng, 2_000, 1_000,
+                                       keyspace=KEYSPACE_LOW, zipf=False)
+        splits = splits_from_sample(sample_enc.w_begin, n_kr)
+
+        def make_device(oldest_version=0):
+            return ShardedTpuConflictSet(
+                mesh, oldest_version, capacity=CONFIG5_CAPACITY // n_kr,
+                delta_capacity=CONFIG5_DELTA // n_kr, splits=splits)
+
+        sup = SupervisedConflictSet(make_device)
+        _phase(f"config5: filling the {n_kr}-shard window to >= "
+               f"{CONFIG5_TARGET_RANGES} in-flight ranges "
+               f"({CONFIG5_TXNS} txns/batch, depth {depth})")
+        committed_code = int(CommitResult.COMMITTED)
+        version = 2_000
+
+        def next_batch():
+            nonlocal version
+            prev, version = version, version + VERSIONS_PER_BATCH
+            enc, kids, snaps = gen_batch(rng, version, prev,
+                                         keyspace=KEYSPACE_LOW, zipf=False)
+            return version, enc, to_transactions(kids, snaps), kids
+
+        # Warmup/compile batch (also the probe target below).
+        v, enc, txns, probe_kids = next_batch()
+        codes = sup.resolve_encoded_async(
+            enc, v, 0, transactions=txns).wait_codes()
+        inserted = int(np.sum(codes == committed_code))
+        n_ranges = 0
+        batches = 1
+        inflight = deque()
+
+        def drain_one():
+            nonlocal inserted, n_ranges
+            enc_d, h = inflight.popleft()
+            c = h.wait_codes()
+            inserted += int(np.sum(c == committed_code))
+            n_ranges += enc_d.n_ranges
+
+        t0 = time.perf_counter()
+        while inserted < CONFIG5_TARGET_RANGES:
+            v, enc, txns, _kids = next_batch()
+            inflight.append((enc, sup.resolve_encoded_async(
+                enc, v, 0, transactions=txns)))
+            batches += 1
+            while len(inflight) >= depth:
+                drain_one()
+        while inflight:
+            drain_one()
+        dt = time.perf_counter() - t0
+        if sup.degraded or sup.stats["fallback_batches"]:
+            # fallback_batches too: a transient degrade-then-repromote
+            # mid-fill would contaminate the fill rate with mirror-speed
+            # batches while leaving sup.degraded False at the end.
+            print("config5: supervised backend degraded mid-fill",
+                  file=sys.stderr)
+            sys.exit(1)
+        shard_sizes = sup.device.shard_sizes()
+        segments = sup.segment_count()      # exact mirror census
+        # At-capacity probe: re-read the first batch's COMMITTED write
+        # keys at snapshot 0 — every one must conflict against the
+        # filled window.  Aborted txns (intra-batch read-write
+        # collisions) never inserted their write, so their keys are
+        # filtered out (kids[nr + i] is txn i's single write key, and
+        # codes[i] is its verdict — gen_batch layout).
+        nr = TXNS_PER_BATCH * READS_PER_TXN
+        committed_writes = np.asarray(probe_kids[nr:])[
+            np.asarray(codes) == committed_code]
+        probe = [CommitTransactionRef(
+                    read_snapshot=0,
+                    read_conflict_ranges=[KeyRange(k, k + b"\x00")])
+                 for k in (b"k%014d" % int(x)
+                           for x in committed_writes[:2048])]
+        verdicts = sup.resolve(probe, version + VERSIONS_PER_BATCH, 0)
+        conflicts = sum(1 for x in verdicts if x == CommitResult.CONFLICT)
+        rate = n_ranges / dt if dt > 0 else 0.0
+        _phase(f"config5: {inserted} in-flight ranges, shards "
+               f"{shard_sizes}, fill {rate:.0f} ranges/s, probe "
+               f"{conflicts}/{len(probe)} conflicts")
+        if conflicts != len(probe):
+            print("config5: at-capacity probe missed conflicts",
+                  file=sys.stderr)
+            sys.exit(1)
+        spread = sum(1 for s in shard_sizes if s > 1)
+        return {
+            "in_flight_ranges": inserted,
+            "window_segments": segments,
+            "shard_base_sizes": shard_sizes,
+            "shards_holding_state": spread,
+            "n_shards": n_kr,
+            "fill_ranges_per_s": round(rate, 1),
+            "fill_batches": batches,
+            "txns_per_batch": CONFIG5_TXNS,
+            "pipeline_depth": depth,
+            "probe_conflicts": conflicts,
+        }
+    finally:
+        TXNS_PER_BATCH = saved_txns
+        knobs.CONFLICT_PIPELINE_DEPTH = saved_depth
+
+
 def _force_cpu_backend() -> None:
     """Deregister the axon TPU-tunnel plugin: jax initializes ALL
     registered PJRT plugins on first use and the axon client creation can
@@ -239,8 +513,21 @@ def child_main(backend: str) -> None:
         N_PARITY = 2
         N_LATENCY = 2
         N_LOWC = 2
+        # Degraded MAIN-figure sizing (unchanged across rounds so the
+        # fallback figure stays comparable round over round).
         CAPACITY = 1 << 16
         DELTA_CAPACITY = 1 << 15
+        # Depth sweep under the fallback: mid-size batches (so compute,
+        # pack and the emulated tunnel transfers are comparable — the
+        # regime the pipeline targets) over the emulated link, with its
+        # OWN window sizing (run_depth_sweep swaps it in): a 5-batch MVCC
+        # window of 16K-txn zipf uniques fits with headroom; delta holds
+        # one batch's 2W+2 boundaries without a grow.
+        global N_SWEEP, SWEEP_TXNS, SWEEP_CAPACITY, SWEEP_DELTA_CAPACITY
+        N_SWEEP = 6
+        SWEEP_TXNS = 16_384
+        SWEEP_CAPACITY = 1 << 18
+        SWEEP_DELTA_CAPACITY = 1 << 16
     from foundationdb_tpu.conflict.oracle import OracleConflictSet
     from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
     from foundationdb_tpu.txn.types import CommitResult
@@ -281,7 +568,7 @@ def child_main(backend: str) -> None:
             "vs_baseline": round(value / NORTH_STAR_RANGES_PER_S, 4)}))
         return
 
-    def make_cs():
+    def make_cs(oldest_version=0):
         if os.environ.get("BENCH_BACKEND") == "sharded":
             # BASELINE config 5 axis: the REAL resolve step sharded over
             # every attached device ("kr" mesh); per-shard capacity makes
@@ -296,9 +583,9 @@ def child_main(backend: str) -> None:
             _phase(f"sharded backend: {n_kr} 'kr' shard(s) over "
                    f"{len(jax.devices())} device(s)")
             return ShardedTpuConflictSet(
-                mesh, 0, capacity=CAPACITY // n_kr,
+                mesh, oldest_version, capacity=CAPACITY // n_kr,
                 delta_capacity=DELTA_CAPACITY // n_kr)
-        return TpuConflictSet(0, capacity=CAPACITY,
+        return TpuConflictSet(oldest_version, capacity=CAPACITY,
                               delta_capacity=DELTA_CAPACITY)
 
     cs = make_cs()
@@ -394,11 +681,36 @@ def child_main(backend: str) -> None:
               file=sys.stderr)
         sys.exit(1)
 
+    # ---- supervised depth sweep (pipelined dispatch, ISSUE 6) -------------
+    # Real device: transfers are real, no emulation.  XLA-CPU fallback:
+    # emulate the measured tunnel link on the lanes (see TUNNEL_*).
+    emulate_tunnel = os.environ.get(
+        "BENCH_TUNNEL_EMU",
+        "1" if os.environ.get("JAX_PLATFORMS") == "cpu" else "0") == "1"
+    _phase("low-contention parity ok; supervised depth sweep next"
+           + (" (emulated tunnel link)" if emulate_tunnel else ""))
+    per_depth = run_depth_sweep(make_cs, floor, emulate_tunnel)
+    d1 = per_depth.get("1", 0.0)
+    best_depth, best_rate = max(per_depth.items(), key=lambda kv: kv[1])
+    speedup = best_rate / d1 if d1 else 0.0
+    if speedup < 1.2:
+        # Informational, not fatal: a loaded box can flatten the overlap;
+        # the recorded PERF figure is what the acceptance gate reads.
+        print(f"# WARNING: best pipeline speedup {speedup:.2f}x "
+              f"(depth {best_depth}) below the 1.2x target",
+              file=sys.stderr)
+
+    # ---- BASELINE config 5: 1M in-flight ranges on the sharded mesh -------
+    config5 = None
+    if os.environ.get("BENCH_BACKEND") == "sharded" and \
+            os.environ.get("BENCH_CONFIG5", "1") != "0":
+        config5 = run_config5()
+
     print(f"# commit_rate={commit_rate:.3f} low={commit_rate_low:.3f} "
           f"oracle={oracle_rate:.0f}/s tpu={value:.0f}/s p50={p50_ms:.2f}ms",
           file=sys.stderr)
 
-    print(json.dumps({
+    doc = {
         "metric": "conflict_range_checks_per_s",
         "value": round(value, 1),
         "unit": "ranges/s",
@@ -409,7 +721,22 @@ def child_main(backend: str) -> None:
         "commit_rate": round(commit_rate, 3),
         "commit_rate_low": round(commit_rate_low, 3),
         "txns_per_batch": TXNS_PER_BATCH,
-    }))
+        "per_depth": per_depth,
+        "pipeline_speedup": round(speedup, 3),
+        "pipeline_best_depth": int(best_depth),
+        "sweep_txns_per_batch": SWEEP_TXNS or TXNS_PER_BATCH,
+    }
+    if emulate_tunnel:
+        # The fallback sweep ran against the round-5 measured tunnel
+        # profile as lane sleeps (no real link on XLA-CPU to overlap).
+        doc["sweep_emulated_tunnel"] = {
+            "h2d_mb_s": TUNNEL_H2D_MB_S,
+            "bytes_per_range": TUNNEL_BYTES_PER_RANGE,
+            "d2h_latency_s": TUNNEL_D2H_S,
+        }
+    if config5 is not None:
+        doc["config5"] = config5
+    print(json.dumps(doc))
 
 
 # ---------------------------------------------------------------------------
@@ -504,6 +831,15 @@ def _run_child(backend: str, platform_env: str, timeout_s: int):
     env.pop("JAX_PLATFORMS", None)
     if platform_env:
         env["JAX_PLATFORMS"] = platform_env
+    if platform_env == "cpu" and env.get("BENCH_BACKEND") == "sharded" and \
+            "xla_force_host_platform_device_count" not in \
+            env.get("XLA_FLAGS", ""):
+        # The sharded config-5 datapoint needs a mesh even on the XLA-CPU
+        # fallback: stand up the 8-device virtual mesh (BASELINE's
+        # stand-in until the real tunnel answers).
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), backend],

@@ -445,6 +445,133 @@ class HardcodedTunableRule(Rule):
                        "BUGGIFY-randomizable, dynamic-knob updatable)")
 
 
+class KnobNameRule(Rule):
+    """FTL009: a knob attribute name that does not exist on its knob
+    class — the typo class dynamic knob plumbing makes silent.
+
+    ``knobs.CONFLICT_DEVICE_TIMEOUT_SEC`` raises AttributeError only on
+    the (possibly rare) path that reads it, and ``getattr(knobs, "NAME",
+    default)`` never raises at all — a misspelled knob quietly pins the
+    default forever.  The rule audits every ALL-CAPS attribute access
+    (and getattr with a literal name) on values produced by the knob
+    factories (``server_knobs()`` / ``client_knobs()``) against the
+    field set statically extracted from core/knobs.py's ``self.NAME =``
+    assignments, so the check needs no import of the linted code."""
+
+    id = "FTL009"
+    title = "unknown knob name (typo against the knob class field set)"
+
+    FACTORIES = {"server_knobs": "ServerKnobs",
+                 "client_knobs": "ClientKnobs"}
+    NAME = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+    def __init__(self, knobs_source: Optional[str] = None) -> None:
+        self._fields = self._load_fields(knobs_source)
+        self._vars: Dict[str, str] = {}
+
+    @staticmethod
+    def _load_fields(src_path: Optional[str] = None) -> Dict[str, Set[str]]:
+        """{knob class -> field names} from core/knobs.py's AST (every
+        ``self.NAME = ...`` in each class body)."""
+        import os
+        if src_path is None:
+            src_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "core", "knobs.py")
+        fields: Dict[str, Set[str]] = {}
+        try:
+            with open(src_path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError, ValueError):
+            return fields          # no knob source: rule reports nothing
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            names: Set[str] = set()
+            for n in ast.walk(node):
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            names.add(t.attr)
+            fields[node.name] = names
+        return fields
+
+    def _factory_class(self, node: ast.expr, ctx) -> Optional[str]:
+        """Knob class name when `node` is a knob-factory call."""
+        if not isinstance(node, ast.Call):
+            return None
+        name = ctx.resolve_call(node.func)
+        if name is None and isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        if name is None:
+            return None
+        return self.FACTORIES.get(name.rsplit(".", 1)[-1])
+
+    _SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+               ast.Module)
+
+    @classmethod
+    def _scope(cls, node: ast.AST, ctx) -> Optional[ast.AST]:
+        """Nearest enclosing function (or Module) of `node`."""
+        n = ctx.parent(node)
+        while n is not None and not isinstance(n, cls._SCOPES):
+            n = ctx.parent(n)
+        return n
+
+    def begin_file(self, ctx) -> None:
+        # Variables assigned from a factory call (`knobs =
+        # server_knobs()`), keyed by ENCLOSING SCOPE: two functions may
+        # bind the same name to different knob classes, so a file-wide
+        # name map would resolve one of them wrongly (false FTL009 on a
+        # valid knob read, or a masked real typo).
+        self._vars = {}
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                cls = self._factory_class(n.value, ctx)
+                if cls is not None:
+                    scope = self._scope(n, ctx)
+                    self._vars[(id(scope), n.targets[0].id)] = cls
+
+    def _receiver_class(self, node: ast.expr, ctx) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            # Same-scope binding first, then a module-level one (the
+            # common shared `knobs = server_knobs()` constant).  No
+            # other-function fallback: that is exactly the wrong-class
+            # hazard the scoping exists to avoid.
+            scope = self._scope(node, ctx)
+            cls = self._vars.get((id(scope), node.id))
+            if cls is None and not isinstance(scope, ast.Module):
+                cls = self._vars.get((id(ctx.tree), node.id))
+            return cls
+        return self._factory_class(node, ctx)
+
+    def _check(self, cls: str, attr: str, node: ast.AST, ctx) -> None:
+        known = self._fields.get(cls)
+        if not known or not self.NAME.match(attr) or attr in known:
+            return
+        ctx.report(self, node,
+                   f"unknown knob {cls}.{attr}: no such field in "
+                   "core/knobs.py (typo? getattr defaults would mask it "
+                   "silently)")
+
+    def visit(self, node: ast.AST, ctx) -> None:
+        if isinstance(node, ast.Attribute):
+            cls = self._receiver_class(node.value, ctx)
+            if cls is not None:
+                self._check(cls, node.attr, node, ctx)
+            return
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "getattr" and len(node.args) >= 2:
+            cls = self._receiver_class(node.args[0], ctx)
+            arg = node.args[1]
+            if cls is not None and isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str):
+                self._check(cls, arg.value, node, ctx)
+
+
 def make_rules() -> List[Rule]:
     """Fresh rule instances — ALWAYS construct per run: rules carry
     cross-file state (TraceEventRule._by_type), so sharing instances
@@ -453,4 +580,4 @@ def make_rules() -> List[Rule]:
     return [WallClockRule(), UnawaitedCoroutineRule(),
             BroadExceptInActorRule(), StrKeyRule(), SetIterationRule(),
             BlockingInActorRule(), TraceEventRule(),
-            HardcodedTunableRule()]
+            HardcodedTunableRule(), KnobNameRule()]

@@ -15,7 +15,9 @@ programs over a tiered window:
 Per-batch step (make_resolve_step):
     too-old -> history query -> intra-batch fixpoint -> insert into DELTA
   History max over [b,e) = max(base range-max via the stored table, delta
-  range-max via a table built over DCAP).  This is EXACT, not conservative:
+  range-max via the HOISTED delta table — device state refreshed by
+  delta_table_step after every insert/merge, never rebuilt inside the
+  per-batch step).  This is EXACT, not conservative:
   wherever delta covers a key its version is newer than base's (versions are
   monotone), so pointwise max(base_V, delta_V) equals the true V(k).
   Per-batch device work is O(batch * log CAP + DCAP log DCAP) — independent
@@ -55,8 +57,8 @@ import numpy as np
 
 from ..ops.digest import (KEY_LANES, MAX_DIGEST, PREFIX_BYTES, ROW_PAD,
                           gather_cols, lex_eq, lex_less, planar_to_rows,
-                          rank_count, rows_to_planar, searchsorted_left,
-                          searchsorted_right)
+                          rank_count, rows_to_planar, searchsorted_interval,
+                          searchsorted_left, searchsorted_right)
 from ..ops.digest import lex_max_cols as _lex_max_cols
 from ..ops.digest import lex_min_cols as _lex_min_cols
 from ..ops.rangemax import NEG_INF, build_sparse_table, range_max
@@ -141,6 +143,15 @@ def compact_layout(t_cap: int, r_pad: int, w_pad: int, u_pad: int,
 def make_delta_state(d_cap: int) -> WindowState:
     """Fresh transparent delta: one segment covering all keys at NEG_INF."""
     return make_window_state(d_cap, int(NEG_INF))
+
+
+# Hoisted delta range-max table (ISSUE 6): the per-batch resolve step no
+# longer rebuilds build_sparse_table(dv) on its critical path — the table
+# is device state, threaded through the step signature like dk/dv, and
+# refreshed by THIS separate program right after each insert/merge (the
+# host enqueues it asynchronously, so it runs while the host packs the
+# next batch instead of in front of the next batch's history probes).
+delta_table_step = jax.jit(build_sparse_table)
 
 
 def _point_insert(dk, dv, dsize, u_k, u_e, w_uidx, w_ins, now_rel,
@@ -253,14 +264,21 @@ def make_resolve_step_compact(cap: int, d_cap: int, t_cap: int, r_pad: int,
     axis_name: as in make_resolve_step — per-shard body with history bits
     max-combined over the mesh axis; gains a trailing `bounds` argument.
 
-    fn(bk, bv, table, size, dk, dv, dsize, flag, buf[, bounds])
+    fn(bk, bv, table, size, dk, dv, dtable, dsize, flag, buf[, bounds])
       -> (dk', dv', dsize', flag', out)
+
+    `dtable` is the delta range-max table OVER THE INPUT dk/dv — hoisted
+    device state built by delta_table_step after the previous insert, so
+    this program contains no build_sparse_table at all (asserted by
+    tests/test_conflict_pipeline.py::
+    test_resolve_step_contains_no_table_build).
     """
     from ..ops.segtree import INF_I32
     L = lw - 1
     lay = compact_layout(t_cap, r_pad, w_pad, u_pad, lw)
 
-    def step(bk, bv, table, size, dk, dv, dsize, flag, buf, bounds=None):
+    def step(bk, bv, table, size, dk, dv, dtable, dsize, flag, buf,
+             bounds=None):
         # ---- unpack the single byte buffer --------------------------------
         def i32(name, n):
             o = lay[name]
@@ -328,13 +346,12 @@ def make_resolve_step_compact(cap: int, d_cap: int, t_cap: int, r_pad: int,
             u_owned = lex_less(cu_b, cu_e)
         else:
             cu_b, cu_e, u_owned = u_b, u_e, None
-        lo_b = searchsorted_right(bk, cu_b) - 1
-        hi_b = searchsorted_left(bk, cu_e)
-        max_base = range_max(table, lo_b, hi_b)
-        dtable = build_sparse_table(dv)
-        lo_d = searchsorted_right(dk, cu_b) - 1
-        hi_d = searchsorted_left(dk, cu_e)
-        max_delta = range_max(dtable, lo_d, hi_d)
+        # Fused probe pass: begin (right-side) and end (left-side) probes
+        # share ONE binary-search loop per table (base, then delta).
+        pos_b, hi_b = searchsorted_interval(bk, cu_b, cu_e)
+        max_base = range_max(table, pos_b - 1, hi_b)
+        pos_d, hi_d = searchsorted_interval(dk, cu_b, cu_e)
+        max_delta = range_max(dtable, pos_d - 1, hi_d)
         vmax_u = jnp.maximum(max_base, max_delta)
         if u_owned is not None:
             vmax_u = jnp.where(u_owned, vmax_u, NEG_INF)
@@ -401,7 +418,9 @@ def make_resolve_step_compact(cap: int, d_cap: int, t_cap: int, r_pad: int,
 
     if axis_name is not None:
         return step
-    return jax.jit(step, donate_argnums=(4, 5, 6, 7))
+    # dtable (argnum 6) is NOT donated: no output shares its shape (the
+    # successor table is built by the separate delta_table_step program).
+    return jax.jit(step, donate_argnums=(4, 5, 7, 8))
 
 
 @lru_cache(maxsize=64)
@@ -428,16 +447,18 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
     verification fails (tpu_backend._pack_compact returning None), which
     routes them through this general interval program.
 
-    fn(bk, bv, table, size, dk, dv, dsize, flag, digests, meta)
+    fn(bk, bv, table, size, dk, dv, dtable, dsize, flag, digests, meta)
       -> (dk', dv', dsize', flag', out)
     where out = int8[t_cap + 12] (codes, then flag/delta_size/base_size as
     bitcast int32 bytes — see OUT_* above).
-    Base arrays pass through untouched (read-only)."""
+    Base arrays pass through untouched (read-only); `dtable` is the
+    hoisted delta range-max table over the INPUT delta (delta_table_step,
+    see make_resolve_step_compact)."""
     u_cap = _next_pow2(2 * (r_cap + w_cap))
     log_u = u_cap.bit_length() - 1
 
-    def step(bk, bv, table, size, dk, dv, dsize, flag, digests, meta,
-             bounds=None):
+    def step(bk, bv, table, size, dk, dv, dtable, dsize, flag, digests,
+             meta, bounds=None):
         # ---- unpack the two packed input blocks ---------------------------
         r_b = digests[:, 0:r_cap]
         r_e = digests[:, r_cap:2 * r_cap]
@@ -470,13 +491,14 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
             r_hist_live = r_live & lex_less(cr_b, cr_e)
         else:
             cr_b, cr_e, r_hist_live = r_b, r_e, r_live
-        lo_b = searchsorted_right(bk, cr_b) - 1  # segment containing begin
-        hi_b = searchsorted_left(bk, cr_e)       # first boundary >= end
-        max_base = range_max(table, lo_b, hi_b)
-        dtable = build_sparse_table(dv)          # DCAP log DCAP: cheap
-        lo_d = searchsorted_right(dk, cr_b) - 1
-        hi_d = searchsorted_left(dk, cr_e)
-        max_delta = range_max(dtable, lo_d, hi_d)
+        # Fused probe pass (one loop per table): right-side begin probes
+        # ("segment containing begin") and left-side end probes ("first
+        # boundary >= end") share one binary search per tier; the delta
+        # range-max table arrives as hoisted state (delta_table_step).
+        pos_b, hi_b = searchsorted_interval(bk, cr_b, cr_e)
+        max_base = range_max(table, pos_b - 1, hi_b)
+        pos_d, hi_d = searchsorted_interval(dk, cr_b, cr_e)
+        max_delta = range_max(dtable, pos_d - 1, hi_d)
         hist_bits = r_hist_live & (jnp.maximum(max_base, max_delta) > snap_r)
         r_scatter = jnp.where(r_live, r_txn, t_cap)
         hist_conflicted = jnp.zeros((t_cap,), bool).at[r_scatter].max(
@@ -561,9 +583,10 @@ def make_resolve_step(cap: int, d_cap: int, t_cap: int, r_cap: int,
     if axis_name is not None:
         return step
 
-    # digests/meta (argnums 8, 9) are never donatable into the outputs;
-    # donating them only produces per-shape "unusable donation" warnings.
-    return jax.jit(step, donate_argnums=(4, 5, 6, 7))
+    # digests/meta (argnums 9, 10) and dtable (6) are never donatable
+    # into the outputs; donating them only produces per-shape "unusable
+    # donation" warnings.
+    return jax.jit(step, donate_argnums=(4, 5, 7, 8))
 
 
 @lru_cache(maxsize=16)

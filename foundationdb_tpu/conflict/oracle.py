@@ -56,6 +56,41 @@ class VersionHistory:
             self.keys[i:j] = [begin, end]
             self.vals[i:j] = [version, cont_v]
 
+    def insert_many(self, ranges: List[Tuple[bytes, bytes]],
+                    version: Version) -> None:
+        """Batch V(k) := version for SORTED, DISJOINT, non-touching
+        [begin, end) ranges (combine_write_ranges output) in ONE linear
+        rebuild pass: O(n + 2w) instead of w list splices (O(w*n)).
+        Semantics identical to calling insert() per range in order —
+        property-tested in tests/test_conflict_oracle.py.  This is what
+        keeps the supervisor's host mirror off the critical path at
+        bench batch sizes (100K writes/batch into a ~500K-segment
+        window)."""
+        if not ranges:
+            return
+        keys, vals = self.keys, self.vals
+        n = len(keys)
+        out_k: List[bytes] = []
+        out_v: List[Version] = []
+        i = 0
+        for b, e in ranges:
+            j = bisect_left(keys, b, i)      # first boundary >= b
+            out_k.extend(keys[i:j])
+            out_v.extend(vals[i:j])
+            k2 = bisect_left(keys, e, j)     # first boundary >= e
+            out_k.append(b)
+            out_v.append(version)
+            if not (k2 < n and keys[k2] == e):
+                # Continuing version at e: the ORIGINAL segment holding e
+                # (prior ranges end strictly before b, so they never cover
+                # e) — exactly insert()'s cont_v.
+                out_k.append(e)
+                out_v.append(vals[k2 - 1])
+            i = k2
+        out_k.extend(keys[i:])
+        out_v.extend(vals[i:])
+        self.keys, self.vals = out_k, out_v
+
     def remove_before(self, oldest: Version) -> None:
         """Merge adjacent segments both below `oldest` (reference removeBefore
         SkipList.cpp:576: a node is dropped iff it and its predecessor are both
@@ -169,9 +204,9 @@ class OracleConflictSet(ConflictSet):
                     if w.begin < w.end:
                         surviving_writes.append((w.begin, w.end))
 
-        # 4. merge surviving write ranges into history at version `now`.
-        for b, e in combine_write_ranges(surviving_writes):
-            self.history.insert(b, e, now)
+        # 4. merge surviving write ranges into history at version `now`
+        # (one linear pass over the segment list, not per-range splices).
+        self.history.insert_many(combine_write_ranges(surviving_writes), now)
 
         # 5. window GC.
         if new_oldest_version is not None and new_oldest_version > self.oldest_version:

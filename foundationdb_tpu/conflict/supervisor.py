@@ -8,8 +8,16 @@ tunnel).  SupervisedConflictSet wraps any device ConflictSet with the
 failure story the Resolver needs:
 
   * **deadline budget** — every device call runs under the
-    CONFLICT_DEVICE_TIMEOUT_S knob (a worker thread guards the call; a
-    wedged tunnel costs one abandoned thread, never the reactor);
+    CONFLICT_DEVICE_TIMEOUT_S knob (worker lanes guard the calls; a
+    wedged tunnel costs abandoned threads, never the reactor);
+  * **depth-N dispatch pipeline** (CONFLICT_PIPELINE_DEPTH) — up to N
+    batches in flight on the device: batch k+1 host-packs/h2d-enqueues
+    on a dispatch lane while batch k's device step runs and batch k-1's
+    verdicts d2h-prefetch on a fetch lane; verdict DELIVERY stays
+    strictly in submission order (the mirror fold-through, taint
+    pruning, and oldest_version advance are sequential), and a full
+    pipeline folds its oldest batch before admitting a new dispatch
+    (the PipelineStalls counter; occupancy in InflightDepth);
   * **transient retry** — idempotent device calls (the d2h wait, probes)
     retry with exponential backoff on transient errors
     (CONFLICT_DEVICE_MAX_RETRIES / CONFLICT_DEVICE_RETRY_BACKOFF_S);
@@ -180,37 +188,87 @@ class BackendHealthMonitor:
         self.failed_probes = 0
 
 
-class _DeadlineGuard:
-    """Runs device calls under a wall-clock budget on a private worker
-    thread.  A call that exceeds its budget raises timed_out and the
-    (possibly wedged) worker is abandoned — the supervisor then discards
-    the whole device object, so the orphan thread can touch nothing the
-    supervisor still uses.  With budget <= 0 calls run inline."""
+class _DoneFuture:
+    """Already-completed future: the inline (budget <= 0, unguarded)
+    pipeline mode's stand-in for a worker-lane future."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value) -> None:
+        self._value = value
+
+    def result(self, timeout=None):
+        return self._value
+
+
+class _DispatchPipeline:
+    """The supervisor's depth-N dispatch pipeline (generalizing the old
+    single-worker deadline guard).
+
+    Two single-worker lanes preserve in-order device interaction while
+    overlapping the three host-visible phases of neighbouring batches:
+
+      dispatch lane — host pack + h2d enqueue (`dev.resolve_*_async`);
+                      state-mutating, so strictly one at a time, FIFO in
+                      submission order;
+      fetch lane    — d2h verdict wait (`handle.wait*`), prefetched as
+                      soon as the dispatch future exists so a healthy
+                      batch's verdicts are already host-side when the
+                      in-order fold reaches it.
+
+    While batch k's device step runs, batch k+1 packs/h2d-enqueues on the
+    dispatch lane and batch k-1's verdicts d2h-fetch on the fetch lane;
+    the caller thread meanwhile folds delivered verdicts into the mirror.
+
+    The deadline duty is unchanged: collect() bounds any wait on a lane
+    future by the CONFLICT_DEVICE_TIMEOUT_S budget; on timeout BOTH lanes
+    are abandoned (a wedged tunnel costs two orphan threads, never the
+    reactor) and the supervisor discards the whole device object, so the
+    orphans can touch nothing the supervisor still uses.  call() keeps
+    the old synchronous guarded-call shape for control-plane operations
+    (init, promotion rebuild, clear); with budget <= 0 it runs inline."""
 
     def __init__(self) -> None:
-        self._executor = None
+        self._dispatch = None
+        self._fetch = None
+
+    def _lane(self, attr: str, name: str):
+        import concurrent.futures as _cf
+        ex = getattr(self, attr)
+        if ex is None:
+            ex = _cf.ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix=name)
+            setattr(self, attr, ex)
+        return ex
+
+    def submit_dispatch(self, fn: Callable):
+        return self._lane("_dispatch", "conflict-dispatch").submit(fn)
+
+    def submit_fetch(self, fn: Callable):
+        return self._lane("_fetch", "conflict-fetch").submit(fn)
+
+    def collect(self, fut, timeout_s: float):
+        import concurrent.futures as _cf
+        try:
+            return fut.result(
+                timeout=timeout_s if timeout_s > 0 else None)
+        except _cf.TimeoutError:
+            fut.cancel()
+            self.close()
+            raise err("timed_out",
+                      f"device call exceeded {timeout_s}s deadline") from None
 
     def call(self, fn: Callable, timeout_s: float):
         if timeout_s <= 0:
             return fn()
-        import concurrent.futures as _cf
-        if self._executor is None:
-            self._executor = _cf.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="conflict-device")
-        fut = self._executor.submit(fn)
-        try:
-            return fut.result(timeout=timeout_s)
-        except _cf.TimeoutError:
-            fut.cancel()
-            self._executor.shutdown(wait=False)
-            self._executor = None
-            raise err("timed_out",
-                      f"device call exceeded {timeout_s}s deadline") from None
+        return self.collect(self.submit_dispatch(fn), timeout_s)
 
     def close(self) -> None:
-        if self._executor is not None:
-            self._executor.shutdown(wait=False)
-            self._executor = None
+        for attr in ("_dispatch", "_fetch"):
+            ex = getattr(self, attr)
+            if ex is not None:
+                ex.shutdown(wait=False)
+                setattr(self, attr, None)
 
 
 class _SyncHandle:
@@ -230,11 +288,16 @@ class SupervisedHandle:
     """In-flight supervised resolution of one batch (wait() -> verdicts).
 
     Handles fold into the mirror strictly in dispatch order; waiting a
-    later handle first transparently folds its predecessors."""
+    later handle first transparently folds its predecessors.  Device
+    interaction is carried by two lane futures (the depth-N pipeline):
+    `dispatch_fut` resolves to (device_handle, t0, t1) once the host
+    pack + h2d enqueue finished, `fetch_fut` to the raw device verdicts
+    once the d2h wait finished."""
 
-    __slots__ = ("owner", "txns", "now", "new_oldest", "device_handle",
-                 "device_obj", "dispatch_t0", "results", "conflicting",
-                 "rechecked", "via_fallback")
+    __slots__ = ("owner", "txns", "now", "new_oldest",
+                 "dispatch_fut", "fetch_fut", "device_obj", "dispatch_t0",
+                 "results", "codes", "conflicting", "rechecked",
+                 "via_fallback")
 
     def __init__(self, owner: "SupervisedConflictSet", txns, now: Version,
                  new_oldest: Optional[Version]) -> None:
@@ -242,22 +305,35 @@ class SupervisedHandle:
         self.txns = txns
         self.now = now
         self.new_oldest = new_oldest
-        self.device_handle = None          # set when dispatched to device
+        self.dispatch_fut = None           # set when dispatched to device
+        self.fetch_fut = None              # prefetched d2h wait
         self.device_obj = None             # which device instance it's on
         self.dispatch_t0 = 0.0
         self.results: Optional[List[CommitResult]] = None
+        self.codes = None                  # int8 verdict array (bulk path)
         self.conflicting: Optional[Dict[int, list]] = None
         self.rechecked = False
         self.via_fallback = False
 
+    @property
+    def folded(self) -> bool:
+        return self.results is not None or self.codes is not None
+
     def wait(self) -> List[CommitResult]:
-        if self.results is None:
+        if not self.folded:
             self.owner._fold_through(self)
+        if self.results is None:
+            self.results = [CommitResult(int(c)) for c in self.codes]
         return self.results
 
     def wait_codes(self):
         import numpy as np
-        return np.asarray([int(r) for r in self.wait()], dtype=np.int8)
+        if not self.folded:
+            self.owner._fold_through(self)
+        if self.codes is None:
+            self.codes = np.asarray([int(r) for r in self.results],
+                                    dtype=np.int8)
+        return self.codes
 
 
 class SupervisedConflictSet(ConflictSet):
@@ -292,7 +368,7 @@ class SupervisedConflictSet(ConflictSet):
             latency_slo_s=float(knobs.CONFLICT_DEVICE_LATENCY_SLO_S),
             slo_strikes=int(knobs.CONFLICT_DEVICE_SLO_STRIKES),
             reprobe_interval_s=float(knobs.CONFLICT_BACKEND_REPROBE_S))
-        self._guard = _DeadlineGuard()
+        self._pipe = _DispatchPipeline()
         self._pending: List[SupervisedHandle] = []
         # Digest-space intervals [begin, end) @ version where the device
         # history is known to diverge from the exact mirror (widened or
@@ -304,7 +380,8 @@ class SupervisedConflictSet(ConflictSet):
         self.force_device_error = None
         self.stats = {"device_batches": 0, "fallback_batches": 0,
                       "rechecked_batches": 0, "degrades": 0,
-                      "promotions": 0, "retries": 0, "taint_size": 0}
+                      "promotions": 0, "retries": 0, "taint_size": 0,
+                      "pipeline_stalls": 0}
         self._device: Optional[ConflictSet] = None
         try:
             self._device = self._guarded(
@@ -365,7 +442,7 @@ class SupervisedConflictSet(ConflictSet):
                     continue
                 raise
             try:
-                return self._guard.call(fn, timeout_s)
+                return self._pipe.call(fn, timeout_s)
             except FdbError as e:
                 if retry and e.name in TRANSIENT_ERRORS \
                         and attempt + 1 < attempts:
@@ -382,10 +459,15 @@ class SupervisedConflictSet(ConflictSet):
 
     # -- degradation / promotion -------------------------------------------
     def _degrade(self, reason: str) -> None:
+        """Leave the device path: later folds of still-pending handles
+        find `device_obj is not self._device` and replay through the
+        exact mirror IN SUBMISSION ORDER (_fold_through walks _pending
+        front-to-back), so a mid-pipeline failure drains the whole
+        pipeline deterministically — no batch lost, no reordering."""
         if self._device is None:
             return
         self._device = None
-        self._guard.close()
+        self._pipe.close()     # abandon both lanes (may be wedged)
         self._taint.clear()      # refers to the discarded device history
         self.stats["taint_size"] = 0
         self._monitor.trip()
@@ -407,7 +489,7 @@ class SupervisedConflictSet(ConflictSet):
         # deadline guard's worker, and on timeout that worker is abandoned
         # while still executing — it must never read live mirror state the
         # reactor keeps mutating, nor write anything back into self (the
-        # _DeadlineGuard invariant).  The rebuild therefore gets copies
+        # _DispatchPipeline invariant).  The rebuild therefore gets copies
         # and RETURNS its results; only this thread installs them.
         floor = self._mirror.oldest_version
         keys = list(self._mirror.history.keys)
@@ -501,8 +583,8 @@ class SupervisedConflictSet(ConflictSet):
                 for w in tr.write_conflict_ranges:
                     if w.begin < w.end:
                         surviving.append((w.begin, w.end))
-        for b, e in combine_write_ranges(surviving):
-            self._mirror.history.insert(b, e, now)
+        self._mirror.history.insert_many(
+            combine_write_ranges(surviving), now)
         if new_oldest is not None and \
                 new_oldest > self._mirror.oldest_version:
             self._mirror.oldest_version = new_oldest
@@ -533,18 +615,70 @@ class SupervisedConflictSet(ConflictSet):
             self._fold_one(h)
             if h is handle:
                 return
-        assert handle.results is not None, "handle not pending and not folded"
+        assert handle.folded, "handle not pending and not folded"
+
+    def _collect_device_codes(self, h: SupervisedHandle):
+        """The d2h half of one supervised device call: BUGGIFY faults,
+        deadline budget, transient retries — the fetch-lane analog of
+        _guarded(..., retry=True).  The first attempt consumes the
+        PREFETCHED fetch future (usually already done: the fetch lane
+        ran the wait while earlier batches folded); a transient failure
+        re-submits the idempotent wait to the lane and tries again."""
+        knobs = server_knobs()
+        timeout_s = float(knobs.CONFLICT_DEVICE_TIMEOUT_S)
+        attempts = 1 + int(knobs.CONFLICT_DEVICE_MAX_RETRIES)
+        backoff = float(knobs.CONFLICT_DEVICE_RETRY_BACKOFF_S)
+        fut = h.fetch_fut
+        for attempt in range(attempts):
+            if attempt:
+                self.stats["retries"] += 1
+                self.metrics.counter("Retries").add(1)
+                _time.sleep(min(backoff * (2 ** (attempt - 1)), 0.25))
+            try:
+                self._inject_faults()
+            except FdbError as e:
+                if e.name in TRANSIENT_ERRORS and attempt + 1 < attempts:
+                    continue
+                raise
+            try:
+                if fut is not None:
+                    return self._pipe.collect(fut, timeout_s)
+                # Inline (budget <= 0) mode: run the wait on this thread.
+                dh = h.dispatch_fut.result()[0]
+                return (dh.wait_codes() if hasattr(dh, "wait_codes")
+                        else dh.wait())
+            except FdbError as e:
+                if e.name in TRANSIENT_ERRORS and attempt + 1 < attempts:
+                    if fut is not None:
+                        fut = self._submit_fetch(h.dispatch_fut)
+                    continue
+                raise
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _submit_fetch(self, dispatch_fut):
+        """Queue the d2h wait for a dispatched batch on the fetch lane
+        (prefetch): raw int8 codes when the device handle offers the
+        bulk path, CommitResult objects otherwise."""
+        def _fetch():
+            dh = dispatch_fut.result()[0]   # re-raises dispatch failures
+            return (dh.wait_codes() if hasattr(dh, "wait_codes")
+                    else dh.wait())
+        return self._pipe.submit_fetch(_fetch)
 
     def _fold_one(self, h: SupervisedHandle) -> None:
-        device_codes: Optional[List[CommitResult]] = None
+        device_codes = None
         slo_tripped = False
-        if h.device_handle is not None and h.device_obj is self._device \
+        if h.dispatch_fut is not None and h.device_obj is self._device \
                 and self._device is not None:
             try:
                 _t_wait = _wall()
-                device_codes = self._guarded(h.device_handle.wait,
-                                             retry=True)
+                device_codes = self._collect_device_codes(h)
                 _t_done = _wall()
+                # The dispatch future is resolved by now (the fetch task
+                # consumed it): record the pack+h2d half of the batch.
+                _dh, _td0, _td1 = h.dispatch_fut.result()
+                h.dispatch_t0 = _td0
+                self.metrics.histogram("Dispatch").record(_td1 - _td0)
                 # Device-vs-mirror profiling: wait = d2h sync + any
                 # remaining device compute; end-to-end = dispatch->codes.
                 self.metrics.histogram("DeviceWait").record(
@@ -597,9 +731,14 @@ class SupervisedConflictSet(ConflictSet):
             h.results, h.conflicting = final, ranges
         else:
             # Unflagged: device verdicts are provably exact (see module
-            # docstring); fold them into the mirror as-is.
+            # docstring); fold them into the mirror as-is.  The bulk path
+            # delivers raw int8 codes (kept as-is; wait() materializes
+            # CommitResult objects only on demand).
             self._mirror_apply(h.txns, device_codes, h.now, h.new_oldest)
-            h.results = device_codes
+            if isinstance(device_codes, list):
+                h.results = device_codes
+            else:
+                h.codes = device_codes
             h.conflicting = None
         self.oldest_version = self._mirror.oldest_version
         self._prune_taint()
@@ -607,40 +746,109 @@ class SupervisedConflictSet(ConflictSet):
             self._degrade("latency SLO exceeded")
 
     # -- public API -----------------------------------------------------------
-    def resolve_async(self, transactions: Sequence[CommitTransactionRef],
-                      now: Version,
-                      new_oldest_version: Optional[Version] = None
-                      ) -> SupervisedHandle:
-        txns = list(transactions)
-        h = SupervisedHandle(self, txns, now, new_oldest_version)
+    def _inject_dispatch_faults(self) -> None:
+        """Pre-dispatch fault injection with _guarded's transient-retry
+        policy (pre-call faults — the tunnel refusing the call before it
+        starts — are always retryable).  Runs ON THE CALLER THREAD so
+        BUGGIFY draws stay deterministic under sim; only after it passes
+        is the real dispatch handed to the pipeline's dispatch lane."""
+        knobs = server_knobs()
+        attempts = 1 + int(knobs.CONFLICT_DEVICE_MAX_RETRIES)
+        backoff = float(knobs.CONFLICT_DEVICE_RETRY_BACKOFF_S)
+        for attempt in range(attempts):
+            if attempt:
+                self.stats["retries"] += 1
+                self.metrics.counter("Retries").add(1)
+                _time.sleep(min(backoff * (2 ** (attempt - 1)), 0.25))
+            try:
+                self._inject_faults()
+                return
+            except FdbError as e:
+                if e.name in TRANSIENT_ERRORS and attempt + 1 < attempts:
+                    continue
+                raise
+
+    def _submit(self, txns: List[CommitTransactionRef], enc, now: Version,
+                new_oldest: Optional[Version]) -> SupervisedHandle:
+        """Shared dispatch half of resolve_async/resolve_encoded_async:
+        enforce the depth-N pipeline bound (folding the oldest in-flight
+        batches first — strict in-order delivery), then enqueue the
+        device dispatch on the dispatch lane and its d2h wait on the
+        fetch lane."""
+        h = SupervisedHandle(self, txns, now, new_oldest)
+        knobs = server_knobs()
+        depth = max(1, int(knobs.CONFLICT_PIPELINE_DEPTH))
+        if len(self._pending) >= depth:
+            # Dispatch blocked on a full pipeline: deliver the oldest
+            # batch(es) before admitting this one.
+            self.stats["pipeline_stalls"] += 1
+            self.metrics.counter("PipelineStalls").add(1)
+            self._fold_through(self._pending[len(self._pending) - depth])
         if self._device is None:
             self._maybe_promote()
         if self._device is not None:
             dev = self._device
-            t0 = _wall()
+            timeout_s = float(knobs.CONFLICT_DEVICE_TIMEOUT_S)
             try:
-                if hasattr(dev, "resolve_async"):
-                    dh = self._guarded(lambda: dev.resolve_async(
-                        txns, now, new_oldest_version))
+                self._inject_dispatch_faults()
+
+                def _dispatch():
+                    # Dispatch band: host pack + h2d enqueue (the async
+                    # device step returns before compute finishes, so
+                    # this isolates the tunnel-send half of a batch).
+                    t0 = _wall()
+                    if enc is not None and \
+                            hasattr(dev, "resolve_encoded_async"):
+                        dh = dev.resolve_encoded_async(enc, now, new_oldest)
+                    elif hasattr(dev, "resolve_async"):
+                        dh = dev.resolve_async(txns, now, new_oldest)
+                    else:
+                        dh = _SyncHandle(dev.resolve(txns, now, new_oldest))
+                    return dh, t0, _wall()
+
+                if timeout_s <= 0:
+                    h.dispatch_fut = _DoneFuture(_dispatch())
                 else:
-                    dh = _SyncHandle(self._guarded(lambda: dev.resolve(
-                        txns, now, new_oldest_version)))
-                # Dispatch band: host pack + h2d enqueue (the async
-                # device step returns before compute finishes, so this
-                # isolates the tunnel-send half of a batch).
-                self.metrics.histogram("Dispatch").record(
-                    _wall() - t0)
-                h.device_handle = dh
+                    h.dispatch_fut = self._pipe.submit_dispatch(_dispatch)
+                    h.fetch_fut = self._submit_fetch(h.dispatch_fut)
                 h.device_obj = dev
-                h.dispatch_t0 = t0
             except Exception as e:          # noqa: BLE001
                 # Dispatch is NOT retried: it mutates device state, so a
                 # mid-dispatch failure leaves it unknown — degrade and let
                 # the mirror own this batch (and promotion rebuild later).
+                # (Pipelined dispatch failures surface at this batch's
+                # fold instead — still before any verdict delivery.)
                 self._monitor.record_failure()
                 self._degrade(f"dispatch failed: {e}")
         self._pending.append(h)
+        self.metrics.histogram("InflightDepth").record(
+            float(len(self._pending)))
         return h
+
+    def resolve_async(self, transactions: Sequence[CommitTransactionRef],
+                      now: Version,
+                      new_oldest_version: Optional[Version] = None
+                      ) -> SupervisedHandle:
+        return self._submit(list(transactions), None, now,
+                            new_oldest_version)
+
+    def resolve_encoded_async(self, batch, now: Version,
+                              new_oldest_version: Optional[Version] = None,
+                              transactions: Optional[
+                                  Sequence[CommitTransactionRef]] = None
+                              ) -> SupervisedHandle:
+        """Bulk columnar dispatch (the bench path): the device gets the
+        pre-encoded batch (zero per-txn Python work on the dispatch
+        lane).  `transactions` — the SAME batch in object form — is
+        REQUIRED: the exact mirror (degrade replay, long-key recheck,
+        fold-in of surviving writes) operates on raw keys the encoded
+        form no longer carries."""
+        if transactions is None:
+            raise TypeError(
+                "SupervisedConflictSet.resolve_encoded_async needs the "
+                "object-form transactions for its exact mirror")
+        return self._submit(list(transactions), batch, now,
+                            new_oldest_version)
 
     def resolve(self, transactions: Sequence[CommitTransactionRef],
                 now: Version,

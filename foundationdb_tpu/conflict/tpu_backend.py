@@ -84,22 +84,29 @@ class ResolveHandle:
             from .fused import OUT_BSIZE, OUT_DSIZE, OUT_FLAG
             arr = np.asarray(self._out)  # one d2h transfer, syncs the step
             extras = arr[self._t_cap:self._t_cap + 12].copy().view(np.int32)
-            if self in self._cs._inflight:
-                self._cs._inflight.remove(self)
-                self._cs._live_boundaries = int(
-                    extras[OUT_DSIZE] + extras[OUT_BSIZE])
-                # Tighten the host's sound delta-occupancy bound with the
-                # actual device size: actual at this batch + the worst-case
-                # growth of batches dispatched since.  Skipped if a merge
-                # re-provisioned the delta after this batch was dispatched.
-                cs = self._cs
-                if (self._depoch == cs._delta_epoch
-                        and self._seq > cs._corrected_seq):
-                    cs._corrected_seq = self._seq
-                    for s in [s for s in cs._needs if s <= self._seq]:
-                        del cs._needs[s]
-                    cs._delta_bound = (int(extras[OUT_DSIZE]) +
-                                       sum(cs._needs.values()))
+            # Bookkeeping runs under the backend's lock: the supervisor's
+            # depth-N pipeline (conflict/supervisor.py) waits handles on
+            # its fetch worker WHILE the dispatch worker runs _dispatch,
+            # so _inflight/_needs/_delta_bound see concurrent access.
+            with self._cs._lock:
+                if self in self._cs._inflight:
+                    self._cs._inflight.remove(self)
+                    self._cs._live_boundaries = int(
+                        extras[OUT_DSIZE] + extras[OUT_BSIZE])
+                    # Tighten the host's sound delta-occupancy bound with
+                    # the actual device size: actual at this batch + the
+                    # worst-case growth of batches dispatched since.
+                    # Skipped if a merge re-provisioned the delta after
+                    # this batch was dispatched.
+                    cs = self._cs
+                    if (self._depoch == cs._delta_epoch
+                            and self._seq > cs._corrected_seq):
+                        cs._corrected_seq = self._seq
+                        for s in [s for s in cs._needs
+                                  if s <= self._seq]:
+                            del cs._needs[s]
+                        cs._delta_bound = (int(extras[OUT_DSIZE]) +
+                                           sum(cs._needs.values()))
             if int(extras[OUT_FLAG]):
                 from ..core.error import err
                 raise err(
@@ -129,6 +136,11 @@ class TpuConflictSet(ConflictSet):
         self._d_cap0 = min(delta_capacity or max(4096, self.capacity // 8),
                            self.capacity)
         self.d_cap = self._d_cap0
+        import threading
+        # Guards the dispatch/wait bookkeeping (_inflight, _needs,
+        # _delta_bound): the supervisor's depth-N pipeline runs _dispatch
+        # and ResolveHandle.wait_codes on different worker threads.
+        self._lock = threading.Lock()
         self._inflight: List[ResolveHandle] = []
         self._gc_interval = gc_interval_batches
         # Dispatch-shape profile (read by the supervisor's status):
@@ -165,6 +177,7 @@ class TpuConflictSet(ConflictSet):
         self.table = build_sparse_table(self.bv)
         dst = self._fused.make_delta_state(self.d_cap)
         self.dk, self.dv, self.dsize = dst.bk, dst.bv, dst.size
+        self.dtable = self._fused.delta_table_step(self.dv)
         self.flag = self._jnp.int32(0)
         self._reset_bookkeeping(live_boundaries=1)
 
@@ -212,11 +225,18 @@ class TpuConflictSet(ConflictSet):
             self.d_cap = self._d_cap0
             dst = self._fused.make_delta_state(self.d_cap)
             self.dk, self.dv, self.dsize = dst.bk, dst.bv, dst.size
+        # Hoisted delta table over the (fresh, just-reset) delta tier.
+        self.dtable = self._fused.delta_table_step(self.dv)
         self.version_base += delta_reb
-        self._batches_since_merge = 0
-        self._delta_bound = 1
-        self._delta_epoch += 1
-        self._needs.clear()
+        # Bookkeeping reset under the lock: with the supervisor's depth-N
+        # pipeline, a fetch-lane ResolveHandle.wait_codes can be mid-way
+        # through its _needs/_delta_bound correction while the dispatch
+        # lane merges for the next batch.
+        with self._lock:
+            self._batches_since_merge = 0
+            self._delta_bound = 1
+            self._delta_epoch += 1
+            self._needs.clear()
 
     def _grow_delta(self, needed: int) -> None:
         """Re-provision the (empty, just-merged) delta tier at a larger
@@ -225,6 +245,7 @@ class TpuConflictSet(ConflictSet):
         self.d_cap = min(_bucket(needed), self.capacity)
         dst = self._fused.make_delta_state(self.d_cap)
         self.dk, self.dv, self.dsize = dst.bk, dst.bv, dst.size
+        self.dtable = self._fused.delta_table_step(self.dv)
 
     # -- batch packing ------------------------------------------------------
     @staticmethod
@@ -371,18 +392,24 @@ class TpuConflictSet(ConflictSet):
                   n_txns: int) -> ResolveHandle:
         t_cap, r_cap, w_cap = enc["caps"]
         need = 2 * enc["nw"] + 2
-        if (self._delta_bound + need > self.d_cap
+        with self._lock:
+            # Bound read under the lock: a fetch-lane wait_codes may be
+            # tightening _delta_bound concurrently (depth-N pipeline).
+            need_merge = (
+                self._delta_bound + need > self.d_cap
                 or self._batches_since_merge >= self._gc_interval
-                # Proactive rebase long before the int32 offset span is at
-                # risk, regardless of the merge cadence.
-                or now - self.version_base >= (1 << 30)):
+                # Proactive rebase long before the int32 offset span is
+                # at risk, regardless of the merge cadence.
+                or now - self.version_base >= (1 << 30))
+        if need_merge:
             self.merge()
         if need > self.d_cap:
             self._grow_delta(need)
-        self._delta_bound += need
-        self._seq += 1
-        self._needs[self._seq] = need
-        self._batches_since_merge += 1
+        with self._lock:
+            self._delta_bound += need
+            self._seq += 1
+            self._needs[self._seq] = need
+            self._batches_since_merge += 1
 
         meta = enc["meta"]
         so = enc["snap_off"]
@@ -404,7 +431,8 @@ class TpuConflictSet(ConflictSet):
         if enc["compact"]:
             self.profile["compact_batches"] += 1
         handle = ResolveHandle(self, out, n_txns, t_cap)
-        self._inflight.append(handle)
+        with self._lock:
+            self._inflight.append(handle)
         return handle
 
     def _invoke_step(self, enc, meta):
@@ -418,16 +446,21 @@ class TpuConflictSet(ConflictSet):
                 self.capacity, self.d_cap, *enc["shapes"])
             self.dk, self.dv, self.dsize, self.flag, out = step(
                 self.bk, self.bv, self.table, self.size,
-                self.dk, self.dv, self.dsize, self.flag,
+                self.dk, self.dv, self.dtable, self.dsize, self.flag,
                 jnp.asarray(enc["buf"]))
-            return out
-        t_cap, r_cap, w_cap = enc["caps"]
-        step = self._fused.make_resolve_step(
-            self.capacity, self.d_cap, t_cap, r_cap, w_cap)
-        self.dk, self.dv, self.dsize, self.flag, out = step(
-            self.bk, self.bv, self.table, self.size,
-            self.dk, self.dv, self.dsize, self.flag,
-            jnp.asarray(enc["digests"]), jnp.asarray(meta))
+        else:
+            t_cap, r_cap, w_cap = enc["caps"]
+            step = self._fused.make_resolve_step(
+                self.capacity, self.d_cap, t_cap, r_cap, w_cap)
+            self.dk, self.dv, self.dsize, self.flag, out = step(
+                self.bk, self.bv, self.table, self.size,
+                self.dk, self.dv, self.dtable, self.dsize, self.flag,
+                jnp.asarray(enc["digests"]), jnp.asarray(meta))
+        # Refresh the hoisted delta table for the NEXT batch: a separate
+        # async device program over the post-insert delta, enqueued here
+        # so it overlaps the host's fold/pack instead of sitting on the
+        # next step's critical path before its history probes.
+        self.dtable = self._fused.delta_table_step(self.dv)
         return out
 
     # -- public API ---------------------------------------------------------

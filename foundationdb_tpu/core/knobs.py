@@ -146,6 +146,14 @@ class ServerKnobs(KnobBase):
         self.CONFLICT_DEVICE_LATENCY_SLO_S = 0.0  # 0 disables the SLO trip
         self.CONFLICT_DEVICE_SLO_STRIKES = 8      # consecutive slow batches
         self.CONFLICT_BACKEND_REPROBE_S = 5.0     # doubles per failed probe
+        # Depth-N dispatch pipeline (conflict/supervisor.py): max batches
+        # in flight on the device (dispatched, verdicts not yet folded)
+        # before resolve_async folds the oldest first.  While batch k's
+        # device step runs, batch k+1 host-packs/h2d-enqueues on the
+        # dispatch lane and batch k-1's verdicts d2h-fetch on the fetch
+        # lane; verdict DELIVERY stays strictly in submission order at
+        # every depth.  1 = fully serialized (the pre-pipeline behavior).
+        self.CONFLICT_PIPELINE_DEPTH = 8
 
         # Resolution balancing (reference masterserver.actor.cpp:1318)
         self.RESOLUTION_BALANCING_INTERVAL = 0.5

@@ -241,13 +241,19 @@ def rank_count(positions: jnp.ndarray, out_len: int) -> jnp.ndarray:
 
 
 def _searchsorted(sorted_keys: jnp.ndarray, queries: jnp.ndarray,
-                  side_left: bool) -> jnp.ndarray:
+                  side_left) -> jnp.ndarray:
     """Vectorized branchless binary search.
 
     sorted_keys: uint32[6, CAP]; queries: uint32[6, Q].  Returns, per query
     q: first index i with keys[i] >= q (left) or keys[i] > q (right).  CAP
     must be a power of two (capacity arrays are padded with MAX_DIGEST above
     the live size).
+
+    side_left is either a Python bool (one tie side for the whole query
+    block) or a bool[Q] array giving the tie side PER QUERY — the fused
+    probe pass (searchsorted_interval) packs begin probes (right side)
+    and end probes (left side) into one loop over the same table, halving
+    the sequential probe loops per history check.
 
     The probe-gather layout is BACKEND-ADAPTIVE (chosen at trace time):
 
@@ -266,6 +272,7 @@ def _searchsorted(sorted_keys: jnp.ndarray, queries: jnp.ndarray,
     if use_rows:
         rows = planar_to_rows(sorted_keys)
     nq = queries.shape[1]
+    per_query_side = not isinstance(side_left, bool)
     lo = jnp.zeros((nq,), dtype=jnp.int32)
     # Binary search maintaining: result in (lo, hi]; start hi = cap.
     hi = jnp.full((nq,), cap, dtype=jnp.int32)
@@ -281,13 +288,24 @@ def _searchsorted(sorted_keys: jnp.ndarray, queries: jnp.ndarray,
             mk_lanes = [sorted_keys[lane][midc] for lane in range(KEY_LANES)]
         # lexicographic keys[midc] < q (or <=) via per-lane where-chain
         last = KEY_LANES - 1
-        if side_left:
-            cmp = mk_lanes[last] < q_lanes[last]    # keys[mid] < q
+        if per_query_side:
+            # Mixed sides: lt and eq chains share the same lane gathers;
+            # descend-right iff keys[mid] < q (left side) / <= q (right).
+            lt = mk_lanes[last] < q_lanes[last]
+            eq = mk_lanes[last] == q_lanes[last]
+            for lane in range(KEY_LANES - 2, -1, -1):
+                same = mk_lanes[lane] == q_lanes[lane]
+                lt = jnp.where(same, lt, mk_lanes[lane] < q_lanes[lane])
+                eq = eq & same
+            cmp = jnp.where(side_left, lt, lt | eq)
         else:
-            cmp = mk_lanes[last] <= q_lanes[last]   # keys[mid] <= q
-        for lane in range(KEY_LANES - 2, -1, -1):
-            cmp = jnp.where(mk_lanes[lane] == q_lanes[lane], cmp,
-                            mk_lanes[lane] < q_lanes[lane])
+            if side_left:
+                cmp = mk_lanes[last] < q_lanes[last]    # keys[mid] < q
+            else:
+                cmp = mk_lanes[last] <= q_lanes[last]   # keys[mid] <= q
+            for lane in range(KEY_LANES - 2, -1, -1):
+                cmp = jnp.where(mk_lanes[lane] == q_lanes[lane], cmp,
+                                mk_lanes[lane] < q_lanes[lane])
         lo = jnp.where(active & cmp, mid + 1, lo)
         hi = jnp.where(active & ~cmp, mid, hi)
     return hi
@@ -299,3 +317,24 @@ def searchsorted_left(sorted_keys: jnp.ndarray, queries: jnp.ndarray) -> jnp.nda
 
 def searchsorted_right(sorted_keys: jnp.ndarray, queries: jnp.ndarray) -> jnp.ndarray:
     return _searchsorted(sorted_keys, queries, False)
+
+
+def searchsorted_interval(sorted_keys: jnp.ndarray, q_begin: jnp.ndarray,
+                          q_end: jnp.ndarray):
+    """Fused history probe over ONE table: (searchsorted_right(keys,
+    q_begin), searchsorted_left(keys, q_end)) computed by a single
+    binary-search loop over the concatenated query block.
+
+    The two-tier history check needs, per range [b, e): the segment
+    containing b (right probe - 1) and the first boundary >= e (left
+    probe).  Running both probes through one loop halves the number of
+    sequential probe loops per table (base and delta: four loops -> two)
+    — the same total gather work, scheduled as one pass with twice the
+    gather width, which XLA batches better and compiles once."""
+    nb = q_begin.shape[1]
+    queries = jnp.concatenate([q_begin, q_end], axis=1)
+    side = jnp.concatenate([
+        jnp.zeros((nb,), bool),
+        jnp.ones((q_end.shape[1],), bool)])
+    pos = _searchsorted(sorted_keys, queries, side)
+    return pos[:nb], pos[nb:]

@@ -54,13 +54,25 @@ class ShardedTpuConflictSet(TpuConflictSet):
     def __init__(self, mesh: Mesh, oldest_version=0,
                  capacity: Optional[int] = None,
                  delta_capacity: Optional[int] = None,
-                 gc_interval_batches: int = 8) -> None:
+                 gc_interval_batches: int = 8,
+                 splits: Optional[np.ndarray] = None) -> None:
         assert "kr" in mesh.axis_names, "mesh must carry a 'kr' axis"
         self.mesh = mesh
         self.n_shards = int(mesh.shape["kr"])
         self._kr = NamedSharding(mesh, P("kr"))
         self._step_cache: dict = {}
         self._merge_cache: dict = {}
+        self._dtable_cache: dict = {}
+        # Key-range split points: uint32[n_shards+1, 8] ascending digest
+        # cuts (row 0 all-zero, last row MAX_DIGEST).  Default: even
+        # lane-0 cuts; workloads with a shared key prefix should pass
+        # equi-depth cuts (sharded_window.splits_from_sample) or one
+        # shard absorbs the whole window.
+        if splits is not None:
+            splits = np.asarray(splits, dtype=np.uint32)
+            assert splits.shape == (self.n_shards + 1, KEY_LANES), \
+                f"splits shape {splits.shape}"
+        self._splits = splits
         super().__init__(oldest_version, capacity=capacity,
                          delta_capacity=delta_capacity,
                          gc_interval_batches=gc_interval_batches)
@@ -70,11 +82,15 @@ class ShardedTpuConflictSet(TpuConflictSet):
         import jax
         return jax.device_put(arr, self._kr)
 
+    def _split_points(self) -> np.ndarray:
+        return self._splits if self._splits is not None \
+            else digest_splits(self.n_shards)
+
     def _shard_window(self, cap: int, value: int) -> tuple:
         """[D, 6, cap] boundaries + [D, cap] versions: each shard one
         segment covering its whole digest range at `value`."""
         d = self.n_shards
-        splits = digest_splits(d)
+        splits = self._split_points()
         bk = np.broadcast_to(MAX_DIGEST[None, :, None],
                              (d, KEY_LANES, cap)).copy()
         bk[:, :, 0] = splits[:d]
@@ -87,7 +103,7 @@ class ShardedTpuConflictSet(TpuConflictSet):
         from ..ops.rangemax import build_sparse_table
         self.version_base = version
         d = self.n_shards
-        splits = digest_splits(d)
+        splits = self._split_points()
         bk, bv = self._shard_window(self.capacity, 0)
         self.bk = self._put(bk)
         self.bv = self._put(bv)
@@ -100,6 +116,7 @@ class ShardedTpuConflictSet(TpuConflictSet):
         self.dk = self._put(dk)
         self.dv = self._put(dv)
         self.dsize = self._put(np.ones((d,), dtype=np.int32))
+        self.dtable = self._build_dtable()
         self.flag = self._put(np.zeros((d,), dtype=np.int32))
         bounds = np.empty((d, KEY_LANES, 2), dtype=np.uint32)
         bounds[:, :, 0] = splits[:d]
@@ -116,6 +133,23 @@ class ShardedTpuConflictSet(TpuConflictSet):
         self.dk = self._put(dk)
         self.dv = self._put(dv)
         self.dsize = self._put(np.ones((self.n_shards,), dtype=np.int32))
+        self.dtable = self._build_dtable()
+
+    def _build_dtable(self):
+        """Hoisted per-shard delta range-max tables [D, LOG+1, DCAP]: the
+        vmapped analog of fused.delta_table_step, refreshed after every
+        insert/merge so the sharded per-batch step never rebuilds them.
+        The jitted builder is cached on self — a fresh jax.jit wrapper
+        per call would miss the pjit cache (keyed on fn identity) and
+        re-trace on the per-batch hot path."""
+        fn = self._dtable_cache.get("fn")
+        if fn is None:
+            import jax
+            from ..ops.rangemax import build_sparse_table
+            fn = jax.jit(jax.vmap(build_sparse_table),
+                         out_shardings=self._kr)
+            self._dtable_cache["fn"] = fn
+        return fn(self.dv)
 
     # -- sharded programs ---------------------------------------------------
     def _sharded_step(self, t_cap: int, r_cap: int, w_cap: int):
@@ -128,11 +162,11 @@ class ShardedTpuConflictSet(TpuConflictSet):
             self.capacity, self.d_cap, t_cap, r_cap, w_cap,
             axis_name="kr")
 
-        def shard_fn(bk, bv, table, size, dk, dv, dsize, flag,
+        def shard_fn(bk, bv, table, size, dk, dv, dtable, dsize, flag,
                      digests, meta, bounds):
             dk2, dv2, ds2, fl2, out = raw(
-                bk[0], bv[0], table[0], size[0], dk[0], dv[0], dsize[0],
-                flag[0], digests, meta, bounds[0])
+                bk[0], bv[0], table[0], size[0], dk[0], dv[0], dtable[0],
+                dsize[0], flag[0], digests, meta, bounds[0])
             return dk2[None], dv2[None], ds2[None], fl2[None], out
 
         spec_state3 = P("kr", None, None)
@@ -140,10 +174,10 @@ class ShardedTpuConflictSet(TpuConflictSet):
         spec_1 = P("kr")
         mapped = shard_map_compat(shard_fn, self.mesh,
             in_specs=(spec_state3, spec_state2, spec_state3, spec_1,
-                      spec_state3, spec_state2, spec_1, spec_1,
+                      spec_state3, spec_state2, spec_state3, spec_1, spec_1,
                       P(None, None), P(None), spec_state3),
             out_specs=(spec_state3, spec_state2, spec_1, spec_1, P(None)))
-        fn = jit_sharded(mapped, donate_argnums=(4, 5, 6, 7))
+        fn = jit_sharded(mapped, donate_argnums=(4, 5, 7, 8))
         self._step_cache[key] = fn
         return fn
 
@@ -156,19 +190,20 @@ class ShardedTpuConflictSet(TpuConflictSet):
         raw = self._fused.make_resolve_step_compact(
             self.capacity, self.d_cap, *shapes, axis_name="kr")
 
-        def shard_fn(bk, bv, table, size, dk, dv, dsize, flag, buf, bounds):
+        def shard_fn(bk, bv, table, size, dk, dv, dtable, dsize, flag, buf,
+                     bounds):
             dk2, dv2, ds2, fl2, out = raw(
-                bk[0], bv[0], table[0], size[0], dk[0], dv[0], dsize[0],
-                flag[0], buf, bounds[0])
+                bk[0], bv[0], table[0], size[0], dk[0], dv[0], dtable[0],
+                dsize[0], flag[0], buf, bounds[0])
             return dk2[None], dv2[None], ds2[None], fl2[None], out
 
         s3 = P("kr", None, None)
         s2 = P("kr", None)
         s1 = P("kr")
         mapped = shard_map_compat(shard_fn, self.mesh,
-            in_specs=(s3, s2, s3, s1, s3, s2, s1, s1, P(None), s3),
+            in_specs=(s3, s2, s3, s1, s3, s2, s3, s1, s1, P(None), s3),
             out_specs=(s3, s2, s1, s1, P(None)))
-        fn = jit_sharded(mapped, donate_argnums=(4, 5, 6, 7))
+        fn = jit_sharded(mapped, donate_argnums=(4, 5, 7, 8))
         self._step_cache[key] = fn
         return fn
 
@@ -208,11 +243,16 @@ class ShardedTpuConflictSet(TpuConflictSet):
             self.flag, self._jnp.asarray(scalars), self._firsts)
         if self.d_cap != self._d_cap0:
             self._grow_delta(self._d_cap0)  # shrink back to the base bucket
+        else:
+            self.dtable = self._build_dtable()  # fresh (reset) delta tier
         self.version_base += delta_reb
-        self._batches_since_merge = 0
-        self._delta_bound = 1
-        self._delta_epoch += 1
-        self._needs.clear()
+        # Same lock discipline as TpuConflictSet.merge: the pipeline's
+        # fetch lane corrects these under self._lock.
+        with self._lock:
+            self._batches_since_merge = 0
+            self._delta_bound = 1
+            self._delta_epoch += 1
+            self._needs.clear()
 
     def _invoke_step(self, enc, meta):
         """Shard-map'd step over the mesh; the shared _dispatch keeps the
@@ -225,15 +265,18 @@ class ShardedTpuConflictSet(TpuConflictSet):
             step = self._sharded_step_compact(enc["shapes"])
             self.dk, self.dv, self.dsize, self.flag, out = step(
                 self.bk, self.bv, self.table, self.size,
-                self.dk, self.dv, self.dsize, self.flag,
+                self.dk, self.dv, self.dtable, self.dsize, self.flag,
                 jnp.asarray(enc["buf"]), self.bounds)
-            return out
-        t_cap, r_cap, w_cap = enc["caps"]
-        step = self._sharded_step(t_cap, r_cap, w_cap)
-        self.dk, self.dv, self.dsize, self.flag, out = step(
-            self.bk, self.bv, self.table, self.size,
-            self.dk, self.dv, self.dsize, self.flag,
-            jnp.asarray(enc["digests"]), jnp.asarray(meta), self.bounds)
+        else:
+            t_cap, r_cap, w_cap = enc["caps"]
+            step = self._sharded_step(t_cap, r_cap, w_cap)
+            self.dk, self.dv, self.dsize, self.flag, out = step(
+                self.bk, self.bv, self.table, self.size,
+                self.dk, self.dv, self.dtable, self.dsize, self.flag,
+                jnp.asarray(enc["digests"]), jnp.asarray(meta), self.bounds)
+        # Hoisted per-shard delta tables for the next batch (see
+        # fused.delta_table_step): enqueued right after the insert.
+        self.dtable = self._build_dtable()
         return out
 
     # -- introspection ------------------------------------------------------

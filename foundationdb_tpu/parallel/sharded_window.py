@@ -91,6 +91,28 @@ def digest_splits(n_shards: int) -> np.ndarray:
     return splits
 
 
+def splits_from_sample(sample_digests: np.ndarray,
+                       n_shards: int) -> np.ndarray:
+    """Equi-depth split points from a planar digest sample (uint32[8, N])
+    -> uint32[n+1, 8], the `splits=` input of ShardedTpuConflictSet.
+
+    digest_splits' even lane-0 cuts balance only keyspaces spread across
+    the first four key bytes; real workloads share long common prefixes
+    (every bench key starts "k0000...", every tenant key its tenant id),
+    which lands the WHOLE window on one shard and voids the capacity
+    multiplier.  This is the resolver-keyrange analog of the reference's
+    load-driven split points (masterserver resolutionBalancing): cut at
+    the sample's d/n quantiles over full-width digests."""
+    from ..ops.digest import DIGEST_BYTES, planar_to_s24
+    s = np.sort(planar_to_s24(sample_digests))
+    splits = np.zeros((n_shards + 1, KEY_LANES), dtype=np.uint32)
+    for d in range(1, n_shards):
+        q = s[min(s.size - 1, (d * s.size) // n_shards)]
+        splits[d] = np.frombuffer(q, dtype=">u4").astype(np.uint32)
+    splits[n_shards] = MAX_DIGEST
+    return splits
+
+
 from ..ops.digest import lex_max_cols as _lex_max_cols  # noqa: E402
 from ..ops.digest import lex_min_cols as _lex_min_cols  # noqa: E402
 

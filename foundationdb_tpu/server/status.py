@@ -96,6 +96,11 @@ def collect_latency_bands(info, worker_docs=()) -> Dict[str, Any]:
         ("tpu_dispatch", backends, "TpuBackend", "Dispatch"),
         ("tpu_device_batch", backends, "TpuBackend", "DeviceBatch"),
         ("tpu_mirror_resolve", backends, "TpuBackend", "MirrorResolve"),
+        # Pipeline occupancy (a COUNT histogram, not seconds): batches in
+        # flight on the device at each dispatch (conflict/supervisor.py
+        # depth-N pipeline; PipelineStalls counts dispatches that found
+        # the pipeline full).
+        ("tpu_inflight_depth", backends, "TpuBackend", "InflightDepth"),
     ]
     out: Dict[str, Any] = {}
     for name, roles, group, hist in spec:

@@ -127,7 +127,15 @@ timed("searchsorted R queries into CAP",
       jax.jit(lambda bk, q: searchsorted_left(bk, q)), cs.bk, qb)
 timed("searchsorted R queries into DCAP",
       jax.jit(lambda dk, q: searchsorted_left(dk, q)), cs.dk, qb)
-timed("build_sparse_table(DCAP)",
+# The fused probe pass (ISSUE 6): begin (right-side) + end (left-side)
+# probes in ONE loop per table — compare against 2x the single-sided rows.
+from foundationdb_tpu.ops.digest import searchsorted_interval  # noqa: E402
+timed("fused begin+end probe into CAP",
+      jax.jit(lambda bk, q: searchsorted_interval(bk, q, q)), cs.bk, qb)
+# Hoisted delta range-max table (ISSUE 6): built by this SEPARATE program
+# after each insert (tpu_backend threads it through the step signature);
+# the per-batch resolve step itself contains no table build.
+timed("delta_table_step(DCAP) [hoisted]",
       jax.jit(build_sparse_table), cs.dv)
 
 cover = jnp.zeros((bucket(W) + 1,), jnp.int32)

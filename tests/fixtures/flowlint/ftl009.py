@@ -1,0 +1,50 @@
+"""Known-bad fixture: FTL009 knob-name typos vs core/knobs.py fields."""
+# expect: FTL009:9 FTL009:11 FTL009:16 FTL009:21 FTL009:50
+
+from foundationdb_tpu.core.knobs import client_knobs, server_knobs
+
+
+def bad():
+    knobs = server_knobs()
+    t = knobs.CONFLICT_DEVICE_TIMEOUT_SEC       # typo of ..._TIMEOUT_S
+    # a getattr default would mask the typo forever
+    d = getattr(knobs, "CONFLICT_PIPELINE_DEPHT", 1)
+    return t, d
+
+
+def bad_chained():
+    return server_knobs().TPU_CONFLICT_CAPASITY  # typo of ..._CAPACITY
+
+
+def bad_client():
+    ck = client_knobs()
+    return ck.KEY_SIZE_LIMITS                   # typo of KEY_SIZE_LIMIT
+
+
+def good():
+    knobs = server_knobs()
+    ok1 = knobs.CONFLICT_DEVICE_TIMEOUT_S       # real ServerKnobs field
+    ok2 = getattr(knobs, "CONFLICT_PIPELINE_DEPTH")
+    ok3 = server_knobs().TPU_CONFLICT_CAPACITY
+    ok4 = client_knobs().KEY_SIZE_LIMIT
+    ok5 = knobs.override                        # method: not ALL-CAPS
+    other = object()
+    ok6 = other.NOT_A_KNOB_RECEIVER             # untracked receiver
+    return ok1, ok2, ok3, ok4, ok5, ok6
+
+
+def good_scoped_server():
+    knobs = server_knobs()
+    return knobs.CONFLICT_DEVICE_TIMEOUT_S
+
+
+def good_scoped_client():
+    # Same variable name bound to a DIFFERENT knob class in a sibling
+    # scope: the per-scope map must not cross-resolve these.
+    knobs = client_knobs()
+    return knobs.KEY_SIZE_LIMIT
+
+
+def bad_scoped_client():
+    knobs = client_knobs()
+    return knobs.CONFLICT_DEVICE_TIMEOUT_S      # ServerKnobs field, not Client

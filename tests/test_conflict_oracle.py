@@ -204,3 +204,44 @@ def test_combine_write_ranges():
     got = combine_write_ranges([(b"c", b"e"), (b"a", b"b"), (b"b", b"c"),
                                 (b"d", b"f"), (b"x", b"x")])
     assert got == [(b"a", b"f")]
+
+
+@pytest.mark.parametrize("seed", [5, 6, 7, 8])
+def test_insert_many_equals_sequential_inserts(seed):
+    """insert_many (the one-pass batch merge the supervisor's mirror and
+    the oracle's step 4 use) is bit-identical to per-range insert() for
+    combine_write_ranges output, on random histories."""
+    rng = DeterministicRandom(seed)
+    for _round in range(20):
+        seq = VersionHistory(0)
+        # Random pre-existing history via sequential inserts.
+        for _ in range(rng.random_int(0, 30)):
+            b = b"%03d" % rng.random_int(0, 60)
+            e = b"%03d" % rng.random_int(0, 60)
+            if b < e:
+                seq.insert(b, e, rng.random_int(1, 100))
+        batch = VersionHistory(0)
+        batch.keys, batch.vals = list(seq.keys), list(seq.vals)
+        ranges = combine_write_ranges([
+            (b"%03d" % rng.random_int(0, 60), b"%03d" % rng.random_int(0, 60))
+            for _ in range(rng.random_int(0, 12))])
+        v = rng.random_int(101, 200)
+        for b, e in ranges:
+            seq.insert(b, e, v)
+        batch.insert_many(ranges, v)
+        assert batch.keys == seq.keys and batch.vals == seq.vals
+
+
+def test_insert_many_touching_boundaries():
+    """Edge cases: range begin/end exactly on existing boundaries, and a
+    range whose end coincides with a later range's vicinity."""
+    seq = VersionHistory(0)
+    seq.insert(b"b", b"d", 5)
+    seq.insert(b"f", b"h", 7)
+    batch = VersionHistory(0)
+    batch.keys, batch.vals = list(seq.keys), list(seq.vals)
+    ranges = [(b"a", b"b"), (b"d", b"f"), (b"h", b"j")]
+    for b, e in ranges:
+        seq.insert(b, e, 9)
+    batch.insert_many(ranges, 9)
+    assert batch.keys == seq.keys and batch.vals == seq.vals

@@ -336,7 +336,9 @@ def test_status_collects_stage_bands(registry):
               [("TxnCommitted", 9)])
     backend = SimpleNamespace(metrics=CounterCollection("TpuBackend", "b0"))
     backend.metrics.histogram("Dispatch").record(4e-4)
+    backend.metrics.histogram("InflightDepth").record(2.0)
     backend.metrics.counter("DeviceBatches").add(2)
+    backend.metrics.counter("PipelineStalls").add(3)
     res_role = SimpleNamespace(
         metrics=CounterCollection("Resolver", "r0"), conflict_set=backend)
     res_role.metrics.histogram("Resolve").record(3e-4)
@@ -352,14 +354,17 @@ def test_status_collects_stage_bands(registry):
     for key in ("grv", "grv_queue", "commit", "commit_batch_assembly",
                 "commit_resolution", "commit_tlog_logging", "commit_reply",
                 "resolver_resolve", "tlog_append", "tlog_durable",
-                "storage_read", "storage_fetch", "tpu_dispatch"):
+                "storage_read", "storage_fetch", "tpu_dispatch",
+                "tpu_inflight_depth"):
         assert key in bands, (key, sorted(bands))
         for stat in ("p50", "p95", "p99", "count", "mean"):
             assert stat in bands[key]
     assert bands["tpu_dispatch"]["count"] == 1
+    assert bands["tpu_inflight_depth"]["mean"] == 2.0
     metrics = collect_cluster_metrics(info)
     assert metrics["CommitProxy"]["TxnCommitted"] == 9
     assert metrics["TpuBackend"]["DeviceBatches"] == 2
+    assert metrics["TpuBackend"]["PipelineStalls"] == 3
     json.dumps({"latency_statistics": bands, "metrics": metrics})
 
 
