@@ -93,6 +93,24 @@ CONFIG5_TARGET_RANGES = 1_000_000
 CONFIG5_CAPACITY = 1 << 22          # total boundaries across shards
 CONFIG5_DELTA = 1 << 20
 
+# Multi-resolver sweep (ISSUE 7, `bench.py resolvers`): the SAME seeded
+# partition-aligned stream through N = 1/2/4 per-resolver supervised
+# backends — each resolver owns an equi-width quarter-cell slice of the
+# keyspace and resolves only its fragments (the commit-proxy clip), with
+# per-resolver and aggregate ranges/s emitted into BENCH_r07.json.  Each
+# resolver's stream is TIMED SEPARATELY on this host; the aggregate
+# models the production deployment (one resolver role per process/chip,
+# all resolving concurrently) as total_ranges / max(per-resolver
+# elapsed) — labeled as such in the JSON.
+RSWEEP_NS = (1, 2, 4)
+RSWEEP_TXNS = 8_192
+RSWEEP_BATCHES = 6           # measured per config (first is compile/warm)
+RSWEEP_WARMUP = 1            # leading batches excluded from the rate
+RSWEEP_KEYSPACE = 262_144
+RSWEEP_CELLS = 4             # finest partition; txns never straddle
+RSWEEP_CAPACITY = 1 << 16    # per-resolver window sizing
+RSWEEP_DELTA = 1 << 15
+
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 # The whole run is budgeted from ONE externally supplied deadline
 # (BENCH_DEADLINE_S): round 5 lost its entire window because the probe
@@ -129,8 +147,17 @@ LKG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def gen_batch(rng: np.random.Generator, version: int, prev: int,
-              keyspace: int = KEYSPACE, zipf: bool = True):
-    """One batch as (EncodedBatch, kids, snaps) — fully vectorized."""
+              keyspace: int = KEYSPACE, zipf: bool = True,
+              cells: int = 0):
+    """One batch as (EncodedBatch, kids, snaps) — fully vectorized.
+
+    cells > 0 partition-aligns the workload (multi-resolver sweep,
+    ISSUE 7): txn i's ranges all land in key cell (i % cells), so a
+    transaction never straddles a resolver boundary and the per-resolver
+    verdict merge is EXACTLY the single-resolver verdict set (straddling
+    txns are pessimistic-only in a partitioned plane — a locally
+    committed / globally aborted txn leaves its writes in the owner's
+    history, as in the reference resolver)."""
     from foundationdb_tpu.conflict.encoded import EncodedBatch
     from foundationdb_tpu.ops.digest import encode_fixed
 
@@ -140,6 +167,12 @@ def gen_batch(rng: np.random.Generator, version: int, prev: int,
         kids = (rng.zipf(1.2, size=n) % keyspace).astype(np.int64)
     else:
         kids = rng.integers(0, keyspace, size=n, dtype=np.int64)
+    if cells:
+        width = keyspace // cells
+        row_txn = np.concatenate([
+            np.arange(t * READS_PER_TXN, dtype=np.int64) // READS_PER_TXN,
+            np.arange(t * WRITES_PER_TXN, dtype=np.int64) // WRITES_PER_TXN])
+        kids = (row_txn % cells) * width + kids % width
     # Key bytes: b"k" + 14 decimal digits (the proxy hands the resolver raw
     # byte keys; forming digests from them is the backend's timed work, but
     # the byte matrix itself is workload generation).
@@ -456,6 +489,185 @@ def run_config5():
     finally:
         TXNS_PER_BATCH = saved_txns
         knobs.CONFLICT_PIPELINE_DEPTH = saved_depth
+
+
+def _rsweep_fragment(enc, kids, r: int, n_res: int, keyspace: int,
+                     cells: int):
+    """Resolver r's fragment of a partition-aligned encoded batch: the
+    commit-proxy clip in columnar form.  ALL txns stay in the fragment
+    (t_snap is full width — the broadcast that keeps every resolver's
+    version window advancing); only the range columns are filtered to
+    the cells resolver r owns.  Returns (fragment, read_mask, write_mask)
+    so the caller can build the matching mirror transactions."""
+    from foundationdb_tpu.conflict.encoded import EncodedBatch
+    nr = enc.r_txn.shape[0]
+    width = keyspace // cells
+    row_res = (kids // width) * n_res // cells
+    rm = row_res[:nr] == r
+    wm = row_res[nr:] == r
+    t_has = np.zeros(enc.n_txns, dtype=bool)
+    t_has[enc.r_txn[rm]] = True
+    frag = EncodedBatch(
+        n_txns=enc.n_txns, t_snap=enc.t_snap, t_has_reads=t_has,
+        r_txn=enc.r_txn[rm], r_begin=enc.r_begin[:, rm],
+        r_end=enc.r_end[:, rm],
+        w_txn=enc.w_txn[wm], w_begin=enc.w_begin[:, wm],
+        w_end=enc.w_end[:, wm], all_point=enc.all_point)
+    return frag, rm, wm
+
+
+def _rsweep_fragment_txns(kids, snaps, rm, wm, n_txns: int):
+    """Object form of one resolver fragment (the supervised backend's
+    exact mirror input): every txn present, ranges clipped per the row
+    masks — the same shape the resolver receives from the proxy."""
+    from foundationdb_tpu.txn.types import CommitTransactionRef, KeyRange
+    nr = n_txns * READS_PER_TXN
+    keys = [b"k%014d" % int(k) for k in kids]
+    txns = []
+    for i in range(n_txns):
+        reads = []
+        for j in range(READS_PER_TXN):
+            row = i * READS_PER_TXN + j
+            if rm[row]:
+                reads.append(KeyRange(keys[row], keys[row] + b"\x00"))
+        writes = []
+        for j in range(WRITES_PER_TXN):
+            row = i * WRITES_PER_TXN + j
+            if wm[row]:
+                k = keys[nr + row]
+                writes.append(KeyRange(k, k + b"\x00"))
+        txns.append(CommitTransactionRef(
+            read_conflict_ranges=reads, write_conflict_ranges=writes,
+            mutations=[], read_snapshot=int(snaps[i])))
+    return txns
+
+
+def run_resolver_sweep(ns=RSWEEP_NS, txns: int = RSWEEP_TXNS,
+                       n_batches: int = RSWEEP_BATCHES,
+                       keyspace: int = RSWEEP_KEYSPACE,
+                       capacity: int = RSWEEP_CAPACITY,
+                       delta_capacity: int = RSWEEP_DELTA,
+                       seed: int = 707) -> dict:
+    """Multi-resolver sweep (ISSUE 7): see the RSWEEP_* constants doc.
+    Returns the JSON record; asserts abort-set parity — the merged
+    (min-across-resolvers) verdicts of every N must be bit-identical to
+    the single-resolver baseline on the same seeded stream."""
+    global TXNS_PER_BATCH
+    from foundationdb_tpu.conflict.supervisor import SupervisedConflictSet
+    from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+
+    saved_txns, TXNS_PER_BATCH = TXNS_PER_BATCH, txns
+    try:
+        rng = np.random.default_rng(seed)
+        stream = []
+        version = 0
+        for _ in range(n_batches):
+            prev, version = version, version + VERSIONS_PER_BATCH
+            enc, kids, snaps = gen_batch(rng, version, prev,
+                                         keyspace=keyspace,
+                                         cells=RSWEEP_CELLS)
+            stream.append((version, enc, kids, snaps))
+
+        def floor(v):
+            return max(0, v - WINDOW_BATCHES * VERSIONS_PER_BATCH)
+
+        per_n = {}
+        baseline = None
+        for n_res in ns:
+            assert RSWEEP_CELLS % n_res == 0, \
+                f"resolver count {n_res} must divide {RSWEEP_CELLS} cells"
+            # Fragment prep (the proxy's clip) is workload assembly, not
+            # resolution — excluded from the timed section.
+            frags = []
+            for r in range(n_res):
+                rows = []
+                for v, enc, kids, snaps in stream:
+                    frag, rm, wm = _rsweep_fragment(
+                        enc, kids, r, n_res, keyspace, RSWEEP_CELLS)
+                    rows.append((v, frag, _rsweep_fragment_txns(
+                        kids, snaps, rm, wm, enc.n_txns)))
+                frags.append(rows)
+            elapsed = [0.0] * n_res
+            timed_ranges = [0] * n_res
+            codes_by_batch = [None] * len(stream)
+            for r in range(n_res):
+                sup = SupervisedConflictSet(
+                    lambda oldest_version=0: TpuConflictSet(
+                        oldest_version, capacity=capacity,
+                        delta_capacity=delta_capacity))
+                for bi, (v, frag, ftxns) in enumerate(frags[r]):
+                    t0 = time.perf_counter()
+                    codes = sup.resolve_encoded_async(
+                        frag, v, floor(v),
+                        transactions=ftxns).wait_codes()
+                    dt = time.perf_counter() - t0
+                    if bi >= RSWEEP_WARMUP:
+                        elapsed[r] += dt
+                        timed_ranges[r] += frag.n_ranges
+                    merged = codes_by_batch[bi]
+                    codes_by_batch[bi] = (codes if merged is None
+                                          else np.minimum(merged, codes))
+                if sup.degraded or sup.stats["fallback_batches"]:
+                    print(f"resolver sweep: backend degraded (N={n_res}, "
+                          f"r={r})", file=sys.stderr)
+                    sys.exit(1)
+            if baseline is None:
+                baseline = codes_by_batch
+            else:
+                for bi, (want, got) in enumerate(
+                        zip(baseline, codes_by_batch)):
+                    assert np.array_equal(want, got), (
+                        f"PARITY FAILURE: N={n_res} merged verdicts "
+                        f"diverge from the 1-resolver baseline "
+                        f"(batch {bi})")
+            agg = (sum(timed_ranges) / max(elapsed)) if max(elapsed) else 0.0
+            per_n[str(n_res)] = {
+                "per_resolver_ranges_per_s": [
+                    round(timed_ranges[r] / elapsed[r], 1)
+                    if elapsed[r] else 0.0 for r in range(n_res)],
+                "per_resolver_ranges": timed_ranges,
+                "aggregate_ranges_per_s": round(agg, 1),
+            }
+            _phase(f"resolver sweep N={n_res}: aggregate "
+                   f"{agg:,.0f} ranges/s")
+        agg1 = per_n[str(ns[0])]["aggregate_ranges_per_s"]
+        return {
+            "metric": "multi_resolver_aggregate_ranges_per_s",
+            "sweep": per_n,
+            "parity": "ok",
+            "txns_per_batch": txns,
+            "batches": n_batches,
+            "warmup_batches": RSWEEP_WARMUP,
+            "keyspace": keyspace,
+            "cells": RSWEEP_CELLS,
+            "capacity_per_resolver": capacity,
+            "scaling_vs_1": {
+                k: round(v["aggregate_ranges_per_s"] / agg1, 3)
+                for k, v in per_n.items()} if agg1 else {},
+            "aggregate_model": (
+                "per-resolver streams timed separately on this host; "
+                "aggregate = total_ranges / max(per-resolver elapsed) — "
+                "models one resolver role per process, as deployed"),
+        }
+    finally:
+        TXNS_PER_BATCH = saved_txns
+
+
+def resolver_sweep_main() -> None:
+    """`bench.py resolvers` entry: run the multi-resolver sweep and write
+    BENCH_r07.json next to this file (plus the JSON line on stdout)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu" or \
+            os.environ.get("BENCH_FORCE_FALLBACK") == "1":
+        _force_cpu_backend()
+    import jax
+    doc = run_resolver_sweep()
+    doc["jax_backend"] = jax.default_backend()
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r07.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps(doc))
 
 
 def _force_cpu_backend() -> None:
@@ -933,6 +1145,12 @@ def parent_main(backend: str) -> None:
 
 def main() -> None:
     backend = sys.argv[1] if len(sys.argv) > 1 else "tpu"
+    if backend == "resolvers":
+        # Multi-resolver sweep (ISSUE 7): runs in-process (the sweep's
+        # batches are small enough not to need the parent/child budget
+        # machinery) and writes BENCH_r07.json.
+        resolver_sweep_main()
+        return
     if backend == "sharded":
         # Mesh-sharded resolver over every attached device (BASELINE
         # config 5 axis); otherwise identical to the tpu run.

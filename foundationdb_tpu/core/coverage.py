@@ -76,5 +76,9 @@ for _name in (
     "ChaosNemesisSwizzle",
     "ChaosNemesisAttrition",
     "ChaosNemesisPartition",
+    # Resolution-plane attrition (ISSUE 7): a live resolver's worker is
+    # killed; recovery must recruit a fresh plane with verdict
+    # continuity (Cycle + ConsistencyCheck run alongside).
+    "ChaosNemesisResolverKill",
 ):
     register(_name)

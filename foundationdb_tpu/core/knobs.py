@@ -155,6 +155,18 @@ class ServerKnobs(KnobBase):
         # every depth.  1 = fully serialized (the pre-pipeline behavior).
         self.CONFLICT_PIPELINE_DEPTH = 8
 
+        # Resolution plane (master recruitment): resolver count override —
+        # 0 recruits DatabaseConfiguration.n_resolvers (the committed
+        # \xff/conf value); > 0 pins the count regardless of configuration
+        # (takes effect at the next recovery, like every recruitment knob).
+        self.RESOLVER_COUNT = 0
+        # Seed recruitment-time resolver boundaries as equi-depth cuts over
+        # the storage shard map (DD keeps shards split by data volume, so
+        # shard boundaries sample the committed key distribution — the
+        # keyspace analog of sharded_window.splits_from_sample's digest
+        # quantiles).  False falls back to static even byte splits.
+        self.RESOLVER_BOUNDARY_EQUIDEPTH = True
+
         # Resolution balancing (reference masterserver.actor.cpp:1318)
         self.RESOLUTION_BALANCING_INTERVAL = 0.5
         self.RESOLUTION_BALANCING_MIN_LOAD = 50   # ranges/poll to bother

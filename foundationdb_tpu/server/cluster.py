@@ -61,11 +61,17 @@ class SimCluster:
                                       recovery_version=recovery_version)
                         for i in range(n_storage)]
 
-        # Resolver key-space partition (reference keyResolvers; rebalanced
-        # dynamically by resolutionBalancing once that lands).
+        # Resolver key-space partition (reference keyResolvers): even
+        # static splits over the user keyspace, with the \xff system range
+        # broadcast to ALL resolvers (RESOLVER_ALL) so every resolver
+        # holds identical system-key history.
+        from .interfaces import RESOLVER_ALL
+        from .system_data import SYSTEM_KEYS_BEGIN
         self.key_resolvers: RangeMap = RangeMap(default=0)
         for i, b in enumerate(_split_points(n_resolvers)):
             self.key_resolvers.set_range(b, b"\xff\xff", i + 1)
+        self.key_resolvers.set_range(SYSTEM_KEYS_BEGIN, b"\xff\xff",
+                                     RESOLVER_ALL)
 
         # Storage shard map: even partition, teams of `replication`
         # consecutive tags (reference keyServers + team structure).

@@ -29,7 +29,7 @@ from ..rpc.endpoint import RequestStream
 from .interfaces import (CACHE_TAG, CommitID, CommitProxyInterface,
                          CommitTransactionRequest, GetCommitVersionRequest,
                          GetKeyServerLocationsReply, GetReadVersionRequest,
-                         ReportRawCommittedVersionRequest,
+                         RESOLVER_ALL, ReportRawCommittedVersionRequest,
                          ResolveTransactionBatchRequest, Tag,
                          TLogCommitRequest)
 from .notified import NotifiedVersion
@@ -485,14 +485,20 @@ class CommitProxy:
                 "Proxy", self.id).detail("Begin", kr.begin).detail(
                 "End", kr.end).detail("To", idx).detail("Version", v).log()
 
-    @staticmethod
-    def _eligible(hist, floor: Version) -> List[int]:
+    def _eligible(self, hist, floor: Version) -> List[int]:
         """Resolvers owning any part of the MVCC window above `floor`:
         walk newest-first; the first entry at/below the floor is the owner
-        at window start and terminates the walk."""
-        out = []
+        at window start and terminates the walk.  A RESOLVER_ALL entry
+        (the \xff system range) expands to every resolver of the epoch —
+        system-key conflict ranges are checked by ALL resolvers against
+        identical broadcast history."""
+        out: List[int] = []
         for v, idx in hist:
-            if idx not in out:
+            if idx == RESOLVER_ALL:
+                for j in range(len(self.resolvers)):
+                    if j not in out:
+                        out.append(j)
+            elif idx not in out:
                 out.append(idx)
             if v <= floor:
                 break

@@ -43,6 +43,15 @@ CACHE_TAG: Tag = 0xFFFFFFFB
 # the shadow applies the same stream and client reads compare replies).
 TSS_TAG_OFFSET: Tag = 2_000_000
 
+# Resolver-index sentinel in keyResolvers range maps: the range is owned
+# by EVERY resolver of the epoch.  Used for the \xff system keyspace —
+# each resolver's conflict window then carries identical system-key
+# history, so metadata transactions get the same verdict regardless of
+# which resolvers judge them, and resolver boundary moves never have to
+# migrate system-range history (reference: ResolutionRequestBuilder sends
+# system/metadata work to all resolvers, CommitProxyServer.actor.cpp:88).
+RESOLVER_ALL: int = -1
+
 
 def tss_tag(tag: Tag) -> Tag:
     return TSS_TAG_OFFSET + tag
@@ -559,6 +568,12 @@ class ServerDBInfo:
     # DD recruits replacements mid-epoch and must honor a committed
     # `configure storage_engine=...` without a private channel.
     storage_engine: str = ""
+    # Resolution-plane key-range assignment of this generation:
+    # (begin, end, resolver_idx) with RESOLVER_ALL marking the broadcast
+    # \xff system range — what the proxies were recruited with, surfaced
+    # so status/fdbcli can render the plane topology.
+    resolver_ranges: List[Tuple[bytes, bytes, int]] = \
+        field(default_factory=list)
 
 
 @dataclass

@@ -33,7 +33,8 @@ from .interfaces import (DatabaseConfiguration, GetCommitVersionReply,
                          InitializeGrvProxyRequest, InitializeResolverRequest,
                          InitializeStorageRequest, InitializeTLogRequest,
                          MasterInterface, MasterRegistrationRequest,
-                         ServerDBInfo, Tag, TLogLockRequest)
+                         RESOLVER_ALL, ServerDBInfo, Tag, TLogLockRequest)
+from .system_data import SYSTEM_KEYS_BEGIN
 
 
 class _ProxyVersionState:
@@ -238,6 +239,15 @@ class DBCoreState:
     # batch, across full power failures.
     tenants: Dict[int, bytes] = field(default_factory=dict)
     tenant_metadata_version: int = 0
+    # Resolution-plane USER-keyspace ownership as of this epoch:
+    # (begin, end, resolver_idx) covering [b"", \xff) contiguously —
+    # recruitment-time equi-depth seeds plus any resolutionBalancing
+    # moves persisted since.  The broadcast \xff system range is implicit
+    # (every epoch appends it; see _key_resolver_ranges).  A recovery
+    # whose resolver count still matches adopts these boundaries instead
+    # of re-seeding, so balanced cuts survive epoch changes.
+    resolver_ranges: List[Tuple[bytes, bytes, int]] = \
+        field(default_factory=list)
 
     def pack(self) -> bytes:
         from ..core.wire import Writer
@@ -281,6 +291,9 @@ class DBCoreState:
         for tid, tname in sorted(self.tenants.items()):
             w.i64(tid).bytes_(tname)
         w.i64(self.tenant_metadata_version)
+        w.u16(len(self.resolver_ranges))
+        for b, e, idx in self.resolver_ranges:
+            w.bytes_(b).bytes_(e).i64(idx)
         return w.done()
 
     @staticmethod
@@ -331,6 +344,11 @@ class DBCoreState:
                 tid = r.i64()
                 tenants[tid] = r.bytes_()
             tenant_metadata_version = r.i64()
+        resolver_ranges: List[Tuple[bytes, bytes, int]] = []
+        if not r.at_end():
+            for _ in range(r.u16()):
+                rb, re_ = r.bytes_(), r.bytes_()
+                resolver_ranges.append((rb, re_, r.i64()))
         return cls(epoch=epoch, recovery_version=rv,
                    tlogs=[None] * len(tlog_ids), log_replication=log_rep,
                    storage_servers={t: None for t in storage_ids},
@@ -342,21 +360,82 @@ class DBCoreState:
                    remote_storage_ids=remote_storage_ids,
                    backup_container=backup_container, locked=locked,
                    tenants=tenants,
-                   tenant_metadata_version=tenant_metadata_version)
+                   tenant_metadata_version=tenant_metadata_version,
+                   resolver_ranges=resolver_ranges)
 
 
 def _split_points(n: int) -> List[bytes]:
     return [bytes([(256 * i) // n]) for i in range(1, n)]
 
 
-def _key_resolver_ranges(n_resolvers: int
+def seed_resolver_boundaries(key_servers_ranges, n_resolvers: int
+                             ) -> List[bytes]:
+    """n-1 interior cut keys for the resolver plane, seeded equi-depth
+    from the storage shard map: DD keeps shards split by data volume
+    (DD_SHARD_SPLIT_BYTES), so shard begin keys sample the committed key
+    distribution the same way sharded_window.splits_from_sample's digest
+    quantiles sample a workload — static even byte splits would land a
+    shared-prefix keyspace (tenants, bench's "k000..." keys) entirely on
+    one resolver.  Falls back to static byte splits when the shard map is
+    too coarse to cut n ways (cold boot) or the knob disables seeding."""
+    if n_resolvers <= 1:
+        return []
+    cands = sorted({b for b, _e, _team in key_servers_ranges
+                    if b"" < b < SYSTEM_KEYS_BEGIN})
+    if not server_knobs().RESOLVER_BOUNDARY_EQUIDEPTH or \
+            len(cands) < n_resolvers - 1:
+        return _split_points(n_resolvers)
+    cuts: List[bytes] = []
+    for i in range(1, n_resolvers):
+        c = cands[min(len(cands) - 1, (i * len(cands)) // n_resolvers)]
+        if not cuts or c > cuts[-1]:
+            cuts.append(c)
+    if len(cuts) != n_resolvers - 1:
+        return _split_points(n_resolvers)
+    return cuts
+
+
+def _valid_resolver_ranges(ranges, n_resolvers: int) -> bool:
+    """A persisted user-keyspace ownership list is adoptable iff it covers
+    [b"", \xff) contiguously AND every index recruited this epoch owns
+    some user range — a count INCREASE must re-seed, or the extra
+    resolvers would hold only the \xff broadcast and never take user
+    traffic (balancing only heals that under real load)."""
+    if not ranges:
+        return False
+    cur = b""
+    seen = set()
+    for b, e, idx in ranges:
+        if b != cur or e <= b or not 0 <= idx < n_resolvers:
+            return False
+        seen.add(idx)
+        cur = e
+    return cur == SYSTEM_KEYS_BEGIN and len(seen) == n_resolvers
+
+
+def _key_resolver_ranges(n_resolvers: int,
+                         user_ranges=None,
+                         boundaries: Optional[List[bytes]] = None
                          ) -> List[Tuple[bytes, bytes, int]]:
-    bounds = [b""] + _split_points(n_resolvers) + [b"\xff\xff"]
-    return [(bounds[i], bounds[i + 1], i) for i in range(n_resolvers)]
+    """The epoch's keyResolvers assignment: user-keyspace ownership ranges
+    (adopted from a previous epoch, seeded from `boundaries`, or static
+    even byte splits) plus the \xff system range broadcast to ALL
+    resolvers — every resolver holds identical system-key history, so
+    metadata transactions resolve identically everywhere and boundary
+    moves never migrate system history."""
+    if user_ranges is None:
+        if boundaries is None:
+            boundaries = _split_points(n_resolvers)
+        bounds = [b""] + list(boundaries) + [SYSTEM_KEYS_BEGIN]
+        user_ranges = [(bounds[i], bounds[i + 1], i)
+                       for i in range(n_resolvers)]
+    return list(user_ranges) + [
+        (SYSTEM_KEYS_BEGIN, b"\xff\xff", RESOLVER_ALL)]
 
 
 async def resolution_balancing(master: Master, resolvers: List[Any],
-                               key_resolver_ranges) -> None:
+                               key_resolver_ranges,
+                               coordinators=None) -> None:
     """Rebalance resolver key ranges by measured load (reference
     masterserver.actor.cpp:1318 resolutionBalancing + the resolver's
     metrics/split endpoints).  When the busiest resolver's sampled range
@@ -364,7 +443,13 @@ async def resolution_balancing(master: Master, resolvers: List[Any],
     hottest owned range is split at the load midpoint and the upper part
     moves; the change piggybacks on version replies, and proxies keep the
     per-version ownership history so old-snapshot conflict checks still
-    reach the resolvers that held the range inside the MVCC window."""
+    reach the resolvers that held the range inside the MVCC window.
+
+    The \xff system range (RESOLVER_ALL) is never a move source or
+    target: every resolver owns it by construction.  With `coordinators`
+    the post-move user-keyspace ownership is persisted into the
+    DBCoreState (fail-soft: a conflicting write — e.g. a racing quorum
+    move — just skips the refresh; the next recovery re-seeds)."""
     from .interfaces import ResolutionMetricsRequest, ResolutionSplitRequest
     from .shardmap import RangeMap
     from ..core.futures import swallow, wait_all
@@ -372,6 +457,25 @@ async def resolution_balancing(master: Master, resolvers: List[Any],
     owned: RangeMap = RangeMap(default=0)
     for b, e, idx in key_resolver_ranges:
         owned.set_range(b, e, idx)
+
+    async def persist_boundaries() -> None:
+        if coordinators is None:
+            return
+        from .coordination import CoordinatedState
+        try:
+            cs = CoordinatedState(coordinators)
+            cur = DBCoreState.coerce(await cs.read())
+            if cur is None or cur.epoch != master.epoch:
+                return      # superseded: the new epoch owns the plane
+            cur.resolver_ranges = [
+                (b, min(e, SYSTEM_KEYS_BEGIN), idx)
+                for b, e, idx in owned.ranges()
+                if idx != RESOLVER_ALL and b < SYSTEM_KEYS_BEGIN]
+            await cs.write(cur.pack())
+        except Exception as e:  # noqa: BLE001 — persistence is advisory
+            TraceEvent("ResolverBoundaryPersistFailed",
+                       Severity.Warn).detail("Error", repr(e)).log()
+
     while True:
         await delay(float(knobs.RESOLUTION_BALANCING_INTERVAL))
         futures = [RequestStream.at(r.metrics.endpoint).get_reply(
@@ -388,7 +492,8 @@ async def resolution_balancing(master: Master, resolvers: List[Any],
             continue
         # Split the busiest resolver's hottest owned range at its load
         # midpoint (the first range with enough samples to split); the
-        # upper half moves to the least-busy resolver.
+        # upper half moves to the least-busy resolver.  RESOLVER_ALL
+        # system ranges never match a real index, so they cannot move.
         src_ranges = [(b, e) for b, e, idx in owned.ranges() if idx == hi]
         split = b = e = None
         for rb, re_ in src_ranges:
@@ -413,6 +518,9 @@ async def resolution_balancing(master: Master, resolvers: List[Any],
             "From", hi).detail("To", lo).detail(
             "SplitKey", split).detail("End", e).detail(
             "Loads", loads).log()
+        # Balanced boundaries survive the epoch: refresh the persisted
+        # user-keyspace ownership (advisory; see persist_boundaries).
+        await persist_boundaries()
 
 
 async def _recruit_region(master, process, workers, config, tlogs,
@@ -1032,13 +1140,19 @@ async def master_server(master: Master, process, coordinators,
         epoch_proxy_ids = [f"proxy{i}.e{master.epoch}"
                            for i in range(config.n_commit_proxies)]
         master.expected_proxies = epoch_proxy_ids
+        # Resolution-plane size: the RESOLVER_COUNT knob pins it, 0 defers
+        # to the committed configuration.  Clamped to the packed cstate's
+        # u8 (and >= 1: the plane must cover the keyspace).
+        n_resolvers = int(server_knobs().RESOLVER_COUNT) or \
+            config.n_resolvers
+        n_resolvers = max(1, min(n_resolvers, 255))
         resolver_futures = [RequestStream.at(
             pick(i + 1).init_resolver.endpoint).get_reply(
             InitializeResolverRequest(
                 resolver_id=f"resolver{i}.e{master.epoch}",
                 epoch=master.epoch, recovery_version=recovery_version,
                 proxy_ids=epoch_proxy_ids))
-            for i in range(config.n_resolvers)]
+            for i in range(n_resolvers)]
         if prev is not None:
             # Storage is long-lived: reuse the existing servers — live
             # interfaces when their processes survived, disk-recovered
@@ -1216,7 +1330,29 @@ async def master_server(master: Master, process, coordinators,
                 storage_interfaces=storage_servers,
                 key_servers_ranges=key_servers_ranges,
                 replication=config.storage_replication))
-        key_resolvers_ranges = _key_resolver_ranges(config.n_resolvers)
+        # Resolution-plane boundaries: adopt the persisted user-keyspace
+        # ownership when the resolver count still matches (equi-depth
+        # seeds and balancing moves survive the epoch change; the new
+        # resolvers start with empty windows either way — the MVCC window
+        # floor at recovery_version makes that safe); otherwise re-seed
+        # equi-depth from the storage shard map.
+        prev_rr = list(prev.resolver_ranges) if prev is not None else []
+        if _valid_resolver_ranges(prev_rr, n_resolvers):
+            key_resolvers_ranges = _key_resolver_ranges(
+                n_resolvers, user_ranges=prev_rr)
+        else:
+            key_resolvers_ranges = _key_resolver_ranges(
+                n_resolvers, boundaries=seed_resolver_boundaries(
+                    key_servers_ranges, n_resolvers))
+        resolver_user_ranges = [r for r in key_resolvers_ranges
+                                if r[2] != RESOLVER_ALL]
+        TraceEvent("ResolutionPlaneRecruited").detail(
+            "Resolvers", n_resolvers).detail(
+            "Adopted", bool(prev_rr and
+                            _valid_resolver_ranges(prev_rr, n_resolvers))
+        ).detail("Boundaries",
+                 [b.decode("utf-8", "backslashreplace")
+                  for _b, b, _i in resolver_user_ranges[:-1]]).log()
         commit_proxy_futures = [RequestStream.at(
             pick(i).init_commit_proxy.endpoint).get_reply(
             InitializeCommitProxyRequest(
@@ -1255,7 +1391,8 @@ async def master_server(master: Master, process, coordinators,
             tlogs=tlogs, log_replication=config.log_replication,
             storage_servers=storage_servers,
             key_servers_ranges=key_servers_ranges,
-            n_resolvers=config.n_resolvers,
+            n_resolvers=n_resolvers,
+            resolver_ranges=resolver_user_ranges,
             map_version=recovery_version,
             backup_active=prev.backup_active if prev else False,
             conf=dict(prev.conf) if prev else {},
@@ -1271,7 +1408,8 @@ async def master_server(master: Master, process, coordinators,
         adopt(master._serve_commit_versions(), "master.serveVersions")
         adopt(master._serve_live_committed(), "master.serveLive")
         adopt(master._serve_report_committed(), "master.serveReport")
-        adopt(resolution_balancing(master, resolvers, key_resolvers_ranges),
+        adopt(resolution_balancing(master, resolvers, key_resolvers_ranges,
+                                   coordinators=coordinators),
               "master.resolutionBalancing")
         db_info = ServerDBInfo(
             epoch=master.epoch, recovery_state="accepting_commits",
@@ -1284,7 +1422,8 @@ async def master_server(master: Master, process, coordinators,
             log_routers=log_routers, remote_tlogs=remote_tlogs,
             remote_storage=remote_storage,
             log_replication=config.log_replication,
-            storage_engine=config.storage_engine)
+            storage_engine=config.storage_engine,
+            resolver_ranges=key_resolvers_ranges)
         await RequestStream.at(
             cc_interface.master_registration.endpoint).get_reply(
             MasterRegistrationRequest(epoch=master.epoch, db_info=db_info))

@@ -148,6 +148,49 @@ def collect_cluster_metrics(info, worker_docs=()) -> Dict[str, Any]:
     return out
 
 
+def collect_resolution_plane(info) -> Dict[str, Any]:
+    """cluster.resolution: the partitioned resolution plane — key-range
+    ownership of this generation (ServerDBInfo.resolver_ranges, the
+    \xff system range rendered as "all") plus per-resolver conflict
+    counters, Resolve latency band, and conflict-backend supervision
+    state keyed by resolver id (ISSUE 7 observability satellite).
+    Reads the sim-side role backrefs; on a real cluster remote resolver
+    processes surface through cluster.roles/metrics instead."""
+
+    def kstr(b) -> str:
+        return b.decode("utf-8", "backslashreplace") \
+            if isinstance(b, (bytes, bytearray)) else str(b)
+
+    ranges = [{"begin": kstr(b), "end": kstr(e),
+               "resolver": ("all" if idx < 0 else idx)}
+              for b, e, idx in getattr(info, "resolver_ranges", []) or []]
+    resolvers: Dict[str, Any] = {}
+    for iface in info.resolvers:
+        role = getattr(iface, "role", None)
+        metrics = getattr(role, "metrics", None)
+        if metrics is None:
+            resolvers[getattr(iface, "id", "?")] = {"reachable": False}
+            continue
+        entry: Dict[str, Any] = {
+            "txn_resolved": metrics.counter("TxnResolved").value,
+            "txn_conflicts": metrics.counter("TxnConflicts").value,
+            "resolved_batches": getattr(role, "resolved_batches", 0),
+            "version": role.version.get(),
+        }
+        h = metrics.histograms.get("Resolve")
+        if h is not None:
+            snap = h.snapshot()
+            if snap.count:
+                entry["resolve"] = snap.to_status()
+        backend = getattr(role, "backend_status", None)
+        bs = backend() if callable(backend) else None
+        if bs:
+            entry["conflict_backend"] = bs
+        resolvers[metrics.role_id] = entry
+    return {"count": len(info.resolvers), "ranges": ranges,
+            "resolvers": resolvers}
+
+
 async def build_status(cc) -> Dict[str, Any]:
     """Assemble the status document from the CC's view + live role polls
     (all polls issued in parallel — one clogged role must not stall the
@@ -288,6 +331,10 @@ async def build_status(cc) -> Dict[str, Any]:
             "layers": {"_valid": True},
             "tenants": tenants_doc,
             "roles": roles,
+            # Partitioned resolution plane: per-resolver conflict stats,
+            # backend supervision, and the generation's key-range
+            # ownership (ISSUE 7).
+            "resolution": collect_resolution_plane(info),
             # Per-stage commit-pipeline latency bands + per-group counter
             # sums (ISSUE 3: the `fdbcli metrics` surface).  Sources:
             # sim-side role backrefs, else the workers' registered

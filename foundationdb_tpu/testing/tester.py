@@ -265,6 +265,20 @@ def run_simulation(spec, seed: int, *, buggify: bool = False,
     from ..server.interfaces import DatabaseConfiguration
 
     spec = load_spec(spec) if isinstance(spec, str) else spec
+    if config is None:
+        # Spec-driven cluster shape: a top-level [cluster] table overrides
+        # the default DatabaseConfiguration field-by-field (e.g.
+        # `n_resolvers = 2` boots the partitioned resolution plane for a
+        # chaos spec).  Unknown keys are rejected loudly — a typo'd field
+        # silently running the default topology would void the spec.
+        fields = dict(n_tlogs=2, log_replication=2, n_storage=2,
+                      storage_replication=2)
+        for k, v in (spec.get("cluster") or {}).items():
+            if k not in DatabaseConfiguration._INT_FIELDS and \
+                    k not in DatabaseConfiguration._STR_FIELDS:
+                raise KeyError(f"unknown [cluster] field {k!r} in spec")
+            fields[k] = v
+        config = DatabaseConfiguration(**fields)
     gc_was_enabled = gc.isenabled()
     gc.collect()
     gc.disable()
@@ -277,9 +291,7 @@ def run_simulation(spec, seed: int, *, buggify: bool = False,
             auditor.__enter__()
         try:
             cluster = SimFdbCluster(
-                config=config or DatabaseConfiguration(
-                    n_tlogs=2, log_replication=2, n_storage=2,
-                    storage_replication=2),
+                config=config,
                 n_workers=n_workers, n_storage_workers=n_storage_workers)
 
             async def go():

@@ -222,6 +222,9 @@ class ChaosNemesisWorkload(TestWorkload):
             loops.append(spawn(self._attrition_loop(), "nemesis.attrition"))
         if self.config.get("partitions", True):
             loops.append(spawn(self._partition_loop(), "nemesis.partition"))
+        if self.config.get("resolverAttrition", False):
+            loops.append(spawn(self._resolver_attrition_loop(),
+                               "nemesis.resolverAttrition"))
         await wait_all(loops)
         # Leave the cluster whole: heal every network fault and bring
         # back every downed worker before quiescence.
@@ -347,6 +350,45 @@ class ChaosNemesisWorkload(TestWorkload):
         self.metrics["reboots"] = reboots
         self.metrics["power_fails"] = power_fails
         self.metrics["kills"] = kills
+
+    async def _resolver_attrition_loop(self) -> None:
+        """Targeted resolution-plane attrition (ISSUE 7): kill the worker
+        hosting a RESOLVER of the current generation — the epoch ends,
+        recovery recruits a fresh plane (persisted boundaries adopted,
+        empty conflict windows behind the recovery_version MVCC floor) —
+        then restart the worker.  The Cycle + ConsistencyCheck workloads
+        running alongside prove verdict continuity across the plane
+        change; generic attrition only hits resolvers by luck."""
+        from ..core.coverage import test_coverage
+        from ..core.rng import deterministic_random
+        rng = deterministic_random()
+        sim = self.cluster.sim
+        restart_delay = float(self.config.get("restartDelay", 1.5))
+        kills = 0
+        while now() < self._deadline:
+            await delay(2.0 + rng.random01() * 3.0)
+            cc = self.cluster.current_cc()
+            if cc is None or cc.db_info.recovery_state not in (
+                    "accepting_commits", "fully_recovered"):
+                continue
+            resolvers = list(cc.db_info.resolvers)
+            if not resolvers:
+                continue
+            iface = resolvers[rng.random_int(0, len(resolvers))]
+            victim = self.cluster.process_of(iface)
+            if victim is None or not victim.alive:
+                continue
+            idx = next((i for i, e in enumerate(self.cluster.workers)
+                        if e[0] is victim), None)
+            if idx is None or not self._safe_to_fail(victim):
+                continue
+            test_coverage("ChaosNemesisResolverKill")
+            sim.kill_process(victim)
+            kills += 1
+            await delay(restart_delay)
+            self.cluster.restart_worker(idx)
+            await delay(restart_delay)      # one victim at a time
+        self.metrics["resolver_kills"] = kills
 
     async def check(self) -> bool:
         # The nemesis's own invariant: it put the cluster back together.

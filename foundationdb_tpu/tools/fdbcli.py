@@ -174,6 +174,32 @@ class Cli:
             vals = ", ".join(f"{k}={v}" for k, v in
                              sorted(counters[g].items()))
             lines.append(f"  {g}: {vals}")
+        # Partitioned resolution plane (ISSUE 7): per-resolver conflict
+        # stats + backend supervision keyed by resolver id, and the
+        # generation's key-range ownership.
+        res = cl.get("resolution", {}) or {}
+        if res.get("resolvers") and (not needle or
+                                     needle in "resolution resolvers"):
+            lines.append(f"Resolution plane ({res.get('count', 0)} "
+                         "resolvers):")
+            lines.append(f"  {'resolver':<22}{'resolved':>10}"
+                         f"{'conflicts':>10}{'p95 ms':>9}  backend")
+            for rid in sorted(res["resolvers"]):
+                r = res["resolvers"][rid]
+                if not r.get("txn_resolved") and "reachable" in r:
+                    lines.append(f"  {rid:<22}{'(unreachable)':>10}")
+                    continue
+                band = r.get("resolve") or {}
+                p95 = (f"{band['p95'] * 1e3:.3f}" if band else "-")
+                cb = r.get("conflict_backend") or {}
+                state = ("degraded" if cb.get("degraded")
+                         else "ok" if cb else "-")
+                lines.append(
+                    f"  {rid:<22}{r.get('txn_resolved', 0):>10}"
+                    f"{r.get('txn_conflicts', 0):>10}{p95:>9}  {state}")
+            for rr in res.get("ranges", []):
+                lines.append(f"    [{rr['begin']!r}, {rr['end']!r}) -> "
+                             f"{rr['resolver']}")
         return "\n".join(lines)
 
     def cmd_configure(self, *assignments: str) -> str:
