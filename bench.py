@@ -250,6 +250,67 @@ def run_parity_regime(make_cs, batches, floor, label: str):
     return committed / max(n, 1)
 
 
+def run_heat_gate(make_cs, batches, floor, repeats: int = 3):
+    """ISSUE 8 overhead gate: the SUPERVISED conflict path — where heat
+    telemetry's only hot-path costs live (the mirror's knob-bounded
+    abort attribution in conflict/supervisor.py plus the resolver-style
+    tracker feed emulated here) — measured on an identical stream with
+    HEAT_TELEMETRY_ENABLED off and on.  The stream is short, so the two
+    modes are INTERLEAVED `repeats` times and each mode keeps its best
+    elapsed (min filters scheduler/allocator noise that would otherwise
+    dwarf a sub-percent delta).  Returns a JSON-able dict with both
+    ranges/s figures and the overhead percentage; the acceptance gate
+    wants |overhead| <= 2%.  Shapes match the main stream, so the
+    programs are already compiled."""
+    from foundationdb_tpu.conflict.heat import ConflictHeatTracker
+    from foundationdb_tpu.conflict.supervisor import SupervisedConflictSet
+    from foundationdb_tpu.core.knobs import server_knobs
+
+    prepared = [(v, enc, to_transactions(kids, snaps))
+                for v, enc, kids, snaps in batches]
+    n_ranges = sum(enc.n_ranges for _v, enc, _t in prepared)
+    knobs = server_knobs()
+    saved = knobs.HEAT_TELEMETRY_ENABLED
+    best = {False: float("inf"), True: float("inf")}
+    try:
+        for _rep in range(max(1, repeats)):
+            for enabled in (False, True):
+                knobs.HEAT_TELEMETRY_ENABLED = enabled
+                sup = SupervisedConflictSet(make_cs)
+                tracker = ConflictHeatTracker()
+                t0 = time.perf_counter()
+                for v, enc, txns in prepared:
+                    h = sup.resolve_encoded_async(enc, v, floor(v),
+                                                  transactions=txns)
+                    h.wait_codes()
+                    # _sample_batch load sampling runs in the resolver
+                    # regardless of the knob (it predates the heat
+                    # plane), so BOTH modes pay it; the knob-gated delta
+                    # is the conflict-attribution feed below.
+                    for tr in txns:
+                        for r in tr.read_conflict_ranges + \
+                                tr.write_conflict_ranges:
+                            tracker.sample_load(r.begin, r.end)
+                    if enabled:
+                        # The resolver's knob-gated feed: only the
+                        # attributed (budget-bounded) sample is recorded
+                        # — the device path's cost stays bounded
+                        # regardless of the batch's abort rate.
+                        for i, ranges in h.attribution.items():
+                            for b, e in ranges:
+                                tracker.record_conflict(b, e)
+                best[enabled] = min(best[enabled],
+                                    time.perf_counter() - t0)
+    finally:
+        knobs.HEAT_TELEMETRY_ENABLED = saved
+    off, on = n_ranges / best[False], n_ranges / best[True]
+    overhead = (off - on) / off * 100.0 if off else 0.0
+    return {"disabled_ranges_per_s": round(off, 1),
+            "enabled_ranges_per_s": round(on, 1),
+            "overhead_pct": round(overhead, 2),
+            "batches": len(prepared), "repeats": max(1, repeats)}
+
+
 class _EmulatedHandle:
     """d2h half of the tunnel emulation: the fetch-lane sleep occupies
     the emulated link before the (instant, XLA-CPU) verdict fetch."""
@@ -912,6 +973,21 @@ def child_main(backend: str) -> None:
               f"(depth {best_depth}) below the 1.2x target",
               file=sys.stderr)
 
+    # ---- heat-telemetry overhead gate (ISSUE 8) ---------------------------
+    heat_overhead = None
+    if os.environ.get("BENCH_HEAT_GATE", "1") != "0":
+        if _remaining_s() > 60:
+            _phase("heat-telemetry overhead gate (supervised path, "
+                   "enabled vs disabled)")
+            heat_overhead = run_heat_gate(
+                make_cs, batches[:N_WARMUP + N_LOWC], floor)
+            if abs(heat_overhead["overhead_pct"]) > 2.0:
+                print(f"# WARNING: heat telemetry overhead "
+                      f"{heat_overhead['overhead_pct']:.2f}% above the "
+                      "2% gate", file=sys.stderr)
+        else:
+            heat_overhead = {"skipped": "BENCH_DEADLINE_S budget"}
+
     # ---- BASELINE config 5: 1M in-flight ranges on the sharded mesh -------
     config5 = None
     if os.environ.get("BENCH_BACKEND") == "sharded" and \
@@ -946,6 +1022,8 @@ def child_main(backend: str) -> None:
             "bytes_per_range": TUNNEL_BYTES_PER_RANGE,
             "d2h_latency_s": TUNNEL_D2H_S,
         }
+    if heat_overhead is not None:
+        doc["heat_overhead"] = heat_overhead
     if config5 is not None:
         doc["config5"] = config5
     print(json.dumps(doc))

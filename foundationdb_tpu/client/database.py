@@ -567,6 +567,16 @@ class Transaction:
     # TenantMapRangeImpl): \xff\xff/management/tenant/map/<name> = JSON
     # {id, prefix-hex} — tooling lists tenants without raw-\xff access.
     MANAGEMENT_TENANT_MAP_PREFIX = b"\xff\xff/management/tenant/map/"
+    # Cluster heat telemetry mirrors (ISSUE 8; reference the
+    # \xff\xff/metrics/ special-key module family): read-only rows
+    # synthesized from status cluster.heat, so a plain client txn — and
+    # the future conflict predictor at the GRV proxy — can consume the
+    # hot-range tables without raw-\xff or status-RPC access.
+    #   conflict_ranges/<resolver>/<begin-hex> = JSON row
+    #   read_hot_ranges/<storage-tag>/<begin-hex> = JSON row
+    METRICS_PREFIX = b"\xff\xff/metrics/"
+    METRICS_CONFLICT_PREFIX = b"\xff\xff/metrics/conflict_ranges/"
+    METRICS_READ_HOT_PREFIX = b"\xff\xff/metrics/read_hot_ranges/"
 
     @staticmethod
     def _tenant_entry_json(entry) -> bytes:
@@ -609,7 +619,57 @@ class Transaction:
                  self._tenant_entry_json(TenantMapEntry.decode(v)))
                 for k, v in raw]
 
+    async def _heat_doc(self) -> dict:
+        """status cluster.heat — the single source both metrics mirrors
+        render (so special keys, `fdbcli top` and status agree)."""
+        get_status = getattr(self.db.cluster, "get_status", None)
+        if get_status is None:
+            return {}
+        doc = await get_status()
+        return doc.get("cluster", {}).get("heat", {}) or {}
+
+    def _heat_rows(self, heat: dict) -> List[Tuple[bytes, bytes]]:
+        """All rows of both \xff\xff/metrics/ modules, key-sorted.
+        Row keys embed the range-begin as HEX so they order like the raw
+        keys; values are self-contained JSON rows."""
+        import json as _json
+        rows: List[Tuple[bytes, bytes]] = []
+        conflict = heat.get("conflict_ranges", {}) or {}
+        for rid in conflict:
+            for row in conflict[rid].get("top_conflict_ranges", []):
+                # begin AND end in the key: two hot ranges sharing a
+                # begin ([a,b) and [a,c)) must stay distinct rows.
+                rows.append((
+                    self.METRICS_CONFLICT_PREFIX + rid.encode() + b"/" +
+                    row["begin_hex"].encode() + b"-" +
+                    row["end_hex"].encode(),
+                    _json.dumps(dict(row, resolver=rid)).encode()))
+        read_hot = heat.get("read_hot_ranges", {}) or {}
+        for tag in read_hot:
+            for row in read_hot[tag]:
+                rows.append((
+                    self.METRICS_READ_HOT_PREFIX + tag.encode() + b"/" +
+                    row["begin_hex"].encode() + b"-" +
+                    row["end_hex"].encode(),
+                    _json.dumps(dict(row, tag=tag)).encode()))
+        rows.sort()
+        return rows
+
+    async def _metrics_module_rows(self, begin: bytes, end: bytes,
+                                   limit: int, reverse: bool = False
+                                   ) -> List[Tuple[bytes, bytes]]:
+        rows = [(k, v) for k, v in self._heat_rows(await self._heat_doc())
+                if begin <= k < end]
+        if reverse:
+            rows.reverse()
+        return rows[:limit]
+
     async def _special_key_get(self, key: bytes) -> Optional[bytes]:
+        if key.startswith(self.METRICS_PREFIX):
+            for k, v in self._heat_rows(await self._heat_doc()):
+                if k == key:
+                    return v
+            return None
         if key.startswith(self.MANAGEMENT_TENANT_MAP_PREFIX):
             # Read-only mirror: a plain read of a nonexistent/odd name
             # (empty, NUL, overlong) is ABSENT, never a name-validation
@@ -651,7 +711,8 @@ class Transaction:
                     return v
             return None
         if key.startswith(b"\xff\xff/status/") or \
-                key.startswith(b"\xff\xff/management/"):
+                key.startswith(b"\xff\xff/management/") or \
+                key.startswith(self.METRICS_PREFIX):
             return await self._special_key_get(key)
         _check_key(key, self.access_system_keys)
         if not snapshot:
@@ -701,6 +762,10 @@ class Transaction:
         tp = self.MANAGEMENT_TENANT_MAP_PREFIX
         if begin.startswith(tp) or (begin <= tp and end > tp):
             return await self._tenant_map_rows(begin, end, limit, reverse)
+        mp = self.METRICS_PREFIX
+        if begin.startswith(mp) or (begin <= mp and end > mp):
+            return await self._metrics_module_rows(begin, end, limit,
+                                                   reverse)
         if not snapshot:
             self.read_conflict_ranges.append((begin, end))
         version = await self._ensure_read_version()
@@ -878,7 +943,8 @@ class Transaction:
             read_snapshot=read_snapshot,
             report_conflicting_keys=self.report_conflicting_keys,
             lock_aware=self.lock_aware,
-            tenant_id=self.tenant_id)
+            tenant_id=self.tenant_id,
+            tag=self.tag)
         if txn.expected_size() > client_knobs().TRANSACTION_SIZE_LIMIT:
             raise err("transaction_too_large")
         await self.db._await_ready()

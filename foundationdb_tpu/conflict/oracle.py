@@ -133,6 +133,14 @@ class OracleConflictSet(ConflictSet):
     def __init__(self, oldest_version: Version = 0) -> None:
         super().__init__(oldest_version)
         self.history = VersionHistory(oldest_version)
+        # Per-batch exact conflict attribution of the LAST resolve (heat
+        # telemetry feed): {txn index: [(begin, end), ...]} for every
+        # CONFLICT verdict — all culprit ranges for reporters, the first
+        # culprit otherwise (the decision loop stops there).  True in
+        # last_attribution_exact marks the ranges as exact (this oracle
+        # always is; the supervisor's conservative fallback is not).
+        self.last_attribution: dict = {}
+        self.last_attribution_exact: dict = {}
 
     def clear(self, version: Version) -> None:
         self.history = VersionHistory(version)
@@ -154,11 +162,13 @@ class OracleConflictSet(ConflictSet):
         n = len(transactions)
         too_old = [False] * n
         conflict = [False] * n
-        reported: dict = {}
+        # Culprit ranges for EVERY conflicted txn (heat attribution);
+        # `reported` (the client-facing conflicting-keys surface) is the
+        # reporter-only projection of the same dict, built at the end.
+        attribution: dict = {}
 
-        def _report(t, tr, rng) -> None:
-            if getattr(tr, "report_conflicting_keys", False):
-                reported.setdefault(t, []).append((rng.begin, rng.end))
+        def _report(t, _tr, rng) -> None:
+            attribution.setdefault(t, []).append((rng.begin, rng.end))
 
         # 1. too-old classification (SkipList.cpp:819-827): snapshot below the
         # window floor, and only if the txn actually read something.
@@ -221,6 +231,53 @@ class OracleConflictSet(ConflictSet):
                 out.append(CommitResult.CONFLICT)
             else:
                 out.append(CommitResult.COMMITTED)
-        reported = {t: rs for t, rs in reported.items()
-                    if out[t] == CommitResult.CONFLICT}
+        attribution = {t: rs for t, rs in attribution.items()
+                       if out[t] == CommitResult.CONFLICT}
+        self.last_attribution = attribution
+        self.last_attribution_exact = {t: True for t in attribution}
+        reported = {t: rs for t, rs in attribution.items()
+                    if getattr(transactions[t], "report_conflicting_keys",
+                               False)}
         return out, reported
+
+    def attribute_conflicts(self, transactions, verdicts,
+                            limit: int = 1 << 30) -> dict:
+        """READ-ONLY exact attribution for a batch someone ELSE resolved
+        (the supervisor's device path): given the final verdicts, rerun
+        only the decision loop's range checks against the CURRENT history
+        — so this must be called BEFORE the batch's surviving writes are
+        inserted — and against the surviving writes of earlier txns in
+        the batch.  At most `limit` CONFLICT txns are attributed (batch
+        order; the caller counts the remainder as conservative).
+        Returns {txn index: [(begin, end), ...]}."""
+        out: dict = {}
+        attributed = 0
+        surviving: List[Tuple[bytes, bytes]] = []
+        for t, (tr, v) in enumerate(zip(transactions, verdicts)):
+            if attributed >= limit:
+                break            # budget exhausted: stop scanning
+            if v == CommitResult.COMMITTED:
+                for w in tr.write_conflict_ranges:
+                    if w.begin < w.end:
+                        surviving.append((w.begin, w.end))
+                continue
+            if v != CommitResult.CONFLICT:
+                continue
+            attributed += 1
+            ranges: List[Tuple[bytes, bytes]] = []
+            report = getattr(tr, "report_conflicting_keys", False)
+            for r in tr.read_conflict_ranges:
+                hit = self.history.query_max(r.begin, r.end) \
+                    > tr.read_snapshot
+                if not hit:
+                    for wb, we in surviving:
+                        if r.begin < we and wb < r.end:
+                            hit = True
+                            break
+                if hit:
+                    ranges.append((r.begin, r.end))
+                    if not report:
+                        break
+            if ranges:
+                out[t] = ranges
+        return out

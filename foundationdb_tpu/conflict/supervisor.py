@@ -297,7 +297,7 @@ class SupervisedHandle:
     __slots__ = ("owner", "txns", "now", "new_oldest",
                  "dispatch_fut", "fetch_fut", "device_obj", "dispatch_t0",
                  "results", "codes", "conflicting", "rechecked",
-                 "via_fallback")
+                 "via_fallback", "attribution", "attribution_exact")
 
     def __init__(self, owner: "SupervisedConflictSet", txns, now: Version,
                  new_oldest: Optional[Version]) -> None:
@@ -314,6 +314,14 @@ class SupervisedHandle:
         self.conflicting: Optional[Dict[int, list]] = None
         self.rechecked = False
         self.via_fallback = False
+        # Heat-telemetry attribution: {txn index: [(begin, end), ...]}
+        # culprit ranges for aborted txns this fold attributed EXACTLY
+        # (mirror-resolved batches: all of them; device batches: a
+        # CONFLICT_ATTRIBUTION_SAMPLE-bounded prefix).  Aborted txns
+        # absent from the dict carry only conservative (whole read set)
+        # blame — the consumer falls back per txn.
+        self.attribution: Dict[int, list] = {}
+        self.attribution_exact: Dict[int, bool] = {}
 
     @property
     def folded(self) -> bool:
@@ -381,7 +389,8 @@ class SupervisedConflictSet(ConflictSet):
         self.stats = {"device_batches": 0, "fallback_batches": 0,
                       "rechecked_batches": 0, "degrades": 0,
                       "promotions": 0, "retries": 0, "taint_size": 0,
-                      "pipeline_stalls": 0}
+                      "pipeline_stalls": 0, "conservative_attribution": 0,
+                      "exact_attribution": 0}
         self._device: Optional[ConflictSet] = None
         try:
             self._device = self._guarded(
@@ -608,6 +617,56 @@ class SupervisedConflictSet(ConflictSet):
                                         host_digest(w.end, True), now))
         self.stats["taint_size"] = len(self._taint)
 
+    def _attribute_device_batch(self, h: SupervisedHandle,
+                                device_codes) -> None:
+        """Fix for the device path's conservative conflict reporting
+        (the old behavior blamed a reporter's ENTIRE read set): a
+        CONFLICT_ATTRIBUTION_SAMPLE-bounded prefix of this batch's
+        aborted txns is attributed EXACTLY against the mirror history —
+        which still holds the pre-batch state the device's decisions
+        were made against — and the remainder keep conservative blame,
+        counted in ConservativeAttribution so the fallback is visible.
+        Cost is knob-bounded: a numpy/list conflict count plus at most
+        `sample` read-range probes of the mirror's segment list."""
+        conflict_code = int(CommitResult.CONFLICT)
+        if isinstance(device_codes, list):
+            conflicted = [i for i, c in enumerate(device_codes)
+                          if int(c) == conflict_code]
+        else:
+            import numpy as np
+            conflicted = np.nonzero(
+                np.asarray(device_codes) == conflict_code)[0].tolist()
+        n_conflicts = len(conflicted)
+        if not n_conflicts:
+            h.conflicting = {}
+            return
+        knobs = server_knobs()
+        budget = (int(knobs.CONFLICT_ATTRIBUTION_SAMPLE)
+                  if knobs.HEAT_TELEMETRY_ENABLED else 0)
+        exact: Dict[int, list] = {}
+        if budget > 0:
+            exact = self._mirror.attribute_conflicts(
+                h.txns, device_codes, budget)
+        h.attribution = exact
+        h.attribution_exact = {i: True for i in exact}
+        conservative = n_conflicts - len(exact)
+        self.stats["exact_attribution"] += len(exact)
+        if conservative:
+            self.stats["conservative_attribution"] += conservative
+            self.metrics.counter("ConservativeAttribution").add(
+                conservative)
+        # Reporters' client-facing ranges: exact where attributed, the
+        # conservative whole read set otherwise (still a legal superset).
+        conflicting: Dict[int, list] = {}
+        for i in conflicted:
+            tr = h.txns[i]
+            if not getattr(tr, "report_conflicting_keys", False):
+                continue
+            rs = exact.get(i)
+            conflicting[i] = rs if rs is not None else \
+                [(r.begin, r.end) for r in tr.read_conflict_ranges]
+        h.conflicting = conflicting
+
     # -- folding -------------------------------------------------------------
     def _fold_through(self, handle: SupervisedHandle) -> None:
         while self._pending:
@@ -708,6 +767,10 @@ class SupervisedConflictSet(ConflictSet):
                 h.txns, h.now, h.new_oldest)
             self.metrics.histogram("MirrorResolve").record(
                 _wall() - _t_m)
+            # Mirror-resolved: the oracle knows every culprit exactly.
+            h.attribution = dict(self._mirror.last_attribution)
+            h.attribution_exact = dict(self._mirror.last_attribution_exact)
+            self.stats["exact_attribution"] += len(h.attribution)
             self.oldest_version = self._mirror.oldest_version
             self._prune_taint()
             return
@@ -729,7 +792,15 @@ class SupervisedConflictSet(ConflictSet):
                 _wall() - _t_m)
             self._taint_divergence(h.txns, device_codes, final, h.now)
             h.results, h.conflicting = final, ranges
+            h.attribution = dict(self._mirror.last_attribution)
+            h.attribution_exact = dict(self._mirror.last_attribution_exact)
+            self.stats["exact_attribution"] += len(h.attribution)
         else:
+            # Device-exact batch: attribute a knob-bounded sample of the
+            # aborted txns against the mirror BEFORE this batch's writes
+            # land in it (satellite 1 — the pre-insert history is what
+            # the conflict decisions were made against).
+            self._attribute_device_batch(h, device_codes)
             # Unflagged: device verdicts are provably exact (see module
             # docstring); fold them into the mirror as-is.  The bulk path
             # delivers raw int8 codes (kept as-is; wait() materializes
@@ -739,7 +810,6 @@ class SupervisedConflictSet(ConflictSet):
                 h.results = device_codes
             else:
                 h.codes = device_codes
-            h.conflicting = None
         self.oldest_version = self._mirror.oldest_version
         self._prune_taint()
         if slo_tripped:
@@ -861,6 +931,12 @@ class SupervisedConflictSet(ConflictSet):
                                new_oldest_version: Optional[Version] = None):
         h = self.resolve_async(transactions, now, new_oldest_version)
         verdicts = h.wait()
+        # Heat-telemetry surface: exact culprits where this batch's fold
+        # attributed them (mirror-resolved: all; device path: the
+        # knob-bounded sample) — consumers fall back to a txn's read set
+        # for aborted indices absent from the dict.
+        self.last_attribution = h.attribution
+        self.last_attribution_exact = h.attribution_exact
         if h.conflicting is not None:       # exact (mirror-resolved) path
             return verdicts, h.conflicting
         from .api import conservative_conflict_ranges

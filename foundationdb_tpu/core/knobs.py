@@ -155,6 +155,31 @@ class ServerKnobs(KnobBase):
         # every depth.  1 = fully serialized (the pre-pipeline behavior).
         self.CONFLICT_PIPELINE_DEPTH = 8
 
+        # Cluster heat telemetry (conflict/heat.py, ISSUE 8): the
+        # conflict-range / read-hot-spot sampling plane surfaced through
+        # status cluster.heat, \xff\xff/metrics/ and `fdbcli top`.  The
+        # master switch gates every hot-path sample (resolver conflict
+        # attribution feed, storage per-shard read heat, the supervised
+        # device path's mirror attribution) so the bench overhead gate
+        # can measure enabled-vs-disabled on the same stream.
+        self.HEAT_TELEMETRY_ENABLED = True
+        # Max aborted txns per device-path batch attributed EXACTLY via
+        # the supervisor's mirror (conflict/supervisor.py satellite fix);
+        # the remainder keep conservative whole-read-set blame, counted
+        # by the ConservativeAttribution counter.
+        self.CONFLICT_ATTRIBUTION_SAMPLE = 32
+        # Rows per table in HotConflictRange emission, cluster.heat and
+        # the \xff\xff/metrics/ mirrors.
+        self.CONFLICT_HEAT_TOP_K = 8
+        # Unified resolver sample table bound (load + conflict columns,
+        # halved when full — the old SAMPLE_TABLE_MAX).
+        self.CONFLICT_HEAT_TABLE_MAX = 4096
+        # Storage read-heat sampling (server/storage.py): per-shard
+        # ops/bytes EMA folded at each queuing-metrics poll.
+        self.READ_HOT_EMA_HALF_LIFE_S = 2.0   # EMA memory
+        self.READ_HOT_SHARD_MAX_REPORT = 8    # rows per reply/status
+        self.READ_HOT_MIN_OPS_PER_S = 10.0    # ReadHotShard trace floor
+
         # Resolution plane (master recruitment): resolver count override —
         # 0 recruits DatabaseConfiguration.n_resolvers (the committed
         # \xff/conf value); > 0 pins the count regardless of configuration

@@ -420,6 +420,18 @@ class CommitProxy:
                 if local_i < len(index_maps[r_idx]):
                     t_idx = index_maps[r_idx][local_i]
                     conflict_ranges.setdefault(t_idx, []).extend(ranges)
+        # Attribution exactness merged the same way (heat telemetry /
+        # commit-debug): exact only if EVERY resolver that aborted the
+        # txn pinned true culprits — one conservative vote poisons the
+        # union, since the merged range list then over-blames.
+        conflict_exact: Dict[int, bool] = {}
+        for r_idx, reply in enumerate(resolutions):
+            for local_i, exact in getattr(reply, "attribution_exact",
+                                          {}).items():
+                if local_i < len(index_maps[r_idx]):
+                    t_idx = index_maps[r_idx][local_i]
+                    conflict_exact[t_idx] = \
+                        conflict_exact.get(t_idx, True) and bool(exact)
         for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
             if t_idx in tenant_errors:
                 # Tenant fence rejection: a SPECIFIC, non-retryable error
@@ -445,6 +457,23 @@ class CommitProxy:
                     # \xff\xff/transaction/conflicting_keys (reference
                     # SpecialKeySpace ConflictingKeysImpl).
                     e.details = conflict_ranges[t_idx]
+                if req.debug_id:
+                    # Traced txn aborted: record WHICH ranges and whether
+                    # the attribution was exact, for the commit-debug
+                    # waterfall (tools/commit_debug.py).  Reporters carry
+                    # the resolver-merged culprits; others fall back to
+                    # the original read set (conservative by definition).
+                    ranges = conflict_ranges.get(t_idx) or [
+                        (r.begin, r.end)
+                        for r in req.transaction.read_conflict_ranges]
+                    exact = conflict_exact.get(t_idx, False) and \
+                        t_idx in conflict_ranges
+                    TraceEvent("CommitConflictDetail").detail(
+                        "DebugID", req.debug_id).detail(
+                        "Version", commit_version).detail(
+                        "Exact", exact).detail(
+                        "Ranges", "; ".join(
+                            f"[{b!r}, {e_!r})" for b, e_ in ranges)).log()
                 req.reply.send_error(e)
         # Reply stage: committed-version report + client reply fan-out.
         self.metrics.histogram("Reply").record(now() - t_reply)
@@ -562,7 +591,12 @@ class CommitProxy:
                         txn.write_conflict_ranges, idx, floor),
                     mutations=list(txn.mutations) if is_state else [],
                     read_snapshot=txn.read_snapshot,
-                    report_conflicting_keys=txn.report_conflicting_keys)
+                    report_conflicting_keys=txn.report_conflicting_keys,
+                    # Tenant/tag identity rides the clipped fragment so
+                    # the resolver's conflict-heat tracker can attribute
+                    # aborts per tenant and per tag (conflict/heat.py).
+                    tenant_id=getattr(txn, "tenant_id", -1),
+                    tag=getattr(txn, "tag", ""))
                 if is_state:
                     requests[idx].txn_state_transactions.append(
                         len(requests[idx].transactions))

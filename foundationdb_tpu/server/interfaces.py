@@ -255,6 +255,12 @@ class ResolveTransactionBatchReply:
     # CONFLICT transactions that set report_conflicting_keys (reference
     # conflictingKRIndices in ResolveTransactionBatchReply).
     conflicting_ranges: Dict[int, List[Any]] = field(default_factory=dict)
+    # {local txn index: exact?} for every CONFLICT verdict: True iff the
+    # backend attributed the TRUE culprit range(s) (heat telemetry /
+    # commit-debug waterfalls) rather than conservatively blaming the
+    # whole read set (the supervised device path past its
+    # CONFLICT_ATTRIBUTION_SAMPLE budget).
+    attribution_exact: Dict[int, bool] = field(default_factory=dict)
 
 
 @dataclass

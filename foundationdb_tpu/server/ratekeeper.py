@@ -107,6 +107,11 @@ class StorageQueuingMetricsReply:
     # per-tenant quota enforcement + status).
     tag_read_ops: Dict[str, float] = field(default_factory=dict)
     tag_read_bytes: Dict[str, float] = field(default_factory=dict)
+    # Read-hot shards (cluster heat telemetry, server/storage.py
+    # _fold_read_heat): (begin, end, ops_per_sec, bytes_per_sec) rows,
+    # hottest first — status folds them into cluster.heat and the
+    # \xff\xff/metrics/read_hot_ranges/ mirror.
+    read_hot_shards: List[Any] = field(default_factory=list)
 
 
 @dataclass

@@ -56,13 +56,18 @@ class Resolver:
         from ..core.histogram import CounterCollection
         self.metrics = CounterCollection("Resolver", resolver_id)
         self.interface.role = self   # sim-side backref for status/tests
-        # Load sampling for resolutionBalancing (reference iops samples,
-        # Resolver.actor.cpp:191-198): every SAMPLE_EVERY'th conflict
-        # range's begin key is tallied; counts halve when the table is
-        # full, bounding memory while preserving the distribution.
+        # Unified heat/load sample table (conflict/heat.py, ISSUE 8):
+        # the load column keeps the resolutionBalancing iops sampling
+        # (reference Resolver.actor.cpp:191-198 — every SAMPLE_EVERY'th
+        # conflict range, halving decay), the conflict column holds the
+        # decayed top-K hot CONFLICT ranges with per-tenant/per-tag
+        # breakdowns — the feed for the \xff\xff/metrics/ mirror and the
+        # ROADMAP conflict predictor.
+        from ..conflict.heat import ConflictHeatTracker
         self._ranges_since_poll = 0
-        self._sample_counts: Dict[bytes, int] = {}
-        self._sample_tick = 0
+        self.heat = ConflictHeatTracker(
+            sample_every=self.SAMPLE_EVERY,
+            table_max=int(server_knobs().CONFLICT_HEAT_TABLE_MAX))
         # Accumulated state transactions for cross-proxy metadata broadcast
         # (reference :220-249): (version, origin_proxy, seq, mutations,
         # local_verdict), version-ascending; trimmed once every registered
@@ -128,8 +133,9 @@ class Resolver:
             trace_batch_event("CommitDebug", req.span,
                               f"Resolver.{self.id}.afterResolve")
         self.metrics.counter("TxnResolved").add(len(req.transactions))
-        self.metrics.counter("TxnConflicts").add(
-            sum(1 for c in committed if c == CommitResult.CONFLICT))
+        n_conflicts = sum(1 for c in committed
+                          if c == CommitResult.CONFLICT)
+        self.metrics.counter("TxnConflicts").add(n_conflicts)
         if getattr(cs, "degraded", False):
             # Supervised device backend running on its CPU-mirror fallback
             # (conflict/supervisor.py): correct but slow — make the
@@ -137,12 +143,23 @@ class Resolver:
             self.metrics.counter("TxnResolvedDegraded").add(
                 len(req.transactions))
         self._sample_batch(req.transactions)
+        self._record_conflict_heat(req.transactions, committed, cs,
+                                   n_conflicts)
+        # Per-txn attribution exactness for aborted txns (the commit
+        # debug waterfall prints exact-vs-conservative): True iff the
+        # backend pinned the true culprit range rather than blaming the
+        # whole read set.
+        exact_map = getattr(cs, "last_attribution_exact", None) or {}
+        attribution_exact = {
+            i: bool(exact_map.get(i, False))
+            for i, v in enumerate(committed) if v == CommitResult.CONFLICT}
         # Foreign state txns resolved since this proxy last heard from us
         # (strictly before this batch's version; ours are appended below).
         lrv = req.last_received_version
         reply = ResolveTransactionBatchReply(
             committed=committed,
             conflicting_ranges=conflicting,
+            attribution_exact=attribution_exact,
             state_transactions=[e for e in self.state_txns
                                 if e[0] > lrv and e[1] != req.proxy_id])
         self.resolved_batches += 1
@@ -182,19 +199,44 @@ class Resolver:
         req.reply.send(reply)
 
     SAMPLE_EVERY = 8
-    SAMPLE_TABLE_MAX = 4096
 
     def _sample_batch(self, transactions) -> None:
+        heat = self.heat
         for txn in transactions:
             for r in txn.read_conflict_ranges + txn.write_conflict_ranges:
                 self._ranges_since_poll += 1
-                self._sample_tick += 1
-                if self._sample_tick % self.SAMPLE_EVERY:
-                    continue
-                c = self._sample_counts
-                c[r.begin] = c.get(r.begin, 0) + 1
-                if len(c) > self.SAMPLE_TABLE_MAX:
-                    self._decay_samples()
+                heat.sample_load(r.begin, r.end)
+
+    def _record_conflict_heat(self, transactions, committed,
+                              conflict_set, n_conflicts: int) -> None:
+        """Per-range heat attribution for the batch's aborted txns: the
+        conflict set's last_attribution names the culprit range(s) —
+        exact always for the oracle, exact for a knob-bounded sample on
+        the supervised device path (the unsampled remainder is SKIPPED,
+        keeping the feed's cost bounded by CONFLICT_ATTRIBUTION_SAMPLE,
+        and surfaced via HeatConservativeTxns + the supervisor's
+        ConservativeAttribution counter).  Tenant/tag identity rides the
+        clipped CommitTransactionRef from the commit proxy."""
+        if not n_conflicts or not server_knobs().HEAT_TELEMETRY_ENABLED:
+            return
+        attr = getattr(conflict_set, "last_attribution", None) or {}
+        exact = getattr(conflict_set, "last_attribution_exact", None) or {}
+        heat = self.heat
+        recorded = 0
+        inexact = n_conflicts - len(attr)   # skipped entirely
+        for i, ranges in attr.items():
+            txn = transactions[i]
+            tenant = getattr(txn, "tenant_id", -1)
+            tag = getattr(txn, "tag", "")
+            for b, e in ranges:
+                heat.record_conflict(b, e, tenant_id=tenant, tag=tag)
+                recorded += 1
+            if not exact.get(i, False):
+                inexact += 1                # recorded, but whole read set
+        if recorded:
+            self.metrics.counter("HeatConflictRanges").add(recorded)
+        if inexact > 0:
+            self.metrics.counter("HeatConservativeTxns").add(inexact)
 
     async def _serve_metrics(self) -> None:
         polls = 0
@@ -210,16 +252,15 @@ class Resolver:
             req.reply.send(n)
 
     def _decay_samples(self) -> None:
-        self._sample_counts = {k: v // 2
-                               for k, v in self._sample_counts.items()
-                               if v >= 2}
+        self.heat.decay()
 
     async def _serve_split(self) -> None:
         """Key splitting [begin, end)'s sampled load at `fraction`
-        (reference ResolutionSplitRequest handling)."""
+        (reference ResolutionSplitRequest handling) — served from the
+        unified sample table's LOAD column projected onto range-begin
+        keys (identical shape to the old begin-keyed sample dict)."""
         async for req in self.interface.split.queue:
-            inside = sorted((k, v) for k, v in self._sample_counts.items()
-                            if req.begin <= k < req.end)
+            inside = self.heat.split_load(req.begin, req.end)
             total = sum(v for _k, v in inside)
             split_key = None
             if total > 0:
@@ -234,6 +275,31 @@ class Resolver:
                         split_key = k
                         break
             req.reply.send(split_key)
+
+    async def _emit_heat(self) -> None:
+        """Periodic HotConflictRange TraceEvents (the trace-side face of
+        the heat plane, reference busiest-tag / read-hot emission style):
+        top-K decayed conflict ranges on the metrics cadence; idle
+        resolvers emit nothing (trace hygiene).  The cadence re-reads
+        METRICS_EMIT_INTERVAL each tick (dynamic knob)."""
+        from ..core.scheduler import delay
+        while True:
+            knobs = server_knobs()
+            await delay(float(knobs.METRICS_EMIT_INTERVAL))
+            if not knobs.HEAT_TELEMETRY_ENABLED:
+                continue
+            for b, e, conflicts, load in self.heat.top_conflicts(
+                    int(knobs.CONFLICT_HEAT_TOP_K)):
+                TraceEvent("HotConflictRange").detail(
+                    "Id", self.id).detail("Begin", b).detail(
+                    "End", e).detail("Conflicts", conflicts).detail(
+                    "Load", load).log()
+
+    def heat_status(self) -> dict:
+        """This resolver's slice of cluster.heat (status JSON + the
+        \xff\xff/metrics/conflict_ranges/ mirror)."""
+        return self.heat.to_status(
+            int(server_knobs().CONFLICT_HEAT_TOP_K))
 
     async def _serve(self) -> None:
         async for req in self.interface.resolve.queue:
@@ -252,6 +318,7 @@ class Resolver:
         process.spawn(self._serve_metrics(), f"{self.id}.resolutionMetrics")
         process.spawn(self._serve_split(), f"{self.id}.resolutionSplit")
         process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
+        process.spawn(self._emit_heat(), f"{self.id}.heatEmit")
         backend_metrics = getattr(self.conflict_set, "metrics", None)
         if backend_metrics is not None:
             # The supervised device backend keeps its own "TpuBackend"

@@ -191,6 +191,51 @@ def collect_resolution_plane(info) -> Dict[str, Any]:
             "resolvers": resolvers}
 
 
+def collect_heat(info, read_hot: Dict[str, Any]) -> Dict[str, Any]:
+    """cluster.heat: the cluster-wide heat telemetry plane (ISSUE 8) —
+    per-resolver decayed top-K conflict ranges keyed by resolver id
+    (conflict/heat.py via Resolver.heat_status), per-storage read-hot
+    shards (the queuing-metrics read_hot_shards rows assembled by
+    build_status), and the cluster-wide busiest tags/tenants folded
+    across resolvers.  This document is ALSO what the
+    \xff\xff/metrics/conflict_ranges/ and /read_hot_ranges/ special-key
+    modules and `fdbcli top` render, so the three surfaces agree by
+    construction.  Conflict side reads the sim-side role backrefs (like
+    collect_resolution_plane); on a real cluster a remote resolver's
+    heat surfaces through its HotConflictRange trace events instead,
+    while the read-hot side rides the queuing-metrics RPC and works
+    everywhere."""
+    from ..core.knobs import server_knobs
+    k = int(server_knobs().CONFLICT_HEAT_TOP_K)
+    conflict: Dict[str, Any] = {}
+    tag_tot: Dict[str, int] = {}
+    tenant_tot: Dict[int, int] = {}
+    for iface in info.resolvers:
+        role = getattr(iface, "role", None)
+        hs = getattr(role, "heat_status", None)
+        if not callable(hs):
+            continue
+        conflict[role.id] = hs()
+        # Cluster-wide busiest folding reads the FULL (decayed) tenant/
+        # tag tables, not the per-resolver top-K rows: a tag ranking 9th
+        # on each of 4 resolvers can still be the cluster's busiest.
+        tracker = getattr(role, "heat", None)
+        for tag, c in getattr(tracker, "tags", {}).items():
+            tag_tot[tag] = tag_tot.get(tag, 0) + c
+        for tenant, c in getattr(tracker, "tenants", {}).items():
+            tenant_tot[tenant] = tenant_tot.get(tenant, 0) + c
+    return {
+        "conflict_ranges": conflict,
+        "read_hot_ranges": read_hot,
+        "busiest_tags": [
+            {"tag": t, "conflicts": c} for t, c in sorted(
+                tag_tot.items(), key=lambda kv: (-kv[1], kv[0]))[:k]],
+        "busiest_tenants": [
+            {"tenant_id": t, "conflicts": c} for t, c in sorted(
+                tenant_tot.items(), key=lambda kv: (-kv[1], kv[0]))[:k]],
+    }
+
+
 async def build_status(cc) -> Dict[str, Any]:
     """Assemble the status document from the CC's view + live role polls
     (all polls issued in parallel — one clogged role must not stall the
@@ -212,6 +257,7 @@ async def build_status(cc) -> Dict[str, Any]:
     storage_status = {}
     total_kv_bytes = 0
     worst_queue = 0
+    read_hot: Dict[str, Any] = {}
     for (tag, ssi), f in zip(tags, ss_futures):
         if f.is_error():
             storage_status[str(tag)] = {"id": ssi.id, "reachable": False}
@@ -225,6 +271,17 @@ async def build_status(cc) -> Dict[str, Any]:
         }
         total_kv_bytes += m.stored_bytes
         worst_queue = max(worst_queue, m.queue_bytes)
+        # Read-hot shards this server reported at its last heat tick
+        # (server/storage.py _fold_read_heat) -> cluster.heat rows.
+        hot_rows = [
+            {"begin": b.decode("utf-8", "backslashreplace"),
+             "end": e.decode("utf-8", "backslashreplace"),
+             "begin_hex": b.hex(), "end_hex": e.hex(),
+             "read_ops_per_sec": ops, "read_bytes_per_sec": nbytes,
+             "storage_server": ssi.id}
+            for b, e, ops, nbytes in getattr(m, "read_hot_shards", [])]
+        if hot_rows:
+            read_hot[str(tag)] = hot_rows
     rk = rk_future.get() if rk_future is not None and \
         not rk_future.is_error() else None
 
@@ -335,6 +392,11 @@ async def build_status(cc) -> Dict[str, Any]:
             # backend supervision, and the generation's key-range
             # ownership (ISSUE 7).
             "resolution": collect_resolution_plane(info),
+            # Cluster heat telemetry (ISSUE 8): per-resolver hot
+            # conflict ranges, per-storage read-hot shards, busiest
+            # tags/tenants — the feed for \xff\xff/metrics/ and
+            # `fdbcli top`.
+            "heat": collect_heat(info, read_hot),
             # Per-stage commit-pipeline latency bands + per-group counter
             # sums (ISSUE 3: the `fdbcli metrics` surface).  Sources:
             # sim-side role backrefs, else the workers' registered

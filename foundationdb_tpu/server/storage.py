@@ -199,6 +199,15 @@ class StorageServer:
         self._tag_read_bytes: Dict[str, int] = {}
         self._read_ops_window = 0
         self._read_window_start = now()
+        # Per-shard read-heat sampling (reference StorageMetrics
+        # readHotRangeShard, ISSUE 8): window counters keyed by the
+        # containing shard's begin key, folded into an ops/bytes-rate
+        # EMA at each queuing-metrics poll; the top rows ride
+        # StorageQueuingMetricsReply.read_hot_shards into ratekeeper /
+        # status cluster.heat and emit ReadHotShard TraceEvents.
+        self._shard_read_ops: Dict[bytes, int] = {}
+        self._shard_read_bytes: Dict[bytes, int] = {}
+        self._shard_heat: Dict[bytes, List[float]] = {}   # b -> [ops, bytes]
         self._process = None
         self._pull_actor = None
         from ..core.histogram import CounterCollection
@@ -464,7 +473,8 @@ class StorageServer:
             self.stats["reads"] += 1
             value = self.data.get(req.key, req.version)
             self._sample_read_tag(
-                req.tag, len(req.key) + (len(value) if value else 0))
+                req.tag, len(req.key) + (len(value) if value else 0),
+                key=req.key)
             self.metrics.histogram("ReadLatency").record(now() - _t0)
             req.reply.send(GetValueReply(value=value, version=req.version))
         except Exception as e:   # noqa: BLE001 - errors propagate via reply
@@ -480,7 +490,8 @@ class StorageServer:
                 req.begin, req.end, req.version, req.limit, req.limit_bytes,
                 req.reverse)
             self._sample_read_tag(
-                req.tag, sum(len(k) + len(v) for k, v in data))
+                req.tag, sum(len(k) + len(v) for k, v in data),
+                key=req.begin)
             req.reply.send(GetKeyValuesReply(data=data, more=more,
                                              version=req.version))
         except Exception as e:   # noqa: BLE001
@@ -593,17 +604,27 @@ class StorageServer:
     # are arbitrary client strings; tenant tags dominate in practice).
     _TAG_REPORT_MAX = 64
 
-    def _sample_read_tag(self, tag: str, nbytes: int = 0) -> None:
+    def _sample_read_tag(self, tag: str, nbytes: int = 0,
+                         key: Optional[bytes] = None) -> None:
         """Busy-read sampling for ratekeeper tag auto-throttling
         (reference storage server busiest-tag tracking feeding
         StorageQueuingMetricsReply.busiestTag) + per-tag byte metering
-        (tenant quotas: tenant/map.py tenant_tag rides every read)."""
+        (tenant quotas: tenant/map.py tenant_tag rides every read) +
+        per-shard read-heat windows (cluster heat telemetry, ISSUE 8)."""
         self._read_ops_window += 1
         if tag:
             self._tag_read_ops[tag] = self._tag_read_ops.get(tag, 0) + 1
             if nbytes:
                 self._tag_read_bytes[tag] = \
                     self._tag_read_bytes.get(tag, 0) + nbytes
+        if key is not None and \
+                server_knobs().HEAT_TELEMETRY_ENABLED:
+            shard = self.shards.range_containing(key)[0]
+            self._shard_read_ops[shard] = \
+                self._shard_read_ops.get(shard, 0) + 1
+            if nbytes:
+                self._shard_read_bytes[shard] = \
+                    self._shard_read_bytes.get(shard, 0) + nbytes
 
     async def _queuing_metrics(self, req) -> None:
         from .ratekeeper import StorageQueuingMetricsReply
@@ -619,11 +640,14 @@ class StorageServer:
         tag_ops = {tag: n / dt for tag, n in top[:self._TAG_REPORT_MAX]}
         tag_bytes = {tag: self._tag_read_bytes.get(tag, 0) / dt
                      for tag in tag_ops}
+        read_hot = self._fold_read_heat(dt)
         # Reset the sampling window each poll so rates track the current
         # storm, not all of history.
         self._read_ops_window = 0
         self._tag_read_ops = {}
         self._tag_read_bytes = {}
+        self._shard_read_ops = {}
+        self._shard_read_bytes = {}
         self._read_window_start = t
         req.reply.send(StorageQueuingMetricsReply(
             queue_bytes=lag * 64,            # approx bytes per version
@@ -633,7 +657,43 @@ class StorageServer:
             busiest_read_rate=busiest_ops / dt,
             total_read_rate=total_rate,
             tag_read_ops=tag_ops,
-            tag_read_bytes=tag_bytes))
+            tag_read_bytes=tag_bytes,
+            read_hot_shards=read_hot))
+
+    def _fold_read_heat(self, dt: float) -> List[Tuple]:
+        """One heat tick (reference readHotRangeShard over the sampled
+        shard map): fold the window's per-shard ops/bytes into rate EMAs,
+        age every shard, drop the cold tail, report the hottest shards as
+        (begin, end, ops_per_sec, bytes_per_sec) rows and emit a
+        ReadHotShard TraceEvent per shard above the knob floor."""
+        knobs = server_knobs()
+        heat = self._shard_heat
+        half = float(knobs.READ_HOT_EMA_HALF_LIFE_S)
+        alpha = 1.0 - 0.5 ** (dt / half) if half > 0 else 1.0
+        keep = 1.0 - alpha
+        for e in heat.values():
+            e[0] *= keep
+            e[1] *= keep
+        for b, n in self._shard_read_ops.items():
+            e = heat.get(b)
+            if e is None:
+                e = heat[b] = [0.0, 0.0]
+            e[0] += alpha * (n / dt)
+            e[1] += alpha * (self._shard_read_bytes.get(b, 0) / dt)
+        for b in [b for b, e in heat.items() if e[0] < 0.01]:
+            del heat[b]   # cold shard aged out: bound the table
+        rows = sorted(heat.items(), key=lambda kv: (-kv[1][0], kv[0]))
+        out: List[Tuple] = []
+        floor = float(knobs.READ_HOT_MIN_OPS_PER_S)
+        for b, (ops, nbytes) in rows[:int(knobs.READ_HOT_SHARD_MAX_REPORT)]:
+            end = self.shards.range_containing(b)[1]
+            out.append((b, end, round(ops, 3), round(nbytes, 3)))
+            if ops >= floor:
+                TraceEvent("ReadHotShard").detail("Id", self.id).detail(
+                    "Begin", b).detail("End", end).detail(
+                    "OpsPerSec", round(ops, 1)).detail(
+                    "BytesPerSec", round(nbytes, 1)).log()
+        return out
 
     # -- watches (reference watchValueQ, trigger :2622) ----------------------
     def _trigger_watch(self, key: bytes) -> None:

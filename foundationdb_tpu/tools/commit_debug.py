@@ -16,6 +16,10 @@ Event model (core/trace.trace_batch_event):
   reply, Resolver.<id>.resolveBatch/afterResolve, TLog.<id>.commit/durable.
 * The link between the two is the proxy's "CommitProxy.batch:<span>"
   CommitDebug event, emitted with DebugID = the client debug id.
+* A debug-tagged txn that aborts on a conflict additionally gets a
+  CommitConflictDetail event (DebugID, Ranges, Exact) from its proxy:
+  the conflicting ranges and whether the resolver attributed the TRUE
+  culprits (exact) or blamed the whole read set (conservative).
 
 Usage:
 
@@ -57,6 +61,26 @@ REQUIRED_STAGES = (
 )
 
 _BATCH_LINK_PREFIX = "CommitProxy.batch:"
+
+
+def conflict_details(events: Iterable[Dict[str, Any]]
+                     ) -> Dict[str, Dict[str, Any]]:
+    """{debug_id: {"ranges": str, "exact": bool}} from the proxy's
+    CommitConflictDetail events (emitted for every debug-tagged txn that
+    aborted on a conflict, server/commit_proxy.py): the conflicting
+    ranges and whether their attribution was exact (the resolver pinned
+    the true culprits) or conservative (whole read set blamed)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("Type") != "CommitConflictDetail":
+            continue
+        did = e.get("DebugID")
+        if did:
+            # Keep the LAST abort of a retried txn (closest to the
+            # attempt the reconstructed timeline ends on).
+            out[did] = {"ranges": e.get("Ranges", ""),
+                        "exact": bool(e.get("Exact"))}
+    return out
 
 
 def load_events(paths: Iterable[str]) -> List[Dict[str, Any]]:
@@ -175,14 +199,20 @@ def main(argv=None) -> int:
     ap.add_argument("--debug-id", default=None,
                     help="only this transaction's timeline")
     args = ap.parse_args(argv)
-    timelines = build_timelines(load_events(args.traces),
-                                debug_id=args.debug_id)
+    events = load_events(args.traces)
+    timelines = build_timelines(events, debug_id=args.debug_id)
     if not timelines:
         print("no debug-id-tagged transactions found "
               "(set transaction.debug_id to trace one)")
         return 1
+    conflicts = conflict_details(events)
     for did in sorted(timelines):
         print(render_waterfall(did, timelines[did]))
+        detail = conflicts.get(did)
+        if detail is not None:
+            mode = "exact" if detail["exact"] else "conservative"
+            print(f"  ABORTED on conflict ({mode} attribution): "
+                  f"{detail['ranges']}")
         if not is_complete(timelines[did]):
             missing = [r for r in REQUIRED_STAGES
                        if not any(r in loc for _t, loc in timelines[did])]

@@ -41,6 +41,8 @@ Commands (reference fdbcli command set):
   status [json]              cluster status summary (or the raw document)
   metrics [FILTER]           per-stage latency bands + role counters
                              (FILTER substring narrows both sections)
+  top                        cluster heat: hot conflict ranges, read-hot
+                             shards, busiest tags/tenants
   configure FIELD=VALUE ...  change configuration transactionally
   getconfiguration           committed \\xff/conf overrides
   lock                       reject non-LOCK_AWARE commits (prints uid)
@@ -200,6 +202,47 @@ class Cli:
             for rr in res.get("ranges", []):
                 lines.append(f"    [{rr['begin']!r}, {rr['end']!r}) -> "
                              f"{rr['resolver']}")
+        return "\n".join(lines)
+
+    def cmd_top(self) -> str:
+        """Cluster heat telemetry (ISSUE 8): the three tables of
+        status cluster.heat — per-resolver decayed hot CONFLICT ranges
+        (exact abort attribution), per-storage read-hot shards, and the
+        busiest tags/tenants by conflicts — the same document the
+        \\xff\\xff/metrics/ special keys mirror."""
+        async def go():
+            return await self.db.cluster.get_status()
+        heat = self.run_async(go()).get("cluster", {}).get("heat", {}) or {}
+        lines = ["Hot conflict ranges (decayed, per resolver):",
+                 f"  {'resolver':<12}{'begin':<22}{'end':<22}"
+                 f"{'conflicts':>10}{'load':>8}"]
+        n = len(lines)
+        for rid in sorted(heat.get("conflict_ranges", {})):
+            for row in heat["conflict_ranges"][rid].get(
+                    "top_conflict_ranges", []):
+                lines.append(
+                    f"  {rid:<12}{row['begin']:<22.22}{row['end']:<22.22}"
+                    f"{row['conflicts']:>10}{row['load']:>8}")
+        if len(lines) == n:
+            lines.append("  (no conflicts attributed yet)")
+        lines.append("Read-hot shards:")
+        lines.append(f"  {'storage':<12}{'begin':<22}{'end':<22}"
+                     f"{'ops/s':>10}{'bytes/s':>12}")
+        n = len(lines)
+        for tag in sorted(heat.get("read_hot_ranges", {})):
+            for row in heat["read_hot_ranges"][tag]:
+                lines.append(
+                    f"  {row['storage_server']:<12}{row['begin']:<22.22}"
+                    f"{row['end']:<22.22}{row['read_ops_per_sec']:>10.1f}"
+                    f"{row['read_bytes_per_sec']:>12.1f}")
+        if len(lines) == n:
+            lines.append("  (no read-hot shards)")
+        lines.append("Busiest tags / tenants (by attributed conflicts):")
+        rows = [f"  tag {r['tag']}: {r['conflicts']}"
+                for r in heat.get("busiest_tags", [])]
+        rows += [f"  tenant {r['tenant_id']}: {r['conflicts']}"
+                 for r in heat.get("busiest_tenants", [])]
+        lines.extend(rows or ["  (none)"])
         return "\n".join(lines)
 
     def cmd_configure(self, *assignments: str) -> str:

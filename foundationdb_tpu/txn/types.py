@@ -157,6 +157,11 @@ class CommitTransactionRef:
     # tenant cache post-resolution — a deleted tenant can never commit —
     # and reject mutations outside the tenant's 8-byte prefix.
     tenant_id: int = -1
+    # Throttling tag (reference TransactionOptions::tags; tenant txns
+    # carry "t/<name>"): rides the commit so the resolvers' conflict-heat
+    # tracker can break hot ranges down per tag (conflict/heat.py) —
+    # the same identity storage uses for busy-read sampling.
+    tag: str = ""
 
     def expected_size(self) -> int:
         s = sum(len(r.begin) + len(r.end) for r in

@@ -124,3 +124,34 @@ def test_dead_tunnel_respects_deadline_budget():
     last = json.loads([ln for ln in r.stdout.strip().splitlines()
                        if ln.startswith("{")][-1])
     assert last["value"] == 1.0 and "error" in last
+
+
+def test_heat_overhead_gate_unit():
+    """run_heat_gate (ISSUE 8): the enabled-vs-disabled measurement runs
+    on the supervised path and records both figures + overhead_pct.
+    (The 2% acceptance bound applies to the real bench's batch sizes —
+    the per-batch feed cost is knob-bounded and fixed, so this tiny
+    smoke config inflates the percentage; here we assert shape and
+    knob restoration only.)"""
+    import numpy as np
+
+    from foundationdb_tpu.conflict.oracle import OracleConflictSet
+    from foundationdb_tpu.core.knobs import server_knobs
+
+    bench = _load_bench()
+    bench.TXNS_PER_BATCH = 400
+    rng = np.random.default_rng(11)
+    batches = []
+    version = 1_000
+    for _ in range(4):
+        prev = version
+        version += bench.VERSIONS_PER_BATCH
+        batches.append((version, *bench.gen_batch(rng, version, prev)))
+    out = bench.run_heat_gate(
+        lambda oldest_version=0: OracleConflictSet(oldest_version),
+        batches, lambda v: max(v - 5_000_000, 0))
+    assert out["disabled_ranges_per_s"] > 0
+    assert out["enabled_ranges_per_s"] > 0
+    assert "overhead_pct" in out and out["batches"] == 4
+    # The measurement must not leak the knob flip.
+    assert server_knobs().HEAT_TELEMETRY_ENABLED is True

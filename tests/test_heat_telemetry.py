@@ -1,0 +1,479 @@
+"""Cluster heat telemetry (ISSUE 8): the conflict-range / read-hot-spot
+sampling plane — tracker determinism and decay, exact vs conservative
+abort attribution (oracle + supervised device path), the unified
+resolver sample table, and the end-to-end surface agreement between
+status cluster.heat, the \xff\xff/metrics/ special keys and `fdbcli
+top` on a planted hot range; plus a double-run unseed test proving the
+plane (sampling, decay, emission cadence) is sim-deterministic."""
+
+import json
+import os
+
+import pytest
+
+from foundationdb_tpu.conflict.heat import ConflictHeatTracker
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.conflict.supervisor import SupervisedConflictSet
+from foundationdb_tpu.core import FdbError
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.txn.types import (CommitResult, CommitTransactionRef,
+                                        KeyRange)
+
+from test_recovery import make_cluster, teardown  # noqa: F401
+
+SPECS = os.path.join(os.path.dirname(__file__), "specs")
+
+
+@pytest.fixture()
+def knobs():
+    """Mutable server knobs restored after the test."""
+    k = server_knobs()
+    saved = dict(k.__dict__)
+    yield k
+    for name, value in saved.items():
+        setattr(k, name, value)
+
+
+def _txn(reads=(), writes=(), snap=0, report=False, tenant=-1, tag=""):
+    return CommitTransactionRef(
+        read_conflict_ranges=[KeyRange(b, e) for b, e in reads],
+        write_conflict_ranges=[KeyRange(b, e) for b, e in writes],
+        mutations=[], read_snapshot=snap, report_conflicting_keys=report,
+        tenant_id=tenant, tag=tag)
+
+
+# ---------------------------------------------------------------------------
+# ConflictHeatTracker: decay, top-K, bounds, determinism
+# ---------------------------------------------------------------------------
+
+def test_tracker_records_and_ranks():
+    t = ConflictHeatTracker(sample_every=1)
+    for _ in range(5):
+        t.record_conflict(b"hot", b"hot\x00", tenant_id=3, tag="t/web")
+    t.record_conflict(b"cold", b"cold\x00")
+    t.sample_load(b"hot", b"hot\x00")
+    top = t.top_conflicts(2)
+    assert top[0][:3] == (b"hot", b"hot\x00", 5)
+    assert top[1][:3] == (b"cold", b"cold\x00", 1)
+    assert t.tenants == {3: 5}
+    assert t.tags == {"t/web": 5}
+    doc = t.to_status(1)
+    assert doc["top_conflict_ranges"][0]["conflicts"] == 5
+    assert doc["top_conflict_ranges"][0]["begin_hex"] == b"hot".hex()
+    assert doc["busiest_tags"] == [{"tag": "t/web", "conflicts": 5}]
+    assert doc["busiest_tenants"] == [{"tenant_id": 3, "conflicts": 5}]
+
+
+def test_tracker_decay_halves_and_drops():
+    t = ConflictHeatTracker(sample_every=1)
+    for _ in range(4):
+        t.record_conflict(b"a", b"b", tenant_id=1, tag="x")
+    t.record_conflict(b"c", b"d")
+    t.decay()
+    assert t.ranges[(b"a", b"b")] == [0, 2]
+    assert (b"c", b"d") not in t.ranges       # single hit aged out
+    assert t.tenants == {1: 2} and t.tags == {"x": 2}
+    t.decay()
+    t.decay()
+    assert not t.ranges and not t.tenants and not t.tags
+
+
+def test_tracker_load_sampling_every_nth():
+    t = ConflictHeatTracker(sample_every=8)
+    hits = sum(t.sample_load(b"k%d" % i, b"k%d\x00" % i)
+               for i in range(64))
+    assert hits == 8                          # exactly one in eight
+    assert t.total_load == 8
+
+
+def test_tracker_table_bound_by_halving():
+    t = ConflictHeatTracker(sample_every=1, table_max=64)
+    for i in range(1000):
+        t.record_conflict(b"k%04d" % i, b"k%04d\x00" % i)
+    assert len(t.ranges) <= 64 + 1
+
+
+def test_tracker_deterministic_across_instances():
+    def feed(t):
+        for i in range(300):
+            k = b"k%02d" % (i % 17)
+            t.sample_load(k, k + b"\x00")
+            if i % 3 == 0:
+                t.record_conflict(k, k + b"\x00", tenant_id=i % 5,
+                                  tag="t%d" % (i % 4))
+            if i % 97 == 0:
+                t.decay()
+        return t.to_status(8)
+
+    assert feed(ConflictHeatTracker()) == feed(ConflictHeatTracker())
+
+
+def test_tracker_split_load_projection():
+    """Two sampled ranges sharing a begin merge their load mass on that
+    begin key — the shape _serve_split consumed from the old begin-keyed
+    dict."""
+    t = ConflictHeatTracker(sample_every=1)
+    t.sample_load(b"b", b"c")
+    t.sample_load(b"b", b"d")
+    t.sample_load(b"e", b"f")
+    t.record_conflict(b"zz", b"zz\x00")   # conflict-only: no load mass
+    assert t.split_load(b"a", b"z") == [(b"b", 2), (b"e", 1)]
+    assert t.split_load(b"c", b"z") == [(b"e", 1)]
+
+
+# ---------------------------------------------------------------------------
+# Exact attribution: oracle, supervisor device path, budget + counter
+# ---------------------------------------------------------------------------
+
+def test_oracle_attributes_all_aborted_txns():
+    """last_attribution covers non-reporters too (first culprit), while
+    the client-facing reported dict stays reporters-only."""
+    cs = OracleConflictSet(0)
+    cs.resolve_with_conflicts([_txn(writes=[(b"h", b"i")])], 10)
+    verdicts, reported = cs.resolve_with_conflicts(
+        [_txn(reads=[(b"a", b"b"), (b"h", b"i")], snap=5),
+         _txn(reads=[(b"h", b"i")], snap=5, report=True)], 20)
+    assert verdicts == [CommitResult.CONFLICT, CommitResult.CONFLICT]
+    assert reported == {1: [(b"h", b"i")]}
+    assert cs.last_attribution == {0: [(b"h", b"i")],
+                                   1: [(b"h", b"i")]}
+    assert cs.last_attribution_exact == {0: True, 1: True}
+
+
+def test_oracle_attribute_conflicts_matches_resolve():
+    """The read-only attribute_conflicts (the supervisor's device-path
+    probe) reproduces resolve_with_conflicts' own attribution, given the
+    same pre-batch history and the final verdicts."""
+    from foundationdb_tpu.core import DeterministicRandom
+    from test_conflict_oracle import make_domain, random_txn
+    rng = DeterministicRandom(99)
+    domain = make_domain()
+    a, b = OracleConflictSet(0), OracleConflictSet(0)
+    now = 0
+    for _ in range(20):
+        now += rng.random_int(1, 2_000_000)
+        batch = [random_txn(rng, domain, now, 4_000_000)
+                 for _ in range(rng.random_int(1, 8))]
+        verdicts = b.resolve(batch, now)     # b lags one batch behind a
+        probed = a.attribute_conflicts(batch, verdicts)
+        a.resolve_with_conflicts(batch, now)
+        want = {t: rs[:1] if not getattr(batch[t],
+                                         "report_conflicting_keys", False)
+                else rs
+                for t, rs in a.last_attribution.items()}
+        got = {t: rs[:1] if not getattr(batch[t],
+                                        "report_conflicting_keys", False)
+               else rs for t, rs in probed.items()}
+        assert got == want, f"attribution divergence at now={now}"
+
+
+def test_supervisor_device_path_exact_attribution(knobs):
+    """Device-resolved batches get budget-bounded EXACT attribution via
+    the mirror; the whole-read-set fallback past the budget is counted
+    in ConservativeAttribution (satellite 1)."""
+    from test_conflict_supervisor import make_tpu
+    knobs.CONFLICT_ATTRIBUTION_SAMPLE = 1
+    sup = SupervisedConflictSet(make_tpu)
+    sup.resolve([_txn(writes=[(b"h", b"h\x00")])], 10)
+    # Two aborted readers of the same dirty key; budget covers one.
+    verdicts, _ = sup.resolve_with_conflicts(
+        [_txn(reads=[(b"h", b"h\x00")], snap=5),
+         _txn(reads=[(b"a", b"b"), (b"h", b"h\x00")], snap=5)], 20)
+    assert verdicts == [CommitResult.CONFLICT, CommitResult.CONFLICT]
+    assert sup.stats["device_batches"] == 2
+    assert sup.last_attribution == {0: [(b"h", b"h\x00")]}
+    assert sup.last_attribution_exact == {0: True}
+    assert sup.stats["exact_attribution"] == 1
+    assert sup.stats["conservative_attribution"] == 1
+    assert sup.metrics.counter("ConservativeAttribution").value == 1
+
+
+def test_supervisor_reporters_get_exact_ranges(knobs):
+    """A reporter inside the attribution budget now gets the TRUE
+    culprit range from the device path, not its whole read set (the old
+    conservative-only behavior)."""
+    from test_conflict_supervisor import make_tpu
+    sup = SupervisedConflictSet(make_tpu)
+    oracle = OracleConflictSet(0)
+    seed = [_txn(writes=[(b"h", b"h\x00")])]
+    sup.resolve(list(seed), 10)
+    oracle.resolve(list(seed), 10)
+    batch = [_txn(reads=[(b"a", b"b"), (b"h", b"h\x00"), (b"x", b"y")],
+                  snap=5, report=True)]
+    got_v, got_r = sup.resolve_with_conflicts(list(batch), 20)
+    want_v, want_r = oracle.resolve_with_conflicts(list(batch), 20)
+    assert got_v == want_v == [CommitResult.CONFLICT]
+    assert got_r == want_r == {0: [(b"h", b"h\x00")]}
+    assert sup.stats["device_batches"] > 0   # not a mirror fallback
+
+
+def test_supervisor_attribution_disabled_by_master_knob(knobs):
+    from test_conflict_supervisor import make_tpu
+    knobs.HEAT_TELEMETRY_ENABLED = False
+    sup = SupervisedConflictSet(make_tpu)
+    sup.resolve([_txn(writes=[(b"h", b"h\x00")])], 10)
+    verdicts, _ = sup.resolve_with_conflicts(
+        [_txn(reads=[(b"h", b"h\x00")], snap=5)], 20)
+    assert verdicts == [CommitResult.CONFLICT]
+    assert sup.last_attribution == {}
+    assert sup.stats["exact_attribution"] == 0
+    assert sup.stats["conservative_attribution"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Resolver: unified sample table + heat feed
+# ---------------------------------------------------------------------------
+
+def test_resolver_feed_and_split_unified(loop):
+    """One table serves both: _sample_batch load lands in the split
+    projection; aborted txns land in the conflict column with tenant/tag
+    breakdowns (fed from the backend's attribution)."""
+    from foundationdb_tpu.server.resolver import Resolver
+    r = Resolver("r-heat", backend="cpu")
+    seed = [_txn(writes=[(b"hot", b"hot\x00")])]
+    committed, _ = r.conflict_set.resolve_with_conflicts(seed, 10)
+    r._sample_batch(seed)
+    batch = [_txn(reads=[(b"hot", b"hot\x00")], snap=5, tenant=7,
+                  tag="t/web"),
+             _txn(reads=[(b"cold", b"cold\x00")],
+                  writes=[(b"cold", b"cold\x00")], snap=15)]
+    committed, _ = r.conflict_set.resolve_with_conflicts(batch, 20)
+    assert committed == [CommitResult.CONFLICT, CommitResult.COMMITTED]
+    r._sample_batch(batch)
+    r._record_conflict_heat(batch, committed, r.conflict_set, 1)
+    top = r.heat.top_conflicts(4)
+    assert top[0][:3] == (b"hot", b"hot\x00", 1)
+    assert r.heat.tenants == {7: 1}
+    assert r.heat.tags == {"t/web": 1}
+    assert r.metrics.counter("HeatConflictRanges").value == 1
+    # Load sampling (every 8th range) feeds the same table the split
+    # server projects; force enough mass to show up.
+    for _ in range(32):
+        r._sample_batch(batch)
+    assert any(b == b"cold" for b, _v in r.heat.split_load(b"", b"\xff"))
+    doc = r.heat_status()
+    assert doc["top_conflict_ranges"][0]["begin"] == "hot"
+
+
+def test_resolver_feed_respects_master_knob(loop, knobs):
+    from foundationdb_tpu.server.resolver import Resolver
+    knobs.HEAT_TELEMETRY_ENABLED = False
+    r = Resolver("r-off", backend="cpu")
+    seed = [_txn(writes=[(b"hot", b"hot\x00")])]
+    r.conflict_set.resolve_with_conflicts(seed, 10)
+    batch = [_txn(reads=[(b"hot", b"hot\x00")], snap=5)]
+    committed, _ = r.conflict_set.resolve_with_conflicts(batch, 20)
+    r._record_conflict_heat(batch, committed, r.conflict_set, 1)
+    assert r.heat.top_conflicts(1) == []
+
+
+# ---------------------------------------------------------------------------
+# End to end: planted hot range -> status == special keys == fdbcli top
+# ---------------------------------------------------------------------------
+
+def _drive_conflicts(db, n=6, key=b"hotkey", tag="hot-tag"):
+    """n read-modify-write pairs on `key`; the second txn of each pair
+    aborts (its snapshot predates the first's commit)."""
+    async def go():
+        aborted = 0
+        for i in range(n):
+            t1 = db.create_transaction()
+            t2 = db.create_transaction()
+            t2.tag = tag
+            await t1.get(key)
+            await t2.get(key)
+            t1.set(key, b"a%d" % i)
+            t2.set(key, b"b%d" % i)
+            await t1.commit()
+            try:
+                await t2.commit()
+            except FdbError as e:
+                assert e.name == "not_committed", e.name
+                aborted += 1
+        return aborted
+    return go
+
+
+def test_e2e_hot_range_all_three_surfaces(teardown):  # noqa: F811
+    from foundationdb_tpu.core.trace import Tracer, get_tracer, set_tracer
+    from foundationdb_tpu.tools.fdbcli import Cli
+    set_tracer(Tracer())
+    c = make_cluster()
+    db = c.database()
+    aborted = c.run_until(c.loop.spawn(_drive_conflicts(db)()), timeout=120)
+    assert aborted >= 4   # the planted hot range really conflicted
+
+    async def read_surfaces():
+        # A burst of reads makes the hosting shard read-hot too.
+        t = db.create_transaction()
+        for _ in range(200):
+            await t.get(b"hotkey", snapshot=True)
+        # Let the heat emission cadence tick at least once.
+        from foundationdb_tpu.core.scheduler import delay
+        await delay(2 * float(server_knobs().METRICS_EMIT_INTERVAL))
+        doc = await db.cluster.get_status()
+        t2 = db.create_transaction()
+        rows = await t2.get_range(b"\xff\xff/metrics/conflict_ranges/",
+                                  b"\xff\xff/metrics/conflict_ranges0",
+                                  limit=100)
+        hot_rows = await t2.get_range(b"\xff\xff/metrics/read_hot_ranges/",
+                                      b"\xff\xff/metrics/read_hot_ranges0",
+                                      limit=100)
+        point = None
+        if rows:
+            t3 = db.create_transaction()
+            point = await t3.get(rows[0][0])
+        return doc, rows, hot_rows, point
+
+    doc, rows, hot_rows, point = c.run_until(
+        c.loop.spawn(read_surfaces()), timeout=120)
+
+    # 1. status cluster.heat names the planted range on some resolver.
+    heat = doc["cluster"]["heat"]
+    tops = [row for rdoc in heat["conflict_ranges"].values()
+            for row in rdoc["top_conflict_ranges"]]
+    assert any(row["begin"] == "hotkey" for row in tops), tops
+    assert any(t["tag"] == "hot-tag" for t in heat["busiest_tags"])
+    # 2. the special-key mirror agrees (same doc, row per range).
+    assert rows, "conflict_ranges special keys empty"
+    parsed = [json.loads(v) for _k, v in rows]
+    assert any(r["begin"] == "hotkey" for r in parsed), parsed
+    assert point == rows[0][1]   # point get == range row
+    # ... and the read-hot module reports the hosting shard.
+    assert hot_rows, "read_hot_ranges special keys empty"
+    hot_parsed = [json.loads(v) for _k, v in hot_rows]
+    assert all(r["read_ops_per_sec"] > 0 for r in hot_parsed)
+    assert heat["read_hot_ranges"], heat
+    # 3. fdbcli top renders the same tables.
+    cli = Cli.__new__(Cli)
+    cli.loop, cli.db = c.loop, db
+    out = cli.dispatch("top")
+    assert "hotkey" in out and "Read-hot shards" in out
+    assert "hot-tag" in out
+    # The resolver ALSO emitted HotConflictRange trace events.
+    evs = get_tracer().find("HotConflictRange")
+    assert any(e.get("Begin") == "b'hotkey'" or "hotkey" in str(e.get(
+        "Begin")) for e in evs), evs[:3]
+
+
+def test_commit_conflict_detail_in_waterfall(teardown):  # noqa: F811
+    """Satellite 3: a debug-tagged aborted txn gets a
+    CommitConflictDetail event naming its conflicting ranges and the
+    attribution mode, and commit_debug surfaces it."""
+    from foundationdb_tpu.core.trace import Tracer, get_tracer, set_tracer
+    from foundationdb_tpu.tools.commit_debug import conflict_details
+    set_tracer(Tracer())
+    c = make_cluster()
+    db = c.database()
+
+    async def go(debug_id, report):
+        t1 = db.create_transaction()
+        t2 = db.create_transaction()
+        t2.debug_id = debug_id
+        t2.report_conflicting_keys = report
+        await t1.get(b"ck")
+        await t2.get(b"ck")
+        await t2.get(b"other")       # extra clean read (over-blame bait)
+        t1.set(b"ck", b"1")
+        t2.set(b"ck", b"2")
+        await t1.commit()
+        try:
+            await t2.commit()
+            return False
+        except FdbError as e:
+            return e.name == "not_committed"
+
+    assert c.run_until(c.loop.spawn(go("dbg-exact", True)), timeout=120)
+    assert c.run_until(c.loop.spawn(go("dbg-cons", False)), timeout=120)
+    details = conflict_details(list(get_tracer().ring))
+    assert "dbg-exact" in details and "dbg-cons" in details, [
+        e for e in get_tracer().ring
+        if e.get("Type") == "CommitConflictDetail"]
+    # Reporter: the resolver-pinned TRUE culprit only — exact.
+    d = details["dbg-exact"]
+    assert "ck" in d["ranges"] and "other" not in d["ranges"]
+    assert d["exact"] is True
+    # Non-reporter: the proxy falls back to the whole read set —
+    # conservative, and marked as such.
+    d = details["dbg-cons"]
+    assert "ck" in d["ranges"] and "other" in d["ranges"]
+    assert d["exact"] is False
+
+
+# ---------------------------------------------------------------------------
+# Determinism: the heat plane under the unseed verifier
+# ---------------------------------------------------------------------------
+
+HEAT_SPEC = """
+[[test]]
+testTitle = 'HeatDeterminism'
+
+  [[test.workload]]
+  testName = 'Cycle'
+  nodeCount = 8
+  actorCount = 4
+  testDuration = 6.0
+"""
+
+
+def test_heat_plane_double_run_unseed_identical(teardown):  # noqa: F811
+    """Same seed, two runs, with the heat plane active (sampling, decay,
+    HotConflictRange/ReadHotShard emission all inside the sim): unseed,
+    digest and fold counts must be bit-identical.  testDuration exceeds
+    METRICS_EMIT_INTERVAL so the emission cadence is inside the digest."""
+    from foundationdb_tpu.testing import run_test_twice
+    r1, r2 = run_test_twice(HEAT_SPEC, seed=211)
+    assert r1.unseed == r2.unseed and r1.digest == r2.digest
+    assert r1.folds == r2.folds and r1.folds > 0
+    assert r1.nondeterminism == [] and r2.nondeterminism == []
+
+
+def test_metrics_rows_distinct_for_shared_begin():
+    """Two hot ranges sharing a begin key ([a,b) and [a,c)) must stay
+    distinct special-key rows — the row key embeds begin AND end."""
+    from foundationdb_tpu.client.database import Transaction
+    heat = {"conflict_ranges": {"r0": {"top_conflict_ranges": [
+        {"begin": "a", "end": "b", "begin_hex": "61", "end_hex": "62",
+         "conflicts": 3, "load": 0},
+        {"begin": "a", "end": "c", "begin_hex": "61", "end_hex": "63",
+         "conflicts": 2, "load": 0}]}},
+        "read_hot_ranges": {"5": [
+            {"begin": "a", "end": "b", "begin_hex": "61", "end_hex": "62",
+             "read_ops_per_sec": 9.0, "read_bytes_per_sec": 1.0,
+             "storage_server": "ss5"}]}}
+    rows = Transaction._heat_rows(Transaction.__new__(Transaction), heat)
+    keys = [k for k, _v in rows]
+    assert len(keys) == len(set(keys)) == 3
+    assert keys == sorted(keys)
+    assert json.loads(dict(rows)[
+        b"\xff\xff/metrics/conflict_ranges/r0/61-63"])["conflicts"] == 2
+
+
+def test_collect_heat_busiest_folds_full_tables():
+    """Cluster-wide busiest tags/tenants fold the resolvers' FULL
+    decayed tables: a tag below every per-resolver top-K cut can still
+    be the cluster's busiest."""
+    from types import SimpleNamespace
+
+    from foundationdb_tpu.server.status import collect_heat
+
+    def fake_resolver(rid, tags):
+        heat = ConflictHeatTracker()
+        for tag, n in tags.items():
+            for _ in range(n):
+                heat.record_conflict(b"k", b"k\x00", tag=tag)
+        role = SimpleNamespace(id=rid, heat=heat,
+                               heat_status=lambda h=heat: h.to_status(2))
+        return SimpleNamespace(role=role)
+
+    # "bg" ranks 3rd (below k=2... but cluster-wide it dominates: 4+4+4
+    # vs "a0".. peaking at 5 on one resolver only.
+    resolvers = [
+        fake_resolver("r0", {"x0": 9, "y0": 8, "bg": 4}),
+        fake_resolver("r1", {"x1": 9, "y1": 8, "bg": 4}),
+        fake_resolver("r2", {"x2": 9, "y2": 8, "bg": 4}),
+    ]
+    info = SimpleNamespace(resolvers=resolvers)
+    doc = collect_heat(info, {})
+    busiest = doc["busiest_tags"]
+    assert busiest[0] == {"tag": "bg", "conflicts": 12}, busiest
