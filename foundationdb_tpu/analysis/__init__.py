@@ -9,12 +9,17 @@ per-seed (testing/tester.py NondeterminismAudit sees the code paths one
 seed happens to execute — flowlint sees every line).
 
 Layout:
-  engine.py -- rule-engine core: one visitor pass per file, pluggable
-               Rule classes, per-line ``# flowlint: disable=FTL0NN``
-               suppressions, committed-baseline support, text + JSON
-               output, stable exit codes.
-  rules.py  -- the shipped rules (FTL001..FTL008), each grounded in a
-               bug class this repo has actually hit.
+  engine.py   -- rule-engine core: one visitor pass per file, pluggable
+                 Rule classes, per-line ``# flowlint: disable=FTL0NN``
+                 suppressions, committed-baseline support, text + JSON
+                 output, stable exit codes.
+  dataflow.py -- per-function dataflow (ISSUE 9): statement-level CFG
+                 with await/yield barrier nodes, reaching-definition
+                 def-use chains carrying a crossed-await bit, and a
+                 lockset abstraction; built once per function on the
+                 shared walk, handed to rules via begin_function().
+  rules.py    -- the shipped rules (FTL001..FTL012), each grounded in a
+                 bug class this repo has actually hit.
 
 Entry points: ``scripts/flowlint.py`` (CLI; scripts/run_chaos.py shells
 its ``--format json`` output to link static findings into chaos
@@ -22,11 +27,13 @@ summaries), ``run_flowlint()`` (programmatic), and the shim kept at
 ``scripts/check_trace_events.py`` (FTL007's old standalone home).
 """
 
+from .dataflow import FunctionDataflow
 from .engine import (Analyzer, Finding, LintResult, Rule, format_text,
-                     load_baseline, run_flowlint, write_baseline)
+                     is_actor, load_baseline, run_flowlint, write_baseline)
 from .rules import make_rules
 
 __all__ = [
-    "Analyzer", "Finding", "LintResult", "Rule", "format_text",
-    "load_baseline", "make_rules", "run_flowlint", "write_baseline",
+    "Analyzer", "Finding", "FunctionDataflow", "LintResult", "Rule",
+    "format_text", "is_actor", "load_baseline", "make_rules",
+    "run_flowlint", "write_baseline",
 ]

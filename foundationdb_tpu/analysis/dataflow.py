@@ -1,0 +1,567 @@
+"""Per-function dataflow for flowlint (ISSUE 9).
+
+The reference Flow ACTOR compiler *enforces* the state-across-wait
+discipline at compile time: locals die at every ``wait()`` unless
+declared ``state`` (PAPER.md).  Our Python port has no such compiler,
+so flowlint grows a dataflow layer: a lightweight statement-level CFG
+per function with await/yield points as BARRIER nodes, reaching
+definitions whose facts carry a crossed-barrier bit (the def-use-chain
+answer to "was this local's value computed before a suspension
+point?"), and a forward lockset analysis over ``with <lock>:`` regions
+and ``.acquire()``/``.release()`` pairs (meet = intersection: a lock
+counts as held only when held on EVERY path into a node).  One
+FunctionDataflow is built per function during the Analyzer's single
+shared walk and handed to every rule via ``Rule.begin_function``.
+
+Approximations (deliberate, documented):
+
+  * statement granularity — uses inside a statement see the facts at
+    statement ENTRY, so ``y = (await f()) + x`` treats ``x`` as read
+    before the await; evaluation-order-exact tracking buys nothing for
+    the hazard classes the rules target;
+  * nested def/class/lambda bodies are EXCLUDED from the parent CFG —
+    each nested function gets its own FunctionDataflow when the shared
+    walk reaches it (a closure runs under its own control flow, often
+    on another thread entirely);
+  * exception edges use the standard conservative approximation: every
+    statement of a ``try`` body may jump to every reachable handler
+    (all frames of the enclosing try stack);
+  * a ``with <lock>:`` region is treated as holding the lock on the
+    exceptional paths out of its body too (``__exit__`` releases it in
+    reality) — conservative for FTL011;
+  * locks are keyed by their dotted source text (``self._lock``,
+    ``self._cs._lock``) — aliasing is invisible, so two names for one
+    lock object (or one name for two objects) are not distinguished;
+    README's FTL012 caveats spell out what this can and cannot prove.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+# Terminal names that make an expression "lock-shaped" for the lockset
+# abstraction: self._lock, self._send_lock, some_mutex ...
+_LOCK_NAME = re.compile(r"(?:^|_)(?:lock|mutex)$", re.IGNORECASE)
+
+# Receiver-mutating container methods: `self.x.append(...)` counts as a
+# WRITE access to attribute x for lockset-discipline purposes (FTL012).
+MUTATOR_METHODS = frozenset({
+    "add", "append", "appendleft", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "remove", "setdefault",
+    "sort", "update",
+})
+
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                  ast.Lambda)
+
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp,
+                   ast.GeneratorExp)
+
+
+def _terminal_name(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def lock_key(expr: ast.expr) -> Optional[str]:
+    """Dotted source text of `expr` when it is lock-shaped (its terminal
+    name ends in lock/mutex), e.g. 'self._lock'; None otherwise."""
+    name = _terminal_name(expr)
+    if name is None or not _LOCK_NAME.search(name):
+        return None
+    try:
+        return ast.unparse(expr)
+    except Exception:               # pragma: no cover - defensive
+        return None
+
+
+class DefInfo:
+    """One definition site of a local name.
+
+    ``value`` is the RHS expression when the assignment binds the whole
+    value to the name, None for opaque binds (params, except-as,
+    imports).  ``unpacked`` marks defs where the name gets a PART or
+    TRANSFORM of ``value`` (tuple unpack, for-target element, with-as
+    enter result, augmented assignment) — value-shape predicates must
+    not trust ``value`` for those."""
+
+    __slots__ = ("idx", "name", "value", "annotation", "is_param",
+                 "unpacked", "lineno")
+
+    def __init__(self, idx: int, name: str, value: Optional[ast.expr],
+                 lineno: int, annotation: Optional[ast.expr] = None,
+                 is_param: bool = False, unpacked: bool = False) -> None:
+        self.idx = idx
+        self.name = name
+        self.value = value
+        self.annotation = annotation
+        self.is_param = is_param
+        self.unpacked = unpacked
+        self.lineno = lineno
+
+    def __repr__(self) -> str:      # pragma: no cover - debug aid
+        return f"DefInfo({self.name}@{self.lineno})"
+
+
+class CFGNode:
+    """One statement-level node.  ``in_defs`` is the reaching-defs fact
+    set at node ENTRY as an int bitmask — bit 2i = def i reaches
+    uncrossed, bit 2i+1 = def i reaches having crossed an await/yield
+    barrier (0 while unreachable); ``in_locks`` is the lockset held at
+    node entry (None while unreachable)."""
+
+    __slots__ = ("idx", "stmt", "succs", "barrier", "defs", "acquires",
+                 "releases", "in_defs", "in_locks")
+
+    def __init__(self, idx: int, stmt: Optional[ast.AST]) -> None:
+        self.idx = idx
+        self.stmt = stmt
+        self.succs: Set[int] = set()
+        self.barrier = False
+        self.defs: List[DefInfo] = []
+        self.acquires: FrozenSet[str] = frozenset()
+        self.releases: FrozenSet[str] = frozenset()
+        self.in_defs = 0
+        self.in_locks: Optional[FrozenSet[str]] = None
+
+
+class _Loop:
+    __slots__ = ("header", "breaks")
+
+    def __init__(self, header: int) -> None:
+        self.header = header
+        self.breaks: List[int] = []
+
+
+class FunctionDataflow:
+    """CFG + reaching definitions + locksets for ONE function body
+    (nested functions excluded — they get their own instance)."""
+
+    def __init__(self, func) -> None:
+        self.func = func
+        self.is_async = isinstance(func, ast.AsyncFunctionDef)
+        self.nodes: List[CFGNode] = []
+        self.defs: List[DefInfo] = []
+        # id(sub-ast) -> CFGNode for every expression scanned into a node.
+        self.node_of: Dict[int, CFGNode] = {}
+        self.loads: List[Tuple[ast.Name, CFGNode]] = []
+        self.calls: List[Tuple[ast.Call, CFGNode]] = []
+        self.awaits: List[Tuple[ast.Await, CFGNode]] = []
+        # (attr, ast node, 'read'|'write'|'call', cfg node) for every
+        # `self.<attr>` access; container-mutator calls classify as write.
+        self.self_accesses: List[Tuple[str, ast.AST, str, CFGNode]] = []
+        self.acquired_locks: Set[str] = set()
+        self._globals: Set[str] = set()
+        self._loop_stack: List[_Loop] = []
+        self._exc_stack: List[List[int]] = []
+
+        entry = self._new_node(func)
+        a = func.args
+        for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            self._add_def(entry, arg.arg, None, func.lineno,
+                          annotation=arg.annotation, is_param=True)
+        self._build_body(func.body, [entry.idx])
+        del self._loop_stack, self._exc_stack
+        self._analyze()
+
+    # -- construction --------------------------------------------------------
+    def _new_node(self, stmt: Optional[ast.AST]) -> CFGNode:
+        n = CFGNode(len(self.nodes), stmt)
+        self.nodes.append(n)
+        # Any statement inside a try may raise into its handlers (every
+        # enclosing frame: an unmatched except type propagates outward).
+        for frame in self._exc_stack:
+            n.succs.update(frame)
+        return n
+
+    def _link(self, preds: List[int], node: CFGNode) -> None:
+        for p in preds:
+            self.nodes[p].succs.add(node.idx)
+
+    def _add_def(self, node: CFGNode, name: str,
+                 value: Optional[ast.expr], lineno: int,
+                 annotation: Optional[ast.expr] = None,
+                 is_param: bool = False, unpacked: bool = False) -> None:
+        if name in self._globals:
+            return                  # global/nonlocal: not a local def
+        d = DefInfo(len(self.defs), name, value, lineno, annotation,
+                    is_param, unpacked)
+        self.defs.append(d)
+        node.defs.append(d)
+
+    def _bind_target(self, node: CFGNode, target: ast.expr,
+                     value: Optional[ast.expr], lineno: int,
+                     unpacked: bool = False) -> None:
+        if isinstance(target, ast.Name):
+            self._add_def(node, target.id, value, lineno,
+                          unpacked=unpacked)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_target(node, elt, value, lineno, unpacked=True)
+        elif isinstance(target, ast.Starred):
+            self._bind_target(node, target.value, value, lineno,
+                              unpacked=True)
+        # Attribute/Subscript targets: covered by the self-access scan.
+
+    # One recursive expression scan per node: loads, calls, awaits,
+    # walrus defs, and self-attribute accesses, with nested scopes
+    # excluded and comprehension targets shadowed out.
+    def _scan(self, node: CFGNode, tree: ast.AST,
+              parent: Optional[ast.AST], grand: Optional[ast.AST],
+              shadow: FrozenSet[str]) -> None:
+        if isinstance(tree, _NESTED_SCOPES):
+            return
+        self.node_of[id(tree)] = node
+        if isinstance(tree, _COMPREHENSIONS):
+            names = {n.id for gen in tree.generators
+                     for n in ast.walk(gen.target)
+                     if isinstance(n, ast.Name)}
+            shadow = shadow | names
+        elif isinstance(tree, ast.Await):
+            node.barrier = True
+            self.awaits.append((tree, node))
+        elif isinstance(tree, (ast.Yield, ast.YieldFrom)):
+            node.barrier = True
+        elif isinstance(tree, ast.Call):
+            self.calls.append((tree, node))
+        elif isinstance(tree, ast.NamedExpr):
+            self._add_def(node, tree.target.id, tree.value,
+                          getattr(tree, "lineno", 0))
+        elif isinstance(tree, ast.Name):
+            if isinstance(tree.ctx, ast.Load) and tree.id not in shadow:
+                self.loads.append((tree, node))
+        elif isinstance(tree, ast.Attribute) and \
+                isinstance(tree.value, ast.Name) and \
+                tree.value.id == "self":
+            kind = self._classify_self_access(tree, parent, grand)
+            self.self_accesses.append((tree.attr, tree, kind, node))
+        for child in ast.iter_child_nodes(tree):
+            if isinstance(tree, ast.NamedExpr) and child is tree.target:
+                continue            # walrus target is a def, not a load
+            self._scan(node, child, tree, parent, shadow)
+
+    @staticmethod
+    def _classify_self_access(attr: ast.Attribute,
+                              parent: Optional[ast.AST],
+                              grand: Optional[ast.AST]) -> str:
+        if isinstance(attr.ctx, (ast.Store, ast.Del)):
+            return "write"
+        if isinstance(parent, ast.Call) and parent.func is attr:
+            return "call"           # self.method(...): not data access
+        if isinstance(parent, ast.Attribute) and parent.value is attr \
+                and isinstance(grand, ast.Call) and grand.func is parent \
+                and parent.attr in MUTATOR_METHODS:
+            return "write"          # self.x.append(...): content write
+        if isinstance(parent, ast.Subscript) and parent.value is attr \
+                and isinstance(parent.ctx, (ast.Store, ast.Del)):
+            return "write"          # self.x[k] = v / del self.x[k]
+        return "read"
+
+    def _scan_stmt(self, node: CFGNode, stmt: ast.AST) -> None:
+        self._scan(node, stmt, None, None, frozenset())
+
+    def _build_body(self, stmts, preds: List[int]) -> List[int]:
+        for stmt in stmts:
+            preds = self._build_stmt(stmt, preds)
+        return preds
+
+    def _build_stmt(self, stmt: ast.stmt, preds: List[int]) -> List[int]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            node = self._new_node(stmt)
+            self._link(preds, node)
+            # Decorators/defaults evaluate in THIS scope; the body does
+            # not (it gets its own FunctionDataflow).
+            for dec in stmt.decorator_list:
+                self._scan_stmt(node, dec)
+            a = getattr(stmt, "args", None)
+            if a is not None:
+                for d in list(a.defaults) + [d for d in a.kw_defaults if d]:
+                    self._scan_stmt(node, d)
+            self._add_def(node, stmt.name, None, stmt.lineno)
+            return [node.idx]
+
+        if isinstance(stmt, ast.If):
+            test = self._new_node(stmt)
+            self._link(preds, test)
+            self._scan_stmt(test, stmt.test)
+            body_exits = self._build_body(stmt.body, [test.idx])
+            if stmt.orelse:
+                else_exits = self._build_body(stmt.orelse, [test.idx])
+            else:
+                else_exits = [test.idx]
+            return body_exits + else_exits
+
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            header = self._new_node(stmt)
+            self._link(preds, header)
+            loop = _Loop(header.idx)
+            if isinstance(stmt, ast.While):
+                self._scan_stmt(header, stmt.test)
+            else:
+                self._scan_stmt(header, stmt.iter)
+                self._bind_target(header, stmt.target, stmt.iter,
+                                  stmt.lineno, unpacked=True)
+                if isinstance(stmt, ast.AsyncFor):
+                    header.barrier = True   # each iteration suspends
+            self._loop_stack.append(loop)
+            body_exits = self._build_body(stmt.body, [header.idx])
+            self._loop_stack.pop()
+            for b in body_exits:
+                self.nodes[b].succs.add(header.idx)
+            if stmt.orelse:
+                exits = self._build_body(stmt.orelse, [header.idx])
+            else:
+                exits = [header.idx]
+            return exits + loop.breaks
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            header = self._new_node(stmt)
+            self._link(preds, header)
+            acquires: Set[str] = set()
+            for item in stmt.items:
+                self._scan_stmt(header, item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind_target(header, item.optional_vars,
+                                      item.context_expr, stmt.lineno,
+                                      unpacked=True)
+                if isinstance(stmt, ast.With):
+                    key = lock_key(item.context_expr)
+                    if key is not None:
+                        acquires.add(key)
+            if isinstance(stmt, ast.AsyncWith):
+                header.barrier = True       # __aenter__/__aexit__ await;
+                #                             async locks are reactor-safe,
+                #                             NOT part of the lockset
+            header.acquires = frozenset(acquires)
+            self.acquired_locks |= acquires
+            body_exits = self._build_body(stmt.body, [header.idx])
+            if acquires:
+                release = self._new_node(stmt)      # synthetic __exit__
+                release.releases = frozenset(acquires)
+                self._link(body_exits, release)
+                return [release.idx]
+            return body_exits
+
+        if isinstance(stmt, ast.Try):
+            # A synthetic finally JUNCTION joins every abrupt exit out
+            # of the protected region (raise, return, break, an
+            # exception mid-statement) into the finalbody — without it
+            # a `try: return x finally: cleanup` leaves the finalbody
+            # unreachable and its lockset/def facts empty.
+            fin: Optional[CFGNode] = None
+            if stmt.finalbody:
+                fin = self._new_node(stmt)
+                self._exc_stack.append([fin.idx])
+            handler_entries: List[int] = []
+            for h in stmt.handlers:
+                hnode = self._new_node(h)
+                if h.type is not None:
+                    self._scan_stmt(hnode, h.type)
+                if h.name:
+                    self._add_def(hnode, h.name, None, h.lineno)
+                handler_entries.append(hnode.idx)
+            if handler_entries:
+                self._exc_stack.append(handler_entries)
+            body_exits = self._build_body(stmt.body, preds)
+            if handler_entries:
+                self._exc_stack.pop()
+            if stmt.orelse:
+                body_exits = self._build_body(stmt.orelse, body_exits)
+            handler_exits: List[int] = []
+            for h, entry in zip(stmt.handlers, handler_entries):
+                handler_exits += self._build_body(h.body, [entry])
+            exits = body_exits + handler_exits
+            if stmt.finalbody:
+                self._exc_stack.pop()
+                exits = self._build_body(stmt.finalbody,
+                                         exits + [fin.idx])
+            return exits
+
+        if isinstance(stmt, ast.Match):
+            subject = self._new_node(stmt)
+            self._link(preds, subject)
+            self._scan_stmt(subject, stmt.subject)
+            exits = [subject.idx]
+            for case in stmt.cases:
+                cnode = self._new_node(case)
+                self._link([subject.idx], cnode)
+                for n in ast.walk(case.pattern):
+                    name = getattr(n, "name", None)
+                    if isinstance(name, str):
+                        self._add_def(cnode, name, None,
+                                      getattr(n, "lineno", stmt.lineno),
+                                      unpacked=True)
+                if case.guard is not None:
+                    self._scan_stmt(cnode, case.guard)
+                exits += self._build_body(case.body, [cnode.idx])
+            return exits
+
+        # -- simple statements ------------------------------------------------
+        if isinstance(stmt, (ast.Global, ast.Nonlocal)):
+            self._globals.update(stmt.names)
+            node = self._new_node(stmt)
+            self._link(preds, node)
+            return [node.idx]
+
+        node = self._new_node(stmt)
+        self._link(preds, node)
+        self._scan_stmt(node, stmt)
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._bind_target(node, t, stmt.value, stmt.lineno)
+        elif isinstance(stmt, ast.AugAssign):
+            # x += v rebinds x from its OLD value: keep the def, mark it
+            # unpacked so value-shape predicates don't trust the RHS.
+            self._bind_target(node, stmt.target, stmt.value, stmt.lineno,
+                              unpacked=True)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None and isinstance(stmt.target, ast.Name):
+                self._add_def(node, stmt.target.id, stmt.value,
+                              stmt.lineno, annotation=stmt.annotation)
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for a in stmt.names:
+                if a.name != "*":
+                    self._add_def(node, a.asname or
+                                  a.name.split(".")[0], None, stmt.lineno)
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            func = stmt.value.func
+            if isinstance(func, ast.Attribute) and not stmt.value.args:
+                key = lock_key(func.value)
+                if key is not None:
+                    # acquire(timeout=...)/acquire(blocking=False) may
+                    # FAIL and return False — a MUST analysis cannot
+                    # treat it as held (the unsound direction); only a
+                    # bare blocking acquire() enters the lockset.
+                    if func.attr == "acquire" and not stmt.value.keywords:
+                        node.acquires = frozenset({key})
+                        self.acquired_locks.add(key)
+                    elif func.attr == "release":
+                        node.releases = frozenset({key})
+
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            return []               # flows to function exit (or handlers,
+            #                         which _new_node already wired up)
+        if isinstance(stmt, ast.Break):
+            if self._loop_stack:
+                self._loop_stack[-1].breaks.append(node.idx)
+            return []
+        if isinstance(stmt, ast.Continue):
+            if self._loop_stack:
+                node.succs.add(self._loop_stack[-1].header)
+            return []
+        return [node.idx]
+
+    # -- analyses ------------------------------------------------------------
+    def _analyze(self) -> None:
+        """Both fixpoints.  Reaching-defs facts are int bitmasks (bit 2i
+        = def i uncrossed, bit 2i+1 = def i crossed-a-barrier): merge is
+        OR, the barrier transfer is one shift (every uncrossed bit moves
+        to its crossed twin), kill/gen are precomputed masks — so the
+        fixpoint is a few big-int ops per node visit.  A def generated
+        AT a barrier node stays uncrossed (``x = await f()`` is fresh
+        after the await); only facts PASSING the barrier get marked."""
+        nnodes = len(self.nodes)
+        preds: List[List[int]] = [[] for _ in self.nodes]
+        for n in self.nodes:
+            for s in n.succs:
+                preds[s].append(n.idx)
+
+        # Per-name def-index lists + per-node kill/gen masks.
+        by_name: Dict[str, List[int]] = {}
+        for d in self.defs:
+            by_name.setdefault(d.name, []).append(d.idx)
+        self._defs_by_name = by_name
+        even = 0
+        for i in range(len(self.defs)):
+            even |= 1 << (2 * i)
+        kills = [0] * nnodes
+        gens = [0] * nnodes
+        for n in self.nodes:
+            k = g = 0
+            for d in n.defs:
+                g |= 1 << (2 * d.idx)
+                for j in by_name[d.name]:
+                    k |= 3 << (2 * j)
+            kills[n.idx] = k
+            gens[n.idx] = g
+
+        outs = [None] * nnodes      # None = not yet computed
+        pending = [False] * nnodes
+        work = [0]
+        pending[0] = True
+        while work:
+            i = work.pop()
+            pending[i] = False
+            node = self.nodes[i]
+            merged = 0
+            for p in preds[i]:
+                o = outs[p]
+                if o is not None:
+                    merged |= o
+            node.in_defs = merged
+            x = merged
+            if node.barrier:
+                x = ((x & even) << 1) | (x & ~even)
+            out = (x & ~kills[i]) | gens[i]
+            if out != outs[i]:
+                outs[i] = out
+                for s in node.succs:
+                    if not pending[s]:
+                        pending[s] = True
+                        work.append(s)
+
+        # Locksets: forward MUST analysis, meet = intersection.
+        lock_outs: List[Optional[FrozenSet[str]]] = [None] * nnodes
+        work = [0]
+        while work:
+            i = work.pop()
+            node = self.nodes[i]
+            if i == 0:
+                held: Optional[FrozenSet[str]] = frozenset()
+            else:
+                held = None
+                for p in preds[i]:
+                    o = lock_outs[p]
+                    if o is None:
+                        continue
+                    held = o if held is None else (held & o)
+                if held is None:
+                    continue        # not yet reachable
+            node.in_locks = held
+            out = (held | node.acquires) - node.releases
+            if out != lock_outs[i]:
+                lock_outs[i] = out
+                work.extend(node.succs)
+
+    # -- queries -------------------------------------------------------------
+    def reaching(self, node: Optional[CFGNode],
+                 name: str) -> List[Tuple[DefInfo, bool]]:
+        """Definitions of `name` reaching `node`'s entry, each with its
+        crossed-an-await/yield-barrier bit; [] for unreachable nodes."""
+        if node is None or not node.in_defs:
+            return []
+        facts = node.in_defs
+        out = []
+        for i in self._defs_by_name.get(name, ()):
+            if facts & (1 << (2 * i)):
+                out.append((self.defs[i], False))
+            if facts & (2 << (2 * i)):
+                out.append((self.defs[i], True))
+        return out
+
+    def lockset(self, node: Optional[CFGNode]) -> FrozenSet[str]:
+        """Locks held on every path into `node` (empty if unreachable)."""
+        if node is None or node.in_locks is None:
+            return frozenset()
+        return node.in_locks
+
+    def node_for(self, tree: ast.AST) -> Optional[CFGNode]:
+        return self.node_of.get(id(tree))

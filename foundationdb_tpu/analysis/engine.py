@@ -1,10 +1,13 @@
 """flowlint rule-engine core.
 
 One AST pass per file: the Analyzer parses each ``.py`` once, builds a
-parent map + import-alias tables, and walks every node exactly once,
-dispatching to each registered Rule's ``visit``.  Rules are stateless
-between files except through their own attributes (cross-file rules use
-``finish`` — see FTL007's schema comparison).
+parent map + by-type node index + import-alias tables, and walks every
+node exactly once, dispatching to each registered Rule's ``visit``;
+entering a function additionally builds that function's dataflow
+(dataflow.py: CFG, reaching defs, locksets) and hands it to each
+rule's ``begin_function``.  Rules are stateless between files except
+through their own attributes (cross-file rules use ``finish`` — see
+FTL007's schema comparison).
 
 Suppression syntax (both forms take a comma list or ``all``):
 
@@ -27,8 +30,23 @@ import os
 import re
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+from .dataflow import FunctionDataflow
+
 _SUPPRESS_LINE = re.compile(r"#\s*flowlint:\s*disable=([A-Za-z0-9_,\s]+)")
 _SUPPRESS_FILE = re.compile(r"#\s*flowlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+# The Python port of Flow's `state` keyword: an assignment marked
+# `# flowlint: state` declares "this local is MEANT to survive awaits"
+# — FTL010 treats it the way the ACTOR compiler treats a state var.
+_STATE_ANNOT = re.compile(r"#\s*flowlint:\s*state\b")
+
+
+def is_actor(node: ast.AST) -> bool:
+    """The ONE 'is this an actor' predicate, shared by every rule that
+    reasons about actors (FTL003's cancellation handling, FTL010's
+    await barriers, FTL011's lock-holding awaits): in this port an
+    actor is exactly an ``async def`` — the unit the reference's ACTOR
+    compiler generates, scheduled by core/scheduler.py's reactor."""
+    return isinstance(node, ast.AsyncFunctionDef)
 
 
 class Finding:
@@ -66,8 +84,23 @@ class Rule:
 
     id = "FTL000"
     title = "base rule"
+    # Set True on rules that read ``ctx.cfg`` from visit() WITHOUT
+    # overriding begin_function (FTL005's widened check): the Analyzer
+    # builds per-function dataflow only when some registered rule
+    # consumes it, so single-rule runs (the check_trace_events shim)
+    # don't pay the two fixpoints per function for nothing.
+    uses_dataflow = False
 
     def begin_file(self, ctx: "FileContext") -> None:  # noqa: B027
+        pass
+
+    def begin_function(self, cfg, ctx: "FileContext") -> None:  # noqa: B027
+        """Called once per (possibly nested) function, right after the
+        walk enters it, with that function's FunctionDataflow (CFG +
+        reaching defs + locksets, dataflow.py).  The cfg covers only
+        the function's own body — nested defs get their own call.  The
+        same object is also visible as ``ctx.cfg`` while the walk is
+        inside the function."""
         pass
 
     def visit(self, node: ast.AST, ctx: "FileContext") -> None:  # noqa: B027
@@ -97,9 +130,23 @@ class FileContext:
         # Lexical stacks maintained by the Analyzer's walk.
         self.func_stack: List[ast.AST] = []
         self.class_stack: List[ast.ClassDef] = []
-        # Parent map: id(child) -> parent node (one pre-pass).
+        # Dataflow stack: one FunctionDataflow per enclosing function,
+        # innermost last (pushed/popped by the Analyzer's walk).
+        self.cfg_stack: List[object] = []
+        # Lines carrying the `# flowlint: state` annotation (the Flow
+        # `state`-keyword port, consumed by FTL010).
+        self.state_lines: Set[int] = {
+            lineno for lineno, text in
+            enumerate(source.splitlines(), 1) if _STATE_ANNOT.search(text)}
+        # ONE pre-pass over the tree: parent map (id(child) -> parent)
+        # plus a by-type node index — rules MUST use ``nodes_of``/
+        # ``enclosing`` for their begin_file prescans instead of
+        # re-walking the tree themselves (the per-rule ast.walk passes
+        # dominated the lint runtime before ISSUE 9 centralized them).
         self._parents: Dict[int, ast.AST] = {}
+        self._by_type: Dict[type, List[ast.AST]] = {}
         for parent in ast.walk(tree):
+            self._by_type.setdefault(type(parent), []).append(parent)
             for child in ast.iter_child_nodes(parent):
                 self._parents[id(child)] = parent
         # Import alias tables (collected file-wide, including imports
@@ -108,12 +155,11 @@ class FileContext:
         # local name -> "module.orig" for `from m import orig [as a]`.
         self.aliases: Dict[str, str] = {}
         self.from_imports: Dict[str, str] = {}
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    self.aliases[a.asname or a.name.split(".")[0]] = a.name
-            elif isinstance(node, ast.ImportFrom) and node.module \
-                    and node.level == 0:
+        for node in self._by_type.get(ast.Import, ()):
+            for a in node.names:
+                self.aliases[a.asname or a.name.split(".")[0]] = a.name
+        for node in self._by_type.get(ast.ImportFrom, ()):
+            if node.module and node.level == 0:
                 for a in node.names:
                     if a.name != "*":
                         self.from_imports[a.asname or a.name] = \
@@ -125,8 +171,13 @@ class FileContext:
         """True when the CLOSEST enclosing function is an actor
         (``async def``); a sync helper nested in an actor is not 'in'
         the actor for lexical-rule purposes."""
-        return bool(self.func_stack) and \
-            isinstance(self.func_stack[-1], ast.AsyncFunctionDef)
+        return bool(self.func_stack) and is_actor(self.func_stack[-1])
+
+    @property
+    def cfg(self):
+        """The innermost enclosing function's FunctionDataflow, or None
+        at module/class level."""
+        return self.cfg_stack[-1] if self.cfg_stack else None
 
     @property
     def at_module_level(self) -> bool:
@@ -134,6 +185,23 @@ class FileContext:
 
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
         return self._parents.get(id(node))
+
+    def nodes_of(self, *types: type) -> List[ast.AST]:
+        """Every node of the given exact AST types, in walk order (the
+        shared pre-pass index — cheaper than any per-rule ast.walk)."""
+        if len(types) == 1:
+            return list(self._by_type.get(types[0], ()))
+        out: List[ast.AST] = []
+        for t in types:
+            out.extend(self._by_type.get(t, ()))
+        return out
+
+    def enclosing(self, node: ast.AST, kinds) -> Optional[ast.AST]:
+        """Nearest ancestor of `node` whose type is in `kinds`."""
+        n = self._parents.get(id(node))
+        while n is not None and not isinstance(n, kinds):
+            n = self._parents.get(id(n))
+        return n
 
     def is_suppressed(self, rule_id: str, line: int) -> bool:
         ids = self.suppress_line.get(line, set()) | self.suppress_file
@@ -207,6 +275,16 @@ class Analyzer:
 
     def __init__(self, rules: Sequence[Rule]) -> None:
         self.rules = list(rules)
+        # Per-node dispatch dominates the lint runtime (PERF.md): only
+        # call the hooks a rule actually overrides.  Dataflow-only
+        # rules (FTL010-012) never pay the per-node visit fan-out.
+        self._visitors = [r for r in self.rules
+                          if type(r).visit is not Rule.visit]
+        self._fn_rules = [r for r in self.rules
+                          if type(r).begin_function is not
+                          Rule.begin_function]
+        self._needs_dataflow = bool(self._fn_rules) or \
+            any(r.uses_dataflow for r in self.rules)
 
     # -- file discovery ------------------------------------------------------
     @staticmethod
@@ -240,18 +318,29 @@ class Analyzer:
 
     # -- the single shared walk ----------------------------------------------
     def _walk(self, node: ast.AST, ctx: FileContext) -> None:
-        for rule in self.rules:
+        for rule in self._visitors:
             rule.visit(node, ctx)
         scoped = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                    ast.ClassDef))
+        is_func = scoped and not isinstance(node, ast.ClassDef)
         if scoped:
             stack = ctx.class_stack if isinstance(node, ast.ClassDef) \
                 else ctx.func_stack
             stack.append(node)
+            if is_func and self._needs_dataflow:
+                # Build this function's dataflow ONCE, during the one
+                # shared walk, and fan it out to every rule — rules
+                # must query it, never re-walk or re-analyze.
+                cfg = FunctionDataflow(node)
+                ctx.cfg_stack.append(cfg)
+                for rule in self._fn_rules:
+                    rule.begin_function(cfg, ctx)
         for child in ast.iter_child_nodes(node):
             self._walk(child, ctx)
         if scoped:
             stack.pop()
+            if is_func and self._needs_dataflow:
+                ctx.cfg_stack.pop()
 
     def run(self, roots: Sequence[str],
             baseline: Optional[List[Dict[str, str]]] = None) -> LintResult:

@@ -1,4 +1,4 @@
-"""flowlint rules FTL001..FTL008.
+"""flowlint rules FTL001..FTL012.
 
 Every rule is grounded in a bug class this repo has actually hit (see
 ISSUE/PR history): wall-clock reads that break unseed reproduction,
@@ -7,9 +7,11 @@ str keys that crashed ``_pack_end``, broad excepts that can swallow
 
 Adding a rule: subclass ``engine.Rule``, set ``id``/``title``, implement
 ``visit`` (called once per AST node — never walk the tree yourself;
-per-file prep goes in ``begin_file``, cross-file checks in ``finish``),
-append it in ``make_rules()``, document it in README's rule table, and
-add a known-bad fixture under tests/fixtures/flowlint/ with
+per-file prep goes in ``begin_file``, cross-file checks in ``finish``)
+and/or ``begin_function`` (handed each function's FunctionDataflow —
+CFG, reaching-defs/def-use chains, locksets; dataflow.py), append it in
+``make_rules()``, document it in README's rule table, and add a
+known-bad fixture under tests/fixtures/flowlint/ with
 ``# expect: FTLnNN:<line>`` markers.
 """
 
@@ -19,7 +21,8 @@ import ast
 import re
 from typing import Dict, List, Optional, Set
 
-from .engine import Finding, Rule
+from .dataflow import lock_key
+from .engine import Finding, Rule, is_actor
 
 # Modules that are real-mode-only BY CONSTRUCTION: never imported on a
 # simulation code path, so wall-clock/entropy/set-order hazards in them
@@ -97,14 +100,9 @@ class UnawaitedCoroutineRule(Rule):
     title = "un-awaited coroutine call"
 
     def begin_file(self, ctx) -> None:
-        async_defs: Set[str] = set()
-        sync_defs: Set[str] = set()
-        for n in ast.walk(ctx.tree):
-            if isinstance(n, ast.AsyncFunctionDef):
-                async_defs.add(n.name)
-            elif isinstance(n, ast.FunctionDef):
-                sync_defs.add(n.name)
-        self._async_defs = async_defs - sync_defs
+        self._async_defs = \
+            {n.name for n in ctx.nodes_of(ast.AsyncFunctionDef)} - \
+            {n.name for n in ctx.nodes_of(ast.FunctionDef)}
 
     def visit(self, node: ast.AST, ctx) -> None:
         if not isinstance(node, ast.Expr) or \
@@ -240,12 +238,26 @@ class SetIterationRule(Rule):
     process-dependent — the exact hazard that breaks cross-process
     unseed reproduction (ROADMAP chaos follow-up).  Flags ``for``
     loops / comprehensions whose iterable is syntactically a set (set
-    literal, set comprehension, ``set(...)``/``frozenset(...)`` call);
-    wrap in ``sorted()`` to fix.  Dict iteration is NOT flagged:
-    Python dicts are insertion-ordered, hence deterministic."""
+    literal, set comprehension, ``set(...)``/``frozenset(...)`` call)
+    — and, through the dataflow layer's def-use chains (ISSUE 9), a
+    NAME whose reaching definition is set-valued: assigned a set
+    expression, a set-operator combination (``a | b``), a call to a
+    same-file helper whose every return is a set, or a parameter
+    annotated ``set``/``Set[...]``/``frozenset``.  Re-binding kills the
+    taint (``s = sorted(s)`` is the fix and is not flagged).  Dict
+    iteration is NOT flagged: Python dicts are insertion-ordered,
+    hence deterministic."""
 
     id = "FTL005"
     title = "set iteration order is PYTHONHASHSEED-dependent"
+    uses_dataflow = True            # reads ctx.cfg from visit()
+
+    _SET_ANNOT = re.compile(
+        r"^(typing\.)?(set|frozenset|Set|FrozenSet|AbstractSet|"
+        r"MutableSet)\b")
+    _SET_OPS = (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+    _SET_METHODS = ("union", "intersection", "difference",
+                    "symmetric_difference", "copy")
 
     @staticmethod
     def _is_set_expr(node: ast.expr) -> bool:
@@ -255,12 +267,88 @@ class SetIterationRule(Rule):
             isinstance(node.func, ast.Name) and \
             node.func.id in ("set", "frozenset")
 
+    def begin_file(self, ctx) -> None:
+        # Set-returning helpers defined in THIS file: every `return` of
+        # the NEAREST enclosing function is syntactically a set
+        # expression.  One level deep on purpose — a fixpoint over
+        # helper-calling-helper chains buys noise, not signal.
+        _FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        all_set: Dict[ast.AST, bool] = {}
+        for r in ctx.nodes_of(ast.Return):
+            fn = ctx.enclosing(r, _FUNCS)
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            ok = r.value is not None and self._is_set_expr(r.value)
+            all_set[fn] = all_set.get(fn, True) and ok
+        # A name is a helper only when EVERY same-named function in the
+        # file qualifies — two classes defining `make()` differently
+        # must not cross-taint (the FTL002 same-name ambiguity rule).
+        bad = {fn.name for fn, ok in all_set.items() if not ok} | \
+              {n.name for n in ctx.nodes_of(ast.FunctionDef)
+               if n not in all_set}
+        self._set_helpers: Set[str] = \
+            {fn.name for fn, ok in all_set.items() if ok} - bad
+
+    def _set_annotation(self, annot: Optional[ast.expr]) -> bool:
+        if annot is None:
+            return False
+        try:
+            text = ast.unparse(annot)
+        except Exception:           # pragma: no cover - defensive
+            return False
+        return bool(self._SET_ANNOT.match(text))
+
+    def _set_valued(self, expr: ast.expr, ctx, depth: int = 0) -> bool:
+        """Is `expr` a set, judging through the current function's
+        def-use chains?  Depth-bounded; unpacked/augmented defs are
+        opaque (never set-valued)."""
+        if depth > 4:
+            return False
+        if self._is_set_expr(expr):
+            return True
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op,
+                                                      self._SET_OPS):
+            return self._set_valued(expr.left, ctx, depth + 1) or \
+                self._set_valued(expr.right, ctx, depth + 1)
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Name) and f.id in self._set_helpers:
+                return True
+            if isinstance(f, ast.Attribute):
+                if f.attr in self._set_helpers and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self":
+                    return True     # self-call of a set-returning method
+                if f.attr in self._SET_METHODS:
+                    return self._set_valued(f.value, ctx, depth + 1)
+            return False
+        if isinstance(expr, ast.Name):
+            cfg = ctx.cfg
+            if cfg is None:
+                return False
+            node = cfg.node_for(expr)
+            for dinfo, _crossed in cfg.reaching(node, expr.id):
+                if dinfo.is_param:
+                    if self._set_annotation(dinfo.annotation):
+                        return True
+                elif not dinfo.unpacked and dinfo.value is not None and \
+                        self._set_valued(dinfo.value, ctx, depth + 1):
+                    return True
+            return False
+        return False
+
     def _check_iter(self, it: ast.expr, ctx) -> None:
         if self._is_set_expr(it):
             ctx.report(self, it,
                        "iteration over a set: order depends on "
                        "PYTHONHASHSEED for str elements — wrap in "
                        "sorted() (deterministic) before iterating")
+        elif isinstance(it, ast.Name) and self._set_valued(it, ctx):
+            ctx.report(self, it,
+                       f"iteration over set-valued '{it.id}': order "
+                       "depends on PYTHONHASHSEED for str elements — "
+                       "wrap in sorted() (deterministic) before "
+                       "iterating")
 
     def visit(self, node: ast.AST, ctx) -> None:
         if not _sim_reachable(ctx.path):
@@ -526,9 +614,8 @@ class KnobNameRule(Rule):
         # name map would resolve one of them wrongly (false FTL009 on a
         # valid knob read, or a masked real typo).
         self._vars = {}
-        for n in ast.walk(ctx.tree):
-            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
-                    isinstance(n.targets[0], ast.Name):
+        for n in ctx.nodes_of(ast.Assign):
+            if len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
                 cls = self._factory_class(n.value, ctx)
                 if cls is not None:
                     scope = self._scope(n, ctx)
@@ -572,6 +659,295 @@ class KnobNameRule(Rule):
                 self._check(cls, arg.value, node, ctx)
 
 
+class StaleStateAcrossAwaitRule(Rule):
+    """FTL010: a local snapshot of shared mutable state read after an
+    await without re-binding — the exact hazard class Flow's ACTOR
+    compiler makes a COMPILE ERROR (locals die at every ``wait()``
+    unless declared ``state``; PAPER.md).
+
+    In this port: inside an actor, a local whose defining RHS reads a
+    MUTABLE ``self`` attribute (one its OWN class reassigns outside
+    ``__init__`` — the epoch/backend/boundary state recovery and
+    degradation swap out underneath a suspended actor; same-named
+    attrs of other classes in the file don't cross-taint) or a
+    module-level mutable container, where a def-use chain crosses an
+    await/yield barrier (dataflow.py's crossed bit) with no re-binding
+    in between.  Sanctioned escapes, mirroring Flow:
+
+      * re-bind after the await (reaching-defs kills the stale fact);
+      * ``# flowlint: state`` on the assignment line — the Python port
+        of the ``state`` keyword: "this snapshot is MEANT to survive
+        suspension" (e.g. folding one consistent view of a batch);
+      * an immutable/copy snapshot — RHS is a call to a value-copying
+        builtin (``list(self.x)``, ``int(self.v)``, ``sorted(...)``),
+        a ``.join()``, or an eager comprehension (generator
+        expressions stay flagged: they read the shared state lazily,
+        after the await): taking an explicit copy IS the fix for torn
+        reads;
+      * an await result (``x = await f(self.y)``): the local holds
+        post-suspension data, not a pre-await snapshot;
+      * attributes a class only ever assigns in ``__init__`` are
+        treated as immutable bindings and never flagged."""
+
+    id = "FTL010"
+    title = "stale shared-state snapshot read across await"
+
+    SNAPSHOT_CALLS = frozenset({
+        "bool", "bytes", "dict", "float", "frozenset", "int", "len",
+        "list", "max", "min", "repr", "set", "sorted", "str", "sum",
+        "tuple",
+    })
+
+    _FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def begin_file(self, ctx) -> None:
+        # Attributes assigned/deleted on `self` OUTSIDE __init__,
+        # keyed by ENCLOSING CLASS: the "actually mutable" filter that
+        # keeps init-frozen handles (self.id, self.interface) quiet —
+        # and two classes sharing an attr NAME must not cross-taint
+        # each other (the FTL009 scope lesson from PR 6).
+        self._mutable_attrs: Dict[int, Set[str]] = {}
+        self._mutable_globals: Set[str] = set()
+        for node in ctx.nodes_of(ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign, ast.Delete):
+            targets = list(node.targets) if isinstance(
+                node, (ast.Assign, ast.Delete)) else [node.target]
+            attrs = []
+            while targets:          # incl. tuple-unpack/starred/chained
+                t = targets.pop()
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    targets.extend(t.elts)
+                elif isinstance(t, ast.Starred):
+                    targets.append(t.value)
+                elif isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    attrs.append(t.attr)
+            if attrs:
+                fn = ctx.enclosing(node, self._FUNCS)
+                if fn is not None and \
+                        fn.name not in ("__init__", "__new__"):
+                    cls = ctx.enclosing(node, (ast.ClassDef,))
+                    self._mutable_attrs.setdefault(
+                        id(cls) if cls else 0, set()).update(attrs)
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) and \
+                    isinstance(node.value, (ast.Dict, ast.List, ast.Set)):
+                self._mutable_globals.add(node.targets[0].id)
+
+    def _shared_source(self, value: ast.expr,
+                       mutable: Set[str]) -> Optional[str]:
+        """Name of the shared mutable state `value` reads, or None."""
+        for n in ast.walk(value):
+            if isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id == "self" and \
+                    isinstance(n.ctx, ast.Load) and \
+                    n.attr in mutable:
+                return f"self.{n.attr}"
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in self._mutable_globals:
+                return n.id
+        return None
+
+    def begin_function(self, cfg, ctx) -> None:
+        if not is_actor(cfg.func):
+            return
+        cls = ctx.class_stack[-1] if ctx.class_stack else None
+        mutable = self._mutable_attrs.get(id(cls) if cls else 0, set())
+        reported: Set = set()
+        for name_node, node in cfg.loads:
+            for dinfo, crossed in cfg.reaching(node, name_node.id):
+                if not crossed or dinfo.is_param or dinfo.value is None:
+                    continue
+                key = (name_node.id, dinfo.idx)
+                if key in reported:
+                    continue
+                if dinfo.lineno in ctx.state_lines:
+                    continue        # declared state (Flow's keyword)
+                v = dinfo.value
+                if isinstance(v, ast.Await):
+                    # `x = await f(self.y)`: x holds the await RESULT —
+                    # post-suspension data, not a pre-await snapshot.
+                    continue
+                if isinstance(v, (ast.ListComp, ast.SetComp,
+                                  ast.DictComp)):
+                    # A comprehension EAGERLY copies what it iterates —
+                    # same policy as set()/list() calls.  GeneratorExp
+                    # stays flagged: it reads the shared state lazily,
+                    # AFTER the await.
+                    continue
+                if isinstance(v, ast.Call) and (
+                        (isinstance(v.func, ast.Name) and
+                         v.func.id in self.SNAPSHOT_CALLS) or
+                        (isinstance(v.func, ast.Attribute) and
+                         v.func.attr == "join")):
+                    continue        # explicit immutable/copy snapshot
+                shared = self._shared_source(v, mutable)
+                if shared is None:
+                    continue
+                reported.add(key)
+                ctx.report(self, name_node,
+                           f"local '{name_node.id}' snapshots shared "
+                           f"mutable state ({shared}, assigned line "
+                           f"{dinfo.lineno}) and is read after an await "
+                           "without re-binding: the awaited suspension "
+                           "may have changed it (recovery, degrade, "
+                           "boundary move) — re-read it after the "
+                           "await, take an explicit copy, or mark the "
+                           "assignment `# flowlint: state` (Flow's "
+                           "state keyword) if the snapshot is "
+                           "intentional")
+
+
+class AwaitHoldingLockRule(Rule):
+    """FTL011: an actor awaiting — or blocking without a timeout —
+    while holding a threading lock.
+
+    A ``with self._lock:`` region containing an ``await`` parks the
+    coroutine mid-critical-section: every OTHER thread that wants the
+    lock (the supervisor's dispatch/fetch lanes, TCP handler threads)
+    blocks for the whole suspension, and if the awaited completion is
+    produced by one of those threads the process deadlocks.  Likewise,
+    a timeout-less ``.result()``/``.wait()``/``.acquire()``/``.join()``
+    /``.get()`` under a held lock stalls the one reactor thread
+    unboundedly (the wait-without-timeout ROADMAP carry-over).  The
+    lockset comes from the dataflow layer (meet = intersection, so a
+    lock is "held" only when held on every path); ``async with`` locks
+    are reactor-safe and never enter the lockset."""
+
+    id = "FTL011"
+    title = "await / unbounded wait while holding a lock"
+
+    WAIT_METHODS = frozenset({"acquire", "get", "join", "result", "wait"})
+
+    @staticmethod
+    def _fmt(locks) -> str:
+        return ", ".join(sorted(locks))
+
+    def begin_function(self, cfg, ctx) -> None:
+        if not is_actor(cfg.func):
+            return
+        for aw, node in cfg.awaits:
+            held = cfg.lockset(node)
+            if held:
+                ctx.report(self, aw,
+                           f"await while holding {self._fmt(held)}: the "
+                           "lock stays held across the suspension — "
+                           "worker threads contending for it stall for "
+                           "the whole await (deadlock if they produce "
+                           "the awaited result); copy what you need and "
+                           "release before awaiting")
+        for call, node in cfg.calls:
+            f = call.func
+            if not isinstance(f, ast.Attribute) or \
+                    f.attr not in self.WAIT_METHODS:
+                continue
+            if call.args or any(kw.arg == "timeout"
+                                for kw in call.keywords):
+                continue
+            held = cfg.lockset(node)
+            if held:
+                ctx.report(self, call,
+                           f".{f.attr}() with no timeout while holding "
+                           f"{self._fmt(held)}: an unbounded block in a "
+                           "critical section wedges every contender "
+                           "(and the reactor, in an actor) — pass "
+                           "timeout= and handle expiry")
+
+
+class LocksetDisciplineRule(Rule):
+    """FTL012: lockset discipline — the static variant of Eraser
+    (Savage et al.), scoped to classes that own or acquire a
+    ``threading.Lock``.
+
+    The PR-6 supervisor race class: ``_needs``/``_delta_bound`` were
+    corrected under ``self._lock`` on the fetch lane but snapshotted
+    lock-free on the dispatch path — caught by review, invisible to
+    syntactic rules.  Here: within such a class, a ``self`` attribute
+    WRITTEN at least once with a non-empty lockset (direct assignment,
+    ``self.x[k] =``, or a container-mutator call like ``.append()``)
+    must not be read or written at another site with an EMPTY lockset.
+    ``__init__``/``__new__`` are exempt (object construction
+    happens-before publication).  What this cannot prove (README):
+    locks are keyed by source text, not object identity; accesses
+    through an alias (``cs = self._x; cs._needs``) and cross-object
+    guards are invisible; a lock-free access that is safe by a
+    happens-before argument needs a justified suppression."""
+
+    id = "FTL012"
+    title = "lock-guarded attribute accessed with empty lockset"
+
+    LOCK_FACTORIES = ("threading.Lock", "threading.RLock")
+
+    class _ClassState:
+        __slots__ = ("node", "owns_lock", "acquired",
+                     "accesses")
+
+        def __init__(self, node: ast.ClassDef) -> None:
+            self.node = node
+            self.owns_lock = False
+            self.acquired: Set[str] = set()
+            # attr -> [(kind, lockset, ast node, function name)]
+            self.accesses: Dict[str, List[tuple]] = {}
+
+    def begin_file(self, ctx) -> None:
+        self._classes: Dict[int, LocksetDisciplineRule._ClassState] = {}
+        for a in ctx.nodes_of(ast.Assign):
+            if isinstance(a.value, ast.Call) and \
+                    ctx.resolve_call(a.value.func) in self.LOCK_FACTORIES:
+                cls = ctx.enclosing(a, (ast.ClassDef,))
+                if cls is not None:
+                    self._state_for(cls).owns_lock = True
+
+    def _state_for(self, cls: ast.ClassDef) -> "_ClassState":
+        state = self._classes.get(id(cls))
+        if state is None:
+            state = self._classes[id(cls)] = self._ClassState(cls)
+        return state
+
+    def begin_function(self, cfg, ctx) -> None:
+        if not ctx.class_stack:
+            return
+        state = self._state_for(ctx.class_stack[-1])
+        state.acquired |= {k for k in cfg.acquired_locks
+                           if k.startswith("self.")}
+        fname = cfg.func.name
+        if fname in ("__init__", "__new__"):
+            return
+        for attr, node_ast, kind, cnode in cfg.self_accesses:
+            if kind == "call" or lock_key(node_ast) is not None:
+                continue            # methods / the lock objects themselves
+            state.accesses.setdefault(attr, []).append(
+                (kind, cfg.lockset(cnode), node_ast, fname))
+
+    def end_file(self, ctx) -> None:
+        for state in self._classes.values():
+            if not (state.owns_lock or state.acquired):
+                continue
+            for attr, accs in sorted(state.accesses.items()):
+                guarded = [a for a in accs if a[0] == "write" and a[1]]
+                if not guarded:
+                    continue
+                locks = frozenset.intersection(*(a[1] for a in guarded))
+                lock_txt = ", ".join(sorted(locks or
+                                            next(iter(guarded))[1]))
+                gw_kind, _gl, gw_node, gw_fn = guarded[0]
+                for kind, held, node_ast, fname in accs:
+                    if held:
+                        continue
+                    ctx.report(self, node_ast,
+                               f"{state.node.name}.{attr} is written "
+                               f"under {lock_txt} ({gw_fn}, line "
+                               f"{getattr(gw_node, 'lineno', 0)}) but "
+                               f"{'written' if kind == 'write' else 'read'}"
+                               f" lock-free in {fname}: racy against "
+                               "the guarded sites — take the lock, or "
+                               "suppress with the happens-before "
+                               "argument")
+
+
 def make_rules() -> List[Rule]:
     """Fresh rule instances — ALWAYS construct per run: rules carry
     cross-file state (TraceEventRule._by_type), so sharing instances
@@ -580,4 +956,6 @@ def make_rules() -> List[Rule]:
     return [WallClockRule(), UnawaitedCoroutineRule(),
             BroadExceptInActorRule(), StrKeyRule(), SetIterationRule(),
             BlockingInActorRule(), TraceEventRule(),
-            HardcodedTunableRule(), KnobNameRule()]
+            HardcodedTunableRule(), KnobNameRule(),
+            StaleStateAcrossAwaitRule(), AwaitHoldingLockRule(),
+            LocksetDisciplineRule()]

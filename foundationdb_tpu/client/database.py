@@ -520,7 +520,7 @@ class Transaction:
 
     async def _ensure_read_version(self) -> Version:
         from ..core.futures import wait_any
-        first_acquire = self._read_version is None
+        first_acquire = self._read_version is None  # flowlint: state -- remembers pre-GRV state for tracing
         if first_acquire:
             await self.db._await_ready()
         f = self.get_read_version()
@@ -920,7 +920,7 @@ class Transaction:
 
     # -- commit (reference tryCommit :5018) ----------------------------------
     async def commit(self) -> Version:
-        wcr = self.writes.write_conflict_ranges() + self._extra_write_ranges
+        wcr = self.writes.write_conflict_ranges() + self._extra_write_ranges  # flowlint: state -- commit resolves the entry-time write set
         if not self.writes.mutations and not wcr:
             # Read-only: nothing to resolve (reference returns immediately).
             self.committed_version = -1
@@ -934,7 +934,7 @@ class Transaction:
         read_snapshot = 0
         if self.read_conflict_ranges:
             read_snapshot = await self._ensure_read_version()
-        txn = CommitTransactionRef(
+        txn = CommitTransactionRef(  # flowlint: state -- one txn snapshot per commit attempt
             read_conflict_ranges=[KeyRange(b, e) for b, e in
                                   _coalesce(self.read_conflict_ranges)],
             write_conflict_ranges=[KeyRange(b, e) for b, e in
@@ -954,7 +954,7 @@ class Transaction:
             from ..core.trace import trace_batch_event
             trace_batch_event("TransactionDebug", self.debug_id,
                               "NativeAPI.commit.Before")
-        f = RequestStream.at(proxy.commit.endpoint).get_reply(
+        f = RequestStream.at(proxy.commit.endpoint).get_reply(  # flowlint: state -- the in-flight commit future
             CommitTransactionRequest(transaction=txn,
                                      debug_id=self.debug_id))
         try:

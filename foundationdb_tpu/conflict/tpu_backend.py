@@ -183,24 +183,30 @@ class TpuConflictSet(ConflictSet):
 
     def _reset_bookkeeping(self, live_boundaries: int) -> None:
         """Merge-scheduling/accounting reset shared with the sharded
-        backend's _reset_state."""
-        self._live_boundaries = live_boundaries
-        self._batches_since_merge = 0
-        # Sound upper bound on delta occupancy (insert adds <= 2W+0 net new
-        # boundaries per batch); drives proactive merge scheduling so the
-        # in-kernel overflow flag never fires in normal operation.  The
-        # bound is tightened with actual device-reported sizes as handles
-        # are waited (see ResolveHandle.wait_codes).
-        self._delta_bound = 1
-        self._delta_epoch = getattr(self, "_delta_epoch", 0) + 1
-        self._seq = getattr(self, "_seq", 0)
-        self._corrected_seq = getattr(self, "_corrected_seq", 0)
-        self._needs: dict = {}
+        backend's _reset_state.  Takes the backend lock (no caller holds
+        it): clear() runs on the supervisor's dispatch lane, and the
+        fetch lane's wait_codes correction may still be unwinding."""
+        with self._lock:
+            self._live_boundaries = live_boundaries
+            self._batches_since_merge = 0
+            # Sound upper bound on delta occupancy (insert adds <= 2W+0
+            # net new boundaries per batch); drives proactive merge
+            # scheduling so the in-kernel overflow flag never fires in
+            # normal operation.  The bound is tightened with actual
+            # device-reported sizes as handles are waited (see
+            # ResolveHandle.wait_codes).
+            self._delta_bound = 1
+            self._delta_epoch = getattr(self, "_delta_epoch", 0) + 1
+            self._seq = getattr(self, "_seq", 0)
+            self._corrected_seq = getattr(self, "_corrected_seq", 0)
+            self._needs: dict = {}
 
     def clear(self, version: Version) -> None:
         # Like the reference clearConflictSet (SkipList.cpp:797): V(k) :=
         # version everywhere; oldest_version is deliberately NOT changed.
-        if self._inflight:
+        with self._lock:
+            in_flight = bool(self._inflight)
+        if in_flight:
             from ..core.error import err
             raise err("internal_error",
                       "clear() with batches in flight; wait() them first")
@@ -503,4 +509,5 @@ class TpuConflictSet(ConflictSet):
         """Upper bound on live boundaries as of the last wait()ed batch
         (base + delta; cross-tier duplicate boundaries count twice, and a
         merge dispatched since then is not yet reflected)."""
-        return self._live_boundaries
+        with self._lock:        # fetch-lane wait_codes updates it
+            return self._live_boundaries

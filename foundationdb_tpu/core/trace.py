@@ -59,7 +59,7 @@ class Tracer:
     def _roll(self) -> None:
         """Shift rolled files up one slot and start a fresh active file
         (caller holds the lock)."""
-        self._fh.close()
+        self._fh.close()  # flowlint: disable=FTL012 -- emit holds the lock
         try:
             last = self._rolled_name(self.keep_files)
             if os.path.exists(last):
@@ -71,8 +71,8 @@ class Tracer:
             os.replace(self.path, self._rolled_name(1))
         except OSError:  # pragma: no cover - a lost roll keeps appending
             pass
-        self._fh = open(self.path, "a", encoding="utf-8")
-        self._bytes_written = 0
+        self._fh = open(self.path, "a", encoding="utf-8")  # flowlint: disable=FTL012 -- emit holds the lock
+        self._bytes_written = 0  # flowlint: disable=FTL012 -- emit holds the lock
 
     def emit(self, event: Dict[str, Any]) -> None:
         # Unseed verification: the (event name, time) stream is part of
@@ -110,23 +110,33 @@ class Tracer:
                 self._fh.flush()
 
     def find(self, type_name: str) -> List[Dict[str, Any]]:
-        return [e for e in self.ring if e.get("Type") == type_name]
+        # Snapshot under the lock: per-connection threads append to the
+        # ring through emit(), and iterating a deque mid-append is
+        # undefined (FTL012 catch).
+        with self._lock:
+            events = list(self.ring)
+        return [e for e in events if e.get("Type") == type_name]
 
     def close(self) -> None:
-        if self._fh is None:
-            return
         # Final accounting (the reference's TraceLog close summary): a
         # run's error count must reach the file even when nothing reads
         # the live ring.  Built by hand — TraceEvent would re-enter emit
         # through the global tracer, which may not be this instance.
         # Events counts the run's events, excluding this summary record.
+        # Counters are snapshotted under the lock (emit bumps them from
+        # other threads) and the lock is RELEASED before emit() retakes
+        # it for the summary record.
+        with self._lock:
+            if self._fh is None:
+                return
+            n_events = self.events_emitted
+            n_errors = self.error_count
         from .scheduler import _current
-        n_events = self.events_emitted
         self.emit({"Type": "TraceStats", "Severity": Severity.Info,
                    "Time": round(_current.now() if _current is not None
                                  else 0.0, 6),
                    "Events": n_events,
-                   "ErrorCount": self.error_count})
+                   "ErrorCount": n_errors})
         with self._lock:
             if self._fh:
                 self._fh.close()

@@ -235,7 +235,11 @@ class TcpTransport:
             self._listener.close()
         except OSError:
             pass
-        for s in self._peer_socks.values():
+        # Snapshot under the lock: _connect threads may still be
+        # registering winners of a connect race (FTL012 catch).
+        with self._lock:
+            socks = list(self._peer_socks.values())
+        for s in socks:
             try:
                 s.close()
             except OSError:

@@ -583,7 +583,7 @@ class CommitProxy:
                 touched = set(range(n))
             if not touched:
                 touched = {0}   # read-only/no-range txns: resolver 0 decides
-            for idx in touched:
+            for idx in sorted(touched):
                 clipped = CommitTransactionRef(
                     read_conflict_ranges=self._clip_ranges(
                         txn.read_conflict_ranges, idx, floor),

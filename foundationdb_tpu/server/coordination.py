@@ -472,7 +472,7 @@ class CoordinatedState:
         then only loses to a genuinely interleaving reader."""
         while True:
             self._battle += 1
-            gen = Generation(self._battle,
+            gen = Generation(self._battle,  # flowlint: state -- CAS generation snapshot (disk-paxos)
                              deterministic_random().random_int(1, 1 << 30))
             futures = [RequestStream.at(c.reg_read).get_reply(
                 GenRegReadRequest(key=CSTATE_KEY, gen=gen))
@@ -504,7 +504,7 @@ class CoordinatedState:
         """Phase 2: quorum write at the read generation.  Raises
         coordinated_state_conflict if another writer won the race."""
         assert self._gen is not None, "read() before write()"
-        gen = self._gen
+        gen = self._gen  # flowlint: state -- CAS generation snapshot (disk-paxos)
         futures = [RequestStream.at(c.reg_write).get_reply(
             GenRegWriteRequest(key=CSTATE_KEY, value=value, gen=gen))
             for c in self.coordinators]

@@ -272,7 +272,7 @@ class DataDistributor:
         init_storage commits the serverTag registry entry, so proxies
         learn the tag's interface and the next recovery carries it."""
         from .interfaces import GetWorkersRequest, InitializeStorageRequest
-        info = self._db_info_var.get() if self._db_info_var else None
+        info = self._db_info_var.get() if self._db_info_var else None  # flowlint: state -- one config snapshot per recruitment
         cc = getattr(info, "cluster_controller", None) if info else None
         if cc is None:
             return None

@@ -98,7 +98,7 @@ class KVStoreMemory(IKeyValueStore):
     async def commit(self) -> None:
         """Log the batch as ONE record (atomic under recovery), fsync,
         then apply to the in-memory image."""
-        batch, self._uncommitted = self._uncommitted, []
+        batch, self._uncommitted = self._uncommitted, []  # flowlint: state -- owns the drained batch (swap pattern)
         if batch:
             blob = b"".join(_enc_kv(op, a, b) for op, a, b in batch)
             self.queue.push(blob)

@@ -368,10 +368,10 @@ class KVStoreBTree(IKeyValueStore):
         return self._finish(_Node(_INTERNAL, keys, None, children))
 
     async def commit(self) -> None:
-        batch, self._uncommitted = self._uncommitted, []
-        page_count0 = self.page_count
+        batch, self._uncommitted = self._uncommitted, []  # flowlint: state -- owns the drained batch (swap pattern)
+        page_count0 = self.page_count  # flowlint: state -- commit-entry snapshot
         free0 = list(self.free)
-        root = self.root
+        root = self.root  # flowlint: state -- commit writes the entry-time root
         for op, a, b in batch:
             if op == 0:
                 r = await self._cow_set(root, a, b)
@@ -513,7 +513,7 @@ class KVStoreBTree(IKeyValueStore):
         persists its free-list pages instead; a scan is the simpler
         equivalent at this engine's scale."""
         reachable = {0, 1}
-        stack = [self.root] if self.root else []
+        stack = [self.root] if self.root else []  # flowlint: state -- traversal pinned to entry-time root (COW)
         while stack:
             pid = stack.pop()
             if pid in reachable:

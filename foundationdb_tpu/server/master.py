@@ -834,7 +834,7 @@ async def master_server(master: Master, process, coordinators,
                 # change or the log capture would have a hole.
                 from .system_data import BACKUP_TAG
                 all_tags.add(BACKUP_TAG)
-            for tag in all_tags:
+            for tag in sorted(all_tags):
                 holder = next((i for i in old_ls.team_for_tag(tag)
                                if i in locked), None)
                 if holder is None:

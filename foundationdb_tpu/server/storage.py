@@ -327,7 +327,7 @@ class StorageServer:
         """The update actor: a peek cursor over this server's tag."""
         from ..core.error import FdbError
         knobs = server_knobs()
-        fetch_from = self.version.get() + 1
+        fetch_from = self.version.get() + 1  # flowlint: state -- peek cursor, advanced at loop end
         while True:
             if self.log_system is None:
                 await delay(0.5)
@@ -387,9 +387,9 @@ class StorageServer:
             if self._pending_engine is not None:
                 req, self._pending_engine = self._pending_engine, None
                 await self._do_migrate_engine(req)
-            target = self.version.get()
-            dv = self.durable_version
-            epoch0 = self.log_epoch
+            target = self.version.get()  # flowlint: state -- fsync frontier chosen before the commit
+            dv = self.durable_version  # flowlint: state -- identity compared post-fsync (rollback check)
+            epoch0 = self.log_epoch  # flowlint: state -- compared post-fsync (rollback check)
             if target <= dv.get():
                 continue
             batch, self._durable_pending = self._durable_pending, []
@@ -793,7 +793,7 @@ class StorageServer:
         double-applied."""
         try:
             new_engine, cleanup_old = self._engine_factory(req.engine)
-            dv = self.durable_version.get()
+            dv = self.durable_version.get()  # flowlint: state -- frontier snapshot for this wait
             await self._image_engine(new_engine, dv)
             old_name = self.engine_name
             self.engine = new_engine

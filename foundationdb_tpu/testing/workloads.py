@@ -908,7 +908,7 @@ class TenantManagementWorkload(TestWorkload):
         if set(live) != set(self.model):
             self.metrics["map_mismatch"] = 1.0
             return False
-        for name, value in self.model.items():
+        for name, value in self.model.items():  # flowlint: state -- checks the entry-time model
             tenant = await self.db.open_tenant(name)
 
             async def read(t):

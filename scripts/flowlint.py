@@ -4,6 +4,9 @@ key-type hazards (foundationdb_tpu/analysis/).
 
     python scripts/flowlint.py                      # lint the package
     python scripts/flowlint.py foundationdb_tpu     # same, explicit
+    python scripts/flowlint.py --changed            # only files in
+                                                    #   `git diff HEAD`
+    python scripts/flowlint.py --changed main       # ... vs a ref
     python scripts/flowlint.py --format json        # machine-readable
     python scripts/flowlint.py --list-rules
     python scripts/flowlint.py --write-baseline     # grandfather current
@@ -26,6 +29,61 @@ sys.path.insert(0, REPO)
 DEFAULT_BASELINE = os.path.join(REPO, "flowlint_baseline.json")
 
 
+def changed_files(paths, ref):
+    """The .py files under `paths` that differ from `ref` (incremental
+    mode): ``git diff --name-only`` plus untracked files (``git
+    ls-files --others``), anchored at the first lint path's repository,
+    names resolved against that repo's toplevel, filtered to existing
+    .py files inside the requested lint roots.  Deleted files drop out
+    (nothing to parse); finding paths/baseline identity are untouched —
+    each surviving file is linted as a single-file root, which the
+    engine rel-ifies exactly like a directory scan."""
+    import subprocess
+    anchor = os.path.abspath(paths[0])
+    anchor_dir = anchor if os.path.isdir(anchor) else \
+        os.path.dirname(anchor)
+    git = ["git", "-C", anchor_dir]
+    top = subprocess.run(git + ["rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True)
+    if top.returncode != 0:
+        raise RuntimeError(f"--changed needs a git checkout: "
+                           f"{top.stderr.strip()}")
+    toplevel = top.stdout.strip()
+    diff = subprocess.run(git + ["diff", "--name-only", ref, "--"],
+                          capture_output=True, text=True)
+    if diff.returncode != 0:
+        raise RuntimeError(f"git diff --name-only {ref} failed: "
+                           f"{diff.stderr.strip()}")
+    # Untracked files never appear in `git diff` output, yet a brand-new
+    # module is the file MOST likely to carry new findings — union them
+    # in (fail-soft: an odd git version just degrades to diff-only).
+    # Run from the TOPLEVEL: unlike diff, `ls-files --others` lists only
+    # the subtree under its cwd, which would drop untracked files under
+    # every lint root but the first.
+    untracked = subprocess.run(
+        ["git", "-C", toplevel, "ls-files", "--others",
+         "--exclude-standard"],
+        capture_output=True, text=True)
+    names = diff.stdout.splitlines()
+    if untracked.returncode == 0:
+        names += untracked.stdout.splitlines()
+    # realpath BOTH sides: `--show-toplevel` is symlink-resolved while
+    # the lint roots may be spelled through a symlink (macOS /tmp,
+    # symlinked CI workspaces) — a prefix mismatch would silently lint
+    # zero files and report the gate green.
+    roots = [os.path.realpath(p) for p in paths]
+    out = []
+    for name in names:
+        if not name.endswith(".py"):
+            continue
+        path = os.path.realpath(os.path.join(toplevel, name))
+        if not os.path.exists(path):
+            continue
+        if any(path == r or path.startswith(r + os.sep) for r in roots):
+            out.append(path)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="flowlint: actor/determinism/key-type static analysis")
@@ -33,6 +91,15 @@ def main(argv=None) -> int:
                     default=[os.path.join(REPO, "foundationdb_tpu")],
                     help="files or directories to lint (default: the "
                          "foundationdb_tpu package)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="incremental mode: lint only .py files in `git "
+                         "diff --name-only REF` (default HEAD) that fall "
+                         "under the given paths; baseline and "
+                         "suppressions behave exactly as in a full scan. "
+                         "Cross-file checks (FTL007 schema drift) only "
+                         "see the changed subset — the tier-1 gate "
+                         "still runs the full scan")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline JSON path, or 'none' to disable "
@@ -57,6 +124,25 @@ def main(argv=None) -> int:
         # Without this, the fallback below would silently overwrite the
         # committed default baseline with whatever was being inspected.
         ap.error("--write-baseline conflicts with --baseline none")
+    if args.write_baseline and args.changed is not None:
+        ap.error("--write-baseline needs a full scan, not --changed "
+                 "(a partial baseline would un-grandfather every "
+                 "unchanged file's findings)")
+    if args.changed is not None:
+        try:
+            args.paths = changed_files(args.paths, args.changed)
+        except RuntimeError as e:
+            print(f"flowlint: {e}", file=sys.stderr)
+            return 2
+        if not args.paths:
+            from foundationdb_tpu.analysis.engine import LintResult
+            empty = LintResult()
+            if args.format == "json":
+                print(json.dumps(empty.to_dict(), indent=2))
+            else:
+                print(format_text(empty) +
+                      f" (no .py changes vs {args.changed})")
+            return 0
     try:
         baseline = load_baseline(baseline_path) if baseline_path else []
         result = Analyzer(make_rules()).run(args.paths, baseline)

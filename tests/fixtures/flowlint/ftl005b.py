@@ -1,0 +1,49 @@
+"""Known-bad fixture: widened FTL005 — set-valuedness tracked through
+the def-use chains (assignments, set-returning helpers, set-annotated
+parameters, set operators), not just syntactic set iteration."""
+# expect: FTL005:15 FTL005:21 FTL005:26 FTL005:33
+
+from typing import Set
+
+
+def _tags_of(txns):
+    return {t.tag for t in txns}
+
+
+def bad_assigned(names):
+    s = set(names)
+    for n in s:                     # BAD: s holds a set
+        use(n)
+
+
+def bad_helper(txns):
+    tags = _tags_of(txns)
+    return [t for t in tags]        # BAD: helper returns a set
+
+
+def bad_param(tags: Set[str]):
+    out = []
+    for t in tags:                  # BAD: set-typed parameter
+        out.append(t)
+    return out
+
+
+def bad_union(names, extras):
+    merged = set(names) | set(extras)
+    for x in merged:                # BAD: union of two sets
+        use(x)
+
+
+def ok_rebound(names):
+    s = set(names)
+    s = sorted(s)
+    for n in s:                     # re-bound to a sorted list: clean
+        use(n)
+
+
+def ok_sorted_wrap(tags: Set[str]):
+    return [t for t in sorted(tags)]    # sorted(): clean
+
+
+def use(x):
+    return x

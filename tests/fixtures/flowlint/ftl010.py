@@ -1,0 +1,68 @@
+"""Known-bad fixture: FTL010 stale shared-state snapshot across await
+(the hazard class Flow's ACTOR compiler rejects at compile time)."""
+# expect: FTL010:23 FTL010:30 FTL010:59
+
+REGISTRY = {}
+
+
+class Backend:
+    def __init__(self):
+        self._device = None
+        self._epoch = 0
+        self.id = "b0"          # only assigned here: immutable binding
+
+    def degrade(self):
+        self._device = None
+        self._epoch += 1
+
+    async def bad_snapshot(self):
+        dev = self._device
+        await wait()
+        # BAD: the await may have degraded/promoted the backend; `dev`
+        # still points at the pre-await device object.
+        return dev.step()
+
+    async def bad_loop(self):
+        dev = self._device
+        while True:
+            await wait()
+            # BAD: every iteration trusts the pre-loop snapshot.
+            x = dev.step()
+            if x:
+                return x
+
+    async def ok_rebound(self):
+        dev = self._device
+        await wait()
+        dev = self._device      # re-bound after the await: clean
+        return dev.step()
+
+    async def ok_declared_state(self):
+        dev = self._device      # flowlint: state
+        await wait()
+        return dev.step()       # declared state (Flow keyword): clean
+
+    async def ok_copy_snapshot(self):
+        epoch = int(self._epoch)
+        await wait()
+        return epoch            # explicit immutable copy: clean
+
+    async def ok_immutable_binding(self):
+        name = self.id
+        await wait()
+        return name             # self.id never reassigned: clean
+
+
+async def bad_module_global():
+    entry = REGISTRY.get("x")
+    await wait()
+    return entry.value          # BAD: REGISTRY is shared module state
+
+
+def sync_reader(backend):
+    dev = backend._device       # not an actor: no await barriers
+    return dev
+
+
+async def wait():
+    return None

@@ -1,0 +1,50 @@
+"""Known-bad fixture: FTL011 await / unbounded wait while holding a
+threading lock (deadlock + event-loop-stall hazard)."""
+# expect: FTL011:16 FTL011:21 FTL011:26
+
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aio = make_async_lock()
+
+    async def bad_await_in_lock(self):
+        with self._lock:
+            # BAD: lock held across the suspension.
+            await step()
+
+    async def bad_result_in_lock(self, fut):
+        with self._lock:
+            # BAD: unbounded block inside the critical section.
+            return fut.result()
+
+    async def bad_acquire_release(self, fut):
+        self._lock.acquire()
+        # BAD: unbounded wait between acquire() and release().
+        x = fut.wait()
+        self._lock.release()
+        return x
+
+    async def ok_timeout(self, fut):
+        with self._lock:
+            return fut.result(timeout=1.0)      # bounded: clean
+
+    async def ok_release_before_await(self):
+        with self._lock:
+            snap = 1
+        await step()                # lock already released: clean
+        return snap
+
+    async def ok_async_lock(self):
+        async with self._aio:
+            await step()            # async lock is reactor-safe: clean
+
+
+def make_async_lock():
+    return None
+
+
+async def step():
+    return None

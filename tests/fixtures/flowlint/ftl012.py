@@ -1,0 +1,46 @@
+"""Known-bad fixture: FTL012 lockset discipline, modeled on the PR-6
+supervisor race — dispatch/fetch-lane bookkeeping (`_needs`,
+`_delta_bound`) corrected under ``self._lock`` on one lane but
+snapshotted lock-free on the other."""
+# expect: FTL012:24 FTL012:26
+
+import threading
+
+
+class RacyBackend:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._needs = {}
+        self._delta_bound = 1
+        self._profile = {"batches": 0}
+
+    def correct_fetch(self, seq, size):
+        with self._lock:
+            self._needs[seq] = size
+            self._delta_bound += size
+
+    def racy_dispatch(self):
+        # BAD: lock-free snapshot of the lock-guarded dict.
+        snap = dict(self._needs)
+        # BAD: lock-free write racing the guarded += above.
+        self._delta_bound = 1
+        return snap
+
+    def unguarded_everywhere(self):
+        # Never written under the lock anywhere: not flagged.
+        self._profile["batches"] += 1
+
+
+class FixedBackend:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._needs = {}
+
+    def fetch(self, seq, size):
+        with self._lock:
+            self._needs[seq] = size
+
+    def dispatch(self):
+        with self._lock:
+            snap = dict(self._needs)    # guarded snapshot: clean
+        return snap

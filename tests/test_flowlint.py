@@ -1,15 +1,19 @@
-"""flowlint (ISSUE 5): rule-engine behavior, one positive fixture per
-rule with exact FTL id + line assertions, suppression/baseline
+"""flowlint (ISSUES 5 + 9): rule-engine behavior, one positive fixture
+per rule with exact FTL id + line assertions, suppression/baseline
 round-trips, the clean-repo gate (tier-1's static-analysis entry, the
-way test_metrics.py runs check_trace_events), and cross-process unseed
+way test_metrics.py runs check_trace_events), the ISSUE-9 dataflow
+layer (CFG/def-use/lockset unit battery + FTL010/011/012 + widened
+FTL005), --changed incremental mode, and cross-process unseed
 reproduction with PYTHONHASHSEED pinned (the ROADMAP chaos follow-up,
 driven by the HashOrderCanary workload)."""
 
+import ast
 import json
 import os
 import re
 import subprocess
 import sys
+import textwrap
 
 import pytest
 
@@ -17,11 +21,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "flowlint")
 FLOWLINT = os.path.join(REPO, "scripts", "flowlint.py")
 
-from foundationdb_tpu.analysis.engine import (Analyzer, load_baseline,
+from foundationdb_tpu.analysis.dataflow import FunctionDataflow
+from foundationdb_tpu.analysis.engine import (Analyzer, is_actor,
+                                              load_baseline,
                                               write_baseline)
 from foundationdb_tpu.analysis.rules import make_rules
 
 EXPECT = re.compile(r"(FTL\d{3}):(\d+)")
+
+N_RULES = 12    # FTL001..FTL012 (FTL000 = unparseable-file pseudo-rule)
 
 
 def _scan(roots, baseline=None):
@@ -53,9 +61,9 @@ def _expected_fixture_findings():
 
 def test_fixture_findings_exact():
     expected = _expected_fixture_findings()
-    assert len(expected) >= 9, "fixture markers went missing"
+    assert len(expected) >= N_RULES, "fixture markers went missing"
     # Every rule id is represented by at least one fixture expectation.
-    assert {f"FTL{i:03d}" for i in range(1, 10)} <= \
+    assert {f"FTL{i:03d}" for i in range(1, N_RULES + 1)} <= \
         {rule for rule, _, _ in expected}
     result = _scan([FIXTURES])
     got = {(f.rule, f.path, f.line) for f in result.new}
@@ -246,8 +254,446 @@ def test_cli_list_rules():
     out = subprocess.run([sys.executable, FLOWLINT, "--list-rules"],
                          capture_output=True, text=True)
     assert out.returncode == 0
-    for i in range(1, 10):
+    for i in range(1, N_RULES + 1):
         assert f"FTL{i:03d}" in out.stdout
+
+
+def test_list_rules_matches_readme_table():
+    """No rule-list drift (ISSUE 9): the shipped rule set and README's
+    rule table must name exactly the same FTL ids — a rule added
+    without a doc row (or vice versa) fails tier-1."""
+    out = subprocess.run([sys.executable, FLOWLINT, "--list-rules"],
+                         capture_output=True, text=True)
+    cli_ids = set(re.findall(r"FTL\d{3}", out.stdout))
+    with open(os.path.join(REPO, "README.md")) as f:
+        readme_ids = set(re.findall(r"^\| (FTL\d{3}) ", f.read(), re.M))
+    assert readme_ids == cli_ids, (
+        f"README table vs --list-rules drift: only in README "
+        f"{sorted(readme_ids - cli_ids)}, only in CLI "
+        f"{sorted(cli_ids - readme_ids)}")
+
+
+# ---------------------------------------------------------------------------
+# Dataflow layer (ISSUE 9): CFG / def-use / lockset unit battery
+# ---------------------------------------------------------------------------
+
+def _cfg(src: str, name=None) -> FunctionDataflow:
+    tree = ast.parse(textwrap.dedent(src))
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                (name is None or n.name == name):
+            return FunctionDataflow(n)
+    raise AssertionError("no function in snippet")
+
+
+def _load(cfg: FunctionDataflow, name: str, nth: int = 0):
+    loads = [(ld, nd) for ld, nd in cfg.loads if ld.id == name]
+    return loads[nth]
+
+
+def _reach_lines(cfg, name, nth=0):
+    ld, nd = _load(cfg, name, nth)
+    return sorted((d.lineno, crossed)
+                  for d, crossed in cfg.reaching(nd, name))
+
+
+def test_cfg_branch_join_merges_both_defs():
+    cfg = _cfg("""\
+        async def f(c):
+            x = 1
+            if c:
+                x = 2
+            use(x)
+        """)
+    assert _reach_lines(cfg, "x") == [(2, False), (4, False)]
+
+
+def test_cfg_branch_else_kills_one_path():
+    cfg = _cfg("""\
+        async def f(c):
+            if c:
+                x = 1
+            else:
+                x = 2
+            use(x)
+        """)
+    assert _reach_lines(cfg, "x") == [(3, False), (5, False)]
+
+
+def test_cfg_rebind_after_await_kills_stale_fact():
+    cfg = _cfg("""\
+        async def f(self):
+            x = self.a
+            await g()
+            x = self.b
+            use(x)
+        """)
+    assert _reach_lines(cfg, "x") == [(4, False)]
+
+
+def test_cfg_await_marks_facts_crossed():
+    cfg = _cfg("""\
+        async def f(self):
+            x = self.a
+            await g()
+            use(x)
+        """)
+    assert _reach_lines(cfg, "x") == [(2, True)]
+
+
+def test_cfg_await_result_def_is_not_crossed():
+    # `x = await g()` defines x AFTER the suspension: fresh, not stale.
+    cfg = _cfg("""\
+        async def f(self):
+            x = await g()
+            use(x)
+        """)
+    assert _reach_lines(cfg, "x") == [(2, False)]
+
+
+def test_cfg_loop_back_edge_carries_crossed_fact():
+    cfg = _cfg("""\
+        async def f(items):
+            x = make()
+            for i in items:
+                use(x)
+                await g()
+        """)
+    # First iteration sees the pre-loop def uncrossed; every later one
+    # sees it through the await barrier on the back edge.
+    assert _reach_lines(cfg, "x") == [(2, False), (2, True)]
+
+
+def test_cfg_try_except_handler_sees_body_defs():
+    cfg = _cfg("""\
+        def f():
+            try:
+                x = 1
+                risky()
+            except ValueError:
+                x = 2
+            return x
+        """)
+    assert _reach_lines(cfg, "x") == [(3, False), (6, False)]
+
+
+def test_cfg_finally_reachable_through_return():
+    # The regression the transport sweep hit: `try: return ... finally:`
+    # must leave the finalbody REACHABLE, with its own with-lock region
+    # intact — otherwise FTL012 sees an empty lockset there.
+    cfg = _cfg("""\
+        async def f(self, fut):
+            try:
+                with self._lock:
+                    return fut.result(timeout=1)
+            finally:
+                with self._lock:
+                    cleanup(self)
+        """)
+    cleanup_calls = [
+        (c, nd) for c, nd in cfg.calls
+        if isinstance(c.func, ast.Name) and c.func.id == "cleanup"]
+    assert len(cleanup_calls) == 1
+    _, nd = cleanup_calls[0]
+    assert cfg.lockset(nd) == frozenset({"self._lock"})
+
+
+def test_cfg_nested_function_excluded_from_parent():
+    cfg = _cfg("""\
+        async def outer(self):
+            x = self.a
+
+            def inner():
+                return x
+
+            await g()
+            return 1
+        """, name="outer")
+    # inner's read of x is NOT a load of the outer CFG...
+    assert [ld.id for ld, _ in cfg.loads if ld.id == "x"] == []
+    # ... and inner gets its own dataflow with no defs of x.
+    inner = _cfg("""\
+        async def outer(self):
+            x = self.a
+
+            def inner():
+                return x
+
+            await g()
+            return 1
+        """, name="inner")
+    ld, nd = _load(inner, "x")
+    assert inner.reaching(nd, "x") == []
+
+
+def test_cfg_lockset_with_region_and_release():
+    cfg = _cfg("""\
+        async def f(self, fut):
+            with self._lock:
+                a = fut.wait()
+            b = fut.wait()
+        """)
+    (_, nd_a), (_, nd_b) = cfg.calls[0], cfg.calls[1]
+    assert cfg.lockset(nd_a) == frozenset({"self._lock"})
+    assert cfg.lockset(nd_b) == frozenset()
+
+
+def test_cfg_lockset_acquire_release_pair():
+    cfg = _cfg("""\
+        async def f(self, fut):
+            self._lock.acquire()
+            a = fut.wait()
+            self._lock.release()
+            b = fut.wait()
+        """)
+    waits = [(c, nd) for c, nd in cfg.calls
+             if isinstance(c.func, ast.Attribute) and c.func.attr == "wait"]
+    assert cfg.lockset(waits[0][1]) == frozenset({"self._lock"})
+    assert cfg.lockset(waits[1][1]) == frozenset()
+
+
+def test_cfg_conditional_acquire_is_not_held():
+    # acquire(timeout=...) / acquire(blocking=False) can FAIL: a MUST
+    # analysis never counts it as held (review catch — the unsound
+    # direction for FTL012).
+    cfg = _cfg("""\
+        async def f(self, fut):
+            self._lock.acquire(timeout=0.1)
+            x = fut.result()
+        """)
+    assert cfg.acquired_locks == set()
+    assert cfg.lockset(cfg.calls[-1][1]) == frozenset()
+
+
+def test_cfg_lockset_meet_is_intersection():
+    # Held on only ONE path into the node => not held (MUST analysis).
+    cfg = _cfg("""\
+        async def f(self, c, fut):
+            if c:
+                self._lock.acquire()
+            x = fut.result()
+        """)
+    results = [(c, nd) for c, nd in cfg.calls
+               if isinstance(c.func, ast.Attribute)
+               and c.func.attr == "result"]
+    assert cfg.lockset(results[0][1]) == frozenset()
+
+
+def test_cfg_async_with_is_barrier_not_lock():
+    cfg = _cfg("""\
+        async def f(self):
+            async with self._aio_lock:
+                await g()
+        """)
+    assert cfg.acquired_locks == set()
+    (_, nd) = cfg.awaits[0]
+    assert cfg.lockset(nd) == frozenset()
+
+
+def test_ftl005_same_named_helper_is_ambiguous(tmp_path):
+    """A set-returning helper NAME shared with a non-set function in
+    the same file is ambiguous at a callsite and must not taint it
+    (review catch — the FTL002 same-name rule applied to FTL005)."""
+    (tmp_path / "a.py").write_text(textwrap.dedent("""\
+        class A:
+            def make(self):
+                return {"x", "y"}
+
+        class B:
+            def make(self):
+                return ["x", "y"]
+
+            def walk(self):
+                s = self.make()
+                return [i for i in s]
+        """))
+    result = _scan([str(tmp_path)])
+    assert result.new == [], [f.message for f in result.new]
+
+
+def test_ftl010_mutable_attrs_are_class_scoped(tmp_path):
+    """An attribute name mutated in ONE class must not taint the
+    same-named init-frozen attribute of another class in the file
+    (review catch — the FTL009 scope lesson)."""
+    (tmp_path / "a.py").write_text(textwrap.dedent("""\
+        class Churner:
+            def churn(self):
+                self.cache = {}
+
+            async def bad(self):
+                c = self.cache
+                await g()
+                return c
+
+        class Frozen:
+            def __init__(self):
+                self.cache = {}
+
+            async def ok(self):
+                c = self.cache
+                await g()
+                return c
+        """))
+    result = _scan([str(tmp_path)])
+    assert [(f.rule, f.line) for f in result.new] == [("FTL010", 8)]
+
+
+def test_ftl010_comprehension_copy_exempt_generator_flagged(tmp_path):
+    """An eager comprehension is a copy snapshot (same policy as
+    set()/list() calls); a GENERATOR expression reads the shared state
+    lazily — after the await — and stays flagged (review catch)."""
+    (tmp_path / "a.py").write_text(textwrap.dedent("""\
+        class C:
+            def churn(self):
+                self.healthy = {}
+
+            async def ok_comp(self):
+                pool = {t for t in self.healthy}
+                await g()
+                return pool
+
+            async def bad_genexp(self):
+                pool = (t for t in self.healthy)
+                await g()
+                return list(pool)
+        """))
+    result = _scan([str(tmp_path)])
+    assert [(f.rule, f.line) for f in result.new] == [("FTL010", 13)]
+
+
+def test_ftl010_tuple_unpack_targets_count_as_mutable(tmp_path):
+    """Tuple-unpack and chained self-attribute assignments make their
+    attrs mutable for FTL010's prescan (review catch: a bare
+    `for t in targets: ... break` missed both forms)."""
+    (tmp_path / "a.py").write_text(textwrap.dedent("""\
+        class C:
+            def swap(self):
+                self.alpha, self.beta = self.beta, self.alpha
+
+            def chain(self, v):
+                self.gamma = self.delta = v
+
+            async def snap(self):
+                a = self.alpha
+                d = self.delta
+                await g()
+                return a, d
+        """))
+    result = _scan([str(tmp_path)])
+    assert sorted({f.rule for f in result.new}) == ["FTL010"]
+    assert len(result.new) == 2, [f.message for f in result.new]
+
+
+def test_is_actor_helper():
+    tree = ast.parse(
+        "async def a():\n    pass\n"
+        "def s():\n    pass\n"
+        "f = lambda: 0\n")
+    async_fn, sync_fn, lam_assign = tree.body
+    assert is_actor(async_fn)
+    assert not is_actor(sync_fn)
+    assert not is_actor(lam_assign.value)
+    assert not is_actor(tree)
+
+
+# ---------------------------------------------------------------------------
+# --changed incremental mode
+# ---------------------------------------------------------------------------
+
+def _git(repo, *args):
+    out = subprocess.run(["git", "-C", str(repo), "-c", "user.name=t",
+                          "-c", "user.email=t@t"] + list(args),
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    return out
+
+
+def test_cli_changed_mode(tmp_path):
+    """--changed lints exactly the files `git diff` names, with full
+    baseline/suppression semantics, and exits clean when nothing
+    changed."""
+    repo = tmp_path / "r"
+    pkg = repo / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "clean.py").write_text("x = 1\n")
+    dirty = pkg / "dirty.py"
+    dirty.write_text("y = 1\n")
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+
+    # Nothing changed: clean exit, zero files scanned.
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--changed", "HEAD", "--baseline",
+         "none", str(pkg)], capture_output=True, text=True)
+    assert out.returncode == 0 and "0 file(s) scanned" in out.stdout
+
+    # A violation in one changed file: only that file is linted.
+    dirty.write_text("import time\nt = time.monotonic()\n")
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--changed", "HEAD", "--baseline",
+         "none", str(pkg)], capture_output=True, text=True)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "dirty.py" in out.stdout and "FTL001" in out.stdout
+    assert "1 file(s) scanned" in out.stdout
+
+    # Suppression semantics identical to a full scan.
+    dirty.write_text(
+        "import time\n"
+        "t = time.monotonic()  # flowlint: disable=FTL001 -- test\n")
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--changed", "HEAD", "--baseline",
+         "none", str(pkg)], capture_output=True, text=True)
+    assert out.returncode == 0 and "1 suppressed" in out.stdout
+
+    # UNTRACKED files are included too: a brand-new module is the one
+    # most likely to carry new findings, and `git diff` never lists it.
+    (pkg / "fresh.py").write_text("import time\nt = time.time()\n")
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--changed", "HEAD", "--baseline",
+         "none", str(pkg)], capture_output=True, text=True)
+    assert out.returncode == 1 and "fresh.py" in out.stdout
+
+    # ... under EVERY lint root, not just the first (ls-files --others
+    # runs from the repo toplevel, unlike the cwd-scoped default).
+    pkg2 = repo / "pkg2"
+    pkg2.mkdir()
+    (pkg2 / "other.py").write_text("import time\nt2 = time.time()\n")
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--changed", "HEAD", "--baseline",
+         "none", str(pkg), str(pkg2)], capture_output=True, text=True)
+    assert out.returncode == 1 and "other.py" in out.stdout
+
+
+def test_cli_changed_through_symlinked_root(tmp_path):
+    """A checkout reached through a symlink (macOS /tmp, symlinked CI
+    workspaces) must not silently lint zero files: git's resolved
+    toplevel and the symlink-spelled lint root are realpath'd to the
+    same prefix (review catch)."""
+    real = tmp_path / "real"
+    (real / "pkg").mkdir(parents=True)
+    (real / "pkg" / "mod.py").write_text("x = 1\n")
+    _git(real, "init", "-q")
+    _git(real, "add", "-A")
+    _git(real, "commit", "-qm", "seed")
+    link = tmp_path / "link"
+    os.symlink(real, link)
+    (link / "pkg" / "mod.py").write_text(
+        "import time\nt = time.monotonic()\n")
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--changed", "HEAD", "--baseline",
+         "none", str(link / "pkg")], capture_output=True, text=True)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "1 file(s) scanned" in out.stdout
+
+
+def test_cli_changed_conflicts_with_write_baseline(tmp_path):
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--changed", "--write-baseline",
+         str(tmp_path)], capture_output=True, text=True)
+    assert out.returncode == 2
+    assert "full scan" in out.stderr
 
 
 # ---------------------------------------------------------------------------
