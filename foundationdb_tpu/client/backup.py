@@ -584,10 +584,17 @@ async def restore_distributed(cluster, db, fs, name: str = "backup",
         "Ranges", RESTORE_RANGES).log()
 
 
-async def restore(db, fs, name: str = "backup") -> int:
+async def restore(db, fs, name: str = "backup", prefix: bytes = b"") -> int:
     """Restore a container into an (empty) cluster: snapshot state, then
     log replay for versions after the snapshot (reference FileBackupAgent
-    restore tasks).  Returns the number of restored mutations."""
+    restore tasks).  Returns the number of restored mutations.
+
+    With `prefix` the whole restored keyspace is SHIFTED under it
+    (reference fdbrestore -k/--add-prefix): key k lands at prefix+k,
+    clear ranges shift both bounds.  A live cluster can then host the
+    restored image next to its current data — how BackupAndRestore
+    chaos runs consistency-check restored-vs-live without a second
+    cluster."""
     container = BackupContainer(fs, name)
     _start, snapshot_version, end_version = await container.read_meta()
     sv, kvs = await container.read_snapshot()
@@ -598,7 +605,7 @@ async def restore(db, fs, name: str = "backup") -> int:
         while True:
             try:
                 for k, v in kvs[i:i + 500]:
-                    t.set(k, v)
+                    t.set(prefix + k, v)
                 await t.commit()
                 applied += min(500, len(kvs) - i)
                 break
@@ -607,8 +614,11 @@ async def restore(db, fs, name: str = "backup") -> int:
     # Log replay in version order, preserving intra-version mutation
     # order.  Each record's transaction also writes a progress marker so a
     # commit_unknown_result can be disambiguated instead of re-applying
-    # (atomic ops are not idempotent).
-    progress_key = b"\xff/restoreProgress/" + name.encode()
+    # (atomic ops are not idempotent).  Prefix-shifted restores use a
+    # DISTINCT marker key: a same-container unshifted restore must not
+    # share progress with a shifted one.
+    progress_key = (b"\xff/restoreProgress/" + name.encode() +
+                    (b"/" + prefix if prefix else b""))
     for idx, (version, muts) in enumerate(await container.read_log()):
         if not sv < version <= end_version:
             continue
@@ -628,11 +638,11 @@ async def restore(db, fs, name: str = "backup") -> int:
                 t.set(progress_key, marker)
                 for m in muts:
                     if m.type == MutationType.SetValue:
-                        t.set(m.param1, m.param2)
+                        t.set(prefix + m.param1, m.param2)
                     elif m.type == MutationType.ClearRange:
-                        t.clear(m.param1, m.param2)
+                        t.clear(prefix + m.param1, prefix + m.param2)
                     else:
-                        t.atomic_op(m.type, m.param1, m.param2)
+                        t.atomic_op(m.type, prefix + m.param1, m.param2)
                 await t.commit()
                 applied += len(muts)
                 break

@@ -80,5 +80,15 @@ for _name in (
     # killed; recovery must recruit a fresh plane with verdict
     # continuity (Cycle + ConsistencyCheck run alongside).
     "ChaosNemesisResolverKill",
+    # Disaster-recovery nemesis battery (ISSUE 10): undrained region
+    # failover (primary dc hard-killed mid-traffic, remote plane adopted
+    # at min(end_version)), rolling coordinator restart (re-election +
+    # CoordinationClientInterface re-pointing), fatal disk fault with
+    # worker restart (the topology heals instead of shrinking), and a
+    # backup captured + restored while the nemesis runs.
+    "ChaosRegionFailover",
+    "ChaosCoordinatorRestart",
+    "ChaosFatalDiskRestart",
+    "BackupRestoreUnderChaos",
 ):
     register(_name)

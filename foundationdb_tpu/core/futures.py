@@ -182,7 +182,16 @@ class PromiseStream(Generic[T]):
             return
         while self._waiters:
             w = self._waiters.popleft()
-            if not w.is_ready():
+            # Deliver only to a waiter some actor is actually awaiting
+            # (it has a resume callback).  A pending-but-callback-less
+            # waiter is ABANDONED: its consumer was cancelled after
+            # pop() (ActorTask.cancel detaches the callback) — e.g. a
+            # deposed cluster controller's stream servers.  Delivering
+            # into it would swallow exactly one message per cancelled
+            # consumer; the re-run consumer then waits forever for a
+            # request whose sender waits forever for a reply (observed
+            # as a wedged recovery after CC re-election, ISSUE 10).
+            if not w.is_ready() and w._callbacks:
                 w._send(value)
                 return
         self._queue.append(value)
@@ -200,7 +209,15 @@ class PromiseStream(Generic[T]):
         self.send_error(END_OF_STREAM)
 
     def pop(self) -> Future:
-        """Future of the next stream value."""
+        """Future of the next stream value.
+
+        Await the returned future DIRECTLY (or via the async-for
+        protocol).  Do not hold it across a combinator (e.g.
+        `wait_any([pop_f, delay(t)])` and re-await after the timeout):
+        send() treats a pending waiter with no attached consumer
+        callback as abandoned-by-cancellation and drops it — the value
+        is preserved for the NEXT pop(), but a dropped future re-awaited
+        later never resolves."""
         f: Future = Future()
         if self._queue:
             f._send(self._queue.popleft())
@@ -283,11 +300,20 @@ class AsyncTrigger:
         return self._inner.on_change()
 
 
+_current_task: "Optional[ActorTask]" = None
+
+
+def current_task() -> "Optional[ActorTask]":
+    """The ActorTask whose coroutine body is executing right now (None
+    between actor steps / in harness code)."""
+    return _current_task
+
+
 class ActorTask:
     """Drives one actor coroutine on the event loop (our ACTOR equivalent)."""
 
     __slots__ = ("coro", "future", "_loop", "_cancelled", "_waiting_on",
-                 "_resume_cb", "name", "_finished", "_started")
+                 "_resume_cb", "name", "_finished", "_started", "process")
 
     def __init__(self, coro, loop, name: str = "") -> None:
         assert inspect.iscoroutine(coro), f"spawn() needs a coroutine, got {coro!r}"
@@ -301,6 +327,12 @@ class ActorTask:
         self._waiting_on: Optional[Future] = None
         self._resume_cb: Optional[Callable] = None
         self.name = name or getattr(coro, "__name__", "actor")
+        # The simulated process this actor runs "on" (set by
+        # SimProcess.spawn; inherited by transitively spawned actors) —
+        # the network's ambient SOURCE address.  None for harness/client
+        # actors that live outside the simulated machine set.
+        self.process = current_task().process \
+            if current_task() is not None else None
 
     def _initial_step(self) -> None:
         if self._cancelled or self._finished:
@@ -317,31 +349,42 @@ class ActorTask:
 
         Also drives post-cancellation cleanup: if the coroutine awaits during
         unwind (e.g. in a finally block) we keep re-hooking until it finishes."""
+        global _current_task
         if self._finished:
             return
         self._waiting_on = None
+        # Ambient actor context while the coroutine body runs: spawned
+        # sub-actors inherit this task's process, and the sim network
+        # reads it as the SOURCE address of outgoing requests (without
+        # it every RPC looked like destination self-traffic and
+        # clogs/partitions never applied to request delivery).
+        prev_task, _current_task = _current_task, self
         try:
-            if throw_exc is not None:
-                awaited = self.coro.throw(throw_exc)
-            else:
-                awaited = self.coro.send(send_value)
-        except StopIteration as stop:
-            self._finish_value(stop.value)
-            return
-        except ActorCancelled as e:
-            # Drop the traceback NOW: it pins the whole unwound frame chain
-            # (and those frames' locals — e.g. held reply promises) until
-            # cyclic GC happens to run, making broken_promise delivery
-            # wall-clock dependent.  Clearing it restores the reference
-            # semantics of Flow's SAV destruction: refcounts free the
-            # frames immediately and their promises break deterministically.
-            e.__traceback__ = None
-            del e
-            self._finish_cancel()
-            return
-        except BaseException as e:  # noqa: BLE001 - actor errors propagate via future
-            self._finish_error(e)
-            return
+            try:
+                if throw_exc is not None:
+                    awaited = self.coro.throw(throw_exc)
+                else:
+                    awaited = self.coro.send(send_value)
+            except StopIteration as stop:
+                self._finish_value(stop.value)
+                return
+            except ActorCancelled as e:
+                # Drop the traceback NOW: it pins the whole unwound frame
+                # chain (and those frames' locals — e.g. held reply
+                # promises) until cyclic GC happens to run, making
+                # broken_promise delivery wall-clock dependent.  Clearing
+                # it restores the reference semantics of Flow's SAV
+                # destruction: refcounts free the frames immediately and
+                # their promises break deterministically.
+                e.__traceback__ = None
+                del e
+                self._finish_cancel()
+                return
+            except BaseException as e:  # noqa: BLE001 - actor errors propagate via future
+                self._finish_error(e)
+                return
+        finally:
+            _current_task = prev_task
 
         if not isinstance(awaited, Future):
             self._finish_error(err("internal_error",

@@ -279,6 +279,22 @@ class ServerKnobs(KnobBase):
         self.UPDATE_STORAGE_BYTE_LIMIT = 1e6
         self.MAX_COMMIT_UPDATES = 2000
 
+        # Disaster-recovery polling (backup_worker.py _url_watch, the
+        # KillRegion/regionFailover plane + drain waits): base interval,
+        # doubling after each no-progress poll up to the cap (the PR-4
+        # GRV-starter lesson applied to the DR surface — a converged
+        # plane must not be re-polled at the hot interval forever, and
+        # chaos-suite dispatch volume is bounded by the cap).
+        self.DR_POLL_INTERVAL_S = 0.5
+        self.DR_POLL_MAX_INTERVAL_S = 4.0
+
+        # Coordination candidacy lease (coordination.py _expiry_loop): a
+        # candidate that neither heartbeats (confirmed leader) nor
+        # re-sends a candidacy within this window is evicted from the
+        # register — the only way a coordinator can tell a dead
+        # candidate's parked long-poll from a live one.
+        self.COORD_CANDIDACY_LEASE_S = 3.0
+
         self._rand("COMMIT_TRANSACTION_BATCH_INTERVAL_MAX",
                    lambda r: r.random01() * 0.1 + 0.001)
         self._rand("RESOLVER_STATE_MEMORY_LIMIT", lambda r: 3e6)

@@ -370,3 +370,40 @@ def yield_now(priority: TaskPriority = TaskPriority.DefaultYield) -> Future:
 
 def spawn(coro, name: str = "") -> Future:
     return get_event_loop().spawn(coro, name)
+
+
+class PollBackoff:
+    """Adaptive poll interval for wait-until-condition loops: starts at
+    `base`, doubles after every empty (no-progress) poll up to `cap`, and
+    resets to `base` on progress.  The DR surface's shared pacing
+    (knobs DR_POLL_INTERVAL_S / DR_POLL_MAX_INTERVAL_S): a converged
+    plane is re-checked at the cap, not the hot interval, bounding the
+    dispatch volume a long wait adds to a chaos run — the same fix the
+    GRV transaction starter got for its starved-queue polling.
+
+        pb = PollBackoff(knobs.DR_POLL_INTERVAL_S,
+                         knobs.DR_POLL_MAX_INTERVAL_S)
+        while not condition():
+            await delay(pb.next())
+        ...
+        pb.reset()          # on observed progress
+    """
+
+    __slots__ = ("base", "cap", "_cur", "polls")
+
+    def __init__(self, base: float, cap: Optional[float] = None) -> None:
+        self.base = float(base)
+        self.cap = float(cap if cap is not None else base)
+        self._cur = self.base
+        self.polls = 0          # empty polls so far (observability/tests)
+
+    def next(self) -> float:
+        """The interval to sleep before the next poll; doubles the one
+        after it (call reset() when a poll observes progress)."""
+        cur = self._cur
+        self._cur = min(self._cur * 2.0, self.cap)
+        self.polls += 1
+        return cur
+
+    def reset(self) -> None:
+        self._cur = self.base

@@ -166,6 +166,25 @@ class SimNetwork:
         p = self.processes.get(address)
         return p is not None and p.alive and p.epoch == epoch
 
+    @staticmethod
+    def _ambient_src_ip(ep: Endpoint) -> str:
+        """The sender's ip when the caller didn't say: the address of
+        the simulated process whose actor is executing right now
+        (ActorTask.process, inherited through spawns).  Without this,
+        src defaulted to the DESTINATION ip and the src==dst self-traffic
+        exemption silently bypassed every clog and partition for request
+        delivery — the whole network fault plane was cosmetic (found by
+        the regionFailover forced-replication-lag scenario, ISSUE 10).
+        Harness/client actors have no process: they send from a sentinel
+        outside the machine set, so interface clogs on the TARGET still
+        apply while pair faults never match."""
+        from ..core.futures import current_task
+        t = current_task()
+        p = t.process if t is not None else None
+        if p is not None:
+            return p.address.ip
+        return "0.0.0.0"
+
     def send_request(self, ep: Endpoint, request: Any,
                      priority: TaskPriority = TaskPriority.DefaultEndpoint,
                      from_address: Optional[NetworkAddress] = None) -> Future:
@@ -173,7 +192,8 @@ class SimNetwork:
         loop = get_event_loop()
         self.messages_sent += 1
         reply_promise: Promise = Promise()
-        src_ip = from_address.ip if from_address else ep.address.ip
+        src_ip = from_address.ip if from_address \
+            else self._ambient_src_ip(ep)
         when = self._delivery_time(src_ip, ep.address.ip)
 
         def fail() -> None:
@@ -231,7 +251,8 @@ class SimNetwork:
                      from_address: Optional[NetworkAddress] = None) -> None:
         """Fire-and-forget delivery (reference sendUnreliable)."""
         self.messages_sent += 1
-        src_ip = from_address.ip if from_address else ep.address.ip
+        src_ip = from_address.ip if from_address \
+            else self._ambient_src_ip(ep)
         when = self._delivery_time(src_ip, ep.address.ip)
         if when is None:
             return

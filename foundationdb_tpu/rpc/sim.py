@@ -50,6 +50,11 @@ class SimProcess:
     def spawn(self, coro, name: str = "") -> Future:
         """Start an actor owned by this process; cancelled on kill/reboot."""
         f = get_event_loop().spawn(coro, name or f"{self.name}:actor")
+        # Pin the actor (and, via inheritance, everything it spawns) to
+        # this process: the sim network reads it as the source address
+        # of outgoing requests, so clogs/partitions apply for real.
+        if f._source_task is not None:
+            f._source_task.process = self
         self._actors.append(f)
         self._actors = [a for a in self._actors if not a.is_ready()]
         return f
@@ -112,9 +117,21 @@ class Simulator:
     # -- topology -----------------------------------------------------------
     def new_process(self, machineid: str = "", dcid: str = "dc0",
                     process_class: str = "unset", name: str = "",
-                    zoneid: str = "") -> SimProcess:
-        ip = f"10.0.{self._next_ip >> 8}.{self._next_ip & 0xff}"
-        self._next_ip += 1
+                    zoneid: str = "",
+                    address: Optional[NetworkAddress] = None) -> SimProcess:
+        """With `address`, the new process REUSES a dead process's
+        network address (a restarted server comes back on the same
+        host:port, so well-known-token endpoints — coordinators — stay
+        valid); the previous holder must be dead."""
+        if address is not None:
+            old = self.processes.get(address)
+            if old is not None and old.alive:
+                raise RuntimeError(
+                    f"address {address} still held by live {old.name}")
+            ip = address.ip
+        else:
+            ip = f"10.0.{self._next_ip >> 8}.{self._next_ip & 0xff}"
+            self._next_ip += 1
         machineid = machineid or f"m{ip}"
         mach = self.machines.get(machineid)
         if mach is None:
@@ -179,6 +196,21 @@ class Simulator:
         """Whole-cluster power loss (the restarting-test scenario)."""
         for machineid in list(self.machines):
             self.power_fail_machine(machineid)
+
+    def wipe_machine(self, machineid: str) -> None:
+        """Destroy a machine's durable files entirely (re-provisioning a
+        replacement box after a dc loss): every process on it must be
+        dead first.  Distinct from power_fail_machine, which only drops
+        UN-SYNCED writes — a failed-back region must not resurrect its
+        pre-failover storage engines as same-tag impostors."""
+        m = self.machines[machineid]
+        for p in m.processes:
+            if p.alive:
+                self.kill_process(p)
+        m.fs.files.clear()
+        m.fs.clear_fault_profiles()
+        TraceEvent("SimWipeMachine", Severity.Warn).detail(
+            "Machine", machineid).log()
 
     def kill_zone(self, zoneid: str) -> None:
         """Kill every process in a failure zone (reference killZone,

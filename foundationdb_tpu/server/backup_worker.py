@@ -43,12 +43,20 @@ class BackupWorker:
         backup: exactly one worker may consume (and pop) BACKUP_TAG, and
         the master's nudge handler recruits the successor — the OLD
         worker must notice and stop rather than split the stream between
-        two containers."""
+        two containers.  Paced by the shared DR poll knob with
+        backoff-after-empty (an unchanged URL is the steady state; the
+        poll converges to the cap instead of re-reading at the hot
+        interval all epoch)."""
+        from ..core.knobs import server_knobs
+        from ..core.scheduler import PollBackoff
         from ..server.system_data import BACKUP_CONTAINER_KEY
         if self.db is None:
             return
+        knobs = server_knobs()
+        pb = PollBackoff(knobs.DR_POLL_INTERVAL_S,
+                         knobs.DR_POLL_MAX_INTERVAL_S)
         while not self.stopped:
-            await delay(3.0)
+            await delay(pb.next())
             try:
                 t = self.db.create_transaction()
                 t.access_system_keys = True

@@ -274,8 +274,11 @@ class SimFdbCluster:
 
     def _handle_reboot(self, p) -> None:
         """sim.on_reboot hook: a reboot_process'd WORKER re-runs its role
-        stack on the same epoch-bumped process (coordinators are not
-        reboot targets — restart them with power_fail_reboot)."""
+        stack on the same epoch-bumped process; a reboot_process'd
+        COORDINATOR re-runs its coordination server (recovering its
+        generation registers from the machine's files) on the same
+        address, so the well-known-token endpoints every
+        CoordinationClientInterface holds keep routing to it."""
         for idx, entry in enumerate(self.workers):
             if entry[0] is p:
                 from ..core.trace import TraceEvent
@@ -287,6 +290,54 @@ class SimFdbCluster:
                 TraceEvent("SimWorkerRebooted").detail(
                     "Worker", p.name).detail("Epoch", p.epoch).log()
                 return
+        for idx, (cp, _server) in enumerate(self.coordinators):
+            if cp is p:
+                self._respawn_coordinator(idx, p)
+                return
+
+    def _respawn_coordinator(self, idx: int, p) -> None:
+        """(Re)start coordinator `idx`'s CoordinationServer on process
+        `p`: a fresh server object whose durable engine recovers the
+        generation registers from the machine's surviving files.
+        Clients are NOT re-pointed by hand — the coordination endpoints
+        are well-known tokens at the coordinator's (stable) address, so
+        every existing CoordinationClientInterface resolves to the new
+        server the moment it registers."""
+        from ..core.trace import TraceEvent
+        from .coordination import (CoordinationClientInterface,
+                                   CoordinationServer)
+        server = CoordinationServer(p.name, fs=self.sim.fs_for(p))
+        server.run(p)
+        self.coordinators[idx] = (p, server)
+        # Refresh the shared client-interface list IN PLACE (workers, CCs
+        # and open databases all hold this exact list object); the new
+        # entry's endpoints are value-equal to the old ones.
+        self.coordinator_clients[idx] = CoordinationClientInterface(server)
+        TraceEvent("SimCoordinatorRestarted").detail(
+            "Coordinator", p.name).detail("Epoch", p.epoch).log()
+
+    def restart_coordinator(self, i: int, hard: bool = False):
+        """Restart coordination server `i` (the coordinatorAttrition
+        nemesis action + ISSUE 10's re-pointing test surface).  A live
+        coordinator gets a clean rolling reboot (same process,
+        epoch-bumped); `hard` kills the process first and boots a
+        REPLACEMENT process on the same machine AND the same network
+        address — either way the durable generation registers are
+        recovered and every client's CoordinationClientInterface keeps
+        working through the well-known-token endpoints."""
+        p_old, _server = self.coordinators[i]
+        if p_old.alive and not hard:
+            self.sim.reboot_process(p_old)   # roles respawn via the hook
+            return p_old
+        if p_old.alive:
+            self.sim.kill_process(p_old)
+        p = self.sim.new_process(name=p_old.name,
+                                 machineid=p_old.locality.machineid,
+                                 process_class="coordinator",
+                                 dcid=p_old.locality.dcid,
+                                 address=p_old.address)
+        self._respawn_coordinator(i, p)
+        return p
 
     def restart_worker(self, i: int):
         """Bring worker `i` back after a kill or machine power-fail: a

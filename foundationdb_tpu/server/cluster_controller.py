@@ -13,7 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..core.error import FdbError
+from ..core.error import FdbError, err
 from ..core.futures import AsyncVar, Promise
 from ..core.scheduler import delay, spawn
 from ..core.trace import Severity, TraceEvent
@@ -127,24 +127,43 @@ class ClusterController:
             self._spawn(self._handle_get_db_info(req), f"{self.id}.getDbInfo")
 
     async def _handle_get_db_info(self, req: GetServerDBInfoRequest) -> None:
-        while req.known_version >= self.db_info_version:
-            p: Promise = Promise()
-            self._db_info_waiters.append(p)
-            await p.get_future()
-        req.reply.send((self.db_info_version, self.db_info))
+        try:
+            while req.known_version >= self.db_info_version:
+                p: Promise = Promise()
+                self._db_info_waiters.append(p)
+                await p.get_future()
+            req.reply.send((self.db_info_version, self.db_info))
+        finally:
+            # Parked long-poll on a halted (deposed) CC: break the reply
+            # EXPLICITLY — leaving it to reply-wrapper __del__ makes the
+            # break depend on refcount/GC timing (the codebase-wide
+            # rule; see SimNetwork.unregister_process).  A worker whose
+            # watch never breaks keeps a dead generation's db_info
+            # forever.
+            if not req.reply.is_set():
+                req.reply.send_error(err("broken_promise"))
 
     async def _serve_open_database(self) -> None:
         async for req in self.interface.open_database.queue:
             self._spawn(self._handle_open_database(req), f"{self.id}.openDb")
 
     async def _handle_open_database(self, req) -> None:
-        while (self.db_info.epoch <= req.known_epoch or
-               self.db_info.recovery_state not in ("accepting_commits",
-                                                   "fully_recovered")):
-            p: Promise = Promise()
-            self._client_waiters.append(p)
-            await p.get_future()
-        req.reply.send(self.client_db_info())
+        try:
+            while (self.db_info.epoch <= req.known_epoch or
+                   self.db_info.recovery_state not in ("accepting_commits",
+                                                       "fully_recovered")):
+                p: Promise = Promise()
+                self._client_waiters.append(p)
+                await p.get_future()
+            req.reply.send(self.client_db_info())
+        finally:
+            # Same explicit break as _handle_get_db_info: a client whose
+            # parked open-database poll dies silently with a deposed CC
+            # keeps committing through a dead epoch's proxies — every
+            # commit then times out, forever (found by the
+            # coordinatorAttrition + regionFailover battery, seed 103).
+            if not req.reply.is_set():
+                req.reply.send_error(err("broken_promise"))
 
     async def _serve_master_registration(self) -> None:
         async for req in self.interface.master_registration.queue:

@@ -135,6 +135,13 @@ class CoordinationServer:
         self._nominee_waiters: Dict[bytes, List[Promise]] = {}
         self._candidates: Dict[bytes, Dict[int, LeaderInfo]] = {}
         self._last_heartbeat: Dict[bytes, float] = {}
+        # Candidacy lease: when each candidate last ASKED (re-sent a
+        # CandidacyRequest).  A candidacy is this register's only
+        # liveness signal for a candidate that is not yet a heartbeating
+        # leader — dead candidates' parked long-polls look identical to
+        # live ones, so expiry evicts by lease, and a live campaigner
+        # simply re-registers on its next round.
+        self._cand_time: Dict[bytes, Dict[int, float]] = {}
         self.reg_read = RequestStream("coord.read", TaskPriority.Coordination)
         self.reg_write = RequestStream("coord.write", TaskPriority.Coordination)
         self.candidacy = RequestStream("coord.candidacy",
@@ -261,12 +268,22 @@ class CoordinationServer:
             req.reply.send(LeaderInfo(change_id=-2, serialized_info=spec,
                                       forward=True))
             return
+        from ..core.scheduler import now as _now
         self._candidates.setdefault(req.key, {})[
             req.my_info.change_id] = req.my_info
+        self._cand_time.setdefault(req.key, {})[
+            req.my_info.change_id] = _now()
         self._maybe_renominate(req.key)
         nominee = self._nominee.get(req.key)
+        # Reply immediately when the requester IS the standing nominee,
+        # even if it already "knows" that change id: a deposed ex-leader
+        # re-campaigning while every register still nominates it must
+        # re-learn its own leadership NOW — parking here (found by the
+        # coordinatorAttrition nemesis) deadlocks the election with all
+        # registers agreeing and nobody leading.
         if nominee is not None and \
-                nominee.change_id != req.known_leader_change_id:
+                (nominee.change_id != req.known_leader_change_id or
+                 nominee.change_id == req.my_info.change_id):
             req.reply.send(nominee)
             return
         p: Promise = Promise()
@@ -319,19 +336,44 @@ class CoordinationServer:
                 req.reply.send(False)    # deposed: stop being leader
 
     async def _expiry_loop(self) -> None:
-        """Drop dead leaders whose heartbeats stopped (reference
-        leaderRegister's timeout logic)."""
+        """Drop dead leaders AND dead candidates (reference
+        leaderRegister's timeout logic).  Liveness has two signals: a
+        CONFIRMED leader heartbeats; an unconfirmed candidate re-sends
+        candidacy rounds (the lease stamp).  The old heartbeat-only rule
+        evicted live not-yet-elected nominees every ~2s while keeping
+        dead candidates parked forever — with restart-phase-shifted
+        coordinators (the coordinatorAttrition nemesis) the election
+        livelocked: every register agreed on the one live candidate yet
+        its nomination never survived long enough anywhere for a quorum
+        to observe it simultaneously."""
+        from ..core.knobs import server_knobs
         from ..core.scheduler import now
         while True:
             await delay(1.0)
             if self._forward_spec() is not None:
                 continue
-            for key in list(self._nominee):
+            lease = float(server_knobs().COORD_CANDIDACY_LEASE_S)
+            for key in list(self._candidates):
+                cands = self._candidates.get(key, {})
+                times = self._cand_time.setdefault(key, {})
                 cur = self._nominee.get(key)
+                cur_id = cur.change_id if cur is not None else None
+                # Non-nominee candidates: evicted once their lease
+                # lapses (dead, or an idle parked loser — it re-asserts
+                # the moment its round wakes on a nominee change).
+                for cid in [cid for cid in list(cands)
+                            if cid != cur_id and
+                            now() - times.get(cid, 0.0) > lease]:
+                    cands.pop(cid, None)
+                    times.pop(cid, None)
                 if cur is None:
                     continue
-                if now() - self._last_heartbeat.get(key, 0.0) > 2.0:
-                    self._candidates.get(key, {}).pop(cur.change_id, None)
+                hb_stale = now() - self._last_heartbeat.get(key,
+                                                            0.0) > 2.0
+                cand_stale = now() - times.get(cur_id, 0.0) > lease
+                if hb_stale and cand_stale:
+                    cands.pop(cur_id, None)
+                    times.pop(cur_id, None)
                     self._set_nominee(key, self._best_candidate(key))
 
     def streams(self) -> List[RequestStream]:
@@ -655,10 +697,15 @@ async def try_become_leader(coordinators: List[CoordinationClientInterface],
             TraceEvent("BecameLeader").detail("ChangeId",
                                               my_info.change_id).log()
             await _lead(coordinators, my_info)
-            # Deposed: campaign again.
+            # Deposed: campaign again.  Forget the "known" leader — it
+            # was US, and registers that still nominate us would park
+            # the next round's candidacy replies against it (the other
+            # half of the re-election deadlock the coordinator-restart
+            # nemesis exposed).
             TraceEvent("LeaderDeposed", Severity.Warn).detail(
                 "ChangeId", my_info.change_id).log()
             out_current_leader.set(None)
+            known_change_id = -1
 
 
 async def monitor_leader(coordinators: List[CoordinationClientInterface],

@@ -580,6 +580,15 @@ class ServerDBInfo:
     # so status/fdbcli can render the plane topology.
     resolver_ranges: List[Tuple[bytes, bytes, int]] = \
         field(default_factory=list)
+    # Region/DR posture of this generation (status cluster.regions):
+    # replication mode ("remote" when the async plane is live,
+    # "primary_only" otherwise), and — when any epoch in this database's
+    # history adopted the remote plane — the failover record:
+    # failover_version (the adopted min(end_version) across locked
+    # remote TLogs; every commit acked at or below it survived),
+    # lost_tail_versions (the visible un-replicated tail above it, 0 for
+    # a drained switchover), drained, and the epoch that failed over.
+    regions: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass

@@ -248,6 +248,16 @@ class DBCoreState:
     # of re-seeding, so balanced cuts survive epoch changes.
     resolver_ranges: List[Tuple[bytes, bytes, int]] = \
         field(default_factory=list)
+    # Region-failover record (the last epoch that adopted the remote
+    # plane): the adopted version — min(end_version) across the locked
+    # remote TLogs, below which every acked commit survived — and the
+    # visible lost tail above it (0 for a drained switchover).  Durable
+    # history: status keeps reporting the loss window across later
+    # epochs and power failures, so an operator inspecting a recovered
+    # cluster can still see what an undrained failover cost.
+    failover_epoch: int = 0
+    failover_version: Version = 0
+    failover_lost_tail: Version = 0
 
     def pack(self) -> bytes:
         from ..core.wire import Writer
@@ -294,6 +304,8 @@ class DBCoreState:
         w.u16(len(self.resolver_ranges))
         for b, e, idx in self.resolver_ranges:
             w.bytes_(b).bytes_(e).i64(idx)
+        w.u32(self.failover_epoch).i64(self.failover_version)
+        w.i64(self.failover_lost_tail)
         return w.done()
 
     @staticmethod
@@ -349,6 +361,13 @@ class DBCoreState:
             for _ in range(r.u16()):
                 rb, re_ = r.bytes_(), r.bytes_()
                 resolver_ranges.append((rb, re_, r.i64()))
+        failover_epoch = 0
+        failover_version: Version = 0
+        failover_lost_tail: Version = 0
+        if not r.at_end():
+            failover_epoch = r.u32()
+            failover_version = r.i64()
+            failover_lost_tail = r.i64()
         return cls(epoch=epoch, recovery_version=rv,
                    tlogs=[None] * len(tlog_ids), log_replication=log_rep,
                    storage_servers={t: None for t in storage_ids},
@@ -361,7 +380,10 @@ class DBCoreState:
                    backup_container=backup_container, locked=locked,
                    tenants=tenants,
                    tenant_metadata_version=tenant_metadata_version,
-                   resolver_ranges=resolver_ranges)
+                   resolver_ranges=resolver_ranges,
+                   failover_epoch=failover_epoch,
+                   failover_version=failover_version,
+                   failover_lost_tail=failover_lost_tail)
 
 
 def _split_points(n: int) -> List[bytes]:
@@ -656,6 +678,22 @@ async def _failover_to_remote_prep(prev: "DBCoreState", recovered_logs,
     teams mapped through the twin involution.  Returns (prev, {}) when
     the remote plane is unreachable (caller then fails recovery).
 
+    The adoption version is EXPLICIT and CONSISTENT: prev'.failover_version
+    = min(end_version) across the locked remote TLogs.  Every remote TLog's
+    version chain is contiguous (log_router.remote_tlog_feeder delivers
+    in-order windows), so version <= failover_version implies EVERY twin
+    tag's mutations through it are present on its locked holder — the
+    acked-commit survival invariant: a commit acknowledged to a client at
+    or below failover_version survives the failover (replicas that ran
+    ahead of it on some tags roll back via storage set_log_system's epoch
+    rollback, so the adopted state is a point-in-time snapshot).  Commits
+    ABOVE failover_version are the undrained lost tail: their clients
+    either never got an ack (proxy died first => commit_unknown_result
+    and a client-side retry) or observed data loss, which
+    prev'.failover_lost_tail makes visible — the max over locked
+    end_versions and feeder-piggybacked known_committed_versions minus
+    the adopted version (0 for a drained fdbcli-style switchover).
+
     Reference: TagPartitionedLogSystem.actor.cpp epochEnd choosing a
     remote log set when the primary's is gone."""
     import dataclasses as _dc
@@ -697,6 +735,22 @@ async def _failover_to_remote_prep(prev: "DBCoreState", recovered_logs,
                        Severity.Error).detail("Begin", b).log()
             return prev, {}
         ranges.append((b, e, new_team))
+    # The explicit adoption point and the loss it makes visible (see
+    # docstring): min() is what the new epoch recovers AT, the spread
+    # above it is tail the primary acked (or at least appended) that not
+    # every remote TLog holds — gone for good once the failover commits.
+    ends = [r.end_version for r in locked.values()]
+    failover_version = min(ends)
+    visible_end = max(max(ends),
+                      max(r.known_committed_version for r in locked.values()))
+    lost_tail = max(0, visible_end - failover_version)
+    TraceEvent("RegionFailoverVersions",
+               Severity.Warn if lost_tail else Severity.Info).detail(
+        "FailoverVersion", failover_version).detail(
+        "VisibleEnd", visible_end).detail(
+        "LostTailVersions", lost_tail).detail(
+        "Drained", lost_tail == 0).detail(
+        "LockedRemoteTLogs", len(locked)).log()
     prev2 = _dc.replace(
         prev,
         # FULL-length list (unresolvable entries stay None): `locked` is
@@ -713,7 +767,10 @@ async def _failover_to_remote_prep(prev: "DBCoreState", recovered_logs,
         # restoring across a hole.
         backup_active=False,
         remote_tlogs=[], remote_tlog_ids=[],
-        remote_storage={}, remote_storage_ids={})
+        remote_storage={}, remote_storage_ids={},
+        failover_epoch=prev.epoch + 1,
+        failover_version=failover_version,
+        failover_lost_tail=lost_tail)
     return prev2, locked
 
 
@@ -862,6 +919,16 @@ async def master_server(master: Master, process, coordinators,
             # Every client-visible commit was acked by ALL old TLogs, so
             # the min over locked end-versions is >= every visible commit.
             recovery_version = min(r.end_version for r in locked.values())
+            if failed_over and recovery_version != prev.failover_version:
+                # The failover MUST recover at exactly the version prep
+                # surfaced (status and the survival invariant are stated
+                # against it); a mismatch means the locked set changed
+                # under us — fail the recovery rather than adopt an
+                # inconsistent point.
+                raise err("master_recovery_failed",
+                          f"failover version drift: locked min "
+                          f"{recovery_version} != surfaced "
+                          f"{prev.failover_version}")
             from ..core.coverage import test_coverage
             test_coverage("RecoveryRegionFailover" if failed_over
                           else "RecoveryMasterLockedOldGeneration")
@@ -1402,7 +1469,12 @@ async def master_server(master: Master, process, coordinators,
             locked=prev.locked if prev else None,
             tenants=dict(prev.tenants) if prev else {},
             tenant_metadata_version=(
-                prev.tenant_metadata_version if prev else 0)))
+                prev.tenant_metadata_version if prev else 0),
+            # Failover history is durable: later epochs (and full power
+            # failures) keep reporting what the last failover cost.
+            failover_epoch=prev.failover_epoch if prev else 0,
+            failover_version=prev.failover_version if prev else 0,
+            failover_lost_tail=prev.failover_lost_tail if prev else 0))
 
         # ACCEPTING_COMMITS (:1943): start the allocator + announce.
         adopt(master._serve_commit_versions(), "master.serveVersions")
@@ -1411,6 +1483,27 @@ async def master_server(master: Master, process, coordinators,
         adopt(resolution_balancing(master, resolvers, key_resolvers_ranges,
                                    coordinators=coordinators),
               "master.resolutionBalancing")
+        # cluster.regions: this generation's DR posture + the durable
+        # failover record (status/fdbcli render it; the regionFailover
+        # nemesis and KillRegion verify the survival invariant against
+        # the surfaced failover_version).
+        regions_doc: Dict[str, Any] = {
+            "configured": config.usable_regions >= 2,
+            "remote_dc": config.remote_dc or "",
+            "replication": "remote" if remote_tlogs else "primary_only",
+            "log_routers": len(log_routers),
+            "remote_tlogs": len(remote_tlogs),
+            "remote_replicas": len(remote_storage),
+        }
+        if failed_over:
+            regions_doc["failed_over_this_epoch"] = True
+        if prev is not None and prev.failover_epoch:
+            regions_doc["failover"] = {
+                "epoch": prev.failover_epoch,
+                "failover_version": prev.failover_version,
+                "lost_tail_versions": prev.failover_lost_tail,
+                "drained": prev.failover_lost_tail == 0,
+            }
         db_info = ServerDBInfo(
             epoch=master.epoch, recovery_state="accepting_commits",
             recovery_version=recovery_version, master=master.interface,
@@ -1423,7 +1516,8 @@ async def master_server(master: Master, process, coordinators,
             remote_storage=remote_storage,
             log_replication=config.log_replication,
             storage_engine=config.storage_engine,
-            resolver_ranges=key_resolvers_ranges)
+            resolver_ranges=key_resolvers_ranges,
+            regions=regions_doc)
         await RequestStream.at(
             cc_interface.master_registration.endpoint).get_reply(
             MasterRegistrationRequest(epoch=master.epoch, db_info=db_info))
@@ -1619,7 +1713,14 @@ async def master_server(master: Master, process, coordinators,
                                 0, shim, dict(live_twins), {}, {})
                             new_info = _dc2.replace(
                                 db_info, log_routers=lr,
-                                remote_tlogs=rt, remote_storage=rs)
+                                remote_tlogs=rt, remote_storage=rs,
+                                regions=dict(
+                                    db_info.regions,
+                                    replication=("remote" if rt
+                                                 else "primary_only"),
+                                    log_routers=len(lr),
+                                    remote_tlogs=len(rt),
+                                    remote_replicas=len(rs)))
                             await RequestStream.at(
                                 cc_interface.master_registration.endpoint
                             ).get_reply(MasterRegistrationRequest(

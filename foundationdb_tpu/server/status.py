@@ -191,6 +191,30 @@ def collect_resolution_plane(info) -> Dict[str, Any]:
             "resolvers": resolvers}
 
 
+def collect_regions(info, workers=None) -> Dict[str, Any]:
+    """cluster.regions: the generation's DR posture (ISSUE 10) — region
+    configuration, async-plane health (log routers / remote TLogs /
+    remote replicas of this epoch), per-dc worker counts, and the
+    durable failover record: failover_version (the adopted
+    min(end_version) across locked remote TLogs — every commit acked at
+    or below it survived), lost_tail_versions (the visible un-replicated
+    tail an undrained hard kill cost), and drained (True for the
+    fdbcli-style switchover that lost nothing).  The master assembles
+    the document at recovery (ServerDBInfo.regions) and the in-epoch
+    plane heal refreshes the counts."""
+    doc = dict(getattr(info, "regions", None) or {})
+    doc.setdefault("configured", False)
+    doc.setdefault("replication", "primary_only")
+    if workers:
+        by_dc: Dict[str, int] = {}
+        for reg in workers:
+            dc = (getattr(reg, "locality", ("", "", "")) or ("",))[0] or "?"
+            by_dc[dc] = by_dc.get(dc, 0) + 1
+        doc["datacenters"] = {dc: {"workers": n}
+                              for dc, n in sorted(by_dc.items())}
+    return doc
+
+
 def collect_heat(info, read_hot: Dict[str, Any]) -> Dict[str, Any]:
     """cluster.heat: the cluster-wide heat telemetry plane (ISSUE 8) —
     per-resolver decayed top-K conflict ranges keyed by resolver id
@@ -392,6 +416,10 @@ async def build_status(cc) -> Dict[str, Any]:
             # backend supervision, and the generation's key-range
             # ownership (ISSUE 7).
             "resolution": collect_resolution_plane(info),
+            # DR posture + failover record (ISSUE 10): region
+            # configuration, async-plane health, drained-vs-undrained
+            # failover history with the surfaced loss window.
+            "regions": collect_regions(info, cc.workers.values()),
             # Cluster heat telemetry (ISSUE 8): per-resolver hot
             # conflict ranges, per-storage read-hot shards, busiest
             # tags/tenants — the feed for \xff\xff/metrics/ and

@@ -265,6 +265,18 @@ def run_simulation(spec, seed: int, *, buggify: bool = False,
     from ..server.interfaces import DatabaseConfiguration
 
     spec = load_spec(spec) if isinstance(spec, str) else spec
+    # Spec-driven SIM topology: a top-level [sim] table sizes the worker
+    # pool (the [cluster] table only shapes the recruited database).  A
+    # chaos spec that needs spare storage capacity — e.g. fatal-disk
+    # attrition under storage_replication=2 needs a third storage worker
+    # for the policy guard to ever allow a kill — carries it itself
+    # instead of relying on every runner's defaults.
+    sim_conf = dict(spec.get("sim") or {})
+    n_workers = int(sim_conf.pop("n_workers", n_workers))
+    n_storage_workers = int(sim_conf.pop("n_storage_workers",
+                                         n_storage_workers))
+    if sim_conf:
+        raise KeyError(f"unknown [sim] fields {sorted(sim_conf)} in spec")
     if config is None:
         # Spec-driven cluster shape: a top-level [cluster] table overrides
         # the default DatabaseConfiguration field-by-field (e.g.

@@ -25,6 +25,58 @@ from ..core.futures import wait_all
 from .workload import TestWorkload, register_workload
 
 
+async def dr_poll_until(predicate, timeout_s: float, what: str,
+                        required: bool = True):
+    """Poll `predicate` at the shared DR pacing (DR_POLL_INTERVAL_S
+    doubling to DR_POLL_MAX_INTERVAL_S) until it returns truthy; that
+    value is returned.  Past `timeout_s`: AssertionError(`what`), or
+    None when not `required` (best-effort waits like failback).  The
+    one shape behind every region-plane / drain / failover wait in the
+    DR workloads, so their timeout+backoff semantics cannot drift."""
+    from ..core.knobs import server_knobs
+    from ..core.scheduler import PollBackoff
+    knobs = server_knobs()
+    pb = PollBackoff(knobs.DR_POLL_INTERVAL_S,
+                     knobs.DR_POLL_MAX_INTERVAL_S)
+    deadline = now() + timeout_s
+    while True:
+        value = predicate()
+        if value:
+            return value
+        if now() >= deadline:
+            if required:
+                raise AssertionError(what)
+            return None
+        await delay(pb.next())
+
+
+def remote_plane_up(cluster):
+    """dr_poll_until predicate: the current generation's async remote
+    plane is recruited — returns its ServerDBInfo, else None."""
+    cc = cluster.current_cc()
+    info = cc.db_info if cc is not None else None
+    if info is not None and getattr(info, "remote_tlogs", None) \
+            and getattr(info, "remote_storage", None):
+        return info
+    return None
+
+
+async def commit_marker(db, key: bytes, timeout_s: float, what: str):
+    """Commit `key = b"1"` with retries, failing LOUDLY past the
+    deadline (a dead commit pipeline must not masquerade as a later
+    drain/failover timeout).  Returns the acked commit version."""
+    t = db.create_transaction()
+    deadline = now() + timeout_s
+    while True:
+        if now() >= deadline:
+            raise AssertionError(what)
+        try:
+            t.set(key, b"1")
+            return await t.commit()
+        except FdbError as e:
+            await t.on_error(e)
+
+
 @register_workload
 class CycleWorkload(TestWorkload):
     name = "Cycle"
@@ -206,11 +258,45 @@ class ChaosNemesisWorkload(TestWorkload):
       satisfy log or storage replication ("never break quorum");
     - partition: random worker pair partitions that always heal.
 
+    Disaster-recovery battery (ISSUE 10), each off by default:
+
+    - regionFailover: provision a remote dc (setup), then hard-kill the
+      ENTIRE primary dc mid-traffic — UNDRAINED, no convergence wait —
+      verify recovery adopts the remote plane at the surfaced
+      failover_version with the acked-commit survival invariant intact,
+      then re-provision the dead dc (wiped machines) and optionally
+      fail the async plane back onto it;
+    - coordinatorAttrition: reboot/hard-restart coordination servers one
+      at a time under a quorum guard (all peers up), exercising
+      well-known-token CoordinationClientInterface re-pointing;
+    - diskFaults: inject a FATAL disk fault (io_error on fsync) into one
+      storage worker's machine, wait for the process-death detection
+      path, then clear the fault and RESTART the worker — the topology
+      heals instead of permanently shrinking.
+
     start() ends by healing the network and restarting every downed
     worker, so quiescence and the invariant workloads' checks (Cycle,
     ConsistencyCheck) run against a whole cluster."""
 
     name = "ChaosNemesis"
+
+    async def setup(self) -> None:
+        if not self.config.get("regionFailover", False):
+            return
+        # Provision the remote dc the failover will adopt (same shape as
+        # KillRegionWorkload.setup): replica hosts, a stateless worker
+        # for the async plane's routers/TLogs, and a CC candidate so the
+        # dc can elect a controller once the primary dies.
+        c = self.cluster
+        self._remote_dc = str(self.config.get("remoteDc", "dcR"))
+        for i in range(int(self.config.get("remoteStorage", 2))):
+            c.add_worker("storage", name=f"nrf{i}", dcid=self._remote_dc)
+        c.add_worker("stateless", name="nrfstate", dcid=self._remote_dc)
+        c.add_worker("stateless", name="nrfcc", dcid=self._remote_dc,
+                     campaign=True)
+        from ..client.management import change_configuration
+        await change_configuration(self.db, usable_regions=2,
+                                   remote_dc=self._remote_dc)
 
     async def start(self) -> None:
         duration = float(self.config.get("testDuration", 10.0))
@@ -225,6 +311,15 @@ class ChaosNemesisWorkload(TestWorkload):
         if self.config.get("resolverAttrition", False):
             loops.append(spawn(self._resolver_attrition_loop(),
                                "nemesis.resolverAttrition"))
+        if self.config.get("coordinatorAttrition", False):
+            loops.append(spawn(self._coordinator_attrition_loop(),
+                               "nemesis.coordinatorAttrition"))
+        if self.config.get("diskFaults", False):
+            loops.append(spawn(self._disk_fault_loop(),
+                               "nemesis.diskFaults"))
+        if self.config.get("regionFailover", False):
+            loops.append(spawn(self._region_failover(),
+                               "nemesis.regionFailover"))
         await wait_all(loops)
         # Leave the cluster whole: heal every network fault and bring
         # back every downed worker before quiescence.
@@ -389,6 +484,210 @@ class ChaosNemesisWorkload(TestWorkload):
             self.cluster.restart_worker(idx)
             await delay(restart_delay)      # one victim at a time
         self.metrics["resolver_kills"] = kills
+
+    async def _coordinator_attrition_loop(self) -> None:
+        """Rolling coordination-server restarts (the PR-4 gap named in
+        ROADMAP): one coordinator at a time — clean reboot or hard
+        kill+replace on the same address — under a quorum guard (every
+        peer must be up before a new victim is taken).  The durable
+        generation registers recover from the machine's files, leader
+        election re-runs through the survivors, and every client's
+        CoordinationClientInterface re-points via the well-known-token
+        endpoints without a stuck GRV pipeline."""
+        from ..core.coverage import test_coverage
+        from ..core.rng import deterministic_random
+        rng = deterministic_random()
+        c = self.cluster
+        restart_delay = float(self.config.get("restartDelay", 1.5))
+        restarts = 0
+        while now() < self._deadline:
+            await delay(2.0 + rng.random01() * 3.0)
+            coords = getattr(c, "coordinators", None)
+            if not coords:
+                return              # static harness: nothing to restart
+            # Quorum guard: restart only when ALL coordinators SERVE, so
+            # at most one is ever down and the majority always answers.
+            # Serving means the register-recovery startup finished
+            # (server._ready), not merely process.alive — a hard restart
+            # flips alive back on synchronously while the replacement is
+            # still recovering its durable registers.
+            if not all(p.alive and s._ready.is_set() for p, s in coords):
+                continue
+            i = rng.random_int(0, len(coords))
+            c.restart_coordinator(i, hard=rng.random01() < 0.5)
+            test_coverage("ChaosCoordinatorRestart")
+            restarts += 1
+            await delay(restart_delay)
+        self.metrics["coordinator_restarts"] = restarts
+
+    async def _disk_fault_loop(self) -> None:
+        """Restart-capable fatal disk faults (the PR-4 ensemble gap):
+        arm an io_error-on-fsync profile on one storage worker's
+        machine, wait for the detection path to kill the process
+        (StorageIoErrorDeath / TLogIoErrorDeath), then DISARM the fault
+        and restart the worker on the same machine — the harness heals
+        instead of permanently shrinking, so a long chaos run keeps its
+        full topology."""
+        from ..core.coverage import test_coverage
+        from ..core.rng import deterministic_random
+        from ..server.sim_fs import DiskFaultProfile
+        rng = deterministic_random()
+        sim = self.cluster.sim
+        restart_delay = float(self.config.get("restartDelay", 1.5))
+        faults = 0
+        while now() < self._deadline:
+            await delay(1.0 + rng.random01() * 2.0)
+            entries = [(i, e[0]) for i, e in enumerate(self.cluster.workers)
+                       if e[0].alive and e[0].process_class == "storage"]
+            if not entries:
+                continue
+            idx, victim = entries[rng.random_int(0, len(entries))]
+            if not self._safe_to_fail(victim):
+                continue
+            fs = sim.fs_for(victim)
+            fs.set_fault_profile("", DiskFaultProfile(io_error_sync_p=1.0))
+            # Bounded wait for the io_error death; a machine that never
+            # fsyncs inside the window just gets the fault disarmed.
+            for _ in range(40):
+                if not victim.alive:
+                    break
+                await delay(0.25)
+            fs.clear_fault_profiles()
+            if not victim.alive:
+                faults += 1
+                test_coverage("ChaosFatalDiskRestart")
+                await delay(restart_delay)
+                self.cluster.restart_worker(idx)
+            await delay(restart_delay)      # one victim at a time
+        self.metrics["disk_fault_restarts"] = faults
+
+    async def _region_failover(self) -> None:
+        """UNDRAINED region failover (the tentpole scenario): once the
+        async plane is up, commit a marker mid-traffic and hard-kill the
+        whole primary dc with NO convergence wait.  Recovery must adopt
+        the remote plane at the surfaced failover_version; the marker —
+        an acked commit — must survive whenever its commit version is at
+        or below it (the acked-commit survival invariant; above it, the
+        surfaced lost tail makes the loss explicit).  Afterwards the
+        dead dc is re-provisioned (machines WIPED: replacement boxes,
+        not resurrected pre-failover disks) and, with failback enabled,
+        the async plane is re-established pointing at it.
+
+        Pair with Cycle: its ring invariant across the lost-tail
+        truncation proves the adopted state is a version-consistent
+        snapshot, not a torn mix of tags."""
+        from ..core.coverage import test_coverage
+        from ..core.error import FdbError
+        from ..server.log_router import is_remote_tag
+        c = self.cluster
+        info = await dr_poll_until(
+            lambda: remote_plane_up(c),
+            float(self.config.get("planeTimeout", 120)),
+            "regionFailover: remote plane never recruited")
+        # Optionally FORCE a real undrained window (reference KillRegion
+        # with min_delay_before_kill): freeze the async plane's pull
+        # path, keep committing on the primary, and only then kill —
+        # everything acked during the window is tail the failover MUST
+        # lose, so the loss path gets exercised instead of draining by
+        # luck on fast seeds.
+        lag = float(self.config.get("replicationLagBeforeKill", 0.0))
+        clogged = []
+        if lag > 0:
+            for iface in (list(getattr(info, "log_routers", []) or []) +
+                          list(getattr(info, "remote_tlogs", []) or [])):
+                p = c.process_of(iface)
+                if p is not None and p.alive:
+                    c.sim.clog_process(p, seconds=600.0)
+                    clogged.append(p)
+            await delay(lag)
+        # An ACKED commit to hold against the surfaced failover_version.
+        marker_v = await commit_marker(
+            self.db, b"nemesis/failover_marker",
+            float(self.config.get("markerTimeout", 60)),
+            "regionFailover: marker commit never landed")
+        # UNDRAINED: kill the primary dc NOW — in-flight commits above
+        # what the routers shipped become the lost tail.  Deliberately a
+        # PRE-KILL snapshot: the same dc set is what failback later
+        # re-points the async plane at.
+        primary_dcs = {p.locality.dcid  # flowlint: state -- pre-kill snapshot reused for failback
+                       for p, _w, _cc2, _lv in c.workers
+                       if p.alive} - {self._remote_dc}
+        killed_idx = [i for i, e in enumerate(c.workers)
+                      if e[0].alive and e[0].locality.dcid in primary_dcs]
+        for i in killed_idx:
+            c.sim.kill_process(c.workers[i][0])
+        # The remote plane must be reachable again for recovery to lock
+        # it — only the PRIMARY was supposed to die.
+        for p in clogged:
+            c.sim.unclog_process(p)
+        # Recovery onto the remote plane: serving tags become the twins
+        # and the failover record surfaces in db_info.regions.
+        def failed_over():
+            cc = c.current_cc()
+            info2 = cc.db_info if cc is not None else None
+            if info2 is not None and info2.recovery_state in (
+                    "accepting_commits", "fully_recovered") and \
+                    info2.storage_servers and \
+                    all(is_remote_tag(tag) for tag in info2.storage_servers):
+                return (getattr(info2, "regions", None) or {}).get(
+                    "failover")
+            return None
+        fo = await dr_poll_until(
+            failed_over, float(self.config.get("failoverTimeout", 240)),
+            "regionFailover: cluster never recovered onto the "
+            "remote plane")
+        self.metrics["failover_version"] = float(fo["failover_version"])
+        self.metrics["lost_tail_versions"] = float(
+            fo["lost_tail_versions"])
+        self.metrics["marker_version"] = float(marker_v)
+        # The survival invariant, checked against the SURFACED version:
+        # acked at or below failover_version => readable after adoption;
+        # acked ABOVE it => the undrained lost tail (with a forced
+        # replication-lag window the marker is GUARANTEED above — the
+        # clog started before it committed — and must be gone).
+        t = self.db.create_transaction()
+        while True:
+            try:
+                got = await t.get(b"nemesis/failover_marker")
+                break
+            except FdbError as e:
+                await t.on_error(e)
+        if marker_v <= fo["failover_version"]:
+            assert got == b"1", (
+                f"acked marker at {marker_v} <= failover_version "
+                f"{fo['failover_version']} was LOST")
+            self.metrics["marker_survived"] = 1.0
+        else:
+            self.metrics["marker_lost"] = 0.0 if got == b"1" else 1.0
+            if lag > 0:
+                assert got is None, (
+                    "marker acked inside the forced replication-lag "
+                    "window survived an undrained failover — the clog "
+                    "did not isolate the async plane")
+        test_coverage("ChaosRegionFailover")
+        self.metrics["region_failovers"] = 1.0
+        # Heal: re-provision the dead dc on WIPED machines (replacement
+        # hardware — pre-failover engines must not come back as
+        # same-tag impostors), then optionally re-point the async plane
+        # at it (failback) through a committed configuration change.
+        for i in killed_idx:
+            c.sim.wipe_machine(c.workers[i][0].locality.machineid)
+            c.restart_worker(i)
+        if self.config.get("failback", True) and primary_dcs:
+            from ..client.management import change_configuration
+            new_remote = sorted(primary_dcs)[0]
+            await change_configuration(self.db, remote_dc=new_remote)
+
+            def failback_plane_up():
+                cc = c.current_cc()
+                info2 = cc.db_info if cc is not None else None
+                return info2 is not None and \
+                    bool(getattr(info2, "remote_tlogs", None))
+            if await dr_poll_until(
+                    failback_plane_up,
+                    float(self.config.get("planeTimeout", 120)),
+                    "failback plane", required=False):
+                self.metrics["failback_plane"] = 1.0
 
     async def check(self) -> bool:
         # The nemesis's own invariant: it put the cluster back together.
@@ -957,29 +1256,35 @@ class KillRegionWorkload(TestWorkload):
         await change_configuration(self.db, usable_regions=2,
                                    remote_dc=self._remote_dc)
 
+    def _primary_dcs(self, info):
+        """The dc ids actually hosting the SERVING storage set of this
+        generation — derived from the recruited configuration, never
+        assumed: a spec whose primary dc is not "dc0" must still kill
+        the real primary (ISSUE 10 satellite)."""
+        dcs = set()
+        for iface in (info.storage_servers or {}).values():
+            p = self.cluster.process_of(iface)
+            if p is not None:
+                dcs.add(p.locality.dcid)
+        dcs.discard(self._remote_dc)
+        return dcs
+
     async def start(self) -> None:
-        from ..core.error import FdbError
         c = self.cluster
-        # Wait for the remote plane.
-        for _ in range(int(self.config.get("planeTimeout", 120) / 0.5)):
-            cc = c.current_cc()
-            info = cc.db_info if cc is not None else None
-            if info is not None and getattr(info, "remote_tlogs", None) \
-                    and getattr(info, "remote_storage", None):
-                break
-            await delay(0.5)
-        else:
-            raise AssertionError("remote plane never recruited")
+        # Wait for the remote plane (shared DR poll pacing: backoff
+        # toward the cap while the plane recruits).
+        await dr_poll_until(
+            lambda: remote_plane_up(c),
+            float(self.config.get("planeTimeout", 120)),
+            "remote plane never recruited")
         # Drained switchover point: a marker commit fully replicated.
-        t = self.db.create_transaction()
-        v = None
-        while v is None:
-            try:
-                t.set(b"killregion/marker", b"1")
-                v = await t.commit()
-            except FdbError as e:
-                await t.on_error(e)
-        for _ in range(int(self.config.get("drainTimeout", 240) / 0.5)):
+        v = await commit_marker(
+            self.db, b"killregion/marker",
+            float(self.config.get("markerTimeout", 60)),
+            "killregion marker commit never landed (commit pipeline "
+            "dead before the kill)")
+
+        def replicas_converged():
             cc = c.current_cc()
             info = cc.db_info if cc is not None else None
             roles = [getattr(i, "role", None)
@@ -987,15 +1292,22 @@ class KillRegionWorkload(TestWorkload):
                                if info is not None else ())]
             if roles and all(r is not None and r.version.get() >= v
                              for r in roles):
-                break
-            await delay(0.5)
-        else:
-            raise AssertionError("remote replicas never converged")
-        # KillRegion: the whole primary dc dies at once.
-        primary_dc = str(self.config.get("primaryDc", "dc0"))
+                return info
+            return None
+        info = await dr_poll_until(
+            replicas_converged,
+            float(self.config.get("drainTimeout", 240)),
+            "remote replicas never converged")
+        # KillRegion: the whole primary dc (derived, possibly several
+        # dcs if storage spans them) dies at once.
+        primary_dcs = self._primary_dcs(info)
+        if str(self.config.get("primaryDc", "")):
+            primary_dcs = {str(self.config.get("primaryDc"))}
+        if not primary_dcs:
+            raise AssertionError("could not derive a primary dc to kill")
         killed = 0
         for p, _w, _cc, _lv in list(c.workers):
-            if p.alive and p.locality.dcid == primary_dc:
+            if p.alive and p.locality.dcid in primary_dcs:
                 c.sim.kill_process(p)
                 killed += 1
         self.metrics["killed"] = killed
@@ -1020,3 +1332,106 @@ class KillRegionWorkload(TestWorkload):
                        for tag in cc.db_info.storage_servers))
         self.metrics["adopted_remote"] = float(adopted)
         return ok and adopted
+
+
+@register_workload
+class BackupAndRestoreWorkload(TestWorkload):
+    """Online backup + prefix-shifted restore under chaos (ISSUE 10;
+    reference fdbserver/workloads/BackupAndRestoreCorrectness.actor.cpp,
+    simplified): submit a backup — the snapshot task chain runs through
+    TaskBucket agents and the mutation log rides BACKUP_TAG through
+    every epoch the nemesis forces — keep mutating the watched prefix
+    while capture runs, stop/seal the container, then restore it into
+    THIS cluster under a shifted prefix (reference fdbrestore
+    --add-prefix) and consistency-check restored-vs-live at the backup's
+    end version.
+
+    Every mutation is IDEMPOTENT (unique-value sets and clears, no
+    atomic ops), so commit_unknown_result retries under chaos cannot
+    skew the model: the tracked model is exactly the definite effect of
+    every acked transaction, the live prefix must equal it after the
+    mutation phase, and the restored image must equal it shifted —
+    proving the capture stream lost nothing across recoveries."""
+
+    name = "BackupAndRestore"
+
+    PREFIX = b"bw/"
+    RESTORE_PREFIX = b"bwr/"
+
+    async def setup(self) -> None:
+        n = int(self.config.get("nodeCount", 25))
+
+        async def populate(t):
+            for i in range(n):
+                t.set(self.PREFIX + b"%04d" % i, b"init%04d" % i)
+        await self.run_transaction(populate)
+        self.model: Dict[bytes, bytes] = {
+            self.PREFIX + b"%04d" % i: b"init%04d" % i for i in range(n)}
+
+    async def start(self) -> None:
+        from ..client.backup import FileBackupAgent, restore
+        from ..core.coverage import test_coverage
+        from ..server.sim_fs import SimFileSystem
+        n = int(self.config.get("nodeCount", 25))
+        duration = float(self.config.get("mutateDuration", 4.0))
+        rng = random.Random(int(self.config.get("seed", 12)))
+        # A fresh SimFileSystem as this run's shared blob store: the
+        # container must survive every process/machine fault the nemesis
+        # injects (it models remote object storage).
+        fs = SimFileSystem()
+        agent = FileBackupAgent(self.cluster, self.db, fs,
+                                name="chaos-backup")
+        await agent.submit()
+        deadline = now() + duration
+        writes = 0
+        while now() < deadline:
+            writes += 1
+            if rng.random() < 0.8:
+                k = self.PREFIX + b"%04d" % rng.randrange(n)
+                v = b"w%08d" % writes
+
+                async def put(t, k=k, v=v):
+                    t.set(k, v)
+                await self.run_transaction(put)
+                self.model[k] = v
+            else:
+                lo = rng.randrange(n)
+                hi = min(n, lo + rng.randrange(1, 4))
+                b = self.PREFIX + b"%04d" % lo
+                e = self.PREFIX + b"%04d" % hi
+
+                async def clr(t, b=b, e=e):
+                    t.clear(b, e)
+                await self.run_transaction(clr)
+                for k in [k for k in self.model if b <= k < e]:
+                    del self.model[k]
+        # Seal: every acked mutation above committed strictly before the
+        # stop version, so the container covers the whole model.
+        end_version = await agent.stop()
+        # Restore the sealed container into the LIVE cluster, shifted.
+        await restore(self.db, fs, name="chaos-backup",
+                      prefix=self.RESTORE_PREFIX)
+        test_coverage("BackupRestoreUnderChaos")
+        self.metrics["mutations"] = writes
+        self.metrics["backup_end_version"] = float(end_version)
+
+    async def check(self) -> bool:
+        async def read_both(t):
+            live = dict(await t.get_range(
+                self.PREFIX, self.PREFIX[:-1] + b"0", limit=100000))
+            shifted_begin = self.RESTORE_PREFIX + self.PREFIX
+            restored = dict(await t.get_range(
+                shifted_begin, shifted_begin[:-1] + b"0", limit=100000))
+            return live, restored
+        live, restored = await self.run_transaction(read_both)
+        expected_restored = {self.RESTORE_PREFIX + k: v
+                             for k, v in self.model.items()}
+        self.metrics["live_keys"] = float(len(live))
+        self.metrics["restored_keys"] = float(len(restored))
+        if live != self.model:
+            self.metrics["live_mismatch"] = 1.0
+            return False
+        if restored != expected_restored:
+            self.metrics["restored_mismatch"] = 1.0
+            return False
+        return True

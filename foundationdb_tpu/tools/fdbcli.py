@@ -141,6 +141,27 @@ class Cli:
             f"  Available              - "
             f"{doc.get('client', {}).get('database_status', {})}",
         ]
+        regions = cl.get("regions") or {}
+        if regions.get("configured"):
+            lines += [
+                "Regions:",
+                f"  Replication            - "
+                f"{regions.get('replication', '?')}"
+                f" (remote dc {regions.get('remote_dc', '?')!r},"
+                f" {regions.get('log_routers', 0)} routers /"
+                f" {regions.get('remote_tlogs', 0)} remote logs /"
+                f" {regions.get('remote_replicas', 0)} replicas)",
+            ]
+        fo = regions.get("failover")
+        if fo:
+            lines += [
+                f"  Last failover          - epoch {fo.get('epoch')}"
+                f" at version {fo.get('failover_version')}"
+                f" ({'drained' if fo.get('drained') else 'UNDRAINED: '}"
+                + ("" if fo.get("drained")
+                   else f"{fo.get('lost_tail_versions')} versions of "
+                        f"acked tail lost") + ")",
+            ]
         return "\n".join(lines)
 
     def cmd_metrics(self, group: str = "") -> str:

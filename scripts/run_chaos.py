@@ -37,7 +37,14 @@ import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-DEFAULT_SPECS = ("ChaosTest.toml", "CycleTest.toml", "TenantTest.toml")
+# The default matrix: the canonical nemesis trio plus the DR battery
+# (ISSUE 10) — region failover + coordinator restarts, and
+# backup/restore under attrition + fatal disk faults.  Their coverage
+# markers (ChaosRegionFailover, ChaosCoordinatorRestart,
+# ChaosFatalDiskRestart, BackupRestoreUnderChaos) land in the summary's
+# coverage ledger like every other registered marker.
+DEFAULT_SPECS = ("ChaosTest.toml", "CycleTest.toml", "TenantTest.toml",
+                 "TwoRegionChaosTest.toml", "BackupRestoreChaosTest.toml")
 
 
 def _ensure_hash_seed_pinned() -> None:

@@ -17,3 +17,8 @@ def test_coverage_registry_and_hits():
     # The built-in ledger knows the codebase's marked paths even before
     # they fire.
     assert "RecoveryRegionFailover" in coverage.report()
+    # Disaster-recovery nemesis battery (ISSUE 10): run_chaos.py's
+    # summary ledger must list these whether or not a run hit them.
+    for marker in ("ChaosRegionFailover", "ChaosCoordinatorRestart",
+                   "ChaosFatalDiskRestart", "BackupRestoreUnderChaos"):
+        assert marker in coverage.report(), marker

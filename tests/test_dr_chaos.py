@@ -1,0 +1,215 @@
+"""Disaster-recovery nemesis battery (ISSUE 10): undrained region
+failover verified against the surfaced failover_version, rolling
+coordinator restarts (CoordinationClientInterface re-pointing), fatal
+disk faults with worker restart, and online backup + prefix-shifted
+restore under chaos.
+
+Tier-1 runs one fast seed of each new spec; the double-run unseed
+verification (same seed => bit-identical RunDigest) is slow-marked and
+also exercised by scripts/run_chaos.py --verify-unseed, whose default
+matrix includes both specs."""
+
+import os
+
+import pytest
+
+from foundationdb_tpu.core import (DeterministicRandom, coverage,
+                                   set_deterministic_random,
+                                   set_event_loop)
+from foundationdb_tpu.rpc.sim import set_simulator
+from foundationdb_tpu.server.cluster import SimFdbCluster
+from foundationdb_tpu.server.interfaces import DatabaseConfiguration
+from foundationdb_tpu.testing import run_simulation, run_test_twice
+
+from test_recovery import commit_kv, read_key, teardown  # noqa: F401
+
+SPECS = os.path.join(os.path.dirname(__file__), "specs")
+
+# Dispatch-volume regression guard (ISSUE 10 satellite): the DR waits
+# (KillRegion/regionFailover plane + drain polls, BackupWorker url
+# watch) run through the shared DR_POLL knob with backoff-after-empty,
+# so a chaos-suite run's RunDigest fold count stays bounded.  Measured
+# ~45k folds for TwoRegionChaos seed 101 at introduction; a hot-loop
+# regression (the pre-PR-4 GRV-starter failure mode) shows up as
+# MILLIONS of extra folds, not thousands — the cap leaves ~40x headroom
+# for legitimate growth.
+TWO_REGION_FOLD_CAP = 2_000_000
+
+
+def _spec(name: str) -> str:
+    return open(os.path.join(SPECS, name)).read()
+
+
+def test_two_region_chaos_fast_seed(teardown):  # noqa: F811
+    """One seed of the region-failover battery: the nemesis provisions a
+    remote dc, hard-kills the primary UNDRAINED mid-traffic, recovery
+    adopts the remote plane at the surfaced failover_version, the acked
+    marker commit survives whenever at/below it, the dead dc is
+    re-provisioned, and the async plane fails back onto it — with
+    rolling coordinator restarts throughout and Cycle +
+    ConsistencyCheck green across the lost-tail truncation."""
+    r = run_simulation(_spec("TwoRegionChaosTest.toml"), seed=101)
+    m = r.metrics["ChaosNemesis"]
+    assert m["region_failovers"] == 1.0
+    assert m["failover_version"] > 0
+    assert m.get("failback_plane") == 1.0
+    assert m["coordinator_restarts"] >= 1
+    assert r.metrics["Cycle"]["swaps"] > 0
+    assert r.metrics["ConsistencyCheck"]["shards_audited"] >= 1
+    assert r.nondeterminism == []
+    assert coverage.covered("ChaosRegionFailover")
+    assert coverage.covered("ChaosCoordinatorRestart")
+    assert coverage.covered("RecoveryRegionFailover")
+    # Dispatch-volume guard (see TWO_REGION_FOLD_CAP).
+    assert r.folds < TWO_REGION_FOLD_CAP, (
+        f"chaos-suite dispatch volume regressed: {r.folds} folds")
+
+
+def test_backup_restore_chaos_fast_seed(teardown):  # noqa: F811
+    """One seed of the backup battery: capture spans nemesis-forced
+    epoch changes and a restart-capable fatal disk fault; the sealed
+    container restores into the live cluster under a shifted prefix and
+    matches the mutation model exactly."""
+    r = run_simulation(_spec("BackupRestoreChaosTest.toml"), seed=201)
+    m = r.metrics["BackupAndRestore"]
+    assert m["mutations"] > 0
+    assert m["backup_end_version"] > 0
+    assert m["restored_keys"] > 0
+    assert r.metrics["Cycle"]["swaps"] > 0
+    assert r.nondeterminism == []
+    assert coverage.covered("BackupRestoreUnderChaos")
+
+
+UNDRAINED_LOSS_SPEC = """
+# Forced-loss variant of TwoRegionChaosTest: the async plane is clogged
+# for a window before the kill, so the marker commit is GUARANTEED to
+# be above the surfaced failover_version and must be lost — the ring
+# invariant still holds on the truncated (version-consistent) state.
+[[test]]
+testTitle = 'UndrainedLoss'
+  [[test.workload]]
+  testName = 'Cycle'
+  nodeCount = 10
+  actorCount = 2
+  testDuration = 6.0
+  [[test.workload]]
+  testName = 'ChaosNemesis'
+  testDuration = 6.0
+  restartDelay = 1.0
+  swizzle = false
+  attrition = false
+  partitions = false
+  regionFailover = true
+  replicationLagBeforeKill = 2.0
+  failback = false
+  [[test.workload]]
+  testName = 'ConsistencyCheck'
+"""
+
+
+def test_undrained_failover_loses_tail_but_stays_consistent(teardown):  # noqa: F811,E501
+    """The acceptance-criteria core, loss side: with the async plane
+    frozen before the kill, the failover surfaces a REAL lost tail —
+    the marker acked inside the window is gone, the surfaced
+    failover_version sits below it, and Cycle's ring invariant still
+    holds on the adopted state (a version-consistent truncation, not a
+    torn mix of tags)."""
+    r = run_simulation(UNDRAINED_LOSS_SPEC, seed=301)
+    m = r.metrics["ChaosNemesis"]
+    assert m["region_failovers"] == 1.0
+    assert m["marker_lost"] == 1.0
+    assert m["marker_version"] > m["failover_version"]
+    # The ring survived the truncation; every replica agrees.
+    assert r.metrics["Cycle"]["swaps"] > 0
+    assert r.metrics["ConsistencyCheck"]["shards_audited"] >= 1
+    assert r.nondeterminism == []
+
+
+@pytest.mark.slow
+def test_two_region_chaos_double_run_unseed(teardown):  # noqa: F811
+    """Acceptance: the region battery is bit-identical under same-seed
+    double run (RunDigest + unseed + fold count)."""
+    r1, r2 = run_test_twice(_spec("TwoRegionChaosTest.toml"), seed=103)
+    assert (r1.unseed, r1.digest, r1.folds) == \
+        (r2.unseed, r2.digest, r2.folds)
+    assert r1.metrics == r2.metrics
+    assert r1.metrics["ChaosNemesis"]["region_failovers"] == 1.0
+
+
+@pytest.mark.slow
+def test_backup_restore_chaos_double_run_unseed(teardown):  # noqa: F811
+    r1, r2 = run_test_twice(_spec("BackupRestoreChaosTest.toml"), seed=203)
+    assert (r1.unseed, r1.digest, r1.folds) == \
+        (r2.unseed, r2.digest, r2.folds)
+    assert r1.metrics == r2.metrics
+    assert r1.metrics["BackupAndRestore"]["restored_keys"] > 0
+
+
+def test_coordinator_restart_repointing(teardown):  # noqa: F811
+    """ISSUE 10 satellite: kill/restart every coordination server, one
+    at a time, mid-run.  The durable generation registers recover from
+    the machine's files, the leader (re-)election converges through the
+    survivors, and the client keeps committing throughout — i.e. its
+    CoordinationClientInterface re-points via the well-known-token
+    endpoints and the GRV pipeline never wedges (only quorum-LOSS was
+    covered before, in test_restarting_quorum.py)."""
+    c = SimFdbCluster(config=DatabaseConfiguration(), n_workers=5,
+                      n_storage_workers=2)
+    db = c.database()
+
+    async def go():
+        for i in range(5):
+            await commit_kv(db, b"coord/pre%02d" % i, b"v%02d" % i)
+        for i in range(len(c.coordinators)):
+            # Alternate clean reboot and hard kill+replace-on-same-
+            # address; both must leave the old client endpoints valid.
+            p = c.restart_coordinator(i, hard=(i % 2 == 1))
+            assert p.alive
+            # Client work DURING the rolling restart: GRV + commit +
+            # read all flow through the (2/3) quorum and then re-reach
+            # the restarted server.
+            await commit_kv(db, b"coord/during%02d" % i, b"x%02d" % i)
+            assert await read_key(db, b"coord/during%02d" % i) == \
+                b"x%02d" % i
+        # Every pre-restart key still readable; a fresh commit works.
+        for i in range(5):
+            assert await read_key(db, b"coord/pre%02d" % i) == b"v%02d" % i
+        await commit_kv(db, b"coord/post", b"done")
+        assert await read_key(db, b"coord/post") == b"done"
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=300)
+    # A controller still leads (election state re-converged on the
+    # rebuilt coordinators) and all three coordinators serve.
+    assert c.current_cc() is not None
+    assert all(p.alive for p, _s in c.coordinators)
+
+
+def test_restart_coordinator_recovers_registers(teardown):  # noqa: F811
+    """A HARD coordinator restart must recover its generation registers
+    from disk: restart a majority (one at a time, sequentially) and
+    then force a full recovery — the new epoch's master reads the
+    DBCoreState through the rebuilt quorum."""
+    c = SimFdbCluster(config=DatabaseConfiguration(), n_workers=5,
+                      n_storage_workers=2)
+    db = c.database()
+
+    async def go():
+        from foundationdb_tpu.core.scheduler import delay
+        await commit_kv(db, b"reg/k", b"v1")
+        for i in range(2):          # majority of 3, sequentially
+            c.restart_coordinator(i, hard=True)
+            await delay(1.0)
+        # Force an epoch change: the next master re-reads the cstate
+        # from the restarted coordinators' recovered registers.
+        cc = c.current_cc()
+        assert cc is not None
+        proc = c.process_of(cc.db_info.master)
+        if proc is not None and proc.alive:
+            c.sim.kill_process(proc)
+        await commit_kv(db, b"reg/k2", b"v2")
+        assert await read_key(db, b"reg/k") == b"v1"
+        assert await read_key(db, b"reg/k2") == b"v2"
+        return True
+
+    assert c.run_until(c.loop.spawn(go()), timeout=300)

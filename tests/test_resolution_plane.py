@@ -275,12 +275,15 @@ def test_dbcorestate_resolver_ranges_roundtrip():
     assert out.resolver_ranges == [(b"", b"k5", 0), (b"k5", b"\xff", 1)]
     assert out.n_resolvers == 2
     # A pre-plane blob (no trailing resolver section) unpacks to [] and
-    # fails validation -> recovery re-seeds.
+    # fails validation -> recovery re-seeds.  Strip the failover record
+    # (u32+i64+i64 = 20 bytes, ISSUE 10) AND the resolver section's
+    # (empty) u16 count to reconstruct the legacy form.
     st2 = DBCoreState(epoch=1, recovery_version=0, tlog_ids=["log0"],
                       storage_ids={})
-    legacy = st2.pack()[:-2]     # strip the trailing (empty) u16 count
+    legacy = st2.pack()[:-22]
     out2 = DBCoreState.unpack(legacy)
     assert out2.resolver_ranges == []
+    assert out2.failover_epoch == 0 and out2.failover_version == 0
     assert not _valid_resolver_ranges(out2.resolver_ranges, 1)
 
 
