@@ -18,7 +18,17 @@ Layout:
                  def-use chains carrying a crossed-await bit, and a
                  lockset abstraction; built once per function on the
                  shared walk, handed to rules via begin_function().
-  rules.py    -- the shipped rules (FTL001..FTL012), each grounded in a
+  callgraph.py-- whole-lint-run call graph (ISSUE 11): module naming,
+                 absolute + relative import resolution, self/cls/super
+                 method dispatch by class, conservative unknown-callee
+                 handling; the map between the per-function dataflows.
+  summaries.py-- bottom-up function summaries composed over the call
+                 graph's SCCs (may-block w/ chain witnesses, set-valued
+                 returns, real-only clock reads) plus the top-down
+                 caller-held entry locksets and lock-param unification;
+                 per-file facts cached by content hash so --changed
+                 links the whole program without re-parsing it.
+  rules.py    -- the shipped rules (FTL001..FTL014), each grounded in a
                  bug class this repo has actually hit.
 
 Entry points: ``scripts/flowlint.py`` (CLI; scripts/run_chaos.py shells
@@ -27,13 +37,15 @@ summaries), ``run_flowlint()`` (programmatic), and the shim kept at
 ``scripts/check_trace_events.py`` (FTL007's old standalone home).
 """
 
+from .callgraph import CallGraph
 from .dataflow import FunctionDataflow
 from .engine import (Analyzer, Finding, LintResult, Rule, format_text,
                      is_actor, load_baseline, run_flowlint, write_baseline)
 from .rules import make_rules
+from .summaries import ProgramIndex
 
 __all__ = [
-    "Analyzer", "Finding", "FunctionDataflow", "LintResult", "Rule",
-    "format_text", "is_actor", "load_baseline", "make_rules",
-    "run_flowlint", "write_baseline",
+    "Analyzer", "CallGraph", "Finding", "FunctionDataflow", "LintResult",
+    "ProgramIndex", "Rule", "format_text", "is_actor", "load_baseline",
+    "make_rules", "run_flowlint", "write_baseline",
 ]

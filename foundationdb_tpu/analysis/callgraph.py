@@ -1,0 +1,394 @@
+"""Whole-lint-run call graph for flowlint (ISSUE 11).
+
+The dataflow layer (dataflow.py) stops at function boundaries; this
+module is the map between them: module naming, import resolution
+(absolute AND relative — the codebase imports almost exclusively via
+``from ..core.scheduler import delay``), and call-target resolution
+from the syntactic shapes the package actually uses:
+
+  * bare names (``helper()``), through ``from``-imports and local
+    module-level defs;
+  * module-attribute calls (``mod.helper()``) through ``import``
+    aliases and ``from pkg import submodule``;
+  * ``self.m()`` / ``cls.m()`` / ``super().m()`` method dispatch BY
+    CLASS — the enclosing class's method table first, then an MRO walk
+    over base classes resolved through the same import tables (in-
+    package bases only);
+  * ``ClassName(...)`` constructors (-> ``__init__``) and explicit
+    ``ClassName.m(...)`` calls.
+
+Everything else (``a.b.c()``, calls on arbitrary receivers, dynamic
+dispatch) is an UNKNOWN callee: it resolves to nothing, contributes no
+summary effects, and — for the caller-held-lockset seeding — its
+terminal name joins a program-wide "unresolved names" set that
+disqualifies any same-named function from claiming "I know all my
+callers" (the conservative direction: an invisible caller might hold
+no lock).
+
+Function identity is ``<root-relative path>::<qualname>`` where
+qualname is ``func`` or ``Class.method`` — the same identity
+summaries.py keys its per-file fact cache on.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .dataflow import lock_key
+
+# JSON-safe call-target specs (stored in the per-file fact cache):
+#   ["name", n]            bare call n(...)
+#   ["attr", base, attr]   base.attr(...) with a Name receiver
+#   ["self", m]            self.m(...)
+#   ["cls", m]             cls.m(...)
+#   ["super", m]           super().m(...)
+#   ["opaque", terminal]   anything else (unknown callee; terminal name
+#                          feeds the conservative disqualification set)
+
+
+def module_name_for(abspath: str) -> Tuple[str, bool]:
+    """(dotted module name, is_package) for a source file, derived from
+    the ``__init__.py`` chain above it — the name Python would import it
+    under from the topmost package's parent.  Files outside any package
+    are their own single-segment module."""
+    abspath = os.path.abspath(abspath)
+    d = os.path.dirname(abspath)
+    parts: List[str] = []
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    parts.reverse()
+    base = os.path.basename(abspath)
+    is_pkg = base == "__init__.py"
+    if not is_pkg:
+        parts.append(os.path.splitext(base)[0])
+    if not parts:                   # no package anywhere: bare stem
+        return os.path.splitext(base)[0], False
+    return ".".join(parts), is_pkg
+
+
+def build_import_tables(tree: ast.Module, module: str,
+                        is_pkg: bool) -> Dict[str, Dict[str, str]]:
+    """{'aliases': name -> absolute module, 'from': name -> absolute
+    'module.attr'} with RELATIVE imports resolved against `module` —
+    the part FileContext's tables skip (they only serve same-file
+    rules, which never need it)."""
+    aliases: Dict[str, str] = {}
+    from_abs: Dict[str, str] = {}
+    pkg_parts = module.split(".") if module else []
+    if not is_pkg and pkg_parts:
+        pkg_parts = pkg_parts[:-1]  # the file's own package
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ""
+            else:
+                anchor = pkg_parts[:len(pkg_parts) - (node.level - 1)] \
+                    if node.level - 1 <= len(pkg_parts) else []
+                if node.level - 1 > len(pkg_parts):
+                    continue        # beyond the top: unresolvable
+                base = ".".join(anchor + ([node.module]
+                                          if node.module else []))
+            if not base:
+                continue
+            for a in node.names:
+                if a.name != "*":
+                    from_abs[a.asname or a.name] = f"{base}.{a.name}"
+    return {"aliases": aliases, "from": from_abs}
+
+
+def resolve_external(tables: Dict[str, Dict[str, str]],
+                     func: ast.expr) -> Optional[str]:
+    """FileContext.resolve_call, but over the absolute import tables
+    (so relative imports resolve too): dotted name of an out-of-scope
+    call target, or None."""
+    if isinstance(func, ast.Name):
+        return tables["from"].get(func.id, func.id)
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        mod = tables["aliases"].get(func.value.id)
+        if mod is not None:
+            return f"{mod}.{func.attr}"
+        mod = tables["from"].get(func.value.id)
+        if mod is not None:
+            return f"{mod}.{func.attr}"
+    return None
+
+
+def call_spec(call: ast.Call) -> List[str]:
+    """The JSON-safe target spec for a call (see module docstring)."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ["name", f.id]
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        if isinstance(v, ast.Name):
+            if v.id == "self":
+                return ["self", f.attr]
+            if v.id == "cls":
+                return ["cls", f.attr]
+            return ["attr", v.id, f.attr]
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) and \
+                v.func.id == "super":
+            return ["super", f.attr]
+        return ["opaque", f.attr]
+    return ["opaque", ""]
+
+
+def base_spec(expr: ast.expr) -> Optional[List[str]]:
+    """Spec for a class-def base: ``Name`` or ``alias.Name``."""
+    if isinstance(expr, ast.Name):
+        return ["name", expr.id]
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        return ["attr", expr.value.id, expr.attr]
+    return None
+
+
+class CallGraph:
+    """Resolution + edges over the per-file facts summaries.py extracts.
+
+    ``facts`` is {rel path: file facts dict}; see summaries.py for the
+    schema.  Resolution is purely syntactic over those tables — nothing
+    is imported or executed."""
+
+    _MRO_CAP = 10
+
+    def __init__(self, facts: Dict[str, dict]) -> None:
+        self.facts = facts
+        # module name -> rel path (first wins on freak collisions)
+        self.module_of: Dict[str, str] = {}
+        for rel, f in facts.items():
+            self.module_of.setdefault(f["module"], rel)
+        # Terminal names of calls NOBODY could resolve: a function whose
+        # name appears here cannot claim to know all its callers.
+        self.unresolved_names: Set[str] = set()
+        # fid -> list of (caller fid, call record) built by resolve_all.
+        self.callers: Dict[str, List[Tuple[str, list]]] = {}
+        # caller fid -> [(call record, callee fid or None)] — resolution
+        # kept OUT of the fact records themselves (they round-trip
+        # through the on-disk cache and must stay pristine).
+        self.calls_of: Dict[str, List[Tuple[list, Optional[str]]]] = {}
+        # (caller fid, line, callee fid or None, raw spec) for --dump.
+        self.edges: List[Tuple[str, int, Optional[str], list]] = []
+
+    # -- identity ------------------------------------------------------------
+    @staticmethod
+    def fid(rel: str, qname: str) -> str:
+        return f"{rel}::{qname}"
+
+    def function(self, fid: str) -> Optional[dict]:
+        rel, _, qname = fid.partition("::")
+        f = self.facts.get(rel)
+        return f["functions"].get(qname) if f else None
+
+    # -- class-table helpers -------------------------------------------------
+    def _class_at(self, rel: str,
+                  name: str) -> Optional[Tuple[str, dict]]:
+        f = self.facts.get(rel)
+        if f and name in f["classes"]:
+            return rel, f["classes"][name]
+        return None
+
+    def _resolve_class_spec(self, rel: str,
+                            spec: List[str]) -> Optional[Tuple[str, dict]]:
+        """(rel, class facts) for a base/class spec seen from `rel`."""
+        f = self.facts.get(rel)
+        if f is None:
+            return None
+        tables = f["imports"]
+        if spec[0] == "name":
+            local = self._class_at(rel, spec[1])
+            if local is not None:
+                return local
+            target = tables["from"].get(spec[1])
+            if target is not None:
+                mod, _, cname = target.rpartition(".")
+                rel2 = self.module_of.get(mod)
+                if rel2 is not None:
+                    return self._class_at(rel2, cname)
+        elif spec[0] == "attr":
+            mod = tables["aliases"].get(spec[1]) or \
+                tables["from"].get(spec[1])
+            rel2 = self.module_of.get(mod) if mod else None
+            if rel2 is not None:
+                return self._class_at(rel2, spec[2])
+        return None
+
+    def _method(self, rel: str, cls_name: str, method: str,
+                skip_own: bool = False) -> Optional[str]:
+        """fid of `method` on (rel, cls_name) or the nearest in-package
+        base (BFS, depth-capped); ``skip_own`` starts at the bases
+        (``super()`` dispatch)."""
+        seen: Set[Tuple[str, str]] = set()
+        queue: List[Tuple[str, str, dict, bool]] = []
+        cls = self._class_at(rel, cls_name)
+        if cls is None:
+            return None
+        queue.append((cls[0], cls_name, cls[1], skip_own))
+        hops = 0
+        while queue and hops < self._MRO_CAP:
+            hops += 1
+            crel, cname, cfacts, skip = queue.pop(0)
+            if (crel, cname) in seen:
+                continue
+            seen.add((crel, cname))
+            if not skip and method in cfacts["methods"]:
+                return self.fid(crel, f"{cname}.{method}")
+            for bspec in cfacts["bases"]:
+                b = self._resolve_class_spec(crel, bspec)
+                if b is not None:
+                    bname = bspec[1] if bspec[0] == "name" else bspec[2]
+                    queue.append((b[0], bname, b[1], False))
+        return None
+
+    # -- call resolution -----------------------------------------------------
+    def _module_member(self, rel: str, name: str) -> Optional[str]:
+        """fid for a module-level function `name` in `rel`, or the
+        ``__init__`` of a module-level class (constructor call)."""
+        f = self.facts.get(rel)
+        if f is None:
+            return None
+        if name in f["functions"]:          # top-level functions keyed bare
+            return self.fid(rel, name)
+        if name in f["classes"]:
+            return self._method(rel, name, "__init__")
+        return None
+
+    def resolve(self, rel: str, cls_name: Optional[str],
+                spec: List[str]) -> Optional[str]:
+        """fid of a call target spec seen from (file `rel`, enclosing
+        class `cls_name`), or None for unknown callees."""
+        f = self.facts.get(rel)
+        if f is None or not spec:
+            return None
+        kind = spec[0]
+        if kind in ("self", "cls"):
+            if cls_name is None:
+                return None
+            return self._method(rel, cls_name, spec[1])
+        if kind == "super":
+            if cls_name is None:
+                return None
+            return self._method(rel, cls_name, spec[1], skip_own=True)
+        tables = f["imports"]
+        if kind == "name":
+            local = self._module_member(rel, spec[1])
+            if local is not None:
+                return local
+            target = tables["from"].get(spec[1])
+            if target is not None:
+                mod, _, member = target.rpartition(".")
+                rel2 = self.module_of.get(mod)
+                if rel2 is not None:
+                    return self._module_member(rel2, member)
+            return None
+        if kind == "attr":
+            base, attr = spec[1], spec[2]
+            mod = tables["aliases"].get(base)
+            if mod is None and tables["from"].get(base) in self.module_of:
+                mod = tables["from"][base]
+            if mod is not None:
+                rel2 = self.module_of.get(mod)
+                return self._module_member(rel2, attr) if rel2 else None
+            # ClassName.m(...) — a class in scope, explicit dispatch.
+            c = self._resolve_class_spec(rel, ["name", base])
+            if c is not None:
+                return self._method(c[0], base, attr)
+            return None
+        return None
+
+    # -- class hierarchy -----------------------------------------------------
+    def _build_hierarchy(self) -> None:
+        """Parent/child links between in-package classes.  A class with
+        an UNRESOLVED base gets a ``None`` parent — an unknown ancestor
+        may define (and internally call) anything, which matters for
+        the virtual-dispatch conservatism below."""
+        self._parents_of: Dict[Tuple[str, str], List] = {}
+        self._children_of: Dict[Tuple[str, str], List] = {}
+        for rel, f in self.facts.items():
+            for cname, c in f["classes"].items():
+                for bspec in c["bases"]:
+                    b = self._resolve_class_spec(rel, bspec)
+                    if b is None:
+                        self._parents_of.setdefault((rel, cname),
+                                                    []).append(None)
+                    else:
+                        bname = bspec[1] if bspec[0] == "name" else bspec[2]
+                        self._parents_of.setdefault(
+                            (rel, cname), []).append((b[0], bname))
+                        self._children_of.setdefault(
+                            (b[0], bname), []).append((rel, cname))
+
+    def virtually_dispatched(self, rel: str, cls: str, name: str) -> bool:
+        """True when a method's `self.`-callsites may dispatch SOMEWHERE
+        ELSE at runtime: the method overrides an ancestor's (callsites
+        in the ancestor reach the override, not the base impl — so the
+        base's resolved callers are not ALL of this method's callers),
+        is overridden by a descendant (this impl's resolved callers can
+        actually land on the override), or sits under an unresolved
+        base (unknown ancestor: anything goes).  Caller-held seeding
+        and lock-param unification both require every caller known, so
+        any of these disqualifies (the conservative direction)."""
+        seen: Set[Tuple[str, str]] = set()
+        queue = list(self._parents_of.get((rel, cls), ()))
+        while queue:                # ancestors (and unknown bases)
+            p = queue.pop()
+            if p is None:
+                return True
+            if p in seen:
+                continue
+            seen.add(p)
+            pf = self.facts.get(p[0])
+            if pf and name in pf["classes"].get(p[1], {}).get(
+                    "methods", {}):
+                return True
+            queue.extend(self._parents_of.get(p, ()))
+        seen.clear()
+        queue = list(self._children_of.get((rel, cls), ()))
+        while queue:                # descendants
+            c = queue.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            cf = self.facts.get(c[0])
+            if cf and name in cf["classes"].get(c[1], {}).get(
+                    "methods", {}):
+                return True
+            queue.extend(self._children_of.get(c, ()))
+        return False
+
+    # -- whole-graph pass ----------------------------------------------------
+    def resolve_all(self) -> None:
+        """Resolve every recorded call once: fills ``edges``,
+        ``callers`` (reverse edges), ``unresolved_names`` (the
+        conservatism set for caller-held seeding), and the class
+        hierarchy links."""
+        self._build_hierarchy()
+        for rel, f in self.facts.items():
+            for qname, fn in f["functions"].items():
+                caller = self.fid(rel, qname)
+                resolved = self.calls_of.setdefault(caller, [])
+                for call in fn["calls"]:
+                    spec = call[1]
+                    target = self.resolve(rel, fn.get("cls"), spec)
+                    self.edges.append((caller, call[0], target, spec))
+                    resolved.append((call, target))
+                    if target is not None:
+                        self.callers.setdefault(target, []).append(
+                            (caller, call))
+                    else:
+                        name = spec[-1] if spec else ""
+                        if name:
+                            self.unresolved_names.add(name)
+
+    def dump(self) -> List[Dict[str, object]]:
+        """JSON rows for ``--dump-callgraph``."""
+        return [{"caller": c, "line": line, "callee": t,
+                 "target": ".".join(str(s) for s in spec)}
+                for c, line, t, spec in
+                sorted(self.edges,
+                       key=lambda e: (e[0], e[1], e[2] or ""))]

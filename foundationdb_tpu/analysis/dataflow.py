@@ -30,9 +30,20 @@ Approximations (deliberate, documented):
     exceptional paths out of its body too (``__exit__`` releases it in
     reality) — conservative for FTL011;
   * locks are keyed by their dotted source text (``self._lock``,
-    ``self._cs._lock``) — aliasing is invisible, so two names for one
-    lock object (or one name for two objects) are not distinguished;
-    README's FTL012 caveats spell out what this can and cannot prove.
+    ``self._cs._lock``).  A LOCAL name in lock position (``with lk:``,
+    ``lk.acquire()``) is resolved through the reaching definitions at
+    that statement (ISSUE 11): when every reaching def binds the name
+    to the SAME lock-shaped attribute expression, the alias
+    canonicalizes to that dotted key and participates in lockset
+    join/meet like the attribute itself; when the defs disagree (two
+    different locks, or a mix of lock and non-lock values) the alias
+    is AMBIGUOUS — it contributes nothing to the lockset and is
+    recorded in ``alias_ambiguities`` for FTL014.  A PARAMETER in lock
+    position is kept under its own name (``lock_params`` records it)
+    and unified with the concrete lock its callers pass by the
+    interprocedural layer (summaries.py).  One name for two objects
+    across FUNCTIONS is still invisible; README's FTL012 caveats spell
+    out what this can and cannot prove.
 """
 
 from __future__ import annotations
@@ -78,6 +89,29 @@ def lock_key(expr: ast.expr) -> Optional[str]:
         return ast.unparse(expr)
     except Exception:               # pragma: no cover - defensive
         return None
+
+
+def lock_annotation(annot: Optional[ast.expr]) -> bool:
+    """True when a parameter annotation names a lock type
+    (``threading.Lock``/``RLock``/``Lock``)."""
+    if annot is None:
+        return False
+    try:
+        text = ast.unparse(annot)
+    except Exception:               # pragma: no cover - defensive
+        return False
+    return bool(re.search(r"\bR?Lock\b", text))
+
+
+def is_set_expr(node: ast.expr) -> bool:
+    """Syntactically set-valued: set literal/comprehension or a
+    ``set()``/``frozenset()`` call (shared by FTL005 and the
+    interprocedural set-valued-return summaries)."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return isinstance(node, ast.Call) and \
+        isinstance(node.func, ast.Name) and \
+        node.func.id in ("set", "frozenset")
 
 
 class DefInfo:
@@ -156,9 +190,24 @@ class FunctionDataflow:
         # `self.<attr>` access; container-mutator calls classify as write.
         self.self_accesses: List[Tuple[str, ast.AST, str, CFGNode]] = []
         self.acquired_locks: Set[str] = set()
+        # Parameters used in lock position (`with p:` / `p.acquire()`):
+        # name -> first use line.  Intraprocedurally they stay keyed by
+        # their own name; summaries.py unifies them with the concrete
+        # lock every caller passes (FTL014 flags callers that disagree).
+        self.lock_params: Dict[str, int] = {}
+        # (line, name, sorted lock keys) for each AMBIGUOUS lock alias:
+        # a Name in lock position whose reaching defs bind it to more
+        # than one lock (or a mix of lock and non-lock values).
+        self.alias_ambiguities: List[Tuple[int, str, List[str]]] = []
         self._globals: Set[str] = set()
         self._loop_stack: List[_Loop] = []
         self._exc_stack: List[List[int]] = []
+        # Bare-NAME lock positions, resolved through reaching defs
+        # AFTER the defs fixpoint (aliases canonicalize to the dotted
+        # attr key they were assigned from): (node, release node or
+        # None, Name expr, 'with'|'acquire'|'release').
+        self._pending_locks: List[Tuple[CFGNode, Optional[CFGNode],
+                                        ast.Name, str]] = []
 
         entry = self._new_node(func)
         a = func.args
@@ -326,6 +375,7 @@ class FunctionDataflow:
             header = self._new_node(stmt)
             self._link(preds, header)
             acquires: Set[str] = set()
+            deferred: List[ast.Name] = []
             for item in stmt.items:
                 self._scan_stmt(header, item.context_expr)
                 if item.optional_vars is not None:
@@ -333,9 +383,16 @@ class FunctionDataflow:
                                       item.context_expr, stmt.lineno,
                                       unpacked=True)
                 if isinstance(stmt, ast.With):
-                    key = lock_key(item.context_expr)
-                    if key is not None:
-                        acquires.add(key)
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Name):
+                        # `with lk:` — whether lk is a lock (and WHICH
+                        # lock) depends on its reaching defs, known
+                        # only after the defs fixpoint.
+                        deferred.append(ce)
+                    else:
+                        key = lock_key(ce)
+                        if key is not None:
+                            acquires.add(key)
             if isinstance(stmt, ast.AsyncWith):
                 header.barrier = True       # __aenter__/__aexit__ await;
                 #                             async locks are reactor-safe,
@@ -343,10 +400,13 @@ class FunctionDataflow:
             header.acquires = frozenset(acquires)
             self.acquired_locks |= acquires
             body_exits = self._build_body(stmt.body, [header.idx])
-            if acquires:
+            if acquires or deferred:
                 release = self._new_node(stmt)      # synthetic __exit__
                 release.releases = frozenset(acquires)
                 self._link(body_exits, release)
+                for ce in deferred:
+                    self._pending_locks.append((header, release, ce,
+                                                "with"))
                 return [release.idx]
             return body_exits
 
@@ -434,17 +494,28 @@ class FunctionDataflow:
         elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
             func = stmt.value.func
             if isinstance(func, ast.Attribute) and not stmt.value.args:
-                key = lock_key(func.value)
-                if key is not None:
-                    # acquire(timeout=...)/acquire(blocking=False) may
-                    # FAIL and return False — a MUST analysis cannot
-                    # treat it as held (the unsound direction); only a
-                    # bare blocking acquire() enters the lockset.
-                    if func.attr == "acquire" and not stmt.value.keywords:
-                        node.acquires = frozenset({key})
-                        self.acquired_locks.add(key)
-                    elif func.attr == "release":
-                        node.releases = frozenset({key})
+                # acquire(timeout=...)/acquire(blocking=False) may
+                # FAIL and return False — a MUST analysis cannot
+                # treat it as held (the unsound direction); only a
+                # bare blocking acquire() enters the lockset.
+                is_acquire = func.attr == "acquire" and \
+                    not stmt.value.keywords
+                is_release = func.attr == "release"
+                if isinstance(func.value, ast.Name):
+                    # `lk.acquire()` — alias/param, resolved after the
+                    # defs fixpoint like a `with lk:` header.
+                    if is_acquire or is_release:
+                        self._pending_locks.append(
+                            (node, None, func.value,
+                             "acquire" if is_acquire else "release"))
+                else:
+                    key = lock_key(func.value)
+                    if key is not None:
+                        if is_acquire:
+                            node.acquires = frozenset({key})
+                            self.acquired_locks.add(key)
+                        elif is_release:
+                            node.releases = frozenset({key})
 
         if isinstance(stmt, (ast.Return, ast.Raise)):
             return []               # flows to function exit (or handlers,
@@ -518,6 +589,11 @@ class FunctionDataflow:
                         pending[s] = True
                         work.append(s)
 
+        # Deferred Name-lock resolution sits BETWEEN the fixpoints: it
+        # queries the reaching defs computed above and adds acquires/
+        # releases the lockset fixpoint below then consumes.
+        self._resolve_deferred_locks()
+
         # Locksets: forward MUST analysis, meet = intersection.
         lock_outs: List[Optional[FrozenSet[str]]] = [None] * nnodes
         work = [0]
@@ -540,6 +616,103 @@ class FunctionDataflow:
             if out != lock_outs[i]:
                 lock_outs[i] = out
                 work.extend(node.succs)
+
+    def _canonical_alias_key(self, node: CFGNode,
+                             name_node: ast.Name) -> Optional[str]:
+        """Lock key for a bare NAME in lock position, judged through
+        its reaching defs at `node` (the FTL014 alias discipline):
+
+          * every reaching def binds the name to the SAME lock-shaped
+            attribute -> that attribute's dotted key (the alias
+            PARTICIPATES in lockset join/meet);
+          * the defs are all parameters -> the name itself, when the
+            param is lock-named or Lock-annotated (recorded in
+            ``lock_params`` for interprocedural unification);
+          * the defs disagree (>=2 distinct locks, or lock + non-lock
+            mix) -> None, with the ambiguity recorded for FTL014;
+          * no def is lock-shaped -> the name itself when lock-named
+            (``local_lock = threading.Lock()``), else None.
+        """
+        name = name_node.id
+        infos = {d.idx: d for d, _ in self.reaching(node, name)}.values()
+        params = [d for d in infos if d.is_param]
+        keys: Set[str] = set()
+        non_lock = False
+        for d in infos:
+            if d.is_param:
+                continue
+            if d.value is None or d.unpacked:
+                non_lock = True
+                continue
+            # `lk = a if c else b` binds one of TWO values in one def.
+            values = [d.value.body, d.value.orelse] \
+                if isinstance(d.value, ast.IfExp) else [d.value]
+            for v in values:
+                k = lock_key(v)
+                if k is not None:
+                    keys.add(k)
+                else:
+                    non_lock = True
+        if keys:
+            if len(keys) == 1 and not non_lock and not params:
+                return next(iter(keys))
+            # The unsound shape: the name IS a lock on some path but
+            # not provably ONE lock — drop it from the lockset and let
+            # FTL014 say why.
+            self.alias_ambiguities.append(
+                (getattr(name_node, "lineno", 0), name, sorted(keys)))
+            return None
+        if params and len(params) == len(list(infos)):
+            d = params[0]
+            if _LOCK_NAME.search(name) or lock_annotation(d.annotation):
+                self.lock_params.setdefault(
+                    name, getattr(name_node, "lineno", d.lineno))
+                return name
+            return None
+        if _LOCK_NAME.search(name):
+            return name             # pre-alias behavior: lock-named local
+        return None
+
+    def alias_lock_key(self, node: CFGNode,
+                       name_node: ast.Name) -> Optional[str]:
+        """PURE alias resolution for a Name in lock-ARGUMENT position
+        (``self._bump(lk)`` where ``lk = self._lock``): the single
+        attribute key every reaching def binds it to, else None.
+        Unlike ``_canonical_alias_key`` this records nothing (no
+        lock-param registration, no FTL014 ambiguity — an ambiguous
+        argument just stays unknown) and params resolve to None (a
+        param-through-param chain needs a fixpoint the canonicalizer
+        doesn't run; unknown is the silent direction)."""
+        keys: Set[str] = set()
+        infos = {d.idx: d for d, _ in
+                 self.reaching(node, name_node.id)}.values()
+        if not infos:
+            return None
+        for d in infos:
+            if d.is_param or d.value is None or d.unpacked:
+                return None
+            values = [d.value.body, d.value.orelse] \
+                if isinstance(d.value, ast.IfExp) else [d.value]
+            for v in values:
+                k = lock_key(v)
+                if k is None:
+                    return None
+                keys.add(k)
+        return next(iter(keys)) if len(keys) == 1 else None
+
+    def _resolve_deferred_locks(self) -> None:
+        for node, release, name_node, kind in self._pending_locks:
+            key = self._canonical_alias_key(node, name_node)
+            if key is None:
+                continue
+            if kind == "release":
+                node.releases = node.releases | {key}
+            else:                   # 'with' header or bare acquire()
+                node.acquires = node.acquires | {key}
+                self.acquired_locks.add(key)
+                if release is not None:
+                    release.releases = release.releases | {key}
+        del self._pending_locks
 
     # -- queries -------------------------------------------------------------
     def reaching(self, node: Optional[CFGNode],

@@ -109,6 +109,16 @@ class Rule:
     def end_file(self, ctx: "FileContext") -> None:  # noqa: B027
         pass
 
+    def finish_program(self, program,
+                       report: Callable[[Finding], None]) -> None:  # noqa: B027
+        """Interprocedural checks (ISSUE 11), called once after every
+        file was walked AND the ProgramIndex (summaries.py: call graph,
+        function summaries, caller-held locksets) was linked.  Any rule
+        overriding this makes the Analyzer build the program context.
+        ``report`` honors per-line suppressions for findings located in
+        scanned files — unlike ``finish``'s raw callback."""
+        pass
+
     def finish(self, report: Callable[[Finding], None]) -> None:  # noqa: B027
         """Cross-file checks, called once after every file was walked."""
         pass
@@ -133,6 +143,10 @@ class FileContext:
         # Dataflow stack: one FunctionDataflow per enclosing function,
         # innermost last (pushed/popped by the Analyzer's walk).
         self.cfg_stack: List[object] = []
+        # Every (function node, FunctionDataflow, enclosing class name,
+        # nested?) the walk built — the interprocedural layer extracts
+        # its per-file facts from these instead of re-analyzing.
+        self.cfg_records: List[tuple] = []
         # Lines carrying the `# flowlint: state` annotation (the Flow
         # `state`-keyword port, consumed by FTL010).
         self.state_lines: Set[int] = {
@@ -227,6 +241,46 @@ class FileContext:
         self.findings.append(Finding(rule.id, self.path, line, message))
 
 
+def topmost_package(path: str) -> Optional[str]:
+    """The outermost directory above `path` that is part of the same
+    package chain (consecutive ``__init__.py``), or None when the file
+    sits outside any package."""
+    pkg, top = os.path.dirname(os.path.abspath(path)), None
+    while os.path.exists(os.path.join(pkg, "__init__.py")):
+        top = pkg
+        pkg = os.path.dirname(pkg)
+    return top
+
+
+def iter_py_files(root: str):
+    """Yield (abspath, root-relative path) for every .py under root.
+    A single-FILE root is rel-ified against its topmost enclosing
+    PACKAGE (the dir the default directory scan uses as root), so a
+    directly-linted core/scheduler.py gets path 'core/scheduler.py'
+    — identical to the directory-scan finding: module exemptions
+    ('core/scheduler.py', 'server/') keep matching AND baseline
+    entries written by a full scan still cover it.  Outside any
+    package, fall back to cwd-relative (portable), then absolute.
+    Shared by the Analyzer's scan and the interprocedural layer's
+    program enumeration (summaries.py) so both see the SAME rel-path
+    identity for every file."""
+    root = os.path.abspath(root)
+    if os.path.isfile(root):
+        top = topmost_package(root)
+        rel = os.path.relpath(root, top or os.getcwd())
+        if top is None and rel.startswith(".."):
+            rel = root
+        yield root, rel.replace(os.sep, "/")
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                yield path, os.path.relpath(path, root).replace(
+                    os.sep, "/")
+
+
 def _suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
     """(per-line suppressed ids, file-wide suppressed ids).  'all' in a
     set suppresses every rule."""
@@ -273,8 +327,10 @@ class LintResult:
 class Analyzer:
     """Runs a rule set over one or more roots (directories or files)."""
 
-    def __init__(self, rules: Sequence[Rule]) -> None:
+    def __init__(self, rules: Sequence[Rule],
+                 summary_cache: Optional[str] = None) -> None:
         self.rules = list(rules)
+        self.summary_cache = summary_cache
         # Per-node dispatch dominates the lint runtime (PERF.md): only
         # call the hooks a rule actually overrides.  Dataflow-only
         # rules (FTL010-012) never pay the per-node visit fan-out.
@@ -283,38 +339,18 @@ class Analyzer:
         self._fn_rules = [r for r in self.rules
                           if type(r).begin_function is not
                           Rule.begin_function]
+        # Rules with interprocedural checks make the run build and link
+        # a ProgramIndex; single-rule runs (the check_trace_events
+        # shim) pay for neither the dataflow nor the program context.
+        self._ip_rules = [r for r in self.rules
+                          if type(r).finish_program is not
+                          Rule.finish_program]
         self._needs_dataflow = bool(self._fn_rules) or \
+            bool(self._ip_rules) or \
             any(r.uses_dataflow for r in self.rules)
 
     # -- file discovery ------------------------------------------------------
-    @staticmethod
-    def _iter_files(root: str):
-        """Yield (abspath, root-relative path) for every .py under root.
-        A single-FILE root is rel-ified against its topmost enclosing
-        PACKAGE (the dir the default directory scan uses as root), so a
-        directly-linted core/scheduler.py gets path 'core/scheduler.py'
-        — identical to the directory-scan finding: module exemptions
-        ('core/scheduler.py', 'server/') keep matching AND baseline
-        entries written by a full scan still cover it.  Outside any
-        package, fall back to cwd-relative (portable), then absolute."""
-        root = os.path.abspath(root)
-        if os.path.isfile(root):
-            pkg, top = os.path.dirname(root), None
-            while os.path.exists(os.path.join(pkg, "__init__.py")):
-                top = pkg
-                pkg = os.path.dirname(pkg)
-            rel = os.path.relpath(root, top or os.getcwd())
-            if top is None and rel.startswith(".."):
-                rel = root
-            yield root, rel.replace(os.sep, "/")
-            return
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
-            for fn in sorted(filenames):
-                if fn.endswith(".py"):
-                    path = os.path.join(dirpath, fn)
-                    yield path, os.path.relpath(path, root).replace(
-                        os.sep, "/")
+    _iter_files = staticmethod(iter_py_files)
 
     # -- the single shared walk ----------------------------------------------
     def _walk(self, node: ast.AST, ctx: FileContext) -> None:
@@ -332,6 +368,10 @@ class Analyzer:
                 # shared walk, and fan it out to every rule — rules
                 # must query it, never re-walk or re-analyze.
                 cfg = FunctionDataflow(node)
+                ctx.cfg_records.append(
+                    (node, cfg,
+                     ctx.class_stack[-1].name if ctx.class_stack else None,
+                     len(ctx.func_stack) > 1))
                 ctx.cfg_stack.append(cfg)
                 for rule in self._fn_rules:
                     rule.begin_function(cfg, ctx)
@@ -346,6 +386,11 @@ class Analyzer:
             baseline: Optional[List[Dict[str, str]]] = None) -> LintResult:
         result = LintResult()
         raw: List[Finding] = []
+        program = None
+        if self._ip_rules:
+            from .summaries import ProgramIndex
+            program = ProgramIndex.for_roots(
+                roots, cache_path=self.summary_cache)
         for root in roots:
             for path, rel in self._iter_files(root):
                 result.files_scanned += 1
@@ -369,6 +414,24 @@ class Analyzer:
                         result.suppressed += 1
                     else:
                         raw.append(f)
+                if program is not None:
+                    program.add_scanned(ctx, path)
+        if program is not None:
+            # Link the whole program (cache/standalone facts for files
+            # outside the scanned set), then run the interprocedural
+            # checks — their reports honor per-line suppressions, which
+            # finish()-time findings otherwise bypass.
+            program.link()
+
+            def _report_ip(f: Finding) -> None:
+                if program.is_suppressed(f.rule, f.path, f.line):
+                    result.suppressed += 1
+                else:
+                    raw.append(f)
+
+            for rule in self._ip_rules:
+                rule.finish_program(program, _report_ip)
+            program.save_cache()
         for rule in self.rules:
             rule.finish(raw.append)
         # Baseline matching: consume entries with multiplicity.
@@ -425,10 +488,13 @@ def format_text(result: LintResult) -> str:
 
 
 def run_flowlint(roots: Sequence[str], rules: Optional[Sequence[Rule]] = None,
-                 baseline_path: Optional[str] = None) -> LintResult:
+                 baseline_path: Optional[str] = None,
+                 summary_cache: Optional[str] = None) -> LintResult:
     """Programmatic entry point (fresh rule instances per run — rules
-    carry cross-file state)."""
+    carry cross-file state).  ``summary_cache`` is the interprocedural
+    fact cache path (None = extract everything live, the default for
+    programmatic runs so tests never write cache files)."""
     from .rules import make_rules
     baseline = load_baseline(baseline_path) if baseline_path else []
-    return Analyzer(list(rules) if rules is not None
-                    else make_rules()).run(roots, baseline)
+    return Analyzer(list(rules) if rules is not None else make_rules(),
+                    summary_cache=summary_cache).run(roots, baseline)

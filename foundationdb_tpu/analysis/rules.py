@@ -1,17 +1,20 @@
-"""flowlint rules FTL001..FTL012.
+"""flowlint rules FTL001..FTL014.
 
 Every rule is grounded in a bug class this repo has actually hit (see
 ISSUE/PR history): wall-clock reads that break unseed reproduction,
 str keys that crashed ``_pack_end``, broad excepts that can swallow
-``ActorCancelled``, tunables hardcoded outside core/knobs.py.
+``ActorCancelled``, tunables hardcoded outside core/knobs.py, the
+caller-holds-the-lock contracts review used to police by hand.
 
 Adding a rule: subclass ``engine.Rule``, set ``id``/``title``, implement
 ``visit`` (called once per AST node — never walk the tree yourself;
-per-file prep goes in ``begin_file``, cross-file checks in ``finish``)
-and/or ``begin_function`` (handed each function's FunctionDataflow —
-CFG, reaching-defs/def-use chains, locksets; dataflow.py), append it in
-``make_rules()``, document it in README's rule table, and add a
-known-bad fixture under tests/fixtures/flowlint/ with
+per-file prep goes in ``begin_file``, cross-file checks in ``finish``),
+``begin_function`` (handed each function's FunctionDataflow — CFG,
+reaching-defs/def-use chains, locksets; dataflow.py), and/or
+``finish_program`` (handed the linked ProgramIndex — call graph,
+bottom-up function summaries, caller-held locksets; summaries.py),
+append it in ``make_rules()``, document it in README's rule table, and
+add a known-bad fixture under tests/fixtures/flowlint/ with
 ``# expect: FTLnNN:<line>`` markers.
 """
 
@@ -63,6 +66,18 @@ class WallClockRule(Rule):
               "time.monotonic_ns", "time.perf_counter",
               "time.perf_counter_ns"}
 
+    @classmethod
+    def is_nondeterministic(cls, name: Optional[str]) -> bool:
+        """The ONE wall-clock/entropy predicate — the direct rule and
+        summaries.py's clock roots must agree on what counts as a
+        read, so both call this."""
+        if name is None:
+            return False
+        if name in cls.CLOCKS or name == "os.urandom" or \
+                name == "random.SystemRandom":
+            return True
+        return name.startswith("random.") and name != "random.Random"
+
     def visit(self, node: ast.AST, ctx) -> None:
         if not isinstance(node, ast.Call) or not _sim_reachable(ctx.path):
             return
@@ -83,6 +98,32 @@ class WallClockRule(Rule):
                        f"module-level {name}() draws shared interpreter "
                        "RNG state: use core.rng.deterministic_random() "
                        "or a seeded random.Random instance")
+
+    def finish_program(self, program, report) -> None:
+        """ISSUE 11: clock reads reached VIA HELPERS.  REAL_ONLY
+        modules are exempt from the direct check because they are
+        'never imported on a sim path by construction' — this pass
+        verifies the construction: a sim-reachable callsite whose
+        resolved callee chain lands on an unguarded wall-clock/entropy
+        read inside a real-only module is exactly such an import.
+        Mode-guarded functions (a ``sim`` branch, EventLoop.now()'s
+        shape) and suppressed read sites never propagate."""
+        for rel, qname, fn, fid in program.iter_scanned_functions():
+            if not _sim_reachable(rel):
+                continue
+            for call, target in program.calls_with_targets(fid):
+                if target is None or not program.may_clock(target):
+                    continue
+                tfn = program.graph.function(target)
+                if tfn is not None and tfn["async"] and not call[3]:
+                    continue        # coroutine built, never run
+                chain = " -> ".join(program.clock_chain(target))
+                report(Finding(
+                    self.id, rel, call[0],
+                    f"call into {target} reaches a wall-clock/entropy "
+                    f"read sanctioned only for real-only modules "
+                    f"({chain}): sim-reachable code must route through "
+                    "core.scheduler.now() / core.rng"))
 
 
 class UnawaitedCoroutineRule(Rule):
@@ -252,6 +293,13 @@ class SetIterationRule(Rule):
     title = "set iteration order is PYTHONHASHSEED-dependent"
     uses_dataflow = True            # reads ctx.cfg from visit()
 
+    def __init__(self) -> None:
+        # Iteration sites whose set-valuedness hinges on calls the
+        # per-file pass cannot resolve (cross-file imports, same-file
+        # chains deeper than one hop): decided against the linked
+        # summaries in finish_program (ISSUE 11).
+        self._deferred: List[tuple] = []
+
     _SET_ANNOT = re.compile(
         r"^(typing\.)?(set|frozenset|Set|FrozenSet|AbstractSet|"
         r"MutableSet)\b")
@@ -298,29 +346,40 @@ class SetIterationRule(Rule):
             return False
         return bool(self._SET_ANNOT.match(text))
 
-    def _set_valued(self, expr: ast.expr, ctx, depth: int = 0) -> bool:
+    def _set_valued(self, expr: ast.expr, ctx, targets: List[list],
+                    depth: int = 0) -> bool:
         """Is `expr` a set, judging through the current function's
         def-use chains?  Depth-bounded; unpacked/augmented defs are
-        opaque (never set-valued)."""
+        opaque (never set-valued).  Calls this file-local pass cannot
+        judge append their target spec to `targets` — the ISSUE-11
+        deferral: if the linked summaries later prove ANY of them
+        set-valued, the iteration is flagged from finish_program."""
         if depth > 4:
             return False
         if self._is_set_expr(expr):
             return True
         if isinstance(expr, ast.BinOp) and isinstance(expr.op,
                                                       self._SET_OPS):
-            return self._set_valued(expr.left, ctx, depth + 1) or \
-                self._set_valued(expr.right, ctx, depth + 1)
+            return self._set_valued(expr.left, ctx, targets, depth + 1) or \
+                self._set_valued(expr.right, ctx, targets, depth + 1)
         if isinstance(expr, ast.Call):
             f = expr.func
-            if isinstance(f, ast.Name) and f.id in self._set_helpers:
-                return True
+            if isinstance(f, ast.Name):
+                if f.id in self._set_helpers:
+                    return True
+                targets.append(["name", f.id])
+                return False
             if isinstance(f, ast.Attribute):
-                if f.attr in self._set_helpers and \
-                        isinstance(f.value, ast.Name) and \
-                        f.value.id == "self":
-                    return True     # self-call of a set-returning method
                 if f.attr in self._SET_METHODS:
-                    return self._set_valued(f.value, ctx, depth + 1)
+                    return self._set_valued(f.value, ctx, targets,
+                                            depth + 1)
+                if isinstance(f.value, ast.Name):
+                    if f.value.id == "self":
+                        if f.attr in self._set_helpers:
+                            return True  # set-returning method, one hop
+                        targets.append(["self", f.attr])
+                    else:
+                        targets.append(["attr", f.value.id, f.attr])
             return False
         if isinstance(expr, ast.Name):
             cfg = ctx.cfg
@@ -332,7 +391,8 @@ class SetIterationRule(Rule):
                     if self._set_annotation(dinfo.annotation):
                         return True
                 elif not dinfo.unpacked and dinfo.value is not None and \
-                        self._set_valued(dinfo.value, ctx, depth + 1):
+                        self._set_valued(dinfo.value, ctx, targets,
+                                         depth + 1):
                     return True
             return False
         return False
@@ -343,12 +403,53 @@ class SetIterationRule(Rule):
                        "iteration over a set: order depends on "
                        "PYTHONHASHSEED for str elements — wrap in "
                        "sorted() (deterministic) before iterating")
-        elif isinstance(it, ast.Name) and self._set_valued(it, ctx):
-            ctx.report(self, it,
-                       f"iteration over set-valued '{it.id}': order "
-                       "depends on PYTHONHASHSEED for str elements — "
-                       "wrap in sorted() (deterministic) before "
-                       "iterating")
+        elif isinstance(it, (ast.Name, ast.Call)):
+            targets: List[list] = []
+            if isinstance(it, ast.Name) and self._set_valued(it, ctx,
+                                                             targets):
+                ctx.report(self, it,
+                           f"iteration over set-valued '{it.id}': order "
+                           "depends on PYTHONHASHSEED for str elements — "
+                           "wrap in sorted() (deterministic) before "
+                           "iterating")
+                return
+            if isinstance(it, ast.Call):
+                # `for x in helper():` — one-hop same-file helpers flag
+                # here; everything else defers to the summaries.
+                if self._set_valued(it, ctx, targets):
+                    ctx.report(self, it,
+                               "iteration over a set-returning call: "
+                               "order depends on PYTHONHASHSEED for str "
+                               "elements — wrap in sorted() "
+                               "(deterministic) before iterating")
+                    return
+            if targets:
+                cls = ctx.class_stack[-1].name if ctx.class_stack else None
+                name = it.id if isinstance(it, ast.Name) else \
+                    "the iterated call"
+                self._deferred.append(
+                    (ctx.path, getattr(it, "lineno", 0), name, cls,
+                     targets))
+
+    def finish_program(self, program, report) -> None:
+        """Resolve the deferred candidates against the set-valued-return
+        summaries (cross-file helpers, same-file chains deeper than the
+        one-hop ``begin_file`` table, recursion through SCCs)."""
+        for path, line, name, cls, targets in self._deferred:
+            hit = None
+            for spec in targets:
+                fid = program.resolve(path, cls, spec)
+                if program.set_valued(fid):
+                    hit = fid
+                    break
+            if hit is not None:
+                report(Finding(
+                    self.id, path, line,
+                    f"iteration over set-valued '{name}': {hit} "
+                    "returns a set on every path (judged through the "
+                    "interprocedural summaries) — order depends on "
+                    "PYTHONHASHSEED for str elements; wrap in sorted() "
+                    "before iterating"))
 
     def visit(self, node: ast.AST, ctx) -> None:
         if not _sim_reachable(ctx.path):
@@ -870,11 +971,16 @@ class LocksetDisciplineRule(Rule):
     ``self.x[k] =``, or a container-mutator call like ``.append()``)
     must not be read or written at another site with an EMPTY lockset.
     ``__init__``/``__new__`` are exempt (object construction
-    happens-before publication).  What this cannot prove (README):
-    locks are keyed by source text, not object identity; accesses
-    through an alias (``cs = self._x; cs._needs``) and cross-object
-    guards are invisible; a lock-free access that is safe by a
-    happens-before argument needs a justified suppression."""
+    happens-before publication).  Since ISSUE 11 every access lockset
+    is SEEDED interprocedurally before the discipline check: the meet
+    of caller-held locksets for private methods whose callers are all
+    known (the ``Tracer._roll`` "caller holds the lock" contract,
+    previously a justified suppression, now proven), and lock
+    PARAMETERS canonicalized to the one lock every caller passes.
+    What this cannot prove (README): locks are keyed by source text,
+    not object identity; cross-object guards are invisible; a
+    lock-free access that is safe by a happens-before argument needs a
+    justified suppression."""
 
     id = "FTL012"
     title = "lock-guarded attribute accessed with empty lockset"
@@ -882,35 +988,41 @@ class LocksetDisciplineRule(Rule):
     LOCK_FACTORIES = ("threading.Lock", "threading.RLock")
 
     class _ClassState:
-        __slots__ = ("node", "owns_lock", "acquired",
-                     "accesses")
+        __slots__ = ("name", "path", "owns_lock", "acquired", "accesses")
 
-        def __init__(self, node: ast.ClassDef) -> None:
-            self.node = node
+        def __init__(self, name: str, path: str) -> None:
+            self.name = name
+            self.path = path
             self.owns_lock = False
             self.acquired: Set[str] = set()
-            # attr -> [(kind, lockset, ast node, function name)]
+            # attr -> [(kind, lockset, line, function name)]
             self.accesses: Dict[str, List[tuple]] = {}
 
+    def __init__(self) -> None:
+        # Keyed (path, class node id): reporting happens at
+        # finish_program time, after the caller-held locksets exist.
+        self._classes: Dict[tuple, LocksetDisciplineRule._ClassState] = {}
+
     def begin_file(self, ctx) -> None:
-        self._classes: Dict[int, LocksetDisciplineRule._ClassState] = {}
         for a in ctx.nodes_of(ast.Assign):
             if isinstance(a.value, ast.Call) and \
                     ctx.resolve_call(a.value.func) in self.LOCK_FACTORIES:
                 cls = ctx.enclosing(a, (ast.ClassDef,))
                 if cls is not None:
-                    self._state_for(cls).owns_lock = True
+                    self._state_for(ctx, cls).owns_lock = True
 
-    def _state_for(self, cls: ast.ClassDef) -> "_ClassState":
-        state = self._classes.get(id(cls))
+    def _state_for(self, ctx, cls: ast.ClassDef) -> "_ClassState":
+        key = (ctx.path, id(cls))
+        state = self._classes.get(key)
         if state is None:
-            state = self._classes[id(cls)] = self._ClassState(cls)
+            state = self._classes[key] = self._ClassState(cls.name,
+                                                          ctx.path)
         return state
 
     def begin_function(self, cfg, ctx) -> None:
         if not ctx.class_stack:
             return
-        state = self._state_for(ctx.class_stack[-1])
+        state = self._state_for(ctx, ctx.class_stack[-1])
         state.acquired |= {k for k in cfg.acquired_locks
                            if k.startswith("self.")}
         fname = cfg.func.name
@@ -920,32 +1032,144 @@ class LocksetDisciplineRule(Rule):
             if kind == "call" or lock_key(node_ast) is not None:
                 continue            # methods / the lock objects themselves
             state.accesses.setdefault(attr, []).append(
-                (kind, cfg.lockset(cnode), node_ast, fname))
+                (kind, cfg.lockset(cnode),
+                 getattr(node_ast, "lineno", 0), fname))
 
-    def end_file(self, ctx) -> None:
+    def finish_program(self, program, report) -> None:
         for state in self._classes.values():
             if not (state.owns_lock or state.acquired):
                 continue
+            seeded: Dict[str, frozenset] = {}
+            canons: Dict[str, Dict[str, str]] = {}
             for attr, accs in sorted(state.accesses.items()):
-                guarded = [a for a in accs if a[0] == "write" and a[1]]
+                eff = []
+                for kind, locks, line, fname in accs:
+                    qname = f"{state.name}.{fname}"
+                    if qname not in seeded:
+                        seeded[qname] = program.entry_locks(state.path,
+                                                            qname)
+                        canons[qname] = program.param_canon(state.path,
+                                                            qname)
+                    canon = canons[qname]
+                    held = frozenset(canon.get(k, k) for k in locks) | \
+                        seeded[qname]
+                    eff.append((kind, held, line, fname))
+                guarded = [a for a in eff if a[0] == "write" and a[1]]
                 if not guarded:
                     continue
                 locks = frozenset.intersection(*(a[1] for a in guarded))
-                lock_txt = ", ".join(sorted(locks or
-                                            next(iter(guarded))[1]))
-                gw_kind, _gl, gw_node, gw_fn = guarded[0]
-                for kind, held, node_ast, fname in accs:
+                lock_txt = ", ".join(sorted(locks or guarded[0][1]))
+                _gw_kind, _gl, gw_line, gw_fn = guarded[0]
+                for kind, held, line, fname in eff:
                     if held:
                         continue
-                    ctx.report(self, node_ast,
-                               f"{state.node.name}.{attr} is written "
-                               f"under {lock_txt} ({gw_fn}, line "
-                               f"{getattr(gw_node, 'lineno', 0)}) but "
-                               f"{'written' if kind == 'write' else 'read'}"
-                               f" lock-free in {fname}: racy against "
-                               "the guarded sites — take the lock, or "
-                               "suppress with the happens-before "
-                               "argument")
+                    report(Finding(
+                        self.id, state.path, line,
+                        f"{state.name}.{attr} is written under "
+                        f"{lock_txt} ({gw_fn}, line {gw_line}) but "
+                        f"{'written' if kind == 'write' else 'read'}"
+                        f" lock-free in {fname}: racy against "
+                        "the guarded sites — take the lock, or "
+                        "suppress with the happens-before "
+                        "argument"))
+
+
+class TransitiveBlockingRule(Rule):
+    """FTL013: a call under a held threading lock whose callee — judged
+    through the bottom-up summaries — reaches an unbounded block.
+
+    FTL011 sees the ``.result()`` under the ``with self._lock:``; it
+    cannot see ``with self._lock: self._drain()`` where ``_drain``
+    (or something IT calls, any depth) does the timeout-less wait.
+    The summaries make that one query: ``may_block(callee)``, LFP over
+    the call graph, propagated through plain calls to sync callees
+    only (an awaited callee's blocking is FTL011's await-under-lock
+    finding at the caller; an un-awaited async call never runs).  The
+    finding renders the full chain to the blocking site.  Findings
+    fire only where the lock is LOCALLY held — deeper frames of the
+    same chain would re-report the same hazard through their
+    caller-held entry locksets, so those stay quiet.  A wrapper that
+    FORWARDS a timeout (``fut.result(timeout=t)``) never enters the
+    summary: timeouts are checked through wrappers for free.  Unknown
+    callees contribute nothing (conservative: no invented findings)."""
+
+    id = "FTL013"
+    title = "transitive unbounded block while holding a lock"
+
+    def finish_program(self, program, report) -> None:
+        for rel, qname, fn, fid in program.iter_scanned_functions():
+            canon = program.param_canon(rel, qname)
+            for call, target in program.calls_with_targets(fid):
+                line, _spec, locks, awaited, _largs = call
+                if awaited or target is None or not locks:
+                    continue
+                tfn = program.graph.function(target)
+                if tfn is None or tfn["async"]:
+                    continue
+                if not program.may_block(target):
+                    continue
+                held = ", ".join(sorted(canon.get(k, k) for k in locks))
+                chain = " -> ".join(
+                    [f"{rel}::{qname} line {line}"]
+                    + program.block_chain(target))
+                report(Finding(
+                    self.id, rel, line,
+                    f"call while holding {held} reaches an unbounded "
+                    f"block: {chain} — the lock stays held across the "
+                    "wait (deadlock if the completion needs the lock, "
+                    "convoy otherwise); release before calling, or "
+                    "bound the wait with timeout="))
+
+
+class LockAliasRule(Rule):
+    """FTL014: lock aliasing discipline.
+
+    A single-valued alias (``lk = self._lock; with lk:``) now
+    PARTICIPATES in lockset join/meet — the dataflow layer resolves it
+    to the underlying attribute key, so FTL011/012/013 see through it
+    (previously the alias silently dropped out of the lockset, the
+    ``cs = self._x`` blind spot).  What this rule FLAGS is the residue
+    static analysis cannot see through:
+
+      * an alias whose reaching defs bind DIFFERENT locks (or a lock
+        on one path and a non-lock on another) — its critical sections
+        guard "some lock", which proves nothing;
+      * a lock PARAMETER whose callers pass different locks — the
+        callee's ``with lk:`` guards a different lock per callsite,
+        so no cross-site discipline can be established.
+
+    Both fixes are the same: name ONE lock (acquire the attribute
+    directly, or split the function per lock)."""
+
+    id = "FTL014"
+    title = "ambiguous lock alias defeats lockset analysis"
+
+    def begin_function(self, cfg, ctx) -> None:
+        seen = set()
+        for line, name, keys in cfg.alias_ambiguities:
+            key = (name, tuple(keys))
+            if key in seen:
+                continue
+            seen.add(key)
+            ctx.report(self, line,
+                       f"lock alias '{name}' may hold different locks "
+                       f"here ({', '.join(keys)}): its critical "
+                       "sections guard no ONE provable lock — bind the "
+                       "alias to a single lock (or use the attribute "
+                       "directly)")
+
+    def finish_program(self, program, report) -> None:
+        for rel, qname, pline, p, keymap in program.param_conflicts:
+            if rel not in program.scanned:
+                continue
+            detail = "; ".join(f"{k} from {', '.join(v)}"
+                               for k, v in sorted(keymap.items()))
+            report(Finding(
+                self.id, rel, pline,
+                f"lock parameter '{p}' of {qname} receives a DIFFERENT "
+                f"lock per caller ({detail}): no cross-site lockset "
+                "discipline can be established through it — pass one "
+                "lock, or split the function per lock"))
 
 
 def make_rules() -> List[Rule]:
@@ -958,4 +1182,5 @@ def make_rules() -> List[Rule]:
             BlockingInActorRule(), TraceEventRule(),
             HardcodedTunableRule(), KnobNameRule(),
             StaleStateAcrossAwaitRule(), AwaitHoldingLockRule(),
-            LocksetDisciplineRule()]
+            LocksetDisciplineRule(), TransitiveBlockingRule(),
+            LockAliasRule()]

@@ -1,0 +1,765 @@
+"""Bottom-up function summaries for flowlint (ISSUE 11).
+
+The dataflow layer answers questions about ONE function; this module
+answers the cross-function ones the remaining hazard shapes need:
+
+  * **may-block-unbounded** — does calling this (sync) function ever
+    reach a timeout-less ``.result()/.wait()/.join()/.get()/.acquire()``
+    or ``time.sleep`` through any chain of plain calls?  (FTL013: a
+    callsite under a held lock reaching such a function is a
+    deadlock/convoy hazard; the finding renders the chain.)
+  * **set-valued return** — does this function always return a set,
+    judging returned calls through callee summaries (FTL005 through
+    arbitrarily deep in-package chains; recursion converges via a
+    greatest-fixpoint over the call-graph SCCs)?
+  * **may-read-wall-clock** — does this REAL_ONLY-module function reach
+    an unguarded wall-clock/entropy read (FTL001 at sim-reachable
+    callsites: the static verification of the "never imported on a sim
+    path" construction)?
+  * **caller-held locksets** — for a private function every caller of
+    which is known, the MEET (intersection) of the locksets held at
+    all its callsites: FTL012 seeds each function's entry lockset with
+    it, so ``Tracer._roll``'s "caller holds the lock" contract is
+    PROVEN instead of suppressed.
+  * **lock-parameter unification** — a parameter used in lock position
+    is unified with the one concrete lock every caller passes (it then
+    participates in FTL012's join/meet); callers that disagree are an
+    FTL014 finding.
+
+Facts are extracted per FILE (one dict per file, JSON-safe) and cached
+on disk keyed by content hash, so ``--changed`` runs reuse the whole
+unchanged program's facts without re-parsing; the cross-file passes
+(call-graph resolution + fixpoints) are cheap and recomputed per run.
+Summary composition is the RacerD/Infer shape: intraprocedural facts
+feed compact per-function summaries, summaries compose bottom-up over
+SCCs in reverse topological order (here: monotone worklist fixpoints,
+which converge identically and need no explicit SCC enumeration), and
+rules consume summaries instead of re-analyzing callees.
+
+Conservative unknown-callee handling: an unresolvable call contributes
+NO summary effects (never invents a finding), and its terminal name
+disqualifies same-named functions from the caller-held seeding (an
+invisible caller might hold no lock — the direction that would
+SILENCE a real race is the one that needs all callers known).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .callgraph import (CallGraph, base_spec, build_import_tables,
+                        call_spec, module_name_for, resolve_external)
+from .dataflow import FunctionDataflow, is_set_expr, lock_key
+from .engine import _suppressions, iter_py_files, topmost_package
+from .rules import AwaitHoldingLockRule, WallClockRule, _sim_reachable
+
+CACHE_VERSION = 1
+
+# THE wait-method and clock predicates live on the rules (FTL011 /
+# FTL001); the summaries import them so the transitive reach can never
+# drift from the direct checks.
+WAIT_METHODS = AwaitHoldingLockRule.WAIT_METHODS
+
+_SET_OPS = (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+_SET_METHODS = ("union", "intersection", "difference",
+                "symmetric_difference", "copy")
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _hash_source(source: str) -> str:
+    return hashlib.sha1(
+        f"v{CACHE_VERSION}:".encode() + source.encode()).hexdigest()
+
+
+_is_clock_name = WallClockRule.is_nondeterministic
+
+
+def _is_private(name: str) -> bool:
+    return name.startswith("_") and not (
+        name.startswith("__") and name.endswith("__"))
+
+
+def _classify_return(v: Optional[ast.expr], cfg: FunctionDataflow,
+                     node, depth: int = 0):
+    """JSON-safe set-valuedness classification of one return value:
+    'set' | 'other' | ['call', *spec] | ['any', [...]] (set operator:
+    set if EITHER side is) | ['all', [...]] (multi-def name: set only
+    if every reaching def is).  Evaluated against callee summaries at
+    link time."""
+    if v is None or depth > 3:
+        return "other"
+    if is_set_expr(v):
+        return "set"
+    if isinstance(v, ast.BinOp) and isinstance(v.op, _SET_OPS):
+        return ["any", [_classify_return(v.left, cfg, node, depth + 1),
+                        _classify_return(v.right, cfg, node, depth + 1)]]
+    if isinstance(v, ast.Call):
+        if isinstance(v.func, ast.Attribute) and \
+                v.func.attr in _SET_METHODS:
+            return _classify_return(v.func.value, cfg, node, depth + 1)
+        spec = call_spec(v)
+        if spec[0] != "opaque":
+            return ["call"] + spec
+        return "other"
+    if isinstance(v, ast.Name):
+        infos = {d.idx: d for d, _ in cfg.reaching(node, v.id)}.values()
+        subs = []
+        for d in infos:
+            if d.is_param or d.unpacked or d.value is None:
+                return "other"
+            subs.append(_classify_return(d.value, cfg, node, depth + 1))
+        if not subs:
+            return "other"
+        return subs[0] if len(subs) == 1 else ["all", subs]
+    return "other"
+
+
+def _line_suppressed(rule_id: str, line: int, suppress_line,
+                     suppress_file) -> bool:
+    ids = suppress_line.get(line, set()) | suppress_file
+    return rule_id in ids or "all" in ids
+
+
+def _arg_lock_keys(call: ast.Call, cfg: FunctionDataflow,
+                   node) -> List[List[object]]:
+    """[[position-or-keyword, lock key], ...] for every lock-shaped
+    argument — how a concrete lock flows into a lock PARAMETER.  A Name
+    argument resolves through the caller's reaching defs (``lk =
+    self._lock; self._bump(lk)`` must unify like the attribute itself,
+    not read as a DIFFERENT lock named 'lk' — a review catch)."""
+    def key_of(a: ast.expr) -> Optional[str]:
+        if isinstance(a, ast.Name):
+            # Reaching defs FIRST: a lock-NAMED alias (`the_lock =
+            # self._lock`) must canonicalize to the attribute, not to
+            # its own caller-frame spelling.
+            return cfg.alias_lock_key(node, a) or lock_key(a)
+        return lock_key(a)
+
+    out: List[List[object]] = []
+    for i, a in enumerate(call.args):
+        k = key_of(a)
+        if k is not None:
+            out.append([i, k])
+    for kw in call.keywords:
+        if kw.arg is not None:
+            k = key_of(kw.value)
+            if k is not None:
+                out.append([kw.arg, k])
+    return out
+
+
+def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
+                       source: str, records, suppress_line,
+                       suppress_file, parents=None) -> dict:
+    """The per-file fact dict (JSON-safe, cacheable).  ``records`` is
+    [(function node, FunctionDataflow, enclosing class name or None,
+    nested?)] — the engine feeds the dataflows it already built during
+    the shared walk; the standalone path (cache miss in ``--changed``)
+    builds its own."""
+    module, is_pkg = module_name_for(abspath)
+    tables = build_import_tables(tree, module, is_pkg)
+
+    classes: Dict[str, dict] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            classes[node.name] = {
+                "bases": [s for s in map(base_spec, node.bases)
+                          if s is not None],
+                "methods": {n.name: n.lineno for n in node.body
+                            if isinstance(n, _FUNCS)},
+            }
+
+    if parents is None:
+        parents = {}
+        for p in ast.walk(tree):
+            for child in ast.iter_child_nodes(p):
+                parents[id(child)] = p
+
+    functions: Dict[str, dict] = {}
+    for func, cfg, cls_name, nested in records:
+        if nested:
+            continue                # closures run under their own control
+        qname = f"{cls_name}.{func.name}" if cls_name else func.name
+        awaited_ids = {id(aw.value) for aw, _ in cfg.awaits
+                       if isinstance(aw.value, ast.Call)}
+        calls, blocks, clock = [], [], []
+        for call, node in cfg.calls:
+            line = getattr(call, "lineno", 0)
+            spec = call_spec(call)
+            calls.append([line, spec, sorted(cfg.lockset(node)),
+                          id(call) in awaited_ids,
+                          _arg_lock_keys(call, cfg, node)])
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr in WAIT_METHODS \
+                    and not call.args and \
+                    not any(kw.arg == "timeout" for kw in call.keywords):
+                # A bare .acquire() the LOCKSET layer already owns is an
+                # acquisition, not a block; one on a non-lock receiver
+                # (semaphore, condition) blocks like any other wait.
+                if f.attr == "acquire" and (
+                        lock_key(f.value) is not None or node.acquires):
+                    pass
+                elif not _line_suppressed("FTL013", line, suppress_line,
+                                          suppress_file):
+                    blocks.append([line, f".{f.attr}() with no timeout"])
+            name = resolve_external(tables, f)
+            if name == "time.sleep" and not _line_suppressed(
+                    "FTL013", line, suppress_line, suppress_file):
+                blocks.append([line, "time.sleep()"])
+            if _is_clock_name(name) and not _line_suppressed(
+                    "FTL001", line, suppress_line, suppress_file):
+                clock.append([line, name])
+        returns = []
+        for node in cfg.nodes:
+            if isinstance(node.stmt, ast.Return):
+                returns.append(_classify_return(node.stmt.value, cfg,
+                                                node))
+        sim_ref = any(
+            (isinstance(n, ast.Name) and n.id == "sim") or
+            (isinstance(n, ast.Attribute) and n.attr == "sim")
+            for n in ast.walk(func))
+        functions[qname] = {
+            "line": func.lineno, "async": cfg.is_async,
+            "cls": cls_name, "name": func.name,
+            "private": _is_private(func.name),
+            "decorated": bool(func.decorator_list),
+            "params": [a.arg for a in
+                       (list(func.args.posonlyargs) + list(func.args.args)
+                        + list(func.args.kwonlyargs))],
+            "calls": calls, "blocks": blocks, "clock": clock,
+            "returns": returns,
+            "lock_params": dict(cfg.lock_params),
+            "sim_ref": sim_ref,
+        }
+
+    # Address-taken detection: a function referenced OUTSIDE call
+    # position (handed to spawn(), stored, decorated, getattr'd) has
+    # callers the graph cannot see — it must never claim "all my
+    # callers hold the lock".
+    escapes: Set[str] = set()
+    top_fns = {q for q, fn in functions.items() if fn["cls"] is None}
+    method_owners: Dict[str, List[str]] = {}
+    for cname, c in classes.items():
+        for m in c["methods"]:
+            method_owners.setdefault(m, []).append(cname)
+    for q, fn in functions.items():
+        if fn["decorated"]:
+            escapes.add(q)
+    def _enclosing_class(node: ast.AST) -> Optional[str]:
+        n = parents.get(id(node))
+        while n is not None and not isinstance(n, ast.ClassDef):
+            n = parents.get(id(n))
+        return n.name if n is not None else None
+
+    for node in ast.walk(tree):
+        parent = parents.get(id(node))
+        in_call_pos = isinstance(parent, ast.Call) and parent.func is node
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in top_fns and not in_call_pos:
+                escapes.add(node.id)
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load) and not in_call_pos:
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                # Scoped to the ENCLOSING class: `self.X` can only name
+                # a method of the class the access sits in (same-named
+                # methods of other classes must not lose their seeding
+                # — the FTL009/FTL010 scope lesson again).
+                owner = _enclosing_class(node)
+                if owner is not None and node.attr in \
+                        classes.get(owner, {}).get("methods", {}):
+                    escapes.add(f"{owner}.{node.attr}")
+                else:
+                    # Inherited (or dynamic) method: can't pin the
+                    # owner — escape every same-named method in the
+                    # file (the conservative direction).
+                    for cname in method_owners.get(node.attr, ()):
+                        escapes.add(f"{cname}.{node.attr}")
+            elif isinstance(base, ast.Name) and base.id in classes:
+                if node.attr in classes[base.id]["methods"]:
+                    escapes.add(f"{base.id}.{node.attr}")
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "getattr" and len(node.args) >= 2 and \
+                isinstance(node.args[1], ast.Constant) and \
+                isinstance(node.args[1].value, str):
+            name = node.args[1].value
+            if name in top_fns:
+                escapes.add(name)
+            for cname in method_owners.get(name, ()):
+                escapes.add(f"{cname}.{name}")
+
+    return {"module": module, "is_pkg": is_pkg, "classes": classes,
+            "imports": tables, "escapes": sorted(escapes),
+            "functions": functions}
+
+
+def extract_standalone(rel: str, abspath: str,
+                       source: str) -> Optional[dict]:
+    """Cache-miss path: parse + build dataflow for every top-level
+    function and method, then extract — used for program files that are
+    outside the scanned set (``--changed``) and not in the cache."""
+    try:
+        tree = ast.parse(source, filename=abspath)
+    except (SyntaxError, ValueError):
+        return None
+    records = []
+    for node in tree.body:
+        if isinstance(node, _FUNCS):
+            records.append((node, FunctionDataflow(node), None, False))
+        elif isinstance(node, ast.ClassDef):
+            for m in node.body:
+                if isinstance(m, _FUNCS):
+                    records.append((m, FunctionDataflow(m), node.name,
+                                    False))
+    sup_line, sup_file = _suppressions(source)
+    return extract_file_facts(rel, abspath, tree, source, records,
+                              sup_line, sup_file)
+
+
+class ProgramIndex:
+    """The whole-lint-run interprocedural context: per-file facts (live
+    for scanned files, cache/standalone for the rest of the program),
+    the call graph over them, and the composed summaries."""
+
+    def __init__(self, program_files: List[Tuple[str, str]],
+                 cache_path: Optional[str] = None) -> None:
+        self.program_files = program_files
+        self.cache_path = cache_path
+        self.scanned: Set[str] = set()
+        self.facts: Dict[str, dict] = {}
+        self.graph: Optional[CallGraph] = None
+        self._hashes: Dict[str, str] = {}
+        self._suppress: Dict[str, tuple] = {}
+        self._entry: Dict[str, Optional[FrozenSet[str]]] = {}
+        self._blocked: Dict[str, tuple] = {}
+        self._clocked: Dict[str, tuple] = {}
+        self._set_valued: Set[str] = set()
+        self._param_canon: Dict[str, Dict[str, str]] = {}
+        # [(rel, qname, line, param, {key: [caller sites]})]
+        self.param_conflicts: List[tuple] = []
+        # rel paths excluded from the program because two roots own the
+        # same rel (for_roots sets this; add_scanned must respect it).
+        self._collisions: Set[str] = set()
+        self._rel_to_path = {rel: path for path, rel in program_files}
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    @classmethod
+    def for_roots(cls, scan_roots,
+                  cache_path: Optional[str] = None) -> "ProgramIndex":
+        """Program = the topmost enclosing package of every scan root
+        (a directory root is its own program root), so a ``--changed``
+        run over three files still links against the whole package."""
+        roots: List[str] = []
+        for p in scan_roots:
+            a = os.path.abspath(p)
+            r = a if os.path.isdir(a) else (topmost_package(a) or a)
+            roots.append(os.path.realpath(r))
+        uniq = sorted(set(roots))
+        keep = [r for r in uniq
+                if not any(o != r and r.startswith(o + os.sep)
+                           for o in uniq)]
+        files: List[Tuple[str, str]] = []
+        seen: Dict[str, str] = {}
+        collisions: Set[str] = set()
+        for r in keep:
+            for path, rel in iter_py_files(r):
+                if rel not in seen:
+                    seen[rel] = path
+                    files.append((path, rel))
+                elif seen[rel] != path:
+                    # Two sibling roots both contain e.g. utils.py: the
+                    # rel path IS the identity everywhere (findings,
+                    # baseline, facts), so keeping both would cross-wire
+                    # their facts.  Both drop out of the program — the
+                    # rules degrade to intraprocedural for them, never
+                    # to wrong-file resolution.
+                    collisions.add(rel)
+        pi = cls([f for f in files if f[1] not in collisions],
+                 cache_path=cache_path)
+        pi._collisions = collisions
+        return pi
+
+    # -- feeding -------------------------------------------------------------
+    def add_scanned(self, ctx, abspath: str) -> None:
+        """Called by the Analyzer for every file it walks: live facts
+        from the dataflows the walk already built.  A file whose rel
+        collides across roots (or maps to a DIFFERENT abspath than the
+        program enumerated) contributes nothing — overwriting would
+        resolve one package's calls against another's facts."""
+        if ctx.path in self._collisions:
+            return
+        known = self._rel_to_path.get(ctx.path)
+        if known is not None and \
+                os.path.realpath(known) != os.path.realpath(abspath):
+            return
+        self.facts[ctx.path] = extract_file_facts(
+            ctx.path, abspath, ctx.tree, ctx.source, ctx.cfg_records,
+            ctx.suppress_line, ctx.suppress_file, parents=ctx._parents)
+        self._hashes[ctx.path] = _hash_source(ctx.source)
+        self._suppress[ctx.path] = (ctx.suppress_line, ctx.suppress_file)
+        self.scanned.add(ctx.path)
+
+    # -- cache ---------------------------------------------------------------
+    def _load_cache(self) -> Dict[str, dict]:
+        if not self.cache_path or not os.path.exists(self.cache_path):
+            return {}
+        try:
+            with open(self.cache_path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("version") != CACHE_VERSION:
+                return {}
+            return data.get("files", {})
+        except (OSError, ValueError):
+            return {}               # corrupt cache: fall back to parsing
+
+    def save_cache(self) -> None:
+        """Persist every program file's facts keyed by content hash —
+        fail-soft (an unwritable cache degrades to re-parsing)."""
+        if not self.cache_path:
+            return
+        entries = {rel: {"hash": self._hashes[rel],
+                         "facts": self.facts[rel]}
+                   for rel in self.facts if rel in self._hashes}
+        try:
+            tmp = self.cache_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": CACHE_VERSION, "files": entries}, f)
+            os.replace(tmp, self.cache_path)
+        except OSError:
+            pass
+
+    # -- linking -------------------------------------------------------------
+    def link(self) -> None:
+        cache = self._load_cache()
+        for abspath, rel in self.program_files:
+            if rel in self.facts:
+                continue
+            try:
+                with open(abspath, "r", encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            h = _hash_source(source)
+            entry = cache.get(rel)
+            if entry and entry.get("hash") == h:
+                self.facts[rel] = entry["facts"]
+                self.cache_hits += 1
+            else:
+                facts = extract_standalone(rel, abspath, source)
+                if facts is None:
+                    continue        # unparseable: no facts, no summaries
+                self.facts[rel] = facts
+                self.cache_misses += 1
+            self._hashes[rel] = h
+        self.graph = CallGraph(self.facts)
+        self.graph.resolve_all()
+        self._compute_param_canon()
+        self._compute_blocked()
+        self._compute_clocked()
+        self._compute_set_valued()
+        self._compute_entry_locks()
+
+    # -- summary fixpoints ---------------------------------------------------
+    def _functions(self):
+        for rel, f in self.facts.items():
+            for qname, fn in f["functions"].items():
+                yield rel, qname, fn, CallGraph.fid(rel, qname)
+
+    def _escaped(self, rel: str, qname: str, fn: dict) -> bool:
+        """All-callers-known is the premise of entry-lockset seeding AND
+        lock-param unification; any way a hidden caller could exist
+        breaks it: address-taken, a same-named call nobody resolved, or
+        virtual dispatch (the method overrides / is overridden / sits
+        under an unresolved base — `self.m()` in the base class runs
+        the OVERRIDE at runtime, which static resolution cannot see)."""
+        if qname in self.facts[rel]["escapes"]:
+            return True
+        if fn["name"] in self.graph.unresolved_names:
+            return True
+        cls = fn.get("cls")
+        return cls is not None and \
+            self.graph.virtually_dispatched(rel, cls, fn["name"])
+
+    def _compute_blocked(self) -> None:
+        """may-block-unbounded, LFP with a witness for chain rendering:
+        witness = ('direct', line, desc) | ('call', line, callee fid).
+        Propagates over PLAIN calls to SYNC callees only — an awaited
+        callee's blocking is the await site's problem (FTL011), and an
+        un-awaited async call never runs its body."""
+        work: List[str] = []
+        for rel, qname, fn, fid in self._functions():
+            if fn["blocks"]:
+                line, desc = fn["blocks"][0]
+                self._blocked[fid] = ("direct", line, desc)
+                work.append(fid)
+        while work:
+            target = work.pop()
+            tfn = self.graph.function(target)
+            if tfn is None or tfn["async"]:
+                continue
+            for caller, call in self.graph.callers.get(target, ()):
+                if call[3]:         # awaited edge
+                    continue
+                if caller not in self._blocked:
+                    self._blocked[caller] = ("call", call[0], target)
+                    work.append(caller)
+
+    def _compute_clocked(self) -> None:
+        """may-read-wall-clock for REAL_ONLY-module functions: direct
+        unsuppressed reads in functions that are NOT mode-guarded (no
+        ``sim`` reference — ``EventLoop.now()``'s virtual/real branch is
+        the sanctioned pattern), propagated through real-only-module
+        callees.  Sim-reachable functions never propagate: their own
+        direct reads are FTL001 findings already."""
+        work: List[str] = []
+        for rel, qname, fn, fid in self._functions():
+            if _sim_reachable(rel) or fn["sim_ref"]:
+                continue
+            if fn["clock"]:
+                line, name = fn["clock"][0]
+                self._clocked[fid] = ("direct", line, name)
+                work.append(fid)
+        while work:
+            target = work.pop()
+            for caller, call in self.graph.callers.get(target, ()):
+                rel = caller.partition("::")[0]
+                if _sim_reachable(rel):
+                    continue        # the FTL001 rule reports this edge
+                cfn = self.graph.function(caller)
+                if cfn is None or cfn["sim_ref"]:
+                    continue
+                tfn = self.graph.function(target)
+                if tfn and tfn["async"] and not call[3]:
+                    continue        # coroutine never awaited: no read
+                if caller not in self._clocked:
+                    self._clocked[caller] = ("call", call[0], target)
+                    work.append(caller)
+
+    def _compute_set_valued(self) -> None:
+        """Set-valued returns, GREATEST fixpoint: start optimistic for
+        every function whose returns are all set-shaped-or-call, then
+        demote until stable — recursion (``def a(): return b()`` /
+        ``def b(): return a()`` guarded by a base case returning a set)
+        converges to True instead of diverging or defaulting False."""
+        candidates: Dict[str, tuple] = {}
+        for rel, qname, fn, fid in self._functions():
+            if fn["returns"] and all(e != "other" for e in fn["returns"]):
+                candidates[fid] = (rel, fn.get("cls"), fn["returns"])
+        sv = set(candidates)
+        changed = True
+        while changed:
+            changed = False
+            for fid, (rel, cls, returns) in candidates.items():
+                if fid not in sv:
+                    continue
+                if not all(self._eval_set(e, rel, cls, sv)
+                           for e in returns):
+                    sv.discard(fid)
+                    changed = True
+        # Groundedness (LFP): the optimism above keeps a PURE call
+        # cycle with no base case "set-valued" forever — demand every
+        # survivor reach at least one literal set return through the
+        # chain (``return rec(x)`` / ``return rec2(x)`` alone proves
+        # nothing; it never returns at all).
+        grounded: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for fid, (rel, cls, returns) in candidates.items():
+                if fid not in sv or fid in grounded:
+                    continue
+                if any(self._eval_grounded(e, rel, cls, grounded)
+                       for e in returns):
+                    grounded.add(fid)
+                    changed = True
+        self._set_valued = sv & grounded
+
+    def _eval_set(self, entry, rel, cls, sv) -> bool:
+        if entry == "set":
+            return True
+        if not isinstance(entry, list):
+            return False
+        kind = entry[0]
+        if kind == "call":
+            target = self.graph.resolve(rel, cls, entry[1:])
+            return target in sv
+        if kind == "any":
+            return any(self._eval_set(e, rel, cls, sv) for e in entry[1])
+        if kind == "all":
+            return all(self._eval_set(e, rel, cls, sv) for e in entry[1])
+        return False
+
+    def _eval_grounded(self, entry, rel, cls, grounded) -> bool:
+        if entry == "set":
+            return True
+        if not isinstance(entry, list):
+            return False
+        if entry[0] == "call":
+            return self.graph.resolve(rel, cls, entry[1:]) in grounded
+        return any(self._eval_grounded(e, rel, cls, grounded)
+                   for e in entry[1])
+
+    def _translate_locks(self, locks: Set[str], spec: List[str],
+                         same_rel: bool) -> FrozenSet[str]:
+        """Caller-frame lock keys that keep meaning in the callee's
+        frame: ``self.*``/``cls.*`` survive self/cls/super dispatch
+        (same object), bare module-level names survive same-module
+        calls; everything else (locals, params, other objects) drops."""
+        out = set()
+        self_call = spec and spec[0] in ("self", "cls", "super")
+        for k in sorted(locks):
+            if k.startswith(("self.", "cls.")):
+                if self_call:
+                    out.add(k)
+            elif "." not in k and same_rel:
+                out.add(k)
+        return frozenset(out)
+
+    def _compute_entry_locks(self) -> None:
+        """Caller-held locksets, top-down meet: entry(f) = ⋂ over every
+        callsite of translate(canon(local lockset) ∪ entry(caller)).
+        Only PRIVATE, non-escaped functions with at least one resolved
+        caller are eligible — everything else enters with ∅ (a public
+        function must stand on its own locks).  TOP (= every lock) is
+        the optimistic start so recursion/SCCs converge downward."""
+        eligible: Dict[str, tuple] = {}
+        for rel, qname, fn, fid in self._functions():
+            if fn["private"] and not self._escaped(rel, qname, fn) and \
+                    self.graph.callers.get(fid):
+                eligible[fid] = (rel, fn)
+        entry: Dict[str, Optional[FrozenSet[str]]] = \
+            {fid: None for fid in eligible}     # None = TOP
+        for _ in range(50):
+            changed = False
+            for fid, (rel, fn) in eligible.items():
+                val: Optional[FrozenSet[str]] = None
+                for caller, call in self.graph.callers[fid]:
+                    crel = caller.partition("::")[0]
+                    canon = self._param_canon.get(caller, {})
+                    locks = {canon.get(k, k) for k in call[2]}
+                    ce = entry.get(caller, frozenset())
+                    if ce is None:
+                        continue    # caller still TOP: identity for meet
+                    eff = self._translate_locks(
+                        locks | set(ce), call[1], crel == rel)
+                    val = eff if val is None else (val & eff)
+                if val != entry[fid]:
+                    entry[fid] = val
+                    changed = True
+            if not changed:
+                break
+        self._entry = entry
+
+    def _compute_param_canon(self) -> None:
+        """Unify each lock PARAMETER with the concrete lock its callers
+        pass: all callers agree -> the param canonicalizes to that
+        dotted key (participates in FTL012's meet); callers DISAGREE ->
+        an FTL014 finding (the alias defeats lockset analysis)."""
+        for rel, qname, fn, fid in self._functions():
+            if not fn["lock_params"]:
+                continue
+            callers = self.graph.callers.get(fid, [])
+            if not callers or self._escaped(rel, qname, fn):
+                continue
+            for p, pline in fn["lock_params"].items():
+                try:
+                    idx = fn["params"].index(p)
+                except ValueError:
+                    continue
+                keys: Dict[str, List[str]] = {}
+                complete = True
+                for caller, call in callers:
+                    shift = 1 if call[1] and \
+                        call[1][0] in ("self", "cls", "super") else 0
+                    k = None
+                    for pos_or_name, lk in call[4]:
+                        if pos_or_name == p or (
+                                isinstance(pos_or_name, int) and
+                                pos_or_name + shift == idx):
+                            k = lk
+                            break
+                    if k is None:
+                        complete = False
+                    else:
+                        keys.setdefault(k, []).append(
+                            f"{caller}:{call[0]}")
+                if len(keys) == 1 and complete:
+                    self._param_canon.setdefault(fid, {})[p] = \
+                        next(iter(keys))
+                elif len(keys) >= 2:
+                    self.param_conflicts.append(
+                        (rel, qname, pline, p,
+                         {k: sorted(v) for k, v in keys.items()}))
+
+    # -- rule-facing queries -------------------------------------------------
+    def entry_locks(self, rel: str, qname: str) -> FrozenSet[str]:
+        v = self._entry.get(CallGraph.fid(rel, qname))
+        return v if v else frozenset()
+
+    def param_canon(self, rel: str, qname: str) -> Dict[str, str]:
+        return self._param_canon.get(CallGraph.fid(rel, qname), {})
+
+    def may_block(self, fid: Optional[str]) -> bool:
+        return fid is not None and fid in self._blocked
+
+    def may_clock(self, fid: Optional[str]) -> bool:
+        return fid is not None and fid in self._clocked
+
+    def set_valued(self, fid: Optional[str]) -> bool:
+        return fid is not None and fid in self._set_valued
+
+    def resolve(self, rel: str, cls_name: Optional[str],
+                spec) -> Optional[str]:
+        return self.graph.resolve(rel, cls_name, list(spec))
+
+    def _chain(self, witness_map: Dict[str, tuple],
+               fid: str) -> List[str]:
+        out, cur = [], fid
+        for _ in range(20):
+            w = witness_map.get(cur)
+            if w is None:
+                break
+            if w[0] == "direct":
+                out.append(f"{cur} line {w[1]}: {w[2]}")
+                break
+            out.append(f"{cur} line {w[1]}")
+            cur = w[2]
+        return out
+
+    def block_chain(self, fid: str) -> List[str]:
+        return self._chain(self._blocked, fid)
+
+    def clock_chain(self, fid: str) -> List[str]:
+        return self._chain(self._clocked, fid)
+
+    def iter_scanned_functions(self):
+        """(rel, qname, fn facts, fid) for every function of every
+        SCANNED file — where interprocedural findings may be reported."""
+        for rel in sorted(self.scanned):
+            f = self.facts.get(rel)
+            if not f:
+                continue
+            for qname, fn in sorted(f["functions"].items()):
+                yield rel, qname, fn, CallGraph.fid(rel, qname)
+
+    def calls_with_targets(self, fid: str):
+        """[(call record, resolved callee fid or None)] for one
+        function (call record: [line, spec, locks, awaited,
+        lock_args])."""
+        return self.graph.calls_of.get(fid, [])
+
+    def is_suppressed(self, rule_id: str, rel: str, line: int) -> bool:
+        sup = self._suppress.get(rel)
+        if sup is None:
+            return False            # findings only land in scanned files
+        return _line_suppressed(rule_id, line, sup[0], sup[1])
+
+    def dump_callgraph(self) -> List[Dict[str, object]]:
+        return self.graph.dump() if self.graph else []

@@ -10,6 +10,8 @@ key-type hazards (foundationdb_tpu/analysis/).
     python scripts/flowlint.py --format json        # machine-readable
     python scripts/flowlint.py --list-rules
     python scripts/flowlint.py --write-baseline     # grandfather current
+    python scripts/flowlint.py --dump-callgraph     # resolved call edges
+    python scripts/flowlint.py --summary-cache none # no interproc cache
 
 Exit codes: 0 = clean (or every finding baselined), 1 = new findings,
 2 = internal error.  Suppress a single line with
@@ -27,6 +29,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 DEFAULT_BASELINE = os.path.join(REPO, "flowlint_baseline.json")
+# Interprocedural fact cache (ISSUE 11): per-file summaries keyed by
+# content hash, so `--changed` links the whole program without
+# re-parsing the unchanged files.  Never committed (.gitignore).
+DEFAULT_SUMMARY_CACHE = os.path.join(REPO, ".flowlint_cache.json")
 
 
 def changed_files(paths, ref):
@@ -108,6 +114,15 @@ def main(argv=None) -> int:
                     help="write current findings to the baseline file "
                          "and exit 0")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--summary-cache", default=DEFAULT_SUMMARY_CACHE,
+                    metavar="PATH",
+                    help="interprocedural summary-cache path, or 'none' "
+                         "to extract everything live "
+                         f"(default: {DEFAULT_SUMMARY_CACHE})")
+    ap.add_argument("--dump-callgraph", action="store_true",
+                    help="print the resolved call graph as JSON edges "
+                         "(caller/line/callee/raw target — the "
+                         "resolution-debugging view) and exit 0")
     args = ap.parse_args(argv)
 
     from foundationdb_tpu.analysis import format_text, load_baseline
@@ -118,6 +133,9 @@ def main(argv=None) -> int:
         for rule in make_rules():
             print(f"{rule.id}  {rule.title}")
         return 0
+
+    summary_cache = None if args.summary_cache == "none" \
+        else args.summary_cache
 
     baseline_path = None if args.baseline == "none" else args.baseline
     if args.write_baseline and baseline_path is None:
@@ -135,6 +153,9 @@ def main(argv=None) -> int:
             print(f"flowlint: {e}", file=sys.stderr)
             return 2
         if not args.paths:
+            if args.dump_callgraph:
+                print("[]")         # no changed files: empty graph
+                return 0
             from foundationdb_tpu.analysis.engine import LintResult
             empty = LintResult()
             if args.format == "json":
@@ -143,9 +164,28 @@ def main(argv=None) -> int:
                 print(format_text(empty) +
                       f" (no .py changes vs {args.changed})")
             return 0
+
+    if args.dump_callgraph:
+        # AFTER the --changed rewrite, so the dump describes the same
+        # file set (hence the same ProgramIndex) the lint would use.
+        from foundationdb_tpu.analysis.summaries import ProgramIndex
+        try:
+            program = ProgramIndex.for_roots(args.paths,
+                                             cache_path=summary_cache)
+            program.link()
+            program.save_cache()
+        except Exception as e:  # noqa: BLE001 - CLI boundary
+            print(f"flowlint: internal error: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(program.dump_callgraph(), indent=2))
+        return 0
+
     try:
         baseline = load_baseline(baseline_path) if baseline_path else []
-        result = Analyzer(make_rules()).run(args.paths, baseline)
+        result = Analyzer(make_rules(),
+                          summary_cache=summary_cache).run(args.paths,
+                                                           baseline)
     except Exception as e:  # noqa: BLE001 - CLI boundary: exit 2, not a trace
         print(f"flowlint: internal error: {type(e).__name__}: {e}",
               file=sys.stderr)

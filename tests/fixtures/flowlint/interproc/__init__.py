@@ -1,0 +1,6 @@
+"""Interprocedural fixture package (ISSUE 11): multi-file shapes the
+per-function dataflow cannot see — cross-file dispatch, caller-held
+locksets, transitive blocking chains, deep set-valued chains, lock
+aliasing, unknown-callee conservatism.  Every expected finding carries
+an exact ``# expect: FTLnnn:<line>`` marker; tests assert got ==
+expected in BOTH directions."""

@@ -1,0 +1,89 @@
+"""Known-bad/known-good battery for FTL014 lock-alias discipline: a
+single-valued alias (local or parameter) PARTICIPATES in the lockset
+join/meet; an ambiguous one is flagged and contributes nothing."""
+# expect: FTL014:48 FTL012:49 FTL014:62
+
+import threading
+
+
+class AliasJoin:
+    """``lk = self._lock; with lk:`` canonicalizes to the attribute:
+    the alias-guarded write and the directly-guarded write meet on the
+    SAME lock — clean (previously the alias dropped out and this
+    class was a false positive waiting to happen)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def via_alias(self):
+        lk = self._lock
+        with lk:
+            self._n += 1            # clean: alias == self._lock
+
+    def direct(self):
+        with self._lock:
+            self._n = 2
+
+
+class AliasSplit:
+    """The alias binds DIFFERENT locks on different paths: its region
+    guards no one provable lock (FTL014), so the write inside it has
+    an empty lockset and races the guarded site (FTL012)."""
+
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._n = 0
+
+    def guarded(self):
+        with self._a_lock:
+            self._n = 1
+
+    def ambiguous(self, c):
+        if c:
+            lk = self._a_lock
+        else:
+            lk = self._b_lock
+        with lk:                    # BAD: which lock is held here?
+            self._n = 2             # BAD: empty lockset vs guarded()
+
+
+class LockParamSplit:
+    """A lock PARAMETER whose callers pass different locks: no
+    cross-site discipline can be established through it (FTL014)."""
+
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._n = 0
+
+    def _locked_add(self, use_lock):
+        with use_lock:              # BAD: a different lock per caller
+            self._n += 1
+
+    def add_a(self):
+        self._locked_add(self._a_lock)
+
+    def add_b(self):
+        self._locked_add(self._b_lock)
+
+
+class LockParamJoin:
+    """Every caller passes the SAME lock: the parameter canonicalizes
+    to it and the guarded sites meet — clean."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def _bump(self, use_lock):
+        with use_lock:
+            self._n += 1            # clean: use_lock == self._lock
+
+    def outer(self):
+        self._bump(self._lock)
+
+    def direct(self):
+        with self._lock:
+            self._n = 3

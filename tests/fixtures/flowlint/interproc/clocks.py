@@ -1,0 +1,14 @@
+"""Known-bad fixture: FTL001 wall-clock reads reached VIA HELPERS from
+sim-reachable code — the static verification of the REAL_ONLY-modules
+"never imported on a sim path" construction."""
+# expect: FTL001:10
+
+from .rpc.real_network import read_guarded, read_wall
+
+
+def bad_stamp():
+    return read_wall()              # BAD: chains to time.monotonic()
+
+
+def ok_guarded(loop):
+    return read_guarded(loop)       # mode-guarded callee: clean

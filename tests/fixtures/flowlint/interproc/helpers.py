@@ -1,0 +1,38 @@
+"""Cross-file targets for the interproc fixtures: blocking wrappers
+(with and without a forwarded timeout) and set-returning helper chains
+(incl. recursion, so the SCC fixpoint has something to converge on).
+Nothing in THIS file is a finding — the hazards live at the callers."""
+
+
+def wait_done(fut):
+    return fut.result()             # unbounded: may-block summary root
+
+
+def wait_bounded(fut, timeout):
+    return fut.result(timeout=timeout)  # timeout forwarded: never blocks
+
+
+def drain(fut):
+    return wait_done(fut)           # depth-2 link of the FTL013 chain
+
+
+def tags_of(txns):
+    return {t.tag for t in txns}
+
+
+def deep_tags(txns):
+    return tags_of(txns)            # depth-2 set-valued chain
+
+
+def rec_tags(txns, depth):
+    if depth == 0:
+        return {t.tag for t in txns}
+    return rec_tags(txns, depth - 1)    # recursion: GFP converges to set
+
+
+def churn(fut):
+    return churn2(fut)              # mutually recursive blockers: the
+
+
+def churn2(fut):
+    return churn(fut) or fut.wait()     # SCC still converges may-block

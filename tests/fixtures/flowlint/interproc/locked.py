@@ -1,0 +1,90 @@
+"""Known-bad/known-good battery for caller-held locksets (FTL012
+seeding — the ``Tracer._roll`` shape) and transitive blocking under a
+lock (FTL013), incl. unknown-callee conservatism."""
+# expect: FTL012:44 FTL012:64 FTL013:78 FTL013:82
+
+import threading
+
+from .helpers import churn, drain, wait_bounded
+
+
+class Roller:
+    """core/trace.py::Tracer._roll, distilled: a private helper whose
+    EVERY caller holds the lock — the entry lockset is seeded with the
+    meet of the callsite locksets, so the lock-free-looking writes are
+    provably guarded and nothing fires (the 3 suppressions ISSUE 11
+    removed from trace.py)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fh = None
+        self._bytes = 0
+
+    def _roll(self):
+        self._fh = object()         # clean: caller holds the lock
+        self._bytes = 0             # clean: caller holds the lock
+
+    def emit(self):
+        with self._lock:
+            self._bytes += 1
+            if self._bytes > 10:
+                self._roll()
+
+
+class LeakyRoller:
+    """Same shape with ONE lock-free caller: the meet over callsites is
+    empty, seeding dies, and the race re-fires — the regression guard
+    for the removed trace.py suppressions."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes = 0
+
+    def _roll(self):
+        self._bytes = 0             # BAD: emit_unlocked calls lock-free
+
+    def emit(self):
+        with self._lock:
+            self._bytes += 1
+            self._roll()
+
+    def emit_unlocked(self):
+        self._roll()
+
+
+class EscapedRoller:
+    """The helper ESCAPES (handed to a callback): an invisible caller
+    might hold no lock, so seeding must not apply — conservative."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes = 0
+
+    def _roll(self):
+        self._bytes = 0             # BAD: address-taken, callers unknown
+
+    def emit(self, loop):
+        with self._lock:
+            self._bytes += 1
+            loop.call_soon(self._roll)
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad_transitive(self, fut):
+        with self._lock:
+            return drain(fut)       # BAD: chain drain -> wait_done -> .result()
+
+    def bad_recursive(self, fut):
+        with self._lock:
+            return churn(fut)       # BAD: mutually-recursive blocker SCC
+
+    def ok_bounded(self, fut):
+        with self._lock:
+            return wait_bounded(fut, 1.0)   # timeout checked through wrapper
+
+    def ok_unknown(self, obj):
+        with self._lock:
+            return obj.mystery()    # unknown callee: no invented finding

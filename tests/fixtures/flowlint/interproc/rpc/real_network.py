@@ -1,0 +1,18 @@
+"""Models a REAL_ONLY module (the path suffix ``rpc/real_network.py``
+is on rules.REAL_ONLY_MODULES): direct wall-clock reads are sanctioned
+HERE — reaching one from a sim-reachable module is the interprocedural
+FTL001 finding, reported at the caller (clocks.py)."""
+
+import time
+
+
+def read_wall():
+    return time.monotonic()         # exempt here: real-only module
+
+
+def read_guarded(loop):
+    """EventLoop.now()'s shape: the ``sim`` branch marks the function
+    mode-guarded, so the read never propagates to sim callers."""
+    if loop.sim:
+        return 0.0
+    return time.monotonic()

@@ -1,0 +1,35 @@
+"""Known-bad fixture: FTL005 through in-package call chains DEEPER
+than the one same-file hop the per-file pass resolves — cross-file
+imports, depth-2 helper chains, and recursion (SCC convergence)."""
+# expect: FTL005:11 FTL005:15 FTL005:20
+
+from .helpers import deep_tags, rec_tags
+
+
+def bad_deep(txns):
+    tags = deep_tags(txns)
+    return [t for t in tags]        # BAD: depth-2 cross-file set chain
+
+
+def bad_recursive(txns):
+    for t in rec_tags(txns, 3):     # BAD: recursion converges set-valued
+        use(t)
+
+
+def bad_via_local(txns):
+    for t in local_chain(txns):     # BAD: same-file chain deeper than 1 hop
+        use(t)
+
+
+def local_chain(txns):
+    return deep_tags(txns)
+
+
+def ok_rebound(txns):
+    tags = sorted(deep_tags(txns))
+    for t in tags:                  # sorted: deterministic, clean
+        use(t)
+
+
+def use(t):
+    return t

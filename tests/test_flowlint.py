@@ -1,11 +1,14 @@
-"""flowlint (ISSUES 5 + 9): rule-engine behavior, one positive fixture
-per rule with exact FTL id + line assertions, suppression/baseline
-round-trips, the clean-repo gate (tier-1's static-analysis entry, the
-way test_metrics.py runs check_trace_events), the ISSUE-9 dataflow
-layer (CFG/def-use/lockset unit battery + FTL010/011/012 + widened
-FTL005), --changed incremental mode, and cross-process unseed
-reproduction with PYTHONHASHSEED pinned (the ROADMAP chaos follow-up,
-driven by the HashOrderCanary workload)."""
+"""flowlint (ISSUES 5 + 9 + 11): rule-engine behavior, one positive
+fixture per rule with exact FTL id + line assertions,
+suppression/baseline round-trips, the clean-repo gate (tier-1's
+static-analysis entry, the way test_metrics.py runs
+check_trace_events), the ISSUE-9 dataflow layer (CFG/def-use/lockset
+unit battery + FTL010/011/012 + widened FTL005), the ISSUE-11
+interprocedural layer (call-graph resolution, summary fixpoints,
+caller-held lockset seeding, FTL013/FTL014, the summary cache),
+--changed incremental mode, and cross-process unseed reproduction with
+PYTHONHASHSEED pinned (the ROADMAP chaos follow-up, driven by the
+HashOrderCanary workload)."""
 
 import ast
 import json
@@ -29,7 +32,7 @@ from foundationdb_tpu.analysis.rules import make_rules
 
 EXPECT = re.compile(r"(FTL\d{3}):(\d+)")
 
-N_RULES = 12    # FTL001..FTL012 (FTL000 = unparseable-file pseudo-rule)
+N_RULES = 14    # FTL001..FTL014 (FTL000 = unparseable-file pseudo-rule)
 
 
 def _scan(roots, baseline=None):
@@ -594,6 +597,531 @@ def test_is_actor_helper():
     assert not is_actor(sync_fn)
     assert not is_actor(lam_assign.value)
     assert not is_actor(tree)
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural layer (ISSUE 11): call graph, summaries, seeding
+# ---------------------------------------------------------------------------
+
+from foundationdb_tpu.analysis.summaries import ProgramIndex
+
+INTERPROC = os.path.join(FIXTURES, "interproc")
+
+
+def _write_pkg(tmp_path, files):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        p = pkg / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return pkg
+
+
+def _program(root):
+    pi = ProgramIndex.for_roots([str(root)])
+    pi.link()
+    return pi
+
+
+def test_interproc_fixture_exact_both_directions():
+    """The multi-file fixture package scanned ALONE (cross-file
+    resolution within it): findings == markers exactly, both ways."""
+    exp = set()
+    for dirpath, dirnames, filenames in os.walk(INTERPROC):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn),
+                                  INTERPROC).replace(os.sep, "/")
+            with open(os.path.join(dirpath, fn)) as f:
+                for line in f:
+                    if "# expect:" in line:
+                        for m in EXPECT.finditer(line):
+                            exp.add((m.group(1), rel, int(m.group(2))))
+    # Every interproc rule is represented: the caller-held FTL012
+    # shape, the chain rule, the alias rule, the widened 001/005.
+    assert {"FTL001", "FTL005", "FTL012", "FTL013", "FTL014"} <= \
+        {r for r, _, _ in exp}
+    result = _scan([INTERPROC])
+    got = {(f.rule, f.path, f.line) for f in result.new}
+    assert got == exp, (f"unexpected: {sorted(got - exp)}\n"
+                        f"missing: {sorted(exp - got)}")
+
+
+def test_callgraph_cross_file_resolution(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "a.py": """\
+            def helper():
+                return 1
+
+            class Base:
+                def shared(self):
+                    return 2
+
+            class Maker:
+                def __init__(self):
+                    self.x = 1
+            """,
+        "b.py": """\
+            import pkg.a as direct
+            from . import a as amod
+            from .a import Base, Maker, helper
+
+            class Sub(Base):
+                def go(self):
+                    return self.shared()
+
+                def go2(self):
+                    return super().shared()
+
+            def calls(obj):
+                helper()
+                amod.helper()
+                direct.helper()
+                Maker()
+                obj.mystery()
+            """})
+    g = _program(pkg).graph
+    assert g.resolve("b.py", None, ["name", "helper"]) == "a.py::helper"
+    assert g.resolve("b.py", None, ["attr", "amod", "helper"]) == \
+        "a.py::helper"
+    assert g.resolve("b.py", None, ["attr", "direct", "helper"]) == \
+        "a.py::helper"
+    assert g.resolve("b.py", "Sub", ["self", "shared"]) == \
+        "a.py::Base.shared"
+    assert g.resolve("b.py", "Sub", ["super", "shared"]) == \
+        "a.py::Base.shared"
+    assert g.resolve("b.py", None, ["name", "Maker"]) == \
+        "a.py::Maker.__init__"
+    assert g.resolve("b.py", None, ["name", "nonesuch"]) is None
+    assert g.resolve("b.py", None, ["opaque", "mystery"]) is None
+    # The unknown receiver call feeds the conservatism set.
+    assert "mystery" in g.unresolved_names
+
+
+def test_summary_may_block_fixpoint(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "w.py": """\
+            def leaf(fut):
+                return fut.result()
+
+            def mid(fut):
+                return leaf(fut)
+
+            def bounded(fut, timeout):
+                return fut.result(timeout=timeout)
+
+            def via_bounded(fut):
+                return bounded(fut, 1.0)
+
+            async def aleaf(fut):
+                return fut.result()
+
+            def spawns_only(fut):
+                aleaf(fut)
+
+            async def awaits_it(fut):
+                return await anested(fut)
+
+            async def anested(fut):
+                return fut.result()
+            """})
+    pi = _program(pkg)
+    fid = "w.py::{}".format
+    assert pi.may_block(fid("leaf"))
+    assert pi.may_block(fid("mid"))           # depth-2 chain
+    assert not pi.may_block(fid("bounded"))   # timeout forwarded
+    assert not pi.may_block(fid("via_bounded"))
+    assert pi.may_block(fid("aleaf"))         # its own body blocks...
+    assert not pi.may_block(fid("spawns_only"))   # ...but a plain call
+    #                                     never runs an async callee
+    assert not pi.may_block(fid("awaits_it"))     # awaited edges are
+    #                                     FTL011's territory, not 013's
+    chain = pi.block_chain(fid("mid"))
+    assert chain[-1].endswith(".result() with no timeout")
+    assert any("leaf" in hop for hop in chain)
+
+
+def test_summary_set_valued_fixpoint(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "s.py": """\
+            def grounded(x):
+                if x:
+                    return {1}
+                return bounce(x)
+
+            def bounce(x):
+                return grounded(x)
+
+            def pure_cycle(x):
+                return pure_cycle2(x)
+
+            def pure_cycle2(x):
+                return pure_cycle(x)
+
+            def not_always(x):
+                if x:
+                    return {1}
+                return [1]
+            """})
+    pi = _program(pkg)
+    assert pi.set_valued("s.py::grounded")
+    assert pi.set_valued("s.py::bounce")      # SCC converges via base case
+    assert not pi.set_valued("s.py::pure_cycle")  # no base: not grounded
+    assert not pi.set_valued("s.py::not_always")
+
+
+def test_entry_lockset_seeding_meet(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "r.py": """\
+            import threading
+
+            class AllLocked:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _helper(self):
+                    return 1
+
+                def a(self):
+                    with self._lock:
+                        self._helper()
+
+                def b(self):
+                    with self._lock:
+                        self._helper()
+
+            class OneUnlocked:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _helper(self):
+                    return 1
+
+                def a(self):
+                    with self._lock:
+                        self._helper()
+
+                def b(self):
+                    self._helper()
+
+            class Escaped:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _helper(self):
+                    return 1
+
+                def a(self, loop):
+                    with self._lock:
+                        loop.call_soon(self._helper)
+                        self._helper()
+
+            class Public:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def helper(self):
+                    return 1
+
+                def a(self):
+                    with self._lock:
+                        self.helper()
+            """})
+    pi = _program(pkg)
+    assert pi.entry_locks("r.py", "AllLocked._helper") == \
+        frozenset({"self._lock"})
+    assert pi.entry_locks("r.py", "OneUnlocked._helper") == frozenset()
+    assert pi.entry_locks("r.py", "Escaped._helper") == frozenset()
+    assert pi.entry_locks("r.py", "Public.helper") == frozenset()
+
+
+def test_entry_seeding_disabled_under_virtual_dispatch(tmp_path):
+    """Review catch: Base.run() calls self._m() lock-free, Sub
+    OVERRIDES _m — static resolution sends Base's callsite to Base._m,
+    so Sub._m would see only its locked caller and be wrongly seeded.
+    Any override relation (either direction, or an unresolved base)
+    disqualifies the method from all-callers-known seeding."""
+    pkg = _write_pkg(tmp_path, {
+        "v.py": """\
+            import threading
+
+            class Base:
+                def _m(self):
+                    return 0
+
+                def run(self):
+                    self._m()
+
+            class Sub(Base):
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+
+                def _m(self):
+                    self._count = 0
+
+                def locked_caller(self):
+                    with self._lock:
+                        self._count += 1
+                        self._m()
+            """})
+    pi = _program(pkg)
+    assert pi.entry_locks("v.py", "Sub._m") == frozenset()
+    # ... and the FTL012 race Base.run's dispatch path creates FIRES.
+    result = _scan([str(pkg)])
+    assert [(f.rule, f.line) for f in result.new
+            if f.rule == "FTL012"], "override silenced a real race"
+
+
+def test_lock_arg_through_alias_canonicalizes(tmp_path):
+    """Review catch: a lock passed through a local alias
+    (``the_lock = self._lock; self._bump(the_lock)``) must unify with
+    the directly-passed attribute — NOT read as a different lock per
+    caller (false FTL014) or defeat param canonicalization."""
+    pkg = _write_pkg(tmp_path, {
+        "al.py": """\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _bump(self, use_lock):
+                    with use_lock:
+                        return 1
+
+                def a(self):
+                    self._bump(self._lock)
+
+                def b(self):
+                    the_lock = self._lock
+                    self._bump(the_lock)
+            """})
+    pi = _program(pkg)
+    assert pi.param_canon("al.py", "C._bump") == \
+        {"use_lock": "self._lock"}
+    assert pi.param_conflicts == []
+
+
+def test_sibling_roots_rel_collision_is_dropped(tmp_path):
+    """Review catch: two scan roots both containing utils.py share one
+    rel-path identity — keeping both would resolve one package's calls
+    against the other's facts.  Colliding rels drop out of the program
+    (intraprocedural-only), they are never cross-wired."""
+    for name, body in (("pkgA", "def helper():\n    return {1}\n"),
+                       ("pkgB", "def helper():\n    return [1]\n")):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "utils.py").write_text(body)
+    pi = ProgramIndex.for_roots([str(tmp_path / "pkgA"),
+                                 str(tmp_path / "pkgB")])
+    pi.link()
+    assert pi._collisions == {"utils.py"}
+    assert "utils.py" not in pi.facts
+    # The full Analyzer run over both roots stays coherent (no phantom
+    # cross-package findings, no crash).
+    result = _scan([str(tmp_path / "pkgA"), str(tmp_path / "pkgB")])
+    assert result.new == [], [f.message for f in result.new]
+
+
+def test_lock_param_canonicalization_and_conflict(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "p.py": """\
+            import threading
+
+            class Agree:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _bump(self, use_lock):
+                    with use_lock:
+                        return 1
+
+                def a(self):
+                    self._bump(self._lock)
+
+                def b(self):
+                    self._bump(self._lock)
+
+            class Disagree:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def _bump(self, use_lock):
+                    with use_lock:
+                        return 1
+
+                def a(self):
+                    self._bump(self._a_lock)
+
+                def b(self):
+                    self._bump(self._b_lock)
+            """})
+    pi = _program(pkg)
+    assert pi.param_canon("p.py", "Agree._bump") == \
+        {"use_lock": "self._lock"}
+    assert pi.param_canon("p.py", "Disagree._bump") == {}
+    assert [(c[1], c[3]) for c in pi.param_conflicts] == \
+        [("Disagree._bump", "use_lock")]
+
+
+def test_trace_roll_is_suppression_free():
+    """The ISSUE-11 acceptance bullet: core/trace.py carries ZERO
+    FTL012 suppressions — the caller-held seeding proves _roll's
+    contract — and the file lints clean directly (the single-file scan
+    still links the whole package, so the seeding applies)."""
+    trace_py = os.path.join(REPO, "foundationdb_tpu", "core", "trace.py")
+    with open(trace_py) as f:
+        src = f.read()
+    assert "disable=FTL012" not in src
+    result = _scan([trace_py])
+    assert result.new == [], [f"{f.line} {f.rule}" for f in result.new]
+
+
+def test_ftl013_finding_renders_chain(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "h.py": """\
+            def wait_done(fut):
+                return fut.result()
+
+            def drain(fut):
+                return wait_done(fut)
+            """,
+        "m.py": """\
+            import threading
+            from .h import drain
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self, fut):
+                    with self._lock:
+                        return drain(fut)
+            """})
+    result = _scan([str(pkg)])
+    ftl13 = [f for f in result.new if f.rule == "FTL013"]
+    assert len(ftl13) == 1
+    msg = ftl13[0].message
+    assert "self._lock" in msg and "->" in msg
+    assert "h.py::drain" in msg and "h.py::wait_done" in msg
+
+
+def test_cli_dump_callgraph():
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--dump-callgraph", "--summary-cache",
+         "none", os.path.join(REPO, "foundationdb_tpu", "core",
+                              "trace.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    edges = {(r["caller"], r["callee"]) for r in rows}
+    # The canonical caller-held edge, resolved by self-dispatch.
+    assert ("core/trace.py::Tracer.emit",
+            "core/trace.py::Tracer._roll") in edges
+    # Unresolved callees are kept (debugging view), as null.
+    assert any(r["callee"] is None for r in rows)
+
+
+def test_summary_cache_staleness(tmp_path):
+    """The cache is keyed by content hash: editing a HELPER file (while
+    scanning with --changed-style single roots) must invalidate its
+    entry — a stale summary would hide the new transitive block.  A
+    corrupt cache degrades to re-parsing, never crashes."""
+    pkg = _write_pkg(tmp_path, {
+        "h.py": """\
+            def drain(fut):
+                return fut.result(timeout=1.0)
+            """,
+        "m.py": """\
+            import threading
+            from .h import drain
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def maybe_bad(self, fut):
+                    with self._lock:
+                        return drain(fut)
+            """})
+    cache = str(tmp_path / "cache.json")
+    args = [sys.executable, FLOWLINT, "--baseline", "none",
+            "--summary-cache", cache, str(pkg)]
+    out = subprocess.run(args, capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert os.path.exists(cache)
+    # Make the helper unbounded: the cached summary for h.py is stale
+    # (hash mismatch) and must be re-extracted -> FTL013 in m.py.
+    (pkg / "h.py").write_text(
+        "def drain(fut):\n    return fut.result()\n")
+    out = subprocess.run(args, capture_output=True, text=True)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "FTL013" in out.stdout
+    # Corrupt cache: fail-soft, identical outcome.
+    with open(cache, "w") as f:
+        f.write("{not json")
+    out = subprocess.run(args, capture_output=True, text=True)
+    assert out.returncode == 1 and "FTL013" in out.stdout
+
+
+def test_changed_mode_links_unchanged_program(tmp_path):
+    """--changed lints ONLY the changed file but still sees the whole
+    program through the summary layer: a new lock-held call into an
+    UNCHANGED helper's blocking chain is caught."""
+    repo = tmp_path / "r"
+    pkg = repo / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "h.py").write_text(
+        "def drain(fut):\n    return fut.result()\n")
+    dirty = pkg / "m.py"
+    dirty.write_text("x = 1\n")
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    dirty.write_text(textwrap.dedent("""\
+        import threading
+        from .h import drain
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self, fut):
+                with self._lock:
+                    return drain(fut)
+        """))
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--changed", "HEAD", "--baseline",
+         "none", "--summary-cache", "none", str(pkg)],
+        capture_output=True, text=True)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "FTL013" in out.stdout and "1 file(s) scanned" in out.stdout
+
+
+def test_run_chaos_embeds_new_rules():
+    """run_chaos embeds findings by SHELLING the CLI, so the new rules
+    ride along automatically: --list-rules (the same rule registry the
+    embedded scan uses) must carry FTL013/FTL014, and collect_flowlint
+    must return the CLI's counts for the clean repo."""
+    out = subprocess.run([sys.executable, FLOWLINT, "--list-rules"],
+                         capture_output=True, text=True)
+    assert "FTL013" in out.stdout and "FTL014" in out.stdout
+    import importlib.util
+    spec_mod = importlib.util.spec_from_file_location(
+        "run_chaos", os.path.join(REPO, "scripts", "run_chaos.py"))
+    run_chaos = importlib.util.module_from_spec(spec_mod)
+    spec_mod.loader.exec_module(run_chaos)
+    doc = run_chaos.collect_flowlint()
+    assert doc["exit_code"] == 0, doc
+    assert doc["counts"]["new"] == 0
+    assert doc["findings"] == []
 
 
 # ---------------------------------------------------------------------------
