@@ -731,6 +731,380 @@ def resolver_sweep_main() -> None:
     print(json.dumps(doc))
 
 
+# ---------------------------------------------------------------------------
+# Conflict-aware scheduling bench (ISSUE 12, `bench.py sched`).
+#
+# Host-side model of the three SCHED_* stages around the exact oracle —
+# predictor admission (sched/predictor.py, fed the same rows the
+# ratekeeper piggybacks), intra-batch reorder (sched/reorder.py), and
+# transaction repair as the commit proxy runs it (a SEPARATE follow-up
+# batch for the re-stamped txns; sched/repair.py eligibility) — over the
+# main bench's high-contention regime (zipf 1.2 point keys, snapshots
+# lagging 0-2 batches).  The measured quantity is commit_rate: committed
+# original transactions / total original transactions, each counted ONCE
+# no matter how often the scheduler defers or repairs it; goodput is
+# committed txns per wall second.  Batch size is scaled to SCHED_TXNS so
+# the oracle's intra-batch pass fits the budget; the stages-off
+# commit_rate of THIS regime is recorded alongside as the in-regime
+# baseline for the 0.144 main-regime figure.
+# ---------------------------------------------------------------------------
+
+SCHED_TXNS = int(os.environ.get("SCHED_BENCH_TXNS", "8192"))
+SCHED_BATCHES = int(os.environ.get("SCHED_BENCH_BATCHES", "13"))
+SCHED_WARMUP = min(3, max(0, SCHED_BATCHES - 2))
+SCHED_REPEATS = int(os.environ.get("SCHED_BENCH_REPEATS", "2"))
+SCHED_KEYSPACE = KEYSPACE            # the main high-contention keyspace
+SCHED_TAG_BUCKETS = 64               # declared-tag granularity
+SCHED_LOWC_BATCHES = 3
+
+
+def _sched_tag(txn, keyspace: int) -> str:
+    """The transaction's DECLARED tag: a key-prefix bucket of its first
+    read (what a real client would declare about its access pattern —
+    the identity the GRV predictor dooms)."""
+    k = txn.read_conflict_ranges[0].begin
+    return "b%02d" % (int(k[1:15]) * SCHED_TAG_BUCKETS // keyspace)
+
+
+class SchedBenchPipeline:
+    """One stages-configuration pass over a shared transaction stream.
+
+    Mirrors the production wiring stage for stage: the predictor sees
+    feed rows shaped exactly like ConflictHeatTracker.feed_rows (per-
+    range conflicts + 1-in-8 load samples + per-tag attribution), a
+    deferred transaction is re-admitted with a FRESH read version after
+    at most SCHED_MAX_DEFERRALS waits, reorder runs at batch assembly,
+    and repaired transactions are re-stamped at the aborting batch's
+    commit version and re-resolved once in a follow-up batch (half a
+    batch interval later, like the proxy's repair batch).  Shared txn
+    objects are never mutated — re-stamps go through dataclasses.replace
+    — so every configuration replays the identical stream."""
+
+    MAX_DEFERRALS = 3
+
+    def __init__(self, predictor_on: bool, reorder_on: bool,
+                 repair_on: bool, keyspace: int) -> None:
+        from foundationdb_tpu.conflict.oracle import OracleConflictSet
+        from foundationdb_tpu.sched.predictor import ConflictPredictor
+        self.oracle = OracleConflictSet(0)
+        self.pred = ConflictPredictor() if predictor_on else None
+        self.reorder_on = reorder_on
+        self.repair_on = repair_on
+        self.keyspace = keyspace
+        self.stats = {"committed": 0, "total": 0, "deferrals": 0,
+                      "repairs": 0, "repairs_ok": 0, "reorder_moved": 0}
+        self._deferred: list = []
+        # Stages-off verdict codes per counted batch (parity guard).
+        self.off_codes: list = []
+
+    def _feed_predictor(self, txlist, attr) -> None:
+        rows = {}
+        for ti, ranges in attr.items():
+            tg = _sched_tag(txlist[ti], self.keyspace)
+            for b, e in ranges:
+                r = rows.setdefault((b, e), [0, 0, {}])
+                r[0] += 1
+                r[2][tg] = r[2].get(tg, 0) + 1
+        for j in range(0, len(txlist), 8):   # the 1-in-8 load column
+            rr = txlist[j].read_conflict_ranges[0]
+            r = rows.setdefault((rr.begin, rr.end), [0, 0, {}])
+            r[1] += 1
+        self.pred.update([(b, e, c, l, tags, {})
+                          for (b, e), (c, l, tags) in rows.items()])
+
+    def _resolve(self, entries, version, floor, repair_sink) -> None:
+        """One commit batch: reorder -> oracle -> repair collection."""
+        import dataclasses as _dc
+        from foundationdb_tpu.sched.reorder import moved_count, reorder_batch
+        from foundationdb_tpu.sched.repair import repair_eligible
+        from foundationdb_tpu.txn.types import CommitResult
+        if self.reorder_on and len(entries) > 1:
+            order = reorder_batch([e[0] for e in entries], exact_max=2048)
+            self.stats["reorder_moved"] += moved_count(order)
+            entries = [entries[i] for i in order]
+        txlist = [e[0] for e in entries]
+        verdicts, _rep = self.oracle.resolve_with_conflicts(
+            txlist, version, floor)
+        attr = self.oracle.last_attribution
+        if self.pred is not None:
+            self._feed_predictor(txlist, attr)
+        for j, (e, v) in enumerate(zip(entries, verdicts)):
+            txn, attempts, _defers, counted = e
+            if v == CommitResult.COMMITTED:
+                if counted:
+                    self.stats["committed"] += 1
+                    if attempts:
+                        self.stats["repairs_ok"] += 1
+            elif v == CommitResult.CONFLICT and self.repair_on and \
+                    repair_eligible(txn, attr.get(j) or [], j in attr,
+                                    attempts, 1):
+                e[0] = _dc.replace(txn, read_snapshot=version)
+                e[1] = attempts + 1
+                self.stats["repairs"] += 1
+                repair_sink.append(e)
+        return verdicts
+
+    def run_batch(self, prev, version, floor, txns, counted: bool):
+        """One stream step: admission over deferred + fresh arrivals,
+        the main commit batch, then the repair follow-up batch."""
+        import dataclasses as _dc
+        fresh = [[t, 0, 0, counted] for t in txns or []]
+        if counted:
+            self.stats["total"] += len(fresh)
+        arrivals, self._deferred = self._deferred + fresh, []
+        admitted = []
+        for e in arrivals:
+            txn, attempts, defers, _counted = e
+            if self.pred is not None and attempts == 0 and \
+                    defers < self.MAX_DEFERRALS and \
+                    self.pred.is_doomed((_sched_tag(txn, self.keyspace),)):
+                e[2] = defers + 1
+                self.stats["deferrals"] += 1
+                self._deferred.append(e)
+                continue
+            if defers:
+                # Deferred requests acquire their read version at
+                # ADMISSION (the whole point of the delay): fresh as of
+                # the last committed batch.
+                e[0] = _dc.replace(txn, read_snapshot=prev)
+            admitted.append(e)
+        repairs: list = []
+        verdicts = None
+        if admitted:
+            verdicts = self._resolve(admitted, version, floor, repairs)
+        if repairs:
+            self._resolve(repairs, version + VERSIONS_PER_BATCH // 2,
+                          floor, [])
+        return admitted, verdicts
+
+    def drained(self) -> bool:
+        return not self._deferred
+
+
+def run_sched_config(stream, keyspace, predictor_on, reorder_on,
+                     repair_on):
+    """One full pass of the shared stream through a stages
+    configuration; returns (stats, elapsed_s, off_verdict_codes)."""
+    pipe = SchedBenchPipeline(predictor_on, reorder_on, repair_on,
+                              keyspace)
+    off_codes = []
+    t0 = time.perf_counter()
+    steps = list(stream) + [(None, None, None, False)] * 4  # drain carries
+    version = None
+    for prev, version_, txns, counted in steps:
+        if version_ is None:
+            if pipe.drained():
+                break
+            prev, version_ = version, version + VERSIONS_PER_BATCH
+            txns, counted = None, False
+        version = version_
+        floor = max(0, version - WINDOW_BATCHES * VERSIONS_PER_BATCH)
+        admitted, verdicts = pipe.run_batch(prev, version, floor, txns,
+                                            counted)
+        if verdicts is not None and not (predictor_on or reorder_on or
+                                         repair_on):
+            off_codes.append(np.asarray([int(v) for v in verdicts],
+                                        dtype=np.int8))
+    elapsed = time.perf_counter() - t0
+    return pipe.stats, elapsed, off_codes
+
+
+def run_sched_bench() -> dict:
+    """The `bench.py sched` measurement: the four stages configurations
+    (off / predictor / reorder / repair / all) interleaved best-of over
+    one shared high-contention stream, the stages-off parity guard, the
+    low-contention regime with every stage on, and (budget permitting)
+    a conflict-plane ranges/s spot check against the round-8 figure."""
+    global TXNS_PER_BATCH
+    from foundationdb_tpu.conflict.oracle import OracleConflictSet
+    from foundationdb_tpu.txn.types import CommitResult
+
+    saved_txns, TXNS_PER_BATCH = TXNS_PER_BATCH, SCHED_TXNS
+    try:
+        rng = np.random.default_rng(4242)
+        stream = []
+        version = 1_000
+        for i in range(SCHED_BATCHES):
+            prev, version = version, version + VERSIONS_PER_BATCH
+            _enc, kids, snaps = gen_batch(rng, version, prev,
+                                          keyspace=SCHED_KEYSPACE)
+            stream.append((prev, version, to_transactions(kids, snaps),
+                           i >= SCHED_WARMUP))
+        _phase(f"sched stream ready: {SCHED_BATCHES} batches x "
+               f"{SCHED_TXNS} txns")
+
+        configs = [("off", (False, False, False)),
+                   ("predictor", (True, False, False)),
+                   ("reorder", (False, True, False)),
+                   ("repair", (False, False, True)),
+                   ("all", (True, True, True))]
+        best = {}
+        for rep in range(max(1, SCHED_REPEATS)):
+            for name, cfg in configs:
+                stats, elapsed, off_codes = run_sched_config(
+                    stream, SCHED_KEYSPACE, *cfg)
+                cur = best.get(name)
+                if cur is not None and cur["stats"] != stats:
+                    print(f"sched: nondeterministic commit accounting "
+                          f"in config {name!r}", file=sys.stderr)
+                    sys.exit(1)
+                if cur is None or elapsed < cur["elapsed"]:
+                    best[name] = {"stats": stats, "elapsed": elapsed,
+                                  "off_codes": off_codes}
+                _phase(f"sched rep{rep} {name}: commit_rate="
+                       f"{stats['committed'] / max(stats['total'], 1):.3f}"
+                       f" ({elapsed:.1f}s)")
+
+        # Knobs-off parity guard: the stages-off pipeline's verdicts must
+        # be bit-identical to a plain oracle pass over the same stream —
+        # the bench-side face of the abort-set parity battery.
+        oracle = OracleConflictSet(0)
+        for bi, (prev, v, txns, _c) in enumerate(stream):
+            floor = max(0, v - WINDOW_BATCHES * VERSIONS_PER_BATCH)
+            want = np.asarray(
+                [int(r) for r in oracle.resolve(txns, v, floor)],
+                dtype=np.int8)
+            got = best["off"]["off_codes"][bi]
+            if not np.array_equal(want, got):
+                print(f"PARITY FAILURE: stages-off sched pipeline "
+                      f"diverges from the plain oracle (batch {bi})",
+                      file=sys.stderr)
+                sys.exit(1)
+
+        def rate(name):
+            s = best[name]["stats"]
+            return s["committed"] / max(s["total"], 1)
+
+        def goodput(name):
+            s = best[name]["stats"]
+            return s["committed"] / max(best[name]["elapsed"], 1e-9)
+
+        # Low-contention regime, every stage ON: the scheduler must be
+        # invisible when there is nothing to schedule around.
+        rng_low = np.random.default_rng(777)
+        low_stream = []
+        version = 1_000
+        for i in range(SCHED_LOWC_BATCHES):
+            prev, version = version, version + VERSIONS_PER_BATCH
+            _enc, kids, snaps = gen_batch(rng_low, version, prev,
+                                          keyspace=KEYSPACE_LOW,
+                                          zipf=False)
+            low_stream.append((prev, version,
+                               to_transactions(kids, snaps), True))
+        low_stats, _el, _oc = run_sched_config(
+            low_stream, KEYSPACE_LOW, True, True, True)
+        commit_rate_low = low_stats["committed"] / max(
+            low_stats["total"], 1)
+        _phase(f"sched low-contention (all on): {commit_rate_low:.3f}")
+
+        doc = {
+            "metric": "sched_commit_rate",
+            "regime": {"txns_per_batch": SCHED_TXNS,
+                       "batches": SCHED_BATCHES,
+                       "warmup_batches": SCHED_WARMUP,
+                       "keyspace": SCHED_KEYSPACE,
+                       "zipf": 1.2,
+                       "repeats": max(1, SCHED_REPEATS)},
+            "commit_rate": {name: round(rate(name), 4)
+                            for name, _ in configs},
+            "goodput_committed_per_s": {
+                name: round(goodput(name), 1) for name, _ in configs},
+            "stage_counters": {name: best[name]["stats"]
+                               for name, _ in configs},
+            "vs_off": {name: (round(rate(name) / rate("off"), 3)
+                              if rate("off") else None)
+                       for name, _ in configs},
+            "vs_main_regime_baseline_0144": round(
+                rate("all") / 0.144, 3),
+            "commit_rate_low": round(commit_rate_low, 4),
+            "parity": "ok",
+        }
+        if rate("all") < 1.5 * 0.144:
+            print(f"# WARNING: stages-on commit_rate {rate('all'):.3f} "
+                  "below the 1.5x 0.144 acceptance floor",
+                  file=sys.stderr)
+        if commit_rate_low < 0.8:
+            print(f"low-contention regime degenerate under sched: "
+                  f"{commit_rate_low:.3f}", file=sys.stderr)
+            sys.exit(1)
+        return doc
+    finally:
+        TXNS_PER_BATCH = saved_txns
+
+
+def run_sched_conflict_plane() -> dict:
+    """Round-8-comparable conflict-plane spot check: a short main-regime
+    supervised stream (same shapes/knobs as run_heat_gate's measured
+    path), so BENCH_r09 carries a ranges/s figure directly against
+    BENCH_r08's — the scheduler must not have moved the conflict core."""
+    global TXNS_PER_BATCH
+    from foundationdb_tpu.conflict.supervisor import SupervisedConflictSet
+    from foundationdb_tpu.conflict.tpu_backend import TpuConflictSet
+
+    saved_txns = TXNS_PER_BATCH
+    TXNS_PER_BATCH = int(os.environ.get("SCHED_BENCH_PLANE_TXNS",
+                                        str(TXNS_PER_BATCH)))
+    try:
+        rng = np.random.default_rng(909)
+        stream = []
+        version = 1_000
+        for _ in range(5):
+            prev, version = version, version + VERSIONS_PER_BATCH
+            enc, kids, snaps = gen_batch(rng, version, prev)
+            stream.append((version, enc, to_transactions(kids, snaps)))
+
+        def floor(v):
+            return max(0, v - WINDOW_BATCHES * VERSIONS_PER_BATCH)
+
+        sup = SupervisedConflictSet(
+            lambda oldest_version=0: TpuConflictSet(
+                oldest_version, capacity=CAPACITY,
+                delta_capacity=DELTA_CAPACITY))
+        # First batch is compile/warm; the rest are measured.
+        v0, enc0, txns0 = stream[0]
+        sup.resolve_encoded_async(enc0, v0, floor(v0),
+                                  transactions=txns0).wait_codes()
+        n_ranges = 0
+        t0 = time.perf_counter()
+        for v, enc, txns in stream[1:]:
+            sup.resolve_encoded_async(enc, v, floor(v),
+                                      transactions=txns).wait_codes()
+            n_ranges += enc.n_ranges
+        dt = time.perf_counter() - t0
+        if sup.degraded or sup.stats["fallback_batches"]:
+            print("sched conflict-plane check degraded to the mirror",
+                  file=sys.stderr)
+            return {"skipped": "supervised backend degraded"}
+        return {"ranges_per_s": round(n_ranges / dt, 1),
+                "batches": len(stream) - 1,
+                "txns_per_batch": TXNS_PER_BATCH}
+    finally:
+        TXNS_PER_BATCH = saved_txns
+
+
+def sched_main() -> None:
+    """`bench.py sched` entry: run the scheduling bench in-process and
+    write BENCH_r09.json next to this file (plus the JSON line)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu" or \
+            os.environ.get("BENCH_FORCE_FALLBACK") == "1":
+        _force_cpu_backend()
+    import jax
+    doc = run_sched_bench()
+    if os.environ.get("SCHED_BENCH_PLANE", "1") != "0" and \
+            _remaining_s() > 240:
+        _phase("sched conflict-plane spot check (supervised path)")
+        doc["conflict_plane"] = run_sched_conflict_plane()
+    else:
+        doc["conflict_plane"] = {"skipped": "budget/SCHED_BENCH_PLANE"}
+    doc["jax_backend"] = jax.default_backend()
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r09.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps(doc))
+
+
 def _force_cpu_backend() -> None:
     """Deregister the axon TPU-tunnel plugin: jax initializes ALL
     registered PJRT plugins on first use and the axon client creation can
@@ -1223,6 +1597,12 @@ def parent_main(backend: str) -> None:
 
 def main() -> None:
     backend = sys.argv[1] if len(sys.argv) > 1 else "tpu"
+    if backend == "sched":
+        # Conflict-aware scheduling bench (ISSUE 12): in-process (the
+        # oracle-model passes need no device budget machinery), writes
+        # BENCH_r09.json.
+        sched_main()
+        return
     if backend == "resolvers":
         # Multi-resolver sweep (ISSUE 7): runs in-process (the sweep's
         # batches are small enough not to need the parent/child budget

@@ -480,6 +480,16 @@ class Transaction:
         # span in CommitDebug trace events.
         if not hasattr(self, "debug_id"):
             self.debug_id: str = ""
+        # Transaction-repair opt-in (sched/repair.py, ISSUE 12): the
+        # client declares its mutations remain valid under re-read
+        # (blind writes, atomic ops, existence guards), so the commit
+        # proxy may re-stamp a staleness-only abort at a fresh read
+        # version and re-resolve it server-side instead of bouncing.
+        # NEVER set this on a transaction whose mutation VALUES were
+        # computed from its reads — the server cannot re-run client
+        # logic, so a repair would commit stale derivations.
+        if not hasattr(self, "repairable"):
+            self.repairable: bool = False
 
     def reset(self) -> None:
         self._conflicting_keys = []
@@ -502,7 +512,8 @@ class Transaction:
                 proxy.get_consistent_read_version.endpoint).get_reply(
                 GetReadVersionRequest(priority=self.priority,
                                       debug_id=self.debug_id,
-                                      tags=(self.tag,) if self.tag else ()))
+                                      tags=(self.tag,) if self.tag else (),
+                                      tenant_id=self.tenant_id))
         return self._read_version
 
     GRV_TIMEOUT = 5.0
@@ -577,6 +588,11 @@ class Transaction:
     METRICS_PREFIX = b"\xff\xff/metrics/"
     METRICS_CONFLICT_PREFIX = b"\xff\xff/metrics/conflict_ranges/"
     METRICS_READ_HOT_PREFIX = b"\xff\xff/metrics/read_hot_ranges/"
+    # Conflict-aware scheduling plane (ISSUE 12):
+    #   scheduler/grv/<proxy>  = JSON predictor/deferral row
+    #   scheduler/proxy/<id>   = JSON reorder/repair row
+    #   scheduler/totals       = JSON knob posture + cluster totals
+    METRICS_SCHEDULER_PREFIX = b"\xff\xff/metrics/scheduler/"
 
     @staticmethod
     def _tenant_entry_json(entry) -> bytes:
@@ -619,15 +635,6 @@ class Transaction:
                  self._tenant_entry_json(TenantMapEntry.decode(v)))
                 for k, v in raw]
 
-    async def _heat_doc(self) -> dict:
-        """status cluster.heat — the single source both metrics mirrors
-        render (so special keys, `fdbcli top` and status agree)."""
-        get_status = getattr(self.db.cluster, "get_status", None)
-        if get_status is None:
-            return {}
-        doc = await get_status()
-        return doc.get("cluster", {}).get("heat", {}) or {}
-
     def _heat_rows(self, heat: dict) -> List[Tuple[bytes, bytes]]:
         """All rows of both \xff\xff/metrics/ modules, key-sorted.
         Row keys embed the range-begin as HEX so they order like the raw
@@ -655,10 +662,42 @@ class Transaction:
         rows.sort()
         return rows
 
+    def _sched_rows(self, sched: dict) -> List[Tuple[bytes, bytes]]:
+        """Rows of the \xff\xff/metrics/scheduler/ module, key-sorted —
+        rendered from the SAME status cluster.scheduler document fdbcli
+        `metrics` prints, so the surfaces agree by construction."""
+        import json as _json
+        p = self.METRICS_SCHEDULER_PREFIX
+        rows: List[Tuple[bytes, bytes]] = []
+        for pid, doc in (sched.get("grv_proxies", {}) or {}).items():
+            rows.append((p + b"grv/" + pid.encode(),
+                         _json.dumps(dict(doc, proxy=pid)).encode()))
+        for pid, doc in (sched.get("commit_proxies", {}) or {}).items():
+            rows.append((p + b"proxy/" + pid.encode(),
+                         _json.dumps(dict(doc, proxy=pid)).encode()))
+        if sched:
+            rows.append((p + b"totals", _json.dumps(
+                dict(sched.get("totals") or {},
+                     enabled=sched.get("enabled") or {})).encode()))
+        rows.sort()
+        return rows
+
+    async def _all_metrics_rows(self) -> List[Tuple[bytes, bytes]]:
+        """Every row of the \xff\xff/metrics/ module family (heat +
+        scheduler), key-sorted, from ONE status fetch."""
+        get_status = getattr(self.db.cluster, "get_status", None)
+        if get_status is None:
+            return []
+        cl = (await get_status()).get("cluster", {})
+        rows = self._heat_rows(cl.get("heat", {}) or {})
+        rows += self._sched_rows(cl.get("scheduler", {}) or {})
+        rows.sort()
+        return rows
+
     async def _metrics_module_rows(self, begin: bytes, end: bytes,
                                    limit: int, reverse: bool = False
                                    ) -> List[Tuple[bytes, bytes]]:
-        rows = [(k, v) for k, v in self._heat_rows(await self._heat_doc())
+        rows = [(k, v) for k, v in await self._all_metrics_rows()
                 if begin <= k < end]
         if reverse:
             rows.reverse()
@@ -666,7 +705,7 @@ class Transaction:
 
     async def _special_key_get(self, key: bytes) -> Optional[bytes]:
         if key.startswith(self.METRICS_PREFIX):
-            for k, v in self._heat_rows(await self._heat_doc()):
+            for k, v in await self._all_metrics_rows():
                 if k == key:
                     return v
             return None
@@ -956,7 +995,8 @@ class Transaction:
                               "NativeAPI.commit.Before")
         f = RequestStream.at(proxy.commit.endpoint).get_reply(  # flowlint: state -- the in-flight commit future
             CommitTransactionRequest(transaction=txn,
-                                     debug_id=self.debug_id))
+                                     debug_id=self.debug_id,
+                                     repair_eligible=self.repairable))
         try:
             idx, _ = await wait_any([f, delay(self.COMMIT_TIMEOUT)])
         except FdbError as e:

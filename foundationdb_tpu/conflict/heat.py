@@ -38,7 +38,8 @@ class ConflictHeatTracker:
     breakdowns of conflict heat.  One instance per resolver."""
 
     __slots__ = ("sample_every", "table_max", "_tick", "ranges",
-                 "tenants", "tags", "total_conflicts", "total_load")
+                 "tenants", "tags", "total_conflicts", "total_load",
+                 "range_tags", "range_tenants")
 
     def __init__(self, sample_every: int = 8, table_max: int = 4096) -> None:
         self.sample_every = max(1, int(sample_every))
@@ -51,6 +52,12 @@ class ConflictHeatTracker:
         self.tags: Dict[str, int] = {}       # throttle tag -> conflict count
         self.total_conflicts = 0             # lifetime (undecayed) counter
         self.total_load = 0
+        # Per-RANGE identity attribution (the conflict predictor's feed,
+        # sched/predictor.py): which tags/tenants the aborts blamed on a
+        # range belonged to.  Bounded by `ranges` — entries live and die
+        # (and halve) with their range row.
+        self.range_tags: Dict[Tuple[bytes, bytes], Dict[str, int]] = {}
+        self.range_tenants: Dict[Tuple[bytes, bytes], Dict[int, int]] = {}
 
     # -- recording -----------------------------------------------------------
     def sample_load(self, begin: bytes, end: bytes) -> bool:
@@ -83,8 +90,16 @@ class ConflictHeatTracker:
         if tenant_id is not None and tenant_id >= 0:
             self.tenants[tenant_id] = \
                 self.tenants.get(tenant_id, 0) + weight
+            rt = self.range_tenants.get((begin, end))
+            if rt is None:
+                rt = self.range_tenants[(begin, end)] = {}
+            rt[tenant_id] = rt.get(tenant_id, 0) + weight
         if tag:
             self.tags[tag] = self.tags.get(tag, 0) + weight
+            rt2 = self.range_tags.get((begin, end))
+            if rt2 is None:
+                rt2 = self.range_tags[(begin, end)] = {}
+            rt2[tag] = rt2.get(tag, 0) + weight
         if len(self.ranges) > self.table_max:
             self.decay()
 
@@ -100,6 +115,22 @@ class ConflictHeatTracker:
         self.tenants = {k: v // 2 for k, v in self.tenants.items()
                         if v >= 2}
         self.tags = {k: v // 2 for k, v in self.tags.items() if v >= 2}
+        # The per-range identity tables halve on the same trigger and
+        # never outlive their range row.
+        self.range_tags = self._halve_identity(self.range_tags)
+        self.range_tenants = self._halve_identity(self.range_tenants)
+
+    def _halve_identity(self, table: Dict) -> Dict:
+        """Halve a per-range identity breakdown, dropping sub-2 counts
+        and entries whose range row just aged out of `ranges`."""
+        out: Dict = {}
+        for k, counts in table.items():
+            if k not in self.ranges:
+                continue
+            halved = {t: v // 2 for t, v in counts.items() if v >= 2}
+            if halved:
+                out[k] = halved
+        return out
 
     # -- queries -------------------------------------------------------------
     def split_load(self, begin: bytes, end: bytes
@@ -122,6 +153,16 @@ class ConflictHeatTracker:
                 if c > 0]
         rows.sort(key=lambda r: (-r[2], r[0], r[1]))
         return rows[:k]
+
+    def feed_rows(self, k: int) -> List[tuple]:
+        """The conflict predictor's wire feed (sched/predictor.py via
+        the ratekeeper piggyback): top-k conflict ranges as (begin, end,
+        conflicts, load, {tag: conflicts}, {tenant: conflicts}) tuples,
+        hottest first, key-ordered on ties."""
+        return [(b, e, c, l,
+                 dict(self.range_tags.get((b, e), ()) or {}),
+                 dict(self.range_tenants.get((b, e), ()) or {}))
+                for b, e, c, l in self.top_conflicts(k)]
 
     @staticmethod
     def _top_counts(counts: Dict, k: int) -> List[Tuple[object, int]]:

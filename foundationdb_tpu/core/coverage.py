@@ -90,5 +90,19 @@ for _name in (
     "ChaosCoordinatorRestart",
     "ChaosFatalDiskRestart",
     "BackupRestoreUnderChaos",
+    # Conflict-aware scheduling (ISSUE 12): predictor deferral at GRV
+    # admission, intra-batch reorder at the commit proxy, and the
+    # server-side repair path (attempted + committed) — the SchedChaos
+    # spec must keep exercising all three stages.
+    "GrvSchedDeferral",
+    "ProxyBatchReordered",
+    "ProxyTxnRepaired",
+    "ProxyTxnRepairCommitted",
+    # Shard-disownment fence (system_data.py DISOWN_SHARD_PREFIX): a
+    # storage server that missed DD's out-of-band RemoveShardRequest
+    # (unreachable during the move) closes the range in-stream instead
+    # of serving frozen data — the stale-read hole the ISSUE-12 chaos
+    # battery flushed out.
+    "SSDisownShardFence",
 ):
     register(_name)

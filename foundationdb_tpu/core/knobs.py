@@ -180,6 +180,43 @@ class ServerKnobs(KnobBase):
         self.READ_HOT_SHARD_MAX_REPORT = 8    # rows per reply/status
         self.READ_HOT_MIN_OPS_PER_S = 10.0    # ReadHotShard trace floor
 
+        # Conflict-aware transaction scheduling (foundationdb_tpu/sched/,
+        # ISSUE 12): three independently gated stages.  All DEFAULT OFF —
+        # the abort-set parity guard promises bit-identical resolver
+        # verdicts and reply bytes with every SCHED_* stage disabled.
+        # (a) Predictor: GRV-admission deferral of transactions whose
+        # declared tag/tenant maps to a predicted-doomed range (decayed
+        # abort-probability EMAs fed from the resolvers' heat trackers
+        # via the ratekeeper's rate-info piggyback).
+        self.SCHED_PREDICTOR_ENABLED = False
+        # Per-deferral delay at the GRV proxy; deterministic sim delay.
+        self.SCHED_ADMISSION_DELAY_S = 0.05
+        # Starvation proof: a request is deferred at most this many
+        # times, then admitted unconditionally.
+        self.SCHED_MAX_DEFERRALS = 3
+        # EMA fold factor per feed snapshot, and the doom thresholds: a
+        # range is predicted-doomed when its abort-probability EMA and
+        # decayed conflict count both clear these.
+        self.SCHED_PREDICTOR_ALPHA = 0.5
+        # Doom threshold on the conflicts/(conflicts+load) EMA.  Load is
+        # 1-in-8 subsampled upstream, so the ratio overweights aborts by
+        # design; 0.3 means roughly "one attributed abort per ~19 range
+        # touches" — well above any low-contention noise floor.
+        self.SCHED_PREDICTOR_ABORT_P = 0.3
+        self.SCHED_PREDICTOR_MIN_CONFLICTS = 4.0
+        self.SCHED_PREDICTOR_TABLE_MAX = 512
+        # (b) Intra-batch reorder at commit-proxy batch assembly: greedy
+        # topological readers-before-writers pre-pass; above EXACT_MAX
+        # transactions it degrades to the one-round in-degree sort.
+        self.SCHED_REORDER_ENABLED = False
+        self.SCHED_REORDER_EXACT_MAX = 1024
+        # (c) Repair: opt-in server-side retry of staleness-only aborts
+        # (re-stamp at a fresh read version, re-resolve) — at most this
+        # many attempts per transaction before the abort goes back to
+        # the client.
+        self.SCHED_REPAIR_ENABLED = False
+        self.TXN_REPAIR_MAX_ATTEMPTS = 1
+
         # Resolution plane (master recruitment): resolver count override —
         # 0 recruits DatabaseConfiguration.n_resolvers (the committed
         # \xff/conf value); > 0 pins the count regardless of configuration

@@ -1,0 +1,42 @@
+"""Conflict-aware transaction scheduling (ISSUE 12).
+
+Three independently knob-gated stages that convert doomed resolve-and-
+abort round trips into useful work, grounded in "Intelligent Transaction
+Scheduling via Conflict Prediction in OLTP DBMS" (arXiv 2409.01675) and
+"Transaction Repair: Full Serializability Without Locks" (arXiv
+1403.5645):
+
+* **predictor** (GRV admission, ``SCHED_PREDICTOR_ENABLED``): a
+  deterministic per-proxy hot-range table of decayed abort-probability
+  EMAs, fed from the resolvers' conflict-heat trackers via a
+  ratekeeper-pattern piggyback.  A transaction whose declared tag maps
+  to a predicted-doomed range is briefly deferred (starvation-proof:
+  ``SCHED_MAX_DEFERRALS``) instead of resolving into a guaranteed abort
+  — when it is finally admitted it reads at a FRESHER version, which is
+  what actually saves it.
+* **reorder** (commit-proxy batch assembly, ``SCHED_REORDER_ENABLED``):
+  a cheap host-side pre-pass ordering same-batch transactions so
+  intra-batch readers run before the writers that would abort them
+  (greedy topological order over write-vs-read interval overlap,
+  deterministic tiebreak).  Identity — provably verdict-order-
+  independent — when disabled.
+* **repair** (commit proxy post-resolution, ``SCHED_REPAIR_ENABLED`` +
+  per-transaction opt-in): a transaction aborted purely on read-set
+  staleness with EXACT culprit attribution is re-stamped at a fresh
+  read version and re-resolved once server-side
+  (``TXN_REPAIR_MAX_ATTEMPTS``), converting a full client bounce into
+  one extra resolver round trip.  Opt-in because the server cannot
+  re-run client logic: the client declares its mutations remain valid
+  under re-read (blind writes, atomic ops, existence guards).
+
+Everything here is deterministic under simulation: no wall clock (decay
+is driven by feed cadence), dict/sorted iteration only, and every stage
+is bit-invisible when its knob is off (the abort-set parity guard in
+tests/test_sched.py pins that).
+"""
+
+from .predictor import ConflictPredictor
+from .reorder import reorder_batch
+from .repair import repair_eligible
+
+__all__ = ["ConflictPredictor", "reorder_batch", "repair_eligible"]

@@ -1,0 +1,53 @@
+"""Transaction repair eligibility: staleness-only aborts, exactly blamed.
+
+Reference: "Transaction Repair: Full Serializability Without Locks"
+(arXiv 1403.5645) — an aborted transaction whose only sin is a stale
+read set can be salvaged by re-executing against fresh reads instead of
+bouncing to the client.  This plane cannot re-run client logic, so the
+salvage is OPT-IN (``Transaction.repairable``): the client declares its
+mutations remain valid under re-read — blind writes, atomic ops,
+existence guards.  The commit proxy then re-stamps the transaction at a
+fresh read version and re-resolves it once (``TXN_REPAIR_MAX_ATTEMPTS``),
+converting a full client round trip into one extra resolver hop.
+
+The eligibility predicate is deliberately strict:
+
+* the abort's attribution must be EXACT (the resolvers pinned the true
+  culprit ranges; conservative whole-read-set blame proves nothing);
+* every culprit must lie INSIDE the transaction's declared read set —
+  pure read-set staleness, no write-write component to re-stamp away
+  (in this OCC plane conflicts are read-vs-write by construction, so a
+  culprit escaping the read set marks attribution breakage, not a
+  repairable abort);
+* the attempt budget must not be exhausted.
+
+Pure functions, no clock, no RNG — callable from the proxy's commit
+path and from the bench's host-side pipeline model alike.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple
+
+
+def culprits_in_read_set(read_ranges: Sequence,
+                         culprits: Iterable[Tuple[bytes, bytes]]) -> bool:
+    """Every culprit [b, e) contained in some declared read range.
+    Culprits arrive clipped per resolver, so containment (not equality)
+    is the right test."""
+    spans = [(r.begin, r.end) for r in read_ranges]
+    for b, e in culprits:
+        if not any(rb <= b and e <= re for rb, re in spans):
+            return False
+    return True
+
+
+def repair_eligible(txn, culprits: List[Tuple[bytes, bytes]],
+                    exact: bool, attempt: int, max_attempts: int) -> bool:
+    """Can this CONFLICT-verdict transaction be re-stamped and
+    re-resolved server-side?  See the module doc for the gates."""
+    if attempt >= max_attempts:
+        return False
+    if not exact or not culprits:
+        return False
+    return culprits_in_read_set(txn.read_conflict_ranges, culprits)

@@ -324,6 +324,25 @@ class CommitProxy:
                                  batch_num: int) -> None:
         self.metrics.counter("TxnCommitBatches").add(1)
         t_start = now()
+        knobs = server_knobs()
+        if knobs.SCHED_REORDER_ENABLED and len(batch) > 1:
+            # Sched stage (b): intra-batch conflict-aware reorder — a
+            # host-side pre-pass placing readers before the writers that
+            # would abort them (sched/reorder.py).  Batch order is this
+            # proxy's choice; verdicts, versionstamps and replies all
+            # follow the REORDERED index from here on.  Skipped entirely
+            # (bit-identical pipeline) when the knob is off.
+            from ..sched.reorder import moved_count, reorder_batch
+            order = reorder_batch(
+                [req.transaction for req in batch],
+                exact_max=int(knobs.SCHED_REORDER_EXACT_MAX))
+            moved = moved_count(order)
+            self.metrics.counter("ReorderBatches").add(1)
+            if moved:
+                batch = [batch[i] for i in order]
+                self.metrics.counter("ReorderSwaps").add(moved)
+                from ..core.coverage import test_coverage
+                test_coverage("ProxyBatchReordered")
         # One span per commit batch (reference Span("commitBatch") in
         # CommitBatchContext): rides the resolution requests and the TLog
         # push explicitly (an ambient global would leak across actor
@@ -432,7 +451,30 @@ class CommitProxy:
                     t_idx = index_maps[r_idx][local_i]
                     conflict_exact[t_idx] = \
                         conflict_exact.get(t_idx, True) and bool(exact)
+        # Sched stage (c): transaction repair (sched/repair.py).  An
+        # opt-in transaction aborted purely on read-set staleness with
+        # EXACT culprit attribution is re-stamped at this batch's commit
+        # version — a read version every culprit write is now visible at
+        # — and re-resolved through a fresh single-purpose batch: one
+        # extra resolver round trip instead of a full client bounce.
+        # Safe against duplicate commits by construction: the original
+        # attempt's verdict was a definitive abort (nothing logged), and
+        # the re-enqueued request carries the ORIGINAL reply promise, so
+        # the client sees exactly one outcome.
+        repaired: set = set()
+        if server_knobs().SCHED_REPAIR_ENABLED and not self.broken:
+            repair_reqs = self._collect_repairs(
+                batch, verdicts, tenant_errors, conflict_ranges,
+                conflict_exact, commit_version, repaired)
+            if repair_reqs:
+                self.local_batch_number += 1
+                self._spawn(
+                    self._commit_batch(repair_reqs,
+                                       self.local_batch_number),
+                    f"{self.id}.repairBatch")
         for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
+            if t_idx in repaired:
+                continue   # reply comes from the repair batch
             if t_idx in tenant_errors:
                 # Tenant fence rejection: a SPECIFIC, non-retryable error
                 # (not not_committed — retrying a dead tenant's write
@@ -441,6 +483,12 @@ class CommitProxy:
                 req.reply.send_error(tenant_errors[t_idx])
             elif verdict == CommitResult.COMMITTED:
                 self.metrics.counter("TxnCommitted").add(1)
+                if getattr(req, "repair_attempt", 0) > 0:
+                    # A server-side repair landed: the abort the client
+                    # never saw became a commit one batch later.
+                    self.metrics.counter("RepairSucceeded").add(1)
+                    from ..core.coverage import test_coverage
+                    test_coverage("ProxyTxnRepairCommitted")
                 req.reply.send(CommitID(version=commit_version,
                                         txn_batch_id=batch_num,
                                         txn_batch_index=t_idx))
@@ -450,6 +498,12 @@ class CommitProxy:
                 req.reply.send_error(err("transaction_too_old"))
             else:
                 self.metrics.counter("TxnConflicted").add(1)
+                if getattr(req, "repair_attempt", 0) > 0:
+                    # Repair budget spent and the re-resolve STILL
+                    # conflicted (the culprit range is being rewritten
+                    # faster than one batch interval): the abort goes
+                    # back to the client like any other.
+                    self.metrics.counter("RepairExhausted").add(1)
                 from ..core.error import err
                 e = err("not_committed")
                 if t_idx in conflict_ranges:
@@ -478,6 +532,61 @@ class CommitProxy:
         # Reply stage: committed-version report + client reply fan-out.
         self.metrics.histogram("Reply").record(now() - t_reply)
         trace_batch_event("CommitDebug", span, "CommitProxy.reply")
+
+    def _collect_repairs(self, batch, verdicts, tenant_errors,
+                         conflict_ranges, conflict_exact,
+                         commit_version: Version, repaired: set
+                         ) -> List[CommitTransactionRequest]:
+        """Repair candidates of one resolved batch (sched stage c):
+        CONFLICT verdicts that opted in, carry attempt budget, passed
+        every fence, and whose EXACT culprit attribution lies entirely
+        inside the declared read set (pure staleness — see
+        sched/repair.py).  Marks chosen indices in `repaired` and
+        returns the re-stamped requests (original reply promises
+        attached) for the follow-up batch."""
+        import dataclasses as _dc
+
+        from ..sched.repair import repair_eligible
+        knobs = server_knobs()
+        max_attempts = int(knobs.TXN_REPAIR_MAX_ATTEMPTS)
+        out: List[CommitTransactionRequest] = []
+        for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
+            if verdict != CommitResult.CONFLICT or t_idx in tenant_errors:
+                continue
+            if not getattr(req, "repair_eligible", False):
+                continue
+            if self.db_locked is not None and \
+                    not getattr(req.transaction, "lock_aware", False):
+                continue   # the lock fence landed after admission
+            attempt = getattr(req, "repair_attempt", 0)
+            if not repair_eligible(
+                    req.transaction, conflict_ranges.get(t_idx) or [],
+                    conflict_exact.get(t_idx, False) and
+                    t_idx in conflict_ranges, attempt, max_attempts):
+                continue
+            self.metrics.counter("RepairAttempted").add(1)
+            from ..core.coverage import test_coverage
+            test_coverage("ProxyTxnRepaired")
+            repaired.add(t_idx)
+            out.append(CommitTransactionRequest(
+                transaction=_dc.replace(req.transaction,
+                                        read_snapshot=commit_version),
+                debug_id=req.debug_id, repair_eligible=True,
+                repair_attempt=attempt + 1, reply=req.reply))
+        return out
+
+    def scheduler_status(self) -> Dict[str, int]:
+        """This proxy's slice of status cluster.scheduler (reorder and
+        repair counters; the GRV proxies contribute the predictor
+        side)."""
+        c = self.metrics.counter
+        return {
+            "reorder_batches": c("ReorderBatches").value,
+            "reorder_swaps": c("ReorderSwaps").value,
+            "repairs_attempted": c("RepairAttempted").value,
+            "repairs_succeeded": c("RepairSucceeded").value,
+            "repairs_exhausted": c("RepairExhausted").value,
+        }
 
     def _spawn(self, coro, name: str):
         """Handlers are PROCESS-scoped: a killed process must cancel its
@@ -562,8 +671,16 @@ class CommitProxy:
         from .system_data import SYSTEM_KEYS_BEGIN
         floor = commit_version - int(
             server_knobs().MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+        sched_repair = bool(server_knobs().SCHED_REPAIR_ENABLED)
         for t_idx, req in enumerate(batch):
             txn = req.transaction
+            # Repair (sched stage c) needs the resolvers' EXACT culprit
+            # ranges on the reply: force per-range conflict reporting
+            # for opted-in transactions while the stage is enabled (the
+            # same wire surface report_conflicting_keys clients use, so
+            # knobs-off bytes are untouched).
+            report_conflicts = txn.report_conflicting_keys or (
+                sched_repair and getattr(req, "repair_eligible", False))
             # Metadata-bearing ("state") transactions go to EVERY resolver
             # with their mutations attached: each resolver records them with
             # its local verdict and streams them to the other proxies
@@ -591,7 +708,7 @@ class CommitProxy:
                         txn.write_conflict_ranges, idx, floor),
                     mutations=list(txn.mutations) if is_state else [],
                     read_snapshot=txn.read_snapshot,
-                    report_conflicting_keys=txn.report_conflicting_keys,
+                    report_conflicting_keys=report_conflicts,
                     # Tenant/tag identity rides the clipped fragment so
                     # the resolver's conflict-heat tracker can attribute
                     # aborts per tenant and per tag (conflict/heat.py).
@@ -857,6 +974,20 @@ class CommitProxy:
                 if m.param1 >= SYSTEM_KEYS_BEGIN or (
                         m.type == MutationType.ClearRange
                         and m.param2 > SYSTEM_KEYS_BEGIN):
+                    # Shard-team shrink: fence the REMOVED members through
+                    # their own mutation streams (DISOWN_SHARD_PREFIX,
+                    # system_data.py) BEFORE the map updates — an
+                    # unreachable member that DD's out-of-band
+                    # RemoveShardRequest can't reach must still stop
+                    # serving the range the moment its version passes
+                    # this commit, or it serves frozen data forever.
+                    from .system_data import (DISOWN_SHARD_PREFIX,
+                                              disowned_spans)
+                    for dtag, db_, de_ in disowned_spans(
+                            self.key_servers, m):
+                        messages.setdefault(dtag, []).append(Mutation(
+                            MutationType.SetValue,
+                            DISOWN_SHARD_PREFIX + db_, de_))
                     if self._apply_metadata(m):
                         messages.setdefault(TXS_TAG, []).append(m)
                 if self.backup_active and m.param1 < SYSTEM_KEYS_BEGIN:

@@ -41,6 +41,16 @@ class GrvProxy:
         self._tag_budgets: dict = {}
         self._tag_deferred: dict = {}
         self.tag_released: dict = {}    # tag -> total released (to RK)
+        # Conflict predictor (sched/predictor.py, ISSUE 12): per-proxy
+        # hot-range abort-probability table fed from the ratekeeper's
+        # rate-info piggyback.  Admission defers a predicted-doomed
+        # request (knob-bounded delay, starvation-proof max-defer count)
+        # so it reads at a fresher version instead of resolving into a
+        # near-certain abort.  Inert while SCHED_PREDICTOR_ENABLED is
+        # off: no deferrals, no feed folding.
+        from ..sched.predictor import ConflictPredictor
+        self.predictor = ConflictPredictor.from_knobs(server_knobs())
+        self._sched_deferred: list = []   # (release_at, req), release order
         self.interface = GrvProxyInterface(proxy_id)
         # Priority queues: immediate > default > batch (reference
         # SystemTransactionQueue/DefaultQueue/BatchQueue).
@@ -97,6 +107,31 @@ class GrvProxy:
                     return True
             return False
 
+        def sched_blocked(req) -> bool:
+            """Predictor deferral (sched stage a): a request whose
+            declared tag/tenant maps to a predicted-doomed range waits
+            out a short deterministic delay in a side queue instead of
+            burning a guaranteed resolve-and-abort round trip.  At most
+            SCHED_MAX_DEFERRALS deferrals per request — then it is
+            admitted unconditionally (starvation-proof)."""
+            knobs = server_knobs()
+            if not knobs.SCHED_PREDICTOR_ENABLED:
+                return False
+            defers = getattr(req, "_sched_defers", 0)
+            if defers >= int(knobs.SCHED_MAX_DEFERRALS):
+                return False
+            if not self.predictor.is_doomed(
+                    getattr(req, "tags", ()) or (),
+                    getattr(req, "tenant_id", -1)):
+                return False
+            req._sched_defers = defers + 1
+            self._sched_deferred.append(
+                (now() + float(knobs.SCHED_ADMISSION_DELAY_S), req))
+            self.metrics.counter("SchedDeferrals").add(1)
+            from ..core.coverage import test_coverage
+            test_coverage("GrvSchedDeferral")
+            return True
+
         def charge_tags(req) -> None:
             # Only THROTTLED tags are tracked/reported: tags are arbitrary
             # client strings, so unconditional accounting would grow
@@ -112,7 +147,7 @@ class GrvProxy:
         q = self.queues[TransactionPriority.DEFAULT]
         while q and budget - charged > 0:
             req = q.pop(0)
-            if tag_blocked(req):
+            if tag_blocked(req) or sched_blocked(req):
                 continue
             charge_tags(req)
             out.append(req)
@@ -121,13 +156,23 @@ class GrvProxy:
         while q and budget - charged > 0 and \
                 batch_budget - batch_charged > 0:
             req = q.pop(0)
-            if tag_blocked(req):
+            if tag_blocked(req) or sched_blocked(req):
                 continue
             charge_tags(req)
             out.append(req)
             charged += req.transaction_count
             batch_charged += req.transaction_count
         return out, charged, batch_charged
+
+    def _requeue_front(self, reqs) -> None:
+        """Re-admit previously deferred requests at the FRONT of their
+        priority queue, original order preserved (shared by the tag-
+        throttle and predictor deferral paths — a deferred request waits
+        out its hold once, never behind fresh arrivals)."""
+        for req in reversed(list(reqs)):
+            pri = min(max(req.priority, TransactionPriority.BATCH),
+                      TransactionPriority.IMMEDIATE)
+            self.queues[pri].insert(0, req)
 
     async def _transaction_starter(self) -> None:
         from ..core.scheduler import now
@@ -142,12 +187,18 @@ class GrvProxy:
         # runs do to it; found via the unseed digest's fold counts).
         starved = False
         while True:
-            have_deferred = any(self._tag_deferred.values())
-            if not any(self.queues) and not have_deferred:
+            if not any(self.queues) and \
+                    not any(self._tag_deferred.values()) and \
+                    not self._sched_deferred:
                 # Sleep until a request arrives (no virtual-time polling).
                 self._wakeup = Promise()
                 await self._wakeup.get_future()
                 starved = False
+            # Recomputed AFTER the park: new deferrals may have arrived
+            # while we slept (and the park condition already consumed
+            # the pre-await state).
+            have_deferred = any(self._tag_deferred.values()) or \
+                bool(self._sched_deferred)
             # Tag-deferred requests wait on token accrual, not on new
             # arrivals: poll at a coarse interval instead of parking.
             await delay(0.05 if have_deferred and not any(self.queues)
@@ -179,12 +230,18 @@ class GrvProxy:
             for tag, held in list(self._tag_deferred.items()):
                 if held and (tag not in self._tag_rates or
                              self._tag_budgets.get(tag, 0.0) > 0.0):
-                    for req in reversed(held):
-                        pri = min(max(req.priority,
-                                      TransactionPriority.BATCH),
-                                  TransactionPriority.IMMEDIATE)
-                        self.queues[pri].insert(0, req)
+                    self._requeue_front(held)
                     held.clear()
+            # Predictor deferrals whose delay has elapsed re-enter their
+            # priority queue at the FRONT (append order preserved): a
+            # deferred request waits its knob-bounded delay once per
+            # deferral, never behind fresh arrivals.
+            if self._sched_deferred:
+                due = [r for at, r in self._sched_deferred if at <= t]
+                if due:
+                    self._sched_deferred = [
+                        (at, r) for at, r in self._sched_deferred if at > t]
+                    self._requeue_front(due)
             last = t
             batch, charged, batch_charged = self._drain(
                 self.transaction_budget, self.batch_budget)
@@ -218,6 +275,11 @@ class GrvProxy:
                                        tag_released=dict(self.tag_released)))
                 self._rate = reply.tps
                 self._batch_rate = min(reply.batch_tps, reply.tps)
+                heat = getattr(reply, "conflict_heat", None)
+                if heat is not None:
+                    # Fold the piggybacked resolver heat rows into this
+                    # proxy's predictor table (sched stage a).
+                    self.predictor.update(heat)
                 new_tags = reply.tag_throttles or {}
                 for tag in new_tags:
                     if tag not in self._tag_rates:
@@ -294,6 +356,15 @@ class GrvProxy:
             req.reply.send(GetReadVersionReply(version=vreply.version,
                                                locked=vreply.locked,
                                                tag_throttles=throttles))
+
+    def scheduler_status(self) -> dict:
+        """This proxy's slice of status cluster.scheduler: predictor
+        table + deferral counters (the \xff\xff/metrics/scheduler/ and
+        fdbcli `metrics` surfaces render the same document)."""
+        doc = self.predictor.status()
+        doc["deferrals"] = self.metrics.counter("SchedDeferrals").value
+        doc["deferred_held"] = len(self._sched_deferred)
+        return doc
 
     def run(self, process) -> None:
         self._process = process

@@ -272,6 +272,19 @@ class ResolutionMetricsRequest:
 
 
 @dataclass
+class ResolverHeatRequest:
+    """Ratekeeper -> resolver: the conflict-heat feed rows for the
+    scheduling predictor (sched/predictor.py) — top-k decayed conflict
+    ranges with per-tag/per-tenant attribution
+    (ConflictHeatTracker.feed_rows).  A separate stream from `metrics`
+    so the resolutionBalancing poll's count-reset semantics are never
+    perturbed."""
+
+    top_k: int = 32
+    reply: Any = None    # -> List[tuple] feed rows
+
+
+@dataclass
 class ResolutionSplitRequest:
     """Master -> resolver: a key splitting the measured load of
     [begin, end) roughly at `fraction` (reference ResolutionSplitRequest,
@@ -292,11 +305,14 @@ class ResolverInterface:
                                      TaskPriority.ResolutionMetrics)
         self.split = RequestStream("resolver.split",
                                    TaskPriority.ResolutionMetrics)
+        self.heat = RequestStream("resolver.heat",
+                                  TaskPriority.ResolutionMetrics)
         self.wait_failure = RequestStream("resolver.waitFailure",
                                           TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
-        return [self.resolve, self.metrics, self.split, self.wait_failure]
+        return [self.resolve, self.metrics, self.split, self.heat,
+                self.wait_failure]
 
 
 # ---------------------------------------------------------------------------
@@ -307,6 +323,14 @@ class ResolverInterface:
 class CommitTransactionRequest:
     transaction: CommitTransactionRef
     debug_id: str = ""
+    # Transaction-repair opt-in (sched/repair.py): the client declares
+    # its mutations remain valid under re-read (blind writes / atomic
+    # ops), so a staleness-only abort may be re-stamped at a fresh read
+    # version and re-resolved server-side.  repair_attempt counts the
+    # server-side retries already spent (proxy-local bookkeeping; rides
+    # the request so the re-enqueued copy carries its budget).
+    repair_eligible: bool = False
+    repair_attempt: int = 0
     reply: Any = None
 
 
@@ -364,6 +388,10 @@ class GetReadVersionRequest:
     # fdbclient/TagThrottle.actor.cpp): auto-throttled hot tags are held
     # at the GRV proxy under a per-tag budget.
     tags: tuple = ()
+    # Tenant identity (reference TenantInfo riding the GRV): the
+    # scheduling predictor's admission check dooms per-tenant as well as
+    # per-tag (sched/predictor.py doomed_tenants).  -1 = raw.
+    tenant_id: int = -1
     reply: Any = None
 
     FLAG_CAUSAL_READ_RISKY = 1
@@ -772,6 +800,10 @@ class InitializeRatekeeperRequest:
     rk_id: str
     storage_interfaces: Dict[Tag, Any] = field(default_factory=dict)
     tlog_interfaces: List[Any] = field(default_factory=list)
+    # This epoch's resolvers: the ratekeeper polls their conflict-heat
+    # feeds and piggybacks the folded rows on GetRateInfoReply for the
+    # GRV proxies' conflict predictors (sched/predictor.py).
+    resolver_interfaces: List[Any] = field(default_factory=list)
     reply: Any = None     # -> RatekeeperInterface
 
 

@@ -1389,7 +1389,10 @@ async def master_server(master: Master, process, coordinators,
             InitializeRatekeeperRequest(
                 rk_id=f"rk.e{master.epoch}",
                 storage_interfaces=storage_servers,
-                tlog_interfaces=list(tlogs)))
+                tlog_interfaces=list(tlogs),
+                # The epoch's resolvers: the RK polls their conflict-heat
+                # feeds for the GRV proxies' predictors (sched stage a).
+                resolver_interfaces=list(resolvers)))
         data_distributor = await RequestStream.at(
             pick(2).init_data_distributor.endpoint).get_reply(
             InitializeDataDistributorRequest(

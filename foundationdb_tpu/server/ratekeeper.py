@@ -86,6 +86,11 @@ class GetRateInfoReply:
     # tag -> per-proxy tps ceiling for auto-throttled hot tags (reference
     # GetRateInfoReply.throttledTags).
     tag_throttles: Dict[str, float] = field(default_factory=dict)
+    # Folded resolver conflict-heat rows (begin, end, conflicts, load,
+    # {tag: n}, {tenant: n}) for the GRV proxies' conflict predictors
+    # (sched/predictor.py).  None while SCHED_PREDICTOR_ENABLED is off —
+    # the rate-info reply then carries exactly its pre-scheduler bytes.
+    conflict_heat: Any = None
 
 
 @dataclass
@@ -163,13 +168,19 @@ class RatekeeperInterface:
 class Ratekeeper:
     def __init__(self, rk_id: str, storage_interfaces: Dict[int, Any],
                  tlog_interfaces: List[Any] = (),
-                 poll_interval: float = 0.5, db: Any = None) -> None:
+                 poll_interval: float = 0.5, db: Any = None,
+                 resolver_interfaces: List[Any] = ()) -> None:
         self.id = rk_id
         self.interface = RatekeeperInterface(rk_id)
         self.interface.role = self   # sim-side backref for status/tests
         self.storage_interfaces = storage_interfaces
         self.tlog_interfaces = list(tlog_interfaces)
+        self.resolver_interfaces = list(resolver_interfaces)
         self.poll_interval = poll_interval
+        # Folded resolver conflict-heat rows for the GRV predictors
+        # (sched/predictor.py); refreshed by _poll_conflict_heat while
+        # SCHED_PREDICTOR_ENABLED, piggybacked on every rate-info reply.
+        self.conflict_heat_rows: List[Any] = []
         # Optional db client (worker-injected): polls committed
         # per-tenant quotas — configuration as data, no private channel.
         self.db = db
@@ -378,6 +389,59 @@ class Ratekeeper:
             self._update_rate()
             await delay(self.poll_interval)
 
+    async def _poll_conflict_heat(self) -> None:
+        """Poll every resolver's conflict-heat feed and fold the rows
+        for the GRV proxies' predictors (the ratekeeper pattern: the
+        feed rides the rate-info replies proxies already poll for).
+        Idle while SCHED_PREDICTOR_ENABLED is off — no requests, no
+        rows, rate-info replies bit-identical to pre-scheduler."""
+        from ..core.futures import swallow, wait_all
+        from .interfaces import ResolverHeatRequest
+        while True:
+            await delay(max(self.poll_interval, 0.25))
+            knobs = server_knobs()
+            if not knobs.SCHED_PREDICTOR_ENABLED or \
+                    not self.resolver_interfaces:
+                if self.conflict_heat_rows:
+                    self.conflict_heat_rows = []
+                continue
+            top_k = max(8, int(knobs.SCHED_PREDICTOR_TABLE_MAX) // 8)
+            futures = [RequestStream.at(r.heat.endpoint).get_reply(
+                ResolverHeatRequest(top_k=top_k))
+                for r in self.resolver_interfaces]
+            await wait_all([swallow(f) for f in futures])
+            self.conflict_heat_rows = self._fold_conflict_heat(
+                [f.get() for f in futures if not f.is_error()], top_k)
+
+    @staticmethod
+    def _fold_conflict_heat(per_resolver: List[Any], top_k: int
+                            ) -> List[tuple]:
+        """Merge per-resolver feed rows: resolver partitions are
+        disjoint over user keys, but the broadcast \xff range (and a
+        boundary move's history overlap) can surface one range twice —
+        sum counts, merge identity breakdowns.  Output hottest-first,
+        key-ordered on ties (deterministic)."""
+        merged: Dict[tuple, list] = {}
+        for rows in per_resolver:
+            for row in rows or ():
+                begin, end, conflicts, load = row[0], row[1], row[2], row[3]
+                tags = dict(row[4] or {}) if len(row) > 4 else {}
+                tenants = dict(row[5] or {}) if len(row) > 5 else {}
+                e = merged.get((begin, end))
+                if e is None:
+                    merged[(begin, end)] = [conflicts, load, tags, tenants]
+                else:
+                    e[0] += conflicts
+                    e[1] += load
+                    for t, n in tags.items():
+                        e[2][t] = e[2].get(t, 0) + n
+                    for t, n in tenants.items():
+                        e[3][t] = e[3].get(t, 0) + n
+        rows = [(b, e, v[0], v[1], v[2], v[3])
+                for (b, e), v in merged.items()]
+        rows.sort(key=lambda r: (-r[2], r[0], r[1]))
+        return rows[:top_k]
+
     async def _serve_rate_info(self) -> None:
         async for req in self.interface.get_rate_info.queue:
             t = now()
@@ -422,7 +486,9 @@ class Ratekeeper:
                 tag_throttles={tag: tps / n_proxies
                                for tag, tps
                                in self.effective_throttles().items()},
-                lease_duration=self.poll_interval * 2))
+                lease_duration=self.poll_interval * 2,
+                conflict_heat=(list(self.conflict_heat_rows)
+                               if self.conflict_heat_rows else None)))
 
     async def _serve_status(self) -> None:
         async for req in self.interface.get_status.queue:
@@ -441,6 +507,9 @@ class Ratekeeper:
         for s in self.interface.streams():
             process.register(s)
         process.spawn(self._poll_storage(), f"{self.id}.pollStorage")
+        if self.resolver_interfaces:
+            process.spawn(self._poll_conflict_heat(),
+                          f"{self.id}.pollConflictHeat")
         process.spawn(self._serve_rate_info(), f"{self.id}.serveRate")
         process.spawn(self._serve_status(), f"{self.id}.serveStatus")
         if self.db is not None:

@@ -276,6 +276,17 @@ class Resolver:
                         break
             req.reply.send(split_key)
 
+    async def _serve_heat(self) -> None:
+        """The scheduling predictor's feed (ResolverHeatRequest, polled
+        by the ratekeeper): top-k decayed conflict ranges with their
+        tag/tenant attribution.  Empty while heat telemetry is disabled
+        — the predictor then simply never dooms anything."""
+        async for req in self.interface.heat.queue:
+            if not server_knobs().HEAT_TELEMETRY_ENABLED:
+                req.reply.send([])
+                continue
+            req.reply.send(self.heat.feed_rows(max(1, int(req.top_k))))
+
     async def _emit_heat(self) -> None:
         """Periodic HotConflictRange TraceEvents (the trace-side face of
         the heat plane, reference busiest-tag / read-hot emission style):
@@ -317,6 +328,7 @@ class Resolver:
         process.spawn(self._serve(), f"{self.id}.serve")
         process.spawn(self._serve_metrics(), f"{self.id}.resolutionMetrics")
         process.spawn(self._serve_split(), f"{self.id}.resolutionSplit")
+        process.spawn(self._serve_heat(), f"{self.id}.heatFeed")
         process.spawn(self.metrics.emit_loop(), f"{self.id}.metrics")
         process.spawn(self._emit_heat(), f"{self.id}.heatEmit")
         backend_metrics = getattr(self.conflict_set, "metrics", None)

@@ -191,6 +191,52 @@ def collect_resolution_plane(info) -> Dict[str, Any]:
             "resolvers": resolvers}
 
 
+def collect_scheduler(info) -> Dict[str, Any]:
+    """cluster.scheduler: the conflict-aware scheduling plane (ISSUE 12)
+    — per-GRV-proxy predictor tables + admission deferrals, per-commit-
+    proxy reorder/repair counters, knob posture, and cluster totals.
+    This document is ALSO what \xff\xff/metrics/scheduler/ and the
+    fdbcli `metrics` Scheduler section render, so the three surfaces
+    agree by construction (the PR-8 heat-plane pattern).  Reads the
+    sim-side role backrefs like collect_resolution_plane."""
+    from ..core.knobs import server_knobs
+    knobs = server_knobs()
+    totals = {"deferrals": 0, "reorder_batches": 0, "reorder_swaps": 0,
+              "repairs_attempted": 0, "repairs_succeeded": 0,
+              "repairs_exhausted": 0}
+    grv: Dict[str, Any] = {}
+    for iface in info.grv_proxies:
+        role = getattr(iface, "role", None)
+        ss = getattr(role, "scheduler_status", None)
+        if not callable(ss):
+            continue
+        doc = ss()
+        grv[role.id] = doc
+        totals["deferrals"] += int(doc.get("deferrals", 0))
+    commit: Dict[str, Any] = {}
+    for iface in info.commit_proxies:
+        role = getattr(iface, "role", None)
+        ss = getattr(role, "scheduler_status", None)
+        if not callable(ss):
+            continue
+        doc = ss()
+        commit[role.id] = doc
+        for key in ("reorder_batches", "reorder_swaps",
+                    "repairs_attempted", "repairs_succeeded",
+                    "repairs_exhausted"):
+            totals[key] += int(doc.get(key, 0))
+    return {
+        "enabled": {
+            "predictor": bool(knobs.SCHED_PREDICTOR_ENABLED),
+            "reorder": bool(knobs.SCHED_REORDER_ENABLED),
+            "repair": bool(knobs.SCHED_REPAIR_ENABLED),
+        },
+        "grv_proxies": grv,
+        "commit_proxies": commit,
+        "totals": totals,
+    }
+
+
 def collect_regions(info, workers=None) -> Dict[str, Any]:
     """cluster.regions: the generation's DR posture (ISSUE 10) — region
     configuration, async-plane health (log routers / remote TLogs /
@@ -425,6 +471,11 @@ async def build_status(cc) -> Dict[str, Any]:
             # tags/tenants — the feed for \xff\xff/metrics/ and
             # `fdbcli top`.
             "heat": collect_heat(info, read_hot),
+            # Conflict-aware scheduling plane (ISSUE 12): per-proxy
+            # predictor deferrals, reorder swaps, repair counters — the
+            # feed for \xff\xff/metrics/scheduler/ and the fdbcli
+            # `metrics` Scheduler section.
+            "scheduler": collect_scheduler(info),
             # Per-stage commit-pipeline latency bands + per-group counter
             # sums (ISSUE 3: the `fdbcli metrics` surface).  Sources:
             # sim-side role backrefs, else the workers' registered

@@ -170,10 +170,16 @@ class _Fetch:
     """Identity-equality marker for one in-flight fetchKeys (prevents the
     shard RangeMap from coalescing two adjacent distinct fetches)."""
 
-    __slots__ = ("buffer",)
+    __slots__ = ("buffer", "disowns")
 
     def __init__(self) -> None:
         self.buffer = []   # [(version, Mutation)] arriving during the fetch
+        # Disownment fences that arrived in-stream DURING the fetch:
+        # [(begin, end, version)].  Re-evaluated at fetch completion —
+        # a disown newer than the fetch's min_version (the acquiring
+        # move's commit) means the range was moved away again while the
+        # snapshot was loading, and must close instead of opening.
+        self.disowns = []
 
 
 class StorageServer:
@@ -278,6 +284,19 @@ class StorageServer:
         range currently being fetched (applied after the snapshot lands —
         reference fetchKeys phase-2 buffering); clears spanning both
         fetching and owned ranges split along shard-state boundaries."""
+        from .system_data import DISOWN_SHARD_PREFIX
+        if m.type == MutationType.SetValue and \
+                m.param1.startswith(DISOWN_SHARD_PREFIX):
+            # Private disownment fence (system_data.py): DD moved
+            # [begin, end) off this server at `version`.  Applied
+            # IN-STREAM, so this server's version cannot pass the move
+            # without the range closing to reads — reads at or above it
+            # get wrong_shard_server and re-locate, never frozen data.
+            # The data itself stays until a RemoveShardRequest or a
+            # re-acquiring fetch clears it (consumed, never stored).
+            self._disown_shard(m.param1[len(DISOWN_SHARD_PREFIX):],
+                               m.param2, version)
+            return
         if m.type == MutationType.ClearRange:
             pieces = list(self.shards.intersecting(m.param1, m.param2))
             if any(st[0] == "fetching" for _b, _e, st in pieces):
@@ -294,6 +313,31 @@ class StorageServer:
                 st[1].buffer.append((version, m))
                 return
         self._apply_direct(m, version)
+
+    def _disown_shard(self, begin: bytes, end: bytes,
+                      version: Version) -> None:
+        if not begin < end:
+            return
+        from ..core.coverage import test_coverage
+        closed = 0
+        for b, e, st in list(self.shards.intersecting(begin, end)):
+            if st[0] == "fetching":
+                # A fetch is loading this span RIGHT NOW.  Whether the
+                # disownment postdates the fetch's acquiring move (the
+                # range moved away again mid-fetch — must close at
+                # completion) or predates it (a stale fence from an
+                # earlier tenure — the re-acquisition wins) is decided
+                # at fetch completion against the fetch's min_version.
+                st[1].disowns.append((b, e, version))
+                continue
+            if st[0] == "owned":
+                self.shards.set_range(b, e, ("absent", 0))
+                closed += 1
+        if closed:
+            test_coverage("SSDisownShardFence")
+            TraceEvent("SSShardDisowned").detail("Id", self.id).detail(
+                "Begin", begin).detail("End", end).detail(
+                "Version", version).log()
 
     def _apply_direct(self, m: Mutation, version: Version) -> None:
         self.stats["mutations"] += 1
@@ -540,6 +584,14 @@ class StorageServer:
                     self._apply_direct(m, version)
             min_read = max(vf, self.version.get())
             self.shards.set_range(req.begin, req.end, ("owned", min_read))
+            for db_, de_, dv in fetch.disowns:
+                # The range was moved away again while the snapshot was
+                # in flight: a disown newer than the acquiring move's
+                # commit (req.min_version) closes its span — opening it
+                # would re-create the frozen-replica stale-read hole
+                # through the fetch window.
+                if dv > req.min_version:
+                    self._disown_shard(db_, de_, dv)
             self.metrics.histogram("FetchKeys").record(now() - _t0)
             TraceEvent("SSFetchKeysDone").detail("Id", self.id).detail(
                 "Begin", req.begin).detail("End", req.end).detail(

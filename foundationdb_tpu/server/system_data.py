@@ -272,6 +272,65 @@ def apply_metadata_mutation(key_servers: RangeMap, m: Mutation):
     return handled, backup_flag
 
 
+# Private shard-disownment mutations (reference ApplyMetadataMutation's
+# PRIVATIZED \xff/serverKeys/<id>/ writes, routed to the affected
+# server's own tag): when a committed \xff/keyServers/ change removes a
+# tag from a shard's team, the commit proxy appends
+#   SetValue(DISOWN_SHARD_PREFIX + <begin>, <end>)
+# to that tag's message stream AT THE MOVE'S COMMIT VERSION.  The
+# storage server applies it in-stream — it cannot advance its version
+# past the move without learning it no longer owns [begin, end) — so a
+# server that was merely unreachable (clogged/partitioned) while DD
+# relocated its shards can NEVER serve a read at a version where the
+# writes stopped flowing to it.  The out-of-band RemoveShardRequest RPC
+# remains the data-cleanup path for reachable members; this mutation is
+# the SOUNDNESS fence (the RPC is lossy exactly when it matters: DD
+# skips members it believes dead).  The prefix sorts outside every
+# storable keyspace (\xff\x02 < \xff/) and is consumed, never stored.
+DISOWN_SHARD_PREFIX = b"\xff\x02/disownShard/"
+
+
+def disowned_spans(key_servers: RangeMap, m: Mutation):
+    """Tags losing ownership if `m` (a committed \\xff/keyServers/
+    mutation) were applied to `key_servers` — computed against the
+    PRE-APPLY map: [(tag, begin, end)] per intersecting old span.
+    Empty for non-keyServers mutations, splits, and pure additions."""
+    out = []
+    if m.type == MutationType.SetValue and \
+            m.param1.startswith(KEY_SERVERS_PREFIX):
+        boundary = m.param1[len(KEY_SERVERS_PREFIX):]
+        new_team = set(decode_key_servers_value(m.param2))
+        _b, e, _v = key_servers.range_containing(boundary)
+        for b0, e0, team in key_servers.intersecting(boundary, e):
+            for t in team or ():
+                if t not in new_team:
+                    out.append((t, b0, e0))
+    elif m.type == MutationType.ClearRange and \
+            m.param2 > KEY_SERVERS_PREFIX and m.param1 < KEY_SERVERS_END:
+        # Boundary removal: the span merges into the PRECEDING shard's
+        # team; anything the absorbed span's teams had beyond that team
+        # is disowned (mirrors apply_key_servers_mutation's merge).
+        lo = max(m.param1, KEY_SERVERS_PREFIX)[len(KEY_SERVERS_PREFIX):]
+        hi_raw = min(m.param2, KEY_SERVERS_END)
+        hi = (hi_raw[len(KEY_SERVERS_PREFIX):]
+              if hi_raw.startswith(KEY_SERVERS_PREFIX) else SYSTEM_KEYS_END)
+        prev_team = None
+        for b, _e, v in key_servers.ranges():
+            if b < lo:
+                prev_team = v
+            else:
+                break
+        keep = set(prev_team or ())
+        rb, re_, _v = key_servers.range_containing(hi)
+        until = hi if rb == hi else re_
+        if until > lo:
+            for b0, e0, team in key_servers.intersecting(lo, until):
+                for t in team or ():
+                    if t not in keep:
+                        out.append((t, b0, e0))
+    return out
+
+
 def apply_key_servers_mutation(key_servers: RangeMap, m: Mutation) -> bool:
     """Apply one committed `\\xff/keyServers/` mutation to a shard map.
 

@@ -367,7 +367,9 @@ class Worker:
         # DD's registry scans.
         rk = Ratekeeper(req.rk_id, req.storage_interfaces,
                         getattr(req, "tlog_interfaces", ()) or (),
-                        db=Database(ClusterConnection(self.coordinators)))
+                        db=Database(ClusterConnection(self.coordinators)),
+                        resolver_interfaces=getattr(
+                            req, "resolver_interfaces", ()) or ())
         rk.run(self.process)
         req.reply.send(rk.interface)
 

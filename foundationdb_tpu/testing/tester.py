@@ -291,6 +291,24 @@ def run_simulation(spec, seed: int, *, buggify: bool = False,
                 raise KeyError(f"unknown [cluster] field {k!r} in spec")
             fields[k] = v
         config = DatabaseConfiguration(**fields)
+    # Spec-driven knob overrides: a top-level [knobs] table sets server
+    # knobs for the run's duration (e.g. the SchedChaosTest spec turns
+    # every SCHED_* stage on) and restores them afterwards — the spec
+    # carries its own posture instead of relying on runner defaults.
+    # Unknown names are rejected loudly, like [cluster]/[sim] fields.
+    from ..core.knobs import server_knobs
+    sknobs = server_knobs()
+    knob_overrides = dict(spec.get("knobs") or {})
+    # Validate EVERY name before setting ANY value: a KeyError raised
+    # mid-application would leak the earlier overrides into the rest of
+    # the process (the finally below only restores what was saved).
+    for k in knob_overrides:
+        if k.startswith("_") or not hasattr(sknobs, k):
+            raise KeyError(f"unknown [knobs] field {k!r} in spec")
+    saved_knobs: Dict[str, Any] = {}
+    for k, v in knob_overrides.items():
+        saved_knobs[k] = getattr(sknobs, k)
+        setattr(sknobs, k, v)
     gc_was_enabled = gc.isenabled()
     gc.collect()
     gc.disable()
@@ -323,6 +341,8 @@ def run_simulation(spec, seed: int, *, buggify: bool = False,
         enable_buggify(False)
         set_simulator(None)
         set_event_loop(None)
+        for k, v in saved_knobs.items():
+            setattr(sknobs, k, v)
         if gc_was_enabled:
             gc.enable()
 

@@ -1435,3 +1435,127 @@ class BackupAndRestoreWorkload(TestWorkload):
             self.metrics["restored_mismatch"] = 1.0
             return False
         return True
+
+
+@register_workload
+class SchedRepairLoadWorkload(TestWorkload):
+    """Repair-eligible blind-write load + exactly-once audit (ISSUE 12).
+
+    Every transaction is a legitimate repair candidate: its mutations
+    are atomic ADDs (value-independent — valid under re-read by
+    construction), guarded by a read conflict range on one SHARED hot
+    key that every transaction also blind-writes.  Under contention the
+    read guard goes stale constantly, so with SCHED_REPAIR_ENABLED the
+    commit proxy exercises the re-stamp/re-resolve path continuously —
+    including across resolver attrition when composed with ChaosNemesis.
+
+    The audit is the duplicate-commit detector the chaos satellite
+    demands: each transaction ADDs 1 to its own UNIQUE counter key, so
+    after quiescence
+
+      * an acked commit's counter must be EXACTLY 1 (a repair retry
+        that double-committed — e.g. onto a freshly recruited resolver
+        — would read 2);
+      * a commit_unknown_result's counter must be 0 or 1;
+      * a definitively-aborted id's counter must be 0;
+      * the hot key's total must lie in [acked, acked + unknown].
+    """
+
+    name = "SchedRepairLoad"
+    HOT = b"sched/hot"
+
+    def __init__(self, cluster, db, config) -> None:
+        super().__init__(cluster, db, config)
+        self._acked: set = set()
+        self._unknown: set = set()
+        self._failed: set = set()
+
+    @staticmethod
+    def _ctr(i: int) -> bytes:
+        return b"sched/ctr/%08d" % i
+
+    async def start(self) -> None:
+        from ..txn.types import MutationType
+        duration = float(self.config.get("testDuration", 8.0))
+        actors = int(self.config.get("actorCount", 3))
+        deadline = now() + duration
+        bounces = [0]
+        one = (1).to_bytes(8, "little")
+
+        async def worker(base: int) -> None:
+            i = 0
+            while now() < deadline:
+                uid = base + i
+                i += 1
+                t = self.db.create_transaction()
+                t.repairable = True
+                t.tag = "schedload"
+                while True:
+                    try:
+                        t.atomic_op(MutationType.AddValue,
+                                    self._ctr(uid), one)
+                        t.atomic_op(MutationType.AddValue, self.HOT, one)
+                        t.add_read_conflict_range(
+                            self.HOT, self.HOT + b"\x00")
+                        await t.commit()
+                        self._acked.add(uid)
+                        break
+                    except FdbError as e:
+                        if e.name == "commit_unknown_result":
+                            # Ambiguous: retrying the ADD could double-
+                            # apply — record and move to a fresh id.
+                            self._unknown.add(uid)
+                            break
+                        if now() >= deadline and e.name == "not_committed":
+                            # Definitive abort at the deadline: no
+                            # commit of this id can ever land.
+                            self._failed.add(uid)
+                            break
+                        bounces[0] += 1
+                        try:
+                            await t.on_error(e)
+                        except FdbError:
+                            self._failed.add(uid)
+                            break
+                        if now() >= deadline + 120.0:
+                            # Hard escape: every retryable error here is
+                            # a definitive no-commit (commit() already
+                            # maps ambiguous losses to
+                            # commit_unknown_result), so abandoning the
+                            # retry leaves the counter provably at 0.
+                            self._failed.add(uid)
+                            break
+        await wait_all([spawn(worker(k * 1_000_000), "schedload.worker")
+                        for k in range(actors)])
+        self.metrics["acked"] = float(len(self._acked))
+        self.metrics["unknown"] = float(len(self._unknown))
+        self.metrics["failed"] = float(len(self._failed))
+        self.metrics["client_bounces"] = float(bounces[0])
+
+    async def check(self) -> bool:
+        async def audit(t):
+            bad = []
+            hot_raw = await t.get(self.HOT)
+            for uid in sorted(self._acked):
+                v = await t.get(self._ctr(uid))
+                n = int.from_bytes(v or b"", "little")
+                if n != 1:
+                    bad.append(("acked", uid, n))
+            for uid in sorted(self._unknown):
+                v = await t.get(self._ctr(uid))
+                n = int.from_bytes(v or b"", "little")
+                if n not in (0, 1):
+                    bad.append(("unknown", uid, n))
+            for uid in sorted(self._failed):
+                v = await t.get(self._ctr(uid))
+                n = int.from_bytes(v or b"", "little")
+                if n != 0:
+                    bad.append(("failed", uid, n))
+            return bad, int.from_bytes(hot_raw or b"", "little")
+        bad, hot_total = await self.run_transaction(audit)
+        self.metrics["hot_total"] = float(hot_total)
+        lo, hi = len(self._acked), len(self._acked) + len(self._unknown)
+        if bad:
+            self.metrics["audit_violations"] = float(len(bad))
+            return False
+        return lo <= hot_total <= hi

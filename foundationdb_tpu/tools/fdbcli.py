@@ -223,6 +223,41 @@ class Cli:
             for rr in res.get("ranges", []):
                 lines.append(f"    [{rr['begin']!r}, {rr['end']!r}) -> "
                              f"{rr['resolver']}")
+        # Conflict-aware scheduling plane (ISSUE 12): predictor
+        # deferrals, reorder swaps, repair counters per proxy — the same
+        # cluster.scheduler document the special keys mirror.
+        sched = cl.get("scheduler", {}) or {}
+        if sched and (not needle or needle in "scheduler sched"):
+            en = sched.get("enabled", {})
+            tot = sched.get("totals", {})
+            lines.append(
+                "Scheduler (predictor="
+                f"{'on' if en.get('predictor') else 'off'}, reorder="
+                f"{'on' if en.get('reorder') else 'off'}, repair="
+                f"{'on' if en.get('repair') else 'off'}):")
+            lines.append(
+                f"  totals: deferrals={tot.get('deferrals', 0)} "
+                f"reorder_swaps={tot.get('reorder_swaps', 0)} "
+                f"repairs={tot.get('repairs_attempted', 0)}"
+                f"/{tot.get('repairs_succeeded', 0)} ok"
+                f"/{tot.get('repairs_exhausted', 0)} exhausted")
+            for pid in sorted(sched.get("grv_proxies", {})):
+                p = sched["grv_proxies"][pid]
+                doomed = ",".join(p.get("doomed_tags", [])) or "-"
+                lines.append(
+                    f"  grv {pid}: deferrals={p.get('deferrals', 0)} "
+                    f"held={p.get('deferred_held', 0)} "
+                    f"ranges={p.get('tracked_ranges', 0)} "
+                    f"doomed_tags={doomed}")
+            for pid in sorted(sched.get("commit_proxies", {})):
+                p = sched["commit_proxies"][pid]
+                lines.append(
+                    f"  proxy {pid}: reorder="
+                    f"{p.get('reorder_swaps', 0)} swaps"
+                    f"/{p.get('reorder_batches', 0)} batches "
+                    f"repairs={p.get('repairs_attempted', 0)}"
+                    f"/{p.get('repairs_succeeded', 0)} ok"
+                    f"/{p.get('repairs_exhausted', 0)} exhausted")
         return "\n".join(lines)
 
     def cmd_top(self) -> str:

@@ -39,12 +39,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The default matrix: the canonical nemesis trio plus the DR battery
 # (ISSUE 10) — region failover + coordinator restarts, and
-# backup/restore under attrition + fatal disk faults.  Their coverage
-# markers (ChaosRegionFailover, ChaosCoordinatorRestart,
-# ChaosFatalDiskRestart, BackupRestoreUnderChaos) land in the summary's
-# coverage ledger like every other registered marker.
+# backup/restore under attrition + fatal disk faults — plus the
+# scheduling battery (ISSUE 12): all three SCHED_* stages on under
+# resolver attrition with the SchedRepairLoad duplicate-commit audit.
+# Their coverage markers (ChaosRegionFailover, ChaosCoordinatorRestart,
+# ChaosFatalDiskRestart, BackupRestoreUnderChaos, ProxyTxnRepaired,
+# GrvSchedDeferral, ProxyBatchReordered) land in the summary's coverage
+# ledger like every other registered marker.
 DEFAULT_SPECS = ("ChaosTest.toml", "CycleTest.toml", "TenantTest.toml",
-                 "TwoRegionChaosTest.toml", "BackupRestoreChaosTest.toml")
+                 "TwoRegionChaosTest.toml", "BackupRestoreChaosTest.toml",
+                 "SchedChaosTest.toml")
 
 
 def _ensure_hash_seed_pinned() -> None:

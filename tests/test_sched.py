@@ -1,0 +1,691 @@
+"""Conflict-aware transaction scheduling (ISSUE 12): the sched/ package
+units (predictor EMAs + doom, greedy/static reorder, repair
+eligibility), the role wiring (resolver heat feed -> ratekeeper fold ->
+GRV predictor deferral; commit-proxy reorder + repair batches), the
+knobs-off abort-set parity guard (verdicts AND frozen reply wire bytes),
+starvation-proofing, determinism, the three-surface status agreement,
+and the SchedChaosTest double-run with the duplicate-commit audit."""
+
+import json
+import os
+
+import pytest
+
+from foundationdb_tpu.conflict.heat import ConflictHeatTracker
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.core import FdbError
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.rpc.endpoint import RequestStream
+from foundationdb_tpu.sched.predictor import ConflictPredictor
+from foundationdb_tpu.sched.reorder import moved_count, reorder_batch
+from foundationdb_tpu.sched.repair import repair_eligible
+from foundationdb_tpu.server.cluster import SimCluster
+from foundationdb_tpu.server.interfaces import (CommitTransactionRequest,
+                                                GetReadVersionRequest,
+                                                ResolverHeatRequest)
+from foundationdb_tpu.txn.types import (CommitResult, CommitTransactionRef,
+                                        KeyRange, Mutation, MutationType)
+
+from test_recovery import make_cluster, teardown  # noqa: F401
+
+SPECS = os.path.join(os.path.dirname(__file__), "specs")
+
+
+@pytest.fixture()
+def knobs():
+    """Mutable server knobs restored after the test."""
+    k = server_knobs()
+    saved = dict(k.__dict__)
+    yield k
+    for name, value in saved.items():
+        setattr(k, name, value)
+
+
+def _txn(reads=(), writes=(), mutations=(), snap=0, tag=""):
+    return CommitTransactionRef(
+        read_conflict_ranges=[KeyRange(k, k + b"\x00") for k in reads],
+        write_conflict_ranges=[KeyRange(k, k + b"\x00") for k in writes],
+        mutations=list(mutations), read_snapshot=snap, tag=tag)
+
+
+def run(cluster, coro, timeout=60):
+    return cluster.run_until(cluster.loop.spawn(coro), timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# Predictor: EMA fold, doom mapping, decay, bounds, determinism
+# ---------------------------------------------------------------------------
+
+def _rows(conflicts=8, load=1, tag="hot", tenant=7):
+    return [(b"k1", b"k1\x00", conflicts, load,
+             {tag: conflicts}, {tenant: conflicts})]
+
+
+def test_predictor_doom_and_decay():
+    p = ConflictPredictor(alpha=0.5, abort_p=0.3, min_conflicts=4)
+    p.update(_rows())
+    # conflicts/(conflicts+load) = 8/9 >> 0.3 and count >= 4: doomed.
+    assert p.is_doomed(("hot",))
+    assert p.is_doomed((), tenant_id=7)
+    assert not p.is_doomed(("cold",))
+    assert not p.is_doomed((), tenant_id=8)
+    assert p.doomed_range_for(("hot",)) == (b"k1", b"k1\x00")
+    # The range stops appearing in the feed: EMA decays, doom lifts.
+    for _ in range(8):
+        p.update([])
+    assert not p.is_doomed(("hot",))
+    assert (b"k1", b"k1\x00") not in p.ranges
+
+
+def test_predictor_thresholds_gate_doom():
+    # Below min_conflicts: never doomed no matter the ratio.
+    p = ConflictPredictor(abort_p=0.3, min_conflicts=4)
+    p.update(_rows(conflicts=2, load=0))
+    assert not p.is_doomed(("hot",))
+    # Below abort_p: heavy load dilutes the ratio.
+    p2 = ConflictPredictor(abort_p=0.5, min_conflicts=1)
+    p2.update(_rows(conflicts=5, load=50))
+    assert not p2.is_doomed(("hot",))
+
+
+def test_predictor_table_bound_and_determinism():
+    rows = [(b"k%04d" % i, b"k%04d\x00" % i, i % 7 + 1, 1, {"t%d" % i: 1}, {})
+            for i in range(200)]
+    a = ConflictPredictor(table_max=64)
+    b = ConflictPredictor(table_max=64)
+    for p in (a, b):
+        p.update(rows)
+        p.update(rows[:50])
+        p.update([])
+    assert len(a.ranges) <= 64
+    # Same feed -> bit-identical table and status (any PYTHONHASHSEED).
+    assert a.ranges == b.ranges
+    assert a.status() == b.status()
+
+
+# ---------------------------------------------------------------------------
+# Reorder: greedy topological order + static degradation
+# ---------------------------------------------------------------------------
+
+def test_reorder_saves_reader_and_chain():
+    # writer(k) before reader(k): original order aborts the reader.
+    txns = [_txn(writes=[b"k"]), _txn(reads=[b"k"], writes=[b"c"])]
+    order = reorder_batch(txns)
+    assert order == [1, 0] and moved_count(order) == 2
+    o = OracleConflictSet(0)
+    verdicts = o.resolve([txns[i] for i in order], 10, 0)
+    assert verdicts == [CommitResult.COMMITTED] * 2
+    # A dependency chain unwinds fully: zero intra-batch aborts.
+    chain = [_txn(writes=[b"a"]), _txn(reads=[b"a"], writes=[b"b"]),
+             _txn(reads=[b"b"], writes=[b"c"])]
+    order = reorder_batch(chain)
+    o2 = OracleConflictSet(0)
+    assert o2.resolve([chain[i] for i in order], 10, 0) == \
+        [CommitResult.COMMITTED] * 3
+
+
+def test_reorder_cycle_deterministic_and_range_overlap():
+    # Mutual RMW clique: no order saves both; tiebreak = original index.
+    clique = [_txn(reads=[b"h"], writes=[b"h"]),
+              _txn(reads=[b"h"], writes=[b"h"])]
+    assert reorder_batch(clique) == [0, 1]
+    # True range writes overlap point reads (the non-point path).
+    wide = [CommitTransactionRef(
+        write_conflict_ranges=[KeyRange(b"a", b"z")], read_snapshot=0),
+        _txn(reads=[b"m"], writes=[b"zz"])]
+    assert reorder_batch(wide) == [1, 0]
+
+
+def test_reorder_static_path_matches_greedy_intent():
+    import random
+    rng = random.Random(5)
+    txns = [_txn(reads=[b"k%03d" % rng.randrange(30),
+                        b"k%03d" % rng.randrange(30)],
+                 writes=[b"k%03d" % rng.randrange(30)])
+            for _ in range(120)]
+
+    def commits(order):
+        o = OracleConflictSet(0)
+        v = o.resolve([txns[i] for i in order], 10, 0)
+        return sum(1 for x in v if x == CommitResult.COMMITTED)
+
+    base = commits(list(range(len(txns))))
+    greedy = commits(reorder_batch(txns))
+    static = commits(reorder_batch(txns, exact_max=1))
+    assert greedy > base and static > base
+    # Both paths are pure functions of the batch: deterministic.
+    assert reorder_batch(txns) == reorder_batch(txns)
+    assert reorder_batch(txns, exact_max=1) == \
+        reorder_batch(txns, exact_max=1)
+
+
+# ---------------------------------------------------------------------------
+# Repair eligibility
+# ---------------------------------------------------------------------------
+
+def test_repair_eligibility_gates():
+    t = _txn(reads=[b"r"], writes=[b"w"], snap=5)
+    culprit = [(b"r", b"r\x00")]
+    assert repair_eligible(t, culprit, True, 0, 1)
+    assert not repair_eligible(t, culprit, True, 1, 1)     # budget spent
+    assert not repair_eligible(t, culprit, False, 0, 1)    # conservative
+    assert not repair_eligible(t, [], True, 0, 1)          # no culprits
+    # A culprit OUTSIDE the read set (write-write-ish attribution
+    # breakage) is never repairable.
+    assert not repair_eligible(t, [(b"x", b"x\x00")], True, 0, 1)
+    # Clipped sub-range of a declared read IS contained.
+    t2 = CommitTransactionRef(
+        read_conflict_ranges=[KeyRange(b"a", b"z")], read_snapshot=5)
+    assert repair_eligible(t2, [(b"m", b"n")], True, 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# Heat tracker feed rows + ratekeeper fold
+# ---------------------------------------------------------------------------
+
+def test_heat_feed_rows_carry_identity_and_decay():
+    t = ConflictHeatTracker(sample_every=1)
+    for _ in range(4):
+        t.record_conflict(b"hot", b"hot\x00", tenant_id=3, tag="t/web")
+    t.sample_load(b"hot", b"hot\x00")
+    rows = t.feed_rows(4)
+    assert rows == [(b"hot", b"hot\x00", 4, 1, {"t/web": 4}, {3: 4})]
+    t.decay()
+    assert t.feed_rows(4)[0][4] == {"t/web": 2}
+    t.decay(), t.decay()
+    assert t.feed_rows(4) == []
+    assert not t.range_tags and not t.range_tenants
+
+
+def test_ratekeeper_fold_merges_rows():
+    from foundationdb_tpu.server.ratekeeper import Ratekeeper
+    folded = Ratekeeper._fold_conflict_heat(
+        [[(b"a", b"b", 3, 1, {"x": 3}, {})],
+         [(b"a", b"b", 2, 1, {"x": 1, "y": 1}, {5: 2}),
+          (b"c", b"d", 9, 0, {}, {})]], top_k=8)
+    assert folded[0] == (b"c", b"d", 9, 0, {}, {})
+    assert folded[1] == (b"a", b"b", 5, 2, {"x": 4, "y": 1}, {5: 2})
+
+
+# ---------------------------------------------------------------------------
+# GRV admission deferral: doom -> bounded deferrals -> admission
+# ---------------------------------------------------------------------------
+
+def test_grv_deferral_bounded_and_starvation_proof(teardown, knobs):
+    knobs.SCHED_PREDICTOR_ENABLED = True
+    knobs.SCHED_ADMISSION_DELAY_S = 0.05
+    knobs.SCHED_MAX_DEFERRALS = 3
+    c = SimCluster()
+    g = c.grv_proxies[0]
+    g.predictor.update([(b"h", b"h\x00", 50, 1, {"doomtag": 50}, {})])
+    assert g.predictor.is_doomed(("doomtag",))
+
+    async def grv(tag):
+        from foundationdb_tpu.core.scheduler import now
+        t0 = now()
+        reply = await RequestStream.at(
+            g.interface.get_consistent_read_version.endpoint).get_reply(
+            GetReadVersionRequest(tags=(tag,) if tag else ()))
+        return reply.version, now() - t0
+
+    # Doomed tag: deferred exactly SCHED_MAX_DEFERRALS times, then
+    # admitted unconditionally (starvation-proof) — the reply ARRIVES
+    # and waited at least the deferral delays.
+    version, waited = run(c, grv("doomtag"))
+    assert version >= 0
+    assert g.metrics.counter("SchedDeferrals").value == 3
+    assert waited >= 0.1   # >= 3 jittered deferral delays
+    assert not g._sched_deferred
+    # Clean tag: admitted without deferral.
+    _v, waited2 = run(c, grv("cleantag"))
+    assert g.metrics.counter("SchedDeferrals").value == 3
+    assert waited2 < 0.05
+    doc = g.scheduler_status()
+    assert doc["deferrals"] == 3 and doc["doomed_tags"] == ["doomtag"]
+
+
+def test_grv_deferral_off_by_default(teardown):
+    c = SimCluster()
+    g = c.grv_proxies[0]
+    g.predictor.update([(b"h", b"h\x00", 50, 1, {"doomtag": 50}, {})])
+
+    async def grv():
+        reply = await RequestStream.at(
+            g.interface.get_consistent_read_version.endpoint).get_reply(
+            GetReadVersionRequest(tags=("doomtag",)))
+        return reply.version
+
+    run(c, grv())
+    assert g.metrics.counter("SchedDeferrals").value == 0
+
+
+# ---------------------------------------------------------------------------
+# Resolver heat feed stream
+# ---------------------------------------------------------------------------
+
+def test_resolver_heat_feed_stream(teardown, knobs):
+    c = SimCluster()
+    r = c.resolvers[0]
+    r.heat.record_conflict(b"hot", b"hot\x00", tag="t/x", tenant_id=2)
+
+    async def poll():
+        return await RequestStream.at(r.interface.heat.endpoint).get_reply(
+            ResolverHeatRequest(top_k=8))
+
+    rows = run(c, poll())
+    assert rows == [(b"hot", b"hot\x00", 1, 0, {"t/x": 1}, {2: 1})]
+    knobs.HEAT_TELEMETRY_ENABLED = False
+    assert run(c, poll()) == []
+
+
+# ---------------------------------------------------------------------------
+# Commit-proxy reorder + repair, end to end through the real pipeline
+# ---------------------------------------------------------------------------
+
+def _commit_req(txn, repair=False, attempt=0):
+    from foundationdb_tpu.core.futures import Promise
+    req = CommitTransactionRequest(transaction=txn, repair_eligible=repair,
+                                   repair_attempt=attempt)
+    req.reply = Promise()
+    return req
+
+
+def _drive_batch(c, reqs):
+    p = c.commit_proxies[0]
+
+    async def go():
+        p.local_batch_number += 1
+        await p._commit_batch(list(reqs), p.local_batch_number)
+        out = []
+        for req in reqs:
+            f = req.reply.get_future()
+            try:
+                out.append(("ok", (await f).version))
+            except FdbError as e:
+                out.append(("err", e.name))
+        return out
+
+    return run(c, go())
+
+
+def test_proxy_reorder_saves_intra_batch_reader(teardown, knobs):
+    knobs.SCHED_REORDER_ENABLED = True
+    c = SimCluster()
+    # writer(k) enqueued before reader(k): without reorder the reader
+    # aborts intra-batch (test_reorder_saves_reader proves that on the
+    # oracle); through the proxy with the knob on, BOTH commit.
+    reqs = [
+        _commit_req(_txn(writes=[b"k"],
+                         mutations=[Mutation(MutationType.SetValue,
+                                             b"k", b"1")])),
+        _commit_req(_txn(reads=[b"k"], writes=[b"c"],
+                         mutations=[Mutation(MutationType.SetValue,
+                                             b"c", b"2")])),
+    ]
+    out = _drive_batch(c, reqs)
+    assert [kind for kind, _ in out] == ["ok", "ok"], out
+    p = c.commit_proxies[0]
+    assert p.metrics.counter("ReorderBatches").value == 1
+    assert p.metrics.counter("ReorderSwaps").value == 2
+    assert p.scheduler_status()["reorder_swaps"] == 2
+
+
+def test_proxy_repair_commits_stale_optin(teardown, knobs):
+    knobs.SCHED_REPAIR_ENABLED = True
+    c = SimCluster()
+    db = c.database()
+
+    async def seed():
+        t = db.create_transaction()
+        t.set(b"hot", b"v1")
+        await t.commit()
+        return t.committed_version
+
+    cv = run(c, seed())
+    # A STALE read guard on b"hot" + a blind write: classic repairable
+    # abort.  Opt-in -> server re-stamps and commits; the client sees
+    # SUCCESS, one batch later.
+    stale = _txn(reads=[b"hot"], writes=[b"blind"], snap=max(cv - 1, 0),
+                 mutations=[Mutation(MutationType.SetValue,
+                                     b"blind", b"x")])
+    out = _drive_batch(c, [_commit_req(stale, repair=True)])
+    assert out[0][0] == "ok", out
+    p = c.commit_proxies[0]
+    assert p.metrics.counter("RepairAttempted").value == 1
+    assert p.metrics.counter("RepairSucceeded").value == 1
+    assert p.metrics.counter("RepairExhausted").value == 0
+
+    # The blind write landed EXACTLY once.
+    async def read():
+        t = db.create_transaction()
+        return await t.get(b"blind")
+    assert run(c, read()) == b"x"
+
+    # The identical non-opt-in transaction still bounces to the client.
+    stale2 = _txn(reads=[b"hot"], writes=[b"blind2"], snap=max(cv - 1, 0),
+                  mutations=[Mutation(MutationType.SetValue,
+                                      b"blind2", b"x")])
+    out2 = _drive_batch(c, [_commit_req(stale2, repair=False)])
+    assert out2[0] == ("err", "not_committed")
+    assert p.metrics.counter("RepairAttempted").value == 1
+
+
+def test_proxy_repair_exhausts_budget(teardown, knobs):
+    knobs.SCHED_REPAIR_ENABLED = True
+    knobs.TXN_REPAIR_MAX_ATTEMPTS = 1
+    c = SimCluster()
+    db = c.database()
+
+    async def seed():
+        t = db.create_transaction()
+        t.set(b"hot", b"v1")
+        await t.commit()
+        return t.committed_version
+
+    cv = run(c, seed())
+    # A request arriving with its repair budget already spent (the
+    # re-enqueued shape) that aborts AGAIN: the abort goes back to the
+    # client and RepairExhausted counts it.
+    stale = _txn(reads=[b"hot"], writes=[b"blind"], snap=max(cv - 1, 0),
+                 mutations=[Mutation(MutationType.SetValue,
+                                     b"blind", b"x")])
+    out = _drive_batch(c, [_commit_req(stale, repair=True, attempt=1)])
+    assert out[0] == ("err", "not_committed")
+    p = c.commit_proxies[0]
+    assert p.metrics.counter("RepairAttempted").value == 0
+    assert p.metrics.counter("RepairExhausted").value == 1
+
+
+# ---------------------------------------------------------------------------
+# Knobs-off abort-set parity: verdicts AND reply wire bytes
+# ---------------------------------------------------------------------------
+
+def _parity_stream(waves=10, per_wave=16, seed=3):
+    import random
+    rng = random.Random(seed)
+    stream = []
+    for w in range(waves):
+        txns = []
+        for _ in range(per_wave):
+            ks = [b"p%03d" % rng.randrange(40) for _ in range(3)]
+            txns.append(_txn(reads=ks[:2], writes=[ks[2]],
+                             snap=max(0, 1000 * (w - rng.randint(1, 2)))))
+        stream.append((1000 * w, 1000 * (w + 1), txns))
+    return stream
+
+
+def test_knobs_off_abort_set_parity(teardown):
+    """With every SCHED_* knob off (the defaults), the proxy->resolver->
+    min-merge pipeline's verdicts are bit-identical to a direct oracle
+    pass in ARRIVAL order — no reorder, no repair, no deferral leaks."""
+    assert not server_knobs().SCHED_PREDICTOR_ENABLED
+    assert not server_knobs().SCHED_REORDER_ENABLED
+    assert not server_knobs().SCHED_REPAIR_ENABLED
+    stream = _parity_stream()
+    c = SimCluster()
+    p = c.commit_proxies[0]
+
+    async def through_pipeline():
+        from foundationdb_tpu.core.futures import wait_all
+        verdicts = []
+        for prev, version, txns in stream:
+            batch = [CommitTransactionRequest(transaction=t) for t in txns]
+            requests, index_maps = p._build_resolution_requests(
+                batch, prev, version)
+            futures = [RequestStream.at(r.resolve.endpoint).get_reply(req)
+                       for r, req in zip(p.resolvers, requests)]
+            resolutions = await wait_all(futures)
+            p.last_resolved_version = version
+            verdicts.append([int(v) for v in p._determine_committed(
+                batch, index_maps, resolutions)])
+        return verdicts
+
+    got = run(c, through_pipeline())
+    oracle = OracleConflictSet(0)
+    want = [[int(v) for v in oracle.resolve(txns, version)]
+            for _prev, version, txns in stream]
+    assert got == want
+    flat = [v for wave in want for v in wave]
+    assert flat.count(int(CommitResult.CONFLICT)) > 3   # non-degenerate
+    cp = c.commit_proxies[0]
+    assert cp.metrics.counter("ReorderBatches").value == 0
+    assert cp.metrics.counter("RepairAttempted").value == 0
+
+
+# Pre-scheduler ResolveTransactionBatchReply wire image, frozen at PR 12:
+# committed=[COMMITTED, CONFLICT], empty state txns, one conflicting
+# range for txn 1, attribution_exact {1: True}.  If a later change adds
+# or reorders reply fields, the encoded bytes change and this test
+# fails — the "batch reply bytes bit-identical" guard made executable.
+_FROZEN_REPLY_HEX = (
+    "0b1c0000005265736f6c76655472616e73616374696f6e42617463685265706c79"
+    "0400000009000000636f6d6d69747465640802000000100c000000436f6d6d6974"
+    "526573756c74030200000000000000100c000000436f6d6d6974526573756c7403"
+    "00000000000000001200000073746174655f7472616e73616374696f6e73080000"
+    "000012000000636f6e666c696374696e675f72616e6765730a0100000003010000"
+    "000000000008010000000902000000060100000061060100000062110000006174"
+    "747269627574696f6e5f65786163740a0100000003010000000000000001"
+)
+
+
+def test_reply_wire_bytes_frozen(teardown):
+    from foundationdb_tpu.rpc.serde import bootstrap_registry, encode_message
+    from foundationdb_tpu.server.interfaces import (
+        ResolveTransactionBatchReply)
+    bootstrap_registry()
+    reply = ResolveTransactionBatchReply(
+        committed=[CommitResult.COMMITTED, CommitResult.CONFLICT],
+        conflicting_ranges={1: [(b"a", b"b")]},
+        attribution_exact={1: True})
+    blob = encode_message(reply)
+    want = bytes.fromhex(_FROZEN_REPLY_HEX)
+    assert blob == want, (
+        "ResolveTransactionBatchReply wire image changed — the sched "
+        "stages promise knobs-off replies bit-identical to pre-PR-12; "
+        f"got {blob.hex()}")
+
+
+# ---------------------------------------------------------------------------
+# Status / special keys / fdbcli agreement (the PR-8 pattern)
+# ---------------------------------------------------------------------------
+
+def test_scheduler_three_surfaces_agree(teardown, knobs):
+    knobs.SCHED_PREDICTOR_ENABLED = True
+    knobs.SCHED_REORDER_ENABLED = True
+    knobs.SCHED_REPAIR_ENABLED = True
+    from foundationdb_tpu.tools.fdbcli import Cli
+    c = make_cluster()
+    db = c.database()
+
+    async def traffic():
+        # One guaranteed repair: seed, then a stale opt-in blind write.
+        t = db.create_transaction()
+        t.set(b"hot", b"v")
+        await t.commit()
+        t2 = db.create_transaction()
+        t2.repairable = True
+        t2.tag = "sched-e2e"
+        t2.set_read_version(max(t.committed_version - 1, 0))
+        t2.add_read_conflict_range(b"hot", b"hot\x00")
+        t2.set(b"blind", b"1")
+        await t2.commit()
+        doc = await db.cluster.get_status()
+        t3 = db.create_transaction()
+        rows = await t3.get_range(b"\xff\xff/metrics/scheduler/",
+                                  b"\xff\xff/metrics/scheduler0",
+                                  limit=100)
+        point = await db.create_transaction().get(rows[0][0]) \
+            if rows else None
+        return doc, rows, point
+
+    doc, rows, point = run(c, traffic(), timeout=120)
+    sched = doc["cluster"]["scheduler"]
+    assert sched["enabled"] == {"predictor": True, "reorder": True,
+                                "repair": True}
+    assert sched["totals"]["repairs_attempted"] >= 1
+    assert sched["totals"]["repairs_succeeded"] >= 1
+    # Special keys render the same document.
+    assert rows, "scheduler special keys empty"
+    parsed = {k: json.loads(v) for k, v in rows}
+    totals_row = parsed[b"\xff\xff/metrics/scheduler/totals"]
+    assert totals_row["repairs_attempted"] == \
+        sched["totals"]["repairs_attempted"]
+    assert totals_row["enabled"] == sched["enabled"]
+    assert point == rows[0][1]          # point get == range row
+    # fdbcli metrics renders the same counters.
+    cli = Cli.__new__(Cli)
+    cli.loop, cli.db = c.loop, db
+    out = cli.dispatch("metrics sched")
+    assert "Scheduler (predictor=on, reorder=on, repair=on)" in out
+    assert "repairs=%d" % sched["totals"]["repairs_attempted"] in out
+
+
+# ---------------------------------------------------------------------------
+# Review-hardening regressions: tenant doom at admission, disown-vs-fetch
+# races, [knobs] validation atomicity
+# ---------------------------------------------------------------------------
+
+def test_grv_deferral_by_tenant_identity(teardown, knobs):
+    """The per-tenant doom path is consultable at admission: a GRV
+    carrying only a tenant id (no tags) defers like a doomed tag."""
+    knobs.SCHED_PREDICTOR_ENABLED = True
+    knobs.SCHED_MAX_DEFERRALS = 2
+    c = SimCluster()
+    g = c.grv_proxies[0]
+    g.predictor.update([(b"h", b"h\x00", 50, 1, {}, {42: 50})])
+    assert g.predictor.is_doomed((), tenant_id=42)
+
+    async def grv():
+        return (await RequestStream.at(
+            g.interface.get_consistent_read_version.endpoint).get_reply(
+            GetReadVersionRequest(tenant_id=42))).version
+
+    assert run(c, grv()) >= 0
+    assert g.metrics.counter("SchedDeferrals").value == 2
+
+
+def test_disown_during_inflight_fetch(teardown, monkeypatch):
+    """A disown fence landing while the range's ACQUIRING fetch is still
+    in flight closes the range at fetch completion (newer than the
+    fetch's min_version); a stale fence from an earlier tenure loses."""
+    from foundationdb_tpu.core.scheduler import delay
+    from foundationdb_tpu.server.interfaces import FetchKeysRequest
+    from foundationdb_tpu.server.storage import StorageServer
+
+    orig = StorageServer._fetch_shard
+
+    async def slow_fetch_shard(self, req):
+        await delay(0.2)   # hold the snapshot so the fence lands mid-fetch
+        return await orig(self, req)
+
+    monkeypatch.setattr(StorageServer, "_fetch_shard", slow_fetch_shard)
+    c = SimCluster(n_storage=2)
+    ss0, ss1 = c.storage
+    db = c.database()
+
+    async def seed():
+        t = db.create_transaction()
+        t.set(b"\x90seed", b"v")
+        await t.commit()
+        return t.committed_version
+
+    cv = run(c, seed())
+    assert cv >= 1   # source serves snapshots at >= cv
+
+    async def race(disown_version, min_version):
+        from foundationdb_tpu.core.futures import Promise
+        req = FetchKeysRequest(begin=b"\x90a", end=b"\x90m",
+                               sources=[ss0.interface],
+                               min_version=min_version)
+        req.reply = Promise()
+        ss1._process.spawn(ss1._fetch_keys(req), "test.fetch")
+        await delay(0.05)
+        assert ss1.shards.lookup(b"\x90b")[0] == "fetching"
+        ss1._disown_shard(b"\x90a", b"\x90m", disown_version)
+        assert ss1.shards.lookup(b"\x90b")[0] == "fetching"  # deferred
+        await req.reply.get_future()
+        return ss1.shards.lookup(b"\x90b")[0]
+
+    # Fence NEWER than the acquiring move: the range must close.
+    assert run(c, race(disown_version=cv + 500,
+                       min_version=cv)) == "absent"
+    # Fence OLDER than the acquiring move: the re-acquisition wins.
+    assert run(c, race(disown_version=max(cv - 1, 0),
+                       min_version=cv)) == "owned"
+
+
+def test_spec_knob_validation_is_atomic(teardown, knobs):
+    """A typo'd [knobs] name rejects the spec WITHOUT leaking earlier
+    overrides into the process."""
+    from foundationdb_tpu.testing.tester import run_simulation
+    spec = {"knobs": {"SCHED_REPAIR_ENABLED": True,
+                      "SCHED_REORDR_ENABLED": True},
+            "test": []}
+    with pytest.raises(KeyError, match="SCHED_REORDR_ENABLED"):
+        run_simulation(spec, 1)
+    assert server_knobs().SCHED_REPAIR_ENABLED is False
+
+
+# ---------------------------------------------------------------------------
+# Chaos: double-run unseed + duplicate-commit audit + coverage
+# ---------------------------------------------------------------------------
+
+def test_sched_chaos_double_run(teardown):
+    from foundationdb_tpu.core import coverage
+    from foundationdb_tpu.testing.tester import run_test_twice
+    r1, r2 = run_test_twice(
+        os.path.join(SPECS, "SchedChaosTest.toml"), seed=12345)
+    assert r1.unseed == r2.unseed and r1.digest == r2.digest
+    m = r1.metrics["SchedRepairLoad"]
+    assert m["acked"] > 0
+    # All three stages actually ran under the nemesis.
+    assert coverage.covered("ProxyTxnRepaired")
+    assert coverage.covered("ProxyTxnRepairCommitted")
+    assert coverage.covered("GrvSchedDeferral")
+    assert coverage.covered("ProxyBatchReordered")
+    assert coverage.covered("ChaosNemesisResolverKill")
+
+
+# ---------------------------------------------------------------------------
+# Bench smoke: the sched subcommand's measurement core at toy scale
+# ---------------------------------------------------------------------------
+
+def test_bench_sched_smoke(monkeypatch):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_sched_under_test",
+        os.path.join(os.path.dirname(__file__), os.pardir, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setattr(bench, "SCHED_TXNS", 512)
+    monkeypatch.setattr(bench, "SCHED_BATCHES", 5)
+    monkeypatch.setattr(bench, "SCHED_WARMUP", 2)
+    monkeypatch.setattr(bench, "SCHED_REPEATS", 1)
+    monkeypatch.setattr(bench, "SCHED_LOWC_BATCHES", 1)
+    doc = bench.run_sched_bench()
+    assert doc["parity"] == "ok"
+    rates = doc["commit_rate"]
+    assert set(rates) == {"off", "predictor", "reorder", "repair", "all"}
+    assert all(0.0 <= v <= 1.0 for v in rates.values())
+    # The stages help (or at worst do nothing) on the contended stream.
+    assert rates["all"] >= rates["off"]
+    assert rates["repair"] >= rates["off"]
+    assert doc["commit_rate_low"] >= 0.95
+    counters = doc["stage_counters"]
+    assert counters["off"]["repairs"] == 0
+    assert counters["off"]["deferrals"] == 0
+    assert counters["all"]["repairs"] > 0
+
+
+def test_flowlint_clean_on_sched_package():
+    """The new package lints clean on its own (the repo-wide empty-
+    baseline gate in test_flowlint covers the rest of the PR)."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "flowlint.py"),
+         os.path.join(repo, "foundationdb_tpu", "sched"),
+         "--baseline", "none"],
+        capture_output=True, text=True, cwd=repo)
+    assert r.returncode == 0, r.stdout + r.stderr
