@@ -44,6 +44,13 @@ from .dataflow import lock_key
 #   ["self", m]            self.m(...)
 #   ["cls", m]             cls.m(...)
 #   ["super", m]           super().m(...)
+#   ["typed", texpr, m]    receiver-typed call (ISSUE 13): the receiver's
+#                          locally inferred type expression `texpr` —
+#                          ["call", *spec] (constructor/factory value),
+#                          ["ann", *base_spec] (annotation), or
+#                          ["selfattr", attr] (`self.X.m()` through the
+#                          class's attribute types) — resolved to a class
+#                          at link time, then dispatched like self-calls
 #   ["opaque", terminal]   anything else (unknown callee; terminal name
 #                          feeds the conservative disqualification set)
 
@@ -174,6 +181,11 @@ class CallGraph:
         self.calls_of: Dict[str, List[Tuple[list, Optional[str]]]] = {}
         # (caller fid, line, callee fid or None, raw spec) for --dump.
         self.edges: List[Tuple[str, int, Optional[str], list]] = []
+        # fid -> (rel, class name) the function always returns an
+        # instance of — filled by ProgramIndex between the two
+        # resolve_all passes; typed specs whose receiver came from a
+        # factory call resolve through it on the second pass.
+        self.returns_instance: Dict[str, Tuple[str, str]] = {}
 
     # -- identity ------------------------------------------------------------
     @staticmethod
@@ -187,15 +199,18 @@ class CallGraph:
 
     # -- class-table helpers -------------------------------------------------
     def _class_at(self, rel: str,
-                  name: str) -> Optional[Tuple[str, dict]]:
+                  name: str) -> Optional[Tuple[str, str, dict]]:
         f = self.facts.get(rel)
         if f and name in f["classes"]:
-            return rel, f["classes"][name]
+            return rel, name, f["classes"][name]
         return None
 
-    def _resolve_class_spec(self, rel: str,
-                            spec: List[str]) -> Optional[Tuple[str, dict]]:
-        """(rel, class facts) for a base/class spec seen from `rel`."""
+    def _resolve_class_spec(
+            self, rel: str,
+            spec: List[str]) -> Optional[Tuple[str, str, dict]]:
+        """(defining rel, DEFINING class name, class facts) for a base/
+        class spec seen from `rel` — the name is the class's own, not
+        the import alias it was reached through."""
         f = self.facts.get(rel)
         if f is None:
             return None
@@ -228,7 +243,7 @@ class CallGraph:
         cls = self._class_at(rel, cls_name)
         if cls is None:
             return None
-        queue.append((cls[0], cls_name, cls[1], skip_own))
+        queue.append((cls[0], cls[1], cls[2], skip_own))
         hops = 0
         while queue and hops < self._MRO_CAP:
             hops += 1
@@ -241,9 +256,94 @@ class CallGraph:
             for bspec in cfacts["bases"]:
                 b = self._resolve_class_spec(crel, bspec)
                 if b is not None:
-                    bname = bspec[1] if bspec[0] == "name" else bspec[2]
-                    queue.append((b[0], bname, b[1], False))
+                    queue.append((b[0], b[1], b[2], False))
         return None
+
+    # -- local type inference resolution (ISSUE 13) --------------------------
+    def resolve_type(self, rel: str, cls_name: Optional[str],
+                     texpr, depth: int = 0) -> Optional[Tuple[str, str]]:
+        """(defining rel, class name) the type expression denotes, or
+        None when it cannot be pinned to ONE in-package class.  texpr:
+        ``["call", *call_spec]`` — a constructor (`x = ClassName()`) or
+        a factory whose returns-instance summary names a class;
+        ``["ann", *base_spec]`` — an annotation; ``["selfattr", X]`` —
+        the enclosing class's attribute-type table through the MRO."""
+        if not texpr or depth > 5:
+            return None
+        kind = texpr[0]
+        if kind == "ann":
+            c = self._resolve_class_spec(rel, list(texpr[1:]))
+            return (c[0], c[1]) if c is not None else None
+        if kind == "call":
+            spec = list(texpr[1:])
+            if spec and spec[0] in ("name", "attr"):
+                c = self._resolve_class_spec(rel, spec)
+                if c is not None:       # constructor call
+                    return (c[0], c[1])
+            fid = self.resolve(rel, cls_name, spec)
+            if fid is not None:         # factory: its summary's class
+                return self.returns_instance.get(fid)
+            return None
+        if kind == "selfattr":
+            if cls_name is None:
+                return None
+            return self.attr_type(rel, cls_name, texpr[1], depth + 1)
+        return None
+
+    def attr_type(self, rel: str, cls_name: str, attr: str,
+                  depth: int = 0) -> Optional[Tuple[str, str]]:
+        """The class of ``self.<attr>`` on (rel, cls_name), looked up in
+        the per-class attribute-type tables (constructor assignments /
+        annotations recorded at extraction) through the MRO."""
+        seen: Set[Tuple[str, str]] = set()
+        queue = [(rel, cls_name)]
+        hops = 0
+        while queue and hops < self._MRO_CAP:
+            hops += 1
+            crel, cname = queue.pop(0)
+            if (crel, cname) in seen:
+                continue
+            seen.add((crel, cname))
+            cf = self.facts.get(crel, {}).get("classes", {}).get(cname)
+            if cf is None:
+                continue
+            texpr = cf.get("attr_types", {}).get(attr)
+            if texpr is not None:
+                return self.resolve_type(crel, cname, texpr, depth + 1)
+            for bspec in cf["bases"]:
+                b = self._resolve_class_spec(crel, bspec)
+                if b is not None:
+                    queue.append((b[0], b[1]))
+        return None
+
+    def attr_owner(self, rel: str, cls_name: str,
+                   attr: str) -> Tuple[str, str]:
+        """The base-MOST in-package ancestor of (rel, cls_name) that
+        assigns ``self.<attr>`` — the attribute's allocation-site owner,
+        the class component of an object-sensitive lock identity.  A
+        Sub method and a Base method locking the inherited ``self._lock``
+        must agree on ONE identity; defaults to the class itself when no
+        ancestor assigns it."""
+        best, best_depth = (rel, cls_name), -1
+        seen: Set[Tuple[str, str]] = set()
+        queue: List[Tuple[str, str, int]] = [(rel, cls_name, 0)]
+        hops = 0
+        while queue and hops < 2 * self._MRO_CAP:
+            hops += 1
+            crel, cname, d = queue.pop(0)
+            if (crel, cname) in seen:
+                continue
+            seen.add((crel, cname))
+            cf = self.facts.get(crel, {}).get("classes", {}).get(cname)
+            if cf is None:
+                continue
+            if attr in cf.get("attrs", ()) and d > best_depth:
+                best, best_depth = (crel, cname), d
+            for bspec in cf["bases"]:
+                b = self._resolve_class_spec(crel, bspec)
+                if b is not None:
+                    queue.append((b[0], b[1], d + 1))
+        return best
 
     # -- call resolution -----------------------------------------------------
     def _module_member(self, rel: str, name: str) -> Optional[str]:
@@ -297,8 +397,18 @@ class CallGraph:
             # ClassName.m(...) — a class in scope, explicit dispatch.
             c = self._resolve_class_spec(rel, ["name", base])
             if c is not None:
-                return self._method(c[0], base, attr)
+                return self._method(c[0], c[1], attr)
             return None
+        if kind == "typed":
+            # obj.m() through the local type-inference pass: resolve the
+            # receiver's type expression to a class, then dispatch like
+            # an explicit ClassName.m — an ambiguous/unknown receiver
+            # never reaches this spec (it stays ["attr", ...] and feeds
+            # the conservatism set as before).
+            t = self.resolve_type(rel, cls_name, list(spec[1]))
+            if t is None:
+                return None
+            return self._method(t[0], t[1], spec[2])
         return None
 
     # -- class hierarchy -----------------------------------------------------
@@ -317,11 +427,10 @@ class CallGraph:
                         self._parents_of.setdefault((rel, cname),
                                                     []).append(None)
                     else:
-                        bname = bspec[1] if bspec[0] == "name" else bspec[2]
                         self._parents_of.setdefault(
-                            (rel, cname), []).append((b[0], bname))
+                            (rel, cname), []).append((b[0], b[1]))
                         self._children_of.setdefault(
-                            (b[0], bname), []).append((rel, cname))
+                            (b[0], b[1]), []).append((rel, cname))
 
     def virtually_dispatched(self, rel: str, cls: str, name: str) -> bool:
         """True when a method's `self.`-callsites may dispatch SOMEWHERE
@@ -362,6 +471,16 @@ class CallGraph:
         return False
 
     # -- whole-graph pass ----------------------------------------------------
+    def clear_resolution(self) -> None:
+        """Drop every resolution artifact (edges, reverse edges, the
+        conservatism set) so ``resolve_all`` can run again — the second
+        pass after ``returns_instance`` is filled resolves the
+        factory-typed receivers the first pass could not."""
+        self.unresolved_names.clear()
+        self.callers.clear()
+        self.calls_of.clear()
+        self.edges.clear()
+
     def resolve_all(self) -> None:
         """Resolve every recorded call once: fills ``edges``,
         ``callers`` (reverse edges), ``unresolved_names`` (the

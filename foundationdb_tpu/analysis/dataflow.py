@@ -149,13 +149,18 @@ class CFGNode:
     barrier (0 while unreachable); ``in_locks`` is the lockset held at
     node entry (None while unreachable)."""
 
-    __slots__ = ("idx", "stmt", "succs", "barrier", "defs", "acquires",
-                 "releases", "in_defs", "in_locks")
+    __slots__ = ("idx", "stmt", "succs", "exc_succs", "barrier", "defs",
+                 "acquires", "releases", "in_defs", "in_locks")
 
     def __init__(self, idx: int, stmt: Optional[ast.AST]) -> None:
         self.idx = idx
         self.stmt = stmt
         self.succs: Set[int] = set()
+        # The subset of succs that are conservative EXCEPTION edges
+        # (mid-statement raise into a handler / finally junction) —
+        # analyses modeling normal completion (FTL016's leak paths)
+        # exclude them; reaching-defs/locksets keep the full set.
+        self.exc_succs: Set[int] = set()
         self.barrier = False
         self.defs: List[DefInfo] = []
         self.acquires: FrozenSet[str] = frozenset()
@@ -216,7 +221,12 @@ class FunctionDataflow:
                     + ([a.kwarg] if a.kwarg else [])):
             self._add_def(entry, arg.arg, None, func.lineno,
                           annotation=arg.annotation, is_param=True)
-        self._build_body(func.body, [entry.idx])
+        # Nodes whose FALL-THROUGH leaves the function (the implicit
+        # `return None` off the end) — a branch test or loop header
+        # here still has in-body successors, so "no successors" is NOT
+        # the exit criterion; FTL016's leak exits need these.
+        self.exit_preds: List[int] = \
+            self._build_body(func.body, [entry.idx])
         del self._loop_stack, self._exc_stack
         self._analyze()
 
@@ -228,6 +238,7 @@ class FunctionDataflow:
         # enclosing frame: an unmatched except type propagates outward).
         for frame in self._exc_stack:
             n.succs.update(frame)
+            n.exc_succs.update(frame)
         return n
 
     def _link(self, preds: List[int], node: CFGNode) -> None:

@@ -473,6 +473,44 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
 
 # -- output ------------------------------------------------------------------
 
+def format_sarif(result: LintResult, rules: Sequence[Rule]) -> str:
+    """SARIF 2.1.0 for PR annotation (ISSUE 13): one run, the rule
+    registry as tool metadata, one ``error``-level result per NEW
+    finding with its repo-relative location — witness chains (FTL013's
+    blocking chain, FTL015's acquisition orders) ride in the message
+    text, where code-scanning UIs render them verbatim."""
+    rule_meta = [{"id": r.id,
+                  "name": type(r).__name__,
+                  "shortDescription": {"text": r.title}}
+                 for r in rules]
+    rule_index = {r.id: i for i, r in enumerate(rules)}
+    results = []
+    for f in result.new:
+        entry = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": f.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(1, f.line)}}}],
+        }
+        if f.rule in rule_index:
+            entry["ruleIndex"] = rule_index[f.rule]
+        results.append(entry)
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "flowlint",
+                "rules": rule_meta}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
+
+
 def format_text(result: LintResult) -> str:
     lines = []
     for f in result.new:

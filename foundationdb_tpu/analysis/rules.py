@@ -1,4 +1,4 @@
-"""flowlint rules FTL001..FTL014.
+"""flowlint rules FTL001..FTL016.
 
 Every rule is grounded in a bug class this repo has actually hit (see
 ISSUE/PR history): wall-clock reads that break unseed reproduction,
@@ -1172,6 +1172,74 @@ class LockAliasRule(Rule):
                 "lock, or split the function per lock"))
 
 
+class LockOrderCycleRule(Rule):
+    """FTL015: lock-ordering cycles — lockdep's discipline, static.
+
+    Two threads taking the same two locks in opposite orders deadlock
+    the moment their critical sections overlap; the hazard composes
+    through calls (``with a: obj.m()`` where ``m`` — any depth down —
+    takes ``b``, against a ``with b: ... a`` chain elsewhere), so no
+    single-function rule can see it.  The engine builds a lock-order
+    graph from the per-function acquisition summaries composed over the
+    call graph and reports each elementary cycle with EVERY edge's
+    acquisition chain as witness.
+
+    Deliberately left out of FTL013 until lock identity became
+    OBJECT-SENSITIVE (ISSUE 13): with locks keyed by source text, two
+    instances sharing the attribute name ``self._lock`` alias, and
+    every ``a.method()``/``b.method()`` cross-call between same-class
+    instances reads as a self-cycle — object identities keyed by
+    (class, attr, instance role) are what hold the noise floor at
+    zero.  Reentrant same-identity nesting (RLock) is excluded: it is
+    not an ordering between two locks."""
+
+    id = "FTL015"
+    title = "lock-ordering cycle (opposite acquisition orders deadlock)"
+
+    def finish_program(self, program, report) -> None:
+        for c in program.lock_cycles():
+            report(Finding(self.id, c["path"], c["line"], c["message"]))
+
+
+class PromiseProtocolRule(Rule):
+    """FTL016: a locally created ``Promise``/``PromiseStream`` must be
+    sent, broken, or escape on EVERY path.
+
+    The ISSUE-10 bug class: a promise a deposed cluster controller left
+    neither sent nor broken wedged its waiter until GC happened to run
+    ``__del__`` — recovery hung on reference-counting luck.  The CFG
+    path analysis (summaries.py ``_leaked_defs``) flags a creation a
+    normal exit can be reached from with the promise neither resolved
+    (``send``/``send_error``/``break_promise``/``close``) nor escaped
+    (returned, stored, passed on — ownership moved); reads
+    (``get_future``/``is_set``/``pop``/``empty``) transfer nothing.
+    Raise paths are exempt (unwinding drops the local deterministically
+    in CPython); the hazard is the branch that KEEPS RUNNING with the
+    promise forgotten.  Interprocedural: a promise obtained from an
+    in-package FACTORY (``p = make_reply()``) is tracked through the
+    returns-instance summary exactly like a direct construction."""
+
+    id = "FTL016"
+    title = "promise neither resolved nor escaped on every path"
+
+    PROMISE_CLASSES = frozenset({"Promise", "PromiseStream"})
+
+    def finish_program(self, program, report) -> None:
+        for rel, qname, fn, fid in program.iter_scanned_functions():
+            for line, name, texpr in fn.get("leaks", ()):
+                t = program.resolve_type(rel, fn.get("cls"), texpr)
+                if t is None or t[1] not in self.PROMISE_CLASSES:
+                    continue
+                report(Finding(
+                    self.id, rel, line,
+                    f"{t[1]} '{name}' ({qname}) reaches a function exit "
+                    "neither sent, broken, nor handed off on some path: "
+                    "its waiter then hangs until GC luck breaks it (the "
+                    "deposed-CC long-poll bug class) — send/send_error/"
+                    "break_promise it on every path, or hand it off "
+                    "explicitly"))
+
+
 def make_rules() -> List[Rule]:
     """Fresh rule instances — ALWAYS construct per run: rules carry
     cross-file state (TraceEventRule._by_type), so sharing instances
@@ -1183,4 +1251,4 @@ def make_rules() -> List[Rule]:
             HardcodedTunableRule(), KnobNameRule(),
             StaleStateAcrossAwaitRule(), AwaitHoldingLockRule(),
             LocksetDisciplineRule(), TransitiveBlockingRule(),
-            LockAliasRule()]
+            LockAliasRule(), LockOrderCycleRule(), PromiseProtocolRule()]

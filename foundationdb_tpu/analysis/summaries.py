@@ -53,11 +53,21 @@ from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 from .callgraph import (CallGraph, base_spec, build_import_tables,
                         call_spec, module_name_for, resolve_external)
-from .dataflow import FunctionDataflow, is_set_expr, lock_key
+from .dataflow import DefInfo, FunctionDataflow, is_set_expr, lock_key
 from .engine import _suppressions, iter_py_files, topmost_package
 from .rules import AwaitHoldingLockRule, WallClockRule, _sim_reachable
 
+# The cache FILE format version (shape of the JSON envelope).
 CACHE_VERSION = 1
+# The analysis-version stamp (ISSUE 13): bumped whenever the fact
+# EXTRACTOR or a summary consumer changes shape, so a rule/extractor
+# upgrade invalidates every cached per-file fact dict instead of
+# silently serving pre-upgrade facts (which would lack the new keys —
+# missed findings at best, KeyErrors at worst).  Every cache entry is
+# keyed by (content hash, stamp); either mismatch is a miss.
+#   2: ISSUE 13 — typed call specs, lock registry (attrs/attr_types/
+#      module_locks), acquisitions, rets_type, promise leaks.
+ANALYSIS_VERSION = 2
 
 # THE wait-method and clock predicates live on the rules (FTL011 /
 # FTL001); the summaries import them so the transitive reach can never
@@ -70,10 +80,11 @@ _SET_METHODS = ("union", "intersection", "difference",
 
 _FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
+LOCK_FACTORY_NAMES = ("threading.Lock", "threading.RLock")
+
 
 def _hash_source(source: str) -> str:
-    return hashlib.sha1(
-        f"v{CACHE_VERSION}:".encode() + source.encode()).hexdigest()
+    return hashlib.sha1(source.encode()).hexdigest()
 
 
 _is_clock_name = WallClockRule.is_nondeterministic
@@ -112,7 +123,11 @@ def _classify_return(v: Optional[ast.expr], cfg: FunctionDataflow,
         for d in infos:
             if d.is_param or d.unpacked or d.value is None:
                 return "other"
-            subs.append(_classify_return(d.value, cfg, node, depth + 1))
+            # At the def's own node (see _classify_ret_type): names in
+            # the RHS must be judged by what reached the ASSIGNMENT.
+            subs.append(_classify_return(d.value, cfg,
+                                         cfg.node_for(d.value) or node,
+                                         depth + 1))
         if not subs:
             return "other"
         return subs[0] if len(subs) == 1 else ["all", subs]
@@ -153,6 +168,257 @@ def _arg_lock_keys(call: ast.Call, cfg: FunctionDataflow,
     return out
 
 
+# -- local type inference (ISSUE 13) -----------------------------------------
+
+def _texpr_of_value(v: Optional[ast.expr]):
+    """JSON-safe type expression for a def's RHS, or None: a (possibly
+    awaited) call with a non-opaque target spec — a constructor or a
+    factory, told apart at link time against the class tables and the
+    returns-instance summaries."""
+    if isinstance(v, ast.Await):
+        v = v.value
+    if isinstance(v, ast.Call):
+        spec = call_spec(v)
+        if spec[0] != "opaque":
+            return ["call"] + spec
+    return None
+
+
+def _infer_receiver(cfg: FunctionDataflow, node, name: str):
+    """The local type-inference lattice, joined over reaching defs:
+    every def must yield the SAME type expression (constructor/factory
+    value, or a class-naming parameter annotation) or the receiver is
+    unknown — ambiguity never resolves a call (the conservative
+    direction: a wrongly-resolved callee could silence caller-held
+    seeding for a real race)."""
+    infos = {d.idx: d for d, _ in cfg.reaching(node, name)}.values()
+    if not infos:
+        return None
+    out = None
+    for d in infos:
+        if d.is_param:
+            spec = base_spec(d.annotation) if d.annotation is not None \
+                else None
+            te = (["ann"] + spec) if spec is not None else None
+        elif d.unpacked or d.value is None:
+            te = None
+        else:
+            te = _texpr_of_value(d.value)
+        if te is None:
+            return None
+        if out is None:
+            out = te
+        elif out != te:
+            return None             # lattice join of two types: unknown
+    return out
+
+
+def _classify_ret_type(v: Optional[ast.expr], cfg: FunctionDataflow,
+                       node, depth: int = 0):
+    """Type expression of one return value (for the returns-instance
+    summary), traced through single-valued local names; 'other' when it
+    cannot be pinned."""
+    if v is None or depth > 3:
+        return "other"
+    te = _texpr_of_value(v)
+    if te is not None:
+        return te
+    if isinstance(v, ast.Name):
+        infos = {d.idx: d for d, _ in cfg.reaching(node, v.id)}.values()
+        out = None
+        for d in infos:
+            if d.is_param or d.unpacked or d.value is None:
+                return "other"
+            # Recurse at the DEF's own node, not the return's: a name
+            # the def's RHS mentions may have been REBOUND between the
+            # assignment and the return (`y = x; x = Other(); return
+            # y`), and querying reaching defs at the return would read
+            # the rebound value — the wrong-class direction that can
+            # silently re-type a receiver.
+            sub = _classify_ret_type(d.value, cfg,
+                                     cfg.node_for(d.value) or node,
+                                     depth + 1)
+            if sub == "other" or (out is not None and sub != out):
+                return "other"
+            out = sub
+        return out if out is not None else "other"
+    return "other"
+
+
+# -- promise-protocol path analysis (FTL016) ---------------------------------
+
+# Methods that RESOLVE a promise/stream (the protocol's terminal ops)
+# vs. reads that transfer nothing.  Any OTHER use of the name — an
+# argument, a return, a store, an unknown method — is an ESCAPE:
+# ownership moved, the protocol is someone else's problem.
+PROMISE_RESOLVERS = frozenset({"send", "send_error", "break_promise",
+                               "close", "break_buffered_replies"})
+_PROMISE_READS = frozenset({"get_future", "is_set", "is_ready", "empty",
+                            "pop"})
+
+
+def _leaked_defs(cfg: FunctionDataflow, parents) -> List[list]:
+    """[[line, name, texpr], ...] for every call-valued local def that
+    reaches a NORMAL function exit neither resolved nor escaped on some
+    path (forward may-analysis over the CFG, one bitmask fixpoint).
+    Whether the def actually creates a Promise/PromiseStream is decided
+    at link time from its type expression — this pass only computes the
+    path property.  Raise exits and exception EDGES are exempt:
+    unwinding drops the local and CPython's refcount breaks the promise
+    deterministically; the hazard class is the branch that KEEPS
+    RUNNING with the promise forgotten (the deposed-CC long-poll shape,
+    ISSUE 10).  A name captured by a nested def/lambda escapes the
+    frame outright (``call_at(..., lambda: p.send(None))`` hands
+    ownership to the scheduler) — closures are outside the CFG, so the
+    whole candidate drops."""
+    captured: Set[str] = set()
+    for sub in ast.walk(cfg.func):
+        if sub is cfg.func or not isinstance(
+                sub, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for nm in ast.walk(sub):
+            if isinstance(nm, ast.Name) and isinstance(nm.ctx, ast.Load):
+                captured.add(nm.id)
+    cands: List[Tuple[DefInfo, list]] = []
+    for d in cfg.defs:
+        if d.is_param or d.unpacked or d.value is None or \
+                d.name in captured:
+            continue
+        # Plain assignment statements only: a walrus inside a larger
+        # expression hands its value to the enclosing expression (an
+        # escape the name-load scan cannot see).
+        if not isinstance(parents.get(id(d.value)),
+                          (ast.Assign, ast.AnnAssign)):
+            continue
+        te = _texpr_of_value(d.value)
+        if te is not None:
+            cands.append((d, te))
+    if not cands:
+        return []
+    idx = {id(d): i for i, (d, _) in enumerate(cands)}
+    by_name: Dict[str, List[int]] = {}
+    for i, (d, _) in enumerate(cands):
+        by_name.setdefault(d.name, []).append(i)
+
+    n = len(cfg.nodes)
+    gens = [0] * n
+    kills = [0] * n
+    for node in cfg.nodes:
+        for d in node.defs:
+            for i in by_name.get(d.name, ()):
+                kills[node.idx] |= 1 << i       # rebind kills (refcount
+                #                                 breaks the old value)
+            i = idx.get(id(d))
+            if i is not None:
+                gens[node.idx] |= 1 << i
+    for name_node, node in cfg.loads:
+        ids = by_name.get(name_node.id)
+        if not ids:
+            continue
+        parent = parents.get(id(name_node))
+        resolves_or_escapes = True
+        if isinstance(parent, ast.Attribute) and parent.value is name_node:
+            grand = parents.get(id(parent))
+            if isinstance(grand, ast.Call) and grand.func is parent:
+                if parent.attr in PROMISE_RESOLVERS:
+                    pass                        # protocol satisfied
+                elif parent.attr in _PROMISE_READS:
+                    resolves_or_escapes = False  # transfers nothing
+                # any other method: conservatively an escape
+        if resolves_or_escapes:
+            for i in ids:
+                kills[node.idx] |= 1 << i
+
+    # Propagate along NORMAL edges only — a fact reaching an exit
+    # through an exception edge describes an unwinding path, which the
+    # Raise exemption already covers.  EXCEPTION: a Return/Break/
+    # Continue under a try-with-finalbody completes NORMALLY through
+    # the finally junction (the CFG wires that edge via the exception
+    # stack) — re-admit those junction edges, or the finalbody never
+    # sees return-path facts and the exit exemption below would
+    # silence every leak exiting through a try/finally.
+    normal = []
+    for node in cfg.nodes:
+        succs = node.succs - node.exc_succs
+        if isinstance(node.stmt, (ast.Return, ast.Break, ast.Continue)):
+            for s in node.exc_succs:
+                st = cfg.nodes[s].stmt
+                if isinstance(st, ast.Try) and st.finalbody:
+                    succs = succs | {s}     # the finally junction
+        normal.append(sorted(succs))
+    preds: List[List[int]] = [[] for _ in cfg.nodes]
+    for node in cfg.nodes:
+        for s in normal[node.idx]:
+            preds[s].append(node.idx)
+    outs: List[Optional[int]] = [None] * n      # None = not yet visited
+    pending = [False] * n
+    # Entry points: the function entry AND every except-handler entry —
+    # handlers are reachable only through the (excluded) exception
+    # edges, but a handler that catches KEEPS RUNNING with its own
+    # creations live, so they seed with empty facts (facts from before
+    # the try stay exempt on the unwind path, as designed).
+    work = [0] + [node.idx for node in cfg.nodes
+                  if isinstance(node.stmt, ast.ExceptHandler)]
+    for i in work:
+        pending[i] = True
+    while work:
+        i = work.pop()
+        pending[i] = False
+        merged = 0
+        for p in preds[i]:
+            if outs[p] is not None:
+                merged |= outs[p]
+        out = (merged & ~kills[i]) | gens[i]
+        if out != outs[i]:
+            outs[i] = out
+            for s in normal[i]:
+                if not pending[s]:
+                    pending[s] = True
+                    work.append(s)
+
+    leaked = 0
+    fallthrough_exits = set(cfg.exit_preds)
+    for node in cfg.nodes:
+        st = node.stmt
+        if isinstance(st, ast.Raise) or outs[node.idx] is None:
+            continue
+        # Exits: nodes whose fall-through leaves the function (the
+        # implicit return off the end — a last-statement branch test
+        # STILL HAS in-body successors, so successor-lessness alone
+        # misses it) plus successor-less nodes (returns, finalbody
+        # ends).
+        if node.idx not in fallthrough_exits and normal[node.idx]:
+            continue
+        if isinstance(st, ast.Return):
+            # A return under a try-with-finalbody exits THROUGH the
+            # finalbody, which may still resolve the promise — that
+            # path is the finally junction's (exception-edge) business,
+            # so this node is not an exit of its own.
+            ch: ast.AST = st
+            p = parents.get(id(st))
+            through_finally = False
+            while p is not None and p is not cfg.func and \
+                    not isinstance(p, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.Lambda)):
+                # Stop at the ENCLOSING function: an outer try/finally
+                # around the whole def must not exempt its returns.  A
+                # try exempts only returns in its body/handlers/orelse
+                # — a return INSIDE the finalbody exits the function
+                # directly, with no further finally of THIS try to
+                # resolve anything.
+                if isinstance(p, ast.Try) and p.finalbody and \
+                        not any(ch is s for s in p.finalbody):
+                    through_finally = True
+                    break
+                ch = p
+                p = parents.get(id(p))
+            if through_finally:
+                continue
+        leaked |= outs[node.idx]
+    return [[d.lineno, d.name, te] for i, (d, te) in enumerate(cands)
+        if leaked & (1 << i)]
+
+
 def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
                        source: str, records, suppress_line,
                        suppress_file, parents=None) -> dict:
@@ -167,18 +433,82 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
     classes: Dict[str, dict] = {}
     for node in tree.body:
         if isinstance(node, ast.ClassDef):
-            classes[node.name] = {
+            c = classes[node.name] = {
                 "bases": [s for s in map(base_spec, node.bases)
                           if s is not None],
                 "methods": {n.name: n.lineno for n in node.body
                             if isinstance(n, _FUNCS)},
+                # The object-sensitivity registry (ISSUE 13): every
+                # self-assigned OR class-body-assigned attr name
+                # (allocation-site ownership for lock identities) and
+                # the attrs with ONE inferable class (constructor
+                # assignment / annotation — conflicting sites drop
+                # out).  A class-body `_lock = threading.Lock()` is an
+                # allocation site like any `self._lock = ...`: Sub and
+                # Base methods locking it must agree on ONE identity.
+                "attrs": [],
+                "attr_types": {},
             }
+            for stmt in node.body:
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target] if isinstance(stmt, ast.AnnAssign) \
+                    else ()
+                for t in targets:
+                    if isinstance(t, ast.Name) and \
+                            t.id not in c["attrs"]:
+                        c["attrs"].append(t.id)
 
     if parents is None:
         parents = {}
         for p in ast.walk(tree):
             for child in ast.iter_child_nodes(p):
                 parents[id(child)] = p
+
+    def _enclosing_class_name(node: ast.AST) -> Optional[str]:
+        n = parents.get(id(node))
+        while n is not None and not isinstance(n, ast.ClassDef):
+            n = parents.get(id(n))
+        return n.name if n is not None else None
+
+    module_locks: List[str] = []
+    for node in ast.walk(tree):
+        targets, value, annot = (), None, None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value, annot = [node.target], node.value, \
+                node.annotation
+        else:
+            continue
+        is_lock = isinstance(value, ast.Call) and \
+            resolve_external(tables, value.func) in LOCK_FACTORY_NAMES
+        for t in targets:
+            if isinstance(t, ast.Name) and is_lock and \
+                    parents.get(id(node)) is tree:
+                module_locks.append(t.id)
+            if not (isinstance(t, ast.Attribute) and
+                    isinstance(t.value, ast.Name) and
+                    t.value.id == "self"):
+                continue
+            owner = _enclosing_class_name(node)
+            c = classes.get(owner) if owner else None
+            if c is None:
+                continue
+            if t.attr not in c["attrs"]:
+                c["attrs"].append(t.attr)
+            te = _texpr_of_value(value)
+            if te is None and annot is not None:
+                spec = base_spec(annot)
+                te = (["ann"] + spec) if spec is not None else None
+            if te is not None:
+                prior = c["attr_types"].get(t.attr)
+                if prior is None:
+                    c["attr_types"][t.attr] = te
+                elif prior != te:
+                    c["attr_types"][t.attr] = False     # conflicted
+    for c in classes.values():
+        c["attr_types"] = {k: v for k, v in c["attr_types"].items()
+                           if v is not False}
 
     functions: Dict[str, dict] = {}
     for func, cfg, cls_name, nested in records:
@@ -191,10 +521,27 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
         for call, node in cfg.calls:
             line = getattr(call, "lineno", 0)
             spec = call_spec(call)
+            f = call.func
+            if spec[0] == "attr" and spec[1] not in tables["aliases"] \
+                    and spec[1] not in tables["from"] and \
+                    spec[1] not in classes:
+                # obj.m() on a plain local: the receiver-typed case.  A
+                # single inferable type upgrades the spec; ambiguity
+                # leaves it an unknown callee (conservatism intact).
+                te = _infer_receiver(cfg, node, spec[1])
+                if te is not None:
+                    spec = ["typed", te, spec[2]]
+            elif spec[0] == "opaque" and isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Attribute) and \
+                    isinstance(f.value.value, ast.Name) and \
+                    f.value.value.id == "self":
+                # self.X.m(): typed through the class's attribute-type
+                # table; the receiver PATH (self.X) also names the
+                # instance role for object-sensitive lock identity.
+                spec = ["typed", ["selfattr", f.value.attr], f.attr]
             calls.append([line, spec, sorted(cfg.lockset(node)),
                           id(call) in awaited_ids,
                           _arg_lock_keys(call, cfg, node)])
-            f = call.func
             if isinstance(f, ast.Attribute) and f.attr in WAIT_METHODS \
                     and not call.args and \
                     not any(kw.arg == "timeout" for kw in call.keywords):
@@ -214,11 +561,33 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
             if _is_clock_name(name) and not _line_suppressed(
                     "FTL001", line, suppress_line, suppress_file):
                 clock.append([line, name])
-        returns = []
+        returns, rets_type = [], []
         for node in cfg.nodes:
             if isinstance(node.stmt, ast.Return):
                 returns.append(_classify_return(node.stmt.value, cfg,
                                                 node))
+                rets_type.append(_classify_ret_type(node.stmt.value,
+                                                    cfg, node))
+        # Lock acquisitions with the locks already held at that point —
+        # the per-function half of the FTL015 lock-ordering summary.
+        # An FTL015-suppressed line contributes no nesting facts, so a
+        # justified ordering never re-enters a cycle through deeper
+        # composition.
+        acquisitions = []
+        for node in cfg.nodes:
+            if not node.acquires:
+                continue
+            aline = getattr(node.stmt, "lineno", 0)
+            if _line_suppressed("FTL015", aline, suppress_line,
+                                suppress_file):
+                continue
+            held = sorted(cfg.lockset(node))
+            for key in sorted(node.acquires):
+                acquisitions.append([aline, key,
+                                     [h for h in held if h != key]])
+        leaks = [lk for lk in _leaked_defs(cfg, parents)
+                 if not _line_suppressed("FTL016", lk[0], suppress_line,
+                                         suppress_file)]
         sim_ref = any(
             (isinstance(n, ast.Name) and n.id == "sim") or
             (isinstance(n, ast.Attribute) and n.attr == "sim")
@@ -232,7 +601,8 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
                        (list(func.args.posonlyargs) + list(func.args.args)
                         + list(func.args.kwonlyargs))],
             "calls": calls, "blocks": blocks, "clock": clock,
-            "returns": returns,
+            "returns": returns, "rets_type": rets_type,
+            "acquisitions": acquisitions, "leaks": leaks,
             "lock_params": dict(cfg.lock_params),
             "sim_ref": sim_ref,
         }
@@ -250,12 +620,6 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
     for q, fn in functions.items():
         if fn["decorated"]:
             escapes.add(q)
-    def _enclosing_class(node: ast.AST) -> Optional[str]:
-        n = parents.get(id(node))
-        while n is not None and not isinstance(n, ast.ClassDef):
-            n = parents.get(id(n))
-        return n.name if n is not None else None
-
     for node in ast.walk(tree):
         parent = parents.get(id(node))
         in_call_pos = isinstance(parent, ast.Call) and parent.func is node
@@ -270,7 +634,7 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
                 # a method of the class the access sits in (same-named
                 # methods of other classes must not lose their seeding
                 # — the FTL009/FTL010 scope lesson again).
-                owner = _enclosing_class(node)
+                owner = _enclosing_class_name(node)
                 if owner is not None and node.attr in \
                         classes.get(owner, {}).get("methods", {}):
                     escapes.add(f"{owner}.{node.attr}")
@@ -296,6 +660,7 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
 
     return {"module": module, "is_pkg": is_pkg, "classes": classes,
             "imports": tables, "escapes": sorted(escapes),
+            "module_locks": sorted(set(module_locks)),
             "functions": functions}
 
 
@@ -341,6 +706,9 @@ class ProgramIndex:
         self._clocked: Dict[str, tuple] = {}
         self._set_valued: Set[str] = set()
         self._param_canon: Dict[str, Dict[str, str]] = {}
+        # may-acquire (FTL015): fid -> {entry: witness}, entry =
+        # ("S", symbolic self-rooted key) | ("C", concrete identity).
+        self._acq: Dict[str, Dict[tuple, tuple]] = {}
         # [(rel, qname, line, param, {key: [caller sites]})]
         self.param_conflicts: List[tuple] = []
         # rel paths excluded from the program because two roots own the
@@ -420,11 +788,13 @@ class ProgramIndex:
             return {}               # corrupt cache: fall back to parsing
 
     def save_cache(self) -> None:
-        """Persist every program file's facts keyed by content hash —
-        fail-soft (an unwritable cache degrades to re-parsing)."""
+        """Persist every program file's facts keyed by (content hash,
+        analysis-version stamp) — fail-soft (an unwritable cache
+        degrades to re-parsing)."""
         if not self.cache_path:
             return
         entries = {rel: {"hash": self._hashes[rel],
+                         "stamp": ANALYSIS_VERSION,
                          "facts": self.facts[rel]}
                    for rel in self.facts if rel in self._hashes}
         try:
@@ -448,7 +818,11 @@ class ProgramIndex:
                 continue
             h = _hash_source(source)
             entry = cache.get(rel)
-            if entry and entry.get("hash") == h:
+            if entry and entry.get("hash") == h and \
+                    entry.get("stamp") == ANALYSIS_VERSION:
+                # BOTH keys must match: a content hit from a cache
+                # written by an older analysis version is STALE — its
+                # facts predate the current extractor/rule shapes.
                 self.facts[rel] = entry["facts"]
                 self.cache_hits += 1
             else:
@@ -460,11 +834,19 @@ class ProgramIndex:
             self._hashes[rel] = h
         self.graph = CallGraph(self.facts)
         self.graph.resolve_all()
+        # Second resolution pass (ISSUE 13): the returns-instance
+        # fixpoint needs resolved factory calls, and factory-typed
+        # receivers need returns-instance — resolve, compute, re-resolve
+        # (the graph is cheap; the facts are not touched).
+        self._compute_returns_instance()
+        self.graph.clear_resolution()
+        self.graph.resolve_all()
         self._compute_param_canon()
         self._compute_blocked()
         self._compute_clocked()
         self._compute_set_valued()
         self._compute_entry_locks()
+        self._compute_acquires()
 
     # -- summary fixpoints ---------------------------------------------------
     def _functions(self):
@@ -659,8 +1041,12 @@ class ProgramIndex:
 
     def _compute_param_canon(self) -> None:
         """Unify each lock PARAMETER with the concrete lock its callers
-        pass: all callers agree -> the param canonicalizes to that
-        dotted key (participates in FTL012's meet); callers DISAGREE ->
+        pass — agreement judged on OBJECT-SENSITIVE identities (ISSUE
+        13), not source text: two callers spelling ``self._lock`` from
+        different classes pass two different lock objects and must
+        CONFLICT (FTL014), not unify.  All callers agree -> the param
+        canonicalizes (textual key when every caller is same-instance
+        self-dispatch, the qualified identity otherwise); disagree ->
         an FTL014 finding (the alias defeats lockset analysis)."""
         for rel, qname, fn, fid in self._functions():
             if not fn["lock_params"]:
@@ -674,10 +1060,14 @@ class ProgramIndex:
                 except ValueError:
                     continue
                 keys: Dict[str, List[str]] = {}
+                texts: Dict[str, str] = {}
+                fabricated: Set[str] = set()
                 complete = True
+                self_only = True
                 for caller, call in callers:
                     shift = 1 if call[1] and \
-                        call[1][0] in ("self", "cls", "super") else 0
+                        call[1][0] in ("self", "cls", "super",
+                                       "typed") else 0
                     k = None
                     for pos_or_name, lk in call[4]:
                         if pos_or_name == p or (
@@ -685,20 +1075,335 @@ class ProgramIndex:
                                 pos_or_name + shift == idx):
                             k = lk
                             break
+                    if k is not None and "." not in k and \
+                            k in (self.graph.function(caller) or
+                                  {}).get("params", ()):
+                        # The caller's OWN param passed through: its
+                        # concrete lock is whatever the caller's callers
+                        # pass — use the caller's canon when computed,
+                        # else UNKNOWN (a fabricated per-caller key here
+                        # would falsely conflict same-lock passthrough
+                        # wrappers).  One pass, no fixpoint: an
+                        # unresolved chain just stays un-canonicalized.
+                        k = self._param_canon.get(caller, {}).get(k)
                     if k is None:
                         complete = False
                     else:
-                        keys.setdefault(k, []).append(
+                        crel = caller.partition("::")[0]
+                        cfn = self.graph.function(caller)
+                        ccls = cfn.get("cls") if cfn else None
+                        if "#" in k:
+                            qs = [k]    # already a qualified identity
+                        else:
+                            qs = self.lock_identities(crel, ccls, k)
+                        if qs:
+                            qk = qs[0]
+                        else:
+                            # A lock with NO shared identity (caller's
+                            # function-local): the per-caller key below
+                            # serves grouping/conflict detection only —
+                            # it must never leak out as a canon value
+                            # (a fresh-per-call lock is not a concrete
+                            # identity two threads can contend on).
+                            qk = f"{caller}#{k}"
+                            fabricated.add(qk)
+                        keys.setdefault(qk, []).append(
                             f"{caller}:{call[0]}")
+                        texts.setdefault(qk, k)
+                        if not (call[1] and
+                                call[1][0] in ("self", "cls", "super")):
+                            self_only = False
                 if len(keys) == 1 and complete:
-                    self._param_canon.setdefault(fid, {})[p] = \
-                        next(iter(keys))
+                    qk = next(iter(keys))
+                    if qk not in fabricated:
+                        self._param_canon.setdefault(fid, {})[p] = \
+                            texts[qk] if self_only else qk
                 elif len(keys) >= 2:
                     self.param_conflicts.append(
                         (rel, qname, pline, p,
-                         {k: sorted(v) for k, v in keys.items()}))
+                         {qk: sorted(v) for qk, v in keys.items()}))
+
+    def _compute_returns_instance(self) -> None:
+        """returns-instance summary (ISSUE 13), LFP: a function every
+        return of which resolves to the SAME in-package class returns
+        an instance of it — constructor returns ground the fixpoint,
+        factory-through-factory chains converge by iteration.  Feeds
+        receiver-typed call resolution (``x = make(); x.m()``) and
+        FTL016's factory-created promises."""
+        ri = self.graph.returns_instance
+        cands = []
+        for rel, qname, fn, fid in self._functions():
+            rts = fn.get("rets_type") or []
+            if rts and all(t != "other" for t in rts):
+                cands.append((fid, rel, fn.get("cls"), rts))
+        changed = True
+        while changed:
+            changed = False
+            for fid, rel, cls, rts in cands:
+                if fid in ri:
+                    continue
+                vals = {self.graph.resolve_type(rel, cls, list(t))
+                        for t in rts}
+                if len(vals) == 1:
+                    v = vals.pop()
+                    if v is not None:
+                        ri[fid] = v
+                        changed = True
+
+    # -- object-sensitive lock identity (ISSUE 13) ---------------------------
+    def lock_identities(self, rel: str, cls: Optional[str],
+                        key: str) -> List[str]:
+        """Identities for a textual lock key seen in (rel, cls), keyed
+        by (class, attr, instance role) instead of source text:
+
+          * ``self._lock`` -> ``<rel>::<AllocOwner>#_lock`` — the
+            allocation-site owner through the MRO, so Base and Sub
+            methods locking the inherited lock agree, while two CLASSES
+            each allocating a ``self._lock`` get distinct identities;
+          * ``self.a._lock`` -> the ROLE identity ``<rel>::<C>#a._lock``
+            (two instances held in different fields never alias) PLUS,
+            when ``a``'s class is known, the class-generic identity of
+            the rest rebased onto it (roles still participate in
+            class-level ordering — the AB/BA cycle through a field);
+          * a bare module-level lock -> ``<rel>#<name>``; a bare
+            function-local lock has NO shared identity (fresh per call)
+            and contributes nothing.
+        """
+        parts = key.split(".")
+        if parts[0] in ("self", "cls"):
+            if cls is None or len(parts) < 2:
+                return []
+            owner = self.graph.attr_owner(rel, cls, parts[1])
+            out = [f"{owner[0]}::{owner[1]}#{'.'.join(parts[1:])}"]
+            if len(parts) > 2:
+                t = self.graph.attr_type(rel, cls, parts[1])
+                if t is not None:
+                    out.extend(self.lock_identities(
+                        t[0], t[1], "self." + ".".join(parts[2:])))
+            return out
+        if len(parts) == 1 and \
+                key in self.facts.get(rel, {}).get("module_locks", ()):
+            return [f"{rel}#{key}"]
+        # Bare function-locals AND dotted non-self paths (a local
+        # instance's lock, a module-attr lock): no shared identity —
+        # keying them by source text would alias every same-spelled
+        # local across functions (false cycles, the unsound direction).
+        return []
+
+    def _acq_entry(self, rel: str, fn: dict, fid: str,
+                   key: str) -> Optional[tuple]:
+        """may-acquire entry for one direct acquisition: self-rooted
+        keys stay SYMBOLIC (rebound through the receiver role at each
+        call edge); module locks are concrete; canonicalized lock
+        params adopt their canon; locals contribute nothing."""
+        parts = key.split(".")
+        if parts[0] in ("self", "cls"):
+            return ("S", key)
+        if "." not in key:
+            if key in self.facts.get(rel, {}).get("module_locks", ()):
+                return ("C", f"{rel}#{key}")
+            if key in fn.get("lock_params", {}):
+                canon = self._param_canon.get(fid, {}).get(key)
+                if canon is None:
+                    return None
+                if "#" in canon:
+                    return ("C", canon)
+                if canon.split(".")[0] in ("self", "cls"):
+                    return ("S", canon)
+            return None
+        return None
+
+    def _transfer_entry(self, e: tuple, spec,
+                        target: str) -> List[tuple]:
+        """Transform one may-acquire entry of `target` into the frame
+        of a caller dispatching via `spec`: self-dispatch keeps the
+        symbol (same instance); ``self.X.m()`` rebinds ``self.`` to the
+        receiver role ``self.X.``; everything else concretizes to the
+        callee's own class-generic identities."""
+        if e[0] == "C":
+            return [e]
+        key = e[1]
+        k0 = spec[0] if spec else None
+        if k0 in ("self", "cls", "super"):
+            return [e]
+        if k0 == "typed" and spec[1][0] == "selfattr" and \
+                key.split(".")[0] == "self":
+            newkey = "self." + spec[1][1] + key[4:]
+            if newkey.count(".") <= 4:
+                return [("S", newkey)]
+        trel = target.partition("::")[0]
+        tfn = self.graph.function(target)
+        tcls = tfn.get("cls") if tfn else None
+        return [("C", i) for i in self.lock_identities(trel, tcls, key)]
+
+    def _compute_acquires(self) -> None:
+        """may-acquire, LFP with witnesses: every lock (identity or
+        self-rooted symbol) a function may take, directly or through
+        any chain of plain sync calls — awaited edges are FTL011's,
+        async bodies never run un-awaited (the may-block precedent)."""
+        T: Dict[str, Dict[tuple, tuple]] = {}
+        for rel, qname, fn, fid in self._functions():
+            d = {}
+            for line, key, held in fn.get("acquisitions", ()):
+                e = self._acq_entry(rel, fn, fid, key)
+                if e is not None and e not in d:
+                    d[e] = ("direct", line)
+            if d:
+                T[fid] = d
+        work = sorted(T)
+        in_work = set(work)
+        while work:
+            target = work.pop()
+            in_work.discard(target)
+            tfn = self.graph.function(target)
+            if tfn is None or tfn["async"]:
+                continue
+            entries = list(T.get(target, ()))
+            for caller, call in self.graph.callers.get(target, ()):
+                if call[3]:         # awaited edge
+                    continue
+                td = T.setdefault(caller, {})
+                added = False
+                for e in entries:
+                    for e2 in self._transfer_entry(e, call[1], target):
+                        if e2 not in td:
+                            td[e2] = ("call", call[0], target, e)
+                            added = True
+                if added and caller not in in_work:
+                    in_work.add(caller)
+                    work.append(caller)
+        self._acq = T
+
+    def lock_cycles(self) -> List[dict]:
+        """FTL015: the lock-order graph (edge A -> B = B acquired while
+        A held, directly or through the composed may-acquire summary,
+        on object-sensitive identities) and its elementary cycles.
+        Returns [{path, line, message}] — one per distinct cycle, each
+        edge carrying its acquisition-chain witness; cycles with no
+        witness site in a scanned file are dropped (nowhere to
+        report)."""
+        adj: Dict[str, Dict[str, tuple]] = {}
+
+        def add(src: str, dst: str, wit: tuple) -> None:
+            if src == dst:
+                return              # reentrant same-identity nesting
+                #                     (RLock, role self-aliasing): not
+                #                     an ORDERING hazard between locks
+            adj.setdefault(src, {}).setdefault(dst, wit)
+
+        def add_pair(held_ids: List[str], acq_ids: List[str],
+                     wit: tuple) -> None:
+            # Pair identities BY LEVEL — role-to-role and generic-to-
+            # generic (a lock's identity list runs role-most to
+            # generic-most) — never role-to-generic cross products:
+            # those duplicate every role-level cycle once more through
+            # the class-generic node.
+            if not held_ids or not acq_ids:
+                return
+            add(held_ids[0], acq_ids[0], wit)
+            add(held_ids[-1], acq_ids[-1], wit)
+
+        for rel, qname, fn, fid in sorted(self._functions(),
+                                          key=lambda t: t[3]):
+            cls = fn.get("cls")
+            canon = self._param_canon.get(fid, {})
+
+            def ids_of(key: str) -> List[str]:
+                k = canon.get(key, key)
+                if "#" in k:
+                    return [k]
+                return self.lock_identities(rel, cls, k)
+
+            for line, key, held in fn.get("acquisitions", ()):
+                for h in held:
+                    add_pair(ids_of(h), ids_of(key),
+                             (rel, fid, line, None, None))
+            for call, target in self.calls_with_targets(fid):
+                line, spec, locks, awaited = call[0], call[1], \
+                    call[2], call[3]
+                if not locks or target is None or awaited:
+                    continue
+                tfn = self.graph.function(target)
+                if tfn is None or tfn["async"]:
+                    continue
+                for e in self._acq.get(target, ()):
+                    for e2 in self._transfer_entry(e, spec, target):
+                        dqs = [e2[1]] if e2[0] == "C" else \
+                            self.lock_identities(rel, cls, e2[1])
+                        for h in locks:
+                            add_pair(ids_of(h), dqs,
+                                     (rel, fid, line, target, e))
+
+        cycles: List[List[str]] = []
+        seen_sets: Set[FrozenSet[str]] = set()
+
+        def dfs(start: str, cur: str, path: List[str]) -> None:
+            if len(cycles) >= 20:
+                return
+            for nxt in sorted(adj.get(cur, ())):
+                if nxt == start and len(path) >= 2:
+                    key = frozenset(path)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        cycles.append(list(path))
+                elif nxt > start and nxt not in path and len(path) < 6:
+                    dfs(start, nxt, path + [nxt])
+
+        for start in sorted(adj):
+            dfs(start, start, [start])
+
+        out = []
+        for cyc in cycles:
+            edges = []
+            for i, a in enumerate(cyc):
+                b = cyc[(i + 1) % len(cyc)]
+                edges.append((a, b, adj[a][b]))
+            site = next(((w[0], w[2]) for _, _, w in edges
+                         if w[0] in self.scanned), None)
+            if site is None:
+                continue
+            parts = []
+            for a, b, (wrel, wfid, wline, wtarget, wentry) in edges:
+                hop = f"{a} then {b} at {wfid} line {wline}"
+                if wtarget is not None:
+                    chain = self._acq_chain(wtarget, wentry)
+                    if chain:
+                        hop += " (via " + " -> ".join(chain) + ")"
+                parts.append(hop)
+            ring = " -> ".join(cyc + [cyc[0]])
+            out.append({
+                "path": site[0], "line": site[1],
+                "message": (
+                    f"lock-ordering cycle {ring}: " + "; ".join(parts) +
+                    " — threads interleaving these acquisition orders "
+                    "deadlock; impose one global order, or suppress "
+                    "with the reason the orders never run "
+                    "concurrently")})
+        return out
+
+    def _acq_chain(self, target: str, entry: tuple) -> List[str]:
+        """Render the acquisition chain behind one composed edge."""
+        out: List[str] = []
+        cur_f, cur_e = target, entry
+        for _ in range(12):
+            w = self._acq.get(cur_f, {}).get(cur_e)
+            if w is None:
+                break
+            if w[0] == "direct":
+                out.append(f"{cur_f} line {w[1]}: acquires")
+                break
+            out.append(f"{cur_f} line {w[1]}")
+            cur_f, cur_e = w[2], w[3]
+        return out
 
     # -- rule-facing queries -------------------------------------------------
+    def resolve_type(self, rel: str, cls_name: Optional[str],
+                     texpr) -> Optional[Tuple[str, str]]:
+        """(rel, class name) a type expression denotes — the local
+        type-inference result, resolved against the class tables and
+        returns-instance summaries (FTL016's promise classification)."""
+        return self.graph.resolve_type(rel, cls_name, list(texpr))
+
     def entry_locks(self, rel: str, qname: str) -> FrozenSet[str]:
         v = self._entry.get(CallGraph.fid(rel, qname))
         return v if v else frozenset()

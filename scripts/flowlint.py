@@ -8,6 +8,8 @@ key-type hazards (foundationdb_tpu/analysis/).
                                                     #   `git diff HEAD`
     python scripts/flowlint.py --changed main       # ... vs a ref
     python scripts/flowlint.py --format json        # machine-readable
+    python scripts/flowlint.py --format sarif       # SARIF 2.1.0 for
+                                                    #   PR annotations
     python scripts/flowlint.py --list-rules
     python scripts/flowlint.py --write-baseline     # grandfather current
     python scripts/flowlint.py --dump-callgraph     # resolved call edges
@@ -106,7 +108,12 @@ def main(argv=None) -> int:
                          "Cross-file checks (FTL007 schema drift) only "
                          "see the changed subset — the tier-1 gate "
                          "still runs the full scan")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
+                    help="output format; 'sarif' emits SARIF 2.1.0 "
+                         "(rule metadata + error-level results with "
+                         "witness chains in the message) for PR "
+                         "annotation pipelines")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline JSON path, or 'none' to disable "
                          f"(default: {DEFAULT_BASELINE})")
@@ -156,10 +163,13 @@ def main(argv=None) -> int:
             if args.dump_callgraph:
                 print("[]")         # no changed files: empty graph
                 return 0
-            from foundationdb_tpu.analysis.engine import LintResult
+            from foundationdb_tpu.analysis.engine import (LintResult,
+                                                          format_sarif)
             empty = LintResult()
             if args.format == "json":
                 print(json.dumps(empty.to_dict(), indent=2))
+            elif args.format == "sarif":
+                print(format_sarif(empty, make_rules()))
             else:
                 print(format_text(empty) +
                       f" (no .py changes vs {args.changed})")
@@ -183,7 +193,8 @@ def main(argv=None) -> int:
 
     try:
         baseline = load_baseline(baseline_path) if baseline_path else []
-        result = Analyzer(make_rules(),
+        rules = make_rules()
+        result = Analyzer(rules,
                           summary_cache=summary_cache).run(args.paths,
                                                            baseline)
     except Exception as e:  # noqa: BLE001 - CLI boundary: exit 2, not a trace
@@ -201,6 +212,9 @@ def main(argv=None) -> int:
 
     if args.format == "json":
         print(json.dumps(result.to_dict(), indent=2))
+    elif args.format == "sarif":
+        from foundationdb_tpu.analysis.engine import format_sarif
+        print(format_sarif(result, rules))
     else:
         print(format_text(result))
     return result.exit_code

@@ -1,0 +1,60 @@
+"""Known-bad battery for FTL015 lock-ordering cycles: the AB/BA
+two-class cycle (composed through receiver-typed calls — an
+annotation-typed back edge and an attribute-typed forward edge) and a
+three-lock module-level cycle."""
+
+import threading
+
+
+class Beta:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits = 0
+
+    def poke(self):
+        with self._lock:
+            self._hits += 1
+
+    def back(self, owner: Alpha):
+        with self._lock:
+            owner.grab()            # BAD half: Beta lock, then Alpha lock
+
+
+class Alpha:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._beta = Beta()
+        self._gen = 0
+
+    def grab(self):
+        with self._lock:
+            self._gen += 1
+
+    def forward(self):
+        with self._lock:
+            self._beta.poke()       # BAD half: Alpha lock, then Beta lock
+
+
+_ALPHA_LOCK = threading.Lock()
+_BRAVO_LOCK = threading.Lock()
+_CHARLIE_LOCK = threading.Lock()
+
+
+def take_ab():
+    with _ALPHA_LOCK:
+        with _BRAVO_LOCK:           # BAD: A then B ...
+            return 1
+
+
+def take_bc():
+    with _BRAVO_LOCK:
+        with _CHARLIE_LOCK:         # ... B then C ...
+            return 2
+
+
+def take_ca():
+    with _CHARLIE_LOCK:
+        with _ALPHA_LOCK:           # ... C then A: a three-lock cycle
+            return 3
+
+# expect: FTL015:35 FTL015:45

@@ -1,0 +1,59 @@
+"""Receiver-typed dispatch battery (ISSUE 13): ``obj.method()`` calls
+resolved through the local type-inference pass — constructor
+assignment, class-typed attribute, factory return, annotation — feed
+the interprocedural rules (here: FTL013's transitive blocking, which
+an unknown callee could never reach); an AMBIGUOUS receiver stays an
+unknown callee and must invent nothing."""
+
+import threading
+
+
+class Engine:
+    def wait_done(self, fut):
+        return fut.result()         # the unbounded block
+
+    def wait_bounded(self, fut, timeout):
+        return fut.result(timeout=timeout)
+
+
+class OtherEngine:
+    def wait_done(self, fut):
+        return fut.result(timeout=1.0)
+
+
+def make_engine():
+    return Engine()
+
+
+class Caller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._eng = Engine()
+
+    def bad_attr_typed(self, fut):
+        with self._lock:
+            return self._eng.wait_done(fut)     # BAD: selfattr-typed
+
+    def bad_ctor_typed(self, fut):
+        eng = Engine()
+        with self._lock:
+            return eng.wait_done(fut)           # BAD: constructor-typed
+
+    def bad_factory_typed(self, fut):
+        eng = make_engine()
+        with self._lock:
+            return eng.wait_done(fut)           # BAD: factory-typed
+
+    def ok_annotation_bounded(self, eng: Engine, fut):
+        with self._lock:
+            return eng.wait_bounded(fut, 1.0)   # clean: timeout wrapper
+
+    def ok_ambiguous(self, flip, fut):
+        if flip:
+            eng = Engine()
+        else:
+            eng = OtherEngine()
+        with self._lock:
+            return eng.wait_done(fut)           # clean: receiver unknown
+
+# expect: FTL013:35 FTL013:40 FTL013:45

@@ -1,0 +1,43 @@
+"""Distilled core/futures.py write-end API for the FTL016 fixtures —
+the protocol surface only (send / send_error / break_promise / close
+resolve; get_future / is_set / pop / empty read)."""
+
+
+class Promise:
+    def __init__(self):
+        self.sent = False
+
+    def send(self, value=None):
+        self.sent = True
+
+    def send_error(self, e):
+        self.sent = True
+
+    def break_promise(self):
+        self.sent = True
+
+    def get_future(self):
+        return self
+
+    def is_set(self):
+        return self.sent
+
+
+class PromiseStream:
+    def __init__(self):
+        self.queue = []
+
+    def send(self, value=None):
+        self.queue.append(value)
+
+    def send_error(self, e):
+        self.queue = None
+
+    def close(self):
+        self.queue = None
+
+    def pop(self):
+        return self.queue
+
+    def empty(self):
+        return not self.queue

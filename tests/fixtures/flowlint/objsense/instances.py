@@ -1,0 +1,35 @@
+"""Two-instance no-alias battery (ISSUE 13): a CLEAN file.
+
+Two instances of one class held in different fields share the
+attribute NAME ``self._lock`` but are different lock objects.  With
+name-keyed identities, ``cross()`` — a's lock held while b's method
+locks b — reads as ``self._lock`` nested inside ``self._lock``: a
+spurious self-cycle.  Object-sensitive identities key the two roles
+apart (``Pair#a._lock`` vs ``Pair#b._lock``), and since only the
+a-then-b order exists, there is no cycle and NOTHING fires."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def locked_op(self):
+        with self._lock:
+            self._n += 1
+
+
+class Pair:
+    def __init__(self):
+        self.a = Worker()
+        self.b = Worker()
+
+    def cross(self):
+        with self.a._lock:
+            self.b.locked_op()      # clean: a-before-b is the ONLY order
+
+    def cross_again(self):
+        with self.a._lock:
+            self.b.locked_op()      # clean: same direction, still acyclic

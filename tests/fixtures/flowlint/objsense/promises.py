@@ -1,0 +1,69 @@
+"""FTL016 promise-protocol battery: a locally created Promise/
+PromiseStream must be sent, broken, or handed off on EVERY path —
+the ISSUE-10 deposed-CC bug class (a parked reply neither sent nor
+broken hangs its waiter until GC luck).  Factory-created promises are
+tracked through the returns-instance summary; escapes (stored, passed,
+returned) transfer ownership and satisfy the protocol."""
+
+from .flowstub import Promise, PromiseStream
+
+
+def make_reply():
+    return Promise()
+
+
+class Server:
+    def __init__(self):
+        self.waiters = []
+        self.value = 0
+
+    def ok_sent_on_all_paths(self, ready):
+        p = Promise()
+        if ready:
+            p.send(self.value)
+        else:
+            p.send_error(RuntimeError("not ready"))
+        return p.get_future()
+
+    def ok_broken_on_miss(self, ready):
+        p = Promise()
+        if ready:
+            p.send(self.value)
+        else:
+            p.break_promise()
+        return p.get_future()
+
+    def bad_leaked_on_one_branch(self, ready):
+        p = Promise()               # BAD: not-ready branch forgets p
+        if ready:
+            p.send(self.value)
+        return p.get_future()
+
+    def bad_factory_leak(self, ready):
+        p = make_reply()            # BAD: early return forgets p
+        if not ready:
+            return None
+        p.send(self.value)
+        return p.get_future()
+
+    def ok_escapes_into_registry(self):
+        p = Promise()
+        self.waiters.append(p)      # handed off: the registry owns it
+        return p.get_future()
+
+    def ok_returned_whole(self):
+        p = Promise()
+        return p                    # handed off: the caller owns it
+
+    def bad_stream_never_closed(self):
+        s = PromiseStream()         # BAD: popped, never closed/handed off
+        fut = s.pop()
+        return fut
+
+    def ok_stream_closed(self):
+        s = PromiseStream()
+        s.send(1)
+        s.close()
+        return s.pop()
+
+# expect: FTL016:37 FTL016:43 FTL016:59

@@ -32,7 +32,7 @@ from foundationdb_tpu.analysis.rules import make_rules
 
 EXPECT = re.compile(r"(FTL\d{3}):(\d+)")
 
-N_RULES = 14    # FTL001..FTL014 (FTL000 = unparseable-file pseudo-rule)
+N_RULES = 16    # FTL001..FTL016 (FTL000 = unparseable-file pseudo-rule)
 
 
 def _scan(roots, baseline=None):
@@ -1108,11 +1108,13 @@ def test_changed_mode_links_unchanged_program(tmp_path):
 def test_run_chaos_embeds_new_rules():
     """run_chaos embeds findings by SHELLING the CLI, so the new rules
     ride along automatically: --list-rules (the same rule registry the
-    embedded scan uses) must carry FTL013/FTL014, and collect_flowlint
+    embedded scan uses AND the tier-1 clean-repo gate runs) must carry
+    FTL013/FTL014 and the ISSUE-13 FTL015/FTL016, and collect_flowlint
     must return the CLI's counts for the clean repo."""
     out = subprocess.run([sys.executable, FLOWLINT, "--list-rules"],
                          capture_output=True, text=True)
     assert "FTL013" in out.stdout and "FTL014" in out.stdout
+    assert "FTL015" in out.stdout and "FTL016" in out.stdout
     import importlib.util
     spec_mod = importlib.util.spec_from_file_location(
         "run_chaos", os.path.join(REPO, "scripts", "run_chaos.py"))
@@ -1122,6 +1124,738 @@ def test_run_chaos_embeds_new_rules():
     assert doc["exit_code"] == 0, doc
     assert doc["counts"]["new"] == 0
     assert doc["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# Object-sensitive engine (ISSUE 13): type inference, lock identity,
+# FTL015 lock-ordering cycles, FTL016 promise protocol
+# ---------------------------------------------------------------------------
+
+OBJSENSE = os.path.join(FIXTURES, "objsense")
+
+
+def test_objsense_fixture_exact_both_directions():
+    """The object-sensitivity fixture package scanned ALONE: findings
+    == markers exactly, both ways — two-instance no-alias stays CLEAN,
+    the AB/BA and three-lock cycles fire, the receiver-typed dispatch
+    battery resolves (and its ambiguous case stays quiet), and the
+    promise-protocol battery fires exactly on its leaks."""
+    exp = set()
+    for dirpath, dirnames, filenames in os.walk(OBJSENSE):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn),
+                                  OBJSENSE).replace(os.sep, "/")
+            with open(os.path.join(dirpath, fn)) as f:
+                for line in f:
+                    if "# expect:" in line:
+                        for m in EXPECT.finditer(line):
+                            exp.add((m.group(1), rel, int(m.group(2))))
+    assert {"FTL013", "FTL015", "FTL016"} <= {r for r, _, _ in exp}
+    result = _scan([OBJSENSE])
+    got = {(f.rule, f.path, f.line) for f in result.new}
+    assert got == exp, (f"unexpected: {sorted(got - exp)}\n"
+                        f"missing: {sorted(exp - got)}")
+
+
+def test_type_inference_lattice(tmp_path):
+    """The local type-inference lattice: constructor assignments,
+    annotations, factory returns (through the returns-instance
+    fixpoint, incl. factory-through-factory), and self-attribute types
+    each resolve a receiver-typed call; a join of two different types
+    is UNKNOWN (the call stays unresolved and keeps feeding the
+    conservatism set)."""
+    pkg = _write_pkg(tmp_path, {
+        "eng.py": """\
+            class Engine:
+                def op(self):
+                    return 1
+
+            class Other:
+                def op(self):
+                    return 2
+
+            def make():
+                return Engine()
+
+            def chain():
+                return make()
+
+            class Holder:
+                def __init__(self):
+                    self.eng = Engine()
+
+                def via_attr(self):
+                    self.eng.op()
+
+            def via_ctor():
+                e = Engine()
+                e.op()
+
+            def via_ann(e: Engine):
+                e.op()
+
+            def via_factory():
+                e = make()
+                e.op()
+
+            def via_chained_factory():
+                e = chain()
+                e.op()
+
+            def ambiguous(c):
+                if c:
+                    e = Engine()
+                else:
+                    e = Other()
+                e.op()
+            """})
+    pi = _program(pkg)
+    g = pi.graph
+
+    def targets_of(qname):
+        return [t for _, t in
+                pi.calls_with_targets(f"eng.py::{qname}") if t]
+
+    for fn in ("via_ctor", "via_ann", "via_factory",
+               "via_chained_factory"):
+        assert "eng.py::Engine.op" in targets_of(fn), fn
+    assert "eng.py::Engine.op" in targets_of("Holder.via_attr")
+    assert targets_of("ambiguous") == []    # join of two types: unknown
+    assert "op" in g.unresolved_names       # ... and stays conservative
+    # The returns-instance fixpoint behind the factory cases.
+    assert g.returns_instance["eng.py::make"] == ("eng.py", "Engine")
+    assert g.returns_instance["eng.py::chain"] == ("eng.py", "Engine")
+    # resolve_type unit shapes.
+    assert g.resolve_type("eng.py", None, ["call", "name", "Engine"]) \
+        == ("eng.py", "Engine")
+    assert g.resolve_type("eng.py", "Holder", ["selfattr", "eng"]) == \
+        ("eng.py", "Engine")
+    assert g.resolve_type("eng.py", None, ["ann", "name", "Other"]) == \
+        ("eng.py", "Other")
+    assert g.resolve_type("eng.py", None,
+                          ["call", "name", "nonesuch"]) is None
+
+
+def test_returns_instance_judges_names_at_their_def_site(tmp_path):
+    """Review catch: tracing `return y` through `y = x` must read x's
+    defs as of the ASSIGNMENT, not the return — a rebind of x in
+    between (`y = x; x = Other(); return y`) would otherwise re-type
+    the factory to the wrong class (the unsound direction: wrongly
+    resolved callees can silence real findings)."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            class Promise:
+                def send(self, v=None):
+                    pass
+
+            class Database:
+                def op(self):
+                    pass
+
+            def make():
+                x = Promise()
+                y = x
+                x = Database()
+                return y
+            """})
+    pi = _program(pkg)
+    assert pi.graph.returns_instance.get("m.py::make") == \
+        ("m.py", "Promise")
+
+
+def test_typed_resolution_preserves_seeding(tmp_path):
+    """The motivating precision win: a RESOLVED receiver-typed call no
+    longer poisons same-named functions out of caller-held-lockset
+    seeding (before ISSUE 13 every obj.method() was an unknown callee
+    whose terminal name disqualified the whole name)."""
+    pkg = _write_pkg(tmp_path, {
+        "s.py": """\
+            import threading
+
+            class Engine:
+                def op(self):
+                    return 1
+
+            class Guarded:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def _op(self):
+                    self._n += 1
+
+                def run(self, eng: Engine):
+                    eng.op()
+                    with self._lock:
+                        self._op()
+            """})
+    pi = _program(pkg)
+    assert "op" not in pi.graph.unresolved_names
+    assert pi.entry_locks("s.py", "Guarded._op") == \
+        frozenset({"self._lock"})
+
+
+def test_lock_identity_role_keying(tmp_path):
+    """Instance-role keying: the allocation-site owner unifies an
+    inherited lock across Base/Sub frames; two instances held in
+    different FIELDS get distinct role identities (plus the shared
+    class-generic identity for class-level ordering); module locks are
+    file-scoped; a function-local lock has no shared identity."""
+    pkg = _write_pkg(tmp_path, {
+        "w.py": """\
+            import threading
+
+            _MOD_LOCK = threading.Lock()
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+            class SubWorker(Worker):
+                def sub_op(self):
+                    with self._lock:
+                        return 1
+
+            class Pair:
+                def __init__(self):
+                    self.a = Worker()
+                    self.b = Worker()
+
+                def use(self):
+                    with self.a._lock:
+                        return 1
+
+            def local_only():
+                tmp_lock = threading.Lock()
+                with tmp_lock:
+                    return 1
+            """})
+    pi = _program(pkg)
+    assert pi.lock_identities("w.py", "Worker", "self._lock") == \
+        ["w.py::Worker#_lock"]
+    assert pi.lock_identities("w.py", "SubWorker", "self._lock") == \
+        ["w.py::Worker#_lock"]      # inherited: the ALLOCATING class
+    ia = pi.lock_identities("w.py", "Pair", "self.a._lock")
+    ib = pi.lock_identities("w.py", "Pair", "self.b._lock")
+    assert ia[0] == "w.py::Pair#a._lock"
+    assert ib[0] == "w.py::Pair#b._lock"
+    assert "w.py::Worker#_lock" in ia and "w.py::Worker#_lock" in ib
+    assert pi.lock_identities("w.py", None, "_MOD_LOCK") == \
+        ["w.py#_MOD_LOCK"]
+    assert pi.lock_identities("w.py", None, "tmp_lock") == []
+
+
+_TWO_INSTANCE_ONE_WAY = """\
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def locked_op(self):
+            with self._lock:
+                self._n += 1
+
+    class Pair:
+        def __init__(self):
+            self.a = Worker()
+            self.b = Worker()
+
+        def cross(self):
+            with self.a._lock:
+                self.b.locked_op()
+"""
+
+
+def test_two_instance_conflation_silenced_and_real_cycle_fires(tmp_path):
+    """The deleted-fix regression for the conflation FTL015 was built
+    to avoid: one-directional nesting between two same-class instances
+    is CLEAN (name-keyed identities would read it as a self-cycle),
+    while adding the REVERSE direction creates a true role-level AB/BA
+    cycle that fires."""
+    pkg = _write_pkg(tmp_path, {"p.py": _TWO_INSTANCE_ONE_WAY})
+    result = _scan([str(pkg)])
+    assert [f for f in result.new if f.rule == "FTL015"] == [], \
+        [f.message for f in result.new]
+
+    pkg2 = tmp_path / "pkg2"
+    pkg2.mkdir()
+    (pkg2 / "__init__.py").write_text("")
+    (pkg2 / "p.py").write_text(textwrap.dedent(
+        _TWO_INSTANCE_ONE_WAY +
+        "\n"
+        "        def cross_rev(self):\n"
+        "            with self.b._lock:\n"
+        "                self.a.locked_op()\n"))
+    result = _scan([str(pkg2)])
+    ftl15 = [f for f in result.new if f.rule == "FTL015"]
+    assert len(ftl15) == 1, [f.message for f in result.new]
+    msg = ftl15[0].message
+    assert "Pair#a._lock" in msg and "Pair#b._lock" in msg
+
+
+_PR10_SHAPE_HEAD = """\
+    class Promise:
+        def send(self, value=None):
+            pass
+
+        def send_error(self, e):
+            pass
+
+        def get_future(self):
+            return self
+
+    class CC:
+        def __init__(self):
+            self.db_info = {}
+
+        def handle_open_database(self, known_epoch, epoch):
+            reply = Promise()
+            if epoch > known_epoch:
+                reply.send(self.db_info)
+"""
+
+
+def test_ftl016_refires_on_pr10_promise_leak_shape(tmp_path):
+    """The deleted-fix regression for the PR-10 bug class: a deposed
+    CC's long-poll reply neither sent nor broken on the parked branch
+    (distilled).  The leaky shape fires; the PR-10 fix shape (the
+    explicit break on the other branch) is silent."""
+    pkg = _write_pkg(tmp_path, {
+        "cc.py": _PR10_SHAPE_HEAD + """\
+            return reply.get_future()
+        """})
+    result = _scan([str(pkg)])
+    ftl16 = [f for f in result.new if f.rule == "FTL016"]
+    assert [(f.path, f.line) for f in ftl16] == [("cc.py", 16)], \
+        [f.message for f in result.new]
+
+    pkg2 = tmp_path / "pkg2"
+    pkg2.mkdir()
+    (pkg2 / "__init__.py").write_text("")
+    (pkg2 / "cc.py").write_text(textwrap.dedent(
+        _PR10_SHAPE_HEAD + """\
+            else:
+                reply.send_error(RuntimeError("deposed"))
+            return reply.get_future()
+        """))
+    result = _scan([str(pkg2)])
+    assert [f for f in result.new if f.rule == "FTL016"] == [], \
+        [f.message for f in result.new]
+
+
+def test_repo_promise_paths_are_clean_shapes():
+    """The cleanup-sweep anchors: the repo files carrying the PR-10
+    fixes and the closure-escape promise patterns (scheduler delay,
+    threadpool run, both network send_request paths) lint FTL016-clean
+    when scanned directly — each was a triaged false-positive class
+    (closure hand-off / finally-break) the analysis must keep
+    understanding."""
+    for rel in ("server/cluster_controller.py", "core/scheduler.py",
+                "core/threadpool.py", "rpc/network.py",
+                "rpc/real_network.py"):
+        target = os.path.join(REPO, "foundationdb_tpu", *rel.split("/"))
+        result = _scan([target])
+        bad = [f for f in result.new if f.rule in ("FTL015", "FTL016")]
+        assert bad == [], (rel, [f"{f.line} {f.rule}" for f in bad])
+
+
+def test_param_canon_is_object_sensitive(tmp_path):
+    """Two callers spelling the textually-identical ``self._lock`` from
+    DIFFERENT classes pass two different lock objects: the parameter
+    must CONFLICT (FTL014), not silently unify — the two-instances-
+    one-name fiction FTL012/013/014 were re-grounded away from."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            import threading
+
+            def _locked_add(use_lock, n):
+                with use_lock:
+                    return n + 1
+
+            def _locked_solo(use_lock, n):
+                with use_lock:
+                    return n + 1
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def go(self):
+                    return _locked_add(self._lock, 1)
+
+                def solo(self):
+                    return _locked_solo(self._lock, 1)
+
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def go(self):
+                    return _locked_add(self._lock, 2)
+            """})
+    pi = _program(pkg)
+    assert [(c[1], c[3]) for c in pi.param_conflicts] == \
+        [("_locked_add", "use_lock")]
+    # One class only: unifies — on the qualified identity, since the
+    # callee's frame has no `self` binding for the caller's object.
+    assert pi.param_canon("m.py", "_locked_solo") == \
+        {"use_lock": "m.py::A#_lock"}
+
+
+def test_summary_cache_stamp_invalidates_on_analysis_upgrade(tmp_path):
+    """ISSUE 13 satellite: cache entries are keyed by (content hash,
+    analysis-version stamp).  A cache whose entries carry an OLDER
+    stamp but matching hashes must be treated as stale — its facts
+    predate the current extractor (here: simulated by stripping the
+    ISSUE-13 keys), and serving them would silence FTL016 for the
+    unchanged helper file."""
+    pkg = _write_pkg(tmp_path, {
+        "h.py": """\
+            class Promise:
+                def send(self, value=None):
+                    pass
+
+                def get_future(self):
+                    return self
+
+            def make_reply():
+                return Promise()
+            """,
+        "m.py": """\
+            from .h import make_reply
+
+            def serve(ready):
+                p = make_reply()
+                if ready:
+                    p.send(1)
+                return p.get_future()
+            """})
+    cache = str(tmp_path / "cache.json")
+    args = [sys.executable, FLOWLINT, "--baseline", "none",
+            "--summary-cache", cache, str(pkg / "m.py")]
+    out = subprocess.run(args, capture_output=True, text=True)
+    assert out.returncode == 1 and "FTL016" in out.stdout, \
+        out.stdout + out.stderr
+    # Doctor the cache into a pre-upgrade one: stamps roll back, the
+    # ISSUE-13 fact keys vanish, hashes stay CORRECT.
+    with open(cache) as f:
+        doc = json.load(f)
+    for entry in doc["files"].values():
+        entry["stamp"] = 1
+        for fn in entry["facts"]["functions"].values():
+            fn.pop("rets_type", None)
+            fn.pop("leaks", None)
+            fn.pop("acquisitions", None)
+    with open(cache, "w") as f:
+        json.dump(doc, f)
+    out = subprocess.run(args, capture_output=True, text=True)
+    assert out.returncode == 1 and "FTL016" in out.stdout, (
+        "stale-stamp cache entry was served: " + out.stdout + out.stderr)
+
+
+def test_local_instance_locks_have_no_shared_identity(tmp_path):
+    """Review catch: two functions each nesting their OWN fresh
+    instances' locks in opposite orders share no lock object — the
+    textual fallback identity for dotted non-self keys aliased them
+    into a false FTL015 cycle; function-local paths now contribute no
+    identity at all."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+            def f():
+                a, b = W(), W()
+                with a._lock:
+                    with b._lock:
+                        return 1
+
+            def g():
+                a, b = W(), W()
+                with b._lock:
+                    with a._lock:
+                        return 1
+            """})
+    result = _scan([str(pkg)])
+    assert [f for f in result.new if f.rule == "FTL015"] == [], \
+        [f.message for f in result.new]
+
+
+def test_ftl016_exit_walk_stops_at_function_boundary(tmp_path):
+    """Review catch: the return-through-finally exemption walked past
+    the enclosing function, so a module-level try/finally around a WHOLE
+    def silenced every leak inside it."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            class Promise:
+                def send(self, v=None):
+                    pass
+
+                def get_future(self):
+                    return self
+
+            try:
+                def serve(ready):
+                    p = Promise()
+                    if ready:
+                        p.send(1)
+                    return p.get_future()
+            finally:
+                pass
+            """})
+    result = _scan([str(pkg)])
+    assert [(f.rule, f.line) for f in result.new] == [("FTL016", 10)], \
+        [f.message for f in result.new]
+
+
+def test_ftl016_sees_creations_inside_except_handlers(tmp_path):
+    """Review catch: handler entries are reachable only through the
+    (excluded) exception edges, so their own promise creations never
+    entered the fixpoint — a caught handler KEEPS RUNNING, so they seed
+    as entry points with empty facts."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            class Promise:
+                def send(self, v=None):
+                    pass
+
+                def get_future(self):
+                    return self
+
+            def serve(risky, ready):
+                try:
+                    risky()
+                except Exception:
+                    p = Promise()
+                    if ready:
+                        p.send(1)
+                    return p.get_future()
+                return None
+            """})
+    result = _scan([str(pkg)])
+    assert [(f.rule, f.line) for f in result.new] == [("FTL016", 12)], \
+        [f.message for f in result.new]
+
+
+def test_fresh_local_lock_param_never_fabricates_identity(tmp_path):
+    """Review catch: a per-call fresh local lock passed through
+    canonicalized lock params must not mint a shared 'concrete'
+    identity — two threads never contend on a lock created fresh per
+    invocation, so no FTL015 cycle can involve it."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            import threading
+
+            _MOD_LOCK = threading.Lock()
+
+            def _helper_acquire(use_lock):
+                with use_lock:
+                    return 1
+
+            def _helper_nested(use_lock):
+                with use_lock:
+                    with _MOD_LOCK:
+                        return 1
+
+            def f():
+                tmp_lock = threading.Lock()
+                with _MOD_LOCK:
+                    _helper_acquire(tmp_lock)
+                _helper_nested(tmp_lock)
+            """})
+    result = _scan([str(pkg)])
+    assert [f for f in result.new if f.rule == "FTL015"] == [], \
+        [f.message for f in result.new]
+
+
+def test_class_body_lock_attr_is_an_allocation_site(tmp_path):
+    """Review catch: `_lock = threading.Lock()` at CLASS BODY level is
+    one shared allocation site — Base and Sub methods passing
+    ``self._lock`` must unify on Base's identity, not conflict as two
+    per-class fabrications."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            import threading
+
+            def _helper(use_lock):
+                with use_lock:
+                    return 1
+
+            class Base:
+                _lock = threading.Lock()
+
+                def m(self):
+                    _helper(self._lock)
+
+            class Sub(Base):
+                def n(self):
+                    _helper(self._lock)
+            """})
+    pi = _program(pkg)
+    assert pi.lock_identities("m.py", "Sub", "self._lock") == \
+        ["m.py::Base#_lock"]
+    assert pi.param_conflicts == []
+    result = _scan([str(pkg)])
+    assert [f for f in result.new if f.rule == "FTL014"] == [], \
+        [f.message for f in result.new]
+
+
+def test_ftl016_fall_off_the_end_exit(tmp_path):
+    """Review catch: a conditional resolve as the LAST statement leaks
+    on the fall-through path — the exit is an EDGE out of the branch
+    test (which still has successors), so successor-less-node exit
+    detection alone missed the rule's own motivating shape."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            class Promise:
+                def send(self, v=None):
+                    pass
+
+                def send_error(self, e):
+                    pass
+
+            def leaky(ok):
+                p = Promise()
+                if ok:
+                    p.send(1)
+
+            def ok_both_branches(ready):
+                p = Promise()
+                if ready:
+                    p.send(1)
+                else:
+                    p.send_error(RuntimeError())
+            """})
+    result = _scan([str(pkg)])
+    assert [(f.rule, f.line) for f in result.new] == [("FTL016", 9)], \
+        [f.message for f in result.new]
+
+
+def test_passthrough_lock_param_does_not_conflict(tmp_path):
+    """Review catch: two wrappers forwarding their OWN param into a
+    shared locked helper must not read as two distinct fabricated
+    locks (false FTL014) — a forwarded param resolves through the
+    caller's canon or stays unknown."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            import threading
+
+            _MOD_LOCK = threading.Lock()
+
+            def _helper(use_lock):
+                with use_lock:
+                    return 1
+
+            def _w1(lk_lock):
+                return _helper(lk_lock)
+
+            def _w2(lk_lock):
+                return _helper(lk_lock)
+
+            def f():
+                _w1(_MOD_LOCK)
+                _w2(_MOD_LOCK)
+            """})
+    result = _scan([str(pkg)])
+    assert [f for f in result.new if f.rule == "FTL014"] == [], \
+        [f.message for f in result.new]
+
+
+def test_ftl016_return_inside_finalbody_is_an_exit(tmp_path):
+    """Review catch: a return INSIDE a finalbody exits the function
+    directly — its own try must not exempt it (there is no further
+    finally to resolve the promise)."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            class Promise:
+                def send(self, v=None):
+                    pass
+
+                def get_future(self):
+                    return self
+
+            def leaky_in_finally():
+                p = Promise()
+                try:
+                    pass
+                finally:
+                    return p.get_future()
+            """})
+    result = _scan([str(pkg)])
+    assert [(f.rule, f.line) for f in result.new] == [("FTL016", 9)], \
+        [f.message for f in result.new]
+
+
+def test_ftl016_return_through_finally(tmp_path):
+    """Review catch: a return inside try-with-finalbody completes
+    NORMALLY through the finalbody — the leak facts must ride that
+    path, so an unresolved promise still fires while one the finalbody
+    resolves stays quiet."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            class Promise:
+                def send(self, v=None):
+                    pass
+
+                def break_promise(self):
+                    pass
+
+                def get_future(self):
+                    return self
+
+            def leaky():
+                try:
+                    p = Promise()
+                    return p.get_future()
+                finally:
+                    pass
+
+            def healed():
+                try:
+                    p = Promise()
+                    return p.get_future()
+                finally:
+                    p.break_promise()
+            """})
+    result = _scan([str(pkg)])
+    assert [(f.rule, f.line) for f in result.new] == [("FTL016", 13)], \
+        [f.message for f in result.new]
+
+
+def test_cli_sarif_format():
+    """--format sarif: valid SARIF 2.1.0 shape — tool rule metadata for
+    the whole registry, error-level results with rule id + location,
+    and the FTL015 witness chain riding the message text."""
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--format", "sarif", "--baseline",
+         "none", OBJSENSE],
+        capture_output=True, text=True)
+    assert out.returncode == 1
+    doc = json.loads(out.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "flowlint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert {f"FTL{i:03d}" for i in range(1, N_RULES + 1)} <= rule_ids
+    assert run["results"], "fixtures must produce results"
+    for r in run["results"]:
+        assert r["level"] == "error"
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["region"]["startLine"] >= 1
+    ftl15 = [r for r in run["results"] if r["ruleId"] == "FTL015"]
+    assert ftl15 and " then " in ftl15[0]["message"]["text"]
+    assert ftl15[0]["locations"][0]["physicalLocation"][
+        "artifactLocation"]["uri"] == "cycles.py"
 
 
 # ---------------------------------------------------------------------------
