@@ -783,16 +783,25 @@ class SchedBenchPipeline:
     MAX_DEFERRALS = 3
 
     def __init__(self, predictor_on: bool, reorder_on: bool,
-                 repair_on: bool, keyspace: int) -> None:
+                 repair_on: bool, keyspace: int,
+                 max_attempts: int = 1, ladder_on: bool = False) -> None:
         from foundationdb_tpu.conflict.oracle import OracleConflictSet
         from foundationdb_tpu.sched.predictor import ConflictPredictor
+        from foundationdb_tpu.sched.repair import RepairLadder
         self.oracle = OracleConflictSet(0)
         self.pred = ConflictPredictor() if predictor_on else None
         self.reorder_on = reorder_on
         self.repair_on = repair_on
         self.keyspace = keyspace
+        # Repair ladder (ISSUE 14): TXN_REPAIR_MAX_ATTEMPTS analog +
+        # per-range version-clock backoff, mirroring the proxy's
+        # RepairLadder wiring exactly.
+        self.max_attempts = max(1, int(max_attempts))
+        self.ladder = RepairLadder(
+            backoff_versions=VERSIONS_PER_BATCH // 4) if ladder_on else None
         self.stats = {"committed": 0, "total": 0, "deferrals": 0,
-                      "repairs": 0, "repairs_ok": 0, "reorder_moved": 0}
+                      "repairs": 0, "repairs_ok": 0, "backed_off": 0,
+                      "reorder_moved": 0}
         self._deferred: list = []
         # Stages-off verdict codes per counted batch (parity guard).
         self.off_codes: list = []
@@ -835,9 +844,28 @@ class SchedBenchPipeline:
                     self.stats["committed"] += 1
                     if attempts:
                         self.stats["repairs_ok"] += 1
-            elif v == CommitResult.CONFLICT and self.repair_on and \
-                    repair_eligible(txn, attr.get(j) or [], j in attr,
-                                    attempts, 1):
+                if attempts and self.ladder is not None:
+                    # A repaired commit proves the range repairable
+                    # again: drop its rungs (proxy reply-loop analog).
+                    self.ladder.note_success(
+                        (r.begin, r.end) for r in txn.read_conflict_ranges)
+            elif v == CommitResult.CONFLICT and self.repair_on:
+                culprits = attr.get(j) or []
+                if attempts >= self.max_attempts and culprits and \
+                        self.ladder is not None:
+                    # Budget exhausted and STILL conflicted: back the
+                    # culprit range off (proxy _collect_repairs analog);
+                    # intermediate rungs keep climbing freely.
+                    self.ladder.note_failure(culprits, version)
+                if not repair_eligible(txn, culprits, j in attr,
+                                       attempts, self.max_attempts):
+                    continue
+                if attempts > 0 and self.ladder is not None and \
+                        not self.ladder.should_attempt(culprits, version):
+                    # Backoff gates ladder CLIMBS only; first repairs
+                    # stay unconditional (proxy analog).
+                    self.stats["backed_off"] += 1
+                    continue
                 e[0] = _dc.replace(txn, read_snapshot=version)
                 e[1] = attempts + 1
                 self.stats["repairs"] += 1
@@ -872,9 +900,16 @@ class SchedBenchPipeline:
         verdicts = None
         if admitted:
             verdicts = self._resolve(admitted, version, floor, repairs)
-        if repairs:
-            self._resolve(repairs, version + VERSIONS_PER_BATCH // 2,
-                          floor, [])
+        # Repair rungs: each failed re-resolve may retry once more (up to
+        # max_attempts) at a later sub-batch version — with max_attempts
+        # = 1 this is the original single follow-up batch.
+        rung = 1
+        step = VERSIONS_PER_BATCH // (self.max_attempts + 1)
+        while repairs and rung <= self.max_attempts:
+            nxt: list = []
+            self._resolve(repairs, version + rung * step, floor, nxt)
+            repairs = nxt
+            rung += 1
         return admitted, verdicts
 
     def drained(self) -> bool:
@@ -882,11 +917,12 @@ class SchedBenchPipeline:
 
 
 def run_sched_config(stream, keyspace, predictor_on, reorder_on,
-                     repair_on):
+                     repair_on, max_attempts=1, ladder_on=False):
     """One full pass of the shared stream through a stages
     configuration; returns (stats, elapsed_s, off_verdict_codes)."""
     pipe = SchedBenchPipeline(predictor_on, reorder_on, repair_on,
-                              keyspace)
+                              keyspace, max_attempts=max_attempts,
+                              ladder_on=ladder_on)
     off_codes = []
     t0 = time.perf_counter()
     steps = list(stream) + [(None, None, None, False)] * 4  # drain carries
@@ -937,7 +973,13 @@ def run_sched_bench() -> dict:
                    ("predictor", (True, False, False)),
                    ("reorder", (False, True, False)),
                    ("repair", (False, False, True)),
-                   ("all", (True, True, True))]
+                   ("all", (True, True, True)),
+                   # Repair LADDER (ISSUE 14): bounded multi-attempt
+                   # re-resolution with per-range version-clock backoff
+                   # (TXN_REPAIR_MAX_ATTEMPTS=3 analog) — alone and on
+                   # top of every other stage.
+                   ("ladder", (False, False, True, 3, True)),
+                   ("all+ladder", (True, True, True, 3, True))]
         best = {}
         for rep in range(max(1, SCHED_REPEATS)):
             for name, cfg in configs:
@@ -992,7 +1034,7 @@ def run_sched_bench() -> dict:
             low_stream.append((prev, version,
                                to_transactions(kids, snaps), True))
         low_stats, _el, _oc = run_sched_config(
-            low_stream, KEYSPACE_LOW, True, True, True)
+            low_stream, KEYSPACE_LOW, True, True, True, 3, True)
         commit_rate_low = low_stats["committed"] / max(
             low_stats["total"], 1)
         _phase(f"sched low-contention (all on): {commit_rate_low:.3f}")
@@ -1099,6 +1141,490 @@ def sched_main() -> None:
     doc["jax_backend"] = jax.default_backend()
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "BENCH_r09.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps(doc))
+
+
+# ---------------------------------------------------------------------------
+# `bench.py e2e` — end-to-end commits/s through the REAL-TCP cluster
+# (ISSUE 14): GRV -> commit proxy -> resolver -> TLog -> reply, measured
+# knobs-off then all-knobs-on (columnar wire frames + vectorized proxy
+# assembly via LIVE dynamic knobs, client GRV batching + read-version
+# lease locally) in ONE run, with per-stage latency-band attribution
+# from status cluster.latency_statistics.  `bench.py e2e --smoke` is the
+# in-process tier-1 parity gate: knobs-off wire images stay legacy,
+# columnar-on abort sets match columnar-off on the same stream, and the
+# sim pipeline commits bit-identically with vectorized assembly on.
+# ---------------------------------------------------------------------------
+
+E2E_PORT_BASE = int(os.environ.get("E2E_PORT_BASE", "47610"))
+E2E_PHASE_S = float(os.environ.get("E2E_PHASE_S", "12"))
+# Interleaved repeats, best-of per posture (the sched bench's protocol):
+# single off/on pairs are hostage to +-30% single-core box noise and to
+# cluster aging (snapshot rollovers, growing stores) biasing whichever
+# phase runs later.
+E2E_REPEATS = int(os.environ.get("E2E_REPEATS", "2"))
+# 32 concurrent committers: the client fan-in regime the GRV lease
+# targets, and deep enough CPU saturation that the wire/assembly savings
+# surface as throughput (8 clients is latency-bound on this 1-core box).
+E2E_CLIENTS = int(os.environ.get("E2E_CLIENTS", "32"))
+E2E_LEASE_S = float(os.environ.get("E2E_LEASE_S", "0.1"))
+E2E_BOOT_TIMEOUT_S = float(os.environ.get("E2E_BOOT_TIMEOUT", "180"))
+E2E_VALUE = b"v" * int(os.environ.get("E2E_VALUE_BYTES", "100"))
+E2E_KEYS_PER_TXN = int(os.environ.get("E2E_KEYS_PER_TXN", "3"))
+
+# Three stateless workers + a dedicated log-class worker so the commit
+# proxy, resolver and TLog land on DISTINCT processes (placement spreads
+# the stateless pool away from the master; the log class is FITNESS_BEST
+# for TLogs only): the hot proxy->resolver / proxy->TLog RPCs must cross
+# real sockets, not take the same-address local-delivery shortcut.
+_E2E_NAMES = {"coord0": (E2E_PORT_BASE, "stateless"),
+              "stateless1": (E2E_PORT_BASE + 1, "stateless"),
+              "stateless2": (E2E_PORT_BASE + 2, "stateless"),
+              "log0": (E2E_PORT_BASE + 3, "log"),
+              "storage0": (E2E_PORT_BASE + 4, "storage"),
+              "storage1": (E2E_PORT_BASE + 5, "storage")}
+
+
+def _e2e_spawn_cluster(base: str):
+    import shutil
+    shutil.rmtree(base, ignore_errors=True)
+    os.makedirs(base, exist_ok=True)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    coords = f"127.0.0.1:{E2E_PORT_BASE}"
+    config = json.dumps({"n_storage": 2, "min_workers": len(_E2E_NAMES)})
+    procs = {}
+    for name, (port, pclass) in _E2E_NAMES.items():
+        cmd = [sys.executable, "-m", "foundationdb_tpu.server.fdbserver",
+               "--port", str(port), "--coordinators", coords,
+               "--datadir", os.path.join(base, name), "--class", pclass,
+               "--config", config, "--name", name]
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+        procs[name] = subprocess.Popen(
+            cmd, cwd=repo, env=env,
+            stdout=open(os.path.join(base, name + ".out"), "wb"),
+            stderr=subprocess.STDOUT)
+    return procs, coords
+
+
+def _e2e_ready(loop, db, procs) -> None:
+    async def probe():
+        from foundationdb_tpu.core.scheduler import delay
+        t = db.create_transaction()
+        while True:
+            dead = {n: p.poll() for n, p in procs.items()
+                    if p.poll() is not None}
+            if dead:
+                raise RuntimeError(f"processes died at boot: {dead}")
+            try:
+                t.set(b"\x01e2e-boot-probe", b"up")
+                await t.commit()
+                return True
+            except Exception as e:  # noqa: BLE001 — boot races retry
+                try:
+                    await t.on_error(e)
+                except Exception:  # noqa: BLE001
+                    t = db.create_transaction()
+                    await delay(0.5)
+
+    loop.run_until(loop.spawn(probe()), timeout=E2E_BOOT_TIMEOUT_S)
+
+
+def _e2e_phase(loop, db, phase: str, phase_s: float, n_clients: int):
+    """Drive n_clients concurrent blind-write committers for phase_s;
+    returns (counts, elapsed_s)."""
+    counts = {"commits": 0, "conflicts": 0, "errors": 0}
+
+    async def committer(cid: int) -> None:
+        from foundationdb_tpu.core.error import FdbError
+        from foundationdb_tpu.core.scheduler import delay
+        from foundationdb_tpu.core.scheduler import now as _lnow
+        stop_at = _lnow() + phase_s
+        i = 0
+        while _lnow() < stop_at:
+            t = db.create_transaction()
+            # Keys recycle modulo a bounded working set: unbounded
+            # unique keys grow the store linearly and the per-poll DD
+            # shard-metrics walk (O(total keys)) with it — phases later
+            # in the run then measure store aging, not the pipeline.
+            base_key = b"e2e/%02d/%06d" % (cid, i % 1500)
+            i += 1
+            try:
+                # Read-modify-write: the read makes the txn GRV-bound
+                # (blind writes never fetch a read version at all), so
+                # the measured path is the FULL pipeline — GRV -> read
+                # -> commit -> resolve -> TLog.  Keys are
+                # committer-unique: zero expected aborts, so the
+                # low-contention abort set must stay empty in both
+                # phases.
+                await t.get(b"e2e/%02d/prev" % cid)
+                for j in range(E2E_KEYS_PER_TXN):
+                    t.set(base_key + b"/%d" % j, E2E_VALUE)
+                t.set(b"e2e/%02d/prev" % cid, base_key)
+                await t.commit()
+                counts["commits"] += 1
+            except FdbError as e:
+                if e.name == "not_committed":
+                    counts["conflicts"] += 1
+                try:
+                    await t.on_error(e)
+                except Exception:  # noqa: BLE001
+                    counts["errors"] += 1
+                    await delay(0.2)
+            except Exception:  # noqa: BLE001
+                counts["errors"] += 1
+                await delay(0.2)
+
+    async def drive() -> None:
+        from foundationdb_tpu.core.futures import wait_all
+        from foundationdb_tpu.core.scheduler import get_event_loop
+        actors = [get_event_loop().spawn(committer(c), f"e2e.committer{c}")
+                  for c in range(n_clients)]
+        await wait_all(actors)
+
+    t0 = time.perf_counter()
+    loop.run_until(loop.spawn(drive()), timeout=phase_s * 4 + 120)
+    return counts, time.perf_counter() - t0
+
+
+def _e2e_status(loop, db) -> dict:
+    async def go():
+        return await db.cluster.get_status()
+    return loop.run_until(loop.spawn(go()), timeout=60)
+
+
+def _e2e_band_totals(status_doc: dict) -> dict:
+    bands = (status_doc.get("cluster", {}) or {}).get(
+        "latency_statistics", {}) or {}
+    return {name: (int(b.get("count", 0)),
+                   float(b.get("mean", 0.0)) * int(b.get("count", 0)))
+            for name, b in bands.items()}
+
+
+def _e2e_attribution(before: dict, after: dict) -> dict:
+    """Per-stage {count, mean_ms} over one phase, by differencing the
+    lifetime band totals captured before/after it."""
+    out = {}
+    for name, (c2, t2) in sorted(after.items()):
+        c1, t1 = before.get(name, (0, 0.0))
+        dc = c2 - c1
+        if dc > 0:
+            out[name] = {"count": dc,
+                         "mean_ms": round((t2 - t1) / dc * 1000.0, 3)}
+    return out
+
+
+def _e2e_rpc_counters(status_doc: dict) -> dict:
+    groups = (status_doc.get("cluster", {}) or {}).get("metrics", {}) or {}
+    return dict(groups.get("Rpc", {}) or {})
+
+
+def run_e2e() -> dict:
+    """Boot the 4-process real-TCP cluster, measure commits/s knobs-off,
+    flip every ISSUE-14 knob live (server side via dynamic knobs, client
+    side locally), measure again, attribute stages."""
+    from foundationdb_tpu.client.database import open_cluster
+    from foundationdb_tpu.core.knobs import client_knobs
+    from foundationdb_tpu.core.scheduler import set_event_loop
+    from foundationdb_tpu.rpc.network import set_network
+
+    base = os.environ.get("E2E_BASEDIR", "/tmp/fdb_e2e_bench")
+    procs, coords = _e2e_spawn_cluster(base)
+    loop = None
+    try:
+        time.sleep(2.5)
+        dead = {n: p.poll() for n, p in procs.items()
+                if p.poll() is not None}
+        if dead:
+            raise RuntimeError(f"processes died at boot: {dead}")
+        loop, db = open_cluster(coords)
+        _e2e_ready(loop, db, procs)
+        _phase("e2e cluster up; warmup")
+
+        # Fresh worker metrics docs: per-phase stage attribution differs
+        # lifetime band totals, so the registration cadence bounds the
+        # sampling error at the phase edges.
+        async def fast_register():
+            from foundationdb_tpu.client.management import set_knob
+            await set_knob(db, "WORKER_REGISTER_INTERVAL_S", 2)
+        loop.run_until(loop.spawn(fast_register()), timeout=60)
+        _e2e_phase(loop, db, "warm", min(3.0, E2E_PHASE_S), 2)
+
+        def settled_status():
+            time.sleep(4.5)   # > 2x the registration interval
+            return _e2e_status(loop, db)
+
+        ck = client_knobs()
+
+        def set_posture(on: bool) -> None:
+            # Server knobs flip LIVE (dynamic-knob path: committed
+            # \xff/knobs/ rows, every worker's knob watch applies them
+            # without restart or recovery); client knobs locally — AND
+            # the local server-knob registry too: this client process
+            # encodes CommitTransactionRequest frames itself, and
+            # serde's gate reads the LOCAL registry (the dynamic-knob
+            # commit only reaches the workers' watches).
+            async def flip():
+                from foundationdb_tpu.client.management import set_knob
+                await set_knob(db, "RPC_COLUMNAR_ENABLED", int(on))
+                await set_knob(db, "PROXY_VECTORIZED_ASSEMBLY", int(on))
+            loop.run_until(loop.spawn(flip()), timeout=60)
+            from foundationdb_tpu.core.knobs import server_knobs
+            server_knobs().RPC_COLUMNAR_ENABLED = bool(on)
+            ck.GRV_BATCH_ENABLED = bool(on)
+            ck.GRV_LEASE_S = E2E_LEASE_S if on else 0.0
+            db._grv_lease = None
+
+        # Prove the columnar path actually engages before any ON window
+        # is measured (knob watch applied on every server); measuring
+        # phases labeled "on" over legacy frames would silently void
+        # the comparison, so a dead knob watch is a hard error.
+        set_posture(True)
+        deadline = time.monotonic() + 30.0
+        engaged = False
+        while time.monotonic() < deadline:
+            _e2e_phase(loop, db, "flip", 1.0, 1)
+            rpc = _e2e_rpc_counters(_e2e_status(loop, db))
+            if rpc.get("ColumnarFrames", 0) > 0:
+                engaged = True
+                break
+        if not engaged:
+            raise RuntimeError(
+                "columnar frames never appeared on the wire: dynamic "
+                "knob propagation is broken — refusing to measure")
+
+        # Interleaved repeats with ALTERNATING posture order (off,on /
+        # on,off / ...): residual drift (store warm-up, box noise) then
+        # lands symmetrically on both postures, and the reported figure
+        # is the MEAN across reps.  Each phase is bracketed by settled
+        # status captures for attribution (kept from its best rep).
+        acc = {"off": [], "on": []}
+        for rep in range(max(1, E2E_REPEATS)):
+            order = (("off", False), ("on", True))
+            if rep % 2:
+                order = order[::-1]
+            for name, on in order:
+                set_posture(on)
+                _e2e_phase(loop, db, "settle", 1.5, 2)   # posture settles
+                s_before = settled_status()
+                counts, elapsed = _e2e_phase(
+                    loop, db, f"{name}{rep}", E2E_PHASE_S, E2E_CLIENTS)
+                s_after = settled_status()
+                rate = counts["commits"] / max(elapsed, 1e-9)
+                _phase(f"e2e rep{rep} {name}: {rate:.1f} commits/s")
+                acc[name].append({"rate": rate, "counts": counts,
+                                  "before": s_before, "after": s_after})
+
+        def fold(phases):
+            mean = sum(p["rate"] for p in phases) / len(phases)
+            top = max(phases, key=lambda p: p["rate"])
+            counts = {k: sum(p["counts"][k] for p in phases)
+                      for k in phases[0]["counts"]}
+            return {"rate": mean, "counts": counts,
+                    "before": top["before"], "after": top["after"],
+                    "rates": [round(p["rate"], 1) for p in phases]}
+
+        off, on = fold(acc["off"]), fold(acc["on"])
+        doc = {
+            "metric": "e2e_commits_per_s",
+            "unit": "commits/s",
+            "regime": {"clients": E2E_CLIENTS, "phase_s": E2E_PHASE_S,
+                       "repeats": max(1, E2E_REPEATS),
+                       "keys_per_txn": E2E_KEYS_PER_TXN,
+                       "value_bytes": len(E2E_VALUE),
+                       "processes": len(procs),
+                       "lease_s": E2E_LEASE_S,
+                       "transport": "real-tcp"},
+            "commits_per_s": {"off": round(off["rate"], 1),
+                              "on": round(on["rate"], 1)},
+            "per_rep": {"off": off["rates"], "on": on["rates"]},
+            "speedup": round(on["rate"] / max(off["rate"], 1e-9), 3),
+            "counts": {"off": off["counts"], "on": on["counts"]},
+            "stage_attribution_ms": {
+                "off": _e2e_attribution(_e2e_band_totals(off["before"]),
+                                        _e2e_band_totals(off["after"])),
+                "on": _e2e_attribution(_e2e_band_totals(on["before"]),
+                                       _e2e_band_totals(on["after"]))},
+            "rpc_counters": _e2e_rpc_counters(on["after"]),
+            "grv_client_stats": dict(db.grv_stats),
+        }
+        if doc["speedup"] < 1.5:
+            print(f"# WARNING: e2e speedup {doc['speedup']} below the "
+                  "1.5x acceptance floor", file=sys.stderr)
+        return doc
+    finally:
+        for p in procs.values():
+            p.kill()
+        for p in procs.values():
+            p.wait()
+        from foundationdb_tpu.core.knobs import client_knobs as _ck
+        from foundationdb_tpu.core.knobs import server_knobs as _sk
+        _ck().GRV_BATCH_ENABLED = False
+        _ck().GRV_LEASE_S = 0.0
+        _sk().RPC_COLUMNAR_ENABLED = False
+        set_network(None)
+        if loop is not None:
+            set_event_loop(None)
+
+
+# -- `bench.py e2e --smoke`: the in-process tier-1 parity gate ---------------
+
+def _e2e_canonical_request():
+    """A fixed, fully-featured hot-RPC payload (also the golden-test
+    subject in tests/test_wire_columnar.py)."""
+    from foundationdb_tpu.server.interfaces import (
+        ResolveTransactionBatchRequest)
+    from foundationdb_tpu.txn.types import (CommitTransactionRef, KeyRange,
+                                            Mutation, MutationType)
+    txns = []
+    for i in range(4):
+        k = b"smoke/%04d" % i
+        txns.append(CommitTransactionRef(
+            read_conflict_ranges=[KeyRange(k, k + b"\x00")],
+            write_conflict_ranges=[KeyRange(k + b"/w", k + b"/w\x00")],
+            mutations=[Mutation(MutationType.SetValue, k + b"/w", b"v" * 8)],
+            read_snapshot=900 + i,
+            report_conflicting_keys=(i % 2 == 0),
+            tenant_id=(7 if i == 3 else -1),
+            tag=("hot" if i == 1 else "")))
+    return ResolveTransactionBatchRequest(
+        prev_version=900, version=1000, last_received_version=800,
+        transactions=txns, txn_state_transactions=[2],
+        proxy_id="proxy0", span="smoke-span")
+
+
+def _e2e_sim_commit_run(vectorized: bool):
+    """One deterministic sim-cluster commit run (6 actors x RMW
+    increments on a shared hot keyspace, conflicts guaranteed); returns
+    (per-actor outcome log, final counter values).  The vectorized knob
+    changes pure computation only — event interleavings are identical —
+    so the two runs must match exactly."""
+    from foundationdb_tpu.core.error import FdbError
+    from foundationdb_tpu.core.knobs import server_knobs
+    from foundationdb_tpu.core.rng import (DeterministicRandom,
+                                           set_deterministic_random)
+    from foundationdb_tpu.core.scheduler import set_event_loop
+    from foundationdb_tpu.rpc.sim import set_simulator
+    from foundationdb_tpu.server.cluster import SimCluster
+    sk = server_knobs()
+    saved = sk.PROXY_VECTORIZED_ASSEMBLY
+    sk.PROXY_VECTORIZED_ASSEMBLY = vectorized
+    set_deterministic_random(DeterministicRandom(424242))
+    try:
+        cl = SimCluster(n_resolvers=2, n_storage=2)
+        db = cl.database()
+        log = []
+
+        async def actor(aid: int) -> None:
+            for op in range(12):
+                key = b"ctr/%d" % ((aid + op) % 4)   # 4 hot keys
+                t = db.create_transaction()
+                for attempt in range(8):
+                    try:
+                        cur = await t.get(key)
+                        n = int(cur or b"0") + 1
+                        t.set(key, b"%d" % n)
+                        v = await t.commit()
+                        log.append((aid, op, "ok", n))
+                        break
+                    except FdbError as e:
+                        log.append((aid, op, e.name, attempt))
+                        await t.on_error(e)
+
+        async def go():
+            from foundationdb_tpu.core.futures import wait_all
+            await wait_all([cl.loop.spawn(actor(a), f"smoke.a{a}")
+                            for a in range(6)])
+            t = db.create_transaction()
+            final = [await t.get(b"ctr/%d" % i) for i in range(4)]
+            return final
+
+        final = cl.run_until(cl.loop.spawn(go()), timeout=120)
+        return log, final
+    finally:
+        sk.PROXY_VECTORIZED_ASSEMBLY = saved
+        set_simulator(None)
+        set_event_loop(None)
+
+
+def run_e2e_smoke() -> dict:
+    """Fast in-process parity gate (tier-1 via tests/test_e2e_bench.py):
+    (1) knobs-off hot-RPC wire images stay the LEGACY format and
+    round-trip; (2) columnar-on abort sets are identical to columnar-off
+    on the same contended stream (every batch round-trips the wire both
+    ways); (3) sim-pipeline commits are bit-identical with vectorized
+    assembly on."""
+    global TXNS_PER_BATCH
+    from foundationdb_tpu.conflict.oracle import OracleConflictSet
+    from foundationdb_tpu.core.knobs import server_knobs
+    from foundationdb_tpu.rpc import serde
+    from foundationdb_tpu.server.interfaces import (
+        ResolveTransactionBatchRequest)
+    serde.bootstrap_registry()
+    sk = server_knobs()
+    doc = {"metric": "e2e_smoke"}
+
+    # (1) knobs-off wire image: legacy tag, exact round trip.
+    assert not sk.RPC_COLUMNAR_ENABLED, "smoke requires default knobs"
+    req = _e2e_canonical_request()
+    blob = serde.encode_message(req)
+    assert blob[0] == serde.T_DATACLASS, "knobs-off frame not legacy!"
+    assert serde.decode_message(blob) == req
+    doc["legacy_wire"] = "ok"
+
+    # (2) columnar-on abort sets == columnar-off on the same stream.
+    saved_txns, TXNS_PER_BATCH = TXNS_PER_BATCH, 256
+    try:
+        rng = np.random.default_rng(1234)
+        oa, ob = OracleConflictSet(0), OracleConflictSet(0)
+        version = 1_000
+        checked = 0
+        for _ in range(6):
+            prev, version = version, version + VERSIONS_PER_BATCH
+            _enc, kids, snaps = gen_batch(rng, version, prev,
+                                          keyspace=2048)
+            txns = to_transactions(kids, snaps)
+            floor = max(0, version - WINDOW_BATCHES * VERSIONS_PER_BATCH)
+            wire = ResolveTransactionBatchRequest(
+                prev_version=prev, version=version,
+                last_received_version=prev, transactions=txns,
+                proxy_id="p0")
+            sk.RPC_COLUMNAR_ENABLED = False
+            off_req = serde.decode_message(serde.encode_message(wire))
+            sk.RPC_COLUMNAR_ENABLED = True
+            on_blob = serde.encode_message(wire)
+            on_req = serde.decode_message(on_blob)
+            sk.RPC_COLUMNAR_ENABLED = False
+            assert on_blob[0] == serde.T_COLUMNAR
+            assert off_req == on_req == wire
+            va = oa.resolve(off_req.transactions, version, floor)
+            vb = ob.resolve(on_req.transactions, version, floor)
+            assert va == vb, "abort sets diverge across wire formats"
+            checked += len(va)
+        doc["abort_set_parity_txns"] = checked
+    finally:
+        TXNS_PER_BATCH = saved_txns
+        sk.RPC_COLUMNAR_ENABLED = False
+
+    # (3) pipeline commits bit-identical with vectorized assembly.
+    log_off, final_off = _e2e_sim_commit_run(vectorized=False)
+    log_on, final_on = _e2e_sim_commit_run(vectorized=True)
+    assert final_off == final_on, "final state diverges"
+    assert log_off == log_on, "commit outcome log diverges"
+    doc["pipeline_parity_ops"] = len(log_off)
+    doc["parity"] = "ok"
+    return doc
+
+
+def e2e_main() -> None:
+    if "--smoke" in sys.argv:
+        print(json.dumps(run_e2e_smoke()))
+        return
+    doc = run_e2e()
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r10.json")
     with open(out, "w") as f:
         json.dump(doc, f, indent=1)
         f.write("\n")
@@ -1597,6 +2123,12 @@ def parent_main(backend: str) -> None:
 
 def main() -> None:
     backend = sys.argv[1] if len(sys.argv) > 1 else "tpu"
+    if backend == "e2e":
+        # End-to-end commits/s (ISSUE 14): real-TCP cluster off/on
+        # measurement writing BENCH_r10.json, or --smoke for the
+        # in-process tier-1 parity gate.
+        e2e_main()
+        return
     if backend == "sched":
         # Conflict-aware scheduling bench (ISSUE 12): in-process (the
         # oracle-model passes need no device budget machinery), writes

@@ -150,6 +150,37 @@ class Database:
         # Shadows this client already quarantined (by mirror endpoint):
         # no further comparison traffic is sent to a benched TSS.
         self._tss_quarantined: set = set()
+        # Read-version acquisition fast paths (ISSUE 14; both knob-gated,
+        # default off — the knobs-off client issues exactly one GRV per
+        # transaction as before):
+        #  - _grv_lease: (expires_at, reply) — a GRV_LEASE_S-bounded
+        #    cached read version (causal-read-risky: a leased version may
+        #    trail the latest commit; OCC still aborts stale read-write
+        #    conflicts, and this client's OWN commits bump the lease
+        #    floor so read-your-own-writes holds per client).
+        #  - _grv_batch: waiters of the in-flight client-side GRV batch
+        #    (reference readVersionBatcher): concurrent plain
+        #    transactions share one GetReadVersionRequest with
+        #    transaction_count = N.
+        self._grv_lease: Optional[Tuple[float, Any]] = None
+        self._grv_batch: Optional[List[Any]] = None
+        self._grv_refreshing = False
+        # This client's highest committed version: the floor below which
+        # a GRV reply must never ARM the lease (a reply resolved at the
+        # proxy before our commit can arrive after it — arming with it
+        # would break per-client read-your-own-writes while the lease
+        # was empty).
+        self._grv_commit_floor: Version = 0
+        # Lease hits not yet reported to the GRV plane: piggybacked on
+        # the NEXT real request's transaction_count, so the ratekeeper's
+        # released-rate accounting still sees the true transaction load.
+        # Without this the lease starves the release signal, the
+        # ratekeeper clamps tps to ~nothing whenever any spring dips,
+        # and the few real GRVs (lease refreshes included!) queue for
+        # seconds — measured as a ~2x e2e commits/s loss.
+        self._grv_leases_unreported = 0
+        self.grv_stats = {"leased": 0, "batched": 0, "requests": 0,
+                          "refreshes": 0}
 
     from ..rpc.endpoint import TRANSPORT_ERRORS as _FAILOVER_ERRORS
 
@@ -367,6 +398,180 @@ class Database:
         self._rr += 1
         return proxies[self._rr % len(proxies)]
 
+    # -- read-version acquisition (reference readVersionBatcher :2717) -------
+    def _read_version_future(self, priority: int, debug_id: str,
+                             tags: tuple, tenant_id: int) -> Future:
+        """One transaction's read-version future.  Plain requests
+        (DEFAULT priority, no tags/tenant/debug id) may be served from
+        the lease or folded into the client-side batch; everything else
+        — throttle tags, tenant identity, priorities, traced txns —
+        keeps its own request so proxy-side enforcement and the
+        scheduling predictor see the true identity."""
+        from ..core.futures import Promise
+        knobs = client_knobs()
+        plain = (priority == TransactionPriority.DEFAULT and not tags
+                 and tenant_id == -1 and not debug_id)
+        if plain:
+            reply = self._leased_read_version()
+            if reply is not None:
+                self.grv_stats["leased"] += 1
+                self._grv_leases_unreported += 1
+                p: Promise = Promise()
+                p.send(reply)
+                return p.get_future()
+            if knobs.GRV_BATCH_ENABLED:
+                p = Promise()
+                if self._grv_batch is None:
+                    self._grv_batch = [p]
+                    from ..core.scheduler import spawn
+                    spawn(self._flush_grv_batch(), "client.grvBatcher")
+                else:
+                    self.grv_stats["batched"] += 1
+                    self._grv_batch.append(p)
+                return p.get_future()
+        self.grv_stats["requests"] += 1
+        proxy = self._grv_proxy()
+        count = 1
+        if plain:
+            count += self._take_unreported_leases()
+        return RequestStream.at(
+            proxy.get_consistent_read_version.endpoint).get_reply(
+            GetReadVersionRequest(priority=priority, debug_id=debug_id,
+                                  transaction_count=count,
+                                  tags=tags, tenant_id=tenant_id))
+
+    def _take_unreported_leases(self) -> int:
+        n, self._grv_leases_unreported = self._grv_leases_unreported, 0
+        return n
+
+    async def _flush_grv_batch(self) -> None:
+        """Close the batching window, issue ONE GRV carrying the whole
+        batch's transaction_count (the ratekeeper budget charge stays
+        exact), fan the reply out to every waiter."""
+        from ..core.scheduler import delay
+        await delay(float(client_knobs().GRV_BATCH_TIMEOUT))
+        waiters, self._grv_batch = self._grv_batch or [], None  # flowlint: state -- owns the drained batch (swap pattern)
+        self.grv_stats["requests"] += 1
+        try:
+            proxy = self._grv_proxy()
+            reply = await RequestStream.at(
+                proxy.get_consistent_read_version.endpoint).get_reply(
+                GetReadVersionRequest(
+                    transaction_count=(len(waiters) +
+                                       self._take_unreported_leases())))
+        except BaseException as e:  # noqa: BLE001 — waiters must never
+            # hang: every promise gets the failure (retryable at each
+            # transaction's own retry loop); cancellation keeps unwinding.
+            for p in waiters:
+                if not p.is_set():
+                    p.send_error(err("request_maybe_delivered",
+                                     f"batched GRV failed: {e!r}"))
+            if not isinstance(e, Exception):
+                raise
+            return
+        self._note_grv_reply(reply)
+        for p in waiters:
+            if not p.is_set():
+                p.send(reply)
+
+    def _leased_read_version(self):
+        """The cached GRV reply while the lease is fresh, else None.
+        A hit in the BACK HALF of the window kicks one background
+        refresh, so under steady traffic the lease renews without any
+        transaction ever blocking on the expiry round trip (the
+        synchronous miss-burst — all committers stalling on one GRV at
+        once — measurably costs ~25% e2e commits/s)."""
+        lease_s = float(client_knobs().GRV_LEASE_S)
+        if lease_s <= 0.0 or self._grv_lease is None:
+            return None
+        from ..core.scheduler import now
+        expires, reply = self._grv_lease
+        t = now()
+        if t <= expires:
+            if t > expires - lease_s / 2 and not self._grv_refreshing:
+                self._grv_refreshing = True
+                from ..core.scheduler import spawn
+                spawn(self._refresh_lease(), "client.grvLeaseRefresh")
+            return reply
+        self._grv_lease = None
+        return None
+
+    async def _refresh_lease(self) -> None:
+        """Background lease renewal: one plain GRV whose reply re-arms
+        the window.  Failures are dropped — the next consumer then pays
+        the round trip like any lease miss."""
+        try:
+            self.grv_stats["requests"] += 1
+            self.grv_stats["refreshes"] += 1
+            proxy = self._grv_proxy()
+            reply = await RequestStream.at(
+                proxy.get_consistent_read_version.endpoint).get_reply(
+                GetReadVersionRequest(
+                    transaction_count=(1 +
+                                       self._take_unreported_leases())))
+            self._note_grv_reply(reply)
+        except FdbError:
+            pass
+        finally:
+            self._grv_refreshing = False
+
+    def _note_grv_reply(self, reply) -> None:
+        """Fold a genuine proxy reply into the lease (never synthetic
+        set_read_version futures — they lack the reply surface — and
+        never locked-database replies); the lease version only moves
+        forward.  Each reply object is folded AT MOST ONCE: a lease HIT
+        re-observes the cached reply at consumption, and letting that
+        refresh the expiry would slide the lease forever under
+        continuous traffic — the GRV_LEASE_S staleness bound must be
+        measured from a real proxy round trip."""
+        lease_s = float(client_knobs().GRV_LEASE_S)
+        if lease_s <= 0.0 or not hasattr(reply, "tag_throttles") or \
+                getattr(reply, "locked", False):
+            return
+        if getattr(reply, "_lease_noted", False):
+            return
+        reply._lease_noted = True
+        from ..core.scheduler import now
+        if reply.version < self._grv_commit_floor:
+            # Resolved at the proxy before our own latest commit:
+            # arming the (possibly empty) lease with it would serve
+            # later transactions a version below this client's writes.
+            import dataclasses as _dc
+            reply = _dc.replace(reply, version=self._grv_commit_floor)
+            reply._lease_noted = True
+        if self._grv_lease is not None and \
+                self._grv_lease[1].version > reply.version:
+            # The held version is newer (e.g. our own commit bumped the
+            # floor), but this FRESH round trip still proves recency:
+            # refresh the expiry on the newer held reply.
+            self._grv_lease = (now() + lease_s, self._grv_lease[1])
+            return
+        self._grv_lease = (now() + lease_s, reply)
+
+    def _note_commit_version(self, version: Version) -> None:
+        """This client's own commit bumps the lease floor so a later
+        leased transaction reads its writes (per-client causality; the
+        proxies reported the version to the master before the commit
+        reply, so `version` is a legal read version cluster-wide).  The
+        floor is tracked even while no lease is armed: an in-flight GRV
+        reply that RESOLVED before this commit may otherwise arm the
+        lease below it."""
+        if float(client_knobs().GRV_LEASE_S) <= 0.0:
+            return
+        if version > self._grv_commit_floor:
+            self._grv_commit_floor = version
+        if self._grv_lease is None:
+            return
+        expires, reply = self._grv_lease
+        if version > reply.version:
+            import dataclasses as _dc
+            bumped = _dc.replace(reply, version=version)
+            # The copy is lease-internal, not a fresh proxy round trip:
+            # it must never re-enter _note_grv_reply as "new" (expiry
+            # would slide; see there).
+            bumped._lease_noted = True
+            self._grv_lease = (expires, bumped)
+
     # -- location cache (reference getKeyLocation :2334) ---------------------
     async def get_key_location(self, key: bytes) -> List[Any]:
         cached = self._location_cache.lookup(key)
@@ -507,13 +712,10 @@ class Transaction:
                 trace_batch_event(
                     "TransactionDebug", self.debug_id,
                     "NativeAPI.getConsistentReadVersion.Before")
-            proxy = self.db._grv_proxy()
-            self._read_version = RequestStream.at(
-                proxy.get_consistent_read_version.endpoint).get_reply(
-                GetReadVersionRequest(priority=self.priority,
-                                      debug_id=self.debug_id,
-                                      tags=(self.tag,) if self.tag else (),
-                                      tenant_id=self.tenant_id))
+            self._read_version = self.db._read_version_future(
+                priority=self.priority, debug_id=self.debug_id,
+                tags=(self.tag,) if self.tag else (),
+                tenant_id=self.tenant_id)
         return self._read_version
 
     GRV_TIMEOUT = 5.0
@@ -544,7 +746,9 @@ class Transaction:
             from ..core.trace import trace_batch_event
             trace_batch_event("TransactionDebug", self.debug_id,
                               "NativeAPI.getConsistentReadVersion.After")
-        return f.get().version
+        reply = f.get()
+        self.db._note_grv_reply(reply)
+        return reply.version
 
     # Special keyspace (reference SpecialKeySpace.actor.h ConflictingKeys
     # module): boundary keys under this prefix with \x01 = range begin,
@@ -1021,6 +1225,7 @@ class Transaction:
             trace_batch_event("TransactionDebug", self.debug_id,
                               "NativeAPI.commit.After")
         self.committed_version = reply.version
+        self.db._note_commit_version(reply.version)
         from ..txn.types import make_versionstamp
         self._committed_stamp = make_versionstamp(reply.version,
                                                   reply.txn_batch_index)

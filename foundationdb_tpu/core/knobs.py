@@ -110,6 +110,14 @@ class ServerKnobs(KnobBase):
         # interval): how often every role's CounterCollection emits its
         # {group}Metrics + LatencyBand trace events (core/metrics.py).
         self.METRICS_EMIT_INTERVAL = 5.0
+        # REAL-mode periodic worker re-registration cadence (worker.py
+        # _stats_announce_loop): each refresh ships the process's
+        # metrics-registry export to the CC, so this bounds the staleness
+        # of cluster.latency_statistics / cluster.metrics on a real
+        # cluster.  Dynamic (re-read per tick) — `bench.py e2e` lowers it
+        # live for per-phase stage attribution.  Sim keeps its own fixed
+        # deterministic interval.
+        self.WORKER_REGISTER_INTERVAL_S = 30.0
 
         # Resolver (reference ServerKnobs.cpp:439)
         self.RESOLVER_STATE_MEMORY_LIMIT = 1_000_000
@@ -213,9 +221,41 @@ class ServerKnobs(KnobBase):
         # (c) Repair: opt-in server-side retry of staleness-only aborts
         # (re-stamp at a fresh read version, re-resolve) — at most this
         # many attempts per transaction before the abort goes back to
-        # the client.
+        # the client.  Values > 1 climb the repair LADDER
+        # (sched/repair.py RepairLadder): each failed re-resolve of a
+        # culprit range backs that RANGE off for BACKOFF_VERSIONS
+        # doubling per rung, so a range rewritten faster than one batch
+        # interval stops burning resolver round trips while cold ranges
+        # still repair at full speed.
         self.SCHED_REPAIR_ENABLED = False
         self.TXN_REPAIR_MAX_ATTEMPTS = 1
+        # Base per-range backoff after a ladder EXHAUSTS (all attempts
+        # spent, still conflicted), in versions — ~a quarter of a commit
+        # batch at the reference 1M versions/s cadence, doubling per
+        # repeat exhaustion, cleared by the next successful repair of
+        # the range.  Small by design: blocking a hot range for whole
+        # batches starves repair wholesale (measured in bench.py sched).
+        self.TXN_REPAIR_BACKOFF_VERSIONS = 250
+        self.TXN_REPAIR_LADDER_TABLE_MAX = 1024
+
+        # End-to-end commit hot path (ISSUE 14).  Both default OFF: the
+        # knobs-off pipeline is bit-identical (wire images golden-guarded,
+        # `bench.py e2e --smoke` parity gate in tier-1).
+        # Columnar wire frames for the two hottest RPCs
+        # (ResolveTransactionBatchRequest fragments, the TLog push, and
+        # the resolver's verdict reply): batch-level frames packing keys/
+        # ranges/versions as contiguous byte columns with shared-prefix
+        # truncation instead of per-object tagged dict encoding
+        # (rpc/serde.py).  Decoding is format-transparent regardless of
+        # this knob — a columnar-off peer still reads columnar frames and
+        # vice versa (mixed-format safe within one protocol version).
+        self.RPC_COLUMNAR_ENABLED = False
+        # Vectorized commit-proxy batch assembly: per-resolver clipped
+        # fragments and the TLog mutation stream built in one pass over
+        # flattened boundary arrays (bisect lookups, cached eligibility)
+        # instead of per-txn RangeMap walks — bit-identical output to the
+        # plain path (parity-tested).
+        self.PROXY_VECTORIZED_ASSEMBLY = False
 
         # Resolution plane (master recruitment): resolver count override —
         # 0 recruits DatabaseConfiguration.n_resolvers (the committed
@@ -341,7 +381,11 @@ class ClientKnobs(KnobBase):
     def __init__(self) -> None:
         super().__init__()
         self.MAX_BATCH_SIZE = 1000
-        self.GRV_BATCH_TIMEOUT = 0.005
+        # Client-side GRV batching window (GRV_BATCH_ENABLED): must stay
+        # BELOW the GRV round trip it amortizes — at 5ms (the old value)
+        # the added latency outweighed the saved requests on a local
+        # cluster (~2ms RTT), measured as a ~5% e2e commits/s LOSS.
+        self.GRV_BATCH_TIMEOUT = 0.001
         self.DEFAULT_BACKOFF = 0.01
         self.DEFAULT_MAX_BACKOFF = 1.0
         self.BACKOFF_GROWTH_RATE = 2.0
@@ -355,6 +399,25 @@ class ClientKnobs(KnobBase):
         # Fraction of reads against a TSS-paired primary that are also
         # mirrored to the shadow for comparison (1.0 = every read).
         self.TSS_SAMPLE_RATE = 1.0
+        # Client-side GRV batching (ISSUE 14; reference readVersionBatcher
+        # in NativeAPI.actor.cpp): concurrent transactions of one Database
+        # share a single GetReadVersionRequest (transaction_count = N)
+        # instead of each serializing on the GRV proxies.  Only "plain"
+        # requests batch (DEFAULT priority, no tags/tenant/debug id) so
+        # throttling and predictor identities stay per-request.  OFF by
+        # default: the knobs-off pipeline issues exactly one GRV per
+        # transaction, bit-identical to the pre-ISSUE-14 client.
+        self.GRV_BATCH_ENABLED = False
+        # Read-version LEASE (causal-read-risky, default off): a read
+        # version obtained from any GRV reply is cached and reused for up
+        # to this many seconds, so a hot client loop stops paying one GRV
+        # round trip per transaction.  CAVEAT: a leased version may be
+        # OLDER than the latest commit — the transaction still reads one
+        # consistent MVCC snapshot and OCC still aborts stale read-write
+        # conflicts, but a read-only transaction can miss writes
+        # committed inside the lease window (the reference's
+        # CAUSAL_READ_RISKY trade).  0 disables.
+        self.GRV_LEASE_S = 0.0
 
 
 class Knobs:

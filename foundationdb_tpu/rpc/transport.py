@@ -50,7 +50,13 @@ KIND_REPLY = 1
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     buf = b""
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            # close() raced this reader thread (EBADF/ECONNRESET at
+            # teardown): same as a clean peer close — end the frame
+            # loop instead of dying with an unhandled thread exception.
+            return None
         if not chunk:
             return None
         buf += chunk

@@ -21,13 +21,24 @@ The eligibility predicate is deliberately strict:
   repairable abort);
 * the attempt budget must not be exhausted.
 
-Pure functions, no clock, no RNG — callable from the proxy's commit
-path and from the bench's host-side pipeline model alike.
+Beyond the single re-resolution, ``RepairLadder`` implements the bounded
+multi-attempt ladder (``TXN_REPAIR_MAX_ATTEMPTS`` > 1): each FAILED
+re-resolution of a culprit range backs that RANGE off for
+``backoff_versions`` doubling per rung, on the commit-VERSION clock — no
+wall time, so the ladder is deterministic in simulation and identical in
+the bench's pipeline model.  A range rewritten faster than one batch
+interval stops burning resolver round trips after a couple of rungs,
+while cold ranges keep repairing at full speed; entries expire as the
+version clock passes them.
+
+Pure functions + a pure-state class, no clock, no RNG — callable from
+the proxy's commit path and from the bench's host-side pipeline model
+alike.
 """
 
 from __future__ import annotations
 
-from typing import Iterable, List, Sequence, Tuple
+from typing import Dict, Iterable, List, Sequence, Tuple
 
 
 def culprits_in_read_set(read_ranges: Sequence,
@@ -51,3 +62,82 @@ def repair_eligible(txn, culprits: List[Tuple[bytes, bytes]],
     if not exact or not culprits:
         return False
     return culprits_in_read_set(txn.read_conflict_ranges, culprits)
+
+
+class RepairLadder:
+    """Per-range repair backoff on the commit-version clock.
+
+    ``note_failure(culprits, version)`` is called when a repair
+    attempt's re-resolution STILL conflicted: every culprit range climbs
+    one rung and is blocked until ``version + backoff << (rung-1)``.
+    ``should_attempt(culprits, version)`` gates the next repair of any
+    transaction blaming a blocked range.  State is bounded by
+    ``table_max`` (expired entries trimmed first, then the
+    earliest-expiring — the least-blocked — so the hottest ranges keep
+    their rungs).  Deliberately version-driven: deterministic in
+    simulation, replayable in the bench model, and self-expiring as the
+    cluster's version clock advances."""
+
+    __slots__ = ("backoff_versions", "table_max", "_entries")
+
+    def __init__(self, backoff_versions: int = 1000,
+                 table_max: int = 1024) -> None:
+        self.backoff_versions = max(1, int(backoff_versions))
+        self.table_max = max(1, int(table_max))
+        # (begin, end) -> [blocked_until_version, rung]
+        self._entries: Dict[Tuple[bytes, bytes], list] = {}
+
+    def should_attempt(self, culprits: Iterable[Tuple[bytes, bytes]],
+                       version: int) -> bool:
+        entries = self._entries
+        for key in culprits:
+            ent = entries.get(key)
+            if ent is not None and version < ent[0]:
+                return False
+        return True
+
+    def note_failure(self, culprits: Iterable[Tuple[bytes, bytes]],
+                     version: int) -> None:
+        entries = self._entries
+        for key in culprits:
+            ent = entries.get(key)
+            if ent is None:
+                entries[key] = [version + self.backoff_versions, 1]
+            else:
+                rung = min(ent[1] + 1, 16)   # cap the shift, not the block
+                ent[0] = version + (self.backoff_versions << (rung - 1))
+                ent[1] = rung
+        if len(entries) > self.table_max:
+            self._trim(version)
+
+    def note_success(self, spans: Iterable[Tuple[bytes, bytes]]) -> None:
+        """A repair covering these read spans committed: drop the rungs
+        of every blocked range CONTAINED in them.  Containment, not
+        equality — entries are keyed by resolver-CLIPPED culprit
+        fragments (see culprits_in_read_set), so a straddling range's
+        fragments must still clear when the whole declared range
+        repairs."""
+        entries = self._entries
+        if not entries:
+            return
+        spans = list(spans)
+        if not spans:
+            return
+        for key in [k for k in entries
+                    if any(sb <= k[0] and k[1] <= se for sb, se in spans)]:
+            del entries[key]
+
+    def blocked_count(self, version: int) -> int:
+        return sum(1 for until, _ in self._entries.values()
+                   if version < until)
+
+    def _trim(self, version: int) -> None:
+        entries = self._entries
+        expired = [k for k, (until, _r) in entries.items()
+                   if until <= version]
+        for k in expired:
+            del entries[k]
+        if len(entries) > self.table_max:
+            for k in sorted(entries, key=lambda k: entries[k][0])[
+                    :len(entries) - self.table_max]:
+                del entries[k]

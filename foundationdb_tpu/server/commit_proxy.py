@@ -487,6 +487,13 @@ class CommitProxy:
                     # A server-side repair landed: the abort the client
                     # never saw became a commit one batch later.
                     self.metrics.counter("RepairSucceeded").add(1)
+                    ladder = getattr(self, "_repair_ladder", None)
+                    if ladder is not None:
+                        # The range proved repairable again: drop its
+                        # backoff rungs so later repairs flow.
+                        ladder.note_success(
+                            (r.begin, r.end) for r in
+                            req.transaction.read_conflict_ranges)
                     from ..core.coverage import test_coverage
                     test_coverage("ProxyTxnRepairCommitted")
                 req.reply.send(CommitID(version=commit_version,
@@ -546,23 +553,49 @@ class CommitProxy:
         attached) for the follow-up batch."""
         import dataclasses as _dc
 
-        from ..sched.repair import repair_eligible
+        from ..sched.repair import RepairLadder, repair_eligible
         knobs = server_knobs()
         max_attempts = int(knobs.TXN_REPAIR_MAX_ATTEMPTS)
+        ladder = getattr(self, "_repair_ladder", None)
+        if ladder is None:
+            ladder = self._repair_ladder = RepairLadder(
+                int(knobs.TXN_REPAIR_BACKOFF_VERSIONS),
+                int(knobs.TXN_REPAIR_LADDER_TABLE_MAX))
         out: List[CommitTransactionRequest] = []
         for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
             if verdict != CommitResult.CONFLICT or t_idx in tenant_errors:
                 continue
             if not getattr(req, "repair_eligible", False):
                 continue
+            attempt = getattr(req, "repair_attempt", 0)
+            culprits = conflict_ranges.get(t_idx) or []
+            if attempt >= max_attempts and culprits:
+                # The WHOLE attempt budget is spent and the re-resolve
+                # still conflicted: the culprit range is being rewritten
+                # faster than the ladder can climb — back the RANGE off
+                # so later transactions blaming it skip their ladders
+                # instead of burning doomed resolver round trips.
+                # Intermediate rungs do NOT back off: retrying them is
+                # exactly what the attempt budget is for.
+                ladder.note_failure(culprits, commit_version)
             if self.db_locked is not None and \
                     not getattr(req.transaction, "lock_aware", False):
                 continue   # the lock fence landed after admission
-            attempt = getattr(req, "repair_attempt", 0)
             if not repair_eligible(
-                    req.transaction, conflict_ranges.get(t_idx) or [],
+                    req.transaction, culprits,
                     conflict_exact.get(t_idx, False) and
                     t_idx in conflict_ranges, attempt, max_attempts):
+                continue
+            if attempt > 0 and \
+                    not ladder.should_attempt(culprits, commit_version):
+                # Ladder backoff gates CLIMBS only (rung 2+): the first
+                # repair of any abort stays unconditional (PR-12
+                # measured it profitable), but further rungs on a range
+                # whose ladders keep exhausting go back to the client
+                # instead of burning near-certain extra round trips.
+                self.metrics.counter("RepairBackedOff").add(1)
+                from ..core.coverage import test_coverage
+                test_coverage("ProxyRepairBackedOff")
                 continue
             self.metrics.counter("RepairAttempted").add(1)
             from ..core.coverage import test_coverage
@@ -586,6 +619,7 @@ class CommitProxy:
             "repairs_attempted": c("RepairAttempted").value,
             "repairs_succeeded": c("RepairSucceeded").value,
             "repairs_exhausted": c("RepairExhausted").value,
+            "repairs_backed_off": c("RepairBackedOff").value,
         }
 
     def _spawn(self, coro, name: str):
@@ -662,6 +696,9 @@ class CommitProxy:
         chain contiguous.  A transaction index is carried implicitly: the
         verdict array of resolver i aligns with the transactions we sent it;
         _determine_committed re-aligns via the returned index maps."""
+        if server_knobs().PROXY_VECTORIZED_ASSEMBLY:
+            return self._build_resolution_requests_vec(
+                batch, prev_version, commit_version)
         n = len(self.resolvers)
         requests = [ResolveTransactionBatchRequest(
             prev_version=prev_version, version=commit_version,
@@ -718,6 +755,102 @@ class CommitProxy:
                     requests[idx].txn_state_transactions.append(
                         len(requests[idx].transactions))
                 requests[idx].transactions.append(clipped)
+                index_maps[idx].append(t_idx)
+        return requests, index_maps
+
+    def _build_resolution_requests_vec(
+            self, batch: List[CommitTransactionRequest],
+            prev_version: Version, commit_version: Version
+    ) -> List[ResolveTransactionBatchRequest]:
+        """PROXY_VECTORIZED_ASSEMBLY fast path: same outputs as the plain
+        builder (parity-tested both ways), one pass per transaction.  The
+        plain path walks the key_resolvers RangeMap once to compute the
+        touched set and then AGAIN per (range, touched resolver) to clip —
+        with per-segment _eligible recomputation each time.  Here the
+        boundary arrays are bound once, each conflict range is walked
+        exactly once with bisect, each history tuple's eligible-resolver
+        list is computed once per batch (floor is batch-constant), and
+        the per-resolver fragments accrete directly into the request
+        lists."""
+        from bisect import bisect_right
+        n = len(self.resolvers)
+        requests = [ResolveTransactionBatchRequest(
+            prev_version=prev_version, version=commit_version,
+            last_received_version=self.last_resolved_version,
+            transactions=[], proxy_id=self.id) for _ in range(n)]
+        index_maps: List[List[int]] = [[] for _ in range(n)]
+        floor = commit_version - int(
+            server_knobs().MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+        sched_repair = bool(server_knobs().SCHED_REPAIR_ENABLED)
+        km = self.key_resolvers
+        bounds = km._bounds
+        values = km._values
+        end_key = km.end_key
+        nbounds = len(bounds)
+        elig_cache: Dict[tuple, List[int]] = {}
+        all_resolvers = list(range(n))
+        sysb = SYSTEM_KEYS_BEGIN
+        clear = MutationType.ClearRange
+        for t_idx, req in enumerate(batch):
+            txn = req.transaction
+            report_conflicts = txn.report_conflicting_keys or (
+                sched_repair and getattr(req, "repair_eligible", False))
+            is_state = any(
+                m.param1 >= sysb or
+                (m.type == clear and m.param2 > sysb)
+                for m in txn.mutations)
+            # One walk per conflict range: clip against the boundary
+            # arrays and append per eligible resolver as we go.
+            clipped_r: Dict[int, List[KeyRange]] = {}
+            clipped_w: Dict[int, List[KeyRange]] = {}
+            for ranges, sink in ((txn.read_conflict_ranges, clipped_r),
+                                 (txn.write_conflict_ranges, clipped_w)):
+                for r in ranges:
+                    b, e = r.begin, r.end
+                    if b >= e:
+                        continue
+                    i = bisect_right(bounds, b) - 1
+                    while i < nbounds:
+                        rb = bounds[i]
+                        if rb >= e:
+                            break
+                        re_ = bounds[i + 1] if i + 1 < nbounds else end_key
+                        cb = rb if rb > b else b
+                        ce = re_ if re_ < e else e
+                        if cb < ce:
+                            hist = values[i]
+                            elig = elig_cache.get(hist)
+                            if elig is None:
+                                elig = elig_cache[hist] = \
+                                    self._eligible(hist, floor)
+                            kr = KeyRange(cb, ce)
+                            for idx in elig:
+                                lst = sink.get(idx)
+                                if lst is None:
+                                    lst = sink[idx] = []
+                                lst.append(kr)
+                        i += 1
+            if is_state:
+                touched: Any = all_resolvers
+            else:
+                touched = set(clipped_r)
+                touched.update(clipped_w)
+                touched = sorted(touched) if touched else (0,)
+            tid = getattr(txn, "tenant_id", -1)
+            tag = getattr(txn, "tag", "")
+            for idx in touched:
+                reqs_idx = requests[idx]
+                clipped = CommitTransactionRef(
+                    read_conflict_ranges=clipped_r.get(idx, []),
+                    write_conflict_ranges=clipped_w.get(idx, []),
+                    mutations=list(txn.mutations) if is_state else [],
+                    read_snapshot=txn.read_snapshot,
+                    report_conflicting_keys=report_conflicts,
+                    tenant_id=tid, tag=tag)
+                if is_state:
+                    reqs_idx.txn_state_transactions.append(
+                        len(reqs_idx.transactions))
+                reqs_idx.transactions.append(clipped)
                 index_maps[idx].append(t_idx)
         return requests, index_maps
 
@@ -943,6 +1076,41 @@ class CommitProxy:
             self, batch: List[CommitTransactionRequest],
             verdicts: List[CommitResult], commit_version: Version
     ) -> Dict[Tag, List[Mutation]]:
+        if server_knobs().PROXY_VECTORIZED_ASSEMBLY:
+            messages = self._assign_mutations_vec(batch, verdicts,
+                                                  commit_version)
+        else:
+            messages = self._assign_mutations_plain(batch, verdicts,
+                                                    commit_version)
+        if getattr(self, "tss_mapping", None):
+            # TSS mirror tags (reference tssMapping routing): the shadow
+            # receives exactly its primary's stream.
+            from .interfaces import tss_tag as _tsst
+            tss_extra = {}
+            for tag, msgs in messages.items():
+                if tag in self.tss_mapping:
+                    tss_extra[_tsst(tag)] = msgs
+            messages.update(tss_extra)
+        if getattr(self, "region_replication", False):
+            # Mirror onto twin tags (region replication): the log routers
+            # pull twins from the primary TLogs and feed the remote plane
+            # (server/log_router.py).  TXS rides REMOTE_TXS so a failover
+            # can replay the epoch's metadata from the remote TLog.
+            from .interfaces import REMOTE_TXS_TAG
+            from .log_router import REMOTE_TAG_OFFSET, twin_tag
+            twins = {}
+            for tag, msgs in messages.items():
+                if tag == TXS_TAG:
+                    twins[REMOTE_TXS_TAG] = msgs
+                elif 0 <= tag < REMOTE_TAG_OFFSET:
+                    twins[twin_tag(tag)] = msgs
+            messages.update(twins)
+        return messages
+
+    def _assign_mutations_plain(
+            self, batch: List[CommitTransactionRequest],
+            verdicts: List[CommitResult], commit_version: Version
+    ) -> Dict[Tag, List[Mutation]]:
         messages: Dict[Tag, List[Mutation]] = {}
         for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
             if verdict != CommitResult.COMMITTED:
@@ -1025,29 +1193,107 @@ class CommitProxy:
                         # Cached range: the mutation also rides CACHE_TAG
                         # (reference CommitProxyServer.actor.cpp:959).
                         messages.setdefault(CACHE_TAG, []).append(m)
-        if getattr(self, "tss_mapping", None):
-            # TSS mirror tags (reference tssMapping routing): the shadow
-            # receives exactly its primary's stream.
-            from .interfaces import tss_tag as _tsst
-            tss_extra = {}
-            for tag, msgs in messages.items():
-                if tag in self.tss_mapping:
-                    tss_extra[_tsst(tag)] = msgs
-            messages.update(tss_extra)
-        if getattr(self, "region_replication", False):
-            # Mirror onto twin tags (region replication): the log routers
-            # pull twins from the primary TLogs and feed the remote plane
-            # (server/log_router.py).  TXS rides REMOTE_TXS so a failover
-            # can replay the epoch's metadata from the remote TLog.
-            from .interfaces import REMOTE_TXS_TAG
-            from .log_router import REMOTE_TAG_OFFSET, twin_tag
-            twins = {}
-            for tag, msgs in messages.items():
-                if tag == TXS_TAG:
-                    twins[REMOTE_TXS_TAG] = msgs
-                elif 0 <= tag < REMOTE_TAG_OFFSET:
-                    twins[twin_tag(tag)] = msgs
-            messages.update(twins)
+        return messages
+
+    def _assign_mutations_vec(
+            self, batch: List[CommitTransactionRequest],
+            verdicts: List[CommitResult], commit_version: Version
+    ) -> Dict[Tag, List[Mutation]]:
+        """PROXY_VECTORIZED_ASSEMBLY fast path: same message streams as
+        the plain assignment (parity-tested), built in one pass over the
+        key_servers boundary arrays with bisect point lookups and direct
+        per-tag list accretion — no setdefault([]) allocation per
+        mutation, no RangeMap method dispatch on the hot point-write
+        path.  System/metadata mutations (rare) run the exact plain-path
+        logic inline and refresh the boundary snapshot, since applying
+        metadata can edit the shard map mid-batch."""
+        from bisect import bisect_right
+        from .system_data import DISOWN_SHARD_PREFIX, disowned_spans
+        messages: Dict[Tag, List[Mutation]] = {}
+        ks = self.key_servers
+        bounds = ks._bounds
+        values = ks._values
+        sysb = SYSTEM_KEYS_BEGIN
+        set_vsk = MutationType.SetVersionstampedKey
+        set_vsv = MutationType.SetVersionstampedValue
+        set_val = MutationType.SetValue
+        clear = MutationType.ClearRange
+        caches = bool(self.storage_caches)
+        for t_idx, (req, verdict) in enumerate(zip(batch, verdicts)):
+            if verdict != CommitResult.COMMITTED:
+                continue
+            stamp = None   # built lazily per transaction
+            for m in req.transaction.mutations:
+                mt = m.type
+                if mt is set_vsk or mt is set_vsv:
+                    if stamp is None:
+                        from ..txn.types import make_versionstamp
+                        stamp = make_versionstamp(commit_version, t_idx)
+                    if mt is set_vsk:
+                        m = Mutation(set_val,
+                                     _splice_stamp(m.param1, stamp),
+                                     m.param2)
+                    else:
+                        m = Mutation(set_val, m.param1,
+                                     _splice_stamp(m.param2, stamp))
+                    mt = set_val
+                p1 = m.param1
+                if p1 >= sysb or (mt is clear and m.param2 > sysb):
+                    # Metadata side effects first (identical to the plain
+                    # path); the shard map may change under us — the
+                    # boundary arrays are mutated in place by set_range,
+                    # but re-bind defensively in case the map object was
+                    # swapped.
+                    for dtag, db_, de_ in disowned_spans(
+                            self.key_servers, m):
+                        messages.setdefault(dtag, []).append(Mutation(
+                            set_val, DISOWN_SHARD_PREFIX + db_, de_))
+                    if self._apply_metadata(m):
+                        messages.setdefault(TXS_TAG, []).append(m)
+                    ks = self.key_servers
+                    bounds = ks._bounds
+                    values = ks._values
+                if self.backup_active and p1 < sysb:
+                    bm = m
+                    if mt is clear and m.param2 > sysb:
+                        bm = Mutation(clear, p1, sysb)
+                    messages.setdefault(BACKUP_TAG, []).append(bm)
+                if mt is clear:
+                    p2 = m.param2
+                    i = bisect_right(bounds, p1) - 1
+                    nb = len(bounds)
+                    while i < len(values):
+                        rb = bounds[i]
+                        if rb >= p2:
+                            break
+                        re_ = bounds[i + 1] if i + 1 < nb else ks.end_key
+                        tags = values[i]
+                        if tags:
+                            cb = rb if rb > p1 else p1
+                            ce = re_ if re_ < p2 else p2
+                            clipped = Mutation(clear, cb, ce)
+                            for tag in tags:
+                                lst = messages.get(tag)
+                                if lst is None:
+                                    lst = messages[tag] = []
+                                lst.append(clipped)
+                        i += 1
+                    if caches:
+                        for b, e, cached in self.cached_ranges.intersecting(
+                                p1, m.param2):
+                            if cached:
+                                messages.setdefault(CACHE_TAG, []).append(
+                                    Mutation(clear, b, e))
+                else:
+                    tags = values[bisect_right(bounds, p1) - 1]
+                    if tags:
+                        for tag in tags:
+                            lst = messages.get(tag)
+                            if lst is None:
+                                lst = messages[tag] = []
+                            lst.append(m)
+                    if caches and self.cached_ranges.lookup(p1):
+                        messages.setdefault(CACHE_TAG, []).append(m)
         return messages
 
     # -- key server locations (reference :1488 doKeyServerLocationRequest) ---

@@ -198,9 +198,18 @@ def serve(port: int, coordinators: List[NetworkAddress], datadir: str,
         stalling remote failure detection that long."""
         import gc
         from ..core.scheduler import delay
+        n = 0
         while True:
             await delay(5.0)
-            gc.collect()
+            n += 1
+            # Full (gen-2) passes only every 6th tick: jax registers a
+            # gc callback that makes every FULL collection expensive
+            # (profiled as bursty multi-ms reactor stalls across all
+            # server processes under e2e load); young-generation passes
+            # still deliver broken-promise __del__s for recently
+            # dropped cycles, and the 30s full-pass bound keeps
+            # long-lived cycles from stalling failure detection.
+            gc.collect(2 if n % 6 == 0 else 1)
 
     proc.spawn(_gc_tick(), f"{proc.name}.gcTick")
     TraceEvent("FdbServerStarted").detail("Address", str(proc.address)) \
@@ -217,7 +226,8 @@ def main(argv=None) -> None:
                     help="comma-separated host:port list")
     ap.add_argument("--datadir", required=True)
     ap.add_argument("--class", dest="process_class", default="stateless",
-                    choices=["stateless", "storage", "coordinator"])
+                    choices=["stateless", "storage", "coordinator", "log",
+                             "transaction"])
     ap.add_argument("--config", default=None,
                     help="DatabaseConfiguration overrides as JSON")
     ap.add_argument("--name", default="")

@@ -331,8 +331,22 @@ class GrvProxy:
                     not self._wait_failure_actor.is_ready():
                 self._wait_failure_actor.cancel()
             return
-        self.stats["grvs"] += len(batch)
-        self.metrics.counter("TxnStarted").add(len(batch))
+        # Client-side GRV batching (ISSUE 14): one request may carry N
+        # transactions (transaction_count); released/started accounting
+        # charges the true count so the ratekeeper's smoothed-release
+        # rate stays exact (identical to len(batch) when every request
+        # carries count 1, i.e. with client batching off).
+        n_txns = 0
+        n_batched = 0
+        for req in batch:
+            c = max(1, int(getattr(req, "transaction_count", 1) or 1))
+            n_txns += c
+            if c > 1:
+                n_batched += 1
+        self.stats["grvs"] += n_txns
+        self.metrics.counter("TxnStarted").add(n_txns)
+        if n_batched:
+            self.metrics.counter("ClientBatchedGrvRequests").add(n_batched)
         # Separate bands: QueueWait ends at batch formation (_t0) — time
         # spent held under the ratekeeper budget — while GRVLatency is
         # the reply path from there (liveness confirm + master version

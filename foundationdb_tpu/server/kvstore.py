@@ -148,9 +148,11 @@ class KVStoreMemory(IKeyValueStore):
                       _U32.pack(zlib.crc32(blob + payload)))
         await f.sync()
         # Atomic promote (rename): old snapshot replaced only after sync.
-        self.fs.files[self.prefix + ".snap"] = f
-        self.fs.files.pop(self.prefix + ".snap.new", None)
-        f.name = self.prefix + ".snap"
+        # Via the filesystem's rename API — the old dict-poke here was
+        # sim-only and CRASHED real-mode storage at the first snapshot
+        # rollover (RealFileSystem.files is a listing, not the open-file
+        # table; flushed out by `bench.py e2e` write volume).
+        self.fs.rename(self.prefix + ".snap.new", self.prefix + ".snap")
         self.queue.pop(snap_seq)
         self._wal_bytes_since_snapshot = 0
         TraceEvent("KVStoreSnapshot").detail("Prefix", self.prefix).detail(

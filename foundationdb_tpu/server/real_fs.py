@@ -97,6 +97,20 @@ class RealFileSystem:
     def exists(self, name: str) -> bool:
         return os.path.exists(self._path(name))
 
+    def rename(self, old: str, new: str) -> None:
+        """Atomic promote via os.replace.  The moved file's open handle
+        stays valid (same inode); a previously-open handle of the
+        REPLACED target becomes an orphan (delete semantics) and is
+        dropped from the open-file table so later opens see the new
+        inode, never the orphan."""
+        f = self._open_files.pop(old, None)
+        os.replace(self._path(old), self._path(new))
+        if f is not None:
+            f.name = new
+            self._open_files[new] = f
+        else:
+            self._open_files.pop(new, None)
+
     def delete(self, name: str) -> None:
         # POSIX unlink semantics, same as SimFileSystem.delete: an already
         # OPEN handle stays valid (writes go to the orphaned inode).  A

@@ -201,11 +201,18 @@ class Resolver:
     SAMPLE_EVERY = 8
 
     def _sample_batch(self, transactions) -> None:
+        from itertools import chain
         heat = self.heat
+        n = 0
         for txn in transactions:
-            for r in txn.read_conflict_ranges + txn.write_conflict_ranges:
-                self._ranges_since_poll += 1
+            # chain() instead of list concatenation: this runs per range
+            # per batch on the resolve hot path, and the throwaway
+            # concat list was measurable at production batch sizes.
+            for r in chain(txn.read_conflict_ranges,
+                           txn.write_conflict_ranges):
+                n += 1
                 heat.sample_load(r.begin, r.end)
+        self._ranges_since_poll += n
 
     def _record_conflict_heat(self, transactions, committed,
                               conflict_set, n_conflicts: int) -> None:

@@ -273,6 +273,20 @@ class SimFileSystem:
     def exists(self, name: str) -> bool:
         return name in self.files
 
+    def rename(self, old: str, new: str) -> None:
+        """Atomic promote (POSIX rename semantics): `old` replaces any
+        existing `new`; a previously-open handle of the replaced target
+        keeps writing its orphaned inode (same as delete)."""
+        f = self.files.pop(old, None)
+        if f is None:
+            raise err("operation_failed", f"no such file {old}")
+        f.name = new
+        self.files[new] = f
+        # Fault rules are name-keyed: re-evaluate for the new name.
+        for substr, profile in self._fault_rules:
+            if substr in new:
+                f.faults = profile
+
     def delete(self, name: str) -> None:
         self.files.pop(name, None)
 

@@ -91,6 +91,13 @@ def collect_latency_bands(info, worker_docs=()) -> Dict[str, Any]:
         ("resolver_resolve", res, "Resolver", "Resolve"),
         ("tlog_append", tlogs, "TLog", "Append"),
         ("tlog_durable", tlogs, "TLog", "DurableWait"),
+        # Hot-RPC serialization cost (ISSUE 14, rpc/serde.py "Rpc"
+        # collection): real clusters only — the bands ride the worker
+        # metrics docs (sim passes objects, no serialization, no roles
+        # to backref), so e2e stage attribution can decompose encode/
+        # decode time instead of hiding it in queue waits.
+        ("rpc_encode", [], "Rpc", "Encode"),
+        ("rpc_decode", [], "Rpc", "Decode"),
         ("storage_read", ss, "StorageServer", "ReadLatency"),
         ("storage_fetch", ss, "StorageServer", "TLogPeek"),
         ("tpu_dispatch", backends, "TpuBackend", "Dispatch"),

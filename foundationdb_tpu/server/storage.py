@@ -224,6 +224,20 @@ class StorageServer:
         # the updateStorage actor batches them into the engine.
         self.engine = engine
         self._durable_pending: List[Tuple[Version, int, bytes, bytes]] = []
+        # True un-durable BYTES for the ratekeeper's storage write-queue
+        # spring.  queue_bytes used to be durability lag in VERSIONS x 64
+        # — harmless in simulation (virtual time, tiny lags) but
+        # catastrophic on real clusters, where versions advance at 1M/s
+        # WALL CLOCK: any fsync latency over ~8ms read as >500KB of
+        # "queue" against the STORAGE_LIMIT_BYTES target and the spring
+        # intermittently clamped cluster tps to ~released*0+1 (found via
+        # bench.py e2e GRV queue waits of 100ms-2s on an unloaded plane).
+        self._durable_pending_bytes = 0
+        # Bytes of the batch currently inside the engine commit/fsync:
+        # still un-durable, so still part of the reported queue — a
+        # large batch stuck in a slow fsync is exactly when the spring
+        # must see the backlog.
+        self._flushing_bytes = 0
         # Engine-migration support (perpetual wiggle): the hosting worker
         # injects a factory `name -> (new_engine, cleanup_old_files)`;
         # the swap itself happens inside _update_storage_loop so it is
@@ -339,17 +353,23 @@ class StorageServer:
                 "Begin", begin).detail("End", end).detail(
                 "Version", version).log()
 
+    def _queue_durable(self, version: Version, op: int, a: bytes,
+                       b) -> None:
+        self._durable_pending.append((version, op, a, b))
+        self._durable_pending_bytes += \
+            len(a) + (len(b) if b is not None else 0) + 16
+
     def _apply_direct(self, m: Mutation, version: Version) -> None:
         self.stats["mutations"] += 1
         if m.type == MutationType.SetValue:
             self.data.set(m.param1, m.param2, version)
             if self.engine is not None:
-                self._durable_pending.append((version, 0, m.param1, m.param2))
+                self._queue_durable(version, 0, m.param1, m.param2)
             self._trigger_watch(m.param1)
         elif m.type == MutationType.ClearRange:
             self.data.clear_range(m.param1, m.param2, version)
             if self.engine is not None:
-                self._durable_pending.append((version, 1, m.param1, m.param2))
+                self._queue_durable(version, 1, m.param1, m.param2)
             for key in list(self._watches):
                 if m.param1 <= key < m.param2:
                     self._trigger_watch(key)
@@ -361,7 +381,7 @@ class StorageServer:
                 # Atomics are resolved once here; the engine logs the result
                 # (reference: the SS update path expands atomic ops before
                 # the versioned data reaches updateStorage).
-                self._durable_pending.append((version, 0, m.param1, result))
+                self._queue_durable(version, 0, m.param1, result)
             self._trigger_watch(m.param1)
         else:
             TraceEvent("SSUnknownMutation", Severity.Warn).detail(
@@ -437,6 +457,8 @@ class StorageServer:
             if target <= dv.get():
                 continue
             batch, self._durable_pending = self._durable_pending, []
+            self._flushing_bytes = self._durable_pending_bytes
+            self._durable_pending_bytes = 0
             try:
                 for _v, op, a, b in batch:
                     if op == 0:
@@ -448,6 +470,7 @@ class StorageServer:
                         self.engine.clear(a, b)
                 self.engine.set(_META_KEY, self._meta_blob(target))
                 await self.engine.commit()
+                self._flushing_bytes = 0
             except Exception as e:  # noqa: BLE001
                 # A dying durability actor must be LOUD: if this loop
                 # silently stopped, durable_version would freeze and TLog
@@ -462,8 +485,10 @@ class StorageServer:
                     # chaos tests verify (coverage ledger, ISSUE 4).
                     from ..core.coverage import test_coverage
                     test_coverage("StorageIoErrorDeath")
+                import traceback
                 TraceEvent("SSUpdateStorageError", Severity.Error).detail(
-                    "Id", self.id).detail("Error", repr(e)).log()
+                    "Id", self.id).detail("Error", repr(e)).detail(
+                    "Where", traceback.format_exc()[-600:]).log()
                 if self._process is not None and \
                         hasattr(self._process, "die"):
                     self._process.die(f"SSUpdateStorageError:{e!r}")
@@ -571,13 +596,13 @@ class StorageServer:
             # (reference fetchKeys clears the range before loading).
             self.data.clear_range(req.begin, req.end, vf)
             if self.engine is not None:
-                self._durable_pending.append((vf, 1, req.begin, req.end))
+                self._queue_durable(vf, 1, req.begin, req.end)
             for k, v in reply.data:
                 c = self.data._chains.get(k)
                 if c is None or c[-1][0] <= vf:
                     self.data.set(k, v, vf)
                     if self.engine is not None:
-                        self._durable_pending.append((vf, 0, k, v))
+                        self._queue_durable(vf, 0, k, v)
             for version, m in fetch.buffer:
                 # Effects at versions <= vf are already inside the snapshot.
                 if version > vf:
@@ -648,8 +673,7 @@ class StorageServer:
         self.shards.set_range(req.begin, req.end, ("absent", 0))
         self.data.clear_range(req.begin, req.end, self.version.get())
         if self.engine is not None:
-            self._durable_pending.append(
-                (self.version.get(), 1, req.begin, req.end))
+            self._queue_durable(self.version.get(), 1, req.begin, req.end)
         req.reply.send(None)
 
     # At most this many per-tag rows ride one queuing-metrics reply (tags
@@ -702,7 +726,10 @@ class StorageServer:
         self._shard_read_bytes = {}
         self._read_window_start = t
         req.reply.send(StorageQueuingMetricsReply(
-            queue_bytes=lag * 64,            # approx bytes per version
+            # TRUE un-durable bytes (was durability lag in versions x 64,
+            # which on real clusters measured wall-clock fsync latency
+            # against a byte target — see _durable_pending_bytes note).
+            queue_bytes=self._durable_pending_bytes + self._flushing_bytes,
             durability_lag=lag,
             stored_bytes=len(self.data),
             busiest_read_tag=busiest_tag,
@@ -797,6 +824,9 @@ class StorageServer:
             self.durable_version = NotifiedVersion(recovery_version)
             self._durable_pending = [
                 e for e in self._durable_pending if e[0] <= recovery_version]
+            self._durable_pending_bytes = sum(
+                len(a) + (len(b) if b is not None else 0) + 16
+                for _v, _op, a, b in self._durable_pending)
             # Re-image unconditionally: durable_version may understate what
             # the engine holds when an _update_storage_loop flush is still
             # in flight — its commit could persist rolled-back mutations

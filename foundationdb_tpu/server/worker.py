@@ -575,10 +575,25 @@ class Worker:
         path, a handful of interface references per send; the sim
         interval is deterministic virtual time, so same-seed runs still
         replay identically."""
-        from ..core.scheduler import delay, get_event_loop
-        interval = 10.0 if get_event_loop().sim else 30.0
+        from ..core.knobs import server_knobs
+        from ..core.scheduler import delay, get_event_loop, now
+        sim = get_event_loop().sim
+        last = now()
         while True:
-            await delay(interval)
+            # Real-mode cadence is a dynamic knob: sleep in short quanta
+            # and re-read it each wake so a LIVE knob lowering (the
+            # stage-attribution tooling wants fresh worker metrics docs)
+            # takes effect now, not after the old interval expires.  The
+            # sim interval stays FIXED — knob overrides must not perturb
+            # deterministic replays.
+            if sim:
+                await delay(10.0)
+            else:
+                interval = float(server_knobs().WORKER_REGISTER_INTERVAL_S)
+                await delay(max(0.5, min(2.5, interval)))
+                if now() - last < interval:
+                    continue
+            last = now()
             if self._current_cc is not None:
                 self._announce_roles()
 

@@ -296,19 +296,32 @@ def run_simulation(spec, seed: int, *, buggify: bool = False,
     # every SCHED_* stage on) and restores them afterwards — the spec
     # carries its own posture instead of relying on runner defaults.
     # Unknown names are rejected loudly, like [cluster]/[sim] fields.
-    from ..core.knobs import server_knobs
+    from ..core.knobs import client_knobs, server_knobs
     sknobs = server_knobs()
+    cknobs = client_knobs()
     knob_overrides = dict(spec.get("knobs") or {})
     # Validate EVERY name before setting ANY value: a KeyError raised
     # mid-application would leak the earlier overrides into the rest of
     # the process (the finally below only restores what was saved).
-    for k in knob_overrides:
-        if k.startswith("_") or not hasattr(sknobs, k):
+    # Names resolve against the server registry first, then the client
+    # one (e.g. GRV_LEASE_S for the e2e-throughput chaos spec) —
+    # unambiguous because the registries share no names.
+    def _knob_target(k: str):
+        if k.startswith("_"):
             raise KeyError(f"unknown [knobs] field {k!r} in spec")
+        if hasattr(sknobs, k):
+            return sknobs
+        if hasattr(cknobs, k):
+            return cknobs
+        raise KeyError(f"unknown [knobs] field {k!r} in spec")
+
+    for k in knob_overrides:
+        _knob_target(k)
     saved_knobs: Dict[str, Any] = {}
     for k, v in knob_overrides.items():
-        saved_knobs[k] = getattr(sknobs, k)
-        setattr(sknobs, k, v)
+        tgt = _knob_target(k)
+        saved_knobs[k] = (tgt, getattr(tgt, k))
+        setattr(tgt, k, v)
     gc_was_enabled = gc.isenabled()
     gc.collect()
     gc.disable()
@@ -341,8 +354,8 @@ def run_simulation(spec, seed: int, *, buggify: bool = False,
         enable_buggify(False)
         set_simulator(None)
         set_event_loop(None)
-        for k, v in saved_knobs.items():
-            setattr(sknobs, k, v)
+        for k, (tgt, v) in saved_knobs.items():
+            setattr(tgt, k, v)
         if gc_was_enabled:
             gc.enable()
 

@@ -1,0 +1,49 @@
+"""E2eThroughputTest chaos battery (ISSUE 14): every commit-pipeline
+fast-path knob ON under the swizzle nemesis + resolver attrition, with
+the exactly-once repair audit and double-run unseed verification —
+perf-path claims must hold under chaos, not quiescence."""
+
+import os
+
+from test_recovery import teardown  # noqa: F401
+
+SPECS = os.path.join(os.path.dirname(__file__), "specs")
+
+
+def test_e2e_throughput_chaos_double_run(teardown):  # noqa: F811
+    from foundationdb_tpu.core import coverage
+    from foundationdb_tpu.core.knobs import client_knobs, server_knobs
+    from foundationdb_tpu.testing.tester import run_test_twice
+    r1, r2 = run_test_twice(
+        os.path.join(SPECS, "E2eThroughputTest.toml"), seed=4242)
+    assert r1.unseed == r2.unseed and r1.digest == r2.digest
+    # The workload mix actually ran and audited.
+    m = r1.metrics["SchedRepairLoad"]
+    assert m["acked"] > 0 and m["failed"] == 0
+    assert m["acked"] <= m["hot_total"] <= m["acked"] + m["unknown"]
+    assert r1.metrics["Cycle"]["swaps"] > 0
+    assert r1.metrics["ReadWrite"]["operations"] > 0
+    assert r1.metrics["ConsistencyCheck"]["shards_audited"] > 0
+    # Repair (ladder posture, TXN_REPAIR_MAX_ATTEMPTS=2) exercised under
+    # the nemesis.
+    assert coverage.covered("ProxyTxnRepaired")
+    assert coverage.covered("ChaosNemesisResolverKill")
+    # Spec knob overrides were restored (client knobs included — the
+    # lease/batch posture must not leak into later tests).
+    assert server_knobs().RPC_COLUMNAR_ENABLED is False
+    assert server_knobs().PROXY_VECTORIZED_ASSEMBLY is False
+    assert client_knobs().GRV_BATCH_ENABLED is False
+    assert client_knobs().GRV_LEASE_S == 0.0
+
+
+def test_e2e_spec_in_chaos_matrix():
+    """run_chaos.py runs the spec by default (the seed-matrix runner's
+    coverage ledger keeps it honest)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "run_chaos_under_test",
+        os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                     "run_chaos.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "E2eThroughputTest.toml" in mod.DEFAULT_SPECS

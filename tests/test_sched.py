@@ -665,16 +665,128 @@ def test_bench_sched_smoke(monkeypatch):
     doc = bench.run_sched_bench()
     assert doc["parity"] == "ok"
     rates = doc["commit_rate"]
-    assert set(rates) == {"off", "predictor", "reorder", "repair", "all"}
+    assert set(rates) == {"off", "predictor", "reorder", "repair", "all",
+                          "ladder", "all+ladder"}
     assert all(0.0 <= v <= 1.0 for v in rates.values())
     # The stages help (or at worst do nothing) on the contended stream.
     assert rates["all"] >= rates["off"]
     assert rates["repair"] >= rates["off"]
+    # The multi-attempt ladder lifts the single-attempt repair ceiling
+    # (ISSUE 14 satellite: TXN_REPAIR_MAX_ATTEMPTS > 1 honored).
+    assert rates["ladder"] >= rates["repair"]
     assert doc["commit_rate_low"] >= 0.95
     counters = doc["stage_counters"]
     assert counters["off"]["repairs"] == 0
     assert counters["off"]["deferrals"] == 0
     assert counters["all"]["repairs"] > 0
+    assert counters["ladder"]["repairs"] > counters["repair"]["repairs"]
+
+
+# ---------------------------------------------------------------------------
+# Repair ladder (ISSUE 14): per-range version-clock backoff
+# ---------------------------------------------------------------------------
+
+def test_repair_ladder_rungs_and_expiry():
+    from foundationdb_tpu.sched.repair import RepairLadder
+    lad = RepairLadder(backoff_versions=100, table_max=8)
+    r = (b"a", b"b")
+    assert lad.should_attempt([r], 1000)
+    lad.note_failure([r], 1000)
+    # Blocked for the base window, open after it.
+    assert not lad.should_attempt([r], 1050)
+    assert lad.should_attempt([r], 1100)
+    # A second exhaustion doubles the rung.
+    lad.note_failure([r], 1100)
+    assert not lad.should_attempt([r], 1250)
+    assert lad.should_attempt([r], 1300)
+    # Unrelated ranges never blocked; a mixed culprit list is blocked if
+    # ANY member is.
+    assert lad.should_attempt([(b"x", b"y")], 1150)
+    assert not lad.should_attempt([(b"x", b"y"), r], 1150)
+
+
+def test_repair_ladder_success_clears():
+    from foundationdb_tpu.sched.repair import RepairLadder
+    lad = RepairLadder(backoff_versions=100)
+    r = (b"a", b"b")
+    lad.note_failure([r], 1000)
+    assert not lad.should_attempt([r], 1001)
+    lad.note_success([r])
+    assert lad.should_attempt([r], 1001)
+    # And the rung count reset with it: next failure is back at rung 1.
+    lad.note_failure([r], 2000)
+    assert lad.should_attempt([r], 2100)
+    # Entries are keyed by resolver-CLIPPED culprit fragments; a success
+    # reported with the FULL declared read range must still clear them
+    # (containment, not equality).
+    lad.note_failure([(b"m", b"mm")], 3000)
+    lad.note_success([(b"a", b"z")])
+    assert lad.should_attempt([(b"m", b"mm")], 3001)
+    # ...but an unrelated span clears nothing.
+    lad.note_failure([(b"m", b"mm")], 4000)
+    lad.note_success([(b"x", b"z")])
+    assert not lad.should_attempt([(b"m", b"mm")], 4001)
+
+
+def test_repair_ladder_table_bound():
+    from foundationdb_tpu.sched.repair import RepairLadder
+    lad = RepairLadder(backoff_versions=1000, table_max=4)
+    # Overfill with live entries: trim keeps the LATEST-expiring (most
+    # blocked) ones.
+    for i in range(10):
+        lad.note_failure([(b"k%02d" % i, b"k%02d\x00" % i)], 100 + i)
+    assert len(lad._entries) <= 4
+    assert (b"k09", b"k09\x00") in lad._entries
+    # Expired entries trim first.
+    lad2 = RepairLadder(backoff_versions=10, table_max=4)
+    for i in range(4):
+        lad2.note_failure([(b"e%d" % i, b"e%d\x00" % i)], 0)
+    lad2.note_failure([(b"live", b"live\x00")], 10_000)
+    lad2.note_failure([(b"live2", b"live2\x00")], 10_000)
+    assert (b"live", b"live\x00") in lad2._entries
+
+
+def test_proxy_repair_ladder_wiring(knobs, teardown):  # noqa: F811
+    """The proxy's _collect_repairs honors TXN_REPAIR_MAX_ATTEMPTS > 1
+    and consults the ladder only for CLIMBS (attempt >= 1): first
+    repairs are never backed off."""
+    import dataclasses as _dc
+    from foundationdb_tpu.server.cluster import SimCluster
+    knobs.SCHED_REPAIR_ENABLED = True
+    knobs.TXN_REPAIR_MAX_ATTEMPTS = 3
+    cl = SimCluster(n_resolvers=1, n_storage=1)
+    proxy = cl.commit_proxies[0]
+    txn = CommitTransactionRef(
+        read_conflict_ranges=[KeyRange(b"hot", b"hot\x00")],
+        write_conflict_ranges=[KeyRange(b"w", b"w\x00")],
+        mutations=[], read_snapshot=500)
+    culprits = {0: [(b"hot", b"hot\x00")]}
+    exact = {0: True}
+
+    def collect(attempt, version):
+        req = CommitTransactionRequest(
+            transaction=txn, repair_eligible=True, repair_attempt=attempt)
+        from foundationdb_tpu.core.futures import Promise
+        req.reply = Promise()
+        repaired: set = set()
+        out = proxy._collect_repairs(
+            [req], [CommitResult.CONFLICT], {}, dict(culprits),
+            dict(exact), version, repaired)
+        return out
+
+    # Attempts below the budget re-enqueue with attempt+1.
+    assert collect(0, 1000) and collect(0, 1000)[0].repair_attempt == 1
+    assert collect(1, 1000)[0].repair_attempt == 2
+    assert collect(2, 1000)[0].repair_attempt == 3
+    # Budget exhausted: no repair, and the range climbs a backoff rung.
+    assert collect(3, 2000) == []
+    assert proxy._repair_ladder.blocked_count(2001) == 1
+    # A CLIMB into the blocked range is deferred...
+    assert collect(1, 2001) == []
+    assert proxy.metrics.counter("RepairBackedOff").value == 1
+    # ...but a FIRST repair of the same range is not.
+    assert collect(0, 2001) != []
+    assert "repairs_backed_off" in proxy.scheduler_status()
 
 
 def test_flowlint_clean_on_sched_package():

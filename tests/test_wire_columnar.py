@@ -1,0 +1,313 @@
+"""Columnar hot-RPC wire frames (ISSUE 14, rpc/serde.py).
+
+Golden-guards BOTH formats of the three hot commit-pipeline messages —
+the knobs-off LEGACY image must never move (mixed-version clusters
+depend on it; sha256-frozen like the PR-12 reply-bytes guard) and the
+columnar image is frozen as full hex — plus mixed-format interop (a
+columnar encoder talking to a decoder whose own knob is off, and vice
+versa, through a real resolve), prefix-truncation edge cases, the
+legacy fallback for payload shapes outside the codec vocabulary, and
+the Encode/Decode observability counters."""
+
+import hashlib
+
+import pytest
+
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.rpc import serde
+from foundationdb_tpu.server.interfaces import (
+    ResolveTransactionBatchReply, ResolveTransactionBatchRequest,
+    TLogCommitRequest)
+from foundationdb_tpu.txn.types import (CommitResult, CommitTransactionRef,
+                                        KeyRange, Mutation, MutationType)
+
+serde.bootstrap_registry()
+
+
+@pytest.fixture()
+def columnar_knob():
+    k = server_knobs()
+    saved = k.RPC_COLUMNAR_ENABLED
+    yield k
+    k.RPC_COLUMNAR_ENABLED = saved
+
+
+def canonical_request():
+    txns = []
+    for i in range(4):
+        k = b"golden/%04d" % i
+        txns.append(CommitTransactionRef(
+            read_conflict_ranges=[KeyRange(k, k + b"\x00")],
+            write_conflict_ranges=[KeyRange(k + b"/w", k + b"/w\x00")],
+            mutations=[Mutation(MutationType.SetValue, k + b"/w", b"v" * 8)],
+            read_snapshot=900 + i,
+            report_conflicting_keys=(i % 2 == 0),
+            tenant_id=(7 if i == 3 else -1),
+            tag=("hot" if i == 1 else "")))
+    return ResolveTransactionBatchRequest(
+        prev_version=900, version=1000, last_received_version=800,
+        transactions=txns, txn_state_transactions=[2],
+        proxy_id="proxy0", span="golden-span")
+
+
+def canonical_reply():
+    return ResolveTransactionBatchReply(
+        committed=[CommitResult.COMMITTED, CommitResult.CONFLICT,
+                   CommitResult.TOO_OLD, CommitResult.COMMITTED],
+        conflicting_ranges={1: [(b"golden/0001", b"golden/0001\x00")]},
+        attribution_exact={1: True},
+        state_transactions=[(1000, "proxy1", 0,
+                             [Mutation(MutationType.SetValue,
+                                       b"\xff/g", b"1")],
+                             CommitResult.COMMITTED)])
+
+
+def canonical_commit_request():
+    from foundationdb_tpu.server.interfaces import CommitTransactionRequest
+    return CommitTransactionRequest(
+        transaction=CommitTransactionRef(
+            read_conflict_ranges=[KeyRange(b"golden/r", b"golden/r\x00")],
+            write_conflict_ranges=[KeyRange(b"golden/w", b"golden/w\x00")],
+            mutations=[Mutation(MutationType.SetValue, b"golden/w",
+                                b"v" * 8),
+                       Mutation(MutationType.AddValue, b"golden/ctr",
+                                b"\x01")],
+            read_snapshot=12345, tag="hot"),
+        debug_id="dbg-7", repair_eligible=True, repair_attempt=1)
+
+
+def canonical_tlog():
+    return TLogCommitRequest(
+        prev_version=900, version=1000, known_committed_version=850,
+        messages={0: [Mutation(MutationType.SetValue,
+                               b"golden/%04d" % i, b"v" * 8)
+                      for i in range(3)],
+                  0xFFFFFFFE: [Mutation(MutationType.SetValue,
+                                        b"\xff/keyServers/golden", b"t")]},
+        span="golden-span")
+
+
+# Frozen wire images.  The LEGACY sha256 is the knobs-off guard: any
+# byte change breaks mixed-version clusters mid-rollout.  The COLUMNAR
+# hex freezes format version 1 end to end.
+REQ_LEGACY_SHA = \
+    "dacbdc9111cb9a9b59a95c1b07097676ce0a0f6edbad872b536becccb018aa08"
+REPLY_LEGACY_SHA = \
+    "e99e1d2c735bd71ef94b5f61ff8a4019e083d8a07fffdfac5add9d6869568d97"
+TLOG_LEGACY_SHA = \
+    "c2d534147c3fa97582fedb57dacbbe6d153856a69332f408a05145cb34ca1c50"
+
+REQ_COLUMNAR_HEX = (
+    "121e0000005265736f6c76655472616e73616374696f6e426174636852657175"
+    "65737401880ed00fc00c0670726f7879300b676f6c64656e2d7370616e010204"
+    "01c80101010108c60101010103686f7401c40101010104c2010101010e000000"
+    "0018000b676f6c64656e2f303030300b01000b022f770d01000d000008767676"
+    "7676767676000b676f6c64656e2f303030310b01000b022f770d01000d000008"
+    "7676767676767676000b676f6c64656e2f303030320b01000b022f770d01000d"
+    "0000087676767676767676000b676f6c64656e2f303030330b01000b022f770d"
+    "01000d0000087676767676767676"
+)
+REPLY_COLUMNAR_HEX = (
+    "121c0000005265736f6c76655472616e73616374696f6e42617463685265706c"
+    "79010402000102010101010302000b676f6c64656e2f303030310b0100080100"
+    "0000090500000003e803000000000000070600000070726f7879310300000000"
+    "0000000008010000000b080000004d75746174696f6e03000000040000007479"
+    "7065100c0000004d75746174696f6e5479706503000000000000000006000000"
+    "706172616d310603000000ff2f6706000000706172616d32060100000031100c"
+    "000000436f6d6d6974526573756c74030200000000000000"
+)
+TLOG_COLUMNAR_HEX = (
+    "1211000000544c6f67436f6d6d69745265717565737401880ed00fa40d0b676f"
+    "6c64656e2d7370616e020003fcffffff1f010000000008000b676f6c64656e2f"
+    "3030303000087676767676767676000b676f6c64656e2f303030310008767676"
+    "7676767676000b676f6c64656e2f30303032000876767676767676760013ff2f"
+    "6b6579536572766572732f676f6c64656e000174"
+)
+CREQ_LEGACY_SHA = \
+    "88d3853d6658b53412cb6d65f8f369d61c27da09e6621770b870d4744a83c78b"
+CREQ_COLUMNAR_HEX = (
+    "1218000000436f6d6d69745472616e73616374696f6e52657175657374010301"
+    "056462672d3708f1c00101010203686f740002080008676f6c64656e2f720801"
+    "00070177080100080000087676767676767676000a676f6c64656e2f63747200"
+    "0101"
+)
+
+
+def _encode(obj, columnar: bool) -> bytes:
+    k = server_knobs()
+    saved = k.RPC_COLUMNAR_ENABLED
+    k.RPC_COLUMNAR_ENABLED = columnar
+    try:
+        return serde.encode_message(obj)
+    finally:
+        k.RPC_COLUMNAR_ENABLED = saved
+
+
+# ---------------------------------------------------------------------------
+# Goldens
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("make,legacy_sha,columnar_hex", [
+    (canonical_request, REQ_LEGACY_SHA, REQ_COLUMNAR_HEX),
+    (canonical_reply, REPLY_LEGACY_SHA, REPLY_COLUMNAR_HEX),
+    (canonical_tlog, TLOG_LEGACY_SHA, TLOG_COLUMNAR_HEX),
+    (canonical_commit_request, CREQ_LEGACY_SHA, CREQ_COLUMNAR_HEX),
+], ids=["request", "reply", "tlog", "commit"])
+def test_wire_goldens(make, legacy_sha, columnar_hex):
+    obj = make()
+    legacy = _encode(obj, columnar=False)
+    assert legacy[0] == serde.T_DATACLASS
+    assert hashlib.sha256(legacy).hexdigest() == legacy_sha, \
+        "knobs-off wire image CHANGED — mixed-version clusters break"
+    col = _encode(obj, columnar=True)
+    assert col[0] == serde.T_COLUMNAR
+    assert col.hex() == columnar_hex, \
+        "columnar frame format CHANGED — bump _COLUMNAR_VERSION instead"
+    # Both decode to the identical object.
+    assert serde.decode_message(legacy) == obj
+    assert serde.decode_message(col) == obj
+    # And columnar is actually smaller (the point of the format).
+    assert len(col) < len(legacy)
+
+
+# ---------------------------------------------------------------------------
+# Mixed-format interop
+# ---------------------------------------------------------------------------
+
+def test_mixed_format_interop_resolver(columnar_knob):
+    """A columnar-encoding proxy talks to a resolver whose own knob is
+    OFF (decode is format-transparent), and a legacy proxy talks to a
+    columnar-enabled resolver — verdicts identical both ways."""
+    from foundationdb_tpu.conflict.oracle import OracleConflictSet
+    req = canonical_request()
+    # New encoder -> legacy-posture decoder.
+    blob = _encode(req, columnar=True)
+    columnar_knob.RPC_COLUMNAR_ENABLED = False
+    decoded_a = serde.decode_message(blob)
+    # Legacy encoder -> columnar-posture decoder.
+    blob = _encode(req, columnar=False)
+    columnar_knob.RPC_COLUMNAR_ENABLED = True
+    decoded_b = serde.decode_message(blob)
+    columnar_knob.RPC_COLUMNAR_ENABLED = False
+    assert decoded_a == decoded_b == req
+    va = OracleConflictSet(0).resolve(decoded_a.transactions, 1000, 0)
+    vb = OracleConflictSet(0).resolve(decoded_b.transactions, 1000, 0)
+    assert va == vb
+
+
+def test_mixed_format_reply_direction(columnar_knob):
+    rep = canonical_reply()
+    blob = _encode(rep, columnar=True)
+    columnar_knob.RPC_COLUMNAR_ENABLED = False
+    assert serde.decode_message(blob) == rep
+    blob = _encode(rep, columnar=False)
+    columnar_knob.RPC_COLUMNAR_ENABLED = True
+    assert serde.decode_message(blob) == rep
+
+
+# ---------------------------------------------------------------------------
+# Codec edge cases
+# ---------------------------------------------------------------------------
+
+def test_columnar_edge_payloads(columnar_knob):
+    """Empty batch, empty keys, 100KB values (> u16), huge/negative
+    versions and tenant ids, all mutation types, empty tag maps."""
+    cases = [
+        ResolveTransactionBatchRequest(
+            prev_version=0, version=0, last_received_version=-1,
+            transactions=[], proxy_id="", span=""),
+        ResolveTransactionBatchRequest(
+            prev_version=(1 << 60), version=(1 << 60) + 5,
+            last_received_version=-(1 << 40),
+            transactions=[CommitTransactionRef(
+                read_conflict_ranges=[KeyRange(b"", b"\xff\xff")],
+                write_conflict_ranges=[],
+                mutations=[Mutation(MutationType.ClearRange, b"",
+                                    b"\xff" * 300),
+                           Mutation(MutationType.CompareAndClear,
+                                    b"k", b"v" * 100_000)],
+                read_snapshot=(1 << 60) + 9,   # above version: zigzag
+                tenant_id=(1 << 40))],
+            proxy_id="p"),
+        TLogCommitRequest(
+            prev_version=1, version=2, known_committed_version=0,
+            messages={}, span=""),
+        TLogCommitRequest(
+            prev_version=1, version=2, known_committed_version=0,
+            messages={0xFFFFFFFE: [], 3: [Mutation(
+                MutationType.AddValue, b"\x00" * 64, b"")]}),
+        ResolveTransactionBatchReply(committed=[]),
+    ]
+    for obj in cases:
+        col = _encode(obj, columnar=True)
+        leg = _encode(obj, columnar=False)
+        assert serde.decode_message(col) == obj
+        assert serde.decode_message(leg) == obj
+
+
+def test_columnar_shared_prefix_compresses(columnar_knob):
+    """Keys sharing long prefixes shrink dramatically — the whole point
+    of the prefix-truncated key stream."""
+    prefix = b"tenant/0000000001/table/users/row/"
+    txns = [CommitTransactionRef(
+        read_conflict_ranges=[KeyRange(prefix + b"%09d" % i,
+                                       prefix + b"%09d\x00" % i)],
+        write_conflict_ranges=[],
+        mutations=[], read_snapshot=10) for i in range(64)]
+    req = ResolveTransactionBatchRequest(
+        prev_version=9, version=10, last_received_version=8,
+        transactions=txns, proxy_id="p")
+    col = _encode(req, columnar=True)
+    leg = _encode(req, columnar=False)
+    assert serde.decode_message(col) == req
+    assert len(col) * 5 < len(leg), (len(col), len(leg))
+
+
+def test_columnar_fallback_for_foreign_shapes(columnar_knob):
+    """A reply whose conflicting ranges are NOT plain (bytes, bytes)
+    tuples falls back to the legacy format transparently (the codec
+    must never ship bytes it cannot reproduce)."""
+    rep = ResolveTransactionBatchReply(
+        committed=[CommitResult.CONFLICT],
+        conflicting_ranges={0: [KeyRange(b"a", b"b")]},   # KeyRange, not tuple
+        attribution_exact={0: True})
+    blob = _encode(rep, columnar=True)
+    assert blob[0] == serde.T_DATACLASS   # fell back
+    assert serde.decode_message(blob) == rep
+
+
+def test_unknown_columnar_version_rejected(columnar_knob):
+    blob = bytearray(_encode(canonical_tlog(), columnar=True))
+    # name is length-prefixed after the tag; the version byte follows.
+    name_len = int.from_bytes(blob[1:5], "little")
+    blob[5 + name_len] = 99
+    from foundationdb_tpu.core.error import FdbError
+    with pytest.raises(FdbError):
+        serde.decode_message(bytes(blob))
+
+
+def test_prefix_len_unit():
+    from foundationdb_tpu.rpc.serde import _prefix_len
+    assert _prefix_len(b"", b"abc") == 0
+    assert _prefix_len(b"abc", b"abc") == 3
+    assert _prefix_len(b"abc", b"abd") == 2
+    assert _prefix_len(b"abc", b"abcdef") == 3
+    assert _prefix_len(b"xbc", b"abc") == 0
+    assert _prefix_len(b"a" * 1000, b"a" * 999 + b"b") == 999
+
+
+def test_encode_decode_bands_recorded(columnar_knob):
+    """The Rpc collection's Encode/Decode histograms + frame counters
+    move for hot types in BOTH formats (e2e stage attribution feed)."""
+    col = serde._rpc_collection()
+    base_cf = col.counter("ColumnarFrames").value
+    base_lf = col.counter("LegacyFrames").value
+    enc0 = col.histogram("Encode").snapshot().count
+    dec0 = col.histogram("Decode").snapshot().count
+    req = canonical_request()
+    serde.decode_message(_encode(req, columnar=True))
+    serde.decode_message(_encode(req, columnar=False))
+    assert col.counter("ColumnarFrames").value == base_cf + 1
+    assert col.counter("LegacyFrames").value == base_lf + 1
+    assert col.histogram("Encode").snapshot().count == enc0 + 2
+    assert col.histogram("Decode").snapshot().count == dec0 + 2
