@@ -1245,10 +1245,11 @@ def _e2e_phase(loop, db, phase: str, phase_s: float, n_clients: int):
         i = 0
         while _lnow() < stop_at:
             t = db.create_transaction()
-            # Keys recycle modulo a bounded working set: unbounded
-            # unique keys grow the store linearly and the per-poll DD
-            # shard-metrics walk (O(total keys)) with it — phases later
-            # in the run then measure store aging, not the pipeline.
+            # Keys recycle modulo a bounded working set so phases stay
+            # comparable as the store ages.  (The original forcing
+            # reason — the per-poll DD shard-metrics walk was O(total
+            # keys) — is gone: storage answers quiet-shard polls from
+            # the incremental _ShardMetricsCache, ISSUE 15.)
             base_key = b"e2e/%02d/%06d" % (cid, i % 1500)
             i += 1
             try:
@@ -1625,6 +1626,526 @@ def e2e_main() -> None:
     doc = run_e2e()
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "BENCH_r10.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps(doc))
+
+
+# ---------------------------------------------------------------------------
+# `bench.py reads` — read-path throughput through the REAL-TCP cluster
+# (ISSUE 15): a Zipfian hot-key point-read storm and long range scans,
+# measured knobs-off then all-read-knobs-on (columnar read RPCs +
+# vectorized storage scans via LIVE dynamic knobs) with per-stage
+# latency-band attribution, plus an in-process B-tree micro section
+# (prefix-compression page ratio, vectorized scan speedup) and an e2e
+# commits/s re-run proving the write path did not regress.
+# `bench.py reads --smoke` is the in-process tier-1 parity gate.
+# ---------------------------------------------------------------------------
+
+READS_PHASE_S = float(os.environ.get("READS_PHASE_S", "10"))
+READS_REPEATS = int(os.environ.get("READS_REPEATS", "2"))
+READS_CLIENTS = int(os.environ.get("READS_CLIENTS", "24"))
+READS_KEYS = int(os.environ.get("READS_KEYS", "4000"))
+READS_VALUE_BYTES = int(os.environ.get("READS_VALUE_BYTES", "100"))
+READS_POINTS_PER_TXN = int(os.environ.get("READS_POINTS_PER_TXN", "16"))
+READS_SCAN_LIMIT = int(os.environ.get("READS_SCAN_LIMIT", "250"))
+
+
+def _reads_key(i: int) -> bytes:
+    # Long shared prefixes: the regime both the columnar reply's
+    # prefix-truncated key stream and the B-tree's page compression are
+    # built for (tenant/table/row-shaped keyspaces).
+    return b"reads/tenant01/users/%08d" % i
+
+
+def _zipf_idx(r, n: int, log_n: float) -> int:
+    """Log-uniform rank (index 0 = the celebrity object)."""
+    import math
+    return min(n - 1, int(math.exp(r.random() * log_n)) - 1)
+
+
+def _reads_load(loop, db) -> None:
+    value = b"v" * READS_VALUE_BYTES
+
+    async def load() -> None:
+        from foundationdb_tpu.core.error import FdbError
+        for base in range(0, READS_KEYS, 200):
+            t = db.create_transaction()
+            while True:
+                try:
+                    for i in range(base, min(base + 200, READS_KEYS)):
+                        t.set(_reads_key(i), value)
+                    await t.commit()
+                    break
+                except FdbError as e:
+                    await t.on_error(e)
+
+    loop.run_until(loop.spawn(load()), timeout=300)
+
+
+def _reads_phase(loop, db, kind: str, phase_s: float, n_clients: int):
+    """Drive n_clients concurrent read actors for phase_s; returns
+    (counts, elapsed_s).  kind: "point" = Zipfian get storm, "scan" =
+    long forward range scans from random offsets."""
+    import math
+    counts = {"reads": 0, "scans": 0, "rows": 0, "errors": 0}
+    log_n = math.log(READS_KEYS)
+    end_key = _reads_key(READS_KEYS)
+
+    async def point_actor(cid: int) -> None:
+        from foundationdb_tpu.core.scheduler import delay
+        from foundationdb_tpu.core.scheduler import now as _lnow
+        import random as _random
+        r = _random.Random(cid * 7919 + 1)
+        stop_at = _lnow() + phase_s
+        while _lnow() < stop_at:
+            t = db.create_transaction()
+            try:
+                for _ in range(READS_POINTS_PER_TXN):
+                    await t.get(_reads_key(
+                        _zipf_idx(r, READS_KEYS, log_n)), snapshot=True)
+                    counts["reads"] += 1
+            except Exception:  # noqa: BLE001 — chaos-free run; count+pace
+                counts["errors"] += 1
+                await delay(0.2)
+
+    async def scan_actor(cid: int) -> None:
+        from foundationdb_tpu.core.scheduler import delay
+        from foundationdb_tpu.core.scheduler import now as _lnow
+        import random as _random
+        r = _random.Random(cid * 104729 + 1)
+        stop_at = _lnow() + phase_s
+        while _lnow() < stop_at:
+            t = db.create_transaction()
+            try:
+                lo = r.randrange(max(READS_KEYS - READS_SCAN_LIMIT, 1))
+                rows = await t.get_range(_reads_key(lo), end_key,
+                                         limit=READS_SCAN_LIMIT,
+                                         snapshot=True)
+                counts["scans"] += 1
+                counts["rows"] += len(rows)
+            except Exception:  # noqa: BLE001
+                counts["errors"] += 1
+                await delay(0.2)
+
+    actor = point_actor if kind == "point" else scan_actor
+
+    async def drive() -> None:
+        from foundationdb_tpu.core.futures import wait_all
+        from foundationdb_tpu.core.scheduler import get_event_loop
+        await wait_all([get_event_loop().spawn(actor(c), f"reads.{kind}{c}")
+                        for c in range(n_clients)])
+
+    t0 = time.perf_counter()
+    loop.run_until(loop.spawn(drive()), timeout=phase_s * 4 + 120)
+    return counts, time.perf_counter() - t0
+
+
+def run_reads() -> dict:
+    """Boot the 6-process real-TCP cluster, load the keyspace, measure
+    point-read and range-scan throughput knobs-off, flip the read-path
+    knobs live, measure again, attribute stages."""
+    from foundationdb_tpu.client.database import open_cluster
+    from foundationdb_tpu.core.scheduler import set_event_loop
+    from foundationdb_tpu.rpc.network import set_network
+
+    base = os.environ.get("READS_BASEDIR", "/tmp/fdb_reads_bench")
+    procs, coords = _e2e_spawn_cluster(base)
+    loop = None
+    try:
+        time.sleep(2.5)
+        dead = {n: p.poll() for n, p in procs.items()
+                if p.poll() is not None}
+        if dead:
+            raise RuntimeError(f"processes died at boot: {dead}")
+        loop, db = open_cluster(coords)
+        _e2e_ready(loop, db, procs)
+        _phase("reads cluster up; loading keyspace")
+        _reads_load(loop, db)
+
+        async def fast_register():
+            from foundationdb_tpu.client.management import set_knob
+            await set_knob(db, "WORKER_REGISTER_INTERVAL_S", 2)
+        loop.run_until(loop.spawn(fast_register()), timeout=60)
+
+        def settled_status():
+            time.sleep(4.5)
+            return _e2e_status(loop, db)
+
+        def set_posture(on: bool) -> None:
+            # Server knobs flip LIVE via the dynamic-knob path; the
+            # LOCAL registry flips too — this client process encodes
+            # GetValueRequest/GetKeyValuesRequest frames itself and
+            # serde's gate reads the local registry.
+            async def flip():
+                from foundationdb_tpu.client.management import set_knob
+                await set_knob(db, "RPC_COLUMNAR_ENABLED", int(on))
+                await set_knob(db, "STORAGE_VECTORIZED_SCAN", int(on))
+                await set_knob(db, "BTREE_PREFIX_COMPRESSION", int(on))
+            loop.run_until(loop.spawn(flip()), timeout=60)
+            from foundationdb_tpu.core.knobs import server_knobs
+            server_knobs().RPC_COLUMNAR_ENABLED = bool(on)
+            server_knobs().STORAGE_VECTORIZED_SCAN = bool(on)
+
+        # Prove columnar read frames engage before measuring any ON
+        # window (same dead-knob-watch guard as `bench.py e2e`).
+        set_posture(True)
+        deadline = time.monotonic() + 30.0
+        engaged = False
+        while time.monotonic() < deadline:
+            _reads_phase(loop, db, "point", 1.0, 2)
+            rpc = _e2e_rpc_counters(_e2e_status(loop, db))
+            if rpc.get("ColumnarFrames", 0) > 0:
+                engaged = True
+                break
+        if not engaged:
+            raise RuntimeError(
+                "columnar frames never appeared on the wire: dynamic "
+                "knob propagation is broken — refusing to measure")
+
+        acc = {"off": {"point": [], "scan": []},
+               "on": {"point": [], "scan": []}}
+        attrib = {}
+        for rep in range(max(1, READS_REPEATS)):
+            order = (("off", False), ("on", True))
+            if rep % 2:
+                order = order[::-1]
+            for name, on in order:
+                set_posture(on)
+                _reads_phase(loop, db, "point", 1.0, 2)   # settle
+                s_before = settled_status()
+                for kind in ("point", "scan"):
+                    counts, elapsed = _reads_phase(
+                        loop, db, kind, READS_PHASE_S, READS_CLIENTS)
+                    if kind == "point":
+                        rate = counts["reads"] / max(elapsed, 1e-9)
+                    else:
+                        rate = counts["rows"] / max(elapsed, 1e-9)
+                    _phase(f"reads rep{rep} {name} {kind}: {rate:.0f}/s "
+                           f"(errors={counts['errors']})")
+                    acc[name][kind].append(
+                        {"rate": rate, "counts": counts})
+                s_after = settled_status()
+                # Per-rep attribution (reps alternate posture order, so
+                # publishing only the last rep would silently pick one
+                # ordering's warm-up profile).
+                attrib.setdefault(name, {})[f"rep{rep}"] = \
+                    _e2e_attribution(_e2e_band_totals(s_before),
+                                     _e2e_band_totals(s_after))
+
+        def fold(phases):
+            mean = sum(p["rate"] for p in phases) / len(phases)
+            return {"rate": round(mean, 1),
+                    "rates": [round(p["rate"], 1) for p in phases]}
+
+        doc = {
+            "metric": "read_path_throughput",
+            "regime": {"clients": READS_CLIENTS, "phase_s": READS_PHASE_S,
+                       "repeats": max(1, READS_REPEATS),
+                       "keys": READS_KEYS,
+                       "value_bytes": READS_VALUE_BYTES,
+                       "points_per_txn": READS_POINTS_PER_TXN,
+                       "scan_limit": READS_SCAN_LIMIT,
+                       "processes": len(procs), "transport": "real-tcp"},
+            "point_reads_per_s": {
+                "off": fold(acc["off"]["point"]),
+                "on": fold(acc["on"]["point"])},
+            "scan_rows_per_s": {
+                "off": fold(acc["off"]["scan"]),
+                "on": fold(acc["on"]["scan"])},
+            "stage_attribution_ms": attrib,
+            "rpc_counters": _e2e_rpc_counters(_e2e_status(loop, db)),
+        }
+        doc["point_speedup"] = round(
+            doc["point_reads_per_s"]["on"]["rate"] /
+            max(doc["point_reads_per_s"]["off"]["rate"], 1e-9), 3)
+        doc["scan_speedup"] = round(
+            doc["scan_rows_per_s"]["on"]["rate"] /
+            max(doc["scan_rows_per_s"]["off"]["rate"], 1e-9), 3)
+        return doc
+    finally:
+        for p in procs.values():
+            p.kill()
+        for p in procs.values():
+            p.wait()
+        from foundationdb_tpu.core.knobs import server_knobs as _sk
+        _sk().RPC_COLUMNAR_ENABLED = False
+        _sk().STORAGE_VECTORIZED_SCAN = False
+        set_network(None)
+        if loop is not None:
+            set_event_loop(None)
+
+
+# -- in-process B-tree micro section ------------------------------------------
+
+def run_btree_micro() -> dict:
+    """Prefix-compression page ratio + vectorized scan speedup on the
+    B-tree engine, same keyspace shape as the TCP bench (the engine is
+    the durable floor under the MVCC window — boot image scans,
+    fetch_shard snapshots and storage re-images all walk it)."""
+    from foundationdb_tpu.core import (DeterministicRandom, EventLoop,
+                                       set_deterministic_random,
+                                       set_event_loop)
+    from foundationdb_tpu.core.knobs import server_knobs
+    from foundationdb_tpu.server.kvstore import open_kv_store
+    from foundationdb_tpu.server.sim_fs import SimFileSystem
+
+    sk = server_knobs()
+    saved = (sk.BTREE_PREFIX_COMPRESSION, sk.STORAGE_VECTORIZED_SCAN)
+    loop = EventLoop(sim=True)
+    set_event_loop(loop)
+    set_deterministic_random(DeterministicRandom(1511))
+    n = int(os.environ.get("READS_BTREE_KEYS", "20000"))
+    value = b"v" * 24
+
+    def drive(coro):
+        return loop.run_until(loop.spawn(coro), timeout=600)
+
+    def build(compress: bool):
+        sk.BTREE_PREFIX_COMPRESSION = compress
+        fs = SimFileSystem()
+        eng = open_kv_store("btree", fs, "bt")
+        drive(eng.recover())
+        for base in range(0, n, 500):
+            for i in range(base, min(base + 500, n)):
+                eng.set(_reads_key(i), value)
+            drive(eng.commit())
+        return eng
+
+    try:
+        plain = build(False)
+        comp = build(True)
+        live_plain = plain.page_count - len(plain.free)
+        live_comp = comp.page_count - len(comp.free)
+
+        def scan_rate(eng, vectorized: bool) -> float:
+            sk.STORAGE_VECTORIZED_SCAN = vectorized
+            t0 = time.perf_counter()
+            rows = 0
+            for _ in range(5):
+                rows += len(eng.read_range(b"", b"\xff"))
+            dt = time.perf_counter() - t0
+            assert rows == 5 * n
+            return rows / dt
+
+        doc = {
+            "keys": n,
+            "pages_live": {"plain": live_plain, "compressed": live_comp},
+            "page_compression_ratio": round(live_plain /
+                                            max(live_comp, 1), 3),
+            "full_scan_rows_per_s": {
+                "recursive": round(scan_rate(plain, False), 0),
+                "vectorized": round(scan_rate(plain, True), 0),
+                "vectorized_compressed": round(scan_rate(comp, True), 0),
+            },
+        }
+        doc["scan_speedup"] = round(
+            doc["full_scan_rows_per_s"]["vectorized"] /
+            max(doc["full_scan_rows_per_s"]["recursive"], 1e-9), 3)
+        # Parity while we're here: all three paths, identical rows.
+        sk.STORAGE_VECTORIZED_SCAN = False
+        a = plain.read_range(b"", b"\xff")
+        b = comp.read_range(b"", b"\xff")
+        sk.STORAGE_VECTORIZED_SCAN = True
+        c = plain.read_range(b"", b"\xff")
+        d = comp.read_range(b"", b"\xff")
+        assert a == b == c == d and len(a) == n
+        doc["parity"] = "ok"
+        return doc
+    finally:
+        sk.BTREE_PREFIX_COMPRESSION, sk.STORAGE_VECTORIZED_SCAN = saved
+        set_event_loop(None)
+
+
+# -- `bench.py reads --smoke`: the in-process tier-1 parity gate -------------
+
+def run_reads_smoke() -> dict:
+    """Fast in-process read-path parity gate (tier-1 via
+    tests/test_reads_bench.py): (1) knobs-off read-RPC wire images stay
+    the LEGACY format and round-trip; (2) columnar-on replies decode to
+    objects identical to columnar-off on randomized data; (3) compressed
+    vs plain B-tree pages yield identical scan results, both knob
+    postures, across a power-fail recovery; (4) the vectorized
+    VersionedMap scan is bit-identical to the plain loop on randomized
+    MVCC probes; (5) the incremental shard-metrics cache's totals equal
+    fresh scans under randomized mutation."""
+    import random as _random
+
+    from foundationdb_tpu.core.knobs import server_knobs
+    from foundationdb_tpu.rpc import serde
+    from foundationdb_tpu.server.interfaces import (GetKeyValuesReply,
+                                                    GetKeyValuesRequest,
+                                                    GetValueReply)
+    serde.bootstrap_registry()
+    sk = server_knobs()
+    doc = {"metric": "reads_smoke"}
+    assert not sk.RPC_COLUMNAR_ENABLED, "smoke requires default knobs"
+    assert not sk.STORAGE_VECTORIZED_SCAN
+    assert not sk.BTREE_PREFIX_COMPRESSION
+
+    # (1) + (2) wire parity on randomized read payloads.
+    rng = _random.Random(1511)
+    checked = 0
+    for trial in range(40):
+        n = rng.randrange(0, 60)
+        data = []
+        for i in range(n):
+            k = _reads_key(rng.randrange(10_000))
+            data.append((k, bytes(rng.randrange(256)
+                                  for _ in range(rng.randrange(0, 40)))))
+        data.sort(key=lambda kv: kv[0])
+        if rng.random() < 0.25:
+            data.reverse()
+        objs = [
+            GetKeyValuesReply(data=data, more=rng.random() < 0.5,
+                              version=rng.randrange(1 << 40)),
+            GetKeyValuesRequest(
+                begin=_reads_key(1), end=_reads_key(rng.randrange(2, 9999)),
+                version=rng.randrange(1 << 40),
+                limit=rng.randrange(1, 1000),
+                limit_bytes=rng.randrange(1, 1 << 20),
+                reverse=rng.random() < 0.5,
+                tag="t" if rng.random() < 0.3 else ""),
+            GetValueReply(value=(None if rng.random() < 0.2 else
+                                 b"x" * rng.randrange(0, 200)),
+                          version=rng.randrange(1 << 40)),
+        ]
+        for obj in objs:
+            leg = serde.encode_message(obj)
+            assert leg[0] == serde.T_DATACLASS, "knobs-off frame not legacy!"
+            sk.RPC_COLUMNAR_ENABLED = True
+            col = serde.encode_message(obj)
+            sk.RPC_COLUMNAR_ENABLED = False
+            assert col[0] == serde.T_COLUMNAR
+            assert serde.decode_message(leg) == obj
+            assert serde.decode_message(col) == obj, type(obj).__name__
+            checked += 1
+    doc["wire_parity_msgs"] = checked
+
+    # (3) compressed vs plain B-tree pages: identical scans (covered in
+    # depth by run_btree_micro's parity; here a quick randomized pass
+    # with clears + power-fail recovery).
+    from foundationdb_tpu.core import (DeterministicRandom, EventLoop,
+                                       set_deterministic_random,
+                                       set_event_loop)
+    from foundationdb_tpu.server.kvstore import open_kv_store
+    from foundationdb_tpu.server.sim_fs import SimFileSystem
+    loop = EventLoop(sim=True)
+    set_event_loop(loop)
+    set_deterministic_random(DeterministicRandom(1512))
+    try:
+        def drive(coro):
+            return loop.run_until(loop.spawn(coro), timeout=120)
+
+        stores = {}
+        for compress in (False, True):
+            sk.BTREE_PREFIX_COMPRESSION = compress
+            fs = SimFileSystem()
+            eng = open_kv_store("btree", fs, "bt")
+            drive(eng.recover())
+            r = _random.Random(99)
+            for round_ in range(8):
+                for _ in range(120):
+                    i = r.randrange(3000)
+                    if r.random() < 0.85:
+                        eng.set(_reads_key(i), b"v%06d" % r.randrange(1 << 20))
+                    else:
+                        # Narrow clears: wide ones would empty the tree
+                        # and starve the scan-parity assertion of rows.
+                        eng.clear(_reads_key(i),
+                                  _reads_key(i + r.randrange(1, 40)))
+                drive(eng.commit())
+            fs.power_fail_all()
+            eng = open_kv_store("btree", fs, "bt")
+            drive(eng.recover())
+            stores[compress] = eng
+        sk.BTREE_PREFIX_COMPRESSION = False
+        scans = {}
+        for compress, eng in stores.items():
+            for vec in (False, True):
+                sk.STORAGE_VECTORIZED_SCAN = vec
+                scans[(compress, vec)] = eng.read_range(b"", b"\xff")
+        sk.STORAGE_VECTORIZED_SCAN = False
+        first = scans[(False, False)]
+        assert first and all(s == first for s in scans.values()), \
+            "btree page-format/scan-path results diverge"
+        doc["btree_parity_rows"] = len(first)
+
+        # (4) VersionedMap vectorized-scan parity on randomized MVCC
+        # probes (tombstones, overlapping versions, byte limits).
+        from foundationdb_tpu.server.storage import VersionedMap
+        vm = VersionedMap()
+        r = _random.Random(4242)
+        for v in range(1, 400):
+            for _ in range(4):
+                i = r.randrange(500)
+                vm.set(_reads_key(i),
+                       None if r.random() < 0.15 else b"u%07d" % v, v)
+        probes = 0
+        for _ in range(300):
+            a, bkey = sorted((r.randrange(520), r.randrange(520)))
+            args = (_reads_key(a), _reads_key(bkey), r.randrange(1, 420),
+                    r.randrange(1, 40), r.randrange(1, 4000),
+                    r.random() < 0.3)
+            sk.STORAGE_VECTORIZED_SCAN = False
+            plain = vm.range_read(*args)
+            sk.STORAGE_VECTORIZED_SCAN = True
+            vec = vm.range_read(*args)
+            sk.STORAGE_VECTORIZED_SCAN = False
+            assert plain == vec, f"range_read diverges at {args}"
+            probes += 1
+        doc["versioned_map_probes"] = probes
+
+        # (5) incremental shard-metrics cache == fresh scans.
+        from foundationdb_tpu.server.storage import _ShardMetricsCache
+        vm2 = VersionedMap()
+        cache = _ShardMetricsCache()
+        vm2._metrics_cache = cache
+        bounds = [_reads_key(i) for i in (0, 120, 300, 700, 1000)]
+        shards = list(zip(bounds, bounds[1:]))
+        ver = 0
+        audited = 0
+        for round_ in range(30):
+            for _ in range(60):
+                ver += 1
+                i = r.randrange(1000)
+                vm2.set(_reads_key(i),
+                        None if r.random() < 0.1 else
+                        b"w" * r.randrange(1, 60), ver)
+            for b, e in shards:
+                hit = cache.get(b, e)
+                fresh = vm2.range_bytes(b, e, ver)
+                if hit is not None:
+                    assert hit == fresh, \
+                        f"shard cache drifted: {hit} != {fresh}"
+                    audited += 1
+                cache.put(b, e, *fresh)
+        assert audited > 50
+        doc["shard_cache_audits"] = audited
+    finally:
+        sk.BTREE_PREFIX_COMPRESSION = False
+        sk.STORAGE_VECTORIZED_SCAN = False
+        sk.RPC_COLUMNAR_ENABLED = False
+        set_event_loop(None)
+    doc["parity"] = "ok"
+    return doc
+
+
+def reads_main() -> None:
+    if "--smoke" in sys.argv:
+        print(json.dumps(run_reads_smoke()))
+        return
+    doc = {"metric": "read_path_round11"}
+    _phase("btree micro (compression ratio + scan speedup)")
+    doc["btree_micro"] = run_btree_micro()
+    _phase("real-TCP read bench")
+    doc["reads"] = run_reads()
+    if os.environ.get("READS_E2E_RECHECK", "1") != "0":
+        _phase("e2e commits/s recheck (write path must not regress)")
+        doc["e2e_recheck"] = run_e2e()
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r11.json")
     with open(out, "w") as f:
         json.dump(doc, f, indent=1)
         f.write("\n")
@@ -2128,6 +2649,12 @@ def main() -> None:
         # measurement writing BENCH_r10.json, or --smoke for the
         # in-process tier-1 parity gate.
         e2e_main()
+        return
+    if backend == "reads":
+        # Read-path throughput (ISSUE 15): real-TCP point/scan bench +
+        # btree micro + e2e recheck writing BENCH_r11.json, or --smoke
+        # for the in-process tier-1 parity gate.
+        reads_main()
         return
     if backend == "sched":
         # Conflict-aware scheduling bench (ISSUE 12): in-process (the

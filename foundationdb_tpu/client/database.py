@@ -984,7 +984,8 @@ class Transaction:
         return reply.value
 
     async def get_range(self, begin: bytes, end: bytes, limit: int = 1000,
-                        reverse: bool = False, snapshot: bool = False
+                        reverse: bool = False, snapshot: bool = False,
+                        limit_bytes: int = 0
                         ) -> List[Tuple[bytes, bytes]]:
         """Range read with RYW overlay (reference getRange :3311).
 
@@ -992,7 +993,14 @@ class Transaction:
         (begin for forward, end for reverse); each chunk's snapshot data is
         complete for its covered span, so overlaying this transaction's
         writes per-span cannot leave gaps even when the storage reply was
-        limit-truncated."""
+        limit-truncated.
+
+        `limit_bytes` > 0 bounds the TOTAL result bytes across chunks
+        (reference GetRangeLimits.bytes): the scan stops once the budget
+        is consumed, with the row that crossed it included — so large-
+        value scans can stream in bounded slices instead of holding a
+        whole shard's rows.  0 (default) keeps the per-chunk storage
+        default, the pre-ISSUE-15 behavior."""
         if begin >= end:
             return []
         p = self.CONFLICTING_KEYS_PREFIX
@@ -1013,25 +1021,45 @@ class Transaction:
             self.read_conflict_ranges.append((begin, end))
         version = await self._ensure_read_version()
         out: List[Tuple[bytes, bytes]] = []
+        nbytes = 0
+        budget = limit_bytes if limit_bytes > 0 else 0
+        # Per-chunk request bound: the remaining budget, capped at the
+        # storage default — shipping a huge remaining budget as ONE
+        # chunk's limit_bytes would ask storage to materialize and
+        # encode it all in a single reply frame.
+        def chunk_bytes() -> int:
+            return min(budget - nbytes, 1 << 20) if budget else 0
         if not reverse:
             cursor = begin
             while cursor < end and len(out) < limit:
                 data, covered_end = await self._fetch_chunk_forward(
-                    cursor, end, version, limit - len(out))
-                out.extend(self._merge_span(data, cursor, covered_end))
+                    cursor, end, version, limit - len(out), chunk_bytes())
+                merged = self._merge_span(data, cursor, covered_end)
+                out.extend(merged)
                 cursor = covered_end
+                if budget:
+                    # Only the new span's bytes: re-summing `out` per
+                    # chunk would make budgeted scans O(rows^2).
+                    nbytes += sum(len(k) + len(v) for k, v in merged)
+                    if nbytes >= budget:
+                        break
         else:
             cursor = end
             while cursor > begin and len(out) < limit:
                 data, covered_begin = await self._fetch_chunk_reverse(
-                    begin, cursor, version, limit - len(out))
+                    begin, cursor, version, limit - len(out), chunk_bytes())
                 merged = self._merge_span(sorted(data), covered_begin, cursor)
                 out.extend(reversed(merged))
                 cursor = covered_begin
+                if budget:
+                    nbytes += sum(len(k) + len(v) for k, v in merged)
+                    if nbytes >= budget:
+                        break
         return out[:limit]
 
     async def _fetch_chunk_forward(
-            self, cursor: bytes, end: bytes, version: Version, limit: int
+            self, cursor: bytes, end: bytes, version: Version, limit: int,
+            limit_bytes: int = 0
     ) -> Tuple[List[Tuple[bytes, bytes]], bytes]:
         """One storage fetch; returns (data, covered_end): the snapshot is
         complete over [cursor, covered_end)."""
@@ -1040,17 +1068,19 @@ class Transaction:
         shard_end = min(rng_e, end)
         if not ssis:
             raise err("wrong_shard_server")
+        kwargs = {"limit_bytes": limit_bytes} if limit_bytes > 0 else {}
         reply = await self.db.read_replica(
             ssis, lambda s: s.get_key_values,
             lambda: GetKeyValuesRequest(begin=cursor, end=shard_end,
                                         version=version, limit=limit,
-                                        tag=self.tag))
+                                        tag=self.tag, **kwargs))
         if reply.more and reply.data:
             return reply.data, key_after(reply.data[-1][0])
         return reply.data, shard_end
 
     async def _fetch_chunk_reverse(
-            self, begin: bytes, cursor: bytes, version: Version, limit: int
+            self, begin: bytes, cursor: bytes, version: Version, limit: int,
+            limit_bytes: int = 0
     ) -> Tuple[List[Tuple[bytes, bytes]], bytes]:
         """One reverse storage fetch; returns (data descending,
         covered_begin): complete over [covered_begin, cursor)."""
@@ -1058,11 +1088,13 @@ class Transaction:
         shard_begin = max(rng_b, begin)
         if not ssis:
             raise err("wrong_shard_server")
+        kwargs = {"limit_bytes": limit_bytes} if limit_bytes > 0 else {}
         reply = await self.db.read_replica(
             ssis, lambda s: s.get_key_values,
             lambda: GetKeyValuesRequest(begin=shard_begin, end=cursor,
                                         version=version, limit=limit,
-                                        reverse=True, tag=self.tag))
+                                        reverse=True, tag=self.tag,
+                                        **kwargs))
         if reply.more and reply.data:
             return reply.data, reply.data[-1][0]   # inclusive smallest key
         return reply.data, shard_begin

@@ -257,6 +257,34 @@ class ServerKnobs(KnobBase):
         # plain path (parity-tested).
         self.PROXY_VECTORIZED_ASSEMBLY = False
 
+        # Read hot path (ISSUE 15) — the read-side mirror of the two
+        # knobs above.  Both DEFAULT OFF with bit-identical knobs-off
+        # behavior (`bench.py reads --smoke` parity gate in tier-1).
+        # Prefix-compressed B-tree LEAF pages (kvstore_btree.py, the
+        # reference's Redwood page key compression): leaves encode one
+        # shared page prefix + per-entry suffix arrays, so dense
+        # same-prefix keyspaces pack several times more records per 4K
+        # page.  Decoding is format-transparent regardless of the knob
+        # (plain pages and compressed pages both always decode), so the
+        # knob can be flipped on a live store: old pages stay readable,
+        # COW rewrites migrate them incrementally.
+        self.BTREE_PREFIX_COMPRESSION = False
+        # Batched/vectorized range scans: the storage server's MVCC
+        # range_read walks its sorted key array emitting rows in slices
+        # with the per-key version-chain probe inlined, and the B-tree's
+        # read_range switches from per-key recursive descent to an
+        # iterative leaf walk emitting bisected page slices.  Results
+        # are bit-identical to the plain paths (parity-tested).
+        self.STORAGE_VECTORIZED_SCAN = False
+        # Incremental DD shard-metrics (storage.py _ShardMetricsCache):
+        # storage maintains per-shard byte/count estimates updated by
+        # write-time deltas, so DD's 0.5s GetShardMetrics poll is O(1)
+        # per unchanged shard instead of O(keys in shard) — the fix that
+        # lets `bench.py e2e` stop bounding its working set.  Totals are
+        # exact (deltas are computed from the replaced value), so this
+        # defaults ON; the knob is the emergency revert to full scans.
+        self.STORAGE_INCREMENTAL_SHARD_METRICS = True
+
         # Resolution plane (master recruitment): resolver count override —
         # 0 recruits DatabaseConfiguration.n_resolvers (the committed
         # \xff/conf value); > 0 pins the count regardless of configuration

@@ -86,3 +86,23 @@ class Reader:
 
     def at_end(self) -> bool:
         return self._o >= len(self._d)
+
+
+def longest_common_prefix_len(a: bytes, b: bytes) -> int:
+    """Length of the longest common prefix, via binary search over
+    C-speed slice compares (no per-byte Python loop).  Shared by the
+    columnar wire frames' prefix-truncated key streams (rpc/serde.py)
+    and the B-tree's compressed leaf pages (server/kvstore_btree.py)."""
+    n = min(len(a), len(b))
+    if n == 0 or a[:1] != b[:1]:
+        return 0
+    if a[:n] == b[:n]:
+        return n
+    lo, hi = 1, n - 1
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if a[:mid] == b[:mid]:
+            lo = mid
+        else:
+            hi = mid - 1
+    return lo

@@ -335,6 +335,18 @@ def _decode_dataclass_body(r: Reader, cls: type) -> Any:
 # decomposes serialization cost instead of hiding it in queue waits.
 
 _COLUMNAR_VERSION = 1
+# Per-message format versions (ISSUE 15): the read-reply family moved to
+# a keys-stream + value-length-column layout, stamped 2 so a frame from
+# the older interleaved layout is rejected loudly instead of misdecoded.
+# Names absent here are version 1.
+_CODEC_VERSIONS: Dict[str, int] = {
+    "GetKeyValuesReply": 2,
+}
+
+
+def _codec_version(name: str) -> int:
+    return _CODEC_VERSIONS.get(name, _COLUMNAR_VERSION)
+
 
 _rpc_metrics = None
 
@@ -412,22 +424,9 @@ def _rb(r: Reader) -> bytes:
     return _rd_raw(r, _rv(r))
 
 
-def _prefix_len(a: bytes, b: bytes) -> int:
-    """Length of the longest common prefix (binary search over C-speed
-    slice compares — no per-byte Python loop)."""
-    n = min(len(a), len(b))
-    if n == 0:
-        return 0
-    if a[:n] == b[:n]:
-        return n
-    lo, hi = 0, n - 1
-    while lo < hi:
-        mid = (lo + hi + 1) // 2
-        if a[:mid] == b[:mid]:
-            lo = mid
-        else:
-            hi = mid - 1
-    return lo
+# Longest-common-prefix helper, shared with the B-tree's compressed
+# leaf pages (one implementation: core/wire.py).
+from ..core.wire import longest_common_prefix_len as _prefix_len  # noqa: E402
 
 
 def _enc_key_stream(out: bytearray, keys: list) -> None:
@@ -795,17 +794,67 @@ def _dec_get_value_reply(r: Reader) -> Any:
     return GetValueReply(value=val, version=_rz(r))
 
 
+def _enc_get_key_values_request(v: Any) -> bytes:
+    """The range-read request: begin/end share a mini prefix-truncated
+    stream (range endpoints usually share a long shard/tenant prefix),
+    limits ride as varints."""
+    out = bytearray()
+    flags = (1 if v.reverse else 0) | (2 if v.tag else 0)
+    out.append(flags)
+    _wz(out, v.version)
+    _wv(out, v.limit)
+    _wv(out, v.limit_bytes)
+    begin, end = v.begin, v.end
+    if type(begin) is not bytes or type(end) is not bytes:
+        raise TypeError("non-bytes range endpoint")
+    _wb(out, begin)
+    p = _prefix_len(begin, end)
+    _wv(out, p)
+    _wv(out, len(end) - p)
+    out += end[p:]
+    if flags & 2:
+        _wb(out, v.tag.encode())
+    return bytes(out)
+
+
+def _dec_get_key_values_request(r: Reader) -> Any:
+    from ..server.interfaces import GetKeyValuesRequest
+    flags = r._d[r._o]
+    r._o += 1
+    version = _rz(r)
+    limit = _rv(r)
+    limit_bytes = _rv(r)
+    begin = _rb(r)
+    p = _rv(r)
+    s = _rv(r)
+    end = begin[:p] + _rd_raw(r, s)
+    tag = _rb(r).decode() if flags & 2 else ""
+    return GetKeyValuesRequest(begin=begin, end=end, version=version,
+                               limit=limit, limit_bytes=limit_bytes,
+                               reverse=bool(flags & 1), tag=tag)
+
+
 def _enc_get_key_values_reply(v: Any) -> bytes:
+    """Format v2 (ISSUE 15): the reply's KEYS ride one prefix-truncated
+    stream (adjacent result rows share long prefixes — a range scan's
+    whole point) and VALUES ride a varint length column + one contiguous
+    blob.  v1 interleaved values into the key stream, diffing each value
+    against its neighboring key — pure overhead for binary values."""
     out = bytearray()
     out.append((1 if v.more else 0))
     _wz(out, v.version)
     data = v.data
-    _wv(out, len(data))
     keys: list = []
+    vals: list = []
     for k, val in data:
+        if type(k) is not bytes or type(val) is not bytes:
+            raise TypeError("non-bytes row")
         keys.append(k)
-        keys.append(val)
+        vals.append(val)
     _enc_key_stream(out, keys)
+    for val in vals:
+        _wv(out, len(val))
+    out += b"".join(vals)
     return bytes(out)
 
 
@@ -814,11 +863,17 @@ def _dec_get_key_values_reply(r: Reader) -> Any:
     flags = r._d[r._o]
     r._o += 1
     version = _rz(r)
-    n = _rv(r)
     keys = _dec_key_stream(r)
-    data = [(keys[2 * i], keys[2 * i + 1]) for i in range(n)]
-    return GetKeyValuesReply(data=data, more=bool(flags & 1),
-                             version=version)
+    lens = [_rv(r) for _ in range(len(keys))]
+    d = r._d
+    o = r._o
+    vals = []
+    for n in lens:
+        vals.append(d[o:o + n])
+        o += n
+    r._o = o
+    return GetKeyValuesReply(data=list(zip(keys, vals)),
+                             more=bool(flags & 1), version=version)
 
 
 # -- TLogPeekReply (TLog -> storage pull path) -------------------------------
@@ -888,6 +943,8 @@ _COLUMNAR_CODECS: Dict[str, tuple] = {
     "TLogPeekReply": (_enc_tlog_peek_reply, _dec_tlog_peek_reply),
     "GetValueRequest": (_enc_get_value_request, _dec_get_value_request),
     "GetValueReply": (_enc_get_value_reply, _dec_get_value_reply),
+    "GetKeyValuesRequest": (_enc_get_key_values_request,
+                            _dec_get_key_values_request),
     "GetKeyValuesReply": (_enc_get_key_values_reply,
                           _dec_get_key_values_reply),
 }
@@ -909,7 +966,7 @@ def _encode_hot(w: Writer, v: Any) -> None:
             payload = None  # vocabulary: the legacy format carries it
     if payload is not None:
         w.u8(T_COLUMNAR).str_(name)
-        w.u8(_COLUMNAR_VERSION)
+        w.u8(_codec_version(name))
         w._parts.append(payload)
         col.counter("ColumnarFrames").add(1)
         col.counter("ColumnarBytes").add(len(payload))
@@ -927,9 +984,10 @@ def _decode_columnar(r: Reader) -> Any:
     t0 = _now()
     name = r.str_()
     ver = r.u8()
-    if ver != _COLUMNAR_VERSION:
+    if ver != _codec_version(name):
         raise FdbError(ERROR_CODES["internal_error"],
-                       message=f"unknown columnar frame version {ver}")
+                       message=f"unknown columnar frame version {ver} "
+                               f"for {name}")
     codec = _COLUMNAR_CODECS.get(name)
     if codec is None:
         raise FdbError(ERROR_CODES["internal_error"],

@@ -543,6 +543,13 @@ class DataDistributor:
 
     # -- shard-size tracking (reference DataDistributionTracker) -------------
     async def _split_loop(self) -> None:
+        """Per-shard size poll.  Storage answers from its incremental
+        _ShardMetricsCache (ISSUE 15): a shard with no writes since the
+        last poll costs O(1) server-side — no key scan — so this sweep
+        is O(changed shards) in scan work even on stores with millions
+        of keys; only shards over the split threshold (which need a
+        split key) and periodic refreshes walk their keys.  The cold-
+        shard poll BACKOFF below additionally thins the RPC count."""
         knobs = server_knobs()
         while True:
             await delay(float(knobs.DD_METRICS_INTERVAL))

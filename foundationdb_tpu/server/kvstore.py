@@ -73,6 +73,11 @@ class IKeyValueStore:
     async def recover(self) -> None:
         raise NotImplementedError
 
+    def stats(self) -> dict:
+        """Engine-shape counters for bench/status (page counts, key
+        counts); engines override with what they can report cheaply."""
+        return {"engine": type(self).__name__}
+
 
 class KVStoreMemory(IKeyValueStore):
     """Log-structured memory engine (reference KeyValueStoreMemory)."""
@@ -130,6 +135,10 @@ class KVStoreMemory(IKeyValueStore):
         lo = bisect.bisect_left(self._keys, begin)
         hi = bisect.bisect_left(self._keys, end)
         return [(k, self._map[k]) for k in self._keys[lo:hi][:limit]]
+
+    def stats(self) -> dict:
+        return {"engine": "memory", "keys": len(self._keys),
+                "wal_bytes_since_snapshot": self._wal_bytes_since_snapshot}
 
     # -- snapshot + recovery (reference log-structured snapshot + WAL) -------
     async def _write_snapshot(self) -> None:

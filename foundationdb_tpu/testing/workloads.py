@@ -1559,3 +1559,201 @@ class SchedRepairLoadWorkload(TestWorkload):
             self.metrics["audit_violations"] = float(len(bad))
             return False
         return lo <= hot_total <= hi
+
+
+@register_workload
+class ZipfianReadStormWorkload(TestWorkload):
+    """Zipfian hot-key read storm + range scans under live mutation
+    (ISSUE 15; reference ReadWrite.actor.cpp's skewed-access mode):
+    readers hammer a log-uniform (Zipf-like) hot set with point reads
+    and long get_range scans while writers rewrite values in place.
+
+    Every value is self-describing — b"%06d|" % index + payload — so
+    EVERY read is an invariant check, not just load: a point read must
+    return its own index prefix (a cross-wired columnar reply or a
+    stale-shard read returns some OTHER row's bytes), and every scan
+    must come back sorted, gap-free in index space, and prefix-correct
+    per row.  This is the read-path mirror of Cycle: any decode/scan
+    fast-path bug that swaps, drops or duplicates rows trips it under
+    nemesis, not just in quiet parity tests."""
+
+    name = "ZipfianReadStorm"
+
+    PREFIX = b"zipfr/"
+
+    def _key(self, i: int) -> bytes:
+        return self.PREFIX + b"%06d" % i
+
+    @staticmethod
+    def _check_row(k: bytes, v: bytes) -> bool:
+        # zipfr/NNNNNN -> value must start b"NNNNNN|".
+        return v.startswith(k[-6:] + b"|")
+
+    async def setup(self) -> None:
+        n = int(self.config.get("nodeCount", 120))
+
+        async def populate(t):
+            for i in range(n):
+                t.set(self._key(i), b"%06d|seed" % i)
+        await self.run_transaction(populate)
+
+    async def start(self) -> None:
+        import math
+        n = int(self.config.get("nodeCount", 120))
+        actors = int(self.config.get("actorCount", 4))
+        duration = float(self.config.get("testDuration", 8.0))
+        point_reads = int(self.config.get("readsPerTransaction", 6))
+        scan_limit = int(self.config.get("scanLimit", 40))
+        rng = random.Random(int(self.config.get("seed", 15)))
+        deadline = now() + duration
+        stats = {"points": 0, "scans": 0, "scan_rows": 0, "writes": 0}
+        violations: List = []
+        log_n = math.log(n)
+
+        def zipf(r) -> int:
+            # Log-uniform rank: index 0 is the celebrity object.
+            return min(n - 1, int(math.exp(r.random() * log_n)) - 1)
+
+        async def reader(seed: int) -> None:
+            r = random.Random(seed)
+            while now() < deadline:
+                async def txn_fn(t):
+                    for _ in range(point_reads):
+                        i = zipf(r)
+                        v = await t.get(self._key(i), snapshot=True)
+                        if v is None or not self._check_row(self._key(i), v):
+                            violations.append(("point", i, v))
+                        stats["points"] += 1
+                    if r.random() < 0.5:
+                        lo = r.randrange(n)
+                        rev = r.random() < 0.25
+                        rows = await t.get_range(
+                            self._key(lo), self.PREFIX + b"\xff",
+                            limit=scan_limit, snapshot=True, reverse=rev)
+                        idx = [int(k[-6:]) for k, _v in rows]
+                        count = min(scan_limit, n - lo)
+                        # Forward: ascending from lo; reverse: descending
+                        # from the top of the keyspace.  Writers only
+                        # rewrite values, so the index set is stable and
+                        # the expectation exact.
+                        expect = (list(range(n - 1, n - 1 - count, -1))
+                                  if rev else list(range(lo, lo + count)))
+                        if idx != expect:
+                            violations.append(("scan-shape", lo, idx[:8]))
+                        for k, v in rows:
+                            if not self._check_row(k, v):
+                                violations.append(("scan-row", k, v))
+                        stats["scans"] += 1
+                        stats["scan_rows"] += len(rows)
+                await self.run_transaction(txn_fn)
+
+        async def writer(seed: int) -> None:
+            r = random.Random(seed)
+            j = 0
+            while now() < deadline:
+                async def txn_fn(t):
+                    for _ in range(2):
+                        i = zipf(r)
+                        t.set(self._key(i), b"%06d|w%07d" % (i, j))
+                        stats["writes"] += 1
+                await self.run_transaction(txn_fn)
+                j += 1
+                await delay(0.05)
+
+        await wait_all(
+            [spawn(reader(rng.randrange(1 << 30)), "zipf.reader")
+             for _ in range(actors)] +
+            [spawn(writer(rng.randrange(1 << 30)), "zipf.writer")])
+        self._violations = violations
+        for k, v in stats.items():
+            self.metrics[k] = float(v)
+        self.metrics["violations"] = float(len(violations))
+
+    async def check(self) -> bool:
+        n = int(self.config.get("nodeCount", 120))
+
+        async def audit(t):
+            rows = await t.get_range(self.PREFIX, self.PREFIX + b"\xff",
+                                     limit=n + 10)
+            return rows
+        rows = await self.run_transaction(audit)
+        ok = (len(rows) == n and
+              all(self._check_row(k, v) for k, v in rows) and
+              [int(k[-6:]) for k, _ in rows] == list(range(n)))
+        return ok and not getattr(self, "_violations", [])
+
+
+@register_workload
+class WatchFanoutWorkload(TestWorkload):
+    """Watch fan-out: ONE key, many watchers (ISSUE 15's celebrity-
+    object scenario; reference WatchAndWait.actor.cpp at scale): every
+    watcher loops get -> watch -> await-fire until it observes the
+    writer's FINAL sentinel, re-registering through chaos errors
+    (broken_promise from a killed storage, too_old after clogs).  The
+    storage server keeps ONE trigger entry per key however many watchers
+    park on it, so the fan-out costs O(1) server state per fire.
+
+    check(): every watcher terminated by OBSERVING the sentinel — a
+    watch plane that drops fires under nemesis hangs the workload
+    (loud timeout) instead of passing silently."""
+
+    name = "WatchFanout"
+
+    KEY = b"fanout/cell"
+    FINAL = b"final"
+
+    async def start(self) -> None:
+        watchers = int(self.config.get("watchCount", 32))
+        bumps = int(self.config.get("bumpCount", 5))
+        duration = float(self.config.get("testDuration", 8.0))
+        fires = [0]
+        done = [0]
+
+        async def setup(t):
+            t.set(self.KEY, b"v0")
+        await self.run_transaction(setup)
+
+        async def watcher(i: int) -> None:
+            while True:
+                async def get_watch(t):
+                    v = await t.get(self.KEY, snapshot=True)
+                    if v == self.FINAL:
+                        return None
+                    f = await t.watch(self.KEY)
+                    await t.commit()
+                    return f
+                f = await self.run_transaction(get_watch)
+                if f is None:
+                    break
+                try:
+                    await f
+                    fires[0] += 1
+                except FdbError:
+                    # Watch holder died / clogged away: re-register off a
+                    # fresh read — the loop's get decides liveness.
+                    pass
+            done[0] += 1
+
+        async def writer() -> None:
+            for j in range(bumps):
+                await delay(duration / (bumps + 1))
+
+                async def bump(t, j=j):
+                    t.set(self.KEY, b"v%d" % (j + 1))
+                await self.run_transaction(bump)
+
+            async def fin(t):
+                t.set(self.KEY, self.FINAL)
+            await self.run_transaction(fin)
+
+        await wait_all([spawn(watcher(i), "fanout.watch")
+                        for i in range(watchers)] + [spawn(writer())])
+        self.metrics["watchers_done"] = float(done[0])
+        self.metrics["watch_fires"] = float(fires[0])
+
+    async def check(self) -> bool:
+        async def final(t):
+            return await t.get(self.KEY)
+        return (await self.run_transaction(final) == self.FINAL and
+                self.metrics.get("watchers_done", 0) ==
+                int(self.config.get("watchCount", 32)))

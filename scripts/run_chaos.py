@@ -48,7 +48,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # ledger like every other registered marker.
 DEFAULT_SPECS = ("ChaosTest.toml", "CycleTest.toml", "TenantTest.toml",
                  "TwoRegionChaosTest.toml", "BackupRestoreChaosTest.toml",
-                 "SchedChaosTest.toml", "E2eThroughputTest.toml")
+                 "SchedChaosTest.toml", "E2eThroughputTest.toml",
+                 "ReadStormTest.toml")
 
 
 def _ensure_hash_seed_pinned() -> None:

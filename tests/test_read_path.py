@@ -1,0 +1,354 @@
+"""Read-path fast paths (ISSUE 15): prefix-compressed B-tree leaf pages
+(on-disk compat both knob postures), vectorized range scans (btree +
+VersionedMap, bit-identical to the plain paths), and the incremental
+shard-metrics cache (exact totals, split/merge boundary eviction)."""
+
+import pytest
+
+from foundationdb_tpu.core import (DeterministicRandom, EventLoop,
+                                   set_deterministic_random, set_event_loop)
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.server.kvstore import open_kv_store
+from foundationdb_tpu.server.sim_fs import SimFileSystem
+from foundationdb_tpu.server.storage import VersionedMap, _ShardMetricsCache
+
+_loop = None
+
+
+def drive(coro):
+    return _loop.run_until(_loop.spawn(coro), timeout=120)
+
+
+def fresh_loop():
+    global _loop
+    _loop = EventLoop(sim=True)
+    set_event_loop(_loop)
+
+
+@pytest.fixture()
+def knobs():
+    k = server_knobs()
+    saved = (k.BTREE_PREFIX_COMPRESSION, k.STORAGE_VECTORIZED_SCAN,
+             k.STORAGE_INCREMENTAL_SHARD_METRICS)
+    yield k
+    (k.BTREE_PREFIX_COMPRESSION, k.STORAGE_VECTORIZED_SCAN,
+     k.STORAGE_INCREMENTAL_SHARD_METRICS) = saved
+    set_event_loop(None)
+
+
+def _key(i: int) -> bytes:
+    return b"tenant/0001/table/users/row/%08d" % i
+
+
+# ---------------------------------------------------------------------------
+# B-tree prefix compression
+# ---------------------------------------------------------------------------
+
+def _build_btree(compress: bool, n=3000, value=b"v" * 20):
+    server_knobs().BTREE_PREFIX_COMPRESSION = compress
+    fs = SimFileSystem()
+    eng = open_kv_store("btree", fs, "bt")
+    drive(eng.recover())
+    for base in range(0, n, 400):
+        for i in range(base, min(base + 400, n)):
+            eng.set(_key(i), value)
+        drive(eng.commit())
+    return fs, eng
+
+
+def test_btree_compression_packs_more_per_page(knobs):
+    fresh_loop()
+    set_deterministic_random(DeterministicRandom(3))
+    _fs, plain = _build_btree(False)
+    _fs, comp = _build_btree(True)
+    live_plain = plain.page_count - len(plain.free)
+    live_comp = comp.page_count - len(comp.free)
+    # 28-byte shared prefixes on 32-byte keys: compression must shrink
+    # the live page set materially, not marginally.
+    assert live_comp < live_plain * 0.75, (live_plain, live_comp)
+    knobs.BTREE_PREFIX_COMPRESSION = False
+    assert plain.read_range(b"", b"\xff") == comp.read_range(b"", b"\xff")
+
+
+def test_btree_on_disk_compat_both_directions(knobs):
+    """A compressed store read by a knobs-OFF engine and a plain store
+    read by a knobs-ON engine both decode fully (pages self-describe
+    via their kind byte), including across power-fail recovery."""
+    fresh_loop()
+    set_deterministic_random(DeterministicRandom(4))
+    for write_compressed in (False, True):
+        fs, eng = _build_btree(write_compressed, n=800)
+        expect = eng.read_range(b"", b"\xff")
+        assert len(expect) == 800
+        fs.power_fail_all()
+        # Opposite posture at recovery.
+        knobs.BTREE_PREFIX_COMPRESSION = not write_compressed
+        eng2 = open_kv_store("btree", fs, "bt")
+        drive(eng2.recover())
+        assert eng2.read_range(b"", b"\xff") == expect
+        # Mixed file: keep writing under the new posture — old and new
+        # pages coexist.
+        for i in range(800, 1000):
+            eng2.set(_key(i), b"nv")
+        drive(eng2.commit())
+        rows = eng2.read_range(b"", b"\xff")
+        assert len(rows) == 1000
+        assert rows[:800] == expect
+
+
+def test_btree_knobs_off_pages_bit_identical(knobs):
+    """The knobs-off page image must not move: same ops, byte-identical
+    files (the on-disk goldens equivalent of the wire guard)."""
+    fresh_loop()
+    set_deterministic_random(DeterministicRandom(5))
+    knobs.BTREE_PREFIX_COMPRESSION = False
+    images = []
+    for _ in range(2):
+        fs, eng = _build_btree(False, n=500)
+        f = fs.open("bt.btree")
+        images.append(drive(f.read(0, f.size())))
+    assert images[0] == images[1]
+
+
+def test_btree_vectorized_scan_parity_with_overflow(knobs):
+    """Vectorized scans must match the recursive path including
+    overflow-chained big values and mid-leaf limits."""
+    fresh_loop()
+    set_deterministic_random(DeterministicRandom(6))
+    for compress in (False, True):
+        knobs.BTREE_PREFIX_COMPRESSION = compress
+        fs = SimFileSystem()
+        eng = open_kv_store("btree", fs, "bt")
+        drive(eng.recover())
+        rng = DeterministicRandom(61)
+        for i in range(600):
+            size = 3000 if rng.random01() < 0.05 else 20   # some overflow
+            eng.set(_key(i), bytes([i % 256]) * size)
+        drive(eng.commit())
+        knobs.STORAGE_VECTORIZED_SCAN = False
+        for lo, hi, limit in ((0, 600, 1 << 30), (37, 411, 55),
+                              (100, 101, 1), (599, 600, 10)):
+            plain = eng.read_range(_key(lo), _key(hi), limit)
+            knobs.STORAGE_VECTORIZED_SCAN = True
+            vec = eng.read_range(_key(lo), _key(hi), limit)
+            knobs.STORAGE_VECTORIZED_SCAN = False
+            assert plain == vec, (compress, lo, hi, limit)
+
+
+def test_btree_compressed_single_key_and_empty_suffix(knobs):
+    """Edge pages: a one-key leaf (prefix == whole key, empty suffix)
+    and keys where one IS the shared prefix of the others."""
+    fresh_loop()
+    set_deterministic_random(DeterministicRandom(7))
+    knobs.BTREE_PREFIX_COMPRESSION = True
+    fs = SimFileSystem()
+    eng = open_kv_store("btree", fs, "bt")
+    drive(eng.recover())
+    eng.set(b"solo", b"v1")
+    drive(eng.commit())
+    assert eng.read_value(b"solo") == b"v1"
+    eng.set(b"solo/child", b"v2")
+    eng.set(b"solo/child2", b"v3")
+    drive(eng.commit())
+    fs.power_fail_all()
+    knobs.BTREE_PREFIX_COMPRESSION = False
+    eng2 = open_kv_store("btree", fs, "bt")
+    drive(eng2.recover())
+    assert eng2.read_range(b"", b"\xff") == [
+        (b"solo", b"v1"), (b"solo/child", b"v2"), (b"solo/child2", b"v3")]
+
+
+# ---------------------------------------------------------------------------
+# VersionedMap vectorized scan
+# ---------------------------------------------------------------------------
+
+def test_versioned_map_vectorized_parity(knobs):
+    fresh_loop()
+    vm = VersionedMap()
+    rng = DeterministicRandom(42)
+    for v in range(1, 300):
+        for _ in range(3):
+            i = rng.random_int(0, 200)
+            vm.set(_key(i), None if rng.random01() < 0.2
+                   else b"u%05d" % v, v)
+    for _ in range(200):
+        a = rng.random_int(0, 210)
+        b = rng.random_int(0, 210)
+        a, b = min(a, b), max(a, b)
+        args = (_key(a), _key(b), rng.random_int(1, 310),
+                rng.random_int(1, 30), rng.random_int(1, 2000),
+                rng.random01() < 0.4)
+        knobs.STORAGE_VECTORIZED_SCAN = False
+        plain = vm.range_read(*args)
+        knobs.STORAGE_VECTORIZED_SCAN = True
+        assert vm.range_read(*args) == plain
+
+
+# ---------------------------------------------------------------------------
+# Incremental shard-metrics cache
+# ---------------------------------------------------------------------------
+
+def test_shard_cache_totals_exact_under_mutation():
+    vm = VersionedMap()
+    cache = _ShardMetricsCache()
+    vm._metrics_cache = cache
+    rng = DeterministicRandom(9)
+    bounds = [_key(i) for i in (0, 50, 200, 400)]
+    shards = list(zip(bounds, bounds[1:]))
+    ver = 0
+    hits = 0
+    for _round in range(25):
+        for _ in range(40):
+            ver += 1
+            i = rng.random_int(0, 399)
+            vm.set(_key(i), None if rng.random01() < 0.15
+                   else b"x" * rng.random_int(1, 50), ver)
+        for b, e in shards:
+            hit = cache.get(b, e)
+            fresh = vm.range_bytes(b, e, ver)
+            if hit is not None:
+                assert hit == fresh
+                hits += 1
+            cache.put(b, e, *fresh)
+    assert hits >= 40
+
+
+def test_shard_cache_split_and_merge_boundaries():
+    """A split's new sub-ranges miss (end mismatch) and re-scan; a
+    merge's put() evicts the absorbed boundary so it cannot keep
+    soaking up deltas that belong to the merged shard."""
+    vm = VersionedMap()
+    cache = _ShardMetricsCache()
+    vm._metrics_cache = cache
+    for i in range(100):
+        vm.set(_key(i), b"v" * 10, i + 1)
+    whole = vm.range_bytes(_key(0), _key(100), 1000)
+    cache.put(_key(0), _key(100), *whole)
+    # Split: polls now come as (0,50) and (50,100) — both must miss.
+    assert cache.get(_key(0), _key(50)) is None
+    left = vm.range_bytes(_key(0), _key(50), 1000)
+    right = vm.range_bytes(_key(50), _key(100), 1000)
+    cache.put(_key(0), _key(50), *left)
+    cache.put(_key(50), _key(100), *right)
+    assert cache.get(_key(0), _key(50)) == left
+    # Mutate inside the right half; the right entry tracks it exactly.
+    vm.set(_key(77), b"w" * 30, 2000)
+    assert cache.get(_key(50), _key(100)) == \
+        vm.range_bytes(_key(50), _key(100), 2000)
+    # Merge back: put(0,100) must evict the stale (50,100) boundary...
+    whole2 = vm.range_bytes(_key(0), _key(100), 2000)
+    cache.put(_key(0), _key(100), *whole2)
+    # ...so a delta at key 60 lands on the merged entry, not the ghost.
+    vm.set(_key(60), b"z" * 44, 3000)
+    assert cache.get(_key(0), _key(100)) == \
+        vm.range_bytes(_key(0), _key(100), 3000)
+
+
+def test_shard_cache_rollback_invalidates():
+    vm = VersionedMap()
+    cache = _ShardMetricsCache()
+    vm._metrics_cache = cache
+    for i in range(20):
+        vm.set(_key(i), b"v", i + 1)
+    cache.put(_key(0), _key(20), *vm.range_bytes(_key(0), _key(20), 100))
+    vm.rollback(10)
+    assert cache.get(_key(0), _key(20)) is None   # wholesale invalidation
+    fresh = vm.range_bytes(_key(0), _key(20), 100)
+    cache.put(_key(0), _key(20), *fresh)
+    assert cache.get(_key(0), _key(20)) == fresh
+
+
+def test_shard_cache_refresh_expiry():
+    cache = _ShardMetricsCache()
+    cache.put(b"a", b"b", 100, 5)
+    for _ in range(cache.REFRESH_POLLS - 1):
+        assert cache.get(b"a", b"b") == (100, 5)
+    assert cache.get(b"a", b"b") is None   # expired: forces a re-scan
+
+
+def test_btree_knob_flip_off_never_wedges_dense_leaves(knobs):
+    """A leaf packed under the COMPRESSED size estimate (long shared
+    prefix, tiny suffixes) must stay writable after the knob flips OFF:
+    its plain encoding can exceed a page, so encode() keeps such pages
+    compressed (the knob-flip safety valve) instead of failing every
+    commit that touches them."""
+    fresh_loop()
+    set_deterministic_random(DeterministicRandom(8))
+    knobs.BTREE_PREFIX_COMPRESSION = True
+    fs = SimFileSystem()
+    eng = open_kv_store("btree", fs, "bt")
+    drive(eng.recover())
+    prefix = b"tenant/" + b"x" * 150 + b"/row/"   # 162-byte shared prefix
+    n = 400
+    for i in range(n):
+        eng.set(prefix + b"%04d" % i, b"v")
+    drive(eng.commit())
+    expect = eng.read_range(b"", b"\xff")
+    assert len(expect) == n
+    # Flip OFF and rewrite/clear inside the dense leaves: every commit
+    # must succeed and results stay exact.
+    knobs.BTREE_PREFIX_COMPRESSION = False
+    for i in range(0, n, 7):
+        eng.set(prefix + b"%04d" % i, b"w")
+    eng.clear(prefix + b"0100", prefix + b"0110")
+    drive(eng.commit())
+    rows = eng.read_range(b"", b"\xff")
+    model = dict(expect)
+    for i in range(0, n, 7):
+        model[prefix + b"%04d" % i] = b"w"
+    for i in range(100, 110):
+        model.pop(prefix + b"%04d" % i, None)
+    assert rows == sorted(model.items())
+    # And the store still recovers cleanly.
+    fs.power_fail_all()
+    eng2 = open_kv_store("btree", fs, "bt")
+    drive(eng2.recover())
+    assert eng2.read_range(b"", b"\xff") == rows
+
+
+# ---------------------------------------------------------------------------
+# Client get_range byte budget (limit_bytes)
+# ---------------------------------------------------------------------------
+
+def test_get_range_limit_bytes_budget():
+    """limit_bytes bounds the TOTAL result bytes across shard chunks
+    (crossing row included), composes with RYW overlay rows, works in
+    reverse, and 0 keeps the pre-ISSUE-15 unbounded behavior."""
+    from foundationdb_tpu.server.cluster import SimCluster
+    from foundationdb_tpu.rpc.sim import set_simulator
+    cl = SimCluster(n_storage=2)
+    try:
+        db = cl.database()
+
+        async def go():
+            t = db.create_transaction()
+            for i in range(60):
+                t.set(b"lb/%04d" % i, b"v" * 50)
+            await t.commit()
+            t = db.create_transaction()
+            full = await t.get_range(b"lb/", b"lb0", limit=1000)
+            assert len(full) == 60
+            # ~57 bytes/row: a 300-byte budget stops after ~6 rows,
+            # prefix-exact.
+            capped = await t.get_range(b"lb/", b"lb0", limit=1000,
+                                       limit_bytes=300)
+            assert 0 < len(capped) < 20
+            assert capped == full[:len(capped)]
+            nbytes = sum(len(k) + len(v) for k, v in capped)
+            prev = nbytes - (len(capped[-1][0]) + len(capped[-1][1]))
+            assert nbytes >= 300 > prev   # crossing row included
+            rcapped = await t.get_range(b"lb/", b"lb0", limit=1000,
+                                        reverse=True, limit_bytes=300)
+            assert 0 < len(rcapped) < 20
+            assert rcapped == full[::-1][:len(rcapped)]
+            # RYW rows ride the budget accounting too.
+            t.set(b"lb/0001", b"w" * 50)
+            capped2 = await t.get_range(b"lb/", b"lb0", limit=1000,
+                                        limit_bytes=300)
+            assert capped2[1] == (b"lb/0001", b"w" * 50)
+            return True
+
+        assert cl.run_until(cl.loop.spawn(go()), timeout=60)
+    finally:
+        set_simulator(None)
+        set_event_loop(None)

@@ -1,0 +1,45 @@
+"""`bench.py reads --smoke` — the tier-1 read-path parity gate
+(ISSUE 15): knobs-off read-RPC wire images stay legacy and round-trip,
+columnar-on replies decode identically to columnar-off, compressed vs
+plain B-tree pages yield identical scan results, the vectorized
+VersionedMap scan is bit-identical, and the incremental shard-metrics
+cache never drifts from fresh scans.  Mirrors the `bench.py e2e --smoke`
+gate in tests/test_e2e_bench.py."""
+
+import importlib.util
+import os
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_reads_under_test",
+        os.path.join(os.path.dirname(__file__), os.pardir, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def test_reads_smoke_gate():
+    bench = _load_bench()
+    doc = bench.run_reads_smoke()
+    assert doc["parity"] == "ok"
+    assert doc["wire_parity_msgs"] > 0
+    assert doc["btree_parity_rows"] > 0
+    assert doc["versioned_map_probes"] > 0
+    assert doc["shard_cache_audits"] > 0
+
+
+def test_read_storm_spec_in_default_matrix():
+    """The read-path chaos battery rides the default matrix, so its
+    perf-path knobs run under nemesis on every chaos sweep."""
+    import importlib.util as iu
+    spec = iu.spec_from_file_location(
+        "run_chaos_under_test",
+        os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                     "run_chaos.py"))
+    mod = iu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "ReadStormTest.toml" in mod.DEFAULT_SPECS
+    from foundationdb_tpu.testing import workload_registry
+    assert "ZipfianReadStorm" in workload_registry
+    assert "WatchFanout" in workload_registry

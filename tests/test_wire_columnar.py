@@ -311,3 +311,135 @@ def test_encode_decode_bands_recorded(columnar_knob):
     assert col.counter("LegacyFrames").value == base_lf + 1
     assert col.histogram("Encode").snapshot().count == enc0 + 2
     assert col.histogram("Decode").snapshot().count == dec0 + 2
+
+
+# ---------------------------------------------------------------------------
+# Read-path wire frames (ISSUE 15): goldens + mixed-format interop
+# ---------------------------------------------------------------------------
+
+def canonical_gkv_request():
+    from foundationdb_tpu.server.interfaces import GetKeyValuesRequest
+    return GetKeyValuesRequest(
+        begin=b"golden/table/row/0010", end=b"golden/table/row/0450",
+        version=1000, limit=250, limit_bytes=1 << 20, reverse=False,
+        tag="hot")
+
+
+def canonical_gkv_reply():
+    from foundationdb_tpu.server.interfaces import GetKeyValuesReply
+    return GetKeyValuesReply(
+        data=[(b"golden/table/row/%04d" % i, b"val-%04d" % i)
+              for i in range(10, 16)], more=True, version=1000)
+
+
+def canonical_gv_reply():
+    from foundationdb_tpu.server.interfaces import GetValueReply
+    return GetValueReply(value=b"payload-bytes", version=1000)
+
+
+GKV_REQ_LEGACY_SHA = \
+    "8d4ec1e98461660dc524fbc4615daf8dda904eacbc46816f9653b4054150fced"
+GKV_REQ_COLUMNAR_HEX = (
+    "12130000004765744b657956616c756573526571756573740102d00ffa018080"
+    "4015676f6c64656e2f7461626c652f726f772f30303130120334353003686f74"
+)
+GKV_REPLY_LEGACY_SHA = \
+    "285ac4303d4f01cc31a6fa0f48931e0fa31d0ed9ecf83464af56448a2634babf"
+# NOTE the version byte 0x02 after the name: the read-reply family is
+# format v2 (keys stream + value-length column; v1 interleaved values
+# into the key stream and is REJECTED, not misdecoded).
+GKV_REPLY_COLUMNAR_HEX = (
+    "12110000004765744b657956616c7565735265706c790201d00f060015676f6c"
+    "64656e2f7461626c652f726f772f303031301401311401321401331401341401"
+    "3508080808080876616c2d3030313076616c2d3030313176616c2d3030313276"
+    "616c2d3030313376616c2d3030313476616c2d30303135"
+)
+GV_REPLY_LEGACY_SHA = \
+    "bf82db7996d802a7c29842812c5911a6546289f54eb51ff1210d5219e3898690"
+GV_REPLY_COLUMNAR_HEX = (
+    "120d00000047657456616c75655265706c7901010d7061796c6f61642d627974"
+    "6573d00f"
+)
+
+
+@pytest.mark.parametrize("make,legacy_sha,columnar_hex", [
+    (canonical_gkv_request, GKV_REQ_LEGACY_SHA, GKV_REQ_COLUMNAR_HEX),
+    (canonical_gkv_reply, GKV_REPLY_LEGACY_SHA, GKV_REPLY_COLUMNAR_HEX),
+    (canonical_gv_reply, GV_REPLY_LEGACY_SHA, GV_REPLY_COLUMNAR_HEX),
+], ids=["gkv_request", "gkv_reply", "gv_reply"])
+def test_read_path_wire_goldens(make, legacy_sha, columnar_hex):
+    obj = make()
+    legacy = _encode(obj, columnar=False)
+    assert legacy[0] == serde.T_DATACLASS
+    assert hashlib.sha256(legacy).hexdigest() == legacy_sha, \
+        "knobs-off wire image CHANGED — mixed-version clusters break"
+    col = _encode(obj, columnar=True)
+    assert col[0] == serde.T_COLUMNAR
+    assert col.hex() == columnar_hex, \
+        "columnar frame format CHANGED — bump the codec version instead"
+    assert serde.decode_message(legacy) == obj
+    assert serde.decode_message(col) == obj
+    assert len(col) < len(legacy)
+
+
+def test_read_reply_mixed_format_interop(columnar_knob):
+    """Columnar storage -> legacy-posture client and vice versa: the
+    decoded reply objects are identical both ways (decode is
+    format-transparent, so the knob can flip per process mid-rollout)."""
+    for make in (canonical_gkv_request, canonical_gkv_reply,
+                 canonical_gv_reply):
+        obj = make()
+        blob = _encode(obj, columnar=True)
+        columnar_knob.RPC_COLUMNAR_ENABLED = False
+        decoded_a = serde.decode_message(blob)
+        blob = _encode(obj, columnar=False)
+        columnar_knob.RPC_COLUMNAR_ENABLED = True
+        decoded_b = serde.decode_message(blob)
+        columnar_knob.RPC_COLUMNAR_ENABLED = False
+        assert decoded_a == decoded_b == obj
+
+
+def test_read_reply_edge_payloads(columnar_knob):
+    """Empty replies, empty keys/values, reverse-ordered rows, big
+    values, huge versions — both formats round-trip identically."""
+    from foundationdb_tpu.server.interfaces import (GetKeyValuesReply,
+                                                    GetKeyValuesRequest,
+                                                    GetValueReply)
+    cases = [
+        GetKeyValuesReply(data=[], more=False, version=0),
+        GetKeyValuesReply(data=[(b"", b"")], more=True, version=-5),
+        GetKeyValuesReply(
+            data=[(b"k/%03d" % i, b"x" * 3000) for i in (5, 4, 3)],
+            more=False, version=(1 << 60)),
+        GetKeyValuesRequest(begin=b"", end=b"\xff\xff", version=0,
+                            limit=1, limit_bytes=1),
+        GetValueReply(value=None, version=7),
+        GetValueReply(value=b"", version=7),
+    ]
+    for obj in cases:
+        assert serde.decode_message(_encode(obj, columnar=True)) == obj
+        assert serde.decode_message(_encode(obj, columnar=False)) == obj
+
+
+def test_read_reply_v1_frame_rejected(columnar_knob):
+    """A v1-stamped GetKeyValuesReply frame (the PR-14 interleaved
+    layout) must be REJECTED loudly — misdecoding it as v2 would hand
+    garbage rows to a transaction."""
+    blob = bytearray(_encode(canonical_gkv_reply(), columnar=True))
+    name_len = int.from_bytes(blob[1:5], "little")
+    assert blob[5 + name_len] == 2
+    blob[5 + name_len] = 1
+    from foundationdb_tpu.core.error import FdbError
+    with pytest.raises(FdbError):
+        serde.decode_message(bytes(blob))
+
+
+def test_read_reply_foreign_shape_falls_back(columnar_knob):
+    """Rows that are not plain (bytes, bytes) fall back to the legacy
+    format transparently (the codec never ships bytes it cannot
+    reproduce)."""
+    from foundationdb_tpu.server.interfaces import GetKeyValuesReply
+    rep = GetKeyValuesReply(data=[("strkey", b"v")], more=False, version=1)
+    blob = _encode(rep, columnar=True)
+    assert blob[0] == serde.T_DATACLASS   # fell back
+    assert serde.decode_message(blob) == rep
