@@ -1418,6 +1418,43 @@ def run_e2e() -> dict:
                 acc[name].append({"rate": rate, "counts": counts,
                                   "before": s_before, "after": s_after})
 
+        # ---- peer-health-plane overhead gate (ISSUE 18) ----------------
+        # The ISSUE-14 knobs stay ON (the measured posture); ONLY
+        # PEER_HEALTH_ENABLED flips, interleaved with alternating order
+        # like the main comparison.  The plane's hot-path cost is one
+        # knob read per request plus the per-peer sampling arithmetic,
+        # so the gate wants |overhead| <= 2%.
+        from foundationdb_tpu.core.knobs import server_knobs as _sknobs
+
+        def set_health(on: bool) -> None:
+            async def flip():
+                from foundationdb_tpu.client.management import set_knob
+                await set_knob(db, "PEER_HEALTH_ENABLED", int(on))
+            loop.run_until(loop.spawn(flip()), timeout=60)
+            _sknobs().PEER_HEALTH_ENABLED = bool(on)
+
+        health = {"off": [], "on": []}
+        for rep in range(max(1, E2E_REPEATS)):
+            order = (("off", False), ("on", True))
+            if rep % 2:
+                order = order[::-1]
+            for name, on in order:
+                set_health(on)
+                _e2e_phase(loop, db, "hsettle", 1.5, 2)
+                counts, elapsed = _e2e_phase(
+                    loop, db, f"health-{name}{rep}", E2E_PHASE_S,
+                    E2E_CLIENTS)
+                health[name].append(counts["commits"] / max(elapsed, 1e-9))
+        set_health(True)   # leave the plane in its default posture
+        h_off = sum(health["off"]) / len(health["off"])
+        h_on = sum(health["on"]) / len(health["on"])
+        h_overhead = (h_off - h_on) / h_off * 100.0 if h_off else 0.0
+        _phase(f"e2e health gate: off {h_off:.1f} on {h_on:.1f} "
+               f"commits/s ({h_overhead:+.2f}%)")
+        if abs(h_overhead) > 2.0:
+            print(f"# WARNING: peer-health plane overhead "
+                  f"{h_overhead:.2f}% above the 2% gate", file=sys.stderr)
+
         def fold(phases):
             mean = sum(p["rate"] for p in phases) / len(phases)
             top = max(phases, key=lambda p: p["rate"])
@@ -1450,6 +1487,11 @@ def run_e2e() -> dict:
                                        _e2e_band_totals(on["after"]))},
             "rpc_counters": _e2e_rpc_counters(on["after"]),
             "grv_client_stats": dict(db.grv_stats),
+            "health_overhead": {
+                "disabled_commits_per_s": round(h_off, 1),
+                "enabled_commits_per_s": round(h_on, 1),
+                "overhead_pct": round(h_overhead, 2),
+                "repeats": max(1, E2E_REPEATS)},
         }
         if doc["speedup"] < 1.5:
             print(f"# WARNING: e2e speedup {doc['speedup']} below the "
@@ -1465,6 +1507,7 @@ def run_e2e() -> dict:
         _ck().GRV_BATCH_ENABLED = False
         _ck().GRV_LEASE_S = 0.0
         _sk().RPC_COLUMNAR_ENABLED = False
+        _sk().PEER_HEALTH_ENABLED = True
         set_network(None)
         if loop is not None:
             set_event_loop(None)

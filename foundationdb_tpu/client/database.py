@@ -797,6 +797,10 @@ class Transaction:
     #   scheduler/proxy/<id>   = JSON reorder/repair row
     #   scheduler/totals       = JSON knob posture + cluster totals
     METRICS_SCHEDULER_PREFIX = b"\xff\xff/metrics/scheduler/"
+    # Gray-failure plane (ISSUE 18), from status cluster.peer_health:
+    #   peer_health/degraded/<address>       = JSON >= K-reporter verdict
+    #   peer_health/link/<reporter>/<peer>   = JSON degraded-link row
+    METRICS_PEER_HEALTH_PREFIX = b"\xff\xff/metrics/peer_health/"
 
     @staticmethod
     def _tenant_entry_json(entry) -> bytes:
@@ -886,15 +890,35 @@ class Transaction:
         rows.sort()
         return rows
 
+    def _peer_health_rows(self, doc: dict) -> List[Tuple[bytes, bytes]]:
+        """Rows of the \xff\xff/metrics/peer_health/ module, key-sorted —
+        rendered from the SAME status cluster.peer_health document fdbcli
+        `metrics` prints, so the surfaces agree by construction."""
+        import json as _json
+        p = self.METRICS_PEER_HEALTH_PREFIX
+        rows: List[Tuple[bytes, bytes]] = []
+        for row in doc.get("links", []) or []:
+            rows.append((
+                p + b"link/" + str(row.get("reporter", "")).encode() +
+                b"/" + str(row.get("peer", "")).encode(),
+                _json.dumps(row).encode()))
+        for entry in doc.get("degraded_processes", []) or []:
+            rows.append((
+                p + b"degraded/" + str(entry.get("address", "")).encode(),
+                _json.dumps(entry).encode()))
+        rows.sort()
+        return rows
+
     async def _all_metrics_rows(self) -> List[Tuple[bytes, bytes]]:
         """Every row of the \xff\xff/metrics/ module family (heat +
-        scheduler), key-sorted, from ONE status fetch."""
+        scheduler + peer health), key-sorted, from ONE status fetch."""
         get_status = getattr(self.db.cluster, "get_status", None)
         if get_status is None:
             return []
         cl = (await get_status()).get("cluster", {})
         rows = self._heat_rows(cl.get("heat", {}) or {})
         rows += self._sched_rows(cl.get("scheduler", {}) or {})
+        rows += self._peer_health_rows(cl.get("peer_health", {}) or {})
         rows.sort()
         return rows
 
@@ -972,15 +996,27 @@ class Transaction:
         ssis = await self.db.get_key_location(key)
         if not ssis:
             raise err("wrong_shard_server", f"no team for {key!r}")
+        if self.debug_id:
+            # Point-read leg of the cross-role timeline (reference
+            # g_traceBatch NativeAPI.getValue points): the id rides the
+            # request so storage can stamp its server-side points too.
+            from ..core.trace import trace_batch_event
+            trace_batch_event("TransactionDebug", self.debug_id,
+                              "NativeAPI.getValue.Before")
         try:
             reply = await self.db.read_replica(
                 ssis, lambda s: s.get_value,
                 lambda: GetValueRequest(key=key, version=version,
+                                        debug_id=self.debug_id,
                                         tag=self.tag))
         except FdbError as e:
             if e.name in ("broken_promise", "wrong_shard_server"):
                 self.db.invalidate_cache(key)
             raise
+        if self.debug_id:
+            from ..core.trace import trace_batch_event
+            trace_batch_event("TransactionDebug", self.debug_id,
+                              "NativeAPI.getValue.After")
         return reply.value
 
     async def get_range(self, begin: bytes, end: bytes, limit: int = 1000,
@@ -1069,11 +1105,23 @@ class Transaction:
         if not ssis:
             raise err("wrong_shard_server")
         kwargs = {"limit_bytes": limit_bytes} if limit_bytes > 0 else {}
+        if self.debug_id:
+            # Per-chunk points (reference g_traceBatch NativeAPI.getRange):
+            # a multi-shard scan shows one Before/After pair per storage
+            # round-trip in the read waterfall.
+            from ..core.trace import trace_batch_event
+            trace_batch_event("TransactionDebug", self.debug_id,
+                              "NativeAPI.getRange.Before")
         reply = await self.db.read_replica(
             ssis, lambda s: s.get_key_values,
             lambda: GetKeyValuesRequest(begin=cursor, end=shard_end,
                                         version=version, limit=limit,
+                                        debug_id=self.debug_id,
                                         tag=self.tag, **kwargs))
+        if self.debug_id:
+            from ..core.trace import trace_batch_event
+            trace_batch_event("TransactionDebug", self.debug_id,
+                              "NativeAPI.getRange.After")
         if reply.more and reply.data:
             return reply.data, key_after(reply.data[-1][0])
         return reply.data, shard_end
@@ -1089,12 +1137,20 @@ class Transaction:
         if not ssis:
             raise err("wrong_shard_server")
         kwargs = {"limit_bytes": limit_bytes} if limit_bytes > 0 else {}
+        if self.debug_id:
+            from ..core.trace import trace_batch_event
+            trace_batch_event("TransactionDebug", self.debug_id,
+                              "NativeAPI.getRange.Before")
         reply = await self.db.read_replica(
             ssis, lambda s: s.get_key_values,
             lambda: GetKeyValuesRequest(begin=shard_begin, end=cursor,
                                         version=version, limit=limit,
-                                        reverse=True, tag=self.tag,
-                                        **kwargs))
+                                        reverse=True, debug_id=self.debug_id,
+                                        tag=self.tag, **kwargs))
+        if self.debug_id:
+            from ..core.trace import trace_batch_event
+            trace_batch_event("TransactionDebug", self.debug_id,
+                              "NativeAPI.getRange.After")
         if reply.more and reply.data:
             return reply.data, reply.data[-1][0]   # inclusive smallest key
         return reply.data, shard_begin

@@ -98,6 +98,10 @@ for _name in (
     "ProxyBatchReordered",
     "ProxyTxnRepaired",
     "ProxyTxnRepairCommitted",
+    # Gray-failure battery (ISSUE 18): one live link latency-inflated —
+    # delivery still succeeds, so only the peer-health plane (ping RTT
+    # verdicts, server/health.py) can observe it.
+    "ChaosNemesisGrayClog",
     # Shard-disownment fence (system_data.py DISOWN_SHARD_PREFIX): a
     # storage server that missed DD's out-of-band RemoveShardRequest
     # (unreachable during the move) closes the range in-stream instead

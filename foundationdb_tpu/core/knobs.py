@@ -119,6 +119,37 @@ class ServerKnobs(KnobBase):
         # deterministic interval.
         self.WORKER_REGISTER_INTERVAL_S = 30.0
 
+        # Peer-health plane (ISSUE 18; reference the 7.1 worker health
+        # monitor: WorkerInterface.actor.cpp UpdateWorkerHealthRequest +
+        # ClusterController degradation tracking).  Master switch gates
+        # every per-peer sample in both transports AND the ping actor, so
+        # the bench overhead gate can measure enabled-vs-disabled.
+        self.PEER_HEALTH_ENABLED = True
+        # Ping-actor cadence: each worker's health monitor pings every
+        # known peer this often (deterministic virtual-time delay in sim).
+        self.PEER_PING_INTERVAL_S = 1.0
+        # A peer whose ping/request RTT EMA exceeds this is latency-
+        # degraded (the gray-failure signal a quorum check can't see).
+        self.PEER_DEGRADED_LATENCY_S = 0.050
+        # ... or whose timeout fraction (timeouts+disconnects over total
+        # attempts in the current window) exceeds this.
+        self.PEER_TIMEOUT_FRACTION = 0.25
+        # Hysteresis: a peer must stay above/below threshold for this many
+        # consecutive health-monitor evaluations before its verdict flips
+        # (verdicts must not flap on one bad sample).
+        self.PEER_VERDICT_HYSTERESIS = 2
+        # CC-side aggregation: a process is cluster-degraded only when at
+        # least this many INDEPENDENT workers report it degraded.
+        self.CC_DEGRADATION_REPORTERS = 2
+        # Health reports older than this are aged out of CC aggregation
+        # (a silent reporter must not pin a stale verdict forever).
+        self.CC_HEALTH_REPORT_MAX_AGE_S = 30.0
+        # Action hook: when ON, a cluster-degraded TLog/resolver triggers
+        # a recovery-based eviction.  DEFAULT OFF with bit-identical
+        # off-posture (parity gate in tier-1): with the knob off the CC
+        # only *reports* — no RNG draw, no scheduling perturbation.
+        self.CC_HEALTH_TRIGGERED_RECOVERY = False
+
         # Resolver (reference ServerKnobs.cpp:439)
         self.RESOLVER_STATE_MEMORY_LIMIT = 1_000_000
         self.KEY_BYTES_PER_SAMPLE = 2e4
@@ -377,6 +408,15 @@ class ServerKnobs(KnobBase):
         # tLogPeekMessages): a lagging puller's catch-up peek pages through
         # the spilled backlog instead of materializing all of it at once.
         self.TLOG_PEEK_DESIRED_BYTES = 1e6
+        # Upper bound on a GRV batch's TLog liveness confirm + master
+        # version fetch (reference TLOG_TIMEOUT in getLiveCommittedVersion):
+        # expiry means this proxy's log generation is wedged or displaced
+        # and the proxy must DIE VISIBLY so recovery replaces the epoch —
+        # a confirm that neither replies nor errors (e.g. the request
+        # parked behind a superseded generation) would otherwise wedge
+        # every future GRV on this proxy.  Sits well above the nemesis's
+        # deliberate <=2 s link clogs so healthy epochs ride those out.
+        self.TLOG_CONFIRM_TIMEOUT_S = 5.0
         # Region replication (log_router.py): bound on a LogRouter's
         # buffered bytes — past it, pulling pauses and the primary TLogs
         # absorb the remote lag via spill-by-reference.

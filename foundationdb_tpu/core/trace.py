@@ -23,6 +23,13 @@ class Severity:
     Error = 40
 
 
+#: Severity value -> status-JSON label (reference Status.actor.cpp's
+#: message severities; status cluster.messages rolls counts per label).
+SEVERITY_NAMES = {Severity.Debug: "debug", Severity.Info: "info",
+                  Severity.Warn: "warning", Severity.WarnAlways:
+                  "warning_always", Severity.Error: "error"}
+
+
 class Tracer:
     """In-memory ring + optional rolling JSONL file.
 
@@ -41,6 +48,10 @@ class Tracer:
         self._fh = open(path, "a", encoding="utf-8") if path else None
         self.error_count = 0
         self.events_emitted = 0
+        # Lifetime event counts per severity value (status
+        # cluster.messages): bumped under the emit lock, so per-connection
+        # threads can't lose increments.
+        self.severity_counts: Dict[int, int] = {}
         self.roll_bytes = roll_bytes
         self.keep_files = max(1, keep_files)
         self.flush_every = max(1, flush_every)
@@ -95,7 +106,9 @@ class Tracer:
         with self._lock:
             self.ring.append(event)
             self.events_emitted += 1
-            if event.get("Severity", 10) >= Severity.Error:
+            sev = event.get("Severity", 10)
+            self.severity_counts[sev] = self.severity_counts.get(sev, 0) + 1
+            if sev >= Severity.Error:
                 self.error_count += 1
             if self._fh:
                 line = json.dumps(event, default=str) + "\n"
@@ -113,13 +126,27 @@ class Tracer:
             if self._fh:
                 self._fh.flush()
 
-    def find(self, type_name: str) -> List[Dict[str, Any]]:
+    def find(self, type_name: str,
+             min_severity: Optional[int] = None) -> List[Dict[str, Any]]:
         # Snapshot under the lock: per-connection threads append to the
         # ring through emit(), and iterating a deque mid-append is
         # undefined (FTL012 catch).
         with self._lock:
             events = list(self.ring)
-        return [e for e in events if e.get("Type") == type_name]
+        return [e for e in events if e.get("Type") == type_name and
+                (min_severity is None or
+                 e.get("Severity", 10) >= min_severity)]
+
+    def messages(self) -> Dict[str, int]:
+        """Per-severity-label lifetime counts (the status
+        cluster.messages shape)."""
+        with self._lock:
+            counts = dict(self.severity_counts)
+        out: Dict[str, int] = {}
+        for sev, n in counts.items():
+            label = SEVERITY_NAMES.get(sev, f"sev{sev}")
+            out[label] = out.get(label, 0) + n
+        return dict(sorted(out.items()))
 
     def close(self) -> None:
         # Final accounting (the reference's TraceLog close summary): a

@@ -45,6 +45,15 @@ class SimNetwork:
         # (reference sim2 clogInterface — the unit the nemesis swizzles).
         self._clog_ip_until: Dict[str, float] = {}
         self._partitioned: set = set()  # frozenset({ip, ip})
+        # Gray clog (ISSUE 18): (ip, ip) -> (extra latency, until).
+        # Unlike a clog, delivery still HAPPENS — just inflated: the
+        # slow-but-alive link shape that quorum checks can never see and
+        # the peer-health plane exists to detect.
+        self._gray_until: Dict[Tuple[str, str], Tuple[float, float]] = {}
+        # Per-source-ip peer telemetry (rpc/peer_metrics.py): each
+        # simulated process observes its own peers, exactly like one
+        # real-mode process's transport table.
+        self._peer_tables: Dict[str, Any] = {}
         self.messages_sent = 0
 
     # -- registration -------------------------------------------------------
@@ -104,6 +113,28 @@ class SimNetwork:
             TraceEvent("UnclogInterface", Severity.Info).detail(
                 "IP", ip).log()
 
+    def gray_clog_pair(self, a: str, b: str, extra_latency: float,
+                       seconds: float) -> None:
+        """Inflate latency between ips a and b by `extra_latency` for
+        `seconds` WITHOUT stopping delivery — the gray-failure shape
+        (half-broken NIC, saturated link): every request still succeeds,
+        just slowly, so only the peer-health plane can see it."""
+        until = get_event_loop().now() + seconds
+        for pair in ((a, b), (b, a)):
+            old = self._gray_until.get(pair)
+            self._gray_until[pair] = (
+                max(extra_latency, old[0]) if old else extra_latency,
+                max(old[1], until) if old else until)
+        TraceEvent("GrayClogPair", Severity.Info).detail("A", a).detail(
+            "B", b).detail("ExtraLatency", extra_latency).detail(
+            "Seconds", seconds).log()
+
+    def ungray_pair(self, a: str, b: str) -> None:
+        if self._gray_until.pop((a, b), None) is not None or \
+                self._gray_until.pop((b, a), None) is not None:
+            TraceEvent("UngrayPair", Severity.Info).detail(
+                "A", a).detail("B", b).log()
+
     def partition_pair(self, a: str, b: str) -> None:
         self._partitioned.add(frozenset((a, b)))
 
@@ -114,6 +145,17 @@ class SimNetwork:
         self._partitioned.clear()
         self._clog_until.clear()
         self._clog_ip_until.clear()
+        self._gray_until.clear()
+
+    # -- peer telemetry (ISSUE 18) ------------------------------------------
+    def peer_table(self, src_ip: str):
+        """The PeerMetricsTable of the process at `src_ip` (lazily
+        created): what its worker health monitor folds into verdicts."""
+        t = self._peer_tables.get(src_ip)
+        if t is None:
+            from .peer_metrics import PeerMetricsTable
+            t = self._peer_tables[src_ip] = PeerMetricsTable(src_ip)
+        return t
 
     # -- delivery -----------------------------------------------------------
     def _latency(self) -> float:
@@ -129,7 +171,22 @@ class SimNetwork:
         _deliver_when_unclogged), or None if the pair is partitioned."""
         if frozenset((src, dst)) in self._partitioned and src != dst:
             return None
-        return get_event_loop().now() + self._latency()
+        return (get_event_loop().now() + self._latency() +
+                self._gray_extra(src, dst))
+
+    def _gray_extra(self, src: str, dst: str) -> float:
+        """Extra one-way latency from an active gray clog (0.0 when the
+        pair is clean or the inflation window has expired)."""
+        if not self._gray_until:
+            return 0.0
+        entry = self._gray_until.get((src, dst))
+        if entry is None:
+            return 0.0
+        extra, until = entry
+        if get_event_loop().now() >= until:
+            del self._gray_until[(src, dst)]
+            return 0.0
+        return extra
 
     def _clog_time(self, src: str, dst: str) -> float:
         clog = self._clog_until.get((src, dst), 0.0)
@@ -195,10 +252,26 @@ class SimNetwork:
         src_ip = from_address.ip if from_address \
             else self._ambient_src_ip(ep)
         when = self._delivery_time(src_ip, ep.address.ip)
+        # Peer telemetry (ISSUE 18): sample the full request->reply RTT
+        # into the sender's table.  Self-traffic is exempt (co-hosted
+        # roles talk in-process — a process is never its own peer), and
+        # the whole plane is knob-gated so the bench overhead gate can
+        # measure it.
+        from ..core.knobs import server_knobs
+        table = None
+        peer_key = ""
+        t0 = 0.0
+        if src_ip != ep.address.ip and server_knobs().PEER_HEALTH_ENABLED:
+            table = self.peer_table(src_ip)
+            peer_key = str(ep.address)
+            table.sample_request(peer_key)
+            t0 = loop.now()
 
         def fail() -> None:
             if not reply_promise.is_set() and \
                     not reply_promise.get_future().is_ready():
+                if table is not None:
+                    table.sample_disconnect(peer_key)
                 reply_promise.send_error(err("broken_promise"))
 
         if when is None:  # partitioned: connection failure after a delay
@@ -222,6 +295,10 @@ class SimNetwork:
                 if reply_promise.is_set() or \
                         reply_promise.get_future().is_ready():
                     return
+                if table is not None:
+                    # An application-level error reply is still a reply:
+                    # the link carried it, so it samples as RTT.
+                    table.sample_rtt(peer_key, loop.now() - t0, loop.now())
                 if e is not None:
                     reply_promise.send_error(e)
                 else:

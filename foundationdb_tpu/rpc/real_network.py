@@ -330,8 +330,8 @@ class RealNetwork:
         self._endpoints: Dict[Endpoint, Tuple[RequestStream, int]] = {}
         self._conns: Dict[Tuple[str, int], _Conn] = {}
         self._all_conns: List[_Conn] = []
-        # reply_id -> (Promise, conn)
-        self._pending: Dict[int, Tuple[Promise, _Conn]] = {}
+        # reply_id -> (Promise, conn, send time)
+        self._pending: Dict[int, Tuple[Promise, _Conn, float]] = {}
         # peer key -> monotonic time before which we won't re-dial
         self._dial_backoff: Dict[Tuple[str, int], float] = {}
         self._next_reply_id = 1
@@ -344,7 +344,23 @@ class RealNetwork:
         ip, port = self._listener.getsockname()
         self.address = NetworkAddress(ip, port)
         loop.add_reader(self._listener, self._on_accept)
+        # Per-peer health telemetry (ISSUE 18): this process's view of
+        # every peer it talks to — RTTs, disconnects, dial failures,
+        # bytes — consumed by the worker health monitor (server/health.py)
+        # and gated on PEER_HEALTH_ENABLED at each sample site.
+        from .peer_metrics import PeerMetricsTable
+        self.peer_metrics = PeerMetricsTable(str(self.address))
+        self._ever_dialed: set = set()
         serde.bootstrap_registry()
+
+    def peer_table(self, src_ip: str = ""):
+        """SimNetwork-surface accessor: a real process has exactly one
+        table (its own), whatever `src_ip` the caller holds."""
+        return self.peer_metrics
+
+    def _health_on(self) -> bool:
+        from ..core.knobs import server_knobs
+        return bool(server_knobs().PEER_HEALTH_ENABLED)
 
     # -- registration (SimNetwork surface) -----------------------------------
     def register(self, process, stream: RequestStream,
@@ -414,11 +430,17 @@ class RealNetwork:
         conn = _Conn(self, sock, key, outbound=True, connecting=(rc != 0))
         self._conns[key] = conn
         self._all_conns.append(conn)
+        if self._health_on():
+            if key in self._ever_dialed:
+                self.peer_metrics.sample_reconnect(f"{key[0]}:{key[1]}")
+            self._ever_dialed.add(key)
         return conn
 
     def _note_dial_failure(self, key) -> None:
         if key is not None:
             self._dial_backoff[key] = self.loop.now() + 1.0
+            if self._health_on():
+                self.peer_metrics.sample_disconnect(f"{key[0]}:{key[1]}")
 
     def _on_conn_closed(self, conn: _Conn) -> None:
         if conn.peer_key is not None and \
@@ -428,9 +450,14 @@ class RealNetwork:
             self._all_conns.remove(conn)
         # Break every reply pending on this connection (the transport-level
         # failure signal; reference: connection_failed -> broken_promise).
-        dead = [rid for rid, (_p, c) in self._pending.items() if c is conn]
+        dead = [rid for rid, entry in self._pending.items()
+                if entry[1] is conn]
+        if dead and conn.peer_key is not None and self._health_on():
+            pk = f"{conn.peer_key[0]}:{conn.peer_key[1]}"
+            for _ in dead:
+                self.peer_metrics.sample_disconnect(pk)
         for rid in dead:
-            promise, _c = self._pending.pop(rid)
+            promise = self._pending.pop(rid)[0]
             if not promise.is_set() and not promise.get_future().is_ready():
                 promise.send_error(err("broken_promise"))
 
@@ -452,7 +479,12 @@ class RealNetwork:
             entry = self._pending.pop(reply_id, None)
             if entry is None:
                 return             # late reply after failure: drop
-            promise, _c = entry
+            promise, _c, t0 = entry
+            if conn.peer_key is not None and self._health_on():
+                self.peer_metrics.sample_rtt(
+                    f"{conn.peer_key[0]}:{conn.peer_key[1]}",
+                    self.loop.now() - t0, self.loop.now(),
+                    nbytes=len(body))
             if promise.is_set() or promise.get_future().is_ready():
                 return
             value = serde.decode_value(r)
@@ -572,12 +604,15 @@ class RealNetwork:
             return promise.get_future()
         reply_id = self._next_reply_id
         self._next_reply_id += 1
-        self._pending[reply_id] = (promise, conn)
+        self._pending[reply_id] = (promise, conn, self.loop.now())
         w = Writer().str_(ep.token).i64(reply_id)
         # encode_envelope attaches the AMBIENT span (core/trace.py) so a
         # handler issuing follow-on RPCs propagates its caller's context.
         w.bytes_(serde.encode_envelope(request))
-        conn.send_frame(K_REQUEST, w.done())
+        frame = w.done()
+        if self._health_on():
+            self.peer_metrics.sample_request(str(ep.address), len(frame))
+        conn.send_frame(K_REQUEST, frame)
         return promise.get_future()
 
     def send_one_way(self, ep: Endpoint, message: Any,

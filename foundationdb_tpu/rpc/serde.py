@@ -102,6 +102,15 @@ def _ship_fields(cls: type) -> list:
     return names
 
 
+# Fields grafted onto a struct AFTER its legacy wire golden was frozen:
+# elided from the legacy frame while they hold their default, so the
+# knobs-off image stays bit-identical (the schema-evolving decoder fills
+# absent fields from dataclass defaults on both old and new peers).
+_ELIDE_DEFAULT_FIELDS = {
+    "GetKeyValuesRequest": ("debug_id",),
+}
+
+
 def encode_value(w: Writer, v: Any) -> None:
     if v is None:
         w.u8(T_NONE)
@@ -186,6 +195,9 @@ def _encode_dataclass(w: Writer, v: Any) -> None:
                        message=f"unregistered dataclass {name}")
     w.u8(T_DATACLASS).str_(name)
     names = _ship_fields(cls)
+    elide = _ELIDE_DEFAULT_FIELDS.get(name)
+    if elide:
+        names = [f for f in names if f not in elide or getattr(v, f)]
     w.u32(len(names))
     for fname in names:
         w.str_(fname)
@@ -799,7 +811,8 @@ def _enc_get_key_values_request(v: Any) -> bytes:
     stream (range endpoints usually share a long shard/tenant prefix),
     limits ride as varints."""
     out = bytearray()
-    flags = (1 if v.reverse else 0) | (2 if v.tag else 0)
+    flags = ((1 if v.reverse else 0) | (2 if v.tag else 0)
+             | (4 if v.debug_id else 0))
     out.append(flags)
     _wz(out, v.version)
     _wv(out, v.limit)
@@ -814,6 +827,8 @@ def _enc_get_key_values_request(v: Any) -> bytes:
     out += end[p:]
     if flags & 2:
         _wb(out, v.tag.encode())
+    if flags & 4:
+        _wb(out, v.debug_id.encode())
     return bytes(out)
 
 
@@ -829,9 +844,11 @@ def _dec_get_key_values_request(r: Reader) -> Any:
     s = _rv(r)
     end = begin[:p] + _rd_raw(r, s)
     tag = _rb(r).decode() if flags & 2 else ""
+    debug_id = _rb(r).decode() if flags & 4 else ""
     return GetKeyValuesRequest(begin=begin, end=end, version=version,
                                limit=limit, limit_bytes=limit_bytes,
-                               reverse=bool(flags & 1), tag=tag)
+                               reverse=bool(flags & 1), debug_id=debug_id,
+                               tag=tag)
 
 
 def _enc_get_key_values_reply(v: Any) -> bytes:

@@ -245,6 +245,17 @@ class Simulator:
         for p in self.machines[machineid].processes:
             self.unclog_process(p)
 
+    def gray_clog_pair(self, a: SimProcess, b: SimProcess,
+                       extra_latency: float, seconds: float) -> None:
+        """Latency-inflate one live link (ISSUE 18 grayClog): delivery
+        still happens, just `extra_latency` slower each way — the
+        gray-failure shape only the peer-health plane can observe."""
+        self.network.gray_clog_pair(a.address.ip, b.address.ip,
+                                    extra_latency, seconds)
+
+    def ungray_pair(self, a: SimProcess, b: SimProcess) -> None:
+        self.network.ungray_pair(a.address.ip, b.address.ip)
+
     def partition(self, a: SimProcess, b: SimProcess) -> None:
         self.network.partition_pair(a.address.ip, b.address.ip)
 

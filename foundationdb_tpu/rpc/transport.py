@@ -114,6 +114,14 @@ class TcpTransport:
                                                daemon=True)
         self._accept_thread.start()
         self._peer_socks: Dict[Tuple[str, int], socket.socket] = {}
+        # Per-peer health telemetry (ISSUE 18, rpc/peer_metrics.py):
+        # request/reply RTTs, timeout/disconnect counters, bytes both
+        # ways — the real-TCP half of the gray-failure plane.  Samples
+        # are gated on PEER_HEALTH_ENABLED at each call site.
+        from .peer_metrics import PeerMetricsTable
+        self.peer_metrics = PeerMetricsTable(
+            f"{self.address[0]}:{self.address[1]}")
+        self._ever_connected: set = set()
 
     # -- server half ---------------------------------------------------------
     def register(self, token: int, handler: Callable[[bytes], bytes]) -> None:
@@ -199,6 +207,12 @@ class TcpTransport:
                 sock.close()   # lost the connect race; use the winner
                 return existing
             self._peer_socks[addr] = sock
+            if addr in self._ever_connected:
+                from ..core.knobs import server_knobs
+                if server_knobs().PEER_HEALTH_ENABLED:
+                    self.peer_metrics.sample_reconnect(
+                        f"{addr[0]}:{addr[1]}")
+            self._ever_connected.add(addr)
         # The outbound handshake already happened; run the bare frame loop
         # (replies and peer-initiated requests both arrive here).
         threading.Thread(target=self._frame_loop, args=(sock,),
@@ -213,20 +227,41 @@ class TcpTransport:
         if not span:
             from ..core.trace import get_current_span
             span = get_current_span()
-        sock = self._connect(addr)
+        from ..core.knobs import server_knobs
+        sample = bool(server_knobs().PEER_HEALTH_ENABLED)
+        peer_key = f"{addr[0]}:{addr[1]}"
+        try:
+            sock = self._connect(addr)
+        except (OSError, ConnectionError):
+            if sample:
+                self.peer_metrics.sample_disconnect(peer_key)
+            raise
         with self._lock:
             reply_token = self._next_reply_token
             self._next_reply_token += 1
             ev = threading.Event()
             self._replies[reply_token] = ev
         body = Writer().bytes_(payload).i64(reply_token).done()
+        if sample:
+            self.peer_metrics.sample_request(peer_key, len(body))
+            import time as _time
+            t0 = _time.monotonic()  # flowlint: disable=FTL001 -- real mode
         with self._send_lock:
             _send_frame(sock, token, KIND_REQUEST, body, span)
         try:
             if not ev.wait(timeout):
+                if sample:
+                    self.peer_metrics.sample_timeout(peer_key)
                 raise TimeoutError(f"no reply for token {token}")
             with self._lock:
-                return self._reply_data.pop(reply_token)
+                reply = self._reply_data.pop(reply_token)
+            if sample:
+                import time as _time
+                self.peer_metrics.sample_rtt(
+                    peer_key,
+                    _time.monotonic() - t0,  # flowlint: disable=FTL001 -- real mode
+                    nbytes=len(reply))
+            return reply
         finally:
             # Always unregister both entries, or timed-out waits leak
             # (late replies are dropped at the frame loop once the wait

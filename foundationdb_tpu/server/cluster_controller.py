@@ -15,7 +15,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.error import FdbError, err
 from ..core.futures import AsyncVar, Promise
-from ..core.scheduler import delay, spawn
+from ..core.scheduler import delay, now, spawn
 from ..core.trace import Severity, TraceEvent
 from ..rpc.endpoint import RequestStream
 from .failure import WaitFailureRequest
@@ -86,7 +86,9 @@ class ClusterController:
                 getattr(req, "storage_versions", {}) or {},
                 getattr(req, "locality", ("", "", "")) or ("", "", ""),
                 getattr(req, "machine_stats", {}) or {},
-                getattr(req, "metrics_doc", {}) or {})
+                getattr(req, "metrics_doc", {}) or {},
+                getattr(req, "health_report", {}) or {},
+                now())
             arrived, self._worker_arrived = self._worker_arrived, []
             for p in arrived:
                 p.send(None)
@@ -112,6 +114,117 @@ class ClusterController:
     async def _serve_get_workers(self) -> None:
         async for req in self.interface.get_workers.queue:
             req.reply.send(list(self.workers.values()))
+
+    # -- gray-failure aggregation (reference ClusterController degradation
+    # info fed by UpdateWorkerHealthRequest) ---------------------------------
+    @staticmethod
+    def _worker_address(reg: WorkerRegistration) -> str:
+        try:
+            return str(reg.worker.ping.endpoint.address)
+        except Exception:  # noqa: BLE001 — pre-ping-era registration
+            return ""
+
+    def compute_peer_health(self) -> Dict[str, Any]:
+        """THE peer-health verdict document — status JSON, the
+        \\xff\\xff/metrics/peer_health/ special keys, and fdbcli all
+        render this one doc, so the three surfaces agree by construction
+        (and the knob-gated recovery hook acts on the same doc).
+
+        `links` is every degraded (reporter -> peer) edge from reports no
+        older than CC_HEALTH_REPORT_MAX_AGE_S; `degraded_processes` lists
+        processes blamed by >= CC_DEGRADATION_REPORTERS DISTINCT
+        reporters — one bad link blames both endpoints at one reporter
+        each, so a single gray link never convicts a process while a
+        genuinely sick process (every peer sees it) crosses the bar."""
+        from ..core.knobs import server_knobs
+        knobs = server_knobs()
+        max_age = float(knobs.CC_HEALTH_REPORT_MAX_AGE_S)
+        t = now()
+        addr_to_wid = {}
+        for wid, reg in self.workers.items():
+            a = self._worker_address(reg)
+            if a:
+                addr_to_wid[a] = wid
+        links: List[Dict[str, Any]] = []
+        reporters_of: Dict[str, List[str]] = {}
+        for wid in sorted(self.workers):
+            reg = self.workers[wid]
+            report = reg.health_report or {}
+            age = t - reg.registered_at
+            if not report or age > max_age:
+                continue
+            for peer, info in sorted(
+                    (report.get("degraded_peers") or {}).items()):
+                links.append({
+                    "reporter": wid,
+                    "reporter_address": self._worker_address(reg),
+                    "peer": peer,
+                    "peer_worker": addr_to_wid.get(peer, ""),
+                    "rtt_ema": info.get("rtt_ema"),
+                    "timeout_fraction": info.get("timeout_fraction"),
+                    "since": info.get("since"),
+                    "report_age": round(age, 3)})
+                reporters_of.setdefault(peer, []).append(wid)
+        need = max(1, int(knobs.CC_DEGRADATION_REPORTERS))
+        degraded = []
+        for peer in sorted(reporters_of):
+            rs = sorted(set(reporters_of[peer]))
+            if len(rs) >= need:
+                degraded.append({"address": peer,
+                                 "worker": addr_to_wid.get(peer, ""),
+                                 "reporters": rs})
+        return {"links": links, "degraded_processes": degraded,
+                "required_reporters": need}
+
+    async def _watch_degraded_tx_system(self) -> None:
+        """Returns when a process hosting a CURRENT-generation TLog or
+        resolver has been degraded (>= CC_DEGRADATION_REPORTERS) long
+        enough to act on — the caller treats it like betterMasterExists
+        and starts a recovery that recruits around the sick process.
+        Spawned ONLY when CC_HEALTH_TRIGGERED_RECOVERY is on: with the
+        knob off (the default) no actor exists, no RNG draws, no events —
+        bit-identical off-posture (parity gate in tier-1)."""
+        from ..core.knobs import server_knobs
+
+        def tx_addresses() -> Dict[str, str]:
+            out: Dict[str, str] = {}
+            for role, ifaces in (
+                    ("tlog", self.db_info.tlogs or []),
+                    ("resolver", self.db_info.resolvers or [])):
+                for iface in ifaces:
+                    for v in vars(iface).values():
+                        ep = getattr(v, "_endpoint", None) or \
+                            getattr(v, "ep", None)
+                        if ep is not None:
+                            out[str(ep.address)] = role
+                            break
+            return out
+
+        while True:
+            await delay(float(server_knobs().PEER_PING_INTERVAL_S))
+            if self.db_info.recovery_state not in ("accepting_commits",
+                                                   "fully_recovered"):
+                continue
+            # Min spacing between health-triggered recoveries: eviction
+            # must not thrash epochs faster than reports age out.
+            min_gap = float(server_knobs().CC_HEALTH_REPORT_MAX_AGE_S)
+            if now() - getattr(self, "_last_health_recovery", -1e18) < min_gap:
+                continue
+            doc = self.compute_peer_health()
+            if not doc["degraded_processes"]:
+                continue
+            tx = tx_addresses()
+            for entry in doc["degraded_processes"]:
+                role = tx.get(entry["address"])
+                if role is None:
+                    continue
+                self._last_health_recovery = now()
+                TraceEvent("CCHealthTriggeredRecovery",
+                           Severity.Warn).detail(
+                    "Address", entry["address"]).detail(
+                    "Role", role).detail(
+                    "Reporters", ",".join(entry["reporters"])).log()
+                return
 
     def _spawn(self, coro, name: str):
         """Handlers must die with the CC's process (parked long-polls on a
@@ -332,15 +445,26 @@ class ClusterController:
                 better_f = self._spawn(
                     self._better_master_exists(worker.id),
                     f"{self.id}.betterMaster")
+                waiters = [failure_f, better_f]
+                # Gray-failure eviction is OPT-IN: with the knob off
+                # (default) the watcher is never spawned — zero extra
+                # actors or events, bit-identical off-posture.
+                from ..core.knobs import server_knobs
+                if server_knobs().CC_HEALTH_TRIGGERED_RECOVERY:
+                    waiters.append(self._spawn(
+                        self._watch_degraded_tx_system(),
+                        f"{self.id}.healthRecovery"))
                 try:
-                    idx, _ = await wait_any([failure_f, better_f])
+                    idx, _ = await wait_any(waiters)
                 finally:
-                    for f in (failure_f, better_f):
+                    for f in waiters:
                         if not f.is_ready():
                             f.cancel()
-                if idx == 1:
+                if idx >= 1:
                     TraceEvent("CCReRecruitMaster").detail(
-                        "Epoch", epoch).log()
+                        "Epoch", epoch).detail(
+                        "Trigger", "betterMaster" if idx == 1
+                        else "peerHealth").log()
                     continue
             except FdbError as e:
                 TraceEvent("CCMasterDied", Severity.Warn).detail(

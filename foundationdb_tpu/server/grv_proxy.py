@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from typing import Any, List, Optional
 
-from ..core.futures import Promise, wait_all
+from ..core.futures import Promise, wait_all, wait_any
 from ..core.knobs import server_knobs
 from ..core.scheduler import delay, now, spawn
 from ..core.trace import TraceEvent
@@ -305,7 +305,7 @@ class GrvProxy:
             await delay(wait)
 
     async def _reply_batch(self, batch: List[GetReadVersionRequest]) -> None:
-        from ..core.error import FdbError
+        from ..core.error import FdbError, err
         _t0 = now()
         # Confirm log-system liveness + fetch live committed version in
         # parallel (reference getLiveCommittedVersion :527).
@@ -315,9 +315,20 @@ class GrvProxy:
             self.master.get_live_committed_version.endpoint).get_reply(
             GetRawCommittedVersionRequest())
         try:
-            if confirms:
-                await wait_all(confirms)
-            vreply = await version_f
+            # Bounded wait (reference TLOG_TIMEOUT in getLiveCommittedVersion):
+            # a confirm that neither replies nor errors — its request parked
+            # behind a displaced log generation — must read as epoch death,
+            # not wedge this proxy's GRV plane forever.
+            guard = delay(server_knobs().TLOG_CONFIRM_TIMEOUT_S)
+            waits = ([wait_all(confirms)] if confirms else []) + [version_f]
+            for f in waits:
+                if not f.is_ready():
+                    await wait_any([f, guard])
+                if f.is_error():
+                    raise f.error
+                if not f.is_ready():
+                    raise err("timed_out", "tlog liveness confirm timed out")
+            vreply = version_f.get()
         except FdbError as e:
             # A failed liveness confirm means our log generation is locked
             # or dead: this proxy must DIE VISIBLY (reference: GRV proxies

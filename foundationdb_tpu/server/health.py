@@ -1,0 +1,212 @@
+"""Worker health monitor: per-peer gray-failure verdicts.
+
+Reference: fdbserver/worker.actor.cpp healthMonitor (:871) — every worker
+pings its peers on a fixed cadence, folds the transport's per-peer samples
+(fdbrpc FlowTransport Peer counters) into degradation verdicts, and ships
+them to the cluster controller in UpdateWorkerHealthRequest; the CC's
+degradation info then feeds recovery placement and (knob-gated) exclusion.
+
+Here the monitor runs as one actor per worker (spawned by Worker.run):
+
+* every PEER_PING_INTERVAL_S it pings each registered peer worker's
+  `ping` stream (interfaces.PingRequest — an immediate-reply echo,
+  because wait_failure deliberately holds requests open and can never
+  measure RTT) and lets the TRANSPORT record the round trips: both the
+  sim network (rpc/network.py) and the real transports sample every
+  request into the process's PeerMetricsTable, so ambient RPC traffic
+  sharpens the same EMAs the pings keep alive on idle links;
+* a ping with no reply inside the ping interval is a timeout sample —
+  the one failure shape the transport cannot see (the reply may simply
+  never come);
+* per peer, a tick is BAD when the RTT EMA exceeds
+  PEER_DEGRADED_LATENCY_S or the failure fraction of this window's
+  attempts reaches PEER_TIMEOUT_FRACTION; PEER_VERDICT_HYSTERESIS
+  consecutive bad (good) ticks flip the verdict to degraded (recovered),
+  so one latency spike or one lost ping never flips anything;
+* verdict flips emit PeerDegraded / PeerRecovered at SevWarn and
+  trigger an immediate re-registration, so the CC sees the change now —
+  not at the next periodic announce (detection latency budget: the
+  grayClog battery asserts three emit intervals end to end).
+
+The whole plane is gated on PEER_HEALTH_ENABLED (re-read every tick, so
+a dynamic knob override takes effect live) and is pure deterministic
+virtual-time scheduling in simulation — same-seed runs replay
+identically with it on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.knobs import server_knobs
+from ..core.scheduler import TaskPriority, delay, now
+from ..core.trace import Severity, TraceEvent
+from ..rpc.endpoint import RequestStream
+from .interfaces import GetWorkersRequest, PingRequest
+
+# Refresh the peer list from the CC every this many ping ticks — peers
+# change on recruitment/death, not per second.
+_PEER_REFRESH_TICKS = 5
+
+
+class HealthMonitor:
+    """Fold transport peer samples into per-peer verdicts and a compact
+    HealthReport riding the worker-registration path."""
+
+    def __init__(self, worker) -> None:
+        self.worker = worker
+        # peer address "ip:port" -> consecutive-tick streak: positive
+        # counts bad ticks, negative counts good ticks since last flip.
+        self._streaks: Dict[str, int] = {}
+        # peer address -> degradation detail (the degraded_peers rows of
+        # the report; keyed/ordered deterministically).
+        self.degraded: Dict[str, Dict[str, Any]] = {}
+        self._peers: List[Tuple[str, Any]] = []   # (addr, ping endpoint)
+        self._generated_at = 0.0
+        self._emit_spawned = False
+
+    # -- report (rides RegisterWorkerRequest.health_report) ------------------
+    def report(self) -> Dict[str, Any]:
+        if not server_knobs().PEER_HEALTH_ENABLED:
+            return {}
+        return {"generated_at": round(self._generated_at, 3),
+                "peers_monitored": len(self._peers),
+                "degraded_peers": {k: dict(v)
+                                   for k, v in sorted(self.degraded.items())}}
+
+    # -- plumbing ------------------------------------------------------------
+    def _table(self):
+        """This process's PeerMetricsTable, from whichever transport is
+        installed (SimNetwork keys tables by source ip; the real network
+        has exactly one and ignores the argument)."""
+        from ..rpc.network import get_network
+        addr = getattr(self.worker.process, "address", None)
+        return get_network().peer_table(addr.ip if addr is not None else "")
+
+    def _own_address(self) -> str:
+        try:
+            return str(self.worker.interface.ping.endpoint.address)
+        except Exception:  # noqa: BLE001 — not registered yet
+            return ""
+
+    async def _refresh_peers(self) -> None:
+        cc = self.worker._current_cc
+        if cc is None:
+            return
+        regs = await RequestStream.at(
+            cc.get_workers.endpoint).try_get_reply(GetWorkersRequest())
+        if regs is None:
+            return
+        own = self._own_address()
+        peers: List[Tuple[str, Any]] = []
+        for reg in regs:
+            try:
+                ep = reg.worker.ping.endpoint
+            except Exception:  # noqa: BLE001 — malformed registration
+                continue
+            addr = str(ep.address)
+            if addr and addr != own:
+                peers.append((addr, ep))
+        peers.sort(key=lambda p: p[0])
+        self._peers = peers
+        # A peer that left the cluster is no longer a gray-failure
+        # subject: drop its verdict state without a PeerRecovered event
+        # (it did not recover; it is gone).
+        live = {a for a, _ in peers}
+        for addr in [a for a in self._streaks if a not in live]:
+            del self._streaks[addr]
+        dropped = [a for a in self.degraded if a not in live]
+        for addr in dropped:
+            del self.degraded[addr]
+        if dropped:
+            self.worker._announce_roles()
+
+    async def _ping_round(self, interval: float) -> None:
+        """Ping every peer concurrently; the full ping interval is the
+        timeout window.  Replies are sampled by the transport as they
+        arrive; only the still-unanswered pings become timeout samples."""
+        table = self._table()
+        pings = []
+        for addr, ep in self._peers:
+            pings.append((addr, RequestStream.at(
+                ep, TaskPriority.FailureMonitor).get_reply(PingRequest())))
+        await delay(interval)
+        for addr, f in pings:
+            if not f.is_ready():
+                table.sample_timeout(addr)
+                f.cancel()
+            elif f.is_error():
+                # Broken promise / dead peer: the transport already
+                # recorded the disconnect sample.
+                pass
+
+    def _evaluate(self) -> None:
+        knobs = server_knobs()
+        table = self._table()
+        flipped = False
+        for addr, _ep in self._peers:
+            pm = table.peer(addr)
+            attempts, failures = pm.take_window()
+            fraction = failures / attempts if attempts else 0.0
+            bad = bool(
+                (pm.rtt_ema is not None and
+                 pm.rtt_ema > knobs.PEER_DEGRADED_LATENCY_S) or
+                (attempts and fraction >= knobs.PEER_TIMEOUT_FRACTION))
+            streak = self._streaks.get(addr, 0)
+            if bad:
+                streak = streak + 1 if streak > 0 else 1
+            else:
+                streak = streak - 1 if streak < 0 else -1
+            self._streaks[addr] = streak
+            need = max(1, int(knobs.PEER_VERDICT_HYSTERESIS))
+            if streak >= need and addr not in self.degraded:
+                self.degraded[addr] = {
+                    "since": round(now(), 3),
+                    "rtt_ema": round(pm.rtt_ema, 6)
+                    if pm.rtt_ema is not None else None,
+                    "timeout_fraction": round(fraction, 3)}
+                flipped = True
+                TraceEvent("PeerDegraded", Severity.Warn).detail(
+                    "Peer", addr).detail(
+                    "RttEma", self.degraded[addr]["rtt_ema"]).detail(
+                    "TimeoutFraction", round(fraction, 3)).detail(
+                    "Reporter", self.worker.process.name).log()
+            elif streak <= -need and addr in self.degraded:
+                del self.degraded[addr]
+                flipped = True
+                TraceEvent("PeerRecovered", Severity.Warn).detail(
+                    "Peer", addr).detail(
+                    "RttEma", round(pm.rtt_ema, 6)
+                    if pm.rtt_ema is not None else None).detail(
+                    "Reporter", self.worker.process.name).log()
+            elif addr in self.degraded:
+                # Keep the row's evidence fresh while degraded persists.
+                self.degraded[addr]["rtt_ema"] = round(pm.rtt_ema, 6) \
+                    if pm.rtt_ema is not None else None
+                self.degraded[addr]["timeout_fraction"] = round(fraction, 3)
+        self._generated_at = now()
+        if flipped:
+            # Event-driven re-registration: the CC must learn of a
+            # verdict change NOW, not at the next periodic announce.
+            self.worker._announce_roles()
+
+    async def run(self) -> None:
+        tick = 0
+        while True:
+            knobs = server_knobs()
+            interval = max(0.1, float(knobs.PEER_PING_INTERVAL_S))
+            if not knobs.PEER_HEALTH_ENABLED:
+                await delay(interval)
+                continue
+            if not self._emit_spawned:
+                # The peer table's CounterCollection rides the standard
+                # {group}Metrics / LatencyBand cadence like every role.
+                self._emit_spawned = True
+                self.worker.process.spawn(
+                    self._table().collection.emit_loop(),
+                    f"{self.worker.process.name}.peerMetricsEmit")
+            if tick % _PEER_REFRESH_TICKS == 0 or not self._peers:
+                await self._refresh_peers()
+            tick += 1
+            await self._ping_round(interval)
+            self._evaluate()

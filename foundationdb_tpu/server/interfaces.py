@@ -527,6 +527,7 @@ class GetKeyValuesRequest:
     limit: int = 1000
     limit_bytes: int = 1 << 20
     reverse: bool = False
+    debug_id: str = ""
     tag: str = ""
     reply: Any = None
 
@@ -633,6 +634,17 @@ class ClientDBInfo:
 
 
 @dataclass
+class PingRequest:
+    """Health-monitor ping (reference fdbrpc PingRequest on every
+    interface): the worker replies immediately, so round-trip time IS
+    link latency — unlike wait_failure, whose requests are deliberately
+    held open and can never measure RTT."""
+
+    echo: int = 0
+    reply: Any = None
+
+
+@dataclass
 class RegisterWorkerRequest:
     worker: "WorkerInterface"
     process_class: str = "unset"
@@ -661,6 +673,13 @@ class RegisterWorkerRequest:
     # CC's status builder can merge latency bands across processes.
     # Empty in simulation (backrefs are authoritative there).
     metrics_doc: Dict[str, Any] = field(default_factory=dict)
+    # Compact per-peer health verdict document from this worker's
+    # HealthMonitor (server/health.py): {"generated_at": ..,
+    # "peers_monitored": N, "degraded_peers": {addr: {...}}}.  The CC
+    # aggregates these across >= CC_DEGRADATION_REPORTERS independent
+    # reporters before marking a process degraded (reference
+    # UpdateWorkerHealthRequest / ClusterController degradation info).
+    health_report: Dict[str, Any] = field(default_factory=dict)
     reply: Any = None
 
 
@@ -676,6 +695,12 @@ class WorkerRegistration:
     locality: tuple = ("", "", "")
     machine_stats: Dict[str, float] = field(default_factory=dict)
     metrics_doc: Dict[str, Any] = field(default_factory=dict)
+    health_report: Dict[str, Any] = field(default_factory=dict)
+    # CC-local arrival stamp of the latest (re-)registration: drives both
+    # the status staleness flags (a process silent past 2x its register
+    # interval is `stale`) and health-report age-out
+    # (CC_HEALTH_REPORT_MAX_AGE_S).
+    registered_at: float = 0.0
 
 
 # -- placement fitness (reference flow/ProcessClass machineClassFitness +
@@ -991,13 +1016,18 @@ class WorkerInterface:
                                                 TaskPriority.DefaultEndpoint)
         self.wait_failure = RequestStream("worker.waitFailure",
                                           TaskPriority.FailureMonitor)
+        # Immediate-reply echo for the peer-health plane: wait_failure
+        # holds requests open (its silence IS the signal), so RTT
+        # measurement needs this separate stream.
+        self.ping = RequestStream("worker.ping",
+                                  TaskPriority.FailureMonitor)
 
     def streams(self) -> List[RequestStream]:
         return [self.init_master, self.init_tlog, self.init_commit_proxy,
                 self.init_grv_proxy, self.init_resolver, self.init_storage,
                 self.init_ratekeeper, self.init_data_distributor,
                 self.init_log_router, self.init_backup_worker,
-                self.wait_failure]
+                self.wait_failure, self.ping]
 
 
 class ClusterControllerInterface:

@@ -313,6 +313,39 @@ def collect_heat(info, read_hot: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def collect_peer_health(cc) -> Dict[str, Any]:
+    """cluster.peer_health: the CC's aggregated gray-failure verdict
+    (ClusterController.compute_peer_health) — degraded links with their
+    reporters/evidence plus the >= CC_DEGRADATION_REPORTERS process
+    convictions.  This document is ALSO what
+    \\xff\\xff/metrics/peer_health/ and the fdbcli `metrics` Peer health
+    section render, so the three surfaces agree by construction (the
+    PR-8/12 pattern)."""
+    return cc.compute_peer_health()
+
+
+def collect_messages() -> Dict[str, Any]:
+    """cluster.messages: process-wide trace-event counts per severity
+    label (reference status cluster.messages) — a cheap first question
+    ("is anything screaming?") answered without grepping trace files."""
+    from ..core.trace import get_tracer
+    tracer = get_tracer()
+    return {"severity_counts": tracer.messages(),
+            "error_count": tracer.error_count,
+            "events_emitted": tracer.events_emitted}
+
+
+def _register_interval() -> float:
+    """The worker re-registration cadence the staleness flags are judged
+    against: the fixed 10s sim interval, or WORKER_REGISTER_INTERVAL_S
+    (worker.py _stats_announce_loop)."""
+    from ..core.knobs import server_knobs
+    from ..core.scheduler import get_event_loop
+    if get_event_loop().sim:
+        return 10.0
+    return float(server_knobs().WORKER_REGISTER_INTERVAL_S)
+
+
 async def build_status(cc) -> Dict[str, Any]:
     """Assemble the status document from the CC's view + live role polls
     (all polls issued in parallel — one clogged role must not stall the
@@ -361,8 +394,10 @@ async def build_status(cc) -> Dict[str, Any]:
             read_hot[str(tag)] = hot_rows
     rk = rk_future.get() if rk_future is not None and \
         not rk_future.is_error() else None
+    peer_health = collect_peer_health(cc)
 
     processes = {}
+    stale_after = 2.0 * _register_interval()
     for wid, reg in sorted(cc.workers.items()):
         entry = {"class_type": reg.process_class, "excluded": False}
         loc = getattr(reg, "locality", ("", "", ""))
@@ -377,6 +412,13 @@ async def build_status(cc) -> Dict[str, Any]:
             entry["memory"] = {
                 "rss_bytes": stats.get("memory_rss_bytes")}
             entry["uptime_seconds"] = stats.get("uptime_seconds")
+        # Staleness stamp: age of this worker's latest metrics-doc
+        # report.  A process silent past twice its register interval is
+        # flagged — its stats/health sections describe the PAST, and a
+        # reader deciding from them should know.
+        age = now() - getattr(reg, "registered_at", 0.0)
+        entry["seconds_since_last_report"] = round(age, 3)
+        entry["stale"] = bool(age > stale_after)
         processes[wid] = entry
 
     # Role latency/counter metrics via the sim-side interface backrefs
@@ -483,6 +525,16 @@ async def build_status(cc) -> Dict[str, Any]:
             # feed for \xff\xff/metrics/scheduler/ and the fdbcli
             # `metrics` Scheduler section.
             "scheduler": collect_scheduler(info),
+            # Gray-failure plane (ISSUE 18): the CC's aggregated per-peer
+            # health verdict — degraded links + >= K-reporter process
+            # convictions, the feed for \xff\xff/metrics/peer_health/
+            # and the fdbcli `metrics` Peer health section.
+            "peer_health": peer_health,
+            "degraded_processes": [
+                e["address"] for e in peer_health["degraded_processes"]],
+            # Trace-severity rollup (ISSUE 18 satellite): per-severity
+            # event counts of the status builder's process.
+            "messages": collect_messages(),
             # Per-stage commit-pipeline latency bands + per-group counter
             # sums (ISSUE 3: the `fdbcli metrics` surface).  Sources:
             # sim-side role backrefs, else the workers' registered

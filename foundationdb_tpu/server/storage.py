@@ -22,7 +22,7 @@ from ..core.futures import AsyncTrigger, Future, wait_any
 from ..core.buggify import buggify
 from ..core.knobs import server_knobs
 from ..core.scheduler import delay, now, spawn
-from ..core.trace import Severity, TraceEvent
+from ..core.trace import Severity, TraceEvent, trace_batch_event
 from ..rpc.endpoint import RequestStream
 from ..txn.atomic import apply_atomic
 from ..txn.types import (ATOMIC_OPS, KeyRange, Mutation, MutationType,
@@ -661,10 +661,21 @@ class StorageServer:
         _t0 = now()
         try:
             self._check_quarantine()
+            # Server-side read waterfall points (reference
+            # storageserver.actor.cpp getValueQ g_traceBatch points):
+            # DoRead marks version-wait done, AfterRead the lookup itself
+            # — the gap between the client's Before and DoRead is
+            # network + version lag.
+            trace_batch_event("TransactionDebug", req.debug_id,
+                              "StorageServer.getValue.Before")
             await self._wait_for_version(req.version)
             self._check_owned(req.key, req.key + b"\x00", req.version)
+            trace_batch_event("TransactionDebug", req.debug_id,
+                              "StorageServer.getValue.DoRead")
             self.stats["reads"] += 1
             value = self.data.get(req.key, req.version)
+            trace_batch_event("TransactionDebug", req.debug_id,
+                              "StorageServer.getValue.AfterRead")
             self._sample_read_tag(
                 req.tag, len(req.key) + (len(value) if value else 0),
                 key=req.key)
@@ -676,12 +687,18 @@ class StorageServer:
     async def _get_key_values(self, req: GetKeyValuesRequest) -> None:
         try:
             self._check_quarantine()
+            trace_batch_event("TransactionDebug", req.debug_id,
+                              "StorageServer.getKeyValues.Before")
             await self._wait_for_version(req.version)
             self._check_owned(req.begin, req.end, req.version)
+            trace_batch_event("TransactionDebug", req.debug_id,
+                              "StorageServer.getKeyValues.AfterVersion")
             self.stats["range_reads"] += 1
             data, more = self.data.range_read(
                 req.begin, req.end, req.version, req.limit, req.limit_bytes,
                 req.reverse)
+            trace_batch_event("TransactionDebug", req.debug_id,
+                              "StorageServer.getKeyValues.AfterRead")
             self._sample_read_tag(
                 req.tag, sum(len(k) + len(v) for k, v in data),
                 key=req.begin)

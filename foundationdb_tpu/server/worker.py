@@ -48,6 +48,7 @@ class Worker:
         # RegisterWorkerRequest.storage_versions: collision tiebreak).
         self.storage_versions: Dict[int, int] = {}
         self._current_cc = None
+        self.health: Optional[Any] = None   # HealthMonitor, set by run()
         from ..core.futures import Promise
         self._scanned: Promise = Promise()
 
@@ -514,7 +515,9 @@ class Worker:
                 locality=((loc.dcid, loc.zoneid, loc.machineid)
                           if loc is not None else ("", "", "")),
                 machine_stats=self._machine_stats(),
-                metrics_doc=self._metrics_doc()))
+                metrics_doc=self._metrics_doc(),
+                health_report=(self.health.report()
+                               if self.health is not None else {})))
 
     def _metrics_doc(self) -> Dict[str, Any]:
         """This process's metrics registry export, attached to the
@@ -604,6 +607,12 @@ class Worker:
         promises break when the process is killed."""
         from .failure import hold_wait_failure
         await hold_wait_failure(self.interface.wait_failure)
+
+    async def _serve_ping(self) -> None:
+        """Immediate echo for the peer-health plane (server/health.py):
+        the round trip the monitor measures IS link latency."""
+        async for req in self.interface.ping.queue:
+            req.reply.send(req.echo)
 
     async def _storage_cache_watch(self, ss) -> None:
         """The StorageCache's registry loop (reference storageCache's
@@ -893,6 +902,10 @@ class Worker:
             p.spawn(self._serve_inits(stream.queue, handler, name),
                     f"{p.name}.init:{name}")
         p.spawn(self._serve_wait_failure(), f"{p.name}.waitFailure")
+        p.spawn(self._serve_ping(), f"{p.name}.ping")
+        from .health import HealthMonitor
+        self.health = HealthMonitor(self)
+        p.spawn(self.health.run(), f"{p.name}.healthMonitor")
         p.spawn(self._watch_db_info(), f"{p.name}.watchDbInfo")
         p.spawn(self._knob_watch(), f"{p.name}.knobWatch")
         p.spawn(self._stats_announce_loop(), f"{p.name}.statsAnnounce")

@@ -308,6 +308,8 @@ class ChaosNemesisWorkload(TestWorkload):
             loops.append(spawn(self._attrition_loop(), "nemesis.attrition"))
         if self.config.get("partitions", True):
             loops.append(spawn(self._partition_loop(), "nemesis.partition"))
+        if self.config.get("grayClog", False):
+            loops.append(spawn(self._gray_clog_loop(), "nemesis.grayClog"))
         if self.config.get("resolverAttrition", False):
             loops.append(spawn(self._resolver_attrition_loop(),
                                "nemesis.resolverAttrition"))
@@ -380,6 +382,42 @@ class ChaosNemesisWorkload(TestWorkload):
             sim.heal_pair(a, b)
             cycles += 1
         self.metrics["partitions"] = cycles
+
+    async def _gray_clog_loop(self) -> None:
+        """Gray failure (ISSUE 18): latency-inflate one LIVE link between
+        two random workers — no drop, no disconnect, so failure
+        monitoring never fires and only the peer-health plane
+        (server/health.py ping RTT verdicts) can see it.  Inflation is
+        held past the verdict hysteresis window, then healed."""
+        from ..core.coverage import test_coverage
+        from ..core.knobs import server_knobs
+        from ..core.rng import deterministic_random
+        rng = deterministic_random()
+        sim = self.cluster.sim
+        knobs = server_knobs()
+        cycles = 0
+        while now() < self._deadline:
+            await delay(1.0 + rng.random01() * 2.0)
+            procs = self._alive_workers()
+            if len(procs) < 2:
+                continue
+            i = rng.random_int(0, len(procs))
+            j = rng.random_int(0, len(procs) - 1)
+            if j >= i:
+                j += 1
+            a, b = procs[i], procs[j]
+            # Inflation comfortably past the degraded-latency bar; hold
+            # long enough for hysteresis to convict, then heal.
+            extra = knobs.PEER_DEGRADED_LATENCY_S * (
+                4.0 + rng.random01() * 4.0)
+            hold = knobs.PEER_PING_INTERVAL_S * (
+                knobs.PEER_VERDICT_HYSTERESIS + 2 + rng.random_int(0, 3))
+            sim.gray_clog_pair(a, b, extra, hold + 60.0)
+            test_coverage("ChaosNemesisGrayClog")
+            await delay(hold)
+            sim.ungray_pair(a, b)
+            cycles += 1
+        self.metrics["gray_clogs"] = cycles
 
     def _safe_to_fail(self, victim) -> bool:
         """Would the survivors still satisfy replication + leave a viable

@@ -62,6 +62,44 @@ REQUIRED_STAGES = (
 
 _BATCH_LINK_PREFIX = "CommitProxy.batch:"
 
+# Location prefixes that belong to the READ side of a transaction's
+# timeline (--reads): client-side NativeAPI get points and the storage
+# server's version-wait/lookup points keyed by the same debug id.
+READ_STAGE_PREFIXES = (
+    "NativeAPI.getConsistentReadVersion.",
+    "GrvProxy.",
+    "NativeAPI.getValue.",
+    "NativeAPI.getRange.",
+    "StorageServer.",
+)
+
+# Substrings a COMPLETE point-read waterfall must contain: GRV, the
+# client Before/After bracket, and the storage server's own points (the
+# test gate that client->storage debug-id plumbing stays wired).
+REQUIRED_READ_STAGES = (
+    "NativeAPI.getConsistentReadVersion.Before",
+    "NativeAPI.getValue.Before",
+    "StorageServer.getValue.DoRead",
+    "StorageServer.getValue.AfterRead",
+    "NativeAPI.getValue.After",
+)
+
+
+def is_read_point(loc: str) -> bool:
+    return any(loc.startswith(p) for p in READ_STAGE_PREFIXES)
+
+
+def read_timelines(timelines: Dict[str, List[Tuple[float, str]]]
+                   ) -> Dict[str, List[Tuple[float, str]]]:
+    """Project full timelines onto their read legs (--reads mode): only
+    read-side points survive, ids with none drop out."""
+    out: Dict[str, List[Tuple[float, str]]] = {}
+    for did, timeline in timelines.items():
+        reads = [(t, loc) for t, loc in timeline if is_read_point(loc)]
+        if reads:
+            out[did] = reads
+    return out
+
 
 def conflict_details(events: Iterable[Dict[str, Any]]
                      ) -> Dict[str, Dict[str, Any]]:
@@ -198,9 +236,14 @@ def main(argv=None) -> int:
     ap.add_argument("traces", nargs="+", help="trace JSONL file(s)")
     ap.add_argument("--debug-id", default=None,
                     help="only this transaction's timeline")
+    ap.add_argument("--reads", action="store_true",
+                    help="read waterfall: only GRV/getValue/getRange/"
+                         "StorageServer points (where reads spend time)")
     args = ap.parse_args(argv)
     events = load_events(args.traces)
     timelines = build_timelines(events, debug_id=args.debug_id)
+    if args.reads:
+        timelines = read_timelines(timelines)
     if not timelines:
         print("no debug-id-tagged transactions found "
               "(set transaction.debug_id to trace one)")
@@ -213,9 +256,10 @@ def main(argv=None) -> int:
             mode = "exact" if detail["exact"] else "conservative"
             print(f"  ABORTED on conflict ({mode} attribution): "
                   f"{detail['ranges']}")
-        if not is_complete(timelines[did]):
-            missing = [r for r in REQUIRED_STAGES
-                       if not any(r in loc for _t, loc in timelines[did])]
+        required = REQUIRED_READ_STAGES if args.reads else REQUIRED_STAGES
+        missing = [r for r in required
+                   if not any(r in loc for _t, loc in timelines[did])]
+        if missing:
             print(f"  (incomplete: missing {', '.join(missing)})")
         print()
     print(render_summary(stage_summary(timelines)))

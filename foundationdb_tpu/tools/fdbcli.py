@@ -258,6 +258,30 @@ class Cli:
                     f"repairs={p.get('repairs_attempted', 0)}"
                     f"/{p.get('repairs_succeeded', 0)} ok"
                     f"/{p.get('repairs_exhausted', 0)} exhausted")
+        # Gray-failure plane (ISSUE 18): the same cluster.peer_health
+        # document status JSON carries and \xff\xff/metrics/peer_health/
+        # mirrors — three surfaces, one source.
+        ph = cl.get("peer_health", {}) or {}
+        if (ph.get("links") or ph.get("degraded_processes")) and \
+                (not needle or needle in "peer health peer_health"):
+            lines.append(
+                "Peer health (degraded links; process conviction needs "
+                f">={ph.get('required_reporters', '?')} reporters):")
+            lines.append(f"  {'reporter':<22}{'peer':<22}{'rtt ms':>9}"
+                         f"{'to frac':>9}{'age s':>8}")
+            for row in ph.get("links", []):
+                rtt = row.get("rtt_ema")
+                lines.append(
+                    f"  {row.get('reporter', '?'):<22}"
+                    f"{row.get('peer', '?'):<22}"
+                    f"{(rtt * 1e3 if rtt is not None else 0):>9.2f}"
+                    f"{row.get('timeout_fraction') or 0:>9.2f}"
+                    f"{row.get('report_age') or 0:>8.1f}")
+            for entry in ph.get("degraded_processes", []):
+                lines.append(
+                    f"  DEGRADED {entry.get('address', '?')} "
+                    f"(worker {entry.get('worker') or '?'}; reporters: "
+                    f"{', '.join(entry.get('reporters', []))})")
         return "\n".join(lines)
 
     def cmd_top(self) -> str:

@@ -45,11 +45,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Their coverage markers (ChaosRegionFailover, ChaosCoordinatorRestart,
 # ChaosFatalDiskRestart, BackupRestoreUnderChaos, ProxyTxnRepaired,
 # GrvSchedDeferral, ProxyBatchReordered) land in the summary's coverage
-# ledger like every other registered marker.
+# ledger like every other registered marker.  GrayFailureTest (ISSUE 18)
+# runs the latency-inflation nemesis — deliveries succeed, only the
+# peer-health plane can observe the fault (ChaosNemesisGrayClog marker).
 DEFAULT_SPECS = ("ChaosTest.toml", "CycleTest.toml", "TenantTest.toml",
                  "TwoRegionChaosTest.toml", "BackupRestoreChaosTest.toml",
                  "SchedChaosTest.toml", "E2eThroughputTest.toml",
-                 "ReadStormTest.toml")
+                 "ReadStormTest.toml", "GrayFailureTest.toml")
 
 
 def _ensure_hash_seed_pinned() -> None:

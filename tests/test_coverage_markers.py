@@ -20,5 +20,6 @@ def test_coverage_registry_and_hits():
     # Disaster-recovery nemesis battery (ISSUE 10): run_chaos.py's
     # summary ledger must list these whether or not a run hit them.
     for marker in ("ChaosRegionFailover", "ChaosCoordinatorRestart",
-                   "ChaosFatalDiskRestart", "BackupRestoreUnderChaos"):
+                   "ChaosFatalDiskRestart", "BackupRestoreUnderChaos",
+                   "ChaosNemesisGrayClog"):
         assert marker in coverage.report(), marker

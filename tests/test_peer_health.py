@@ -1,0 +1,324 @@
+"""Gray-failure observability (ISSUE 18): the peer-health plane.
+
+A gray-clogged link (latency inflated, delivery still succeeds) is
+invisible to failure monitoring — only the per-peer telemetry (transport
+RTT EMAs + the worker health monitor's ping verdicts, server/health.py)
+can see it.  This battery proves:
+
+* unit: PeerMetrics EMA/window arithmetic;
+* a quiescent cluster reports ZERO degraded peers (no false positives);
+* a grayClog-ed link is reported degraded within the detection budget
+  (3 stats-emit intervals) IDENTICALLY on all three surfaces: status
+  cluster.peer_health, the \xff\xff/metrics/peer_health/ special keys,
+  and fdbcli `metrics`;
+* one gray link never convicts a process under the default K=2
+  reporter bar, while K=1 convicts (and ages out when reports stop);
+* the knob-gated CC_HEALTH_TRIGGERED_RECOVERY hook: OFF (default) a
+  degraded TLog host never triggers recovery; ON it does;
+* double-run unseed verification: the whole plane (pings, verdicts,
+  re-registrations, grayClog nemesis) is sim-deterministic.
+"""
+
+import json
+import os
+
+import pytest
+
+from foundationdb_tpu.core.knobs import server_knobs
+from foundationdb_tpu.core.scheduler import delay, now
+from foundationdb_tpu.core.trace import (Severity, Tracer, get_tracer,
+                                         set_tracer)
+from foundationdb_tpu.rpc.peer_metrics import EMA_ALPHA, PeerMetrics
+
+from test_recovery import commit_kv, make_cluster, teardown  # noqa: F401
+
+SPECS = os.path.join(os.path.dirname(__file__), "specs")
+
+# The sim Worker announces stats every 10s (worker._stats_announce_loop);
+# ISSUE 18's detection budget is three emit intervals end to end.
+EMIT_INTERVAL_S = 10.0
+DETECTION_BUDGET_S = 3 * EMIT_INTERVAL_S
+
+
+@pytest.fixture()
+def knobs():
+    """Mutable server knobs restored after the test."""
+    k = server_knobs()
+    saved = dict(k.__dict__)
+    yield k
+    for name, value in saved.items():
+        setattr(k, name, value)
+
+
+# ---------------------------------------------------------------------------
+# Unit: PeerMetrics arithmetic
+# ---------------------------------------------------------------------------
+
+def test_peer_metrics_ema_and_window():
+    pm = PeerMetrics("1.2.3.4:1")
+    assert pm.rtt_ema is None
+    pm.record_rtt(0.100, at=1.0)
+    assert pm.rtt_ema == pytest.approx(0.100)   # first sample seeds the EMA
+    pm.record_rtt(0.200, at=2.0)
+    assert pm.rtt_ema == pytest.approx(
+        (1 - EMA_ALPHA) * 0.100 + EMA_ALPHA * 0.200)
+    pm.record_timeout()
+    pm.record_disconnect()
+    assert pm.take_window() == (4, 2)           # 2 replies + 2 failures
+    assert pm.take_window() == (0, 0)           # window resets
+    doc = pm.to_doc()
+    assert doc["replies"] == 2 and doc["timeouts"] == 1
+    assert doc["disconnects"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Quiescent cluster: zero false positives
+# ---------------------------------------------------------------------------
+
+def test_quiescent_cluster_zero_degraded(teardown):  # noqa: F811
+    set_tracer(Tracer())
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        await commit_kv(db, b"q", b"1")
+        # Several full verdict windows of healthy pings.
+        await delay(8.0)
+        return await db.cluster.get_status()
+
+    doc = c.run_until(c.loop.spawn(go()), timeout=120)
+    ph = doc["cluster"]["peer_health"]
+    assert ph["links"] == []
+    assert ph["degraded_processes"] == []
+    assert doc["cluster"]["degraded_processes"] == []
+    assert not get_tracer().find("PeerDegraded")
+    # Severity ledger (satellite: status cluster.messages) counts the
+    # boot's events; a healthy run has info traffic and no errors.
+    msgs = doc["cluster"]["messages"]
+    assert msgs["severity_counts"].get("info", 0) > 0
+    assert msgs["severity_counts"].get("error", 0) == 0
+    assert msgs["error_count"] == 0
+    assert msgs["events_emitted"] > 0
+    # Staleness stamps: every live worker reported recently.
+    procs = doc["cluster"]["processes"]
+    assert procs and all(not p["stale"] for p in procs.values()), procs
+    assert all(p["seconds_since_last_report"] >= 0.0
+               for p in procs.values())
+
+
+# ---------------------------------------------------------------------------
+# grayClog -> detection on all three surfaces within the budget
+# ---------------------------------------------------------------------------
+
+def test_gray_link_detected_on_all_three_surfaces(teardown):  # noqa: F811
+    from foundationdb_tpu.tools.fdbcli import Cli
+    set_tracer(Tracer())
+    c = make_cluster()
+    db = c.database()
+    a, b = c.workers[0][0], c.workers[1][0]
+
+    async def go():
+        await commit_kv(db, b"g", b"1")
+        await delay(3.0)               # health monitors discover peers
+        t_fault = now()
+        c.sim.gray_clog_pair(a, b, 0.2, 600.0)
+        doc = None
+        while now() < t_fault + DETECTION_BUDGET_S:
+            await delay(1.0)
+            doc = await db.cluster.get_status()
+            if doc["cluster"]["peer_health"]["links"]:
+                break
+        detect_s = now() - t_fault
+        t = db.create_transaction()
+        rows = await t.get_range(b"\xff\xff/metrics/peer_health/",
+                                 b"\xff\xff/metrics/peer_health0",
+                                 limit=100)
+        point = await db.create_transaction().get(rows[0][0]) if rows \
+            else None
+        return doc, detect_s, rows, point
+
+    def row_key(raw):
+        # report_age advances between two status renders of the same
+        # link — identity is the (reporter, peer, since) edge.
+        r = json.loads(raw)
+        return (r["reporter"], r["peer"], r["since"])
+
+    doc, detect_s, rows, point = c.run_until(c.loop.spawn(go()),
+                                             timeout=240)
+    # 1. status: the degraded LINK names the grayed pair, both ways.
+    ph = doc["cluster"]["peer_health"]
+    assert ph["links"], f"no degraded link within {detect_s:.1f}s"
+    assert detect_s <= DETECTION_BUDGET_S
+    ips = {a.address.ip, b.address.ip}
+    for row in ph["links"]:
+        assert row["reporter_address"].split(":")[0] in ips, row
+        assert row["peer"].split(":")[0] in ips, row
+        assert row["rtt_ema"] is None or row["rtt_ema"] > \
+            server_knobs().PEER_DEGRADED_LATENCY_S or \
+            row["timeout_fraction"] >= server_knobs().PEER_TIMEOUT_FRACTION
+    # ONE gray link blames each endpoint at one reporter — under the
+    # default K=2 bar neither process is convicted.
+    assert ph["required_reporters"] == 2
+    assert ph["degraded_processes"] == []
+    assert doc["cluster"]["degraded_processes"] == []
+    # PeerDegraded fired at SevWarn (satellite: severity filter).
+    evs = get_tracer().find("PeerDegraded", min_severity=Severity.Warn)
+    assert evs and all(e["Severity"] == Severity.Warn for e in evs)
+    assert not get_tracer().find("PeerDegraded",
+                                 min_severity=Severity.Error)
+    # 2. special keys render the same links (same doc by construction).
+    link_rows = [(k, v) for k, v in rows
+                 if k.startswith(b"\xff\xff/metrics/peer_health/link/")]
+    assert len(link_rows) == len(ph["links"])
+    parsed = [json.loads(v) for _k, v in link_rows]
+    assert sorted((r["reporter"], r["peer"]) for r in parsed) == \
+        sorted((r["reporter"], r["peer"]) for r in ph["links"])
+    assert point is not None            # point get sees the same link
+    assert row_key(point) == row_key(rows[0][1])
+    # 3. fdbcli `metrics` prints the same section.
+    cli = Cli.__new__(Cli)
+    cli.loop, cli.db = c.loop, db
+    out = cli.dispatch("metrics peer_health")
+    assert "Peer health" in out, out
+    assert any(row["peer"] in out for row in ph["links"]), out
+
+
+# ---------------------------------------------------------------------------
+# Conviction bar + recovery knob (off-posture and on)
+# ---------------------------------------------------------------------------
+
+def _tlog_host_ip(cc) -> str:
+    """ip of the worker hosting the current generation's first TLog."""
+    iface = cc.db_info.tlogs[0]
+    for v in vars(iface).values():
+        ep = getattr(v, "_endpoint", None) or getattr(v, "ep", None)
+        if ep is not None:
+            return ep.address.ip
+    raise AssertionError("no endpoint on TLog interface")
+
+
+def test_single_reporter_convicts_and_recovery_stays_off(
+        teardown, knobs):  # noqa: F811
+    """K=1: one gray link convicts both endpoints — and with
+    CC_HEALTH_TRIGGERED_RECOVERY off (default) a degraded TLog host
+    still never triggers a recovery (bit-identical off-posture)."""
+    set_tracer(Tracer())
+    knobs.CC_DEGRADATION_REPORTERS = 1
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        await commit_kv(db, b"k", b"1")
+        cc = c.current_cc()
+        epoch0 = cc.db_info.epoch
+        tlog_ip = _tlog_host_ip(cc)
+        victim = next(p for p, *_ in c.workers
+                      if p.address.ip == tlog_ip)
+        other = next(p for p, *_ in c.workers
+                     if p.address.ip != tlog_ip)
+        await delay(3.0)
+        c.sim.gray_clog_pair(victim, other, 0.2, 600.0)
+        deadline = now() + DETECTION_BUDGET_S
+        doc = None
+        while now() < deadline:
+            await delay(1.0)
+            doc = await db.cluster.get_status()
+            if doc["cluster"]["degraded_processes"]:
+                break
+        # Grace period: the recovery hook would fire within a ping
+        # interval of conviction if it were (wrongly) armed.  Re-fetch:
+        # by now BOTH endpoints of the link have crossed hysteresis.
+        await delay(5.0)
+        doc = await db.cluster.get_status()
+        return doc, epoch0, tlog_ip, c.current_cc().db_info.epoch
+
+    doc, epoch0, tlog_ip, epoch1 = c.run_until(c.loop.spawn(go()),
+                                               timeout=240)
+    degraded = doc["cluster"]["peer_health"]["degraded_processes"]
+    assert degraded, doc["cluster"]["peer_health"]
+    assert any(e["address"].split(":")[0] == tlog_ip for e in degraded)
+    assert all(len(e["reporters"]) >= 1 for e in degraded)
+    # Knob off: no recovery, no trigger event — ever.
+    assert epoch1 == epoch0
+    assert not get_tracer().find("CCHealthTriggeredRecovery")
+
+
+def test_health_triggered_recovery_when_enabled(teardown, knobs):  # noqa: F811
+    set_tracer(Tracer())
+    knobs.CC_DEGRADATION_REPORTERS = 1
+    knobs.CC_HEALTH_TRIGGERED_RECOVERY = True
+    c = make_cluster()
+    db = c.database()
+
+    async def go():
+        await commit_kv(db, b"r", b"1")
+        cc = c.current_cc()
+        epoch0 = cc.db_info.epoch
+        tlog_ip = _tlog_host_ip(cc)
+        victim = next(p for p, *_ in c.workers
+                      if p.address.ip == tlog_ip)
+        other = next(p for p, *_ in c.workers
+                     if p.address.ip != tlog_ip)
+        await delay(3.0)
+        c.sim.gray_clog_pair(victim, other, 0.2, 600.0)
+        deadline = now() + DETECTION_BUDGET_S + 15.0
+        while now() < deadline:
+            await delay(1.0)
+            if get_tracer().find("CCHealthTriggeredRecovery"):
+                break
+        c.sim.ungray_pair(victim, other)
+        # The triggered recovery must complete back to a serving state.
+        while now() < deadline + 60.0:
+            cc2 = c.current_cc()
+            if cc2 is not None and cc2.db_info.epoch > epoch0 and \
+                    cc2.db_info.recovery_state in ("accepting_commits",
+                                                   "fully_recovered"):
+                return epoch0, cc2.db_info.epoch
+            await delay(1.0)
+        return epoch0, c.current_cc().db_info.epoch
+
+    epoch0, epoch1 = c.run_until(c.loop.spawn(go()), timeout=300)
+    evs = get_tracer().find("CCHealthTriggeredRecovery")
+    assert evs, "recovery hook never fired with the knob on"
+    assert evs[0]["Role"] in ("tlog", "resolver")
+    assert epoch1 > epoch0
+    # ... and commits still flow afterwards.
+    c.run_until(c.loop.spawn(commit_kv(db, b"r2", b"2")), timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# Determinism: the whole plane under the unseed verifier
+# ---------------------------------------------------------------------------
+
+GRAY_SPEC = """
+[[test]]
+testTitle = 'GrayFailureDeterminism'
+
+  [[test.workload]]
+  testName = 'Cycle'
+  nodeCount = 8
+  actorCount = 3
+  testDuration = 8.0
+
+  [[test.workload]]
+  testName = 'ChaosNemesis'
+  testDuration = 8.0
+  swizzle = false
+  attrition = false
+  partitions = false
+  grayClog = true
+
+  [[test.workload]]
+  testName = 'ConsistencyCheck'
+"""
+
+
+def test_gray_failure_double_run_unseed_identical(teardown):  # noqa: F811
+    """Same seed, two runs, with pings, verdict flips, event-driven
+    re-registrations and the grayClog nemesis all inside the digest:
+    unseed, digest and fold counts must be bit-identical."""
+    from foundationdb_tpu.testing import run_test_twice
+    r1, r2 = run_test_twice(GRAY_SPEC, seed=311)
+    assert r1.unseed == r2.unseed and r1.digest == r2.digest
+    assert r1.folds == r2.folds and r1.folds > 0
+    assert r1.nondeterminism == [] and r2.nondeterminism == []
