@@ -267,7 +267,9 @@ class CallGraph:
         ``["call", *call_spec]`` — a constructor (`x = ClassName()`) or
         a factory whose returns-instance summary names a class;
         ``["ann", *base_spec]`` — an annotation; ``["selfattr", X]`` —
-        the enclosing class's attribute-type table through the MRO."""
+        the enclosing class's attribute-type table through the MRO;
+        ``["selfelem", X]`` — the ELEMENT type of the container attr X
+        (``Dict[K, C]`` values / ``List[C]`` elements, ISSUE 20)."""
         if not texpr or depth > 5:
             return None
         kind = texpr[0]
@@ -288,13 +290,21 @@ class CallGraph:
             if cls_name is None:
                 return None
             return self.attr_type(rel, cls_name, texpr[1], depth + 1)
+        if kind == "selfelem":
+            if cls_name is None:
+                return None
+            return self.attr_type(rel, cls_name, texpr[1], depth + 1,
+                                  table="elem_types")
         return None
 
     def attr_type(self, rel: str, cls_name: str, attr: str,
-                  depth: int = 0) -> Optional[Tuple[str, str]]:
+                  depth: int = 0,
+                  table: str = "attr_types") -> Optional[Tuple[str, str]]:
         """The class of ``self.<attr>`` on (rel, cls_name), looked up in
         the per-class attribute-type tables (constructor assignments /
-        annotations recorded at extraction) through the MRO."""
+        annotations recorded at extraction) through the MRO.  With
+        ``table="elem_types"`` the lookup answers for the container's
+        ELEMENTS instead (``self.<attr>[k]``)."""
         seen: Set[Tuple[str, str]] = set()
         queue = [(rel, cls_name)]
         hops = 0
@@ -307,7 +317,7 @@ class CallGraph:
             cf = self.facts.get(crel, {}).get("classes", {}).get(cname)
             if cf is None:
                 continue
-            texpr = cf.get("attr_types", {}).get(attr)
+            texpr = cf.get(table, {}).get(attr)
             if texpr is not None:
                 return self.resolve_type(crel, cname, texpr, depth + 1)
             for bspec in cf["bases"]:

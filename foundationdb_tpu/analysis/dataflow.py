@@ -56,6 +56,14 @@ from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 # abstraction: self._lock, self._send_lock, some_mutex ...
 _LOCK_NAME = re.compile(r"(?:^|_)(?:lock|mutex)$", re.IGNORECASE)
 
+# Container-of-locks names for SUBSCRIPTED lock positions
+# (``with self._locks[shard]:``): the plural/collection spellings of
+# the same convention.  Every element of one container collapses to a
+# single may-alias identity (``self._locks[*]``) — per allocation site,
+# not per key expression, exactly like PR 13's instance roles.
+_LOCK_CONTAINER_NAME = re.compile(
+    r"(?:^|_)(?:locks?|mutex(?:es)?)$", re.IGNORECASE)
+
 # Receiver-mutating container methods: `self.x.append(...)` counts as a
 # WRITE access to attribute x for lockset-discipline purposes (FTL012).
 MUTATOR_METHODS = frozenset({
@@ -81,7 +89,23 @@ def _terminal_name(expr: ast.expr) -> Optional[str]:
 
 def lock_key(expr: ast.expr) -> Optional[str]:
     """Dotted source text of `expr` when it is lock-shaped (its terminal
-    name ends in lock/mutex), e.g. 'self._lock'; None otherwise."""
+    name ends in lock/mutex), e.g. 'self._lock'; None otherwise.
+
+    A SUBSCRIPT of a lock-container-named base (``self._locks[shard]``,
+    ``mutexes[i]``) keys as ``<base>[*]`` — one may-alias element
+    identity per container, so two different shards' locks unify.
+    That is the may direction FTL011/013 want (holding ANY element
+    counts as holding the container's element identity) and errs
+    toward "protected" for FTL012."""
+    if isinstance(expr, ast.Subscript):
+        base = expr.value
+        name = _terminal_name(base)
+        if name is None or not _LOCK_CONTAINER_NAME.search(name):
+            return None
+        try:
+            return ast.unparse(base) + "[*]"
+        except Exception:           # pragma: no cover - defensive
+            return None
     name = _terminal_name(expr)
     if name is None or not _LOCK_NAME.search(name):
         return None

@@ -38,6 +38,19 @@ _SUPPRESS_FILE = re.compile(r"#\s*flowlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
 # `# flowlint: state` declares "this local is MEANT to survive awaits"
 # — FTL010 treats it the way the ACTOR compiler treats a state var.
 _STATE_ANNOT = re.compile(r"#\s*flowlint:\s*state\b")
+# The FTL017 justified-escape hatch: `# flowlint: owned -- <why>` on a
+# promise's CREATION line declares its registry is drained outside the
+# package's sight (C extension, test harness).  Kept separate from
+# disable= so the sanction travels with the FACTS (summaries.py) and
+# keeps applying when the file is read from the summary cache.
+_OWNED_ANNOT = re.compile(r"#\s*flowlint:\s*owned\b")
+
+
+def owned_lines(source: str) -> List[int]:
+    """Lines carrying the ``# flowlint: owned`` annotation."""
+    return [lineno for lineno, text in
+            enumerate(source.splitlines(), 1)
+            if _OWNED_ANNOT.search(text)]
 
 
 def is_actor(node: ast.AST) -> bool:
@@ -307,6 +320,11 @@ class LintResult:
         self.baselined: List[Finding] = []
         self.suppressed: int = 0
         self.files_scanned: int = 0
+        # --stats instrumentation (ISSUE 20): per-rule finding (new +
+        # baselined) and suppression counts, and wall-clock per phase
+        # (populated only when the Analyzer was given a clock).
+        self.rule_stats: Dict[str, Dict[str, int]] = {}
+        self.timings: Dict[str, float] = {}
 
     @property
     def exit_code(self) -> int:
@@ -323,14 +341,35 @@ class LintResult:
             "baselined": [f.to_dict() for f in self.baselined],
         }
 
+    def stats_dict(self) -> Dict[str, object]:
+        """The ``--stats`` document: per-rule finding/suppression
+        counts (every registered rule listed, zeros included — a
+        stable shape CI can diff) + phase timings in seconds."""
+        return {
+            "version": 1,
+            "files_scanned": self.files_scanned,
+            "counts": {"new": len(self.new),
+                       "baselined": len(self.baselined),
+                       "suppressed": self.suppressed},
+            "rules": {k: dict(v)
+                      for k, v in sorted(self.rule_stats.items())},
+            "phases": {k: round(v, 6)
+                       for k, v in self.timings.items()},
+        }
+
 
 class Analyzer:
     """Runs a rule set over one or more roots (directories or files)."""
 
     def __init__(self, rules: Sequence[Rule],
-                 summary_cache: Optional[str] = None) -> None:
+                 summary_cache: Optional[str] = None,
+                 clock: Optional[Callable[[], float]] = None) -> None:
         self.rules = list(rules)
         self.summary_cache = summary_cache
+        # Injected by the CLI for --stats (time.perf_counter there) —
+        # the analysis package itself never reads a clock, so FTL001
+        # stays clean over its own source.
+        self._clock = clock
         # Per-node dispatch dominates the lint runtime (PERF.md): only
         # call the hooks a rule actually overrides.  Dataflow-only
         # rules (FTL010-012) never pay the per-node visit fan-out.
@@ -386,6 +425,16 @@ class Analyzer:
             baseline: Optional[List[Dict[str, str]]] = None) -> LintResult:
         result = LintResult()
         raw: List[Finding] = []
+        stats = result.rule_stats
+        for r in self.rules:
+            stats[r.id] = {"findings": 0, "suppressed": 0}
+
+        def _bump(rule_id: str, kind: str) -> None:
+            stats.setdefault(
+                rule_id, {"findings": 0, "suppressed": 0})[kind] += 1
+
+        clock = self._clock
+        t0 = clock() if clock else 0.0
         program = None
         if self._ip_rules:
             from .summaries import ProgramIndex
@@ -412,10 +461,12 @@ class Analyzer:
                 for f in ctx.findings:
                     if ctx.is_suppressed(f.rule, f.line):
                         result.suppressed += 1
+                        _bump(f.rule, "suppressed")
                     else:
                         raw.append(f)
                 if program is not None:
                     program.add_scanned(ctx, path)
+        t1 = clock() if clock else 0.0
         if program is not None:
             # Link the whole program (cache/standalone facts for files
             # outside the scanned set), then run the interprocedural
@@ -426,6 +477,7 @@ class Analyzer:
             def _report_ip(f: Finding) -> None:
                 if program.is_suppressed(f.rule, f.path, f.line):
                     result.suppressed += 1
+                    _bump(f.rule, "suppressed")
                 else:
                     raw.append(f)
 
@@ -441,12 +493,17 @@ class Analyzer:
                  entry.get("message", ""))
             remaining[k] = remaining.get(k, 0) + 1
         for f in sorted(raw, key=Finding.sort_key):
+            _bump(f.rule, "findings")
             k = f.key()
             if remaining.get(k, 0) > 0:
                 remaining[k] -= 1
                 result.baselined.append(f)
             else:
                 result.new.append(f)
+        t2 = clock() if clock else 0.0
+        if clock:
+            result.timings = {"scan": t1 - t0, "link": t2 - t1,
+                              "total": t2 - t0}
         return result
 
 

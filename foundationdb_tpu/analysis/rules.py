@@ -1,4 +1,4 @@
-"""flowlint rules FTL001..FTL016.
+"""flowlint rules FTL001..FTL018.
 
 Every rule is grounded in a bug class this repo has actually hit (see
 ISSUE/PR history): wall-clock reads that break unseed reproduction,
@@ -499,7 +499,16 @@ class TraceEventRule(Rule):
     2. no two modules may emit the same Type with different *chained*
        detail schemas — a Type is a contract for trace consumers.
        Details added through a variable are invisible statically and
-       make that callsite "open" (exempt from the comparison)."""
+       make that callsite "open" (exempt from the comparison);
+    3. every ``trace_batch_event(type, id, location)`` span point must
+       carry a dotted CamelCase-headed Location (ISSUE 20) — the
+       commit-debug waterfall keys hops on the ``Role.point`` prefix,
+       so a free-form location silently drops out of the timeline.
+       F-string locations (``f"Rpc.encode.{name}"``, the PR-14 codec
+       span points; ``f"TLog.{self.id}.commit"``) are validated on
+       their static prefix, which must reach a separator on the same
+       grammar; a fully-dynamic location with no static head is a
+       finding."""
 
     id = "FTL007"
     title = "TraceEvent naming / schema drift"
@@ -508,6 +517,12 @@ class TraceEventRule(Rule):
     # Established cross-role correlation events whose Location field IS
     # the schema discriminator (emitted via trace_batch_event).
     SCHEMA_ALLOWLIST = {"CommitDebug", "TransactionDebug"}
+    # Span-point Location grammar: CamelCase role head + >=1 dotted
+    # point segments.  The PREFIX form additionally accepts ':' (the
+    # ``CommitProxy.batch:{span}`` key spelling) and a trailing
+    # separator with the segment still to come from the f-string.
+    SPAN_POINT = re.compile(r"^[A-Z][A-Za-z0-9]*(\.[A-Za-z0-9_]+)+$")
+    SPAN_PREFIX = re.compile(r"^[A-Z][A-Za-z0-9]*([.:][A-Za-z0-9_]*)*$")
 
     def __init__(self) -> None:
         # type -> {module: [keyset or None per callsite]}
@@ -544,9 +559,44 @@ class TraceEventRule(Rule):
                 return None
             return None
 
+    def _check_span_point(self, call: ast.Call, ctx) -> None:
+        loc = call.args[2]
+        if isinstance(loc, ast.Constant) and isinstance(loc.value, str):
+            if not self.SPAN_POINT.match(loc.value):
+                ctx.report(
+                    self, call,
+                    f"trace_batch_event location {loc.value!r} is not "
+                    "a dotted CamelCase-headed span point "
+                    "('Role.point', e.g. 'CommitProxy.batchStart', "
+                    "'Rpc.encode.<name>') — the commit-debug waterfall "
+                    "drops it")
+        elif isinstance(loc, ast.JoinedStr):
+            vals = loc.values
+            head = vals[0] if vals else None
+            if not (isinstance(head, ast.Constant) and
+                    isinstance(head.value, str)):
+                ctx.report(
+                    self, call,
+                    "trace_batch_event f-string location has no static "
+                    "CamelCase head — trace consumers key hops on the "
+                    "'Role.point' prefix; start the location with the "
+                    "literal role name")
+            elif not self.SPAN_PREFIX.match(head.value):
+                ctx.report(
+                    self, call,
+                    f"trace_batch_event location prefix {head.value!r} "
+                    "does not follow the 'Role.point' span-point "
+                    "grammar (CamelCase head, dotted segments)")
+        # A location built from a plain variable is invisible
+        # statically: an open callsite, same as opaque detail keys.
+
     def visit(self, node: ast.AST, ctx) -> None:
         if not isinstance(node, ast.Call):
             return
+        if isinstance(node.func, ast.Name) and \
+                node.func.id == "trace_batch_event" and \
+                len(node.args) >= 3:
+            self._check_span_point(node, ctx)
         # Only the outermost call of each chain: skip a Call that is the
         # receiver of another attribute call.
         parent = ctx.parent(node)
@@ -1240,6 +1290,210 @@ class PromiseProtocolRule(Rule):
                     "explicitly"))
 
 
+class ContainerOwnershipRule(Rule):
+    """FTL017: a promise parked in a container field nobody drains.
+
+    FTL016 treats ANY escape as "ownership moved"; this rule closes the
+    container half of that trust (ISSUE 20).  Pushing a Promise into
+    ``self.<field>`` (append/add/heappush/put/subscript-store) is only
+    a sanctioned hand-off if some in-package function DRAINS that field
+    — extracts elements (pop/popleft/heappop/subscript/iterate) and
+    resolves them (send/send_error/break_promise), possibly through a
+    helper the element is forwarded to (the producer/consumer
+    summaries composed bottom-up in summaries.py's ownership
+    fixpoint).  A registry nobody drains is the deposed-CC bug class
+    at scale: every parked waiter hangs until GC luck.  Field identity
+    is the allocation-site owner through the MRO (like lock
+    identities), so a drain in Base sanctions parks in Sub.
+    ``# flowlint: owned -- <why>`` on the CREATION line is the
+    justified escape hatch (a registry drained outside the package's
+    sight).  Conservative directions: an unresolvable park type or
+    field contributes nothing; ANY in-package drain of the field
+    sanctions it (may-analysis on the consumer side)."""
+
+    id = "FTL017"
+    title = "promise parked in a container field nobody drains"
+
+    PROMISE_CLASSES = PromiseProtocolRule.PROMISE_CLASSES
+
+    def finish_program(self, program, report) -> None:
+        seen: Set[tuple] = set()
+        for rel, qname, fn, fid in program.iter_scanned_functions():
+            cls = fn.get("cls")
+            if cls is None:
+                continue        # parks are self-container stores only
+            for line, attr, texpr in fn.get("parks", ()):
+                t = program.resolve_type(rel, cls, texpr)
+                if t is None or t[1] not in self.PROMISE_CLASSES:
+                    continue
+                if program.field_drained(rel, cls, attr) or \
+                        program.owned_line(rel, line):
+                    continue
+                ident = program.field_identity(rel, cls, attr)
+                key = (rel, line, ident)
+                if key in seen:
+                    continue
+                seen.add(key)
+                report(Finding(
+                    self.id, rel, line,
+                    f"{t[1]} created here ({qname}) is parked in "
+                    f"'self.{attr}' but no in-package function drains "
+                    f"{ident[1]}.{attr} (pop/iterate + send/send_error/"
+                    "break_promise on the elements) — every parked "
+                    "waiter hangs until GC luck (the deposed-CC bug "
+                    "class); drain the registry, or annotate the "
+                    "creation with '# flowlint: owned -- <why>'"))
+
+
+class WireEvolutionRule(Rule):
+    """FTL018: wire-evolution hazards on golden-frozen structs.
+
+    PRs 14-16 froze the hot-RPC wire image behind sha256 goldens, with
+    ``_ELIDE_DEFAULT_FIELDS`` (a field elided from the frame while at
+    its default) and ``_CODEC_VERSIONS`` (an explicit format bump) as
+    the two sanctioned evolution paths.  One field grafted outside
+    them silently breaks the mixed-version rollout: the old decoder
+    rejects the new frame mid-upgrade.  This rule cross-references the
+    ``_GOLDEN_FROZEN_FIELDS`` registry against every scanned
+    ``@dataclass`` field list:
+
+      * a field beyond the frozen list that is neither elided nor
+        version-gated -> finding at the field's line;
+      * a sanctioned added field with NO default -> finding (the
+        decode path is not format-transparent: a frame without the
+        field cannot fill it);
+      * a frozen field missing from the dataclass, or an elide entry
+        naming a nonexistent field -> drift finding at the class line.
+
+    ``reply`` fields never travel (serde's ``_iter_fields`` skips
+    them) and are skipped here too.  A struct name defined in more
+    than one scanned file is ambiguous and contributes nothing (the
+    silent direction)."""
+
+    id = "FTL018"
+    title = "field grafted onto a golden-frozen wire struct"
+
+    REGISTRIES = ("_GOLDEN_FROZEN_FIELDS", "_ELIDE_DEFAULT_FIELDS",
+                  "_CODEC_VERSIONS")
+    SKIP_FIELDS = frozenset({"reply"})
+
+    def __init__(self) -> None:
+        self._registries: Dict[str, dict] = {}
+        # struct -> [(path, class line, fields, class-line suppressed)]
+        # with fields = [(name, has_default, line, suppressed)].
+        self._structs: Dict[str, List[tuple]] = {}
+
+    def _collect_registry(self, name: str, value: ast.expr) -> None:
+        if not isinstance(value, ast.Dict):
+            return
+        table = self._registries.setdefault(name, {})
+        for k, v in zip(value.keys, value.values):
+            if not (isinstance(k, ast.Constant) and
+                    isinstance(k.value, str)):
+                continue
+            if isinstance(v, (ast.Tuple, ast.List)):
+                elts = [e.value for e in v.elts
+                        if isinstance(e, ast.Constant) and
+                        isinstance(e.value, str)]
+                table.setdefault(k.value, elts)
+            elif isinstance(v, ast.Constant) and \
+                    isinstance(v.value, int):
+                table.setdefault(k.value, v.value)
+
+    @staticmethod
+    def _is_dataclass(node: ast.ClassDef) -> bool:
+        for d in node.decorator_list:
+            f = d.func if isinstance(d, ast.Call) else d
+            name = f.id if isinstance(f, ast.Name) else \
+                f.attr if isinstance(f, ast.Attribute) else None
+            if name == "dataclass":
+                return True
+        return False
+
+    def visit(self, node: ast.AST, ctx) -> None:
+        if isinstance(node, ast.Assign) and ctx.at_module_level and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id in self.REGISTRIES:
+            self._collect_registry(node.targets[0].id, node.value)
+        elif isinstance(node, ast.ClassDef) and self._is_dataclass(node):
+            fields = []
+            for stmt in node.body:
+                if not (isinstance(stmt, ast.AnnAssign) and
+                        isinstance(stmt.target, ast.Name)):
+                    continue
+                ann = stmt.annotation
+                head = ann.value if isinstance(ann, ast.Subscript) \
+                    else ann
+                hname = head.id if isinstance(head, ast.Name) else \
+                    head.attr if isinstance(head, ast.Attribute) else None
+                if hname == "ClassVar":
+                    continue        # not a wire field
+                fields.append((stmt.target.id, stmt.value is not None,
+                               stmt.lineno,
+                               ctx.is_suppressed(self.id, stmt.lineno)))
+            self._structs.setdefault(node.name, []).append(
+                (ctx.path, node.lineno, fields,
+                 ctx.is_suppressed(self.id, node.lineno)))
+
+    def finish(self, report) -> None:
+        golden = self._registries.get("_GOLDEN_FROZEN_FIELDS")
+        if not golden:
+            return                  # no frozen registry in the scan
+        elide = self._registries.get("_ELIDE_DEFAULT_FIELDS", {})
+        versions = self._registries.get("_CODEC_VERSIONS", {})
+        for struct in sorted(golden):
+            frozen = golden[struct]
+            defs = self._structs.get(struct, [])
+            if not isinstance(frozen, list) or len(defs) != 1:
+                continue
+            path, cls_line, fields, cls_sup = defs[0]
+            frozen_set = set(frozen)
+            elided = set(elide.get(struct) or ())
+            gated = isinstance(versions.get(struct), int) and \
+                versions[struct] >= 2
+            names: Set[str] = set()
+            for fname, has_default, line, sup in fields:
+                if fname in self.SKIP_FIELDS:
+                    continue
+                names.add(fname)
+                if fname in frozen_set or sup:
+                    continue
+                if fname not in elided and not gated:
+                    report(Finding(
+                        self.id, path, line,
+                        f"field '{fname}' grafted onto golden-frozen "
+                        f"wire struct {struct} with no "
+                        "_ELIDE_DEFAULT_FIELDS registration and no "
+                        "_CODEC_VERSIONS bump — the previous release's "
+                        "decoder rejects the new frame mid-rollout; "
+                        "elide it at its default, or version-gate the "
+                        "codec"))
+                elif not has_default:
+                    report(Finding(
+                        self.id, path, line,
+                        f"added field '{fname}' on golden-frozen "
+                        f"{struct} has no default — the decode path is "
+                        "not format-transparent (a frame without the "
+                        "field cannot fill it); give it a wire-absent "
+                        "default"))
+            if cls_sup:
+                continue
+            for missing in sorted(frozen_set - names):
+                report(Finding(
+                    self.id, path, cls_line,
+                    f"golden-frozen field '{missing}' of {struct} no "
+                    "longer exists on the dataclass — frames encoded "
+                    "by the frozen format no longer decode; restore "
+                    "the field or re-freeze the goldens deliberately"))
+            for ghost in sorted(elided - names):
+                report(Finding(
+                    self.id, path, cls_line,
+                    f"_ELIDE_DEFAULT_FIELDS names '{ghost}' on "
+                    f"{struct}, which has no such field — registry "
+                    "drift; drop the stale entry"))
+
+
 def make_rules() -> List[Rule]:
     """Fresh rule instances — ALWAYS construct per run: rules carry
     cross-file state (TraceEventRule._by_type), so sharing instances
@@ -1251,4 +1505,5 @@ def make_rules() -> List[Rule]:
             HardcodedTunableRule(), KnobNameRule(),
             StaleStateAcrossAwaitRule(), AwaitHoldingLockRule(),
             LocksetDisciplineRule(), TransitiveBlockingRule(),
-            LockAliasRule(), LockOrderCycleRule(), PromiseProtocolRule()]
+            LockAliasRule(), LockOrderCycleRule(), PromiseProtocolRule(),
+            ContainerOwnershipRule(), WireEvolutionRule()]

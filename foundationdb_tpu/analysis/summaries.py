@@ -25,6 +25,11 @@ answers the cross-function ones the remaining hazard shapes need:
     is unified with the one concrete lock every caller passes (it then
     participates in FTL012's join/meet); callers that disagree are an
     FTL014 finding.
+  * **container ownership** (ISSUE 20) — a promise parked in a
+    ``self.<field>`` container is only a sanctioned escape if some
+    in-package function DRAINS that field (extract + resolve, composed
+    bottom-up through pass-the-promise helpers); an undrained registry
+    is FTL017 at the creation line.
 
 Facts are extracted per FILE (one dict per file, JSON-safe) and cached
 on disk keyed by content hash, so ``--changed`` runs reuse the whole
@@ -54,7 +59,8 @@ from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 from .callgraph import (CallGraph, base_spec, build_import_tables,
                         call_spec, module_name_for, resolve_external)
 from .dataflow import DefInfo, FunctionDataflow, is_set_expr, lock_key
-from .engine import _suppressions, iter_py_files, topmost_package
+from .engine import (_suppressions, iter_py_files, owned_lines,
+                     topmost_package)
 from .rules import AwaitHoldingLockRule, WallClockRule, _sim_reachable
 
 # The cache FILE format version (shape of the JSON envelope).
@@ -67,7 +73,12 @@ CACHE_VERSION = 1
 # keyed by (content hash, stamp); either mismatch is a miss.
 #   2: ISSUE 13 — typed call specs, lock registry (attrs/attr_types/
 #      module_locks), acquisitions, rets_type, promise leaks.
-ANALYSIS_VERSION = 2
+#   3: ISSUE 20 — container ownership protocol (parks/drains/
+#      drain_forwards/resolver_params/param_forwards, per-file owned
+#      lines), per-class container element types (elem_types),
+#      annotation-driven receiver specs (Optional[C] / C | None /
+#      string forward references).
+ANALYSIS_VERSION = 3
 
 # THE wait-method and clock predicates live on the rules (FTL011 /
 # FTL001); the summaries import them so the transitive reach can never
@@ -184,6 +195,130 @@ def _texpr_of_value(v: Optional[ast.expr]):
     return None
 
 
+_OPTIONAL_HEADS = frozenset({"Optional"})
+_UNION_HEADS = frozenset({"Union"})
+_ELEM_CONTAINER_HEADS = frozenset({
+    "List", "list", "Set", "set", "FrozenSet", "frozenset", "Deque",
+    "deque", "Sequence", "MutableSequence", "Iterable", "Iterator",
+    "Tuple", "tuple"})
+_ELEM_MAPPING_HEADS = frozenset({
+    "Dict", "dict", "DefaultDict", "defaultdict", "OrderedDict",
+    "Mapping", "MutableMapping"})
+_SCALAR_ANN_NAMES = frozenset({
+    "None", "Any", "int", "float", "bool", "str", "bytes", "bytearray",
+    "object", "complex"})
+
+
+def _ann_head(a: ast.expr) -> Optional[str]:
+    if isinstance(a, ast.Name):
+        return a.id
+    if isinstance(a, ast.Attribute):
+        return a.attr
+    return None
+
+
+def _parse_str_ann(a: ast.expr) -> ast.expr:
+    """A string annotation re-parsed to its expression (PEP 484 forward
+    references — the codebase's dominant spelling for self-referential
+    classes)."""
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        try:
+            return ast.parse(a.value.strip(), mode="eval").body
+        except (SyntaxError, ValueError):
+            return a
+    return a
+
+
+def ann_spec(a: Optional[ast.expr]):
+    """Base spec of the ONE class an annotation names, through the
+    idioms the codebase actually writes: plain ``C``/``mod.C``,
+    ``Optional[C]``, ``Union[C, None]``, ``C | None``, and ``"C"``
+    forward references.  A union of two real classes is ambiguity —
+    None (the conservative direction: a wrongly-typed receiver can
+    silence caller-held seeding for a real race)."""
+    if a is None:
+        return None
+    a = _parse_str_ann(a)
+    if isinstance(a, ast.Subscript):
+        head = _ann_head(a.value)
+        if head in _OPTIONAL_HEADS:
+            return ann_spec(a.slice)
+        if head in _UNION_HEADS:
+            elts = a.slice.elts if isinstance(a.slice, ast.Tuple) \
+                else [a.slice]
+            return _one_class_spec(elts)
+        return None
+    if isinstance(a, ast.BinOp) and isinstance(a.op, ast.BitOr):
+        elts, work = [], [a]
+        while work:
+            e = work.pop()
+            if isinstance(e, ast.BinOp) and isinstance(e.op, ast.BitOr):
+                work.extend([e.left, e.right])
+            else:
+                elts.append(e)
+        return _one_class_spec(elts)
+    if _ann_head(a) in _SCALAR_ANN_NAMES:
+        return None
+    return base_spec(a)
+
+
+def _one_class_spec(elts):
+    specs = []
+    for e in elts:
+        s = ann_spec(e)
+        if s is not None and s not in specs:
+            specs.append(s)
+    return specs[0] if len(specs) == 1 else None
+
+
+def _join_type(table: dict, key: str, te) -> None:
+    """Single-type join for the class attr/elem type tables:
+    conflicting sites poison the entry (False, stripped after the
+    walk) — ambiguity never types a receiver."""
+    prior = table.get(key)
+    if prior is None:
+        table[key] = te
+    elif prior != te:
+        table[key] = False
+
+
+def elem_ann_spec(a: Optional[ast.expr]):
+    """Base spec of the ONE class a CONTAINER annotation stores:
+    ``List[C]`` / ``Deque[C]`` / ``Set[C]`` elements, ``Dict[K, C]``
+    values, with one level of ``Tuple[...]`` flattening
+    (``List[Tuple[int, int, Promise]]`` — the notified-waiter heap
+    shape) and scalar members ignored.  None unless exactly one class
+    survives — a heterogeneous container types nothing."""
+    if a is None:
+        return None
+    a = _parse_str_ann(a)
+    if not isinstance(a, ast.Subscript):
+        return None
+    head = _ann_head(a.value)
+    if head in _OPTIONAL_HEADS:
+        return elem_ann_spec(a.slice)
+    sl = a.slice
+    if head in _ELEM_MAPPING_HEADS:
+        if not (isinstance(sl, ast.Tuple) and len(sl.elts) == 2):
+            return None
+        cands = [sl.elts[1]]
+    elif head in _ELEM_CONTAINER_HEADS:
+        cands = list(sl.elts) if isinstance(sl, ast.Tuple) else [sl]
+    else:
+        return None
+    flat = []
+    for c in cands:
+        c = _parse_str_ann(c)
+        if isinstance(c, ast.Subscript) and \
+                _ann_head(c.value) in ("Tuple", "tuple"):
+            inner = c.slice
+            flat.extend(inner.elts if isinstance(inner, ast.Tuple)
+                        else [inner])
+        else:
+            flat.append(c)
+    return _one_class_spec(flat)
+
+
 def _infer_receiver(cfg: FunctionDataflow, node, name: str):
     """The local type-inference lattice, joined over reaching defs:
     every def must yield the SAME type expression (constructor/factory
@@ -197,8 +332,7 @@ def _infer_receiver(cfg: FunctionDataflow, node, name: str):
     out = None
     for d in infos:
         if d.is_param:
-            spec = base_spec(d.annotation) if d.annotation is not None \
-                else None
+            spec = ann_spec(d.annotation)
             te = (["ann"] + spec) if spec is not None else None
         elif d.unpacked or d.value is None:
             te = None
@@ -419,6 +553,292 @@ def _leaked_defs(cfg: FunctionDataflow, parents) -> List[list]:
         if leaked & (1 << i)]
 
 
+# -- container ownership protocol (FTL017, ISSUE 20) -------------------------
+
+_PARK_METHODS = frozenset({"append", "appendleft", "add", "push",
+                           "put", "put_nowait"})
+_PARK_FREE = frozenset({"heappush"})
+_POP_METHODS = frozenset({"pop", "popleft", "popitem", "get",
+                          "get_nowait"})
+_POP_FREE = frozenset({"heappop"})
+_ITER_WRAPPERS = frozenset({"list", "tuple", "sorted", "iter",
+                            "reversed"})
+_ITER_VIEWS = frozenset({"values", "items"})
+
+
+def _walk_own_scope(func):
+    """Walk the function's OWN statements — nested defs/lambdas run
+    (and drain) under their own control, mirroring the records loop."""
+    work = list(ast.iter_child_nodes(func))
+    while work:
+        n = work.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        work.extend(ast.iter_child_nodes(n))
+
+
+def _self_attr_name(e) -> Optional[str]:
+    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+            and e.value.id == "self":
+        return e.attr
+    return None
+
+
+def _terminal_of(e) -> Optional[str]:
+    if isinstance(e, ast.Name):
+        return e.id
+    if isinstance(e, ast.Attribute):
+        return e.attr
+    return None
+
+
+def _container_protocol(func, cfg: FunctionDataflow) -> dict:
+    """Producer/consumer facts for the cross-function ownership
+    protocol: an escape into a ``self.<field>`` container is only
+    sanctioned when some in-package function DRAINS that field —
+    extracts elements (pop/popleft/heappop/subscript/iterate) and
+    resolves them (PROMISE_RESOLVERS), possibly through a helper the
+    element is handed to.  Five JSON-safe fact lists:
+
+      parks:           [[creation line, field, texpr]] — a value pushed
+                       into a self-container (append/add/heappush/
+                       put/setdefault/subscript-store), attributed to
+                       the CREATION line of the pushed name's
+                       call-valued def(s) (the push line for an inline
+                       call), tuple/list wrappers unwrapped;
+      drains:          [field] — fields whose extracted elements this
+                       function resolves directly;
+      drain_forwards:  [[field, callee spec, arg index]] — an extracted
+                       element handed to a callee; a drain iff the
+                       callee's matching param resolves (composed
+                       bottom-up at link time);
+      resolver_params: [param] — params this function resolves;
+      param_forwards:  [[param, callee spec, arg index]].
+
+    Unknown callees and unidentifiable fields contribute nothing (the
+    silent direction — FTL017 never invents a finding from ambiguity;
+    the drain side is deliberately may-analysis: ANY in-package drain
+    sanctions the registry)."""
+    own = [n for n in _walk_own_scope(func)]
+    params = {a.arg for a in (list(func.args.posonlyargs)
+                              + list(func.args.args)
+                              + list(func.args.kwonlyargs))}
+
+    def _unwrap_or(e):
+        # `self._batch or []` — the swap-with-default idiom; the field
+        # is the interesting operand.
+        if isinstance(e, ast.BoolOp) and isinstance(e.op, ast.Or):
+            for v in e.values:
+                if _self_attr_name(v) is not None:
+                    return v
+        return e
+
+    # One level of local aliasing: `ws = self._waiters` AND the atomic
+    # tuple swap `ws, self._waiters = self._waiters, []` (the
+    # swap-and-drain idiom in core/futures.py / cluster_controller's
+    # _publish), with an optional `or []` default on the swapped-out
+    # value.
+    alias: Dict[str, str] = {}
+    for n in own:
+        if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+            continue
+        t0 = n.targets[0]
+        if isinstance(t0, ast.Name):
+            fld = _self_attr_name(_unwrap_or(n.value))
+            if fld is not None:
+                alias[t0.id] = fld
+        elif isinstance(t0, ast.Tuple) and \
+                isinstance(n.value, ast.Tuple) and \
+                len(t0.elts) == len(n.value.elts):
+            for tt, vv in zip(t0.elts, n.value.elts):
+                if isinstance(tt, ast.Name):
+                    fld = _self_attr_name(_unwrap_or(vv))
+                    if fld is not None:
+                        alias[tt.id] = fld
+
+    def field_of(e) -> Optional[str]:
+        fld = _self_attr_name(e)
+        if fld is not None:
+            return fld
+        if isinstance(e, ast.Name):
+            return alias.get(e.id)
+        return None
+
+    def pop_field(call) -> Optional[str]:
+        """self.<field> (or an alias of it) an extraction call pulls
+        from, else None."""
+        if not isinstance(call, ast.Call):
+            return None
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in _POP_METHODS:
+            return field_of(f.value)
+        if _terminal_of(f) in _POP_FREE and call.args:
+            return field_of(call.args[0])
+        return None
+
+    def iter_field(e) -> Optional[str]:
+        """self.<field> a for-loop iterable ranges over — directly,
+        via .values()/.items(), or under one list()/sorted()-style
+        wrapper."""
+        fld = field_of(e)
+        if fld is not None:
+            return fld
+        if isinstance(e, ast.Call):
+            f = e.func
+            if isinstance(f, ast.Attribute) and f.attr in _ITER_VIEWS:
+                return field_of(f.value)
+            if isinstance(f, ast.Name) and f.id in _ITER_WRAPPERS \
+                    and e.args:
+                return iter_field(e.args[0])
+        return None
+
+    parks: List[list] = []
+
+    def record_park(field: str, value, line: int) -> None:
+        vs = value.elts if isinstance(value, (ast.Tuple, ast.List)) \
+            else [value]
+        for v in vs:
+            te = _texpr_of_value(v)
+            if te is not None:
+                if [line, field, te] not in parks:
+                    parks.append([line, field, te])
+                continue
+            if not isinstance(v, ast.Name):
+                continue
+            node = cfg.node_for(v)
+            infos = [d for d, _ in cfg.reaching(node, v.id)] \
+                if node is not None else \
+                [d for d in cfg.defs if d.name == v.id]
+            for d in infos:
+                if d.is_param or d.unpacked or d.value is None:
+                    continue
+                dte = _texpr_of_value(d.value)
+                if dte is not None and \
+                        [d.lineno, field, dte] not in parks:
+                    parks.append([d.lineno, field, dte])
+
+    for n in own:
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in _PARK_METHODS and n.args:
+                fld = field_of(f.value)
+                if fld is not None:
+                    record_park(fld, n.args[0], n.lineno)
+            elif isinstance(f, ast.Attribute) and \
+                    f.attr == "setdefault" and len(n.args) >= 2:
+                fld = field_of(f.value)
+                if fld is not None:
+                    record_park(fld, n.args[1], n.lineno)
+            elif _terminal_of(f) in _PARK_FREE and len(n.args) >= 2:
+                fld = field_of(n.args[0])
+                if fld is not None:
+                    record_park(fld, n.args[1], n.lineno)
+        elif isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript):
+                    fld = field_of(t.value)
+                    if fld is not None:
+                        record_park(fld, n.value, n.lineno)
+
+    drains: Set[str] = set()
+    bound: Dict[str, Set[str]] = {}     # extracted name -> source fields
+
+    def bind(target, field: str) -> None:
+        tgts = target.elts if isinstance(target, ast.Tuple) \
+            else [target]
+        for t in tgts:
+            if isinstance(t, ast.Name):
+                bound.setdefault(t.id, set()).add(field)
+            elif isinstance(t, ast.Tuple):
+                bind(t, field)
+
+    def popped_fields(v) -> Set[str]:
+        """Fields whose extracted element(s) `v` evaluates to: a pop
+        call, a Subscript PROJECTION of one (``self._pending.pop(rid)
+        [0]`` — the element rides inside a tuple entry), or a Name
+        already bound to popped values (two-step unpack: ``entry =
+        d.pop(k); p, _c, t0 = entry``)."""
+        fld = pop_field(v)
+        if fld is not None:
+            return {fld}
+        if isinstance(v, ast.Subscript):
+            return popped_fields(v.value)
+        if isinstance(v, ast.Name):
+            return set(bound.get(v.id, ()))
+        return set()
+
+    # To fixpoint: _walk_own_scope yields in stack order, not source
+    # order, so a name-through-name binding may be seen before its
+    # source name is bound.  Bounded by the alias-chain depth.
+    changed = True
+    while changed:
+        changed = False
+        before = {k: set(v) for k, v in bound.items()}
+        for n in own:
+            if isinstance(n, (ast.Assign, ast.AnnAssign)) and \
+                    getattr(n, "value", None) is not None:
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for fld in popped_fields(n.value):
+                    for t in targets:
+                        bind(t, fld)
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                fld = iter_field(n.iter)
+                if fld is not None:
+                    bind(n.target, fld)
+        if {k: set(v) for k, v in bound.items()} != before:
+            changed = True
+
+    resolved_names: Set[str] = set()
+    for n in own:
+        if not (isinstance(n, ast.Call) and
+                isinstance(n.func, ast.Attribute) and
+                n.func.attr in PROMISE_RESOLVERS):
+            continue
+        recv = n.func.value
+        fld = pop_field(recv)
+        if fld is not None:             # self.F.pop(0).send(...)
+            drains.add(fld)
+        elif isinstance(recv, ast.Subscript):
+            fld = field_of(recv.value)
+            if fld is not None:         # self.F[k].send(...)
+                drains.add(fld)
+        elif isinstance(recv, ast.Name):
+            resolved_names.add(recv.id)
+
+    for name, fields in bound.items():
+        if name in resolved_names:
+            drains.update(fields)
+
+    drain_forwards: List[list] = []
+    param_forwards: List[list] = []
+    for n in own:
+        if not isinstance(n, ast.Call):
+            continue
+        spec = call_spec(n)
+        if spec[0] == "opaque":
+            continue
+        for i, a in enumerate(n.args):
+            if not isinstance(a, ast.Name):
+                continue
+            for fld in sorted(bound.get(a.id, ())):
+                rec = [fld, spec, i]
+                if rec not in drain_forwards:
+                    drain_forwards.append(rec)
+            if a.id in params:
+                rec = [a.id, spec, i]
+                if rec not in param_forwards:
+                    param_forwards.append(rec)
+
+    return {"parks": parks, "drains": sorted(drains),
+            "drain_forwards": drain_forwards,
+            "resolver_params": sorted(params & resolved_names),
+            "param_forwards": param_forwards}
+
+
 def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
                        source: str, records, suppress_line,
                        suppress_file, parents=None) -> dict:
@@ -448,6 +868,10 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
                 # Base methods locking it must agree on ONE identity.
                 "attrs": [],
                 "attr_types": {},
+                # attr -> ONE inferable element type for container
+                # attrs (``Dict[K, C]`` values / ``List[C]`` elements,
+                # ISSUE 20) — feeds ``self.X[k].m()`` receiver typing.
+                "elem_types": {},
             }
             for stmt in node.body:
                 targets = stmt.targets if isinstance(stmt, ast.Assign) \
@@ -457,6 +881,12 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
                     if isinstance(t, ast.Name) and \
                             t.id not in c["attrs"]:
                         c["attrs"].append(t.id)
+                if isinstance(stmt, ast.AnnAssign) and \
+                        isinstance(stmt.target, ast.Name):
+                    es = elem_ann_spec(stmt.annotation)
+                    if es is not None:
+                        _join_type(c["elem_types"], stmt.target.id,
+                                   ["ann"] + es)
 
     if parents is None:
         parents = {}
@@ -498,16 +928,18 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
                 c["attrs"].append(t.attr)
             te = _texpr_of_value(value)
             if te is None and annot is not None:
-                spec = base_spec(annot)
+                spec = ann_spec(annot)
                 te = (["ann"] + spec) if spec is not None else None
             if te is not None:
-                prior = c["attr_types"].get(t.attr)
-                if prior is None:
-                    c["attr_types"][t.attr] = te
-                elif prior != te:
-                    c["attr_types"][t.attr] = False     # conflicted
+                _join_type(c["attr_types"], t.attr, te)
+            if annot is not None:
+                es = elem_ann_spec(annot)
+                if es is not None:
+                    _join_type(c["elem_types"], t.attr, ["ann"] + es)
     for c in classes.values():
         c["attr_types"] = {k: v for k, v in c["attr_types"].items()
+                           if v is not False}
+        c["elem_types"] = {k: v for k, v in c["elem_types"].items()
                            if v is not False}
 
     functions: Dict[str, dict] = {}
@@ -539,6 +971,17 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
                 # table; the receiver PATH (self.X) also names the
                 # instance role for object-sensitive lock identity.
                 spec = ["typed", ["selfattr", f.value.attr], f.attr]
+            elif spec[0] == "opaque" and isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Subscript) and \
+                    isinstance(f.value.value, ast.Attribute) and \
+                    isinstance(f.value.value.value, ast.Name) and \
+                    f.value.value.value.id == "self":
+                # self.X[k].m(): typed through the class's container
+                # ELEMENT-type table (``Dict[K, C]`` / ``List[C]``
+                # annotations) — every element of one container
+                # collapses to a single may-alias identity.
+                spec = ["typed", ["selfelem", f.value.value.attr],
+                        f.attr]
             calls.append([line, spec, sorted(cfg.lockset(node)),
                           id(call) in awaited_ids,
                           _arg_lock_keys(call, cfg, node)])
@@ -592,6 +1035,7 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
             (isinstance(n, ast.Name) and n.id == "sim") or
             (isinstance(n, ast.Attribute) and n.attr == "sim")
             for n in ast.walk(func))
+        proto = _container_protocol(func, cfg)
         functions[qname] = {
             "line": func.lineno, "async": cfg.is_async,
             "cls": cls_name, "name": func.name,
@@ -605,6 +1049,10 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
             "acquisitions": acquisitions, "leaks": leaks,
             "lock_params": dict(cfg.lock_params),
             "sim_ref": sim_ref,
+            "parks": proto["parks"], "drains": proto["drains"],
+            "drain_forwards": proto["drain_forwards"],
+            "resolver_params": proto["resolver_params"],
+            "param_forwards": proto["param_forwards"],
         }
 
     # Address-taken detection: a function referenced OUTSIDE call
@@ -661,6 +1109,10 @@ def extract_file_facts(rel: str, abspath: str, tree: ast.Module,
     return {"module": module, "is_pkg": is_pkg, "classes": classes,
             "imports": tables, "escapes": sorted(escapes),
             "module_locks": sorted(set(module_locks)),
+            # ``# flowlint: owned -- why`` lines: the FTL017 escape
+            # hatch, kept in the FACTS (not the engine's per-scan
+            # suppression maps) so cached files keep their sanction.
+            "owned": owned_lines(source),
             "functions": functions}
 
 
@@ -709,6 +1161,10 @@ class ProgramIndex:
         # may-acquire (FTL015): fid -> {entry: witness}, entry =
         # ("S", symbolic self-rooted key) | ("C", concrete identity).
         self._acq: Dict[str, Dict[tuple, tuple]] = {}
+        # FTL017 ownership protocol: drained field identities
+        # (rel, class, attr) and the composed resolver-param sets.
+        self._drained: Set[tuple] = set()
+        self._resolver_params: Dict[str, Set[str]] = {}
         # [(rel, qname, line, param, {key: [caller sites]})]
         self.param_conflicts: List[tuple] = []
         # rel paths excluded from the program because two roots own the
@@ -847,6 +1303,7 @@ class ProgramIndex:
         self._compute_set_valued()
         self._compute_entry_locks()
         self._compute_acquires()
+        self._compute_ownership()
 
     # -- summary fixpoints ---------------------------------------------------
     def _functions(self):
@@ -1150,6 +1607,73 @@ class ProgramIndex:
                         ri[fid] = v
                         changed = True
 
+    # -- container ownership protocol (ISSUE 20) -----------------------------
+    def _compute_ownership(self) -> None:
+        """The FTL017 producer/consumer protocol, composed bottom-up:
+        an LFP over param forwarding lifts "resolves its param" through
+        pass-the-promise helper chains, then every drain site — direct,
+        or a forward whose callee's matching param resolves — marks its
+        FIELD IDENTITY (allocation-site owner through the MRO, exactly
+        like lock identities) as drained.  Unknown callees contribute
+        nothing: a forward the graph cannot resolve never sanctions a
+        registry."""
+        rp: Dict[str, Set[str]] = {}
+        for rel, qname, fn, fid in self._functions():
+            if fn.get("resolver_params"):
+                rp[fid] = set(fn["resolver_params"])
+
+        def forwarded_resolves(rel, cls, spec, i) -> bool:
+            target = self.graph.resolve(rel, cls, list(spec))
+            if target is None:
+                return False
+            tfn = self.graph.function(target)
+            if tfn is None:
+                return False
+            shift = 1 if spec and spec[0] in ("self", "cls", "super",
+                                              "typed") else 0
+            tparams = tfn.get("params", [])
+            j = i + shift
+            return j < len(tparams) and tparams[j] in rp.get(target, ())
+
+        changed = True
+        while changed:
+            changed = False
+            for rel, qname, fn, fid in self._functions():
+                for param, spec, i in fn.get("param_forwards", ()):
+                    if param in rp.get(fid, ()):
+                        continue
+                    if forwarded_resolves(rel, fn.get("cls"), spec, i):
+                        rp.setdefault(fid, set()).add(param)
+                        changed = True
+        self._resolver_params = rp
+
+        drained: Set[tuple] = set()
+        for rel, qname, fn, fid in self._functions():
+            cls = fn.get("cls")
+            if cls is None:
+                continue
+            for attr in fn.get("drains", ()):
+                drained.add(self.field_identity(rel, cls, attr))
+            for attr, spec, i in fn.get("drain_forwards", ()):
+                if forwarded_resolves(rel, cls, spec, i):
+                    drained.add(self.field_identity(rel, cls, attr))
+        self._drained = drained
+
+    def field_identity(self, rel: str, cls: str, attr: str) -> tuple:
+        """(rel, class, attr) keyed by the base-most assigner through
+        the MRO — Sub parking into an inherited registry and Base
+        draining it agree on ONE field."""
+        owner = self.graph.attr_owner(rel, cls, attr)
+        return (owner[0], owner[1], attr)
+
+    def field_drained(self, rel: str, cls: str, attr: str) -> bool:
+        return self.field_identity(rel, cls, attr) in self._drained
+
+    def owned_line(self, rel: str, line: int) -> bool:
+        """``# flowlint: owned -- why`` on the creation line — the
+        FTL017 justified-escape hatch."""
+        return line in self.facts.get(rel, {}).get("owned", ())
+
     # -- object-sensitive lock identity (ISSUE 13) ---------------------------
     def lock_identities(self, rel: str, cls: Optional[str],
                         key: str) -> List[str]:
@@ -1167,21 +1691,30 @@ class ProgramIndex:
             class-level ordering — the AB/BA cycle through a field);
           * a bare module-level lock -> ``<rel>#<name>``; a bare
             function-local lock has NO shared identity (fresh per call)
-            and contributes nothing.
+            and contributes nothing;
+          * a container element key ``self._locks[*]`` (ISSUE 20)
+            carries its may-alias marker through: the identity is the
+            ALLOCATION SITE of the container, same as a scalar attr —
+            ``<rel>::<AllocOwner>#_locks[*]``.
         """
+        suffix = ""
+        if key.endswith("[*]"):
+            key, suffix = key[:-3], "[*]"
         parts = key.split(".")
         if parts[0] in ("self", "cls"):
             if cls is None or len(parts) < 2:
                 return []
             owner = self.graph.attr_owner(rel, cls, parts[1])
-            out = [f"{owner[0]}::{owner[1]}#{'.'.join(parts[1:])}"]
+            out = [f"{owner[0]}::{owner[1]}#"
+                   f"{'.'.join(parts[1:])}{suffix}"]
             if len(parts) > 2:
                 t = self.graph.attr_type(rel, cls, parts[1])
                 if t is not None:
                     out.extend(self.lock_identities(
-                        t[0], t[1], "self." + ".".join(parts[2:])))
+                        t[0], t[1],
+                        "self." + ".".join(parts[2:]) + suffix))
             return out
-        if len(parts) == 1 and \
+        if len(parts) == 1 and not suffix and \
                 key in self.facts.get(rel, {}).get("module_locks", ()):
             return [f"{rel}#{key}"]
         # Bare function-locals AND dotted non-self paths (a local

@@ -110,6 +110,36 @@ _ELIDE_DEFAULT_FIELDS = {
     "GetKeyValuesRequest": ("debug_id",),
 }
 
+# The hot-RPC wire image as frozen by the sha256 goldens (PRs 14-16),
+# minus `reply` (never travels).  flowlint's FTL018 cross-references
+# every @dataclass against this registry: a field grafted onto one of
+# these structs must either appear in _ELIDE_DEFAULT_FIELDS (elided at
+# its default, so the legacy frame stays bit-identical) or ride an
+# explicit _CODEC_VERSIONS bump — anything else breaks the
+# mixed-version rollout, because the previous release's decoder
+# rejects the new frame.  Re-freezing the goldens deliberately means
+# updating this list in the same commit.
+_GOLDEN_FROZEN_FIELDS = {
+    "ResolveTransactionBatchRequest": (
+        "prev_version", "version", "last_received_version",
+        "transactions", "txn_state_transactions", "proxy_id", "span"),
+    "ResolveTransactionBatchReply": (
+        "committed", "state_transactions", "conflicting_ranges",
+        "attribution_exact"),
+    "CommitTransactionRequest": (
+        "transaction", "debug_id", "repair_eligible", "repair_attempt"),
+    "TLogCommitRequest": (
+        "prev_version", "version", "known_committed_version",
+        "messages", "span"),
+    "TLogPeekReply": ("messages", "end", "max_known_version"),
+    "GetValueRequest": ("key", "version", "debug_id", "tag"),
+    "GetValueReply": ("value", "version"),
+    "GetKeyValuesRequest": (
+        "begin", "end", "version", "limit", "limit_bytes", "reverse",
+        "tag"),
+    "GetKeyValuesReply": ("data", "more", "version"),
+}
+
 
 def encode_value(w: Writer, v: Any) -> None:
     if v is None:

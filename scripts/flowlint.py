@@ -126,6 +126,12 @@ def main(argv=None) -> int:
                     help="interprocedural summary-cache path, or 'none' "
                          "to extract everything live "
                          f"(default: {DEFAULT_SUMMARY_CACHE})")
+    ap.add_argument("--stats", action="store_true",
+                    help="after linting, print per-rule finding/"
+                         "suppression counts and phase timings "
+                         "(scan/link/total) as JSON to stdout; the "
+                         "findings themselves go to stderr so the "
+                         "stats stay machine-parseable")
     ap.add_argument("--dump-callgraph", action="store_true",
                     help="print the resolved call graph as JSON edges "
                          "(caller/line/callee/raw target — the "
@@ -194,9 +200,16 @@ def main(argv=None) -> int:
     try:
         baseline = load_baseline(baseline_path) if baseline_path else []
         rules = make_rules()
-        result = Analyzer(rules,
-                          summary_cache=summary_cache).run(args.paths,
-                                                           baseline)
+        # The wall clock is INJECTED here rather than imported by the
+        # engine: analysis/ itself must stay clean under FTL001
+        # (wall-clock reads in actor code), and the CLI boundary is
+        # where nondeterminism is allowed in.
+        clock = None
+        if args.stats:
+            import time
+            clock = time.perf_counter
+        result = Analyzer(rules, summary_cache=summary_cache,
+                          clock=clock).run(args.paths, baseline)
     except Exception as e:  # noqa: BLE001 - CLI boundary: exit 2, not a trace
         print(f"flowlint: internal error: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -209,6 +222,17 @@ def main(argv=None) -> int:
               f"{len(result.new) + len(result.baselined)} finding(s) "
               f"written to {target}")
         return 0
+
+    if args.stats:
+        # Findings to stderr, stats JSON to stdout: `flowlint --stats |
+        # jq .phases.total` works even when the lint is red.
+        if args.format == "json":
+            print(json.dumps(result.to_dict(), indent=2),
+                  file=sys.stderr)
+        else:
+            print(format_text(result), file=sys.stderr)
+        print(json.dumps(result.stats_dict(), indent=2))
+        return result.exit_code
 
     if args.format == "json":
         print(json.dumps(result.to_dict(), indent=2))

@@ -1,0 +1,43 @@
+"""Minimal Promise/PromiseStream stand-ins for the container-ownership
+battery (FTL017) — enough surface for the type lattice to resolve
+receivers, no scheduler behind them."""
+
+
+class Promise:
+    def __init__(self):
+        self.sent = False
+
+    def send(self, value):
+        self.sent = True
+
+    def send_error(self, error):
+        self.sent = True
+
+    def break_promise(self):
+        self.sent = True
+
+    def get_future(self):
+        return self
+
+    def is_set(self):
+        return self.sent
+
+
+class PromiseStream:
+    def __init__(self):
+        self.queue = []
+
+    def send(self, value):
+        self.queue.append(value)
+
+    def send_error(self, error):
+        self.queue.append(error)
+
+    def close(self):
+        self.queue = None
+
+    def pop(self):
+        return self.queue.pop(0)
+
+    def empty(self):
+        return not self.queue

@@ -1,0 +1,27 @@
+"""Container-element lock identity: ``with self._locks[shard]:``
+collapses to ONE may-alias element identity per container allocation
+site (``self._locks[*]``), so the lock rules see subscripted
+acquisitions at all — holding any element across an await is FTL011
+exactly like a scalar lock."""
+# expect: FTL011:18
+
+import threading
+
+
+class ShardedTable:
+    def __init__(self):
+        self._locks = {}
+        self._rows = {}
+
+    async def bad_await_holding_element(self, shard, fut):
+        with self._locks[shard]:
+            await fut               # BAD: element lock held across await
+
+    def ok_sync_update(self, shard, value):
+        with self._locks[shard]:
+            self._rows[shard] = value
+
+    def lock_for(self, shard):
+        if shard not in self._locks:
+            self._locks[shard] = threading.Lock()
+        return self._locks[shard]

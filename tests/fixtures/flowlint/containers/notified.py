@@ -1,0 +1,71 @@
+"""Drained registries distilled from the repo — ALL CLEAN, no
+suppressions: the heap-of-tuples version gate (core/notified.py's
+shape) and the chained ``pop(0).send`` gate.  FTL017 must recognize
+both drain idioms (tuple-unpack of heappop, pop-call receiver of a
+resolver) or the real package would light up."""
+
+import heapq
+
+from .flowstub import Promise
+
+
+class Notified:
+    """when_at_least parks ``(version, seq, promise)`` on a heap;
+    set_value pops and sends every ripe waiter."""
+
+    def __init__(self):
+        self._value = 0
+        self._seq = 0
+        self._waiters = []
+
+    def when_at_least(self, version):
+        if self._value >= version:
+            p = Promise()
+            p.send(self._value)
+            return p.get_future()
+        p = Promise()
+        self._seq += 1
+        heapq.heappush(self._waiters, (version, self._seq, p))
+        return p.get_future()
+
+    def set_value(self, value):
+        self._value = value
+        while self._waiters and self._waiters[0][0] <= value:
+            _, _, p = heapq.heappop(self._waiters)
+            p.send(value)
+
+
+class _Gate:
+    """data_distribution's FIFO lock shape: release resolves the head
+    waiter straight off the pop call."""
+
+    def __init__(self):
+        self._queue = []
+
+    def wait(self):
+        p = Promise()
+        self._queue.append(p)
+        return p.get_future()
+
+    def release(self):
+        if self._queue:
+            self._queue.pop(0).send(None)
+
+
+class Broadcaster:
+    """cluster_controller's _publish shape: the atomic tuple swap
+    (``waiters, self._waiters = self._waiters, []``) then a fan-out
+    loop over the swapped-out batch."""
+
+    def __init__(self):
+        self._waiters = []
+
+    def subscribe(self):
+        p = Promise()
+        self._waiters.append(p)
+        return p.get_future()
+
+    def publish(self, value):
+        waiters, self._waiters = self._waiters or [], []
+        for p in waiters:
+            p.send(value)

@@ -1,0 +1,59 @@
+"""FTL017 battery: a promise PARKED in a ``self.<field>`` container is
+only a sanctioned FTL016 hand-off if some in-package function DRAINS
+that field — extracts elements and resolves them.  The registries here
+are never drained (the distilled ISSUE-10 deposed-CC shape: one parked
+waiter per request, hanging until GC luck), except where annotated
+``# flowlint: owned`` or drained through a forwarded helper."""
+# expect: FTL017:19 FTL017:24
+
+from .flowstub import Promise
+
+
+class LongPollRegistry:
+    def __init__(self):
+        self._waiters = []
+        self._stash = []
+        self._external = []
+
+    def subscribe(self):
+        p = Promise()               # BAD: _waiters is never drained
+        self._waiters.append(p)
+        return p.get_future()
+
+    def stash(self):
+        p = Promise()               # BAD: popped below, never resolved
+        self._stash.append(p)
+        return p.get_future()
+
+    def rebalance(self):
+        # A pop whose element is DISCARDED is not a drain — nothing is
+        # ever sent or broken, so `stash` above still fires.
+        if self._stash:
+            self._stash.pop()
+
+    def adopt(self):
+        q = Promise()  # flowlint: owned -- drained by the harness-side poller
+        self._external.append(q)
+        return q.get_future()
+
+
+class FanoutRegistry:
+    """Cross-function drain: each element is handed to a helper that
+    resolves it — sanctioned through the bottom-up forward summaries
+    (drain_forwards composed with the helper's resolver params)."""
+
+    def __init__(self):
+        self._parked = []
+
+    def subscribe(self):
+        p = Promise()               # OK: drain_all -> _resolve drains
+        self._parked.append(p)
+        return p.get_future()
+
+    def drain_all(self, value):
+        for p in self._parked:
+            self._resolve(p, value)
+        self._parked.clear()
+
+    def _resolve(self, p, value):
+        p.send(value)

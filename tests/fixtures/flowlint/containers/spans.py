@@ -1,0 +1,25 @@
+"""FTL007 span-point battery: ``trace_batch_event`` locations must
+follow the 'Role.point' grammar (dotted, CamelCase head) or the
+commit-debug waterfall tool drops them on the floor; an f-string needs
+a static CamelCase head so the waterfall can still bucket by role."""
+# expect: FTL007:20 FTL007:21 FTL007:24 FTL007:25
+
+
+def trace_batch_event(event_type, debug_id, location):
+    """Local stand-in with the real three-positional signature."""
+    return (event_type, debug_id, location)
+
+
+class Recorder:
+    def __init__(self):
+        self.span = "s"
+
+    def emit(self, name):
+        trace_batch_event("CommitDebug", self.span,
+                          "CommitProxy.batchStart")             # OK
+        trace_batch_event("CommitDebug", self.span, "lowercase.point")
+        trace_batch_event("CommitDebug", self.span, "NoDotHere")
+        trace_batch_event("CommitDebug", self.span,
+                          f"Rpc.encode.{name}")                 # OK
+        trace_batch_event("CommitDebug", self.span, f"{name}.encode")
+        trace_batch_event("CommitDebug", self.span, f"bad head {name}")

@@ -1,0 +1,67 @@
+"""FTL018 battery: wire-evolution hazards against a (fictional)
+golden-frozen registry.  The registries mirror rpc/serde.py's shape;
+the struct names are invented so the real package's goldens never
+collide with the fixture's."""
+# expect: FTL018:34 FTL018:44 FTL018:48 FTL018:57
+
+from dataclasses import dataclass, field
+from typing import Any, ClassVar, List
+
+_GOLDEN_FROZEN_FIELDS = {
+    "PingRequest": ("token", "version"),
+    "PongReply": ("token", "echo"),
+    "StatusRequest": ("detail",),
+    "LegacyProbe": ("probe_id", "deadline"),
+    "BumpedReply": ("rows",),
+}
+
+_ELIDE_DEFAULT_FIELDS = {
+    "PongReply": ("trace_id",),
+    "StatusRequest": ("verbose", "ghost_field"),
+}
+
+_CODEC_VERSIONS = {
+    "BumpedReply": 2,
+}
+
+
+@dataclass
+class PingRequest:
+    token: str
+    version: int = 0
+    # BAD: grafted beyond the frozen list — not elided, not
+    # version-gated; the previous release's decoder rejects the frame.
+    hops: int = 0
+    reply: Any = None               # never travels: skipped
+
+
+@dataclass
+class PongReply:
+    token: str
+    echo: bytes = b""
+    # BAD: elide-sanctioned but NO default — a legacy frame without the
+    # field cannot fill it (not format-transparent).
+    trace_id: str
+
+
+@dataclass
+class StatusRequest:
+    # Class line is BAD too: the elide registry names 'ghost_field',
+    # which does not exist here (registry drift).
+    KIND: ClassVar[str] = "status"
+    detail: int = 0
+    verbose: bool = False           # OK: elided at its default
+
+
+@dataclass
+class LegacyProbe:
+    # BAD (class line): frozen field 'deadline' no longer exists —
+    # frames encoded by the frozen format no longer decode.
+    probe_id: int = 0
+
+
+@dataclass
+class BumpedReply:
+    rows: List[bytes] = field(default_factory=list)
+    # OK: the _CODEC_VERSIONS bump sanctions the new field.
+    checksum: int = 0

@@ -51,6 +51,14 @@ class Server:
         self.waiters.append(p)      # handed off: the registry owns it
         return p.get_future()
 
+    def notify_all(self):
+        # The ownership-protocol consumer (FTL017): without this drain
+        # the append above would be a park into a registry nobody
+        # empties — exactly the hang the escape rule trusts away.
+        waiters, self.waiters = self.waiters, []
+        for p in waiters:
+            p.send(self.value)
+
     def ok_returned_whole(self):
         p = Promise()
         return p                    # handed off: the caller owns it
@@ -66,4 +74,4 @@ class Server:
         s.close()
         return s.pop()
 
-# expect: FTL016:37 FTL016:43 FTL016:59
+# expect: FTL016:37 FTL016:43 FTL016:67

@@ -32,7 +32,7 @@ from foundationdb_tpu.analysis.rules import make_rules
 
 EXPECT = re.compile(r"(FTL\d{3}):(\d+)")
 
-N_RULES = 16    # FTL001..FTL016 (FTL000 = unparseable-file pseudo-rule)
+N_RULES = 18    # FTL001..FTL018 (FTL000 = unparseable-file pseudo-rule)
 
 
 def _scan(roots, baseline=None):
@@ -2004,3 +2004,379 @@ def test_hash_order_canary_is_actually_sensitive():
     assert (a["unseed"], a["digest"]) != (b["unseed"], b["digest"]), (
         "canary failed to observe hash-order difference — it no longer "
         "guards the PYTHONHASHSEED pin")
+
+
+# ---------------------------------------------------------------------------
+# Container sensitivity & ownership protocol (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def test_container_lock_element_identity_named_in_finding(tmp_path):
+    """``with self._locks[shard]:`` enters the lockset as the ONE
+    may-alias element identity per container (``self._locks[*]``) —
+    before ISSUE 20 the subscripted receiver keyed as nothing and the
+    lock rules were blind to sharded locks entirely."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            class T:
+                def __init__(self):
+                    self._locks = {}
+
+                async def bad(self, k, fut):
+                    with self._locks[k]:
+                        await fut
+            """})
+    result = _scan([str(pkg)])
+    found = [f for f in result.new if f.rule == "FTL011"]
+    assert len(found) == 1 and "self._locks[*]" in found[0].message, \
+        [f.message for f in result.new]
+
+
+def test_container_lock_cycle_through_elements(tmp_path):
+    """FTL015 sees lock-order cycles THROUGH container elements: gate
+    then element in one method, element then gate in another, is a
+    cycle on the element identity."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._gate_lock = threading.Lock()
+                    self._locks = {}
+
+                def a(self, k):
+                    with self._gate_lock:
+                        with self._locks[k]:
+                            return 1
+
+                def b(self, k):
+                    with self._locks[k]:
+                        with self._gate_lock:
+                            return 1
+            """})
+    result = _scan([str(pkg)])
+    cycles = [f for f in result.new if f.rule == "FTL015"]
+    assert cycles and any("_locks[*]" in f.message for f in cycles), \
+        [f.message for f in result.new]
+
+
+def test_container_lock_elements_do_not_unify_across_classes(tmp_path):
+    """Element identities are allocation-site-owned (PR-13 style): two
+    classes both spelling ``self._locks[k]`` hold two DIFFERENT
+    containers' elements — opposite nesting against a shared module
+    lock is not a cycle."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            import threading
+
+            _MOD_LOCK = threading.Lock()
+
+            class A:
+                def __init__(self):
+                    self._locks = {}
+
+                def m(self, k):
+                    with _MOD_LOCK:
+                        with self._locks[k]:
+                            return 1
+
+            class B:
+                def __init__(self):
+                    self._locks = {}
+
+                def n(self, k):
+                    with self._locks[k]:
+                        with _MOD_LOCK:
+                            return 1
+            """})
+    result = _scan([str(pkg)])
+    assert [f for f in result.new if f.rule == "FTL015"] == [], \
+        [f.message for f in result.new]
+
+
+def test_optional_and_union_annotations_type_the_receiver(tmp_path):
+    """ISSUE 20 annotation lattice: ``Optional[C]``, ``C | None`` and
+    STRING forward references all feed the receiver type, so a typed
+    param's method call resolves and the blocking summary composes
+    through it (FTL013)."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            import threading
+            import time
+            from typing import Optional
+
+            class Worker:
+                def block(self):
+                    time.sleep(1)
+
+            def _poke_opt(w: Optional[Worker]):
+                if w is not None:
+                    w.block()
+
+            def _poke_str(w: "Worker | None"):
+                if w is not None:
+                    w.block()
+
+            class M:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def via_optional(self, w):
+                    with self._lock:
+                        _poke_opt(w)
+
+                def via_string_union(self, w):
+                    with self._lock:
+                        _poke_str(w)
+            """})
+    result = _scan([str(pkg)])
+    lines = sorted(f.line for f in result.new if f.rule == "FTL013")
+    assert len(lines) == 2, [f"{f.rule}:{f.line} {f.message}"
+                             for f in result.new]
+
+
+def test_dict_element_annotation_types_subscripted_receiver(tmp_path):
+    """``self._workers: Dict[str, Worker]`` gives the SUBSCRIPTED
+    receiver an element type: ``self._workers[k].block()`` resolves
+    through the selfelem texpr and the held-lock blocking chain fires
+    (FTL013)."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            import threading
+            import time
+            from typing import Dict
+
+            class Worker:
+                def block(self):
+                    time.sleep(1)
+
+            class M:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._workers: Dict[str, Worker] = {}
+
+                def bad(self, k):
+                    with self._lock:
+                        self._workers[k].block()
+            """})
+    result = _scan([str(pkg)])
+    found = [f for f in result.new if f.rule == "FTL013"]
+    assert len(found) == 1 and "block" in found[0].message, \
+        [f.message for f in result.new]
+
+
+_FTL017_PKG = {
+    "flow.py": """\
+        class Promise:
+            def send(self, value=None):
+                pass
+
+            def send_error(self, error=None):
+                pass
+
+            def get_future(self):
+                return self
+        """,
+    "registry.py": """\
+        from .flow import Promise
+
+        class Registry:
+            def __init__(self):
+                self._waiters = []
+
+            def subscribe(self):
+                p = Promise()
+                self._waiters.append(p)
+                return p.get_future()
+        """}
+
+_FTL017_DRAINER = """\
+    from .registry import Registry
+
+    class Drainer(Registry):
+        def flush(self, value):
+            for p in self._waiters:
+                p.send(value)
+            self._waiters.clear()
+    """
+
+
+def test_ftl017_fires_at_creation_line_and_drain_silences(tmp_path):
+    """The undrained registry fires AT THE CREATION LINE (where the
+    hang is debugged from); adding a drain anywhere in the package —
+    here a subclass in ANOTHER file, unified through the MRO field
+    identity — silences it with no suppression."""
+    pkg = _write_pkg(tmp_path, _FTL017_PKG)
+    result = _scan([str(pkg)])
+    assert [(f.rule, f.path, f.line) for f in result.new] == \
+        [("FTL017", "registry.py", 8)], [f.message for f in result.new]
+
+    (pkg / "drainer.py").write_text(textwrap.dedent(_FTL017_DRAINER))
+    result = _scan([str(pkg)])
+    assert result.new == [], [f.message for f in result.new]
+
+
+def test_ftl017_drain_deletion_refires(tmp_path):
+    """Deleting the one drain site re-fires the park — the sanction is
+    recomputed from the live program, never latched."""
+    pkg = _write_pkg(tmp_path, _FTL017_PKG)
+    (pkg / "drainer.py").write_text(textwrap.dedent(_FTL017_DRAINER))
+    assert _scan([str(pkg)]).new == []
+
+    (pkg / "drainer.py").write_text(textwrap.dedent("""\
+        from .registry import Registry
+
+        class Drainer(Registry):
+            def flush(self, value):
+                pass
+        """))
+    result = _scan([str(pkg)])
+    assert [(f.rule, f.line) for f in result.new] == [("FTL017", 8)], \
+        [f.message for f in result.new]
+
+
+def test_ftl017_owned_annotation_is_the_escape_hatch(tmp_path):
+    """``# flowlint: owned -- <why>`` on the CREATION line sanctions a
+    registry drained outside the package's sight — and only that line:
+    the un-annotated park in the same class still fires."""
+    pkg = _write_pkg(tmp_path, dict(_FTL017_PKG, **{
+        "registry.py": """\
+            from .flow import Promise
+
+            class Registry:
+                def __init__(self):
+                    self._waiters = []
+                    self._external = []
+
+                def subscribe(self):
+                    p = Promise()
+                    self._waiters.append(p)
+                    return p.get_future()
+
+                def adopt(self):
+                    q = Promise()  # flowlint: owned -- harness drains it
+                    self._external.append(q)
+                    return q.get_future()
+            """}))
+    result = _scan([str(pkg)])
+    assert [(f.rule, f.line) for f in result.new] == [("FTL017", 9)], \
+        [f.message for f in result.new]
+
+
+def test_summary_cache_staleness_guards_container_facts(tmp_path):
+    """ISSUE 20 satellite: the ownership protocol is only as sound as
+    the cache.  With the drain CACHED in a sibling file, (a) tampered
+    facts under a CURRENT stamp are served — the drain vanishes and
+    FTL017 fires, proving the facts really come from the cache; (b)
+    rolling the stamp back to a pre-upgrade value forces re-extraction
+    and the drain returns.  Both directions pin ANALYSIS_VERSION as
+    the thing that saves correctness after an extractor upgrade."""
+    pkg = _write_pkg(tmp_path, _FTL017_PKG)
+    (pkg / "drainer.py").write_text(textwrap.dedent(_FTL017_DRAINER))
+    cache = str(tmp_path / "cache.json")
+    args = [sys.executable, FLOWLINT, "--baseline", "none",
+            "--summary-cache", cache, str(pkg / "registry.py")]
+    out = subprocess.run(args, capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+    with open(cache) as f:
+        doc = json.load(f)
+    entry = next(e for rel, e in doc["files"].items()
+                 if rel.endswith("drainer.py"))
+    for fn in entry["facts"]["functions"].values():
+        fn["drains"] = []
+        fn["drain_forwards"] = []
+    with open(cache, "w") as f:
+        json.dump(doc, f)
+    out = subprocess.run(args, capture_output=True, text=True)
+    assert out.returncode == 1 and "FTL017" in out.stdout, (
+        "tampered cached container facts were NOT served — the cache "
+        "test has no teeth: " + out.stdout + out.stderr)
+
+    for e in doc["files"].values():
+        e["stamp"] = 1
+    with open(cache, "w") as f:
+        json.dump(doc, f)
+    out = subprocess.run(args, capture_output=True, text=True)
+    assert out.returncode == 0, (
+        "stale-stamp entry with doctored container facts was served: "
+        + out.stdout + out.stderr)
+
+
+def test_ftl018_real_wire_registry_is_clean():
+    """The shipped _GOLDEN_FROZEN_FIELDS registry matches the shipped
+    interface dataclasses exactly — no grafted field, no ghost elide,
+    no removed frozen field, with zero suppressions."""
+    result = _scan([
+        os.path.join(REPO, "foundationdb_tpu", "rpc", "serde.py"),
+        os.path.join(REPO, "foundationdb_tpu", "server",
+                     "interfaces.py")])
+    assert [f for f in result.new if f.rule == "FTL018"] == [], \
+        [f.message for f in result.new if f.rule == "FTL018"]
+
+
+def test_ftl007_real_span_points_are_clean():
+    """Every literal trace_batch_event location in the package follows
+    the Role.point grammar and every f-string location has a static
+    CamelCase head — the commit-debug waterfall keeps bucketing."""
+    result = _scan([os.path.join(REPO, "foundationdb_tpu")])
+    assert [f for f in result.new if f.rule == "FTL007"] == [], \
+        [f.message for f in result.new if f.rule == "FTL007"]
+
+
+def test_cli_stats_shape(tmp_path):
+    """--stats prints machine-parseable JSON to STDOUT (findings move
+    to stderr): per-rule finding/suppression counts for every shipped
+    rule and the scan/link/total phase timings."""
+    pkg = _write_pkg(tmp_path, {
+        "m.py": """\
+            import time
+
+            def f():
+                return time.time()
+            """})
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--baseline", "none", "--stats",
+         str(pkg)],
+        capture_output=True, text=True)
+    assert out.returncode == 1, out.stdout + out.stderr
+    stats = json.loads(out.stdout)
+    assert set(stats) == {"version", "files_scanned", "counts",
+                          "rules", "phases"}
+    assert set(stats["counts"]) == {"new", "baselined", "suppressed"}
+    assert set(stats["rules"]) == \
+        {f"FTL{i:03d}" for i in range(1, N_RULES + 1)}
+    assert stats["rules"]["FTL001"]["findings"] == 1
+    assert all(set(v) == {"findings", "suppressed"}
+               for v in stats["rules"].values())
+    assert set(stats["phases"]) == {"scan", "link", "total"}
+    assert all(isinstance(v, float) and v >= 0
+               for v in stats["phases"].values())
+    assert "FTL001" in out.stderr      # findings went to stderr
+
+
+def test_scan_time_budget(tmp_path):
+    """PERF budget as tier-1: the full-package scan stays under 5s
+    (phase-timed inside the process, startup excluded) and a warm
+    --changed pass under 1.5s wall — the edit-lint loop stays
+    interactive as rules accumulate."""
+    import time
+    cache = str(tmp_path / "cache.json")
+    target = os.path.join(REPO, "foundationdb_tpu")
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--summary-cache", cache, "--stats",
+         target],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    total = json.loads(out.stdout)["phases"]["total"]
+    assert total <= 5.0, f"full scan {total:.2f}s blew the 5s budget"
+
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, FLOWLINT, "--summary-cache", cache,
+         "--changed", "HEAD", target],
+        capture_output=True, text=True)
+    elapsed = time.monotonic() - t0
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert elapsed <= 1.5, (
+        f"warm --changed took {elapsed:.2f}s against the 1.5s budget")
